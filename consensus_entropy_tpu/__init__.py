@@ -1,0 +1,38 @@
+"""consensus_entropy_tpu — TPU-native consensus-entropy active learning.
+
+A brand-new JAX/XLA/Flax framework with the capability set of the reference
+implementation (juansgomez87/consensus-entropy, ISMIR 2021): query-by-committee
++ uncertainty-sampling active learning for personalized 4-class music emotion
+recognition.
+
+Architecture (TPU-first, not a port):
+
+- ``ops``       — the north-star fused scoring graph: committee probabilities →
+                  consensus mean → Shannon entropy → masked top-k, one jit'd XLA
+                  graph with fixed shapes so a shrinking pool never recompiles.
+- ``parallel``  — ``jax.sharding.Mesh`` construction and sharding rules: pool
+                  axis sharded across chips, committee axis vmap'd; collectives
+                  ride ICI via XLA (no hand-written NCCL/MPI analogue).
+- ``models``    — committee members. Flax ShortChunkCNN (jnp mel frontend) runs
+                  batched on TPU; classic sklearn members (GNB/SGD/XGB with
+                  warm-start class preservation) stay host-side and feed logits
+                  into the same on-device reduction.
+- ``al``        — the active-learning driver: acquisition modes mc/hc/mix/rand,
+                  per-user loop, incremental retraining, reporting, resume.
+- ``data``      — host data layer: AMG1608 annotations + human-consensus table,
+                  DEAM frame/annotation join, grouped splits, audio crop store.
+- ``train``     — DEAM pre-training (committee construction).
+
+Reference semantics are cited throughout as ``<file>:<line>`` into the
+reference repo; behavior is reimplemented, never copied (reference is AGPLv3).
+"""
+
+__version__ = "0.1.0"
+
+from consensus_entropy_tpu.config import (  # noqa: F401
+    ALConfig,
+    CNNConfig,
+    PathsConfig,
+    ScoringConfig,
+    TrainConfig,
+)

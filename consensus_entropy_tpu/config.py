@@ -1,0 +1,175 @@
+"""Typed configuration for the framework.
+
+Replaces the reference's flat star-imported constants module (``settings.py``,
+star-imported at ``amg_test.py:38`` / ``deam_classifier.py:38``) with frozen
+dataclasses.  Every default mirrors the reference value and cites its source.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Literal
+
+AcquisitionMode = Literal["mc", "hc", "mix", "rand"]
+
+#: Quadrant label codec — ``amg_test.py:54`` (``{'Q1': 0, ... 'Q4': 3}``).
+QUADRANT_TO_CLASS = {"Q1": 0, "Q2": 1, "Q3": 2, "Q4": 3}
+CLASS_TO_QUADRANT = {v: k for k, v in QUADRANT_TO_CLASS.items()}
+NUM_CLASSES = 4
+
+#: Feature-column slice bounds used for both DEAM and AMG openSMILE features
+#: (``amg_test.py:64``, ``deam_classifier.py:182-185``).
+FEATURE_SLICE_START = "F0final_sma_stddev"
+FEATURE_SLICE_STOP = "mfcc_sma_de[14]_amean"
+FEATURE_SLICE_STOP_FFTMAG = "pcm_fftMag_mfcc_sma_de[14]_amean"
+NUM_FEATURES = 260  # verified from the shipped GNB pickle (n_features_in_=260)
+
+
+@dataclasses.dataclass(frozen=True)
+class PathsConfig:
+    """Dataset / model-store locations (``settings.py:11-33``)."""
+
+    models_root: str = "./models"
+    deam_root: str = "./data/deam"
+    amg_root: str = "./data/amg1608"
+
+    @property
+    def pretrained_dir(self) -> str:
+        return os.path.join(self.models_root, "pretrained")
+
+    @property
+    def users_dir(self) -> str:
+        return os.path.join(self.models_root, "users")
+
+    @property
+    def deam_features_dir(self) -> str:
+        return os.path.join(self.deam_root, "features")
+
+    @property
+    def deam_dataset_csv(self) -> str:
+        return os.path.join(self.deam_root, "dataset_quads.csv")
+
+    @property
+    def deam_npy_dir(self) -> str:
+        return os.path.join(self.deam_root, "npy")
+
+    @property
+    def amg_features_dir(self) -> str:
+        return os.path.join(self.amg_root, "feats")
+
+    @property
+    def amg_dataset_csv(self) -> str:
+        return os.path.join(self.amg_root, "dataset_feats.csv")
+
+    @property
+    def amg_npy_dir(self) -> str:
+        return os.path.join(self.amg_root, "npy")
+
+    @property
+    def amg_annotations_mat(self) -> str:
+        return os.path.join(self.amg_root, "anno", "AMG1608.mat")
+
+    @property
+    def amg_mapping_mat(self) -> str:
+        return os.path.join(self.amg_root, "anno", "1608_song_id.mat")
+
+
+@dataclasses.dataclass(frozen=True)
+class CNNConfig:
+    """ShortChunkCNN architecture hyperparameters.
+
+    Mirrors ``short_cnn.py:284-291`` (constructor defaults) and
+    ``settings.py:36`` (``input_length``).  ``n_layers`` is configurable here
+    (the reference hard-codes 7) so tests can use tiny inputs.
+    """
+
+    n_channels: int = 128
+    sample_rate: int = 16000
+    n_fft: int = 512
+    hop_length: int = 256  # torchaudio default: n_fft // 2
+    f_min: float = 0.0
+    f_max: float = 8000.0
+    n_mels: int = 128
+    n_class: int = NUM_CLASSES
+    n_layers: int = 7
+    input_length: int = 59049  # ~3.69 s @ 16 kHz
+    dropout_rate: float = 0.5
+    #: Compute dtype for conv/dense (MXU-friendly); params stay float32.
+    compute_dtype: str = "float32"
+
+    @property
+    def channel_widths(self) -> tuple[int, ...]:
+        """Per-layer output channels: 128,128,256,256,256,256,512 for the
+        default config (``short_cnn.py:304-310``)."""
+        widths = []
+        for i in range(self.n_layers):
+            if i < 2:
+                widths.append(self.n_channels)
+            elif i < self.n_layers - 1:
+                widths.append(self.n_channels * 2)
+            else:
+                widths.append(self.n_channels * 4)
+        return tuple(widths)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """CNN training hyperparameters (``settings.py:36-42``)."""
+
+    n_epochs: int = 200  # pre-training (n_epochs_cnn)
+    n_epochs_retrain: int = 100  # AL incremental retraining
+    batch_size: int = 5
+    lr: float = 1e-4
+    weight_decay: float = 1e-4  # Adam weight_decay (amg_test.py:281)
+    log_step: int = 20
+    #: Stale-epoch counts before each optimizer transition.  Pre-training uses
+    #: 40 for the adam→sgd step (``deam_classifier.py:150``); retraining uses
+    #: 20 (``amg_test.py:205``).  Subsequent lr drops are always 20 epochs.
+    adam_patience: int = 20
+    sgd_patience: int = 20
+    sgd_momentum: float = 0.9
+    sgd_weight_decay: float = 1e-4
+    sgd_lrs: tuple[float, ...] = (1e-3, 1e-4, 1e-5)
+
+
+@dataclasses.dataclass(frozen=True)
+class ALConfig:
+    """Active-learning experiment parameters (CLI surface of
+    ``amg_test.py:545-573``)."""
+
+    queries: int = 10  # -q
+    epochs: int = 10  # -e
+    mode: AcquisitionMode = "mc"  # -m
+    num_anno: int = 150  # -n: min annotations per user
+    train_size: float = 0.85  # GroupShuffleSplit (amg_test.py:363)
+    seed: int = 1987  # amg_test.py:55 (global numpy seed in the reference)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScoringConfig:
+    """Configuration of the fused pool-scoring graph (the north-star kernel).
+
+    ``pad_pool_to`` fixes the pool axis so the jit graph never recompiles as
+    the pool shrinks by ``queries`` songs per AL iteration — invalidated songs
+    are masked instead (SURVEY.md §7 hard part 1).
+    """
+
+    pad_pool_to: int = 2048
+    #: Tie policy for the ``np.argsort(ent)[::-1]`` ranking (``amg_test.py:445``;
+    #: the reference's own tie order is implementation-defined introsort).
+    #: 'numpy' = reversed stable sort (highest index wins ties); 'fast' =
+    #: ``lax.top_k`` (lowest index wins).  Entropy values identical either way.
+    tie_break: Literal["numpy", "fast"] = "fast"
+    compute_dtype: str = "float32"
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Top-level aggregate."""
+
+    paths: PathsConfig = dataclasses.field(default_factory=PathsConfig)
+    cnn: CNNConfig = dataclasses.field(default_factory=CNNConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    al: ALConfig = dataclasses.field(default_factory=ALConfig)
+    scoring: ScoringConfig = dataclasses.field(default_factory=ScoringConfig)

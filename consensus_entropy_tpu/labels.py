@@ -1,0 +1,106 @@
+"""Quadrant geometry and label codecs.
+
+The reference contains *two* subtly different arousal/valence → quadrant
+mappings; both are reproduced here exactly and documented side by side
+(SURVEY.md §7 step 1):
+
+- **AMG variant** (``amg_test.py:69-78``) — boundary-asymmetric::
+
+      Q1:  a >= 0 and v >= 0
+      Q2:  a >  0 and v <  0
+      Q3:  a <= 0 and v <= 0
+      Q4:  a <  0 and v >  0
+
+  Axis points resolve as: (a=0, v<0) → Q3, (a>0, v=0) → Q1, (a=0, v>0) → Q1,
+  (a<0, v=0) → Q3.
+
+- **DEAM variant** (``deam_classifier.py:90-97``) — half-open on arousal::
+
+      Q1:  a >= 0 and v >= 0
+      Q2:  a >= 0 and v <  0
+      Q3:  a <  0 and v <  0
+      Q4:  a <  0 and v >= 0
+
+Note the reference's quadrant naming is nonstandard (its "valence" column is
+the first ``song_label`` component and quadrants rotate clockwise from Q1);
+we replicate the observed predicate order verbatim rather than re-deriving
+from circumplex convention.
+
+All functions are pure, vectorized, and jit-safe (``jnp`` ops only), with
+numpy twins for host-side dataframe work.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from consensus_entropy_tpu.config import NUM_CLASSES, QUADRANT_TO_CLASS
+
+
+def quadrant_amg(arousal, valence):
+    """AMG-variant quadrant as int class (Q1..Q4 → 0..3), jit-safe.
+
+    Matches ``amg_test.py:69-78`` exactly, including boundary behavior.
+    """
+    a = jnp.asarray(arousal)
+    v = jnp.asarray(valence)
+    q1 = (a >= 0) & (v >= 0)
+    q2 = (a > 0) & (v < 0)
+    q3 = (a <= 0) & (v <= 0)
+    # Q4 = complement: a < 0 and v > 0
+    return jnp.where(q1, 0, jnp.where(q2, 1, jnp.where(q3, 2, 3))).astype(jnp.int32)
+
+
+def quadrant_deam(arousal, valence):
+    """DEAM-variant quadrant as int class (Q1..Q4 → 0..3), jit-safe.
+
+    Matches ``deam_classifier.py:90-97`` exactly.
+    """
+    a = jnp.asarray(arousal)
+    v = jnp.asarray(valence)
+    q1 = (a >= 0) & (v >= 0)
+    q2 = (a >= 0) & (v < 0)
+    q3 = (a < 0) & (v < 0)
+    return jnp.where(q1, 0, jnp.where(q2, 1, jnp.where(q3, 2, 3))).astype(jnp.int32)
+
+
+def quadrant_amg_np(arousal, valence) -> np.ndarray:
+    """Numpy twin of :func:`quadrant_amg` for host dataframe pipelines."""
+    a = np.asarray(arousal)
+    v = np.asarray(valence)
+    q1 = (a >= 0) & (v >= 0)
+    q2 = (a > 0) & (v < 0)
+    q3 = (a <= 0) & (v <= 0)
+    return np.where(q1, 0, np.where(q2, 1, np.where(q3, 2, 3))).astype(np.int32)
+
+
+def quadrant_deam_np(arousal, valence) -> np.ndarray:
+    """Numpy twin of :func:`quadrant_deam`."""
+    a = np.asarray(arousal)
+    v = np.asarray(valence)
+    q1 = (a >= 0) & (v >= 0)
+    q2 = (a >= 0) & (v < 0)
+    q3 = (a < 0) & (v < 0)
+    return np.where(q1, 0, np.where(q2, 1, np.where(q3, 2, 3))).astype(np.int32)
+
+
+def class_to_name(c: int) -> str:
+    return f"Q{int(c) + 1}"
+
+
+def names_to_classes(names) -> np.ndarray:
+    """Vectorized 'Q1'..'Q4' → 0..3 (codec at ``amg_test.py:54``)."""
+    return np.asarray([QUADRANT_TO_CLASS[n] for n in names], dtype=np.int32)
+
+
+def one_hot(classes, num_classes: int = NUM_CLASSES):
+    """One-hot targets as float32 (``short_cnn.py:356-359`` uses unit rows;
+    the CNN trains with BCE on these)."""
+    c = jnp.asarray(classes)
+    return (c[..., None] == jnp.arange(num_classes)).astype(jnp.float32)
+
+
+def one_hot_np(classes, num_classes: int = NUM_CLASSES) -> np.ndarray:
+    c = np.asarray(classes)
+    return (c[..., None] == np.arange(num_classes)).astype(np.float32)

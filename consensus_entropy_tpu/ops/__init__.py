@@ -1,0 +1,11 @@
+"""Device-side ops: the fused consensus-entropy scoring graph and its pieces."""
+
+from consensus_entropy_tpu.ops.entropy import shannon_entropy  # noqa: F401
+from consensus_entropy_tpu.ops.topk import masked_top_k  # noqa: F401
+from consensus_entropy_tpu.ops.scoring import (  # noqa: F401
+    consensus_mean,
+    score_hc,
+    score_mc,
+    score_mix,
+    make_scoring_fns,
+)

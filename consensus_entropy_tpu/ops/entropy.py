@@ -1,0 +1,46 @@
+"""Shannon entropy with ``scipy.stats.entropy`` parity, jit-safe.
+
+The reference computes acquisition scores with ``scipy.stats.entropy(pk,
+axis=1)`` (``amg_test.py:443,451,479``), whose semantics are:
+
+1. normalize ``pk`` to sum to 1 along ``axis``;
+2. return ``-sum(p * log(p))`` in **nats** with the convention
+   ``0 * log(0) = 0``.
+
+This module reproduces those semantics in pure ``jnp`` so the entropy lives
+inside the fused scoring graph (no host round-trip per AL iteration, unlike
+the reference which calls scipy on a freshly gathered numpy array every
+iteration).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def shannon_entropy(pk, axis: int = -1):
+    """Entropy of (unnormalized) distributions along ``axis``, in nats.
+
+    Parity target: ``scipy.stats.entropy(pk, axis=axis)`` for non-negative
+    finite inputs.  Rows that sum to zero return NaN, as scipy does.
+    """
+    pk = jnp.asarray(pk)
+    total = jnp.sum(pk, axis=axis, keepdims=True)
+    p = pk / total
+    # 0*log(0) := 0.  `where` keeps the gradient/NaN story clean: log is only
+    # evaluated where p > 0.
+    plogp = jnp.where(p > 0, p * jnp.log(jnp.where(p > 0, p, 1.0)), 0.0)
+    return -jnp.sum(plogp, axis=axis)
+
+
+def masked_entropy(pk, valid_mask, axis: int = -1, fill: float = -jnp.inf):
+    """Entropy per row with invalid rows replaced by ``fill``.
+
+    ``valid_mask`` has the shape of ``pk`` minus ``axis``.  Invalid rows (the
+    padding that keeps the scoring graph's shapes static while the pool
+    shrinks) are forced to ``fill`` (default ``-inf``) so top-k never selects
+    them — this is what lets the AL loop drop q songs per iteration without
+    an XLA recompile (SURVEY.md §7 hard part 1).
+    """
+    ent = shannon_entropy(pk, axis=axis)
+    return jnp.where(valid_mask, ent, fill)

@@ -1,0 +1,134 @@
+"""The fused pool-scoring graph — the framework's north-star kernel.
+
+Reference semantics being fused (one jit'd XLA graph instead of a per-member
+Python loop with disk reloads and host scipy calls):
+
+- **mc** (``amg_test.py:425-447``): committee ``predict_proba`` → mean across
+  members → Shannon entropy across classes → top-q songs.
+- **hc** (``amg_test.py:449-455``): entropy of the human-consensus frequency
+  table rows → top-q (queried rows are subsequently masked out by the caller).
+- **mix** (``amg_test.py:457-484``): stack the mc consensus rows and the
+  remaining hc rows into one matrix (song ids may repeat across the two
+  blocks), entropy over all rows, top-q rows.
+- **rand** (``amg_test.py:486-489``): uniform shuffle — implemented here as
+  scoring with uniform random keys so it shares the masked-top-k machinery.
+
+Shape/masking contract (SURVEY.md §7 hard part 1): the pool axis is padded to
+a fixed ``N`` and every function takes a boolean ``pool_mask``; shrinking the
+pool (q songs removed per AL iteration) only flips mask bits, so XLA compiles
+each scoring function exactly once per run.
+
+All functions are pure and shard-agnostic: the ``parallel`` package overlays
+``NamedSharding`` constraints to split the pool axis across TPU chips, and
+XLA inserts the ICI collectives (the mean/entropy are row-local; only top-k
+induces a gather).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from consensus_entropy_tpu.ops.entropy import masked_entropy
+from consensus_entropy_tpu.ops.topk import masked_top_k
+
+
+class ScoreResult(NamedTuple):
+    """Result of one acquisition scoring pass.
+
+    ``entropy`` is the per-row masked score (−inf on padding), ``values`` /
+    ``indices`` the top-k rows.  For ``mix`` the row space is the
+    concatenation ``[mc rows (N); hc rows (N)]`` and ``indices`` live in
+    ``[0, 2N)``; use :func:`split_mix_index` to recover block + song slot.
+    """
+
+    entropy: jax.Array
+    values: jax.Array
+    indices: jax.Array
+
+
+def consensus_mean(member_probs, member_mask=None):
+    """Mean class distribution across the committee axis.
+
+    ``member_probs``: ``(M, N, C)`` stacked per-member probabilities (CNN
+    members computed on device, sklearn members fed from host).
+    ``member_mask``: optional ``(M,)`` bool — lets one compiled graph serve
+    committees of varying size (padded members contribute nothing).
+
+    Parity: ``np.mean(np.array(pred_prob), axis=0)`` (``amg_test.py:441``).
+    """
+    p = jnp.asarray(member_probs)
+    if member_mask is None:
+        return jnp.mean(p, axis=0)
+    m = jnp.asarray(member_mask)
+    w = m.astype(p.dtype)[:, None, None]
+    return jnp.sum(p * w, axis=0) / jnp.sum(w)
+
+
+def score_mc(member_probs, pool_mask, *, k: int, member_mask=None,
+             tie_break: str = "fast") -> ScoreResult:
+    """Machine-consensus acquisition: fused mean → entropy → top-k."""
+    consensus = consensus_mean(member_probs, member_mask)
+    ent = masked_entropy(consensus, pool_mask)
+    values, indices = masked_top_k(ent, pool_mask, k, tie_break)
+    return ScoreResult(ent, values, indices)
+
+
+def score_hc(hc_freq, hc_mask, *, k: int, tie_break: str = "fast") -> ScoreResult:
+    """Human-consensus acquisition: entropy of annotator-frequency rows."""
+    ent = masked_entropy(hc_freq, hc_mask)
+    values, indices = masked_top_k(ent, hc_mask, k, tie_break)
+    return ScoreResult(ent, values, indices)
+
+
+def score_mix(member_probs, pool_mask, hc_freq, hc_mask, *, k: int,
+              member_mask=None, tie_break: str = "fast") -> ScoreResult:
+    """Hybrid acquisition: entropy over stacked [mc consensus; hc rows].
+
+    Mirrors ``pd.concat([consensus_prob_mc, this_consensus_hc])`` + entropy +
+    top-q (``amg_test.py:473-481``).  The same song can appear in both blocks
+    (and thus twice in the top-k), exactly as in the reference.
+    """
+    consensus = consensus_mean(member_probs, member_mask)
+    stacked = jnp.concatenate([consensus, jnp.asarray(hc_freq)], axis=0)
+    stacked_mask = jnp.concatenate(
+        [jnp.asarray(pool_mask), jnp.asarray(hc_mask)], axis=0)
+    ent = masked_entropy(stacked, stacked_mask)
+    values, indices = masked_top_k(ent, stacked_mask, k, tie_break)
+    return ScoreResult(ent, values, indices)
+
+
+def split_mix_index(indices, n_pool: int):
+    """Map mix-space row indices back to (is_hc_block, song_slot)."""
+    indices = jnp.asarray(indices)
+    return indices >= n_pool, jnp.where(indices >= n_pool,
+                                        indices - n_pool, indices)
+
+
+def score_rand(key, pool_mask, *, k: int) -> ScoreResult:
+    """Random acquisition baseline (``amg_test.py:486-489``): a uniform
+    shuffle of the valid pool expressed as top-k over uniform scores, so it
+    reuses the same masked machinery and stays on device."""
+    pool_mask = jnp.asarray(pool_mask)
+    scores = jax.random.uniform(key, pool_mask.shape)
+    values, indices = masked_top_k(scores, pool_mask, k, "fast")
+    return ScoreResult(scores, values, indices)
+
+
+def make_scoring_fns(*, k: int, tie_break: str = "fast",
+                     donate: bool = False) -> dict[str, Callable]:
+    """Jit-compile the four acquisition scorers with ``k`` baked in.
+
+    Returns ``{'mc': fn, 'hc': fn, 'mix': fn, 'rand': fn}``.  Each fn is a
+    ``jax.jit`` with static top-k width; callers pass device (or to-be-
+    transferred host) arrays and get a :class:`ScoreResult` of device arrays.
+    """
+    mc = jax.jit(functools.partial(score_mc, k=k, tie_break=tie_break))
+    hc = jax.jit(functools.partial(score_hc, k=k, tie_break=tie_break))
+    mix = jax.jit(functools.partial(score_mix, k=k, tie_break=tie_break))
+    rand = jax.jit(functools.partial(score_rand, k=k))
+    del donate  # reserved: buffer donation lands with the pipelined driver
+    return {"mc": mc, "hc": hc, "mix": mix, "rand": rand}

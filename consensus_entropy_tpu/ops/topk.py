@@ -1,0 +1,50 @@
+"""Masked top-k ranking with reference-parity tie semantics.
+
+The reference ranks acquisition scores with ``np.argsort(ent)[::-1][:q]``
+(``amg_test.py:445,452,480``).  numpy's default argsort is an unstable
+introsort, so the reference's order among *tied* scores is implementation-
+defined — there is nothing exact to be parity with.  Two deterministic
+policies are provided (identical on distinct scores):
+
+- ``'fast'``  — ``lax.top_k``: lowest index wins ties.
+- ``'numpy'`` — reversed **stable** ascending sort, i.e.
+  ``np.argsort(ent, kind='stable')[::-1][:q]``: highest index wins ties.
+
+``k`` must be static under jit (it is the CLI ``-q`` flag, fixed per run).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def masked_top_k(scores, valid_mask, k: int, tie_break: str = "fast"):
+    """Top-k indices of ``scores`` restricted to ``valid_mask``.
+
+    Returns ``(values, indices)`` each of shape ``(k,)``.  Masked entries are
+    treated as ``-inf`` and therefore rank last; if fewer than ``k`` entries
+    are valid, trailing results have ``values == -inf`` (callers use
+    ``values > -inf`` — see :func:`valid_count` — to trim).
+
+    tie_break:
+      - ``'fast'``  — ``lax.top_k`` (lowest index first among ties).
+      - ``'numpy'`` — ``np.argsort(scores, kind='stable')[::-1][:k]``
+        (highest index first among ties).
+    """
+    scores = jnp.asarray(scores)
+    neg_inf = jnp.asarray(-jnp.inf, dtype=scores.dtype)
+    masked = jnp.where(valid_mask, scores, neg_inf)
+    if tie_break == "fast":
+        return lax.top_k(masked, k)
+    if tie_break == "numpy":
+        # Stable ascending argsort, reversed == numpy's argsort()[::-1].
+        order = jnp.argsort(masked, stable=True)[::-1]
+        idx = order[:k]
+        return masked[idx], idx
+    raise ValueError(f"unknown tie_break: {tie_break!r}")
+
+
+def valid_count(values) -> jnp.ndarray:
+    """How many of the returned top-k slots hold real (unmasked) entries."""
+    return jnp.sum(values > -jnp.inf)

@@ -1,0 +1,20 @@
+"""Device-mesh construction and sharding rules.
+
+The reference has **no** distributed backend (SURVEY.md §2: no NCCL/MPI/Gloo;
+single process, one optional CUDA GPU).  The TPU-native scale story is built
+here instead: a ``jax.sharding.Mesh`` whose ``pool`` axis splits the unlabeled
+pool across chips and whose ``member``/``dp`` axes parallelize committee
+training — with XLA emitting the ICI collectives.
+"""
+
+from consensus_entropy_tpu.parallel.mesh import (  # noqa: F401
+    POOL_AXIS,
+    MEMBER_AXIS,
+    DP_AXIS,
+    make_pool_mesh,
+    make_training_mesh,
+)
+from consensus_entropy_tpu.parallel.sharding import (  # noqa: F401
+    make_sharded_scoring_fns,
+    make_shardmap_mc_scorer,
+)

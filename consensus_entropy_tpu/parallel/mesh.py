@@ -1,0 +1,68 @@
+"""Mesh construction.
+
+Axis vocabulary (used consistently across the framework):
+
+- ``pool``   — the unlabeled-pool axis (N songs).  This is where scale lives
+  in this problem (SURVEY.md §5: "Scale in this problem is along the pool
+  axis, not sequence"); sharded across chips for scoring.
+- ``member`` — the committee axis (M models).  CNN members are stacked
+  pytrees ``vmap``'d over this axis; sharding it parallelizes committee
+  retraining (each chip trains a subset of members).
+- ``dp``     — batch data-parallel axis for CNN (re)training.
+
+Sequence/context parallelism (ring attention, Ulysses) is genuinely N/A —
+there is no attention anywhere in the model family (largest member is a
+~10M-param CNN on 3.69 s audio crops); documented rather than silently
+omitted, per SURVEY.md §2.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+POOL_AXIS = "pool"
+MEMBER_AXIS = "member"
+DP_AXIS = "dp"
+
+
+def make_pool_mesh(devices=None) -> Mesh:
+    """1-D mesh over all (or the given) devices, pool axis only.
+
+    Used by the scoring path: committee probs ``(M, N, C)`` are sharded on
+    ``N``; the consensus mean and entropy are row-local (zero communication),
+    and only the final top-k gathers ``k`` candidates per chip over ICI.
+    """
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (POOL_AXIS,))
+
+
+def make_training_mesh(dp: int | None = None, member: int | None = None,
+                       devices=None) -> Mesh:
+    """2-D ``(dp, member)`` mesh for committee training.
+
+    Default factorization: put as many chips as divide the committee on the
+    ``member`` axis and the rest on ``dp``.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if dp is None and member is None:
+        member = _largest_divisor_at_most(n, 4)
+        dp = n // member
+    elif dp is None:
+        dp = n // member  # type: ignore[operator]
+    elif member is None:
+        member = n // dp
+    if dp * member != n:
+        raise ValueError(f"dp*member = {dp}*{member} != {n} devices")
+    return Mesh(np.asarray(devices).reshape(dp, member), (DP_AXIS, MEMBER_AXIS))
+
+
+def _largest_divisor_at_most(n: int, cap: int) -> int:
+    for d in range(min(cap, n), 0, -1):
+        if n % d == 0:
+            return d
+    return 1

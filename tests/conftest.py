@@ -1,0 +1,32 @@
+"""Test harness: force an 8-device virtual CPU platform before JAX initializes.
+
+This substitutes for multi-chip hardware (SURVEY.md §4d): every sharding /
+collective test runs against a real 8-way mesh of host devices, which is the
+same code path XLA uses on a TPU slice (minus ICI).
+"""
+
+import os
+import sys
+
+# Must happen before the first backend initialization anywhere in the test
+# session.  This environment's JAX build hard-defaults jax_platforms to the
+# TPU plugin and ignores JAX_PLATFORMS/XLA_FLAGS env vars, so the config API
+# is the only reliable override.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1987)

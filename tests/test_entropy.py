@@ -1,0 +1,64 @@
+"""Entropy kernel: scipy.stats.entropy parity (the reference's scorer)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from scipy.stats import entropy as scipy_entropy
+
+from consensus_entropy_tpu.ops.entropy import masked_entropy, shannon_entropy
+
+
+def test_matches_scipy_on_random_rows(rng):
+    pk = rng.uniform(0.0, 1.0, size=(64, 4))
+    got = np.asarray(shannon_entropy(pk, axis=1))
+    want = scipy_entropy(pk, axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_matches_scipy_unnormalized_and_axis0(rng):
+    pk = rng.uniform(0.0, 5.0, size=(4, 33))
+    np.testing.assert_allclose(
+        np.asarray(shannon_entropy(pk, axis=0)), scipy_entropy(pk, axis=0),
+        rtol=1e-4)
+
+
+def test_zero_entries_convention():
+    # 0*log(0) = 0, exactly scipy's convention.
+    pk = np.array([[0.5, 0.5, 0.0, 0.0], [1.0, 0.0, 0.0, 0.0]])
+    got = np.asarray(shannon_entropy(pk, axis=1))
+    np.testing.assert_allclose(got, [np.log(2.0), 0.0], atol=1e-5)
+
+
+def test_uniform_is_log_c():
+    pk = np.full((3, 4), 0.25)
+    np.testing.assert_allclose(
+        np.asarray(shannon_entropy(pk, axis=1)), np.log(4.0), rtol=1e-4)
+
+
+def test_hc_rounding_parity(rng):
+    # The HC table is built from frequencies rounded to 3 decimals
+    # (amg_test.py:115); rows then no longer sum to exactly 1.  scipy
+    # renormalizes — ours must too.
+    counts = rng.integers(0, 20, size=(50, 4)) + 1
+    freq = np.round(counts / counts.sum(axis=1, keepdims=True), 3)
+    np.testing.assert_allclose(
+        np.asarray(shannon_entropy(freq, axis=1)),
+        scipy_entropy(freq, axis=1), rtol=1e-4)
+
+
+def test_masked_entropy_fills_invalid(rng):
+    pk = rng.uniform(0.1, 1.0, size=(8, 4))
+    mask = np.array([True, False] * 4)
+    ent = np.asarray(masked_entropy(pk, mask, axis=-1))
+    assert np.all(np.isneginf(ent[~mask]))
+    np.testing.assert_allclose(ent[mask], scipy_entropy(pk, axis=1)[mask],
+                               rtol=1e-4)
+
+
+def test_jit_and_grad():
+    pk = jnp.asarray([[0.2, 0.3, 0.1, 0.4]])
+    ent = jax.jit(shannon_entropy)(pk)
+    np.testing.assert_allclose(np.asarray(ent), scipy_entropy(np.asarray(pk), axis=1),
+                               rtol=1e-4)
+    g = jax.grad(lambda p: shannon_entropy(p, axis=-1).sum())(pk)
+    assert np.all(np.isfinite(np.asarray(g)))

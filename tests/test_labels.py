@@ -1,0 +1,83 @@
+"""Quadrant geometry: exact parity with both reference mappings."""
+
+import numpy as np
+import pytest
+
+from consensus_entropy_tpu import labels
+
+
+def _ref_amg(a, v):
+    # Oracle: the predicate chain at amg_test.py:69-78, re-expressed.
+    if a >= 0 and v >= 0:
+        return 0
+    elif a > 0 and v < 0:
+        return 1
+    elif a <= 0 and v <= 0:
+        return 2
+    elif a < 0 and v > 0:
+        return 3
+    raise AssertionError("unreachable")
+
+
+def _ref_deam(a, v):
+    # Oracle: deam_classifier.py:90-97, re-expressed.
+    if a >= 0 and v >= 0:
+        return 0
+    elif a >= 0 and v < 0:
+        return 1
+    elif a < 0 and v < 0:
+        return 2
+    elif a < 0 and v >= 0:
+        return 3
+    raise AssertionError("unreachable")
+
+
+GRID = [-1.0, -0.5, 0.0, 0.5, 1.0]
+
+
+@pytest.mark.parametrize("a", GRID)
+@pytest.mark.parametrize("v", GRID)
+def test_amg_matches_reference_predicates(a, v):
+    assert int(labels.quadrant_amg(a, v)) == _ref_amg(a, v)
+    assert int(labels.quadrant_amg_np(a, v)) == _ref_amg(a, v)
+
+
+@pytest.mark.parametrize("a", GRID)
+@pytest.mark.parametrize("v", GRID)
+def test_deam_matches_reference_predicates(a, v):
+    assert int(labels.quadrant_deam(a, v)) == _ref_deam(a, v)
+    assert int(labels.quadrant_deam_np(a, v)) == _ref_deam(a, v)
+
+
+def test_boundary_asymmetries_documented():
+    # The two mappings genuinely disagree on the negative-valence arousal axis:
+    # (a=0, v<0): AMG→Q3, DEAM→Q2.  (a<0, v=0): AMG→Q3, DEAM→Q4.
+    assert int(labels.quadrant_amg(0.0, -1.0)) == 2
+    assert int(labels.quadrant_deam(0.0, -1.0)) == 1
+    assert int(labels.quadrant_amg(-1.0, 0.0)) == 2
+    assert int(labels.quadrant_deam(-1.0, 0.0)) == 3
+
+
+def test_vectorized_random(rng):
+    a = rng.uniform(-2, 2, size=500)
+    v = rng.uniform(-2, 2, size=500)
+    expect_amg = np.array([_ref_amg(x, y) for x, y in zip(a, v)])
+    expect_deam = np.array([_ref_deam(x, y) for x, y in zip(a, v)])
+    np.testing.assert_array_equal(np.asarray(labels.quadrant_amg(a, v)), expect_amg)
+    np.testing.assert_array_equal(labels.quadrant_amg_np(a, v), expect_amg)
+    np.testing.assert_array_equal(np.asarray(labels.quadrant_deam(a, v)), expect_deam)
+    np.testing.assert_array_equal(labels.quadrant_deam_np(a, v), expect_deam)
+
+
+def test_one_hot_roundtrip(rng):
+    c = rng.integers(0, 4, size=32)
+    oh = labels.one_hot_np(c)
+    assert oh.shape == (32, 4)
+    np.testing.assert_array_equal(oh.argmax(axis=1), c)
+    np.testing.assert_array_equal(np.asarray(labels.one_hot(c)), oh)
+
+
+def test_name_codec():
+    assert labels.class_to_name(0) == "Q1"
+    np.testing.assert_array_equal(
+        labels.names_to_classes(["Q1", "Q4", "Q2"]), [0, 3, 1])

@@ -1,0 +1,98 @@
+"""Sharded scoring on an 8-device virtual CPU mesh: parity with the
+single-device graph, and the explicit shard_map two-stage top-k."""
+
+import jax
+import numpy as np
+import pytest
+from scipy.stats import entropy as scipy_entropy
+
+from consensus_entropy_tpu.ops import scoring
+from consensus_entropy_tpu.parallel import (
+    make_pool_mesh,
+    make_sharded_scoring_fns,
+    make_shardmap_mc_scorer,
+    make_training_mesh,
+)
+from consensus_entropy_tpu.parallel.sharding import pad_pool
+
+
+def _probs(rng, m, n, c=4):
+    p = rng.uniform(0.01, 1.0, size=(m, n, c)).astype(np.float32)
+    return p / p.sum(axis=-1, keepdims=True)
+
+
+def test_eight_devices_present():
+    assert len(jax.devices()) == 8
+
+
+def test_pool_mesh_shape():
+    mesh = make_pool_mesh()
+    assert mesh.shape == {"pool": 8}
+
+
+def test_training_mesh_factorization():
+    mesh = make_training_mesh()
+    assert mesh.shape["dp"] * mesh.shape["member"] == 8
+    mesh2 = make_training_mesh(dp=8, member=1)
+    assert mesh2.shape == {"dp": 8, "member": 1}
+    with pytest.raises(ValueError):
+        make_training_mesh(dp=3, member=3)
+
+
+def test_sharded_mc_matches_single_device(rng):
+    mesh = make_pool_mesh()
+    fns = make_sharded_scoring_fns(mesh, k=10)
+    p = _probs(rng, 16, 512)
+    mask = np.ones(512, dtype=bool)
+    mask[400:] = False
+    res = fns["mc"](p, mask)
+    ref = scoring.score_mc(p, mask, k=10, tie_break="fast")
+    np.testing.assert_allclose(np.asarray(res.entropy)[:400],
+                               np.asarray(ref.entropy)[:400], rtol=1e-4)
+    np.testing.assert_array_equal(np.asarray(res.indices),
+                                  np.asarray(ref.indices))
+
+
+def test_sharded_hc_and_mix(rng):
+    mesh = make_pool_mesh()
+    fns = make_sharded_scoring_fns(mesh, k=6)
+    counts = rng.integers(1, 30, size=(256, 4))
+    hc = (counts / counts.sum(axis=1, keepdims=True)).astype(np.float32)
+    hc_mask = np.ones(256, dtype=bool)
+    res = fns["hc"](hc, hc_mask)
+    ent_ref = scipy_entropy(hc, axis=1)
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(res.indices)),
+        np.sort(np.argsort(ent_ref)[::-1][:6]))
+
+    p = _probs(rng, 4, 256)
+    pool_mask = np.ones(256, dtype=bool)
+    res_mix = fns["mix"](p, pool_mask, hc, hc_mask)
+    ref_mix = scoring.score_mix(p, pool_mask, hc, hc_mask, k=6,
+                                tie_break="fast")
+    np.testing.assert_array_equal(np.asarray(res_mix.indices),
+                                  np.asarray(ref_mix.indices))
+
+
+def test_shardmap_two_stage_topk(rng):
+    mesh = make_pool_mesh()
+    scorer = make_shardmap_mc_scorer(mesh, k=12)
+    p = _probs(rng, 8, 1024)
+    mask = np.ones(1024, dtype=bool)
+    mask[1000:] = False
+    res = scorer(p, mask)
+    ref = scoring.score_mc(p, mask, k=12, tie_break="fast")
+    np.testing.assert_allclose(np.asarray(res.values), np.asarray(ref.values),
+                               rtol=1e-4)
+    np.testing.assert_array_equal(np.asarray(res.indices),
+                                  np.asarray(ref.indices))
+
+
+def test_pad_pool_helper(rng):
+    x = rng.uniform(size=(100, 4))
+    (xp,), mask = pad_pool([x], 100, 256)
+    assert xp.shape == (256, 4)
+    assert mask.sum() == 100
+    np.testing.assert_array_equal(xp[:100], x)
+    with pytest.raises(ValueError):
+        pad_pool([x], 100, 64)

@@ -1,0 +1,55 @@
+"""Masked top-k: reference tie semantics (np.argsort reversed) and masking."""
+
+import numpy as np
+
+from consensus_entropy_tpu.ops.topk import masked_top_k, valid_count
+
+
+def _ref_rank(scores, q):
+    # amg_test.py:445 is np.argsort(ent)[::-1][:q]; numpy's default introsort
+    # makes its tie order implementation-defined, so our deterministic
+    # analogue pins kind='stable'.
+    return np.argsort(scores, kind="stable")[::-1][:q]
+
+
+def test_numpy_tie_break_exact(rng):
+    scores = rng.uniform(size=100).round(1)  # force ties
+    mask = np.ones(100, dtype=bool)
+    _, idx = masked_top_k(scores, mask, 10, tie_break="numpy")
+    np.testing.assert_array_equal(np.asarray(idx), _ref_rank(scores, 10))
+
+
+def test_all_ties_numpy_order():
+    scores = np.zeros(16)
+    mask = np.ones(16, dtype=bool)
+    _, idx = masked_top_k(scores, mask, 5, tie_break="numpy")
+    # reversed stable sort: highest index first
+    np.testing.assert_array_equal(np.asarray(idx), [15, 14, 13, 12, 11])
+
+
+def test_fast_matches_values(rng):
+    scores = rng.uniform(size=257)
+    mask = np.ones(257, dtype=bool)
+    v_fast, _ = masked_top_k(scores, mask, 17, tie_break="fast")
+    v_np, _ = masked_top_k(scores, mask, 17, tie_break="numpy")
+    np.testing.assert_allclose(np.asarray(v_fast), np.asarray(v_np))
+    np.testing.assert_allclose(np.asarray(v_fast), np.sort(scores)[::-1][:17])
+
+
+def test_mask_excludes(rng):
+    scores = rng.uniform(size=64)
+    mask = np.zeros(64, dtype=bool)
+    mask[10:20] = True
+    for tb in ("fast", "numpy"):
+        v, idx = masked_top_k(scores, mask, 5, tie_break=tb)
+        assert set(np.asarray(idx)).issubset(set(range(10, 20)))
+        assert int(valid_count(v)) == 5
+
+
+def test_fewer_valid_than_k():
+    scores = np.arange(8.0)
+    mask = np.zeros(8, dtype=bool)
+    mask[:3] = True
+    v, idx = masked_top_k(scores, mask, 5, tie_break="fast")
+    assert int(valid_count(v)) == 3
+    np.testing.assert_array_equal(np.asarray(idx)[:3], [2, 1, 0])
