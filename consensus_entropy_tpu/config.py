@@ -98,6 +98,20 @@ class CNNConfig:
     #: Compute dtype for conv/dense (MXU-friendly); params stay float32.
     compute_dtype: str = "float32"
 
+    def __post_init__(self):
+        # Fail fast if the pooling pyramid collapses a spatial dim to zero
+        # (the reference hard-codes a geometry where this can't happen:
+        # 128 mels × 231 frames through 7 2×2 pools → 1×1).
+        f = self.n_mels
+        t = (self.input_length + 2 * (self.n_fft // 2)) // self.hop_length - 1
+        for layer in range(self.n_layers):
+            f, t = f // 2, t // 2
+            if f == 0 or t == 0:
+                raise ValueError(
+                    f"CNN geometry collapses at layer {layer + 1}: "
+                    f"n_mels={self.n_mels}, input_length={self.input_length} "
+                    f"survive only {layer} of {self.n_layers} 2x2 pools")
+
     @property
     def channel_widths(self) -> tuple[int, ...]:
         """Per-layer output channels: 128,128,256,256,256,256,512 for the

@@ -1,0 +1,1 @@
+"""Host data layer: annotations, features, splits, audio crop stores."""

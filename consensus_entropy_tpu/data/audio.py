@@ -1,0 +1,110 @@
+"""Waveform storage and random-crop sampling.
+
+The reference's ``AudioFolder`` (``short_cnn.py:351-383``) mmap-loads one
+``{song_id}.npy`` per ``__getitem__`` and takes a uniform random
+``input_length``-sample crop (``short_cnn.py:376-377``), shuttling each crop
+through a DataLoader worker process at batch_size 1.
+
+TPU-native replacement: the pool's waveforms are padded once into a single
+``(n_songs, max_len)`` device array; per-epoch crop sampling is a ``vmap``'d
+``dynamic_slice`` with ``jax.random`` starts — zero host↔device traffic per
+epoch and deterministic under explicit keys (the reference's crops depend on
+global numpy RNG state and worker scheduling).  A host-memory variant exists
+for pools too large for HBM (e.g. full DEAM pre-training).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _crop_start(u, n_samples, input_length):
+    """Random crop start with reference semantics: ``floor(u * (len - L))``
+    (``short_cnn.py:376``); u ∈ [0,1). Requires ``len >= L``."""
+    return jnp.floor(u * (n_samples - input_length)).astype(jnp.int32)
+
+
+class DeviceWaveformStore:
+    """All waveforms resident on device; crops sampled in-graph.
+
+    ``waveforms`` maps song id → 1-D float array.  Ids are assigned dense
+    row indices in insertion order (use ``row_of`` to translate).
+    """
+
+    def __init__(self, waveforms: Mapping[object, np.ndarray],
+                 input_length: int, dtype=jnp.float32):
+        if not waveforms:
+            raise ValueError("empty waveform store")
+        self.input_length = int(input_length)
+        self.ids = list(waveforms.keys())
+        self._row = {sid: i for i, sid in enumerate(self.ids)}
+        lengths = np.array([len(waveforms[s]) for s in self.ids], np.int32)
+        short = [s for s, n in zip(self.ids, lengths) if n < input_length]
+        if short:
+            raise ValueError(
+                f"{len(short)} waveform(s) shorter than input_length "
+                f"{input_length}: {short[:5]}")
+        max_len = int(lengths.max())
+        buf = np.zeros((len(self.ids), max_len), np.float32)
+        for i, sid in enumerate(self.ids):
+            w = np.asarray(waveforms[sid], np.float32)
+            buf[i, : len(w)] = w
+        self.data = jnp.asarray(buf, dtype)
+        self.lengths = jnp.asarray(lengths)
+
+    def row_of(self, song_ids: Sequence) -> np.ndarray:
+        return np.array([self._row[s] for s in song_ids], np.int32)
+
+    def sample_crops(self, key, rows):
+        """``(len(rows), input_length)`` random crops, fully on device."""
+        rows = jnp.asarray(rows)
+        return _sample_crops(self.data, self.lengths, rows, key,
+                             self.input_length)
+
+
+def _sample_crops(data, lengths, rows, key, input_length: int):
+    u = jax.random.uniform(key, (rows.shape[0],))
+    starts = _crop_start(u, lengths[rows], input_length)
+
+    def one(row, start):
+        return jax.lax.dynamic_slice_in_dim(data[row], start, input_length)
+
+    return jax.vmap(one)(rows, starts)
+
+
+class HostWaveformStore:
+    """Host-memory variant for pools too large for HBM (full DEAM npy dir).
+
+    Same API; crops assembled in numpy (optionally from mmap'd .npy files)
+    and shipped as one batch array — one transfer per call, not one per song.
+    """
+
+    def __init__(self, npy_dir: str, song_ids: Sequence, input_length: int,
+                 mmap: bool = True):
+        self.input_length = int(input_length)
+        self.ids = list(song_ids)
+        self._row = {sid: i for i, sid in enumerate(self.ids)}
+        mode = "r" if mmap else None
+        self._arrays = [np.load(os.path.join(npy_dir, f"{sid}.npy"),
+                                mmap_mode=mode) for sid in self.ids]
+        for sid, a in zip(self.ids, self._arrays):
+            if len(a) < input_length:
+                raise ValueError(f"waveform {sid} shorter than {input_length}")
+
+    def row_of(self, song_ids: Sequence) -> np.ndarray:
+        return np.array([self._row[s] for s in song_ids], np.int32)
+
+    def sample_crops(self, key, rows):
+        rows = np.asarray(rows)
+        u = np.asarray(jax.random.uniform(key, (len(rows),)))
+        out = np.empty((len(rows), self.input_length), np.float32)
+        for j, (r, uj) in enumerate(zip(rows, u)):
+            a = self._arrays[int(r)]
+            start = int(np.floor(uj * (len(a) - self.input_length)))
+            out[j] = a[start: start + self.input_length]
+        return jnp.asarray(out)

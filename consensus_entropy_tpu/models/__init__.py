@@ -1,0 +1,3 @@
+"""Committee members: Flax ShortChunkCNN (device) + sklearn members (host)."""
+
+from consensus_entropy_tpu.models.short_cnn import ShortChunkCNN  # noqa: F401

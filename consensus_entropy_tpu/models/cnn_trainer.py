@@ -1,0 +1,225 @@
+"""CNN training: optax loops with the reference's optimizer schedule.
+
+Reference semantics reproduced (``amg_test.py:203-341``, ``deam_classifier.py:
+106-176,249-316``):
+
+- BCE loss on sigmoid outputs vs one-hot targets, log clamped at −100
+  (torch ``BCELoss`` semantics), mean reduction.
+- Adam(lr=1e-4, L2 weight_decay=1e-4) → after ``patience`` stale epochs,
+  SGD(momentum .9, nesterov, wd 1e-4) at 1e-3 → 1e-4 → 1e-5, **reloading the
+  best checkpoint at every transition** (``amg_test.py:205-217``).
+  torch-style *coupled* weight decay (added to the gradient before the
+  optimizer transform), not AdamW-style decoupled.
+- Per-epoch validation on the (randomly re-cropped) test set; best model
+  kept by ``score = 1 − val_loss`` (``amg_test.py:267-273``).
+
+TPU-first shape of the loop: each epoch is ONE jit'd function — crop
+sampling (device RNG), ``lax.scan`` over fixed-shape batches, forward/backward
+on the MXU, validation pass, and best-params update via ``tree_map(where)``
+all fused; the host only advances the epoch counter and switches the optax
+transform at phase transitions (≤4 compilations total, cached afterwards).
+The reference instead runs a Python batch loop with a DataLoader worker
+process and per-batch host↔device transfers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from consensus_entropy_tpu.config import CNNConfig, TrainConfig
+from consensus_entropy_tpu.data.audio import DeviceWaveformStore
+from consensus_entropy_tpu.models.short_cnn import ShortChunkCNN
+
+PHASES = ("adam", "sgd_1", "sgd_2", "sgd_3")  # amg_test.py:203-231
+
+
+def bce_loss(preds, targets):
+    """torch.nn.BCELoss parity: mean over all elements, log clamped at −100."""
+    p = jnp.clip(preds, 0.0, 1.0)
+    log_p = jnp.maximum(jnp.log(jnp.maximum(p, 1e-44)), -100.0)
+    log_1p = jnp.maximum(jnp.log(jnp.maximum(1.0 - p, 1e-44)), -100.0)
+    return -jnp.mean(targets * log_p + (1.0 - targets) * log_1p)
+
+
+def make_tx(phase: str, cfg: TrainConfig) -> optax.GradientTransformation:
+    """Optimizer for a schedule phase, torch-coupled weight decay."""
+    if phase == "adam":
+        return optax.chain(optax.add_decayed_weights(cfg.weight_decay),
+                           optax.adam(cfg.lr))
+    idx = PHASES.index(phase) - 1
+    return optax.chain(
+        optax.add_decayed_weights(cfg.sgd_weight_decay),
+        optax.sgd(cfg.sgd_lrs[idx], momentum=cfg.sgd_momentum, nesterov=True))
+
+
+@dataclasses.dataclass
+class EpochResult:
+    train_loss: float
+    val_loss: float
+    val_f1_pairs: tuple  # (y_true, y_pred) for host-side metrics
+    improved: bool
+
+
+class CNNTrainer:
+    """Drives pre-training and AL retraining of one CNN member."""
+
+    def __init__(self, config: CNNConfig = CNNConfig(),
+                 train_config: TrainConfig = TrainConfig()):
+        self.config = config
+        self.train_config = train_config
+        self.model = ShortChunkCNN(config)
+        self._epoch_fns: dict[str, Callable] = {}
+
+    # -- jitted epoch step (built per phase, cached) -----------------------
+
+    def _epoch_fn(self, phase: str, n_train: int, n_test: int,
+                  batch_size: int) -> Callable:
+        key_ = (phase, n_train, n_test, batch_size)
+        if key_ in self._epoch_fns:
+            return self._epoch_fns[key_]
+        tx = make_tx(phase, self.train_config)
+        model = self.model
+        n_batches = max(n_train // batch_size, 1)
+        used = n_batches * batch_size
+
+        def epoch(params, batch_stats, opt_state, best_params, best_stats,
+                  best_score, data, lengths, train_rows, train_y, test_rows,
+                  test_y, key):
+            kperm, kcrop, ktest, kdrop = jax.random.split(key, 4)
+            # shuffle + crop the training pool (epoch-fresh random crops,
+            # matching the reference's shuffling DataLoader).
+            perm = jax.random.permutation(kperm, n_train)[:used]
+            rows = train_rows[perm]
+            u = jax.random.uniform(kcrop, (used,))
+            starts = jnp.floor(
+                u * (lengths[rows] - model.config.input_length)).astype(jnp.int32)
+
+            def crop(row, start):
+                return jax.lax.dynamic_slice_in_dim(
+                    data[row], start, model.config.input_length)
+
+            xs = jax.vmap(crop)(rows, starts).reshape(
+                n_batches, batch_size, model.config.input_length)
+            ys = train_y[perm].reshape(n_batches, batch_size, -1)
+            dkeys = jax.random.split(kdrop, n_batches)
+
+            def loss_fn(p, stats, x, y, dk):
+                out, mutated = model.apply(
+                    {"params": p, "batch_stats": stats}, x, train=True,
+                    rngs={"dropout": dk}, mutable=["batch_stats"])
+                return bce_loss(out, y), mutated["batch_stats"]
+
+            def step(carry, batch):
+                p, stats, opt = carry
+                x, y, dk = batch
+                (loss, new_stats), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(p, stats, x, y, dk)
+                updates, opt = tx.update(grads, opt, p)
+                p = optax.apply_updates(p, updates)
+                return (p, new_stats, opt), loss
+
+            (params, batch_stats, opt_state), losses = jax.lax.scan(
+                step, (params, batch_stats, opt_state), (xs, ys, dkeys))
+
+            # validation with fresh random test crops (the reference's test
+            # loader also crops randomly every pass — short_cnn.py:376).
+            ut = jax.random.uniform(ktest, (n_test,))
+            tstarts = jnp.floor(
+                ut * (lengths[test_rows] - model.config.input_length)
+            ).astype(jnp.int32)
+            xt = jax.vmap(crop)(test_rows, tstarts)
+            preds = model.apply({"params": params, "batch_stats": batch_stats},
+                                xt, train=False)
+            val_loss = bce_loss(preds, test_y)
+
+            # best-checkpoint update on device: score = 1 - val_loss
+            # (amg_test.py:267-273).
+            score = 1.0 - val_loss
+            improved = score > best_score
+            best_params = jax.tree.map(
+                lambda new, old: jnp.where(improved, new, old),
+                params, best_params)
+            best_stats = jax.tree.map(
+                lambda new, old: jnp.where(improved, new, old),
+                batch_stats, best_stats)
+            best_score = jnp.where(improved, score, best_score)
+            return (params, batch_stats, opt_state, best_params, best_stats,
+                    best_score, jnp.mean(losses), val_loss, preds, improved)
+
+        fn = jax.jit(epoch, donate_argnums=(0, 1, 2, 3, 4))
+        self._epoch_fns[key_] = fn
+        return fn
+
+    # -- host-level loop ---------------------------------------------------
+
+    def fit(self, variables, store: DeviceWaveformStore, train_ids, train_y,
+            test_ids, test_y, key, *, n_epochs: int | None = None,
+            batch_size: int | None = None, adam_patience: int | None = None,
+            callback=None):
+        """Train with the adam→sgd best-reload schedule; returns
+        ``(best_variables, history)``.
+
+        ``train_y`` / ``test_y``: one-hot float arrays aligned with the id
+        lists.  ``callback(epoch, info_dict)`` is invoked per epoch (metrics /
+        reporting hook).
+        """
+        cfg = self.train_config
+        n_epochs = n_epochs or cfg.n_epochs
+        batch_size = batch_size or cfg.batch_size
+        adam_patience = adam_patience or cfg.adam_patience
+
+        train_rows = jnp.asarray(store.row_of(train_ids))
+        test_rows = jnp.asarray(store.row_of(test_ids))
+        train_y = jnp.asarray(train_y)
+        test_y = jnp.asarray(test_y)
+
+        params = variables["params"]
+        batch_stats = variables["batch_stats"]
+        best_params = jax.tree.map(jnp.copy, params)
+        best_stats = jax.tree.map(jnp.copy, batch_stats)
+        best_score = jnp.asarray(-jnp.inf)
+
+        phase_i = 0
+        tx = make_tx(PHASES[phase_i], cfg)
+        opt_state = tx.init(params)
+        drop_counter = 0
+        history = []
+
+        for epoch in range(n_epochs):
+            drop_counter += 1
+            fn = self._epoch_fn(PHASES[phase_i], len(train_ids),
+                                len(test_ids), batch_size)
+            key, sub = jax.random.split(key)
+            (params, batch_stats, opt_state, best_params, best_stats,
+             best_score, train_loss, val_loss, preds, improved) = fn(
+                params, batch_stats, opt_state, best_params, best_stats,
+                best_score, store.data, store.lengths, train_rows, train_y,
+                test_rows, test_y, sub)
+
+            info = {"epoch": epoch, "phase": PHASES[phase_i],
+                    "train_loss": float(train_loss),
+                    "val_loss": float(val_loss),
+                    "improved": bool(improved)}
+            history.append(info)
+            if callback is not None:
+                callback(epoch, info, np.asarray(preds))
+
+            # schedule: reload best at each transition (amg_test.py:205-229).
+            patience = adam_patience if PHASES[phase_i] == "adam" \
+                else cfg.sgd_patience
+            if phase_i < len(PHASES) - 1 and drop_counter >= patience:
+                params = jax.tree.map(jnp.copy, best_params)
+                batch_stats = jax.tree.map(jnp.copy, best_stats)
+                phase_i += 1
+                tx = make_tx(PHASES[phase_i], cfg)
+                opt_state = tx.init(params)
+                drop_counter = 0
+
+        return ({"params": best_params, "batch_stats": best_stats},
+                history)

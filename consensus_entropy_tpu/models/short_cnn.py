@@ -1,0 +1,124 @@
+"""Flax ShortChunkCNN — the TPU-native CNN committee member.
+
+Architecture parity with the reference's torch model (``short_cnn.py:278-349``):
+log-mel frontend → BatchNorm over the 1-channel spectrogram → 7× [3×3 conv →
+BN → ReLU → 2×2 maxpool] with widths (128,128,256,256,256,256,512) → global
+max pool → Dense(512) → BN → ReLU → Dropout(0.5) → Dense(4) → **sigmoid**
+(the reference trains with BCELoss on one-hot targets, ``amg_test.py:294`` —
+outputs are per-class Bernoullis, not a softmax simplex; the downstream
+entropy renormalizes, matching ``scipy.stats.entropy`` semantics).
+
+TPU-first choices (vs a line-for-line port):
+
+- NHWC layout throughout (XLA's native conv layout on TPU).
+- The mel frontend is jnp matmuls (see ``ops/mel.py``) fused into the same
+  jit graph — no torchaudio buffer shipped in checkpoints.
+- BatchNorm uses running statistics for *all* inference (the reference
+  evaluates with batch_size=1 where train-mode BN would be degenerate —
+  SURVEY.md §7 hard part 3).
+- Committee inference/training is ``vmap`` over stacked parameter pytrees
+  (``stack_params``) rather than a Python loop that reloads each member from
+  disk per iteration (``amg_test.py:434``).
+- Optional bfloat16 compute (params/stats stay float32).
+
+Torch-default hyperparameters preserved: BN eps=1e-5, BN momentum 0.1 (flax
+``momentum=0.9``), conv/pool geometry identical.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from consensus_entropy_tpu.config import CNNConfig
+from consensus_entropy_tpu.ops.mel import log_mel_spectrogram
+
+
+class ConvBlock(nn.Module):
+    """3×3 conv (pad 1) → BN → ReLU → 2×2 max pool (``short_cnn.py:28-37``)."""
+
+    features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.Conv(self.features, (3, 3), padding=1, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        return nn.max_pool(x, (2, 2), strides=(2, 2))
+
+
+class ShortChunkCNN(nn.Module):
+    """VGG-ish short-chunk CNN over ~3.69 s mel spectrograms."""
+
+    config: CNNConfig = CNNConfig()
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        """x: waveform ``(B, L)`` float — returns sigmoid scores ``(B, C)``."""
+        cfg = self.config
+        dtype = jnp.dtype(cfg.compute_dtype)
+        s = log_mel_spectrogram(x, cfg)  # (B, n_mels, T)
+        s = s[..., None].astype(dtype)  # NHWC: (B, n_mels, T, 1)
+        s = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=dtype, name="spec_bn")(s)
+        for width in cfg.channel_widths:
+            s = ConvBlock(width, dtype=dtype)(s, train)
+        # Global max pool over remaining (freq, time) — the reference squeezes
+        # freq (==1 after 7 pools) then MaxPool1d's time (short_cnn.py:334-339).
+        s = jnp.max(s, axis=(1, 2))
+        s = nn.Dense(cfg.channel_widths[-1], dtype=dtype, name="dense1")(s)
+        s = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=dtype, name="head_bn")(s)
+        s = nn.relu(s)
+        s = nn.Dropout(cfg.dropout_rate, deterministic=not train)(s)
+        s = nn.Dense(cfg.n_class, dtype=dtype, name="dense2")(s)
+        return nn.sigmoid(s.astype(jnp.float32))
+
+
+def init_variables(key, config: CNNConfig = CNNConfig(), batch_size: int = 2):
+    """Initialize ``{'params', 'batch_stats'}`` for a single member."""
+    model = ShortChunkCNN(config)
+    x = jnp.zeros((batch_size, config.input_length), jnp.float32)
+    return model.init({"params": key}, x, train=False)
+
+
+def apply_infer(variables, x, config: CNNConfig = CNNConfig()):
+    """Inference forward pass (running-stats BN, no dropout)."""
+    return ShortChunkCNN(config).apply(variables, x, train=False)
+
+
+def apply_train(variables, x, dropout_key, config: CNNConfig = CNNConfig()):
+    """Training forward pass; returns ``(scores, new_batch_stats)``."""
+    out, mutated = ShortChunkCNN(config).apply(
+        variables, x, train=True, rngs={"dropout": dropout_key},
+        mutable=["batch_stats"])
+    return out, mutated["batch_stats"]
+
+
+def stack_params(member_variables: list):
+    """Stack per-member variable pytrees along a leading committee axis.
+
+    The stacked pytree is what ``vmap``/``shard_map`` consume: committee
+    inference is ``vmap(apply_infer, in_axes=(0, None))`` — one fused graph
+    for all M members instead of M sequential model loads (``amg_test.py:428-438``).
+    """
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *member_variables)
+
+
+def unstack_params(stacked, index: int):
+    """Extract member ``index`` from a stacked pytree."""
+    return jax.tree.map(lambda leaf: leaf[index], stacked)
+
+
+def num_members(stacked) -> int:
+    return jax.tree.leaves(stacked)[0].shape[0]
+
+
+def committee_infer(stacked_variables, x, config: CNNConfig = CNNConfig()):
+    """All members score the same crops: ``(M, B, C)`` sigmoid outputs."""
+    return jax.vmap(lambda v: apply_infer(v, x, config))(stacked_variables)

@@ -1,0 +1,63 @@
+"""Waveform stores: crop geometry, determinism, host/device agreement."""
+
+import jax
+import numpy as np
+import pytest
+
+from consensus_entropy_tpu.data.audio import DeviceWaveformStore, HostWaveformStore
+
+
+def _waves(rng, n=6, base=2000, var=500):
+    return {f"s{i}": rng.standard_normal(base + int(rng.integers(0, var)))
+            .astype(np.float32) for i in range(n)}
+
+
+def test_crops_shape_and_content(rng):
+    waves = _waves(rng)
+    store = DeviceWaveformStore(waves, input_length=1024)
+    rows = store.row_of(["s0", "s3", "s5"])
+    crops = np.asarray(store.sample_crops(jax.random.key(0), rows))
+    assert crops.shape == (3, 1024)
+    # each crop is a contiguous slice of its source waveform
+    for c, sid in zip(crops, ["s0", "s3", "s5"]):
+        w = waves[sid]
+        starts = np.flatnonzero(np.isclose(w, c[0]))
+        assert any(np.allclose(w[s: s + 1024], c) for s in starts
+                   if s + 1024 <= len(w))
+
+
+def test_crops_deterministic_and_keyed(rng):
+    store = DeviceWaveformStore(_waves(rng), input_length=512)
+    rows = store.row_of(store.ids)
+    a = np.asarray(store.sample_crops(jax.random.key(7), rows))
+    b = np.asarray(store.sample_crops(jax.random.key(7), rows))
+    c = np.asarray(store.sample_crops(jax.random.key(8), rows))
+    np.testing.assert_array_equal(a, b)
+    assert not np.allclose(a, c)
+
+
+def test_exact_length_song(rng):
+    w = {"x": rng.standard_normal(1024).astype(np.float32)}
+    store = DeviceWaveformStore(w, input_length=1024)
+    crops = np.asarray(store.sample_crops(jax.random.key(0), store.row_of(["x"])))
+    np.testing.assert_array_equal(crops[0], w["x"])
+
+
+def test_too_short_rejected(rng):
+    with pytest.raises(ValueError, match="shorter"):
+        DeviceWaveformStore({"x": np.zeros(10, np.float32)}, input_length=100)
+
+
+def test_host_store_matches_api(rng, tmp_path):
+    waves = _waves(rng, n=4)
+    for sid, w in waves.items():
+        np.save(tmp_path / f"{sid}.npy", w)
+    store = HostWaveformStore(str(tmp_path), list(waves), input_length=700)
+    rows = store.row_of(["s1", "s2"])
+    crops = np.asarray(store.sample_crops(jax.random.key(3), rows))
+    assert crops.shape == (2, 700)
+    for c, sid in zip(crops, ["s1", "s2"]):
+        w = waves[sid]
+        starts = np.flatnonzero(np.isclose(w, c[0]))
+        assert any(np.allclose(w[s: s + 700], c) for s in starts
+                   if s + 700 <= len(w))

@@ -1,0 +1,105 @@
+"""Flax ShortChunkCNN: architecture geometry, train/infer semantics, vmap
+committee."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensus_entropy_tpu.config import CNNConfig
+from consensus_entropy_tpu.models import short_cnn
+
+TINY = CNNConfig(n_channels=4, n_mels=32, n_layers=5, input_length=8192)
+
+
+@pytest.fixture(scope="module")
+def tiny_vars():
+    return short_cnn.init_variables(jax.random.key(0), TINY)
+
+
+def test_channel_widths_default():
+    # short_cnn.py:304-310: 128,128,256,256,256,256,512
+    assert CNNConfig().channel_widths == (128, 128, 256, 256, 256, 256, 512)
+
+
+def test_output_shape_and_range(tiny_vars, rng):
+    x = rng.standard_normal((3, TINY.input_length)).astype(np.float32)
+    out = np.asarray(short_cnn.apply_infer(tiny_vars, x, TINY))
+    assert out.shape == (3, 4)
+    assert (out > 0).all() and (out < 1).all()  # sigmoid head
+
+
+def test_jit_and_batch_size_one(tiny_vars, rng):
+    # The AL loop evaluates with batch_size=1 (amg_test.py:378-387); BN must
+    # use running stats so a single example is well-defined.
+    x = rng.standard_normal((1, TINY.input_length)).astype(np.float32)
+    f = jax.jit(lambda v, x: short_cnn.apply_infer(v, x, TINY))
+    out = np.asarray(f(tiny_vars, x))
+    assert out.shape == (1, 4)
+    assert np.isfinite(out).all()
+
+
+def test_train_updates_batch_stats(tiny_vars, rng):
+    x = rng.standard_normal((4, TINY.input_length)).astype(np.float32)
+    out, new_stats = short_cnn.apply_train(
+        tiny_vars, x, jax.random.key(1), TINY)
+    assert out.shape == (4, 4)
+    old = jax.tree.leaves(tiny_vars["batch_stats"])
+    new = jax.tree.leaves(new_stats)
+    assert any(not np.allclose(a, b) for a, b in zip(old, new))
+
+
+def test_dropout_only_in_train(tiny_vars, rng):
+    x = rng.standard_normal((2, TINY.input_length)).astype(np.float32)
+    a = short_cnn.apply_infer(tiny_vars, x, TINY)
+    b = short_cnn.apply_infer(tiny_vars, x, TINY)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    t1, _ = short_cnn.apply_train(tiny_vars, x, jax.random.key(1), TINY)
+    t2, _ = short_cnn.apply_train(tiny_vars, x, jax.random.key(2), TINY)
+    assert not np.allclose(np.asarray(t1), np.asarray(t2))
+
+
+def test_committee_vmap(tiny_vars, rng):
+    members = [short_cnn.init_variables(jax.random.key(i), TINY)
+               for i in range(3)]
+    stacked = short_cnn.stack_params(members)
+    assert short_cnn.num_members(stacked) == 3
+    x = rng.standard_normal((5, TINY.input_length)).astype(np.float32)
+    probs = np.asarray(short_cnn.committee_infer(stacked, x, TINY))
+    assert probs.shape == (3, 5, 4)
+    # members differ → outputs differ
+    assert not np.allclose(probs[0], probs[1])
+    # unstack round-trip matches per-member apply
+    one = np.asarray(short_cnn.apply_infer(
+        short_cnn.unstack_params(stacked, 1), x, TINY))
+    np.testing.assert_allclose(probs[1], one, rtol=1e-5)
+
+
+def test_param_count_matches_reference_architecture():
+    # Independent arithmetic for the torch model (short_cnn.py:278-317):
+    # conv k*k*cin*cout + cout bias; BN 2*c (scale/bias); dense in*out + out.
+    cfg = CNNConfig()
+    widths = cfg.channel_widths
+    expect = 0
+    cin = 1
+    expect += 2 * 1  # spec_bn over 1 channel
+    for w in widths:
+        expect += 3 * 3 * cin * w + w  # conv
+        expect += 2 * w  # bn scale+bias
+        cin = w
+    expect += 512 * 512 + 512  # dense1
+    expect += 2 * 512  # head bn
+    expect += 512 * 4 + 4  # dense2
+    variables = short_cnn.init_variables(jax.random.key(0), cfg, batch_size=1)
+    got = sum(int(np.prod(p.shape))
+              for p in jax.tree.leaves(variables["params"]))
+    assert got == expect
+
+
+def test_spatial_collapse_geometry():
+    # 128 mels / 231 frames through 7 2x2 pools → (1, 1) spatial, as the
+    # reference's squeeze+MaxPool1d path requires (short_cnn.py:334-339).
+    f, t = 128, 231
+    for _ in range(7):
+        f, t = f // 2, t // 2
+    assert (f, t) == (1, 1)

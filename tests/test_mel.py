@@ -1,0 +1,105 @@
+"""Mel frontend parity vs torch.stft and an independent mel-fb oracle.
+
+torchaudio itself is not installed in this image; the oracles are built from
+its documented semantics on top of ``torch.stft`` (the exact kernel
+torchaudio's MelSpectrogram wraps).
+"""
+
+import numpy as np
+import pytest
+import torch
+
+from consensus_entropy_tpu.config import CNNConfig
+from consensus_entropy_tpu.ops import mel
+
+
+def _torch_power_spec(x_np, n_fft=512, hop=256):
+    # torchaudio.transforms.Spectrogram defaults: centered, reflect pad,
+    # periodic Hann, power=2, no normalization.
+    x = torch.from_numpy(x_np.astype(np.float32))
+    w = torch.hann_window(n_fft, periodic=True)
+    spec = torch.stft(x, n_fft=n_fft, hop_length=hop, win_length=n_fft,
+                      window=w, center=True, pad_mode="reflect",
+                      return_complex=True)
+    return (spec.abs() ** 2).numpy()
+
+
+def _oracle_mel_fb(sr=16000, n_fft=512, n_mels=128, f_min=0.0, f_max=8000.0):
+    # Independent implementation of torchaudio.functional.melscale_fbanks
+    # (mel_scale='htk', norm=None), written loop-wise on purpose.
+    n_freqs = n_fft // 2 + 1
+    freqs = np.linspace(0, sr / 2, n_freqs)
+
+    def to_mel(f):
+        return 2595.0 * np.log10(1.0 + f / 700.0)
+
+    def to_hz(m):
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+
+    pts = to_hz(np.linspace(to_mel(f_min), to_mel(f_max), n_mels + 2))
+    fb = np.zeros((n_freqs, n_mels))
+    for m in range(n_mels):
+        lo, ctr, hi = pts[m], pts[m + 1], pts[m + 2]
+        for i, f in enumerate(freqs):
+            if lo <= f <= ctr and ctr > lo:
+                fb[i, m] = (f - lo) / (ctr - lo)
+            elif ctr < f <= hi and hi > ctr:
+                fb[i, m] = (hi - f) / (hi - ctr)
+    return fb
+
+
+def test_mel_filterbank_matches_oracle():
+    fb = mel.mel_filterbank()
+    oracle = _oracle_mel_fb()
+    assert fb.shape == (257, 128)
+    np.testing.assert_allclose(fb, oracle, atol=2e-6)
+
+
+def test_filterbank_covers_band():
+    fb = mel.mel_filterbank()
+    # Low mel triangles can be narrower than one 31.25 Hz FFT bin and come
+    # out all-zero — torchaudio does the same (it warns).  Above the first
+    # few, every filter must have support.
+    support = fb.sum(axis=0) > 0
+    assert support[8:].all()
+
+
+@pytest.mark.parametrize("method", ["matmul", "fft"])
+def test_power_spectrogram_matches_torch_stft(rng, method):
+    x = rng.standard_normal((2, 4096)).astype(np.float32)
+    got = np.asarray(mel.power_spectrogram(x, method=method))
+    want = _torch_power_spec(x)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=1e-2, rtol=1e-3)
+
+
+def test_matmul_and_fft_paths_agree(rng):
+    x = rng.standard_normal((8192,)).astype(np.float32)
+    a = np.asarray(mel.power_spectrogram(x, method="matmul"))
+    b = np.asarray(mel.power_spectrogram(x, method="fft"))
+    np.testing.assert_allclose(a, b, atol=1e-2, rtol=1e-3)
+
+
+def test_frame_count_canonical():
+    cfg = CNNConfig()
+    assert mel.n_frames_for(cfg.input_length) == 231
+    x = np.zeros((1, cfg.input_length), dtype=np.float32)
+    out = np.asarray(mel.log_mel_spectrogram(x, cfg))
+    assert out.shape == (1, 128, 231)
+
+
+def test_amplitude_to_db_semantics():
+    p = np.array([1.0, 0.0, 1e-12, 100.0])
+    db = np.asarray(mel.amplitude_to_db(p))
+    np.testing.assert_allclose(db, [0.0, -100.0, -100.0, 20.0], atol=1e-4)
+
+
+def test_log_mel_full_chain_vs_torch(rng):
+    cfg = CNNConfig()
+    x = rng.standard_normal((3, cfg.input_length)).astype(np.float32) * 0.1
+    got = np.asarray(mel.log_mel_spectrogram(x, cfg))
+    power = _torch_power_spec(x)  # (3, 257, 231)
+    fb = _oracle_mel_fb()
+    want = 10.0 * np.log10(np.maximum(
+        np.einsum("bft,fm->bmt", power, fb), 1e-10))
+    np.testing.assert_allclose(got, want, atol=5e-3)
