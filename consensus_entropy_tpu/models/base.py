@@ -1,0 +1,50 @@
+"""The committee-member contract.
+
+The reference's committee is duck-typed sklearn objects plus a torch model
+dispatched by filename substring checks (``amg_test.py:404-413,496-509``).
+Here the contract is explicit (SURVEY.md §7 step 4): every member can score
+the pool, incrementally absorb a labeled batch, and round-trip to disk.
+
+Two member species exist:
+
+- **Host members** (GNB/SGD/boosting) — stay on CPU; their per-song
+  probability tables are fed into the on-device fused scoring graph.
+- **Device members** (Flax CNN) — stacked-params pytrees scored via ``vmap``
+  on TPU; they implement the same protocol through ``CNNMember``.
+"""
+
+from __future__ import annotations
+
+import abc
+import numpy as np
+
+
+class Member(abc.ABC):
+    """One committee member."""
+
+    #: short algorithm tag, e.g. 'gnb', 'sgd', 'xgb', 'cnn_jax'
+    kind: str = "?"
+
+    def __init__(self, name: str):
+        self.name = name
+
+    @abc.abstractmethod
+    def predict_proba(self, X: np.ndarray) -> np.ndarray:
+        """Class probabilities ``(n, C)`` for feature rows ``X``."""
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """Hard labels; default argmax of probabilities."""
+        return np.argmax(self.predict_proba(X), axis=1)
+
+    @abc.abstractmethod
+    def update(self, X: np.ndarray, y: np.ndarray) -> None:
+        """Incrementally absorb a labeled batch (the AL query step):
+        ``partial_fit`` for GNB/SGD (``amg_test.py:509``), continued boosting
+        for XGB (``amg_test.py:507``), retraining for the CNN."""
+
+    @abc.abstractmethod
+    def save(self, path: str) -> None: ...
+
+    @classmethod
+    @abc.abstractmethod
+    def load(cls, path: str) -> "Member": ...

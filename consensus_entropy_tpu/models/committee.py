@@ -1,0 +1,192 @@
+"""Committee orchestration: host members + device CNN members, one reduction.
+
+Reference hot loop #1 (``amg_test.py:425-447``) reloads every member from
+disk each iteration, scores sequentially (CNN at batch_size=1), aggregates
+frames with pandas groupby, and ships everything through scipy on host.
+
+TPU-native shape of the same computation:
+
+- CNN members live as ONE stacked pytree; scoring all of them over all pool
+  songs is a single ``vmap``'d jit dispatch (async — the host thread returns
+  immediately).
+- While the TPU chews the CNN graph, the host computes sklearn members'
+  frame probabilities and segment-means them into per-song tables (numpy
+  ``reduceat``, not pandas groupby).
+- Host tables are concatenated onto the device probs and the fused
+  mean→entropy→top-k graph runs on TPU (see ``ops.scoring``); overlap comes
+  free from JAX's async dispatch (SURVEY.md §7 hard part 6).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consensus_entropy_tpu.config import CNNConfig, NUM_CLASSES, TrainConfig
+from consensus_entropy_tpu.data.audio import DeviceWaveformStore
+from consensus_entropy_tpu.models import short_cnn
+from consensus_entropy_tpu.models.base import Member
+from consensus_entropy_tpu.models.cnn_trainer import CNNTrainer
+from consensus_entropy_tpu.utils.checkpoint import load_variables, save_variables
+
+
+class FramePool:
+    """Per-song frame features in segment layout for host member scoring.
+
+    ``X``: ``(n_frames_total, F)`` rows sorted/grouped by song; ``song_ids``
+    gives the unique songs in order; ``offsets`` the start row of each song's
+    segment.  ``mean_by_song(p)`` replaces the reference's
+    ``DataFrame(...).groupby('s_id').mean()`` (``amg_test.py:437``).
+    """
+
+    def __init__(self, X: np.ndarray, frame_song: Sequence):
+        frame_song = np.asarray(frame_song)
+        order = np.argsort(frame_song, kind="stable")
+        self.X = np.ascontiguousarray(np.asarray(X)[order])
+        sorted_ids = frame_song[order]
+        change = np.flatnonzero(
+            np.r_[True, sorted_ids[1:] != sorted_ids[:-1]])
+        self.offsets = change
+        self.song_ids = list(sorted_ids[change])
+        self.counts = np.diff(np.r_[change, len(sorted_ids)])
+
+    @property
+    def n_songs(self) -> int:
+        return len(self.song_ids)
+
+    def mean_by_song(self, frame_values: np.ndarray) -> np.ndarray:
+        sums = np.add.reduceat(frame_values, self.offsets, axis=0)
+        return sums / self.counts[:, None]
+
+    def rows_for_songs(self, songs: Sequence) -> np.ndarray:
+        """Row indices of all frames belonging to ``songs`` (batch build)."""
+        wanted = set(songs)
+        keep = []
+        for i, sid in enumerate(self.song_ids):
+            if sid in wanted:
+                start = self.offsets[i]
+                keep.append(np.arange(start, start + self.counts[i]))
+        return (np.concatenate(keep) if keep
+                else np.empty(0, np.int64))
+
+
+class CNNMember(Member):
+    """Flax CNN committee member (device species of the Member protocol)."""
+
+    kind = "cnn_jax"
+
+    def __init__(self, name: str, variables, config: CNNConfig = CNNConfig(),
+                 train_config: TrainConfig = TrainConfig()):
+        super().__init__(name)
+        self.variables = variables
+        self.config = config
+        self.train_config = train_config
+
+    def predict_proba(self, X):  # feature-table API doesn't apply
+        raise TypeError("CNNMember scores audio crops via Committee")
+
+    def update(self, X, y):
+        raise TypeError("CNNMember retrains via Committee.retrain_cnn")
+
+    def save(self, path):
+        save_variables(path, self.variables,
+                       meta={"kind": self.kind, "name": self.name})
+
+    @classmethod
+    def load(cls, path, config: CNNConfig = CNNConfig(),
+             train_config: TrainConfig = TrainConfig()):
+        variables, meta = load_variables(path)
+        return cls(meta.get("name", os.path.basename(path)), variables,
+                   config, train_config)
+
+
+class Committee:
+    """The user's private committee: M_host sklearn + M_cnn Flax members."""
+
+    def __init__(self, host_members: list[Member],
+                 cnn_members: list[CNNMember],
+                 config: CNNConfig = CNNConfig(),
+                 train_config: TrainConfig = TrainConfig()):
+        self.host_members = host_members
+        self.cnn_members = cnn_members
+        self.config = config
+        self.trainer = CNNTrainer(config, train_config)
+        self._infer = jax.jit(
+            lambda stacked, x: short_cnn.committee_infer(stacked, x,
+                                                         self.config))
+
+    @property
+    def size(self) -> int:
+        return len(self.host_members) + len(self.cnn_members)
+
+    @property
+    def member_names(self) -> list[str]:
+        return ([m.name for m in self.cnn_members]
+                + [m.name for m in self.host_members])
+
+    def _stacked(self):
+        return short_cnn.stack_params([m.variables for m in self.cnn_members])
+
+    def pool_probs(self, pool: FramePool | None,
+                   store: DeviceWaveformStore | None,
+                   song_ids: Sequence, key) -> jnp.ndarray:
+        """Stacked member probabilities ``(M, N, C)`` over ``song_ids``.
+
+        CNN rows first (committee order = member_names).  One random crop per
+        song per scoring pass, as the reference's batch-1 loader does
+        (``amg_test.py:378-382``) — committee entropy is stochastic across
+        passes by design (SURVEY.md §7 hard part 4).
+        """
+        blocks = []
+        if self.cnn_members:
+            assert store is not None
+            crops = store.sample_crops(key, store.row_of(song_ids))
+            blocks.append(self._infer(self._stacked(), crops))  # async
+        if self.host_members:
+            assert pool is not None
+            rowmap = {s: i for i, s in enumerate(pool.song_ids)}
+            sel = np.array([rowmap[s] for s in song_ids])
+            host = np.empty((len(self.host_members), len(song_ids),
+                             NUM_CLASSES), np.float32)
+            for i, m in enumerate(self.host_members):
+                frame_p = m.predict_proba(pool.X)
+                host[i] = pool.mean_by_song(frame_p)[sel]
+            blocks.append(jnp.asarray(host))
+        return jnp.concatenate(blocks, axis=0) if len(blocks) > 1 else blocks[0]
+
+    def update_host(self, X_batch: np.ndarray, y_batch: np.ndarray):
+        """Incremental update of every host member (``amg_test.py:503-509``)."""
+        for m in self.host_members:
+            m.update(X_batch, y_batch)
+
+    def retrain_cnns(self, store: DeviceWaveformStore, train_ids, train_y,
+                     test_ids, test_y, key, *, n_epochs: int | None = None):
+        """Retrain every CNN member on the queried songs (hot loop #2,
+        ``amg_test.py:496-502``); members get distinct crop/dropout streams."""
+        histories = []
+        for i, m in enumerate(self.cnn_members):
+            sub = jax.random.fold_in(key, i)
+            best, hist = self.trainer.fit(
+                m.variables, store, train_ids, train_y, test_ids, test_y,
+                sub, n_epochs=n_epochs or self.trainer.train_config.n_epochs_retrain)
+            m.variables = best
+            histories.append(hist)
+        return histories
+
+    def predict_songs_cnn(self, store: DeviceWaveformStore, song_ids, key):
+        """Per-song CNN scores ``(M_cnn, n, C)`` for evaluation."""
+        crops = store.sample_crops(key, store.row_of(song_ids))
+        return self._infer(self._stacked(), crops)
+
+    # -- persistence -------------------------------------------------------
+
+    def save(self, directory: str):
+        os.makedirs(directory, exist_ok=True)
+        for m in self.host_members:
+            m.save(os.path.join(directory, f"classifier_{m.kind}.{m.name}.pkl"))
+        for m in self.cnn_members:
+            m.save(os.path.join(directory, f"classifier_cnn.{m.name}.msgpack"))

@@ -1,0 +1,259 @@
+"""Host-side classic committee members: GNB, SGD-logistic, gradient boosting.
+
+These stay on CPU by design (trees and tiny generative models don't map to
+XLA — SURVEY.md §2 native-components table); their per-song probability
+tables feed the on-device fused reduction.
+
+Incremental-update semantics reproduced:
+
+- GNB / SGD: ``partial_fit(X, y)`` on the queried batch (``amg_test.py:509``).
+- XGB: continued boosting from the existing booster (``amg_test.py:507``)
+  **with class preservation** — the reference vendors a patched
+  ``xgboost/sklearn.py`` whose delta (lines 854-860, "added for active
+  learning") skips recomputing ``classes_`` when a booster is passed, so the
+  4-class softprob objective survives a query batch that lacks some classes.
+  Here that semantics is a thin wrapper around ``xgboost.train`` with
+  ``num_class`` pinned — no vendored library fork.  When xgboost is not
+  installed (this image ships without it), ``BoostedTreesMember`` falls back
+  to sklearn ``GradientBoostingClassifier`` warm-start boosting with the same
+  class-preservation contract.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+from sklearn.ensemble import GradientBoostingClassifier
+from sklearn.linear_model import SGDClassifier
+from sklearn.naive_bayes import GaussianNB
+
+from consensus_entropy_tpu.config import NUM_CLASSES
+from consensus_entropy_tpu.models.base import Member
+
+try:  # gated: not baked into this image
+    import xgboost as _xgb
+
+    HAVE_XGBOOST = True
+except ImportError:  # pragma: no cover - env without xgboost
+    _xgb = None
+    HAVE_XGBOOST = False
+
+ALL_CLASSES = np.arange(NUM_CLASSES)
+
+
+class _PickledSklearnMember(Member):
+    """Shared persistence for members whose state is one sklearn estimator."""
+
+    def __init__(self, name: str, estimator):
+        super().__init__(name)
+        self.estimator = estimator
+
+    def predict_proba(self, X):
+        return self._full_proba(self.estimator.predict_proba(np.asarray(X)),
+                                getattr(self.estimator, "classes_", ALL_CLASSES))
+
+    @staticmethod
+    def _full_proba(p, classes) -> np.ndarray:
+        """Expand to all NUM_CLASSES columns if the estimator saw fewer."""
+        if p.shape[1] == NUM_CLASSES:
+            return p
+        full = np.zeros((p.shape[0], NUM_CLASSES), p.dtype)
+        full[:, np.asarray(classes, int)] = p
+        return full
+
+    def predict(self, X):
+        return self.estimator.predict(np.asarray(X))
+
+    def save(self, path):
+        with open(path, "wb") as f:
+            pickle.dump({"kind": self.kind, "name": self.name,
+                         "estimator": self.estimator}, f)
+
+    @classmethod
+    def load(cls, path):
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        obj = cls.__new__(cls)
+        Member.__init__(obj, state["name"])
+        obj.estimator = state["estimator"]
+        return obj
+
+
+class GNBMember(_PickledSklearnMember):
+    """GaussianNB (``deam_classifier.py:210-212``)."""
+
+    kind = "gnb"
+
+    def __init__(self, name: str = "gnb", estimator: GaussianNB | None = None):
+        super().__init__(name, estimator or GaussianNB())
+
+    def fit(self, X, y):
+        self.estimator.fit(np.asarray(X), np.asarray(y))
+        return self
+
+    def update(self, X, y):
+        # partial_fit needs the class universe on a cold start only.
+        if not hasattr(self.estimator, "classes_"):
+            self.estimator.partial_fit(X, y, classes=ALL_CLASSES)
+        else:
+            self.estimator.partial_fit(X, y)
+
+
+class SGDMember(_PickledSklearnMember):
+    """SGD logistic regression, L2 (``deam_classifier.py:213-218``;
+    reference ``loss='log'`` is modern sklearn's ``'log_loss'``)."""
+
+    kind = "sgd"
+
+    def __init__(self, name: str = "sgd", estimator: SGDClassifier | None = None,
+                 seed: int | None = None):
+        super().__init__(name, estimator or SGDClassifier(
+            loss="log_loss", penalty="l2", random_state=seed, warm_start=True))
+
+    def fit(self, X, y):
+        self.estimator.fit(np.asarray(X), np.asarray(y))
+        return self
+
+    def update(self, X, y):
+        if not hasattr(self.estimator, "classes_"):
+            self.estimator.partial_fit(X, y, classes=ALL_CLASSES)
+        else:
+            self.estimator.partial_fit(X, y)
+
+
+class XGBMember(Member):
+    """Gradient-boosted trees via xgboost with AL-safe continued boosting.
+
+    Mirrors ``XGBClassifier(max_depth=5, eval_metric='auc', nthread=4)``
+    (``deam_classifier.py:226-231``) but drives ``xgboost.train`` directly so
+    ``num_class=4`` is pinned across warm-start updates — the semantics of
+    the reference's vendored patch (``xgboost/sklearn.py:854-860``) without
+    forking the library.
+    """
+
+    kind = "xgb"
+
+    def __init__(self, name: str = "xgb", *, max_depth: int = 5,
+                 n_estimators: int = 100, learning_rate: float = 0.3,
+                 nthread: int = 4, seed: int = 0):
+        if not HAVE_XGBOOST:
+            raise ImportError("xgboost unavailable; use BoostedTreesMember")
+        super().__init__(name)
+        self.params = {"objective": "multi:softprob",
+                       "num_class": NUM_CLASSES, "max_depth": max_depth,
+                       "eta": learning_rate, "nthread": nthread,
+                       "seed": seed, "eval_metric": "auc"}
+        self.n_estimators = n_estimators
+        self.booster = None
+
+    def fit(self, X, y):
+        d = _xgb.DMatrix(np.asarray(X), label=np.asarray(y))
+        self.booster = _xgb.train(self.params, d, self.n_estimators)
+        return self
+
+    def update(self, X, y):
+        """Continued boosting: adds rounds to the *existing* booster; the
+        objective stays 4-class even if the batch lacks classes."""
+        d = _xgb.DMatrix(np.asarray(X), label=np.asarray(y))
+        self.booster = _xgb.train(self.params, d, self.n_estimators,
+                                  xgb_model=self.booster)
+
+    def predict_proba(self, X):
+        return self.booster.predict(_xgb.DMatrix(np.asarray(X)))
+
+    def save(self, path):
+        raw = self.booster.save_raw() if self.booster is not None else None
+        with open(path, "wb") as f:
+            pickle.dump({"kind": self.kind, "name": self.name,
+                         "params": self.params,
+                         "n_estimators": self.n_estimators, "raw": raw}, f)
+
+    @classmethod
+    def load(cls, path):
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        obj = cls(state["name"])
+        obj.params = state["params"]
+        obj.n_estimators = state["n_estimators"]
+        if state["raw"] is not None:
+            obj.booster = _xgb.Booster(model_file=None)
+            obj.booster.load_model(bytearray(state["raw"]))
+        return obj
+
+
+class BoostedTreesMember(_PickledSklearnMember):
+    """Fallback boosted-trees member (xgboost absent): sklearn
+    ``GradientBoostingClassifier`` with ``warm_start`` continued boosting.
+
+    Class preservation: the estimator is always first fit with all 4 classes
+    present (the pre-trainer guarantees this); warm-start updates keep
+    ``classes_`` fixed, and query batches are boosted as additional stages.
+    """
+
+    kind = "xgb"  # fills the xgb committee slot
+
+    def __init__(self, name: str = "xgb", *, max_depth: int = 5,
+                 n_estimators: int = 50, update_estimators: int = 10,
+                 seed: int | None = None):
+        super().__init__(name, GradientBoostingClassifier(
+            max_depth=max_depth, n_estimators=n_estimators,
+            warm_start=True, random_state=seed))
+        self.update_estimators = update_estimators
+
+    def fit(self, X, y):
+        X, y = np.asarray(X), np.asarray(y)
+        self.estimator.fit(X, y)
+        self._remember(X, y)
+        return self
+
+    def update(self, X, y):
+        X, y = np.asarray(X), np.asarray(y)
+        # warm-start boosting requires every class present in y (sklearn
+        # validates); pad the batch with one nearest-feature row per missing
+        # class drawn from the estimator's training memory — since AL batches
+        # are small this keeps semantics close to continued boosting.
+        missing = np.setdiff1d(self.estimator.classes_, np.unique(y))
+        if missing.size:
+            Xm, ym = self._anchor_rows(missing)
+            X, y = np.vstack([X, Xm]), np.concatenate([y, ym])
+        self.estimator.n_estimators += self.update_estimators
+        self.estimator.fit(X, y)
+        self._remember(X, y)
+
+    # -- memory of one representative row per class ------------------------
+
+    def _remember(self, X, y):
+        mem = getattr(self, "_class_rows", {})
+        for c in np.unique(y):
+            mem[int(c)] = X[y == c][0]
+        self._class_rows = mem
+
+    def _anchor_rows(self, classes):
+        rows = [self._class_rows[int(c)] for c in classes]
+        return np.stack(rows), np.asarray(classes)
+
+    def save(self, path):
+        with open(path, "wb") as f:
+            pickle.dump({"kind": self.kind, "name": self.name,
+                         "estimator": self.estimator,
+                         "update_estimators": self.update_estimators,
+                         "class_rows": getattr(self, "_class_rows", {})}, f)
+
+    @classmethod
+    def load(cls, path):
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        obj = cls.__new__(cls)
+        Member.__init__(obj, state["name"])
+        obj.estimator = state["estimator"]
+        obj.update_estimators = state["update_estimators"]
+        obj._class_rows = state["class_rows"]
+        return obj
+
+
+def make_boosted_member(name: str = "xgb", seed: int = 0, **kw) -> Member:
+    """The boosted-trees committee slot: xgboost if present, else fallback."""
+    if HAVE_XGBOOST:
+        return XGBMember(name, seed=seed, **kw)
+    return BoostedTreesMember(name, seed=seed, **kw)

@@ -1,0 +1,1 @@
+"""Host utilities: checkpointing, profiling, structured logging."""

@@ -1,0 +1,49 @@
+"""Checkpointing for Flax variable pytrees.
+
+The reference's checkpoints are ``torch.save(state_dict)`` files rewritten
+on every validation improvement, with the mel filterbank smuggled inside and
+restored before ``load_state_dict`` (``amg_test.py:176-177,273``).  Here:
+
+- variables (params + batch_stats) serialize via flax msgpack with a JSON
+  meta sidecar header in the same file;
+- writes are atomic (tmp + rename) so a killed run can't leave a torn
+  best-checkpoint — the reference can (SURVEY.md §5 failure detection);
+- no frontend constants are stored (the mel fb is config-derived).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+import jax
+import numpy as np
+from flax import serialization
+
+_MAGIC = b"CETPU1\n"
+
+
+def save_variables(path: str, variables, meta: dict | None = None) -> None:
+    payload = serialization.to_bytes(jax.tree.map(np.asarray, variables))
+    header = json.dumps(meta or {}).encode()
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<I", len(header)))
+        f.write(header)
+        f.write(payload)
+    os.replace(tmp, path)
+
+
+def load_variables(path: str):
+    """Returns ``(variables, meta)``."""
+    with open(path, "rb") as f:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise ValueError(f"{path}: not a cetpu checkpoint")
+        (hlen,) = struct.unpack("<I", f.read(4))
+        meta = json.loads(f.read(hlen).decode())
+        payload = f.read()
+    variables = serialization.msgpack_restore(payload)
+    return variables, meta

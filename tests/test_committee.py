@@ -1,0 +1,122 @@
+"""Committee orchestration: FramePool aggregation, mixed host+device probs,
+checkpoint round-trip."""
+
+import jax
+import numpy as np
+
+from consensus_entropy_tpu.config import CNNConfig, NUM_CLASSES, TrainConfig
+from consensus_entropy_tpu.data.audio import DeviceWaveformStore
+from consensus_entropy_tpu.labels import one_hot_np
+from consensus_entropy_tpu.models import short_cnn
+from consensus_entropy_tpu.models.committee import CNNMember, Committee, FramePool
+from consensus_entropy_tpu.models.sklearn_members import GNBMember, SGDMember
+from consensus_entropy_tpu.utils.checkpoint import load_variables, save_variables
+
+TINY = CNNConfig(n_channels=4, n_mels=32, n_layers=5, input_length=8192)
+
+
+def _frame_pool(rng, n_songs=10, frames_per=(3, 8), f=12):
+    rows, sids = [], []
+    for i in range(n_songs):
+        k = int(rng.integers(*frames_per))
+        rows.append(rng.standard_normal((k, f)).astype(np.float32))
+        sids += [f"song{i}"] * k
+    return FramePool(np.vstack(rows), sids)
+
+
+def test_frame_pool_groupby_mean_parity(rng):
+    import pandas as pd
+
+    X = rng.standard_normal((50, 4)).astype(np.float32)
+    sids = [f"s{i % 7}" for i in range(50)]
+    pool = FramePool(X, sids)
+    df = pd.DataFrame(X.copy())
+    df["s_id"] = sids
+    want = df.groupby("s_id").mean().sort_index()
+    got = pool.mean_by_song(pool.X)
+    np.testing.assert_array_equal(pool.song_ids, list(want.index))
+    np.testing.assert_allclose(got, want.values, rtol=1e-5)
+
+
+def test_rows_for_songs(rng):
+    pool = _frame_pool(rng)
+    rows = pool.rows_for_songs(["song2", "song5"])
+    i2 = pool.song_ids.index("song2")
+    i5 = pool.song_ids.index("song5")
+    assert len(rows) == pool.counts[i2] + pool.counts[i5]
+
+
+def _committee(rng, n_cnn=2):
+    Xf = rng.standard_normal((120, 12)).astype(np.float32)
+    yf = rng.integers(0, 4, size=120)
+    host = [GNBMember().fit(Xf, yf), SGDMember(seed=0).fit(Xf, yf)]
+    cnns = [CNNMember(f"cnn{i}",
+                      short_cnn.init_variables(jax.random.key(i), TINY), TINY)
+            for i in range(n_cnn)]
+    return Committee(host, cnns, TINY, TrainConfig(batch_size=2))
+
+
+def test_pool_probs_shape_and_blocks(rng):
+    com = _committee(rng)
+    pool = _frame_pool(rng, n_songs=8, f=12)
+    waves = {s: rng.standard_normal(9000).astype(np.float32)
+             for s in pool.song_ids}
+    store = DeviceWaveformStore(waves, TINY.input_length)
+    probs = np.asarray(com.pool_probs(pool, store, pool.song_ids,
+                                      jax.random.key(0)))
+    assert probs.shape == (4, 8, NUM_CLASSES)
+    # host blocks are proper distributions; CNN blocks are sigmoid scores
+    np.testing.assert_allclose(probs[2:].sum(axis=-1), 1.0, atol=1e-4)
+    assert ((probs[:2] > 0) & (probs[:2] < 1)).all()
+
+
+def test_host_only_committee(rng):
+    com = _committee(rng, n_cnn=0)
+    pool = _frame_pool(rng, n_songs=6, f=12)
+    probs = np.asarray(com.pool_probs(pool, None, pool.song_ids,
+                                      jax.random.key(0)))
+    assert probs.shape == (2, 6, NUM_CLASSES)
+
+
+def test_committee_update_and_retrain(rng):
+    com = _committee(rng, n_cnn=1)
+    pool = _frame_pool(rng, n_songs=6, f=12)
+    waves = {s: rng.standard_normal(9500).astype(np.float32)
+             for s in pool.song_ids}
+    store = DeviceWaveformStore(waves, TINY.input_length)
+    Xb = rng.standard_normal((10, 12)).astype(np.float32)
+    yb = rng.integers(0, 4, size=10)
+    com.update_host(Xb, yb)
+    ids = pool.song_ids[:4]
+    y = one_hot_np(rng.integers(0, 4, size=4))
+    before = np.asarray(com.cnn_members[0].variables["params"]
+                        ["dense2"]["kernel"])
+    hists = com.retrain_cnns(store, ids, y, ids, y, jax.random.key(1),
+                             n_epochs=2)
+    assert len(hists) == 1 and len(hists[0]) == 2
+    after = np.asarray(com.cnn_members[0].variables["params"]
+                       ["dense2"]["kernel"])
+    assert not np.allclose(before, after)
+
+
+def test_variables_checkpoint_roundtrip(tmp_path, rng):
+    v = short_cnn.init_variables(jax.random.key(0), TINY)
+    path = str(tmp_path / "cnn.msgpack")
+    save_variables(path, v, meta={"name": "cnn0"})
+    v2, meta = load_variables(path)
+    assert meta["name"] == "cnn0"
+    x = rng.standard_normal((2, TINY.input_length)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(short_cnn.apply_infer(v, x, TINY)),
+        np.asarray(short_cnn.apply_infer(v2, x, TINY)), rtol=1e-6)
+
+
+def test_committee_save(tmp_path, rng):
+    com = _committee(rng, n_cnn=1)
+    com.save(str(tmp_path / "user0"))
+    import os
+
+    files = sorted(os.listdir(tmp_path / "user0"))
+    assert any(f.startswith("classifier_cnn") for f in files)
+    assert any(f.startswith("classifier_gnb") for f in files)
+    assert any(f.startswith("classifier_sgd") for f in files)

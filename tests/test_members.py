@@ -1,0 +1,87 @@
+"""Host committee members: partial_fit semantics, class preservation,
+persistence round-trips."""
+
+import numpy as np
+import pytest
+
+from consensus_entropy_tpu.config import NUM_CLASSES
+from consensus_entropy_tpu.models.sklearn_members import (
+    HAVE_XGBOOST,
+    BoostedTreesMember,
+    GNBMember,
+    SGDMember,
+    make_boosted_member,
+)
+
+
+def _data(rng, n=200, f=12):
+    X = rng.standard_normal((n, f))
+    centers = rng.standard_normal((NUM_CLASSES, f)) * 3
+    y = rng.integers(0, NUM_CLASSES, size=n)
+    X += centers[y]
+    return X.astype(np.float32), y
+
+
+@pytest.mark.parametrize("cls", [GNBMember, SGDMember])
+def test_fit_predict_proba(cls, rng):
+    X, y = _data(rng)
+    m = cls().fit(X, y)
+    p = m.predict_proba(X)
+    assert p.shape == (len(X), NUM_CLASSES)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, atol=1e-5)
+    assert (m.predict(X) == y).mean() > 0.8
+
+
+@pytest.mark.parametrize("cls", [GNBMember, SGDMember])
+def test_partial_fit_update(cls, rng):
+    X, y = _data(rng)
+    m = cls().fit(X[:150], y[:150])
+    m.update(X[150:], y[150:])  # amg_test.py:509
+    assert m.predict_proba(X[:5]).shape == (5, NUM_CLASSES)
+
+
+@pytest.mark.parametrize("cls", [GNBMember, SGDMember])
+def test_update_with_missing_classes_keeps_4_columns(cls, rng):
+    X, y = _data(rng)
+    m = cls().fit(X, y)
+    sel = y == 0  # a query batch containing only class 0
+    m.update(X[sel][:5], y[sel][:5])
+    p = m.predict_proba(X[:10])
+    assert p.shape == (10, NUM_CLASSES)
+    np.testing.assert_array_equal(m.estimator.classes_, np.arange(4))
+
+
+def test_boosted_fallback_class_preservation(rng):
+    X, y = _data(rng)
+    m = BoostedTreesMember(n_estimators=10, update_estimators=5, seed=0)
+    m.fit(X, y)
+    n0 = m.estimator.n_estimators_
+    sel = y == 2
+    m.update(X[sel][:6], y[sel][:6])  # single-class batch, like the AL loop
+    assert m.estimator.n_estimators_ > n0  # boosting continued
+    p = m.predict_proba(X[:7])
+    assert p.shape == (7, NUM_CLASSES)
+    np.testing.assert_array_equal(m.estimator.classes_, np.arange(4))
+
+
+def test_make_boosted_member_gating():
+    m = make_boosted_member(seed=0)
+    if HAVE_XGBOOST:
+        assert type(m).__name__ == "XGBMember"
+    else:
+        assert isinstance(m, BoostedTreesMember)
+    assert m.kind == "xgb"
+
+
+@pytest.mark.parametrize("factory", [
+    lambda: GNBMember(), lambda: SGDMember(seed=1),
+    lambda: BoostedTreesMember(n_estimators=5, seed=1)])
+def test_save_load_roundtrip(factory, rng, tmp_path):
+    X, y = _data(rng, n=80)
+    m = factory().fit(X, y)
+    path = str(tmp_path / "m.pkl")
+    m.save(path)
+    m2 = type(m).load(path)
+    np.testing.assert_allclose(m2.predict_proba(X[:9]),
+                               m.predict_proba(X[:9]), rtol=1e-6)
+    m2.update(X[:10], y[:10])  # loaded member must still be updatable
