@@ -1,0 +1,1 @@
+"""Active-learning driver: acquisition, per-user loop, reporting, resume."""

@@ -1,0 +1,118 @@
+"""Acquisition: the bridge between the fused scoring graph and song ids.
+
+Wraps ``ops.scoring`` with the bookkeeping the reference does inline in its
+driver (``amg_test.py:425-489``): index↔song-id mapping, the hc table's
+"queried rows never repeat" removal (``amg_test.py:455,484``), the mix
+block-concatenation, and the shrinking-pool mask — all while keeping every
+device shape fixed across the 10 AL iterations (one compile per mode per
+user-pool size class).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consensus_entropy_tpu.config import NUM_CLASSES
+from consensus_entropy_tpu.ops import scoring
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+class Acquirer:
+    """Per-user acquisition state over a fixed padded pool.
+
+    ``train_songs``: the user's train-split song ids (pool rows, in order).
+    ``hc_rows``: human-consensus frequency table aligned with ``train_songs``
+    (the reference restricts hc to train songs at ``amg_test.py:376``).
+    """
+
+    def __init__(self, train_songs, hc_rows: np.ndarray | None, *, queries: int,
+                 mode: str, tie_break: str = "fast", pad_multiple: int = 8,
+                 seed: int = 0):
+        self.mode = mode
+        self.queries = queries
+        self.songs = list(train_songs)
+        self.n_valid = len(self.songs)
+        self.n_pad = _round_up(max(self.n_valid, queries), pad_multiple)
+        self._song_row = {s: i for i, s in enumerate(self.songs)}
+
+        self.pool_mask = np.zeros(self.n_pad, bool)
+        self.pool_mask[: self.n_valid] = True
+        self.hc_mask = self.pool_mask.copy()
+        if hc_rows is not None:
+            hc = np.zeros((self.n_pad, NUM_CLASSES), np.float32)
+            hc[: self.n_valid] = np.asarray(hc_rows, np.float32)
+            self.hc = hc
+        else:
+            self.hc = np.zeros((self.n_pad, NUM_CLASSES), np.float32)
+            self.hc_mask[:] = False
+        self._fns = scoring.make_scoring_fns(k=queries, tie_break=tie_break)
+        self._rand_key = jax.random.key(seed)
+
+    # -- helpers -----------------------------------------------------------
+
+    @property
+    def remaining_songs(self) -> list:
+        return [s for s, ok in zip(self.songs, self.pool_mask) if ok]
+
+    def pad_probs(self, member_probs) -> np.ndarray:
+        """Pad ``(M, n_live, C)`` member probs (over ``remaining_songs``) out
+        to the fixed ``(M, n_pad, C)`` device shape."""
+        member_probs = np.asarray(member_probs)
+        m = member_probs.shape[0]
+        out = np.zeros((m, self.n_pad, NUM_CLASSES), np.float32)
+        live = np.flatnonzero(self.pool_mask)
+        out[:, live] = member_probs
+        return out
+
+    # -- the four modes ----------------------------------------------------
+
+    def select(self, member_probs=None) -> list:
+        """Pick the next query batch; returns song ids (≤ ``queries``).
+
+        ``member_probs``: ``(M, n_live, C)`` over ``remaining_songs`` — only
+        needed for mc/mix.  Updates pool/hc masks exactly as the reference
+        mutates its tables.
+        """
+        if self.mode == "mc":
+            res = self._fns["mc"](self.pad_probs(member_probs), self.pool_mask)
+            q_songs = self._ids(res)
+        elif self.mode == "hc":
+            res = self._fns["hc"](self.hc, self.hc_mask)
+            q_songs = self._ids(res)
+            self._remove_hc(q_songs)  # amg_test.py:455
+        elif self.mode == "mix":
+            res = self._fns["mix"](self.pad_probs(member_probs),
+                                   self.pool_mask, self.hc, self.hc_mask)
+            is_hc, slots = scoring.split_mix_index(res.indices, self.n_pad)
+            valid = np.asarray(res.values) > -np.inf
+            raw = [self.songs[int(s)]
+                   for s, ok in zip(np.asarray(slots), valid) if ok]
+            # the same song can surface from both blocks; the reference's
+            # isin-based batch build dedups implicitly (amg_test.py:491)
+            q_songs = list(dict.fromkeys(raw))
+            self._remove_hc(q_songs)  # amg_test.py:484
+        elif self.mode == "rand":
+            self._rand_key, sub = jax.random.split(self._rand_key)
+            res = self._fns["rand"](sub, self.pool_mask)
+            q_songs = self._ids(res)
+        else:
+            raise ValueError(f"unknown mode {self.mode!r}")
+
+        # remove the batch from the unlabeled pool (amg_test.py:520-523)
+        for s in q_songs:
+            self.pool_mask[self._song_row[s]] = False
+        return q_songs
+
+    def _ids(self, res: scoring.ScoreResult) -> list:
+        idx = np.asarray(res.indices)
+        valid = np.asarray(res.values) > -np.inf
+        return [self.songs[int(i)] for i, ok in zip(idx, valid) if ok]
+
+    def _remove_hc(self, q_songs):
+        for s in q_songs:
+            self.hc_mask[self._song_row[s]] = False

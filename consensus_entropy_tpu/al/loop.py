@@ -1,0 +1,159 @@
+"""The per-user active-learning loop — TPU-native rebuild of
+``AMG_Tester.run`` (``amg_test.py:344-539``).
+
+Per user: grouped 85/15 song split → per-iteration [score pool → query top-q
+→ reveal the user's labels → incrementally retrain every member → evaluate]
+× ``epochs``, with epoch-0 baseline evaluation and text/jsonl reporting.
+
+What moved on device: committee scoring + consensus entropy + top-k (one jit
+graph, fixed shapes, mask-shrunk pool), CNN retraining epochs, crop sampling.
+What stays host: sklearn partial_fit/boosting, frame bookkeeping, metrics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping
+
+import jax
+import numpy as np
+
+from consensus_entropy_tpu.al.acquisition import Acquirer
+from consensus_entropy_tpu.al.reporting import UserReport, weighted_f1
+from consensus_entropy_tpu.config import ALConfig
+from consensus_entropy_tpu.data.audio import DeviceWaveformStore
+from consensus_entropy_tpu.labels import one_hot_np
+from consensus_entropy_tpu.models.committee import Committee, FramePool
+
+
+@dataclasses.dataclass
+class UserData:
+    """Everything the loop needs for one user."""
+
+    user_id: object
+    pool: FramePool  # frames of the user's annotated songs (scaled features)
+    labels: Mapping  # song id → class 0..3 (the user's annotations; oracle)
+    hc_rows: np.ndarray | None = None  # HC freq rows aligned with pool.song_ids
+    store: DeviceWaveformStore | None = None  # audio (CNN committees only)
+
+
+@dataclasses.dataclass
+class SplitData:
+    train_songs: list
+    test_songs: list
+    X_test: np.ndarray  # test frames (host-member evaluation is frame-level,
+    y_test_frames: np.ndarray  # amg_test.py:411-413)
+    y_test_songs: np.ndarray  # song-level labels (CNN eval, amg_test.py:406-408)
+
+
+def grouped_split(pool: FramePool, labels: Mapping, train_size: float,
+                  rng: np.random.Generator) -> SplitData:
+    """Song-grouped shuffle split (``GroupShuffleSplit`` semantics,
+    ``amg_test.py:363-366``): train_size fraction of *songs*."""
+    songs = list(pool.song_ids)
+    perm = rng.permutation(len(songs))
+    n_train = int(round(train_size * len(songs)))
+    train_songs = [songs[i] for i in sorted(perm[:n_train])]
+    test_songs = [songs[i] for i in sorted(perm[n_train:])]
+    rows = pool.rows_for_songs(test_songs)
+    X_test = pool.X[rows]
+    # per-frame labels repeat the song label (the reference's y_train/y_test
+    # are frame-indexed with identical labels per song)
+    frame_song = np.concatenate(
+        [[s] * pool.counts[pool.song_ids.index(s)] for s in test_songs]) \
+        if test_songs else np.empty(0, object)
+    y_test_frames = np.array([labels[s] for s in frame_song], np.int32) \
+        if len(frame_song) else np.empty(0, np.int32)
+    y_test_songs = np.array([labels[s] for s in test_songs], np.int32)
+    return SplitData(train_songs, test_songs, X_test, y_test_frames,
+                     y_test_songs)
+
+
+class ALLoop:
+    def __init__(self, config: ALConfig, *, tie_break: str = "fast",
+                 retrain_epochs: int | None = None):
+        self.config = config
+        self.tie_break = tie_break
+        self.retrain_epochs = retrain_epochs
+
+    def _evaluate(self, committee: Committee, data: UserData,
+                  split: SplitData, report: UserReport, key) -> list[float]:
+        """Evaluate every member on the user's test set; returns F1 list in
+        committee order (CNN members first, as ``member_names``)."""
+        f1s = []
+        if committee.cnn_members:
+            probs = np.asarray(committee.predict_songs_cnn(
+                data.store, split.test_songs, key))
+            for m, p in zip(committee.cnn_members, probs):
+                y_pred = p.argmax(axis=1)
+                f1s.append(report.model_eval(m.name, split.y_test_songs,
+                                             y_pred))
+        for m in committee.host_members:
+            y_pred = m.predict(split.X_test)
+            f1s.append(report.model_eval(m.name, split.y_test_frames, y_pred))
+        return f1s
+
+    def run_user(self, committee: Committee, data: UserData, user_path: str,
+                 *, seed: int | None = None) -> dict:
+        cfg = self.config
+        seed = cfg.seed if seed is None else seed
+        rng = np.random.default_rng(seed)
+        key = jax.random.key(seed)
+
+        split = grouped_split(data.pool, data.labels, cfg.train_size, rng)
+        hc_rows = None
+        if data.hc_rows is not None:
+            row_of = {s: i for i, s in enumerate(data.pool.song_ids)}
+            hc_rows = np.asarray(data.hc_rows)[
+                [row_of[s] for s in split.train_songs]]
+        acq = Acquirer(split.train_songs, hc_rows, queries=cfg.queries,
+                       mode=cfg.mode, tie_break=self.tie_break, seed=seed)
+
+        trajectory = []
+        with UserReport(user_path, cfg.mode) as report:
+            # epoch 0: baseline evaluation (amg_test.py:398-418)
+            report.epoch_header(-1)
+            key, sub = jax.random.split(key)
+            f1s = self._evaluate(committee, data, split, report, sub)
+            report.epoch_summary(-1, f1s)
+            trajectory.append(float(np.mean(f1s)))
+
+            for epoch in range(cfg.epochs):
+                report.epoch_header(epoch)
+                live = acq.remaining_songs
+                if len(live) == 0:
+                    break
+                member_probs = None
+                if cfg.mode in ("mc", "mix"):
+                    key, sub = jax.random.split(key)
+                    member_probs = np.asarray(committee.pool_probs(
+                        data.pool, data.store, live, sub))
+                q_songs = acq.select(member_probs)
+
+                # reveal labels; build the frame batch (amg_test.py:491-493)
+                rows = data.pool.rows_for_songs(q_songs)
+                X_batch = data.pool.X[rows]
+                frame_labels = []
+                for s in q_songs:
+                    n = data.pool.counts[data.pool.song_ids.index(s)]
+                    frame_labels += [data.labels[s]] * int(n)
+                y_batch = np.asarray(frame_labels, np.int32)
+
+                committee.update_host(X_batch, y_batch)
+                if committee.cnn_members:
+                    y_q = one_hot_np([data.labels[s] for s in q_songs])
+                    y_t = one_hot_np(split.y_test_songs)
+                    key, sub = jax.random.split(key)
+                    committee.retrain_cnns(
+                        data.store, q_songs, y_q, split.test_songs, y_t, sub,
+                        n_epochs=self.retrain_epochs)
+
+                key, sub = jax.random.split(key)
+                f1s = self._evaluate(committee, data, split, report, sub)
+                report.epoch_summary(epoch, f1s, queried=q_songs,
+                                     pool_size=len(acq.remaining_songs))
+                trajectory.append(float(np.mean(f1s)))
+
+        return {"user": data.user_id, "mode": cfg.mode,
+                "trajectory": trajectory,
+                "final_mean_f1": trajectory[-1] if trajectory else None}

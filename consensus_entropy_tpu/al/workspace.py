@@ -1,0 +1,93 @@
+"""Per-user workspaces: private committee copies + crash resume.
+
+Reference behavior (``amg_test.py:146-171``): each user gets
+``models/users/{uid}/{mode}/`` populated with a copy of every pretrained
+model; if the directory already exists the whole user is skipped (crude
+resume at user granularity — partially processed users are NOT redone).
+
+Reproduced with one robustness fix: a user directory is only considered
+complete once a ``DONE`` marker is written at the end of the user's run, so
+a run killed mid-user redoes that user instead of silently skipping it
+(SURVEY.md §5 failure detection / elastic recovery).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+from consensus_entropy_tpu.config import CNNConfig, TrainConfig
+from consensus_entropy_tpu.models.base import Member
+from consensus_entropy_tpu.models.committee import CNNMember, Committee
+from consensus_entropy_tpu.models.sklearn_members import (
+    BoostedTreesMember,
+    GNBMember,
+    SGDMember,
+)
+
+_DONE = "DONE"
+
+_HOST_LOADERS = {"gnb": GNBMember, "sgd": SGDMember, "xgb": None}
+
+
+def user_dir(users_root: str, user, mode: str) -> str:
+    return os.path.join(users_root, str(user), mode)
+
+
+def create_user(users_root: str, pretrained_dir: str, user, mode: str):
+    """Returns ``(path, skip)``; copies the pretrained committee on first
+    creation (``amg_test.py:146-171``)."""
+    path = user_dir(users_root, user, mode)
+    if os.path.exists(os.path.join(path, _DONE)):
+        return path, True
+    if os.path.isdir(path):  # stale partial run: redo from pristine models
+        shutil.rmtree(path)
+    os.makedirs(path)
+    for fname in sorted(os.listdir(pretrained_dir)):
+        if fname.endswith((".pkl", ".msgpack")):
+            shutil.copy(os.path.join(pretrained_dir, fname),
+                        os.path.join(path, fname))
+    return path, False
+
+
+def mark_done(path: str) -> None:
+    with open(os.path.join(path, _DONE), "w") as f:
+        f.write("ok\n")
+
+
+def load_committee(path: str, config: CNNConfig = CNNConfig(),
+                   train_config: TrainConfig = TrainConfig()) -> Committee:
+    """Load every model file in a workspace into a Committee.
+
+    File naming (written by ``Committee.save``):
+    ``classifier_{kind}.{name}.pkl`` for host members,
+    ``classifier_cnn.{name}.msgpack`` for Flax members.
+    """
+    host: list[Member] = []
+    cnns: list[CNNMember] = []
+    for fname in sorted(os.listdir(path)):
+        full = os.path.join(path, fname)
+        if fname.endswith(".msgpack"):
+            cnns.append(CNNMember.load(full, config, train_config))
+        elif fname.endswith(".pkl"):
+            kind = fname.split(".")[0].replace("classifier_", "")
+            loader = _HOST_LOADERS.get(kind)
+            if loader is None:  # boosted slot: dispatch on pickle content
+                host.append(_load_boosted(full))
+            else:
+                host.append(loader.load(full))
+    if not host and not cnns:
+        raise FileNotFoundError(f"no committee members in {path}")
+    return Committee(host, cnns, config, train_config)
+
+
+def _load_boosted(path: str) -> Member:
+    import pickle
+
+    with open(path, "rb") as f:
+        state = pickle.load(f)
+    if "raw" in state:
+        from consensus_entropy_tpu.models.sklearn_members import XGBMember
+
+        return XGBMember.load(path)
+    return BoostedTreesMember.load(path)

@@ -42,6 +42,16 @@ except ImportError:  # pragma: no cover - env without xgboost
 ALL_CLASSES = np.arange(NUM_CLASSES)
 
 
+def _require_all_classes(y):
+    """Pre-training must expose the full class universe (DEAM does; the
+    reference's partial_fit/warm-start chain silently relies on it)."""
+    seen = np.unique(y)
+    if len(seen) != NUM_CLASSES:
+        raise ValueError(
+            f"pre-training data must contain all {NUM_CLASSES} classes; "
+            f"got {sorted(int(c) for c in seen)}")
+
+
 class _PickledSklearnMember(Member):
     """Shared persistence for members whose state is one sklearn estimator."""
 
@@ -89,7 +99,9 @@ class GNBMember(_PickledSklearnMember):
         super().__init__(name, estimator or GaussianNB())
 
     def fit(self, X, y):
-        self.estimator.fit(np.asarray(X), np.asarray(y))
+        y = np.asarray(y)
+        _require_all_classes(y)
+        self.estimator.fit(np.asarray(X), y)
         return self
 
     def update(self, X, y):
@@ -112,7 +124,9 @@ class SGDMember(_PickledSklearnMember):
             loss="log_loss", penalty="l2", random_state=seed, warm_start=True))
 
     def fit(self, X, y):
-        self.estimator.fit(np.asarray(X), np.asarray(y))
+        y = np.asarray(y)
+        _require_all_classes(y)
+        self.estimator.fit(np.asarray(X), y)
         return self
 
     def update(self, X, y):
@@ -203,6 +217,7 @@ class BoostedTreesMember(_PickledSklearnMember):
 
     def fit(self, X, y):
         X, y = np.asarray(X), np.asarray(y)
+        _require_all_classes(y)
         self.estimator.fit(X, y)
         self._remember(X, y)
         return self

@@ -1,0 +1,90 @@
+"""Acquirer: mode semantics, pool shrinkage, hc removal, fixed shapes."""
+
+import numpy as np
+import pytest
+
+from consensus_entropy_tpu.al.acquisition import Acquirer
+
+
+def _probs(rng, m, n):
+    p = rng.uniform(0.01, 1, size=(m, n, 4)).astype(np.float32)
+    return p / p.sum(-1, keepdims=True)
+
+
+def _hc(rng, n):
+    c = rng.integers(1, 20, size=(n, 4))
+    return np.round(c / c.sum(1, keepdims=True), 3).astype(np.float32)
+
+
+SONGS = [f"s{i:03d}" for i in range(37)]
+
+
+def test_mc_shrinks_pool(rng):
+    acq = Acquirer(SONGS, None, queries=5, mode="mc", seed=0)
+    assert acq.n_pad % 8 == 0 and acq.n_pad >= 37
+    total = set()
+    for _ in range(4):
+        live = acq.remaining_songs
+        q = acq.select(_probs(rng, 3, len(live)))
+        assert len(q) == 5
+        assert not set(q) & total  # never re-queried
+        total |= set(q)
+    assert len(acq.remaining_songs) == 37 - 20
+
+
+def test_mc_picks_max_entropy(rng):
+    acq = Acquirer(SONGS, None, queries=3, mode="mc")
+    live = acq.remaining_songs
+    p = np.zeros((2, len(live), 4), np.float32)
+    p[:, :, 0] = 1.0  # everything certain → entropy 0
+    for j, hot in enumerate([5, 11, 20]):  # three uniform (max-entropy) songs
+        p[:, hot, :] = 0.25
+    q = acq.select(p)
+    assert set(q) == {SONGS[5], SONGS[11], SONGS[20]}
+
+
+def test_hc_mode_removes_rows(rng):
+    hc = _hc(rng, 37)
+    acq = Acquirer(SONGS, hc, queries=6, mode="hc")
+    q1 = acq.select()
+    q2 = acq.select()
+    assert not set(q1) & set(q2)
+    # and pool also shrank (amg_test.py:520-523 applies in every mode)
+    assert len(acq.remaining_songs) == 37 - len(q1) - len(q2)
+
+
+def test_mix_mode_dedups_and_removes(rng):
+    hc = _hc(rng, 37)
+    acq = Acquirer(SONGS, hc, queries=6, mode="mix")
+    live = acq.remaining_songs
+    q = acq.select(_probs(rng, 4, len(live)))
+    assert 1 <= len(q) <= 6
+    assert len(set(q)) == len(q)
+    for s in q:
+        r = acq._song_row[s]
+        assert not acq.pool_mask[r] and not acq.hc_mask[r]
+
+
+def test_rand_mode_unique_and_seeded():
+    a1 = Acquirer(SONGS, None, queries=8, mode="rand", seed=3)
+    a2 = Acquirer(SONGS, None, queries=8, mode="rand", seed=3)
+    a3 = Acquirer(SONGS, None, queries=8, mode="rand", seed=4)
+    q1, q2, q3 = a1.select(), a2.select(), a3.select()
+    assert q1 == q2
+    assert q1 != q3
+    assert len(set(q1)) == 8
+
+
+def test_exhausting_pool(rng):
+    songs = SONGS[:7]
+    acq = Acquirer(songs, None, queries=5, mode="mc")
+    q1 = acq.select(_probs(rng, 2, 7))
+    assert len(q1) == 5
+    q2 = acq.select(_probs(rng, 2, 2))
+    assert len(q2) == 2  # only 2 valid left; -inf slots trimmed
+    assert acq.remaining_songs == []
+
+
+def test_unknown_mode():
+    with pytest.raises(ValueError):
+        Acquirer(SONGS, None, queries=3, mode="zzz").select()
