@@ -1,0 +1,106 @@
+"""AL personalization CLI — surface parity with
+``amg_test.py -q 10 -e 10 -m mc -n 150`` (``amg_test.py:542-585``) plus
+``--device {tpu,cpu}`` (BASELINE.json).
+
+Per user: copy the pretrained committee into a private workspace, run the
+consensus-entropy AL loop, persist models + reports, mark done (resumable).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from consensus_entropy_tpu.cli.common import (
+    add_device_arg,
+    add_path_args,
+    configure_device,
+)
+
+MODES = ("mc", "hc", "mix", "rand")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="Consensus-entropy active learning on AMG1608")
+    p.add_argument("-q", "--queries", required=True, type=int,
+                   help="queries per AL iteration")
+    p.add_argument("-e", "--epochs", required=True, type=int,
+                   help="AL iterations")
+    p.add_argument("-n", "--num_anno", required=True, type=int,
+                   help="minimum annotations per user")
+    p.add_argument("-m", "--mode", required=True, choices=MODES,
+                   help="acquisition: machine-consensus [mc], human "
+                        "consensus [hc], both [mix], random [rand]")
+    p.add_argument("--max-users", type=int, default=None,
+                   help="cap the user count (debug)")
+    p.add_argument("--seed", type=int, default=1987)
+    p.add_argument("--tie-break", choices=("fast", "numpy"), default="fast")
+    add_path_args(p)
+    add_device_arg(p)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    configure_device(args.device)
+
+    import numpy as np
+
+    from consensus_entropy_tpu.al import workspace
+    from consensus_entropy_tpu.al.loop import ALLoop, UserData
+    from consensus_entropy_tpu.config import ALConfig, CNNConfig, PathsConfig
+    from consensus_entropy_tpu.data import amg
+
+    paths = PathsConfig(models_root=args.models_root,
+                        deam_root=args.deam_root, amg_root=args.amg_root)
+    cfg = ALConfig(queries=args.queries, epochs=args.epochs, mode=args.mode,
+                   num_anno=args.num_anno, seed=args.seed)
+
+    anno = amg.load_annotations(paths.amg_annotations_mat,
+                                paths.amg_mapping_mat)
+    hc_table = amg.hc_frequency_table(anno)
+    anno, users = amg.filter_users(anno, cfg.num_anno)
+    print(f"Users with more than {cfg.num_anno} annotations: {len(users)}")
+    pool = amg.load_feature_pool(paths.amg_dataset_csv,
+                                 paths.amg_features_dir)
+
+    cnn_cfg = CNNConfig()
+    store = None
+    if any(f.endswith(".msgpack") for f in os.listdir(paths.pretrained_dir)):
+        from consensus_entropy_tpu.data.audio import HostWaveformStore
+
+        store = HostWaveformStore(paths.amg_npy_dir, pool.song_ids,
+                                  cnn_cfg.input_length)
+
+    loop = ALLoop(cfg, tie_break=args.tie_break)
+    results = []
+    for num_user, u_id in enumerate(users[: args.max_users]):
+        user_path, skip = workspace.create_user(
+            paths.users_dir, paths.pretrained_dir, u_id, cfg.mode)
+        if skip:
+            print(f"Skipping user {u_id}, already exists!")
+            continue
+        committee = workspace.load_committee(user_path, cnn_cfg)
+        sub_pool, labels = amg.user_pool(pool, anno, u_id)
+        hc_rows = hc_table.reindex(sub_pool.song_ids).to_numpy(np.float32)
+        data = UserData(u_id, sub_pool, labels, hc_rows=hc_rows, store=store)
+        print(f"Creating and performing active learning for user {u_id} "
+              f"with {len(labels)} annotations.")
+        print(f"User {num_user} / {len(users) - 1}")
+        res = loop.run_user(committee, data, user_path, seed=cfg.seed)
+        committee.save(user_path)
+        workspace.mark_done(user_path)
+        results.append(res)
+        print(f"user {u_id}: final mean F1 = {res['final_mean_f1']:.4f}")
+
+    if results:
+        finals = [r["final_mean_f1"] for r in results]
+        print(f"\n{len(results)} users; final committee F1 "
+              f"μ={np.mean(finals):.4f} σ={np.std(finals):.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

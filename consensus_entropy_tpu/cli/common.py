@@ -1,0 +1,29 @@
+"""Shared CLI plumbing: device selection, path flags."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def add_device_arg(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--device", choices=("tpu", "cpu"), default="tpu",
+                        help="execution backend (BASELINE.json: --device tpu "
+                             "gates the JAX/TPU path; cpu forces the host "
+                             "platform, e.g. for CI)")
+
+
+def configure_device(device: str) -> None:
+    """Must run before the first JAX backend touch."""
+    if device == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def add_path_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--models-root", default="./models",
+                        help="model store root (settings.py:11)")
+    parser.add_argument("--deam-root", default="./data/deam",
+                        help="DEAM dataset root (settings.py:17-21)")
+    parser.add_argument("--amg-root", default="./data/amg1608",
+                        help="AMG1608 dataset root (settings.py:27-33)")

@@ -1,0 +1,93 @@
+"""Pre-training CLI — surface parity with ``deam_classifier.py -cv N -m MODEL``
+(``deam_classifier.py:353-384``) plus ``--device`` and the ``cnn_jax``
+registry entry (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from consensus_entropy_tpu.cli.common import (
+    add_device_arg,
+    add_path_args,
+    configure_device,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from consensus_entropy_tpu.train.pretrain import MODEL_CHOICES
+
+    p = argparse.ArgumentParser(
+        description="Pre-train committee members on DEAM")
+    p.add_argument("-cv", "--cross_val", required=True, dest="cross_val",
+                   help="cross validation splits (int)")
+    p.add_argument("-m", "--model", required=True, dest="model",
+                   choices=MODEL_CHOICES,
+                   help="model to train ('cnn' is an alias of the Flax "
+                        "'cnn_jax'; there is no torch path)")
+    p.add_argument("--epochs", type=int, default=None,
+                   help="override CNN epochs (default settings n_epochs_cnn)")
+    p.add_argument("--seed", type=int, default=1987)
+    add_path_args(p)
+    add_device_arg(p)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        cv = int(args.cross_val)
+    except ValueError:
+        print("Cross validation parameter must be a number!")
+        return 2
+    configure_device(args.device)
+
+    import os
+
+    from consensus_entropy_tpu.config import PathsConfig
+    from consensus_entropy_tpu.data import deam
+    from consensus_entropy_tpu.train import pretrain
+
+    paths = PathsConfig(models_root=args.models_root,
+                        deam_root=args.deam_root, amg_root=args.amg_root)
+    out_dir = paths.pretrained_dir
+
+    if args.model in ("cnn", "cnn_jax"):
+        import numpy as np
+
+        from consensus_entropy_tpu.config import CNNConfig, TrainConfig
+        from consensus_entropy_tpu.data.audio import HostWaveformStore
+
+        df = deam.load_dataset(paths.deam_features_dir,
+                               os.path.join(args.deam_root, "annotations",
+                                            "arousal.csv"),
+                               os.path.join(args.deam_root, "annotations",
+                                            "valence.csv"),
+                               cache_csv=paths.deam_dataset_csv)
+        # song-level label = majority frame quadrant (the reference's
+        # groupby('song_id').max() picks the lexicographic max quadrant,
+        # deam_classifier.py:253; we keep that exact rule)
+        per_song = (df.groupby("song_id")["quadrants"].max())
+        labels = {sid: int(q[1]) - 1 for sid, q in per_song.items()}
+        cfg = CNNConfig()
+        store = HostWaveformStore(paths.deam_npy_dir, list(labels),
+                                  cfg.input_length)
+        pretrain.pretrain_cnn(labels, store, cv=cv, out_dir=out_dir,
+                              config=cfg, train_config=TrainConfig(),
+                              n_epochs=args.epochs, seed=args.seed)
+    else:
+        df = deam.load_dataset(paths.deam_features_dir,
+                               os.path.join(args.deam_root, "annotations",
+                                            "arousal.csv"),
+                               os.path.join(args.deam_root, "annotations",
+                                            "valence.csv"),
+                               cache_csv=paths.deam_dataset_csv)
+        X, y, song_ids = deam.training_arrays(df)
+        pretrain.pretrain_classic(args.model, X, y, song_ids, cv=cv,
+                                  out_dir=out_dir, seed=args.seed)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

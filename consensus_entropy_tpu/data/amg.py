@@ -1,0 +1,134 @@
+"""AMG1608 data layer: annotations, human-consensus table, feature pool.
+
+Parity targets (all host-side, numpy/pandas):
+
+- ``load_annotations`` — ``amg_test.py:87-126``: the ``song_label`` tensor
+  ``(n_songs, n_users, 2)`` with columns ``[valence, arousal]`` per
+  annotation (NaN = unannotated) joined with the ``mat_id2song_id`` mapping
+  into a long (song, user, valence, arousal, quadrant) table, AMG-variant
+  quadrant geometry.
+- ``hc_frequency_table`` — ``amg_test.py:108-117``: per-song relative
+  frequencies of Q1..Q4 over **all** annotators, rounded to 3 decimals
+  (the rounding is load-bearing: downstream entropy renormalizes).
+- ``filter_users`` — ``amg_test.py:119-126``: keep users with ≥ num_anno
+  annotations (46 users at the paper's n=150).
+- ``load_feature_pool`` — ``amg_test.py:57-65,128-144``: openSMILE frame
+  features (many ~1 s frames per song), scaled by a StandardScaler **fit on
+  the entire pool at once** (by design in the reference), sliced to the 260
+  columns ``F0final_sma_stddev``..``mfcc_sma_de[14]_amean``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pandas as pd
+
+from consensus_entropy_tpu.config import (
+    FEATURE_SLICE_START,
+    FEATURE_SLICE_STOP,
+    NUM_CLASSES,
+)
+from consensus_entropy_tpu.labels import quadrant_amg_np
+from consensus_entropy_tpu.models.committee import FramePool
+
+QUAD_COLS = ["Q1", "Q2", "Q3", "Q4"]
+
+
+def load_annotations(mat_path: str, mapping_path: str) -> pd.DataFrame:
+    """Long annotation table: song_id, user_id, valence, arousal, quadrant
+    (int class 0..3)."""
+    from scipy.io import loadmat
+
+    anno = loadmat(mat_path)["song_label"]  # (n_songs, n_users, 2)
+    mapping = loadmat(mapping_path)["mat_id2song_id"]
+    n_songs, n_users = anno.shape[0], anno.shape[1]
+    song_ids = np.repeat(np.asarray(mapping).reshape(n_songs)[:, None],
+                         n_users, axis=1).ravel()
+    user_ids = np.tile(np.arange(n_users), n_songs)
+    valence = anno[:, :, 0].ravel()
+    arousal = anno[:, :, 1].ravel()
+    ok = ~(np.isnan(valence) | np.isnan(arousal))
+    df = pd.DataFrame({
+        "song_id": song_ids[ok], "user_id": user_ids[ok],
+        "valence": valence[ok], "arousal": arousal[ok]})
+    df["quadrant"] = quadrant_amg_np(df.arousal.values, df.valence.values)
+    return df
+
+
+def hc_frequency_table(anno: pd.DataFrame) -> pd.DataFrame:
+    """Per-song quadrant frequency over all annotators, rounded to 3 decimals
+    (``amg_test.py:109-117``).  Index: song_id; columns Q1..Q4."""
+    counts = (anno.groupby(["song_id", "quadrant"]).size()
+              .unstack(fill_value=0)
+              .reindex(columns=range(NUM_CLASSES), fill_value=0))
+    freq = counts.div(counts.sum(axis=1), axis=0).round(3)
+    freq.columns = QUAD_COLS
+    return freq
+
+
+def filter_users(anno: pd.DataFrame, num_anno: int):
+    """Users with ≥ num_anno annotations; returns (filtered_anno, user_ids)
+    preserving the reference's first-appearance user order."""
+    counts = anno.groupby("user_id").size()
+    keep = counts[counts >= num_anno].index
+    out = anno[anno.user_id.isin(keep)]
+    return out, out.user_id.unique().tolist()
+
+
+def _assemble_feature_csvs(features_dir: str) -> pd.DataFrame:
+    """Concatenate per-song openSMILE CSVs (``amg_test.py:128-144``):
+    ``{song_id}.csv`` (sep=';'), drop frameTime, tag with s_id."""
+    frames = []
+    for root, _dirs, files in os.walk(features_dir):
+        for f in sorted(files):
+            if not f.lower().endswith(".csv"):
+                continue
+            df = pd.read_csv(os.path.join(root, f), sep=";")
+            sid = f[: -len(".csv")]
+            # numeric ids normalize to int so they join with the .mat song
+            # ids (the reference gets this for free from csv round-tripping)
+            df["s_id"] = int(sid) if sid.isdigit() else sid
+            if "frameTime" in df.columns:
+                del df["frameTime"]
+            frames.append(df)
+    if not frames:
+        raise FileNotFoundError(f"no feature CSVs under {features_dir}")
+    return pd.concat(frames, axis=0, ignore_index=True)
+
+
+def load_feature_pool(dataset_csv: str | None = None,
+                      features_dir: str | None = None,
+                      scale: bool = True) -> FramePool:
+    """The scaled frame-feature pool as a :class:`FramePool`.
+
+    Reads the cached dataset CSV if present, else assembles from per-song
+    CSVs and writes the cache (``amg_test.py:57-60``).  Scaling is a
+    StandardScaler fit over the full pool (``amg_test.py:64``).
+    """
+    if dataset_csv is not None and os.path.exists(dataset_csv):
+        df = pd.read_csv(dataset_csv, sep=";")
+    else:
+        df = _assemble_feature_csvs(features_dir)
+        if dataset_csv is not None:
+            df.to_csv(dataset_csv, sep=";", index=False)
+    X = df.loc[:, FEATURE_SLICE_START:FEATURE_SLICE_STOP].to_numpy(np.float32)
+    if scale:
+        from sklearn.preprocessing import StandardScaler
+
+        X = StandardScaler().fit_transform(X).astype(np.float32)
+    return FramePool(X, df["s_id"].tolist())
+
+
+def user_pool(pool: FramePool, anno: pd.DataFrame, user_id) -> tuple:
+    """Restrict the pool to one user's annotated songs (``amg_test.py:352-
+    356``); returns ``(FramePool, labels dict song→class)``."""
+    mine = anno[anno.user_id == user_id]
+    labels = dict(zip(mine.song_id, mine.quadrant))
+    songs = [s for s in pool.song_ids if s in labels]
+    rows = pool.rows_for_songs(songs)
+    frame_song = np.concatenate(
+        [[s] * pool.counts[pool.song_ids.index(s)] for s in songs])
+    sub = FramePool(pool.X[rows], frame_song)
+    return sub, {s: int(labels[s]) for s in songs}

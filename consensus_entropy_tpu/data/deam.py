@@ -1,0 +1,95 @@
+"""DEAM data layer: frame-feature ↔ dynamic-annotation join for pre-training.
+
+Parity target ``deam_classifier.py:58-104``: per-song openSMILE CSVs
+(frameTime at 500 ms steps, sep=';') joined with the DEAM dynamic
+arousal/valence tables (columns ``sample_15000ms`` …), keeping the common
+timestamps when the two annotation rows disagree in length, labeling each
+frame by the DEAM-variant quadrant geometry, concatenated into one long
+table cached as CSV.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import numpy as np
+import pandas as pd
+
+from consensus_entropy_tpu.labels import quadrant_deam_np
+
+
+def _sample_cols_to_seconds(cols) -> list[float]:
+    """'sample_15000ms' → 15.0  (``deam_classifier.py:72``)."""
+    return [int(re.sub(r"\D", "", c)) / 1000.0 for c in cols]
+
+
+def load_dataset(features_dir: str, arousal_csv: str, valence_csv: str,
+                 cache_csv: str | None = None) -> pd.DataFrame:
+    """Long frame table with columns: openSMILE features…, arousal, valence,
+    quadrants ('Q1'..'Q4'), song_id."""
+    if cache_csv is not None and os.path.exists(cache_csv):
+        return pd.read_csv(cache_csv)
+
+    arousal = pd.read_csv(arousal_csv)
+    valence = pd.read_csv(valence_csv)
+
+    feat_files = []
+    for root, _dirs, files in os.walk(features_dir):
+        feat_files += [os.path.join(root, f) for f in files
+                       if f.lower().endswith(".csv")]
+    feat_files.sort(key=lambda f: int(re.sub(r"\D", "", f)))
+    if not feat_files:
+        raise FileNotFoundError(f"no feature CSVs under {features_dir}")
+
+    rows = []
+    for path in feat_files:
+        s_id = int(os.path.basename(path)[: -len(".csv")])
+        feat = pd.read_csv(path, sep=";")
+        a_row = arousal[arousal.song_id == s_id].dropna(axis=1)
+        v_row = valence[valence.song_id == s_id].dropna(axis=1)
+        if a_row.empty or v_row.empty:
+            continue
+        t_a = _sample_cols_to_seconds(a_row.columns[1:])
+        t_v = _sample_cols_to_seconds(v_row.columns[1:])
+        # keep the shorter annotation when lengths disagree
+        # (deam_classifier.py:75-83)
+        t_common = t_a if len(t_a) <= len(t_v) else t_v
+        sliced = feat[feat.frameTime.isin(t_common)].copy()
+        cols = [f"sample_{int(t * 1000)}ms" for t in sliced.frameTime]
+        sliced["arousal"] = a_row.loc[:, cols].values[0]
+        sliced["valence"] = v_row.loc[:, cols].values[0]
+        q = quadrant_deam_np(sliced.arousal.values, sliced.valence.values)
+        sliced["quadrants"] = [f"Q{c + 1}" for c in q]
+        sliced["song_id"] = s_id
+        rows.append(sliced)
+
+    df = pd.concat(rows, ignore_index=True)
+    if cache_csv is not None:
+        df.to_csv(cache_csv, index=False)
+    return df
+
+
+def training_arrays(df: pd.DataFrame, scale: bool = True):
+    """(X, y, song_ids) for the pre-trainer (``deam_classifier.py:181-197``):
+    feature slice, full-pool StandardScaler, LabelEncoder('Q1'..)→0..3."""
+    from consensus_entropy_tpu.config import (
+        FEATURE_SLICE_START,
+        FEATURE_SLICE_STOP,
+        FEATURE_SLICE_STOP_FFTMAG,
+    )
+
+    if FEATURE_SLICE_STOP_FFTMAG in df.columns:
+        X = df.loc[:, FEATURE_SLICE_START:FEATURE_SLICE_STOP_FFTMAG]
+    elif FEATURE_SLICE_STOP in df.columns:
+        X = df.loc[:, FEATURE_SLICE_START:FEATURE_SLICE_STOP]
+    else:
+        raise ValueError("unrecognized feature columns")
+    X = X.to_numpy(np.float32)
+    if scale:
+        from sklearn.preprocessing import StandardScaler
+
+        X = StandardScaler().fit_transform(X).astype(np.float32)
+    # LabelEncoder on 'Q1'..'Q4' sorts lexicographically → 0..3
+    y = np.array([int(q[1]) - 1 for q in df["quadrants"]], np.int32)
+    return X, y, df["song_id"].to_numpy()

@@ -1,0 +1,1 @@
+"""Pre-training (committee construction) on DEAM."""

@@ -1,0 +1,193 @@
+"""DEAM pre-training: builds the committee the AL loop personalizes.
+
+Reference: ``deam_classifier.py:179-350``.  Classic path = grouped
+cross-validation keeping **every fold estimator** as a committee member
+(5-fold → 5 models per algorithm, paper §3.3); CNN path = per-fold training
+loops.  Reproduced with the same registry surface (including the registry
+entries the paper never used) plus the TPU-native ``cnn_jax`` entry
+(BASELINE.json north star).
+
+Differences by design:
+
+- fold training runs in-process (the reference shells out to a joblib
+  process pool, ``n_jobs=10``, for experiment-level parallelism; our CNN
+  folds are TPU-bound and the sklearn fits are seconds-scale),
+- metrics are returned/printed *and* written as jsonl,
+- no ``pdb.set_trace()`` at the end of a training run
+  (``deam_classifier.py:350``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable
+
+import numpy as np
+
+from consensus_entropy_tpu.config import CNNConfig, TrainConfig
+from consensus_entropy_tpu.models.base import Member
+from consensus_entropy_tpu.models.sklearn_members import (
+    GNBMember,
+    SGDMember,
+    _PickledSklearnMember,
+    make_boosted_member,
+)
+
+
+class GenericSklearnMember(_PickledSklearnMember):
+    """Registry entries beyond the paper's committee (rf/svc/knn/gpc/gbc —
+    ``deam_classifier.py:201-225``).  They pre-train and score; they have no
+    incremental-update path in the reference's AL dispatch either
+    (``amg_test.py:503-509`` only handles xgb/gnb/sgd)."""
+
+    def __init__(self, name: str, kind: str, estimator):
+        super().__init__(name, estimator)
+        self.kind = kind
+
+    def fit(self, X, y):
+        self.estimator.fit(np.asarray(X), np.asarray(y))
+        return self
+
+    def update(self, X, y):
+        raise NotImplementedError(
+            f"{self.kind} has no incremental-update rule (matches the "
+            "reference's AL dispatch, amg_test.py:503-509)")
+
+
+def _registry(seed) -> dict[str, Callable[[str], Member]]:
+    from sklearn.ensemble import GradientBoostingClassifier, RandomForestClassifier
+    from sklearn.gaussian_process import GaussianProcessClassifier
+    from sklearn.gaussian_process.kernels import RBF
+    from sklearn.neighbors import KNeighborsClassifier
+    from sklearn.svm import SVC
+
+    return {
+        "gnb": lambda name: GNBMember(name),
+        "sgd": lambda name: SGDMember(name, seed=seed),
+        "xgb": lambda name: make_boosted_member(name, seed=seed or 0),
+        "rf": lambda name: GenericSklearnMember(
+            name, "rf", RandomForestClassifier(random_state=seed,
+                                               warm_start=True)),
+        "svc": lambda name: GenericSklearnMember(
+            name, "svc", SVC(probability=True, random_state=seed)),
+        "knn": lambda name: GenericSklearnMember(
+            name, "knn", KNeighborsClassifier()),
+        "gpc": lambda name: GenericSklearnMember(
+            name, "gpc", GaussianProcessClassifier(
+                kernel=1.0 * RBF(1.0), random_state=seed, warm_start=True)),
+        "gbc": lambda name: GenericSklearnMember(
+            name, "gbc", GradientBoostingClassifier(
+                max_depth=2, random_state=seed, warm_start=True)),
+    }
+
+
+MODEL_CHOICES = ("gnb", "sgd", "xgb", "rf", "svc", "knn", "gpc", "gbc",
+                 "cnn", "cnn_jax")
+
+
+def grouped_folds(song_ids, n_splits: int, rng: np.random.Generator,
+                  test_size: float = 0.1):
+    """GroupShuffleSplit semantics (``deam_classifier.py:199``): n_splits
+    independent shuffles of the song groups, default 10% test groups."""
+    songs = np.unique(song_ids)
+    for _ in range(n_splits):
+        perm = rng.permutation(len(songs))
+        n_test = max(1, int(round(test_size * len(songs))))
+        test_songs = set(songs[perm[:n_test]])
+        test_mask = np.array([s in test_songs for s in song_ids])
+        yield np.flatnonzero(~test_mask), np.flatnonzero(test_mask)
+
+
+def pretrain_classic(model: str, X, y, song_ids, *, cv: int,
+                     out_dir: str, seed: int = 1987) -> dict:
+    """Train ``cv`` fold estimators of ``model`` and persist each as
+    ``classifier_{model}.it_{i}.pkl`` (``deam_classifier.py:331-333``)."""
+    from sklearn.metrics import f1_score, precision_score, recall_score
+
+    registry = _registry(seed)
+    if model not in registry:
+        raise ValueError(f"unknown classic model {model!r}")
+    os.makedirs(out_dir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    scores = {"precision": [], "recall": [], "f1": []}
+    for i, (tr, te) in enumerate(grouped_folds(song_ids, cv, rng)):
+        member = registry[model](f"it_{i}")
+        member.fit(X[tr], y[tr])
+        y_pred = member.predict(X[te])
+        scores["precision"].append(
+            precision_score(y[te], y_pred, average="weighted",
+                            zero_division=0))
+        scores["recall"].append(
+            recall_score(y[te], y_pred, average="weighted", zero_division=0))
+        scores["f1"].append(f1_score(y[te], y_pred, average="weighted"))
+        member.save(os.path.join(out_dir,
+                                 f"classifier_{model}.{member.name}.pkl"))
+    summary = {k: {"mean": float(np.mean(v)), "std": float(np.std(v))}
+               for k, v in scores.items()}
+    _print_cv(summary)
+    _append_jsonl(out_dir, {"model": model, "cv": cv, **summary})
+    return summary
+
+
+def pretrain_cnn(song_labels: dict, store, *, cv: int, out_dir: str,
+                 config: CNNConfig = CNNConfig(),
+                 train_config: TrainConfig = TrainConfig(),
+                 n_epochs: int | None = None, seed: int = 1987) -> dict:
+    """Per-fold Flax CNN training (``deam_classifier.py:249-316``), saving
+    ``classifier_cnn.it_{i}.msgpack`` per fold.
+
+    ``song_labels``: song id → class; ``store``: a waveform store holding
+    those songs.
+    """
+    import jax
+
+    from consensus_entropy_tpu.labels import one_hot_np
+    from consensus_entropy_tpu.models.cnn_trainer import CNNTrainer
+    from consensus_entropy_tpu.models.short_cnn import init_variables
+    from consensus_entropy_tpu.utils.checkpoint import save_variables
+    from sklearn.metrics import f1_score
+
+    os.makedirs(out_dir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    songs = np.array(list(song_labels.keys()), dtype=object)
+    sids = np.asarray(songs)
+    trainer = CNNTrainer(config, train_config)
+    f1s = []
+    for i, (tr, te) in enumerate(grouped_folds(sids, cv, rng)):
+        key = jax.random.key(seed + i)
+        variables = init_variables(jax.random.fold_in(key, 0), config)
+        train_ids = [songs[j] for j in tr]
+        test_ids = [songs[j] for j in te]
+        y_tr = one_hot_np([song_labels[s] for s in train_ids])
+        y_te = one_hot_np([song_labels[s] for s in test_ids])
+        best, _hist = trainer.fit(
+            variables, store, train_ids, y_tr, test_ids, y_te,
+            jax.random.fold_in(key, 1), n_epochs=n_epochs,
+            adam_patience=40)  # pre-training patience, deam_classifier.py:150
+        save_variables(
+            os.path.join(out_dir, f"classifier_cnn.it_{i}.msgpack"), best,
+            meta={"kind": "cnn_jax", "name": f"it_{i}"})
+        # fold eval: one random crop per test song
+        from consensus_entropy_tpu.models.short_cnn import apply_infer
+
+        crops = store.sample_crops(jax.random.fold_in(key, 2),
+                                   store.row_of(test_ids))
+        preds = np.asarray(apply_infer(best, crops, config)).argmax(axis=1)
+        f1s.append(f1_score(y_te.argmax(axis=1), preds, average="weighted"))
+    summary = {"f1": {"mean": float(np.mean(f1s)), "std": float(np.std(f1s))}}
+    _print_cv(summary)
+    _append_jsonl(out_dir, {"model": "cnn_jax", "cv": cv, **summary})
+    return summary
+
+
+def _print_cv(summary: dict) -> None:
+    print("\n*-*-*-*-*-*-*-\n CV RESULTS\n*-*-*-*-*-*-*-")
+    for metric, s in summary.items():
+        print("{}: {:.3f} ± {:.3f} ({:.3f})".format(
+            metric.upper(), s["mean"], 2 * s["std"], s["std"]))
+
+
+def _append_jsonl(out_dir: str, record: dict) -> None:
+    with open(os.path.join(out_dir, "pretrain_metrics.jsonl"), "a") as f:
+        f.write(json.dumps(record) + "\n")

@@ -1,0 +1,173 @@
+"""Data layer on synthetic fixtures (.mat annotations, openSMILE-style CSVs)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+from scipy.io import savemat
+from scipy.stats import entropy as scipy_entropy
+
+from consensus_entropy_tpu.data import amg, deam
+
+N_SONGS, N_USERS = 12, 9
+
+
+@pytest.fixture
+def amg_fixture(tmp_path, rng):
+    # song_label (songs, users, 2=[valence, arousal]) with NaN holes
+    lab = rng.uniform(-1, 1, size=(N_SONGS, N_USERS, 2))
+    holes = rng.uniform(size=(N_SONGS, N_USERS)) < 0.35
+    lab[holes] = np.nan
+    # every song keeps at least one annotation
+    lab[:, 0, :] = np.where(np.isnan(lab[:, 0, :]), 0.5, lab[:, 0, :])
+    song_ids = np.arange(101, 101 + N_SONGS)
+    mat = str(tmp_path / "AMG1608.mat")
+    mapping = str(tmp_path / "1608_song_id.mat")
+    savemat(mat, {"song_label": lab})
+    savemat(mapping, {"mat_id2song_id": song_ids.reshape(-1, 1)})
+    return mat, mapping, lab, song_ids
+
+
+def test_load_annotations(amg_fixture):
+    mat, mapping, lab, song_ids = amg_fixture
+    df = amg.load_annotations(mat, mapping)
+    n_valid = np.sum(~np.isnan(lab[:, :, 0]))
+    assert len(df) == n_valid
+    assert set(df.song_id.unique()) == set(song_ids)
+    # spot-check one annotation end to end, incl. [valence, arousal] order
+    s, u = song_ids[3], 0
+    row = df[(df.song_id == s) & (df.user_id == u)].iloc[0]
+    np.testing.assert_allclose(row.valence, lab[3, 0, 0])
+    np.testing.assert_allclose(row.arousal, lab[3, 0, 1])
+    a, v = lab[3, 0, 1], lab[3, 0, 0]
+    if a >= 0 and v >= 0:
+        assert row.quadrant == 0
+    assert set(df.quadrant.unique()) <= {0, 1, 2, 3}
+
+
+def test_hc_table_rounded_frequencies(amg_fixture):
+    mat, mapping, lab, song_ids = amg_fixture
+    df = amg.load_annotations(mat, mapping)
+    hc = amg.hc_frequency_table(df)
+    assert list(hc.columns) == ["Q1", "Q2", "Q3", "Q4"]
+    assert len(hc) == N_SONGS
+    # rows are frequencies rounded to 3 decimals (amg_test.py:115)
+    sid = song_ids[0]
+    mine = df[df.song_id == sid]
+    want = np.round(np.bincount(mine.quadrant, minlength=4) / len(mine), 3)
+    np.testing.assert_allclose(hc.loc[sid].values, want)
+    # entropy over rows is finite (consumed by the hc scorer)
+    assert np.isfinite(scipy_entropy(hc.values, axis=1)).all()
+
+
+def test_filter_users(amg_fixture):
+    mat, mapping, lab, _ = amg_fixture
+    df = amg.load_annotations(mat, mapping)
+    counts = df.groupby("user_id").size()
+    thresh = int(counts.median())
+    out, users = amg.filter_users(df, thresh)
+    assert set(users) == set(counts[counts >= thresh].index)
+    assert out.user_id.isin(users).all()
+
+
+@pytest.fixture
+def feats_fixture(tmp_path, rng):
+    cols = (["F0final_sma_stddev"]
+            + [f"feat_{i}" for i in range(3)]
+            + ["mfcc_sma_de[14]_amean"])
+    fdir = tmp_path / "feats"
+    fdir.mkdir()
+    for sid in range(101, 101 + N_SONGS):
+        k = int(rng.integers(3, 7))
+        df = pd.DataFrame(rng.standard_normal((k, len(cols))), columns=cols)
+        df.insert(0, "frameTime", np.arange(k) * 1.0)
+        df.insert(0, "junk_before", 0.0)  # column outside the slice
+        df.to_csv(fdir / f"{sid}.csv", sep=";", index=False)
+    return str(fdir), cols
+
+
+def test_load_feature_pool_assemble_and_cache(feats_fixture, tmp_path):
+    fdir, cols = feats_fixture
+    cache = str(tmp_path / "dataset_feats.csv")
+    pool = amg.load_feature_pool(cache, fdir)
+    assert pool.X.shape[1] == len(cols)  # slice excludes junk + frameTime
+    assert pool.n_songs == N_SONGS
+    assert all(isinstance(s, (int, np.integer)) for s in pool.song_ids)
+    # full-pool scaling (amg_test.py:64)
+    np.testing.assert_allclose(pool.X.mean(axis=0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(pool.X.std(axis=0), 1.0, atol=1e-3)
+    # second load hits the cache and matches
+    pool2 = amg.load_feature_pool(cache, None)
+    np.testing.assert_allclose(pool2.X, pool.X, rtol=1e-5)
+
+
+def test_user_pool(amg_fixture, feats_fixture, tmp_path):
+    mat, mapping, *_ = amg_fixture
+    fdir, _ = feats_fixture
+    df = amg.load_annotations(mat, mapping)
+    pool = amg.load_feature_pool(None, fdir)
+    sub, labels = amg.user_pool(pool, df, 0)
+    my_songs = set(df[df.user_id == 0].song_id)
+    assert set(labels) == my_songs & set(pool.song_ids)
+    assert sub.n_songs == len(labels)
+
+
+# ---------------------------------------------------------------- DEAM ----
+
+
+@pytest.fixture
+def deam_fixture(tmp_path, rng):
+    cols = (["F0final_sma_stddev"] + [f"f{i}" for i in range(2)]
+            + ["mfcc_sma_de[14]_amean"])
+    fdir = tmp_path / "features"
+    fdir.mkdir()
+    times = np.arange(15.0, 20.0, 0.5)  # DEAM: 500 ms steps from 15 s
+    a_rows, v_rows = [], []
+    for sid in (3, 4, 5):
+        df = pd.DataFrame(rng.standard_normal((len(times), len(cols))),
+                          columns=cols)
+        df.insert(0, "frameTime", times)
+        df.to_csv(fdir / f"{sid}.csv", sep=";", index=False)
+        cols_ms = [f"sample_{int(t * 1000)}ms" for t in times]
+        a = {"song_id": sid}
+        v = {"song_id": sid}
+        for c in cols_ms:
+            a[c] = rng.uniform(-1, 1)
+            v[c] = rng.uniform(-1, 1)
+        a_rows.append(a)
+        v_rows.append(v)
+    # song 5: arousal annotations one step shorter → join keeps the shorter
+    del a_rows[2][f"sample_{int(times[-1] * 1000)}ms"]
+    a_csv, v_csv = str(tmp_path / "arousal.csv"), str(tmp_path / "valence.csv")
+    pd.DataFrame(a_rows).to_csv(a_csv, index=False)
+    pd.DataFrame(v_rows).to_csv(v_csv, index=False)
+    return str(fdir), a_csv, v_csv
+
+
+def test_deam_join(deam_fixture, tmp_path):
+    fdir, a_csv, v_csv = deam_fixture
+    df = deam.load_dataset(fdir, a_csv, v_csv,
+                           cache_csv=str(tmp_path / "cache.csv"))
+    assert set(df.song_id.unique()) == {3, 4, 5}
+    # song 5 dropped its last frame (shorter arousal row wins)
+    assert (df[df.song_id == 5].shape[0]
+            == df[df.song_id == 3].shape[0] - 1)
+    assert set(df.quadrants.unique()) <= {"Q1", "Q2", "Q3", "Q4"}
+    # quadrant matches the DEAM-variant geometry row-wise
+    from consensus_entropy_tpu.labels import quadrant_deam_np
+
+    want = quadrant_deam_np(df.arousal.values, df.valence.values)
+    got = np.array([int(q[1]) - 1 for q in df.quadrants])
+    np.testing.assert_array_equal(got, want)
+    # cache round-trip
+    df2 = deam.load_dataset(fdir, a_csv, v_csv,
+                            cache_csv=str(tmp_path / "cache.csv"))
+    assert len(df2) == len(df)
+
+
+def test_deam_training_arrays(deam_fixture):
+    fdir, a_csv, v_csv = deam_fixture
+    df = deam.load_dataset(fdir, a_csv, v_csv)
+    X, y, sids = deam.training_arrays(df)
+    assert X.shape[0] == len(df) == len(y) == len(sids)
+    assert X.shape[1] == 4  # the feature slice
+    np.testing.assert_allclose(X.mean(axis=0), 0.0, atol=1e-4)
