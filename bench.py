@@ -1,0 +1,197 @@
+"""North-star benchmark: AL pool-scoring wall-clock per iteration.
+
+Measures the fused TPU scoring graph at BASELINE.json configs[4] scale —
+16-member committee over a 100k-excerpt synthetic pool — against a CPU
+baseline with the reference's structure (``amg_test.py:428-447``): a Python
+loop over members, per-frame ``predict_proba``, per-song groupby-mean, then
+``np.mean`` → ``scipy.stats.entropy`` → ``argsort`` top-q on host.
+
+The device path runs the identical math as ONE jit'd XLA graph: batched
+member probabilities (a single MXU matmul for all members), frame→song mean,
+consensus mean, entropy, and top-k fused, pool axis sharded across all
+available chips.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
+``vs_baseline`` is the CPU-over-device speedup (higher is better; the
+BASELINE.json north star is >= 50x).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_inputs(n_members: int, n_pool: int, n_frames: int, n_features: int,
+                n_class: int, seed: int = 1987):
+    """Synthetic pool features + linear committee members.
+
+    Frame features mirror the AMG openSMILE layout (260-d per-second frames,
+    several frames per song — ``amg_test.py:64,435-437``); members are
+    softmax-linear probabilistic classifiers (the SGD-logistic committee
+    member's functional form).
+    """
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n_pool, n_frames, n_features), np.float32)
+    w = (rng.standard_normal((n_members, n_features, n_class), np.float32)
+         / np.sqrt(n_features))
+    b = rng.standard_normal((n_members, n_class), np.float32) * 0.1
+    return x, w, b
+
+
+def cpu_reference_iteration(x, w, b, k: int):
+    """Reference-structure scoring on host: per-member Python loop
+    (``amg_test.py:428-438``), then consensus mean → scipy entropy → argsort
+    top-q (``amg_test.py:441-447``)."""
+    from scipy.stats import entropy as scipy_entropy
+
+    n_pool, n_frames, n_features = x.shape
+    frames = x.reshape(n_pool * n_frames, n_features)
+    pred_prob = []
+    for m in range(w.shape[0]):  # sequential member loop, as the reference
+        logits = frames @ w[m] + b[m]
+        logits -= logits.max(axis=1, keepdims=True)
+        p = np.exp(logits)
+        p /= p.sum(axis=1, keepdims=True)
+        # groupby('s_id').mean() — frames are contiguous per song here.
+        pred_prob.append(p.reshape(n_pool, n_frames, -1).mean(axis=1))
+    consensus = np.mean(np.asarray(pred_prob), axis=0)
+    ent = scipy_entropy(consensus, axis=1)
+    q_idx = np.argsort(ent)[::-1][:k]
+    return ent, q_idx
+
+
+def build_device_iteration(k: int):
+    """The fused graph: members' probs → song mean → consensus → entropy →
+    top-k, one XLA program, pool axis sharded across all devices.
+
+    The extra ``eps`` argument (folded in as ``+ eps * 0.0``, a no-op) lets
+    the timing loop chain iterations through a device-side data dependency,
+    so steady-state per-iteration latency is measured without a host sync
+    per call (on this environment's tunneled TPU, ``block_until_ready`` does
+    not block and a host readback costs ~90 ms of tunnel overhead that a real
+    AL loop consuming device-resident results never pays).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from consensus_entropy_tpu.ops.scoring import score_mc
+    from consensus_entropy_tpu.parallel.mesh import POOL_AXIS, make_pool_mesh
+
+    mesh = make_pool_mesh()
+
+    def iteration(x, w, b, mask, eps):
+        logits = jnp.einsum("nkf,mfc->mnkc", x, w + eps * 0.0)
+        logits = logits + b[:, None, None, :]
+        probs = jax.nn.softmax(logits, axis=-1)
+        song_probs = jnp.mean(probs, axis=2)  # groupby(s_id).mean() parity
+        return score_mc(song_probs, mask, k=k)
+
+    x_sh = NamedSharding(mesh, P(POOL_AXIS))
+    repl = NamedSharding(mesh, P())
+    fn = jax.jit(iteration,
+                 in_shardings=(x_sh, repl, repl, x_sh, repl),
+                 out_shardings=repl)
+    return mesh, x_sh, fn
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--members", type=int, default=16)
+    ap.add_argument("--pool", type=int, default=100_000)
+    ap.add_argument("--frames", type=int, default=4)
+    ap.add_argument("--features", type=int, default=260)
+    ap.add_argument("--classes", type=int, default=4)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--chain", type=int, default=50,
+                    help="iterations per dependent-chain timing window")
+    ap.add_argument("--trials", type=int, default=5)
+    ap.add_argument("--cpu-reps", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    x, w, b = make_inputs(args.members, args.pool, args.frames,
+                          args.features, args.classes)
+    _log(f"devices: {jax.devices()}")
+    _log(f"pool {args.pool} x {args.frames} frames x {args.features} feats, "
+         f"{args.members} members, k={args.k}")
+
+    # -- device path ------------------------------------------------------
+    mesh, x_sh, fn = build_device_iteration(args.k)
+    # Pad the pool axis to a multiple of the mesh (fixed-shape contract).
+    n_dev = mesh.devices.size
+    n_pad = -(-args.pool // n_dev) * n_dev
+    x_pad = np.zeros((n_pad,) + x.shape[1:], np.float32)
+    x_pad[: args.pool] = x
+    mask = np.zeros(n_pad, bool)
+    mask[: args.pool] = True
+
+    xd = jax.device_put(x_pad, x_sh)
+    wd, bd = jnp.asarray(w), jnp.asarray(b)
+    md = jax.device_put(mask, x_sh)
+
+    t0 = time.perf_counter()
+    eps = jnp.float32(0.0)
+    for _ in range(3):  # compile + fully execute before timing
+        result = fn(xd, wd, bd, md, eps)
+        eps = result.values[0]
+    np.asarray(result.values)
+    _log(f"compile + warmup: {time.perf_counter() - t0:.2f}s")
+
+    times = []
+    for _ in range(args.trials):
+        t0 = time.perf_counter()
+        eps = jnp.float32(0.0)
+        for _ in range(args.chain):
+            result = fn(xd, wd, bd, md, eps)
+            eps = result.values[0]  # device-side dependency between iters
+        np.asarray(result.values)  # one sync per chain
+        times.append((time.perf_counter() - t0) / args.chain)
+    dev_ms = float(np.median(times) * 1e3)
+    _log(f"device median over {args.trials} x {args.chain}-iter chains: "
+         f"{dev_ms:.3f} ms/iter (min {min(times)*1e3:.3f})")
+
+    # -- CPU reference-structure baseline ---------------------------------
+    cpu_times = []
+    for _ in range(args.cpu_reps):
+        t0 = time.perf_counter()
+        ent_cpu, idx_cpu = cpu_reference_iteration(x, w, b, args.k)
+        cpu_times.append(time.perf_counter() - t0)
+    cpu_ms = float(np.median(cpu_times) * 1e3)
+    _log(f"cpu median over {args.cpu_reps} reps: {cpu_ms:.1f} ms")
+
+    # -- parity check -----------------------------------------------------
+    ent_dev = np.asarray(result.entropy)[: args.pool]
+    max_err = float(np.max(np.abs(ent_dev - ent_cpu)))
+    same_queries = set(np.asarray(result.indices).tolist()) == set(
+        idx_cpu.tolist())
+    _log(f"entropy max |err| vs scipy: {max_err:.2e}; "
+         f"top-{args.k} sets match: {same_queries}")
+    if max_err > 1e-3 or not same_queries:
+        _log("PARITY FAILURE — benchmark numbers not comparable")
+        return 1
+
+    print(json.dumps({
+        "metric": f"al_pool_scoring_latency_{args.members}m_{args.pool}",
+        "value": round(dev_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(cpu_ms / dev_ms, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
