@@ -21,13 +21,14 @@ from consensus_entropy_tpu.models.base import Member
 from consensus_entropy_tpu.models.committee import CNNMember, Committee
 from consensus_entropy_tpu.models.sklearn_members import (
     BoostedTreesMember,
+    GenericSklearnMember,
     GNBMember,
     SGDMember,
 )
 
 _DONE = "DONE"
 
-_HOST_LOADERS = {"gnb": GNBMember, "sgd": SGDMember, "xgb": None}
+_HOST_LOADERS = {"gnb": GNBMember, "sgd": SGDMember}
 
 
 def user_dir(users_root: str, user, mode: str) -> str:
@@ -71,11 +72,12 @@ def load_committee(path: str, config: CNNConfig = CNNConfig(),
             cnns.append(CNNMember.load(full, config, train_config))
         elif fname.endswith(".pkl"):
             kind = fname.split(".")[0].replace("classifier_", "")
-            loader = _HOST_LOADERS.get(kind)
-            if loader is None:  # boosted slot: dispatch on pickle content
+            if kind == "xgb":  # boosted slot: dispatch on pickle content
                 host.append(_load_boosted(full))
-            else:
-                host.append(loader.load(full))
+            elif kind in _HOST_LOADERS:
+                host.append(_HOST_LOADERS[kind].load(full))
+            else:  # rf/svc/knn/gpc/gbc: frozen-during-AL generic members
+                host.append(GenericSklearnMember.load(full))
     if not host and not cnns:
         raise FileNotFoundError(f"no committee members in {path}")
     return Committee(host, cnns, config, train_config)

@@ -68,7 +68,13 @@ def main(argv=None) -> int:
 
     cnn_cfg = CNNConfig()
     store = None
-    if any(f.endswith(".msgpack") for f in os.listdir(paths.pretrained_dir)):
+    try:
+        pretrained_files = os.listdir(paths.pretrained_dir)
+    except FileNotFoundError:
+        print("No pre-trained models of this type!  Run deam-classifier "
+              f"first (looked in {paths.pretrained_dir}).")
+        return 1
+    if any(f.endswith(".msgpack") for f in pretrained_files):
         from consensus_entropy_tpu.data.audio import HostWaveformStore
 
         store = HostWaveformStore(paths.amg_npy_dir, pool.song_ids,

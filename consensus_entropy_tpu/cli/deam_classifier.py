@@ -53,18 +53,17 @@ def main(argv=None) -> int:
                         deam_root=args.deam_root, amg_root=args.amg_root)
     out_dir = paths.pretrained_dir
 
-    if args.model in ("cnn", "cnn_jax"):
-        import numpy as np
+    df = deam.load_dataset(paths.deam_features_dir,
+                           os.path.join(args.deam_root, "annotations",
+                                        "arousal.csv"),
+                           os.path.join(args.deam_root, "annotations",
+                                        "valence.csv"),
+                           cache_csv=paths.deam_dataset_csv)
 
+    if args.model in ("cnn", "cnn_jax"):
         from consensus_entropy_tpu.config import CNNConfig, TrainConfig
         from consensus_entropy_tpu.data.audio import HostWaveformStore
 
-        df = deam.load_dataset(paths.deam_features_dir,
-                               os.path.join(args.deam_root, "annotations",
-                                            "arousal.csv"),
-                               os.path.join(args.deam_root, "annotations",
-                                            "valence.csv"),
-                               cache_csv=paths.deam_dataset_csv)
         # song-level label = majority frame quadrant (the reference's
         # groupby('song_id').max() picks the lexicographic max quadrant,
         # deam_classifier.py:253; we keep that exact rule)
@@ -77,12 +76,6 @@ def main(argv=None) -> int:
                               config=cfg, train_config=TrainConfig(),
                               n_epochs=args.epochs, seed=args.seed)
     else:
-        df = deam.load_dataset(paths.deam_features_dir,
-                               os.path.join(args.deam_root, "annotations",
-                                            "arousal.csv"),
-                               os.path.join(args.deam_root, "annotations",
-                                            "valence.csv"),
-                               cache_csv=paths.deam_dataset_csv)
         X, y, song_ids = deam.training_arrays(df)
         pretrain.pretrain_classic(args.model, X, y, song_ids, cv=cv,
                                   out_dir=out_dir, seed=args.seed)

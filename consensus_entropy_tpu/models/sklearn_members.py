@@ -90,6 +90,35 @@ class _PickledSklearnMember(Member):
         return obj
 
 
+class GenericSklearnMember(_PickledSklearnMember):
+    """Registry entries beyond the paper's committee (rf/svc/knn/gpc/gbc —
+    ``deam_classifier.py:201-225``).  They pre-train and score; ``update`` is
+    a no-op because the reference's AL dispatch (``amg_test.py:503-509``)
+    only retrains xgb/gnb/sgd/cnn and silently leaves other members frozen.
+    """
+
+    def __init__(self, name: str, kind: str, estimator):
+        super().__init__(name, estimator)
+        self.kind = kind
+
+    def fit(self, X, y):
+        self.estimator.fit(np.asarray(X), np.asarray(y))
+        return self
+
+    def update(self, X, y):
+        pass  # frozen during AL, matching the reference dispatch
+
+    @classmethod
+    def load(cls, path):
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        obj = cls.__new__(cls)
+        Member.__init__(obj, state["name"])
+        obj.estimator = state["estimator"]
+        obj.kind = state["kind"]
+        return obj
+
+
 class GNBMember(_PickledSklearnMember):
     """GaussianNB (``deam_classifier.py:210-212``)."""
 

@@ -28,31 +28,11 @@ import numpy as np
 from consensus_entropy_tpu.config import CNNConfig, TrainConfig
 from consensus_entropy_tpu.models.base import Member
 from consensus_entropy_tpu.models.sklearn_members import (
+    GenericSklearnMember,
     GNBMember,
     SGDMember,
-    _PickledSklearnMember,
     make_boosted_member,
 )
-
-
-class GenericSklearnMember(_PickledSklearnMember):
-    """Registry entries beyond the paper's committee (rf/svc/knn/gpc/gbc —
-    ``deam_classifier.py:201-225``).  They pre-train and score; they have no
-    incremental-update path in the reference's AL dispatch either
-    (``amg_test.py:503-509`` only handles xgb/gnb/sgd)."""
-
-    def __init__(self, name: str, kind: str, estimator):
-        super().__init__(name, estimator)
-        self.kind = kind
-
-    def fit(self, X, y):
-        self.estimator.fit(np.asarray(X), np.asarray(y))
-        return self
-
-    def update(self, X, y):
-        raise NotImplementedError(
-            f"{self.kind} has no incremental-update rule (matches the "
-            "reference's AL dispatch, amg_test.py:503-509)")
 
 
 def _registry(seed) -> dict[str, Callable[[str], Member]]:
@@ -87,9 +67,11 @@ MODEL_CHOICES = ("gnb", "sgd", "xgb", "rf", "svc", "knn", "gpc", "gbc",
 
 
 def grouped_folds(song_ids, n_splits: int, rng: np.random.Generator,
-                  test_size: float = 0.1):
+                  test_size: float = 0.2):
     """GroupShuffleSplit semantics (``deam_classifier.py:199``): n_splits
-    independent shuffles of the song groups, default 10% test groups."""
+    independent shuffles of the song groups; default 20% test groups
+    (sklearn's GroupShuffleSplit default when ``test_size`` is unset, as the
+    reference leaves it)."""
     songs = np.unique(song_ids)
     for _ in range(n_splits):
         perm = rng.permutation(len(songs))
@@ -151,10 +133,9 @@ def pretrain_cnn(song_labels: dict, store, *, cv: int, out_dir: str,
     os.makedirs(out_dir, exist_ok=True)
     rng = np.random.default_rng(seed)
     songs = np.array(list(song_labels.keys()), dtype=object)
-    sids = np.asarray(songs)
     trainer = CNNTrainer(config, train_config)
     f1s = []
-    for i, (tr, te) in enumerate(grouped_folds(sids, cv, rng)):
+    for i, (tr, te) in enumerate(grouped_folds(songs, cv, rng)):
         key = jax.random.key(seed + i)
         variables = init_variables(jax.random.fold_in(key, 0), config)
         train_ids = [songs[j] for j in tr]

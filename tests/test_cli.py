@@ -121,3 +121,27 @@ def test_bad_cv_arg(synth_roots):
                                "--deam-root", synth_roots["deam"],
                                "--amg-root", synth_roots["amg"]])
     assert rc == 2
+
+
+def test_generic_model_workflow(synth_roots):
+    """Pre-train a non-committee registry model (rf) and run AL with it —
+    its pickles must load and stay frozen through AL iterations."""
+    flags = ["--models-root", synth_roots["models"],
+             "--deam-root", synth_roots["deam"],
+             "--amg-root", synth_roots["amg"], "--device", "cpu"]
+    assert deam_classifier.main(["-cv", "2", "-m", "rf"] + flags) == 0
+    assert deam_classifier.main(["-cv", "2", "-m", "gnb"] + flags) == 0
+    rc = amg_test.main(["-q", "4", "-e", "2", "-m", "mc", "-n", "10",
+                        "--max-users", "1"] + flags)
+    assert rc == 0
+
+
+def test_missing_pretrained_dir_is_clean_error(synth_roots, capsys):
+    """AL before pre-training exits with a message, not a traceback
+    (reference parity: amg_test.py:81-84)."""
+    rc = amg_test.main(["-q", "4", "-e", "2", "-m", "mc", "-n", "10",
+                        "--models-root", synth_roots["models"],
+                        "--deam-root", synth_roots["deam"],
+                        "--amg-root", synth_roots["amg"], "--device", "cpu"])
+    assert rc == 1
+    assert "No pre-trained models" in capsys.readouterr().out

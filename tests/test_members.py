@@ -85,3 +85,62 @@ def test_save_load_roundtrip(factory, rng, tmp_path):
     np.testing.assert_allclose(m2.predict_proba(X[:9]),
                                m.predict_proba(X[:9]), rtol=1e-6)
     m2.update(X[:10], y[:10])  # loaded member must still be updatable
+
+
+def test_generic_member_roundtrip_and_frozen_update(rng, tmp_path):
+    """rf/svc/... registry members: pickle round-trip preserves `kind`, and
+    `update` is a no-op (the reference's AL dispatch, amg_test.py:503-509,
+    leaves non-xgb/gnb/sgd members frozen rather than crashing)."""
+    from sklearn.ensemble import RandomForestClassifier
+
+    from consensus_entropy_tpu.models.sklearn_members import (
+        GenericSklearnMember,
+    )
+
+    X, y = _data(rng)
+    m = GenericSklearnMember("it_0", "rf",
+                             RandomForestClassifier(n_estimators=5,
+                                                    random_state=0))
+    m.fit(X, y)
+    before = m.predict_proba(X[:8])
+    m.update(X[:4], y[:4])  # must not raise, must not change the model
+    np.testing.assert_array_equal(before, m.predict_proba(X[:8]))
+
+    path = str(tmp_path / "classifier_rf.it_0.pkl")
+    m.save(path)
+    m2 = GenericSklearnMember.load(path)
+    assert m2.kind == "rf" and m2.name == "it_0"
+    np.testing.assert_array_equal(before, m2.predict_proba(X[:8]))
+
+
+def test_workspace_loads_generic_members(rng, tmp_path):
+    """load_committee dispatches unknown kinds to GenericSklearnMember
+    instead of the boosted-trees loader (which KeyErrors on their pickles)."""
+    from sklearn.neighbors import KNeighborsClassifier
+
+    from consensus_entropy_tpu.al.workspace import load_committee
+    from consensus_entropy_tpu.models.sklearn_members import (
+        GenericSklearnMember,
+    )
+
+    X, y = _data(rng)
+    GNBMember("it_0").fit(X, y).save(str(tmp_path / "classifier_gnb.it_0.pkl"))
+    GenericSklearnMember("it_0", "knn", KNeighborsClassifier(3)).fit(
+        X, y).save(str(tmp_path / "classifier_knn.it_0.pkl"))
+    committee = load_committee(str(tmp_path))
+    kinds = sorted(m.kind for m in committee.host_members)
+    assert kinds == ["gnb", "knn"]
+    committee.update_host(X[:4], y[:4])  # knn stays frozen, gnb partial_fits
+
+
+def test_grouped_folds_default_test_size():
+    """Reference parity: GroupShuffleSplit with test_size unset holds out 20%
+    of the groups (deam_classifier.py:199)."""
+    from consensus_entropy_tpu.train.pretrain import grouped_folds
+
+    song_ids = np.repeat(np.arange(50), 3)
+    rng_ = np.random.default_rng(0)
+    for tr, te in grouped_folds(song_ids, 3, rng_):
+        test_songs = np.unique(song_ids[te])
+        assert len(test_songs) == 10  # 20% of 50 groups
+        assert not set(test_songs) & set(np.unique(song_ids[tr]))
