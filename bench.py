@@ -6,10 +6,23 @@ baseline with the reference's structure (``amg_test.py:428-447``): a Python
 loop over members, per-frame ``predict_proba``, per-song groupby-mean, then
 ``np.mean`` → ``scipy.stats.entropy`` → ``argsort`` top-q on host.
 
-The device path runs the identical math as ONE jit'd XLA graph: batched
-member probabilities (a single MXU matmul for all members), frame→song mean,
-consensus mean, entropy, and top-k fused, pool axis sharded across all
-available chips.
+Two device implementations of the identical math, both one compiled program:
+
+- **xla**:    batched member logits (one MXU matmul for all members), frame→
+  song mean, consensus, entropy, top-k — jit'd, pool axis sharded across all
+  available chips (``ops.scoring`` + einsum).
+- **pallas**: the same chain as ONE hand-fused Pallas kernel
+  (``ops.pallas_scoring``) — no intermediate probability tensor in HBM.
+
+``--impl auto`` (the default) times both and reports the faster, so the
+recorded number tracks the best available path as kernels improve.
+
+Timing methodology: the per-iteration body is chained *inside the compiled
+program* (``lax.fori_loop``, iterations linked through a scalar data
+dependency) and one host sync closes each window.  On this environment's
+tunneled TPU a single dispatch costs ~2 ms and a host readback ~90 ms —
+per-call timing would measure the tunnel, not the device; a real AL loop
+consuming device-resident results pays neither.
 
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
@@ -20,7 +33,6 @@ BASELINE.json north star is >= 50x).
 from __future__ import annotations
 
 import argparse
-import functools
 import json
 import sys
 import time
@@ -71,16 +83,12 @@ def cpu_reference_iteration(x, w, b, k: int):
     return ent, q_idx
 
 
-def build_device_iteration(k: int):
-    """The fused graph: members' probs → song mean → consensus → entropy →
-    top-k, one XLA program, pool axis sharded across all devices.
+def build_xla_impl(x, w, b, k: int):
+    """jit'd einsum → score_mc, pool axis sharded across all devices.
 
-    The extra ``eps`` argument (folded in as ``+ eps * 0.0``, a no-op) lets
-    the timing loop chain iterations through a device-side data dependency,
-    so steady-state per-iteration latency is measured without a host sync
-    per call (on this environment's tunneled TPU, ``block_until_ready`` does
-    not block and a host readback costs ~90 ms of tunnel overhead that a real
-    AL loop consuming device-resident results never pays).
+    Returns ``(iteration_args, iteration_fn)`` where ``iteration_fn(args,
+    eps)`` -> ScoreResult; ``eps`` is a scalar folded in as a no-op so timing
+    windows can chain iterations through a device-side data dependency.
     """
     import jax
     import jax.numpy as jnp
@@ -90,20 +98,129 @@ def build_device_iteration(k: int):
     from consensus_entropy_tpu.parallel.mesh import POOL_AXIS, make_pool_mesh
 
     mesh = make_pool_mesh()
+    n_pool = x.shape[0]
+    n_dev = mesh.devices.size
+    n_pad = -(-n_pool // n_dev) * n_dev
+    x_pad = np.zeros((n_pad,) + x.shape[1:], np.float32)
+    x_pad[:n_pool] = x
+    mask = np.zeros(n_pad, bool)
+    mask[:n_pool] = True
 
-    def iteration(x, w, b, mask, eps):
+    x_sh = NamedSharding(mesh, P(POOL_AXIS))
+    args = (jax.device_put(x_pad, x_sh), jnp.asarray(w), jnp.asarray(b),
+            jax.device_put(mask, x_sh))
+
+    def iteration(args, eps):
+        x, w, b, mask = args
         logits = jnp.einsum("nkf,mfc->mnkc", x, w + eps * 0.0)
         logits = logits + b[:, None, None, :]
         probs = jax.nn.softmax(logits, axis=-1)
         song_probs = jnp.mean(probs, axis=2)  # groupby(s_id).mean() parity
         return score_mc(song_probs, mask, k=k)
 
-    x_sh = NamedSharding(mesh, P(POOL_AXIS))
-    repl = NamedSharding(mesh, P())
-    fn = jax.jit(iteration,
-                 in_shardings=(x_sh, repl, repl, x_sh, repl),
-                 out_shardings=repl)
-    return mesh, x_sh, fn
+    return args, iteration
+
+
+def build_pallas_impl(x, w, b, k: int, tile_n: int, fuse_topk: bool = False):
+    """Pre-packed pool + the hand-fused Pallas kernel (single chip; the
+    pool-sharded multi-chip variant goes through ``shard_map`` and is
+    exercised by the test suite).  Frames are lane-packed (``auto_pack``) so
+    every matmul/VPU op fills the full 128-lane vreg."""
+    import jax
+    import jax.numpy as jnp
+
+    from consensus_entropy_tpu.ops.pallas_scoring import (
+        auto_pack,
+        pack_pool,
+        pack_weights,
+        score_mc_linear_fused,
+    )
+    from consensus_entropy_tpu.ops.scoring import ScoreResult
+
+    n_members, n_pool = w.shape[0], x.shape[0]
+    n_frames, n_class = x.shape[1], w.shape[2]
+    pack = auto_pack(n_frames, n_members, n_class)
+    x_tiles, _ = pack_pool(x, tile_n, pack)
+    w_p, b_p = pack_weights(w, b, pack)
+    n_eff = n_members * pack
+    _log(f"[pallas] frame packing x{pack}: {n_eff * n_class} lanes, "
+         f"{n_frames // pack} matmuls/tile, tile_n={tile_n}")
+    n_rows = x_tiles.shape[0] * x_tiles.shape[2]
+    mask = np.zeros(n_rows, bool)
+    mask[:n_pool] = True
+    args = (jax.device_put(jnp.asarray(x_tiles)), jnp.asarray(w_p),
+            jnp.asarray(b_p), jnp.asarray(mask))
+
+    def iteration(args, eps):
+        x_tiles, w_packed, b_packed, mask = args
+        ent, values, indices = score_mc_linear_fused(
+            x_tiles, w_packed + eps * 0.0, b_packed, mask,
+            n_members=n_eff, k=k, fuse_topk=fuse_topk)
+        return ScoreResult(ent, values, indices)
+
+    return args, iteration
+
+
+def time_device_impl(name: str, args, iteration, *, chain: int, trials: int):
+    """Median per-iteration latency of ``iteration`` chained ``chain`` times
+    inside one compiled program (one dispatch + one sync per window)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def window(args, eps):
+        return lax.fori_loop(
+            0, chain, lambda i, e: iteration(args, e).values[0] * 1e-12, eps)
+
+    t0 = time.perf_counter()
+    out = window(args, jnp.float32(0.0))
+    np.asarray(out)
+    _log(f"[{name}] compile + first window: {time.perf_counter() - t0:.2f}s")
+
+    times = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        out = window(args, jnp.float32(0.0))
+        np.asarray(out)  # one sync per chain
+        times.append((time.perf_counter() - t0) / chain)
+    ms = float(np.median(times) * 1e3)
+    _log(f"[{name}] median over {trials} x {chain}-iter windows: "
+         f"{ms:.3f} ms/iter (min {min(times) * 1e3:.3f})")
+    return ms
+
+
+def check_parity(name: str, args, iteration, ent_cpu, idx_cpu, k: int,
+                 tol: float = 1e-3) -> bool:
+    """One un-chained evaluation vs the float64 CPU oracle.
+
+    The query-set check is boundary-tolerant: when the oracle's rank-k gap
+    is below float32 resolution (on this synthetic pool the top ranks sit
+    ~1e-6 apart at entropy ≈ ln 4), no f32 implementation can reproduce the
+    float64 set exactly and the order of two near-ties is rounding luck.
+    The principled contract is: every selected song scores within ``tol`` of
+    the oracle's k-th-best, and every song clearly above the boundary
+    (> kth + tol) is selected.
+    """
+    import jax.numpy as jnp
+
+    result = iteration(args, jnp.float32(0.0))
+    n_pool = ent_cpu.shape[0]
+    ent_dev = np.asarray(result.entropy)[:n_pool]
+    max_err = float(np.max(np.abs(ent_dev - ent_cpu)))
+
+    idx_dev = np.asarray(result.indices)
+    kth = np.sort(ent_cpu)[-k]
+    distinct = len(set(idx_dev.tolist())) == k
+    all_near_top = bool(np.all(ent_cpu[idx_dev] >= kth - tol))
+    must_have = np.flatnonzero(ent_cpu > kth + tol)
+    clear_winners_in = set(must_have.tolist()) <= set(idx_dev.tolist())
+    ok = (max_err <= tol and distinct and all_near_top and clear_winners_in)
+    _log(f"[{name}] entropy max |err| vs scipy: {max_err:.2e}; top-{k} "
+         f"boundary-consistent: {all_near_top and clear_winners_in} "
+         f"(exact-set match: "
+         f"{set(idx_dev.tolist()) == set(idx_cpu.tolist())})")
+    return ok
 
 
 def main(argv=None) -> int:
@@ -114,78 +231,72 @@ def main(argv=None) -> int:
     ap.add_argument("--features", type=int, default=260)
     ap.add_argument("--classes", type=int, default=4)
     ap.add_argument("--k", type=int, default=10)
-    ap.add_argument("--chain", type=int, default=50,
-                    help="iterations per dependent-chain timing window")
+    ap.add_argument("--impl", choices=("auto", "xla", "pallas"),
+                    default="auto")
+    ap.add_argument("--tile-n", type=int, default=512,
+                    help="pallas pool tile (pool rows per grid step)")
+    ap.add_argument("--fuse-topk", action="store_true",
+                    help="rank queries inside the pallas kernel")
+    ap.add_argument("--chain", type=int, default=150,
+                    help="iterations per in-program timing window")
     ap.add_argument("--trials", type=int, default=5)
     ap.add_argument("--cpu-reps", type=int, default=3)
-    args = ap.parse_args(argv)
+    args_ns = ap.parse_args(argv)
 
     import jax
-    import jax.numpy as jnp
 
-    x, w, b = make_inputs(args.members, args.pool, args.frames,
-                          args.features, args.classes)
+    x, w, b = make_inputs(args_ns.members, args_ns.pool, args_ns.frames,
+                          args_ns.features, args_ns.classes)
     _log(f"devices: {jax.devices()}")
-    _log(f"pool {args.pool} x {args.frames} frames x {args.features} feats, "
-         f"{args.members} members, k={args.k}")
+    _log(f"pool {args_ns.pool} x {args_ns.frames} frames x "
+         f"{args_ns.features} feats, {args_ns.members} members, k={args_ns.k}")
 
-    # -- device path ------------------------------------------------------
-    mesh, x_sh, fn = build_device_iteration(args.k)
-    # Pad the pool axis to a multiple of the mesh (fixed-shape contract).
-    n_dev = mesh.devices.size
-    n_pad = -(-args.pool // n_dev) * n_dev
-    x_pad = np.zeros((n_pad,) + x.shape[1:], np.float32)
-    x_pad[: args.pool] = x
-    mask = np.zeros(n_pad, bool)
-    mask[: args.pool] = True
-
-    xd = jax.device_put(x_pad, x_sh)
-    wd, bd = jnp.asarray(w), jnp.asarray(b)
-    md = jax.device_put(mask, x_sh)
-
-    t0 = time.perf_counter()
-    eps = jnp.float32(0.0)
-    for _ in range(3):  # compile + fully execute before timing
-        result = fn(xd, wd, bd, md, eps)
-        eps = result.values[0]
-    np.asarray(result.values)
-    _log(f"compile + warmup: {time.perf_counter() - t0:.2f}s")
-
-    times = []
-    for _ in range(args.trials):
-        t0 = time.perf_counter()
-        eps = jnp.float32(0.0)
-        for _ in range(args.chain):
-            result = fn(xd, wd, bd, md, eps)
-            eps = result.values[0]  # device-side dependency between iters
-        np.asarray(result.values)  # one sync per chain
-        times.append((time.perf_counter() - t0) / args.chain)
-    dev_ms = float(np.median(times) * 1e3)
-    _log(f"device median over {args.trials} x {args.chain}-iter chains: "
-         f"{dev_ms:.3f} ms/iter (min {min(times)*1e3:.3f})")
-
-    # -- CPU reference-structure baseline ---------------------------------
+    # -- CPU reference-structure baseline + oracle ------------------------
     cpu_times = []
-    for _ in range(args.cpu_reps):
+    for _ in range(args_ns.cpu_reps):
         t0 = time.perf_counter()
-        ent_cpu, idx_cpu = cpu_reference_iteration(x, w, b, args.k)
+        ent_cpu, idx_cpu = cpu_reference_iteration(x, w, b, args_ns.k)
         cpu_times.append(time.perf_counter() - t0)
     cpu_ms = float(np.median(cpu_times) * 1e3)
-    _log(f"cpu median over {args.cpu_reps} reps: {cpu_ms:.1f} ms")
+    _log(f"cpu median over {args_ns.cpu_reps} reps: {cpu_ms:.1f} ms")
 
-    # -- parity check -----------------------------------------------------
-    ent_dev = np.asarray(result.entropy)[: args.pool]
-    max_err = float(np.max(np.abs(ent_dev - ent_cpu)))
-    same_queries = set(np.asarray(result.indices).tolist()) == set(
-        idx_cpu.tolist())
-    _log(f"entropy max |err| vs scipy: {max_err:.2e}; "
-         f"top-{args.k} sets match: {same_queries}")
-    if max_err > 1e-3 or not same_queries:
-        _log("PARITY FAILURE — benchmark numbers not comparable")
+    # -- device implementations -------------------------------------------
+    impls = {}
+    if args_ns.impl in ("auto", "xla"):
+        impls["xla"] = build_xla_impl(x, w, b, args_ns.k)
+    if args_ns.impl in ("auto", "pallas"):
+        devices = jax.devices()
+        if len(devices) == 1 and devices[0].platform == "tpu":
+            impls["pallas"] = build_pallas_impl(x, w, b, args_ns.k,
+                                                args_ns.tile_n,
+                                                args_ns.fuse_topk)
+        else:
+            _log("[pallas] skipped: needs a single TPU device (found "
+                 f"{len(devices)} x {devices[0].platform}; the kernel is "
+                 "Mosaic-only and the sharded variant is covered by tests)")
+            if args_ns.impl == "pallas":
+                _log("nothing to run for --impl pallas on this host")
+                return 1
+
+    results = {}
+    for name, (iargs, ifn) in impls.items():
+        if not check_parity(name, iargs, ifn, ent_cpu, idx_cpu, args_ns.k):
+            _log(f"[{name}] PARITY FAILURE — implementation excluded")
+            continue
+        results[name] = time_device_impl(name, iargs, ifn,
+                                         chain=args_ns.chain,
+                                         trials=args_ns.trials)
+
+    if not results:
+        _log("every candidate implementation failed the parity gate")
         return 1
 
+    best = min(results, key=results.get)
+    dev_ms = results[best]
+    _log(f"best impl: {best} ({dev_ms:.3f} ms/iter)")
+
     print(json.dumps({
-        "metric": f"al_pool_scoring_latency_{args.members}m_{args.pool}",
+        "metric": f"al_pool_scoring_latency_{args_ns.members}m_{args_ns.pool}",
         "value": round(dev_ms, 3),
         "unit": "ms",
         "vs_baseline": round(cpu_ms / dev_ms, 1),

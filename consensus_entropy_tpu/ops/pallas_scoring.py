@@ -1,0 +1,353 @@
+"""Pallas TPU kernel: fully-fused linear-member pool scoring.
+
+The XLA path (``ops.scoring`` + an einsum for member logits) materializes the
+per-member probability tensor ``(M, N, K, C)`` in HBM between the member
+forward and the consensus reduction, and finishes with a sort-based
+``lax.top_k`` over the full pool — at benchmark scale (16 members x 100k
+songs x 4 frames) those intermediates plus the top-k cost as much device
+time as the matmuls.  This kernel keeps the whole chain
+
+    member logits -> softmax -> frame mean -> consensus mean -> entropy
+    -> per-tile top-k candidates
+
+inside VMEM per pool tile, so HBM traffic collapses to ONE pass over the
+pool features plus an ``(N,)`` entropy write and a tiny candidate list
+(``n_tiles x k``) that a final ``lax.top_k`` merges.  Semantics match the
+reference chain ``predict_proba`` -> ``groupby('s_id').mean()`` ->
+``np.mean(members)`` -> ``scipy.stats.entropy`` -> ``argsort[::-1][:q]``
+(``amg_test.py:428-447``) for softmax-linear members (the SGD-logistic
+committee member's functional form, ``deam_classifier.py:216-222``).
+
+MXU-shaped design decisions (measured on v5e; a naive per-member variant ran
+2.6x SLOWER than XLA because a ``(TILE_N,F)@(F,4)`` matmul pads its 4 output
+lanes to 128, wasting 32x MXU work per member):
+
+1. **All members in one matmul.**  The committee's weight matrices are packed
+   column-wise into ``(F, M*C)`` so each frame needs ONE matmul.  Per-member
+   softmax over the packed lane axis cannot reshape ``(TILE_N, M*C) ->
+   (TILE_N, M, C)`` (Mosaic: "unsupported shape cast" on lane splits), so the
+   grouped reductions are expressed as matmuls: group sums via a block-
+   diagonal ones matrix, the member sum via a ``(M*C, C)`` selector applied
+   once per tile.  The stability shift is the per-member MEAN logit (also a
+   block-diagonal matmul; constant within every group, hence softmax-exact,
+   and independent across members).  Shifted logits are clamped at +85
+   before ``exp`` so f32 cannot overflow; at least one lane per group sits
+   at or above its mean, so every group sum is >= 1 and 0/0 is impossible.
+   The only approximation regime is a within-member logit spread > 85 nats
+   from its mean — a probability ratio above e^170, unrepresentable in the
+   reference's f64 pipeline too.
+2. **Contiguous tile DMA.**  The pool is pre-packed once per AL run into
+   ``(n_tiles, K, TILE_N, F)`` so every grid step streams one contiguous
+   block from HBM instead of TILE_N*K strided 1 KB rows.
+3. **Top-k fused.**  Each tile runs k passes of masked max/argmax on its own
+   entropy vector (VPU, zero extra HBM) and emits k candidates; the global
+   merge is a ``lax.top_k`` over ``n_tiles*k`` elements instead of N.
+
+The kernel is shard-agnostic: under ``shard_map`` over the ``pool`` mesh axis
+each chip runs it on its own ``N / D`` shard and the candidate merge rides
+the existing local-topk -> all_gather pattern
+(``parallel.sharding.make_shardmap_mc_scorer``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from consensus_entropy_tpu.ops.topk import masked_top_k
+
+#: Pool rows per grid step.  (K, TILE_N, F) fp32 at the AMG geometry
+#: (K=4, F=260) is ~3 MB of VMEM after lane padding — small enough to
+#: double-buffer, large enough to amortize control overhead.
+DEFAULT_TILE_N = 512
+
+#: Candidate slots per tile (lane-aligned); fused top-k requires k <= this.
+_CAND_LANES = 128
+
+
+def auto_pack(n_frames: int, n_members: int, n_class: int) -> int:
+    """Largest frame-packing factor P with P | K and P*M*C <= 128.
+
+    Consensus = mean over all (member, frame) softmaxes, so P frames can be
+    treated as P extra member copies: lanes fill up to the full 128-lane
+    vreg (M*C = 64 at the reference geometry wastes half of every VPU op
+    and matmul) and the frame loop shortens to K/P.
+    """
+    best = 1
+    for p in range(2, n_frames + 1):
+        if n_frames % p == 0 and p * n_members * n_class <= _CAND_LANES:
+            best = p
+    return best
+
+
+def pack_weights(w, b, pack: int = 1):
+    """Pack per-member weights ``(M, F, C)`` / biases ``(M, C)`` into the
+    kernel's column-concatenated layout ``(F', M'*C)`` / ``(M'*C,)``.
+
+    With ``pack=P`` the member set is replicated into a block-diagonal
+    ``(P*F, P*M*C)`` matrix so one matmul evaluates P frames at once (see
+    :func:`auto_pack`); pass the matching ``pack`` to :func:`pack_pool` and
+    ``n_members = P*M`` to the scoring calls.
+    """
+    w = jnp.asarray(w)
+    m, f, c = w.shape
+    w2 = jnp.transpose(w, (1, 0, 2)).reshape(f, m * c)
+    b2 = jnp.asarray(b).reshape(m * c)
+    if pack == 1:
+        return w2, b2
+    blocks = [jnp.pad(w2, ((0, 0), (p * m * c, (pack - 1 - p) * m * c)))
+              for p in range(pack)]
+    return jnp.concatenate(blocks, axis=0), jnp.tile(b2, pack)
+
+
+def pack_pool(x_songs, tile_n: int = DEFAULT_TILE_N, pack: int = 1):
+    """Tile the pool features for contiguous per-step DMA.
+
+    ``x_songs``: ``(N, K, F)`` song-major features (K frames per song).
+    Returns ``(x_tiles, n_valid)`` where ``x_tiles`` is
+    ``(n_tiles, K/pack, tile_n, pack*F)`` with the pool axis zero-padded to
+    a multiple of ``tile_n`` (``pack`` groups of adjacent frames share a row
+    — see :func:`auto_pack`).  Done ONCE per AL run (the pool shrinks only
+    via the mask), so its cost is off the per-iteration path.
+    """
+    x_songs = jnp.asarray(x_songs)
+    n, k, f = x_songs.shape
+    if k % pack:
+        raise ValueError(f"pack {pack} does not divide n_frames {k}")
+    n_padded = pl.cdiv(n, tile_n) * tile_n
+    if n_padded != n:
+        x_songs = jnp.pad(x_songs, ((0, n_padded - n), (0, 0), (0, 0)))
+    x_tiles = jnp.transpose(
+        x_songs.reshape(n_padded // tile_n, tile_n, k // pack, pack * f),
+        (0, 2, 1, 3))
+    return x_tiles, n
+
+
+def _kernel(n_members: int, n_cand: int, x_ref, w_ref, b_ref, mask_ref,
+            ent_ref, cval_ref, cidx_ref, acc_ref):
+    """One pool tile: fused member softmaxes -> consensus entropy -> top-k.
+
+    x_ref:    (1, K, TILE_N, F) packed pool-feature tile.
+    w_ref:    (F, M*C) column-packed member weights.
+    b_ref:    (1, M*C) packed member biases.
+    mask_ref: (8, TILE_N) pool-validity mask as float32 0/1 (row 0 is real;
+              the 8-sublane broadcast satisfies Mosaic block alignment).
+    ent_ref:  (8, TILE_N) masked entropy out (-inf on invalid rows),
+              broadcast across sublanes; the wrapper reads row 0.
+    cval_ref: (1, 8, _CAND_LANES) top-``n_cand`` entropy values of this tile.
+    cidx_ref: (1, 8, _CAND_LANES) matching GLOBAL pool-row indices.
+    acc_ref:  (TILE_N, M*C) VMEM scratch — running sum of probabilities.
+    """
+    n_frames = x_ref.shape[1]
+    tile_n = x_ref.shape[2]
+    mc = w_ref.shape[1]
+    n_class = mc // n_members
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Grouped-softmax helper matrices (lane-axis group ops as matmuls).
+    row_g = lax.broadcasted_iota(jnp.int32, (mc, mc), 0) // n_class
+    col_g = lax.broadcasted_iota(jnp.int32, (mc, mc), 1) // n_class
+    sum_mat = (row_g == col_g).astype(jnp.float32)        # block-diag ones
+    sel_rows = lax.broadcasted_iota(jnp.int32, (mc, n_class), 0)
+    sel_cols = lax.broadcasted_iota(jnp.int32, (mc, n_class), 1)
+    sel_mat = (sel_rows % n_class == sel_cols).astype(jnp.float32)
+
+    for k in range(n_frames):           # static unroll: frame mean
+        logits = jnp.dot(x_ref[0, k], w_ref[:],
+                         preferred_element_type=jnp.float32)  # (TILE_N, M*C)
+        logits = logits + b_ref[0, :]
+        # Per-member mean shift: softmax-exact (constant within each group)
+        # and member-independent, unlike a global row max which couples
+        # members and distorts any member far below the committee's max.
+        gmean = jnp.dot(logits, sum_mat,
+                        preferred_element_type=jnp.float32) / n_class
+        e = jnp.exp(jnp.minimum(logits - gmean, 85.0))
+        gsum = jnp.dot(e, sum_mat, preferred_element_type=jnp.float32)
+        acc_ref[:] += e / gsum                        # per-member softmax
+
+    # Member sum once per tile; consensus = acc / (M*K) is already
+    # normalized — normalize anyway for scipy.stats.entropy parity
+    # (ops.entropy.shannon_entropy semantics).
+    consensus = jnp.dot(acc_ref[:], sel_mat,
+                        preferred_element_type=jnp.float32)   # (TILE_N, C)
+    p = consensus / jnp.sum(consensus, axis=-1, keepdims=True)
+    plogp = jnp.where(p > 0, p * jnp.log(jnp.where(p > 0, p, 1.0)), 0.0)
+    ent = -jnp.sum(plogp, axis=-1)                            # (TILE_N,)
+
+    masked = jnp.where(mask_ref[0, :] > 0, ent, -jnp.inf)
+    ent_ref[:] = jnp.broadcast_to(masked[None, :], ent_ref.shape)
+
+    # Per-tile top-k: k passes of max + lowest-index-among-ties argmax.
+    # Matches lax.top_k tie semantics after the global merge (tiles are
+    # visited in index order).
+    # Per-tile top-k candidates (n_cand=0 -> fused top-k disabled; the k
+    # cross-lane max/argmax reductions cost ~1 ms over a 100k pool on v5e,
+    # so the default path leaves ranking to one XLA lax.top_k instead).
+    offset = pl.program_id(0) * tile_n
+    remaining = masked[None, :]                               # (1, TILE_N)
+    ids = lax.broadcasted_iota(jnp.int32, (1, tile_n), 1)
+    lane = lax.broadcasted_iota(jnp.int32, (1, cval_ref.shape[2]), 1)
+    cand_v = jnp.full(lane.shape, -jnp.inf, jnp.float32)
+    cand_i = jnp.zeros(lane.shape, jnp.int32)
+    for j in range(n_cand):
+        best = jnp.max(remaining)
+        best_id = jnp.min(jnp.where(remaining == best, ids,
+                                    jnp.int32(2**31 - 1)))
+        # Vector selects, not scalar stores (Mosaic cannot store scalars).
+        cand_v = jnp.where(lane == j, best, cand_v)
+        cand_i = jnp.where(lane == j, best_id + offset, cand_i)
+        remaining = jnp.where(ids == best_id, -jnp.inf, remaining)
+    cval_ref[0] = jnp.broadcast_to(cand_v, cval_ref.shape[1:])
+    cidx_ref[0] = jnp.broadcast_to(cand_i, cidx_ref.shape[1:])
+
+
+@functools.partial(jax.jit, static_argnames=("n_members", "n_cand",
+                                             "interpret"))
+def _call_kernel(x_tiles, w_packed, b_packed, mask8, *, n_members: int,
+                 n_cand: int, interpret: bool):
+    n_tiles, n_frames, tile_n, n_feat = x_tiles.shape
+    mc = w_packed.shape[1]
+    n_class = mc // n_members
+
+    kernel = functools.partial(_kernel, n_members, n_cand)
+    ent8, cval, cidx = pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, n_frames, tile_n, n_feat),
+                         lambda i: (i, 0, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((n_feat, mc), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, mc), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, tile_n), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((8, tile_n), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, _CAND_LANES), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, _CAND_LANES), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((8, n_tiles * tile_n), jnp.float32),
+            jax.ShapeDtypeStruct((n_tiles, 8, _CAND_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((n_tiles, 8, _CAND_LANES), jnp.int32),
+        ),
+        scratch_shapes=[pltpu.VMEM((tile_n, mc), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n_tiles * tile_n * mc * (n_frames * (n_feat + mc)
+                                               + n_class),
+            bytes_accessed=4 * (x_tiles.size + n_feat * mc
+                                + 16 * n_tiles * tile_n),
+            transcendentals=n_tiles * n_frames * tile_n * mc,
+        ),
+        interpret=interpret,
+    )(x_tiles.astype(jnp.float32), w_packed.astype(jnp.float32),
+      b_packed.astype(jnp.float32).reshape(1, mc), mask8)
+    return ent8[0], cval, cidx
+
+
+def _validate_packed(x_tiles, w_packed, b_packed, n_members: int) -> int:
+    n_tiles, _, tile_n, n_feat = x_tiles.shape
+    mc = w_packed.shape[1]
+    if (w_packed.shape[0] != n_feat or mc % n_members
+            or b_packed.shape != (mc,)):
+        raise ValueError(f"shape mismatch: x {x_tiles.shape}, "
+                         f"w {w_packed.shape}, b {b_packed.shape}, "
+                         f"M={n_members}")
+    return n_tiles * tile_n
+
+
+def packed_score_mc(x_tiles, w_packed, b_packed, pool_mask, *,
+                    n_members: int, k: int, tie_break: str = "fast",
+                    fuse_topk: bool = False, interpret: bool = False):
+    """Fused machine-consensus acquisition over a pre-packed pool.
+
+    Args:
+      x_tiles:   ``(n_tiles, K, tile_n, F)`` from :func:`pack_pool`.
+      w_packed:  ``(F, M*C)`` from :func:`pack_weights`.
+      b_packed:  ``(M*C,)`` from :func:`pack_weights`.
+      pool_mask: ``(n_tiles * tile_n,)`` bool — False on padding and on
+                 already-queried songs (the fixed-shape AL contract).
+      n_members: M (static — defines the softmax grouping of the lane axis).
+      k:         queries per iteration (static).
+
+    Returns ``(entropy, values, indices)`` with the same semantics as
+    ``ops.scoring.score_mc``: entropy is -inf on invalid rows; for
+    ``tie_break='fast'`` ties go to the lowest pool index.  When fewer than
+    ``k`` rows are valid, trailing values are -inf and (with ``fuse_topk``)
+    their indices are unspecified (callers use ``ops.topk.valid_count``).
+    ``fuse_topk=True`` ranks inside the kernel (per-tile candidates merged
+    by a tiny top-k) — measured slower than one XLA ``lax.top_k`` on v5e,
+    kept for mesh shapes where the full-pool gather is the bottleneck.
+    ``tie_break='numpy'`` always uses the XLA fallback (the fused candidate
+    pass is lowest-index-wins by construction).
+    """
+    n_rows = _validate_packed(x_tiles, w_packed, b_packed, n_members)
+    if pool_mask.shape != (n_rows,):
+        raise ValueError(f"pool_mask {pool_mask.shape} != ({n_rows},)")
+
+    fused = fuse_topk and tie_break == "fast" and k <= _CAND_LANES
+    n_cand = min(k, _CAND_LANES) if fused else 0
+    mask8 = jnp.broadcast_to(
+        jnp.asarray(pool_mask, jnp.float32)[None, :], (8, n_rows))
+    ent, cval, cidx = _call_kernel(x_tiles, w_packed, b_packed, mask8,
+                                   n_members=n_members, n_cand=n_cand,
+                                   interpret=interpret)
+    if not fused:
+        values, indices = masked_top_k(ent, pool_mask, k, tie_break)
+        return ent, values, indices
+
+    flat_v = cval[:, 0, :n_cand].reshape(-1)
+    flat_i = cidx[:, 0, :n_cand].reshape(-1)
+    values, j = lax.top_k(flat_v, k)
+    return ent, values, jnp.take(flat_i, j)
+
+
+def packed_consensus_entropy(x_tiles, w_packed, b_packed, *, n_members: int,
+                             interpret: bool = False):
+    """Fused consensus entropy only (no masking/top-k) over a packed pool.
+
+    Returns ``(n_tiles * tile_n,)`` float32 Shannon entropy (nats) of the
+    committee-consensus class distribution per (padded) pool row.
+    """
+    n_rows = _validate_packed(x_tiles, w_packed, b_packed, n_members)
+    mask8 = jnp.ones((8, n_rows), jnp.float32)
+    ent, _, _ = _call_kernel(x_tiles, w_packed, b_packed, mask8,
+                             n_members=n_members, n_cand=0,
+                             interpret=interpret)
+    return ent
+
+
+def linear_consensus_entropy(x_songs, w, b, *, tile_n: int = DEFAULT_TILE_N,
+                             interpret: bool = False):
+    """Convenience wrapper: song-major ``(N, K, F)`` features, per-member
+    ``(M, F, C)`` weights / ``(M, C)`` biases -> ``(N,)`` entropy.
+
+    Packs on every call — use :func:`pack_pool` + :func:`pack_weights` +
+    :func:`packed_score_mc` in iteration loops so packing cost is paid once.
+    """
+    m = jnp.asarray(w).shape[0]
+    x_tiles, n_valid = pack_pool(x_songs, tile_n)
+    w_packed, b_packed = pack_weights(w, b)
+    ent = packed_consensus_entropy(x_tiles, w_packed, b_packed,
+                                   n_members=m, interpret=interpret)
+    return ent[:n_valid]
+
+
+def score_mc_linear_fused(x_tiles, w_packed, b_packed, pool_mask, *,
+                          n_members: int, k: int, tie_break: str = "fast",
+                          fuse_topk: bool = False, interpret: bool = False):
+    """Alias kept for the benchmark/driver surface: fused mc scoring on a
+    pre-packed pool (see :func:`packed_score_mc`)."""
+    return packed_score_mc(x_tiles, w_packed, b_packed, pool_mask,
+                           n_members=n_members, k=k, tie_break=tie_break,
+                           fuse_topk=fuse_topk, interpret=interpret)

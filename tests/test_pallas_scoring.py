@@ -1,0 +1,169 @@
+"""Pallas fused linear-member scoring vs a numpy/scipy oracle of the
+reference chain predict_proba -> groupby.mean -> consensus -> entropy
+(amg_test.py:428-447), run through the Pallas interpreter on CPU."""
+
+import numpy as np
+import pytest
+from scipy.stats import entropy as scipy_entropy
+
+from consensus_entropy_tpu.ops import pallas_scoring
+
+
+def _make_problem(rng, m=3, n=50, k_frames=2, f=12, c=4):
+    x = rng.standard_normal((n, k_frames, f)).astype(np.float32)
+    w = (rng.standard_normal((m, f, c)) / np.sqrt(f)).astype(np.float32)
+    b = (rng.standard_normal((m, c)) * 0.1).astype(np.float32)
+    return x, w, b
+
+
+def _oracle_entropy(x, w, b):
+    """Straight-line float64 oracle: per-frame softmax, frame mean, member
+    mean, scipy entropy — the reference's mc chain for linear members."""
+    n, k_frames, f = x.shape
+    frames = x.reshape(n * k_frames, f).astype(np.float64)
+    per_member = []
+    for m in range(w.shape[0]):
+        logits = frames @ w[m] + b[m]
+        logits -= logits.max(axis=1, keepdims=True)
+        p = np.exp(logits)
+        p /= p.sum(axis=1, keepdims=True)
+        per_member.append(p.reshape(n, k_frames, -1).mean(axis=1))
+    consensus = np.mean(per_member, axis=0)
+    return scipy_entropy(consensus, axis=1)
+
+
+def test_entropy_parity(rng):
+    x, w, b = _make_problem(rng, n=48)
+    ent = pallas_scoring.linear_consensus_entropy(
+        x, w, b, tile_n=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(ent), _oracle_entropy(x, w, b),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_entropy_parity_uneven_tiles(rng):
+    # N=50 with tile_n=32 exercises the internal zero-pad + trim.
+    x, w, b = _make_problem(rng, n=50)
+    ent = pallas_scoring.linear_consensus_entropy(
+        x, w, b, tile_n=32, interpret=True)
+    assert ent.shape == (50,)
+    np.testing.assert_allclose(np.asarray(ent), _oracle_entropy(x, w, b),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pack_roundtrip(rng):
+    x, w, b = _make_problem(rng, m=2, n=8, k_frames=3, f=5)
+    x_tiles, n_valid = pallas_scoring.pack_pool(x, tile_n=8)
+    assert n_valid == 8 and x_tiles.shape == (1, 3, 8, 5)
+    np.testing.assert_array_equal(
+        np.asarray(x_tiles)[0, 1], x[:, 1, :])
+    w_p, b_p = pallas_scoring.pack_weights(w, b)
+    # Column block m of the packed matrix is member m's weight matrix.
+    np.testing.assert_array_equal(np.asarray(w_p)[:, 4:8], w[1])
+    np.testing.assert_array_equal(np.asarray(b_p)[4:8], b[1])
+
+
+def test_fused_score_matches_unfused(rng):
+    # The fused kernel and the XLA scoring graph must pick identical queries.
+    from consensus_entropy_tpu.ops import scoring
+
+    x, w, b = _make_problem(rng, m=4, n=64, k_frames=3)
+    mask = np.ones(64, dtype=bool)
+    mask[60:] = False
+
+    x_tiles, _ = pallas_scoring.pack_pool(x, tile_n=16)
+    w_p, b_p = pallas_scoring.pack_weights(w, b)
+    ent, values, idx = pallas_scoring.score_mc_linear_fused(
+        x_tiles, w_p, b_p, mask, n_members=4, k=8, interpret=True)
+
+    frames = x.reshape(-1, x.shape[-1])
+    probs = []
+    for m in range(w.shape[0]):
+        logits = frames @ w[m] + b[m]
+        logits -= logits.max(axis=1, keepdims=True)
+        p = np.exp(logits)
+        p /= p.sum(axis=1, keepdims=True)
+        probs.append(p.reshape(64, 3, -1).mean(axis=1))
+    res = scoring.score_mc(np.asarray(probs, np.float32), mask, k=8)
+
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(res.indices))
+    ent_np = np.asarray(ent)
+    assert np.all(np.isneginf(ent_np[~mask]))
+    np.testing.assert_allclose(ent_np[mask], np.asarray(res.entropy)[mask],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_shape_validation(rng):
+    x, w, b = _make_problem(rng)
+    x_tiles, _ = pallas_scoring.pack_pool(x, tile_n=16)
+    w_p, b_p = pallas_scoring.pack_weights(w, b)
+    with pytest.raises(ValueError):
+        pallas_scoring.packed_consensus_entropy(
+            x_tiles[..., :-1], w_p, b_p, n_members=3, interpret=True)
+
+
+def test_fused_topk_ties_and_masked_tile(rng):
+    # Duplicate rows create exact entropy ties; reference semantics ('fast')
+    # = lax.top_k on the masked entropy vector: lowest index wins.
+    x, w, b = _make_problem(rng, m=3, n=40, k_frames=2)
+    x[7] = x[3]          # tie pair across tiles
+    x[25] = x[3]
+    x_tiles, _ = pallas_scoring.pack_pool(x, tile_n=8)
+    w_p, b_p = pallas_scoring.pack_weights(w, b)
+    mask = np.ones(40, bool)
+    mask[8:16] = False   # a fully-masked tile
+    ent, values, idx = pallas_scoring.packed_score_mc(
+        x_tiles, w_p, b_p, mask, n_members=3, k=6, interpret=True)
+    from consensus_entropy_tpu.ops.topk import masked_top_k
+    v_ref, i_ref = masked_top_k(np.asarray(ent), mask, 6, "fast")
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(i_ref))
+    np.testing.assert_allclose(np.asarray(values), np.asarray(v_ref))
+
+
+def test_fused_topk_fewer_valid_than_k(rng):
+    x, w, b = _make_problem(rng, m=2, n=16, k_frames=1)
+    x_tiles, _ = pallas_scoring.pack_pool(x, tile_n=8)
+    w_p, b_p = pallas_scoring.pack_weights(w, b)
+    mask = np.zeros(16, bool)
+    mask[[2, 5, 9]] = True
+    ent, values, idx = pallas_scoring.packed_score_mc(
+        x_tiles, w_p, b_p, mask, n_members=2, k=5, interpret=True)
+    v = np.asarray(values)
+    assert np.sum(v > -np.inf) == 3
+    assert set(np.asarray(idx)[:3].tolist()) == {2, 5, 9}
+
+
+def test_frame_packing_parity(rng):
+    # pack=2: frames become extra member copies; entropy must be identical.
+    x, w, b = _make_problem(rng, m=3, n=32, k_frames=4, f=10)
+    assert pallas_scoring.auto_pack(4, 3, 4) == 4  # 4*3*4=48 <= 128
+    for pack in (1, 2, 4):
+        x_tiles, _ = pallas_scoring.pack_pool(x, tile_n=16, pack=pack)
+        w_p, b_p = pallas_scoring.pack_weights(w, b, pack=pack)
+        ent = pallas_scoring.packed_consensus_entropy(
+            x_tiles, w_p, b_p, n_members=3 * pack, interpret=True)
+        np.testing.assert_allclose(np.asarray(ent), _oracle_entropy(x, w, b),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=f"pack={pack}")
+
+
+def test_pack_pool_rejects_non_divisor(rng):
+    x, _, _ = _make_problem(rng, n=8, k_frames=3)
+    with pytest.raises(ValueError):
+        pallas_scoring.pack_pool(x, tile_n=8, pack=2)
+
+
+def test_member_far_below_committee_max(rng):
+    # A member whose logits sit far below another member's max must still
+    # contribute its own (sharp) softmax to the consensus — a global-row-max
+    # stability shift would flatten it to a uniform vote.
+    f = 8
+    x = np.zeros((16, 1, f), np.float32)
+    x[:, 0, 0] = 1.0
+    w = np.zeros((2, f, 4), np.float32)
+    w[0, 0] = [0.0, 0.0, 0.0, 80.0]    # member A: sharp, huge logits
+    w[1, 0] = [0.0, 0.0, 0.0, 5.0]     # member B: sharp, tiny logits
+    b = np.zeros((2, 4), np.float32)
+    ent = pallas_scoring.linear_consensus_entropy(x, w, b, tile_n=16,
+                                                  interpret=True)
+    np.testing.assert_allclose(np.asarray(ent), _oracle_entropy(x, w, b),
+                               rtol=1e-5, atol=1e-6)
