@@ -122,10 +122,11 @@ def build_xla_impl(x, w, b, k: int):
 
 
 def build_pallas_impl(x, w, b, k: int, tile_n: int, fuse_topk: bool = False):
-    """Pre-packed pool + the hand-fused Pallas kernel (single chip; the
-    pool-sharded multi-chip variant goes through ``shard_map`` and is
-    exercised by the test suite).  Frames are lane-packed (``auto_pack``) so
-    every matmul/VPU op fills the full 128-lane vreg."""
+    """Pre-packed pool + the hand-fused Pallas kernel.  Single-chip only:
+    a multi-chip (shard_map-wrapped) variant of the kernel is not
+    implemented — on multi-device hosts ``--impl auto`` uses the sharded
+    XLA path.  Frames are lane-packed (``auto_pack``) so every matmul/VPU
+    op fills the full 128-lane vreg."""
     import jax
     import jax.numpy as jnp
 
@@ -273,7 +274,8 @@ def main(argv=None) -> int:
         else:
             _log("[pallas] skipped: needs a single TPU device (found "
                  f"{len(devices)} x {devices[0].platform}; the kernel is "
-                 "Mosaic-only and the sharded variant is covered by tests)")
+                 "Mosaic-only and has no multi-chip variant — the sharded "
+                 "XLA path covers multi-device runs)")
             if args_ns.impl == "pallas":
                 _log("nothing to run for --impl pallas on this host")
                 return 1

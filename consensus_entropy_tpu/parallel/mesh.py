@@ -25,6 +25,7 @@ from jax.sharding import Mesh
 POOL_AXIS = "pool"
 MEMBER_AXIS = "member"
 DP_AXIS = "dp"
+SEQ_AXIS = "seq"
 
 
 def make_pool_mesh(devices=None) -> Mesh:
@@ -37,6 +38,18 @@ def make_pool_mesh(devices=None) -> Mesh:
     if devices is None:
         devices = jax.devices()
     return Mesh(np.asarray(devices), (POOL_AXIS,))
+
+
+def make_seq_mesh(devices=None) -> Mesh:
+    """1-D mesh over all (or the given) devices, sequence axis only.
+
+    Used by the long-audio path (``parallel.sequence``): a full song's
+    analysis windows are distributed contiguously across chips, with the
+    window-overlap halo exchanged between ring neighbors over ICI.
+    """
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (SEQ_AXIS,))
 
 
 def make_training_mesh(dp: int | None = None, member: int | None = None,

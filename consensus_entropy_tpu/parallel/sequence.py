@@ -1,0 +1,204 @@
+"""Sequence (context) parallelism for long-audio committee scoring.
+
+The reference scores each song from ONE uniform-random 59049-sample crop per
+pass (``short_cnn.py:376-377``, ``amg_test.py:173-201``), so committee CNN
+probabilities are stochastic and a full song (minutes of audio, millions of
+samples) is never actually heard.  The TPU-native long-audio path replaces
+that with deterministic full-coverage inference:
+
+    song waveform -> sliding analysis windows (length = the reference crop,
+    stride = ``hop``) -> committee CNN on every window -> per-member mean of
+    the sigmoid outputs over all windows.
+
+Scale lives on the window/time axis, so that is what gets sharded: a
+``shard_map`` over the ``seq`` mesh axis gives each chip a contiguous block
+of windows.  When windows overlap (``hop < window``) the first
+``window - hop`` samples of each chip's chunk are also the tail of its left
+neighbor's last window — that halo is exchanged over ICI with ONE
+``lax.ppermute`` per pass (ring shift, the canonical halo pattern) instead
+of replicating the waveform.  The final per-member reduction is a masked
+``psum`` pair, so every collective rides ICI and the result replicates.
+
+This is the framework's context-parallel story (SURVEY.md §5: the reference
+has no sequence dimension at all — attention-style ring/Ulysses CP is N/A,
+but long audio is real): a 10-minute 16 kHz song is ~9.6 M samples = 163
+windows, which an 8-chip slice scores 8 windows-per-chip deep.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from consensus_entropy_tpu.config import CNNConfig
+from consensus_entropy_tpu.models.short_cnn import ShortChunkCNN
+from consensus_entropy_tpu.parallel.mesh import SEQ_AXIS
+
+
+class WindowPlan(NamedTuple):
+    """Static geometry of a sharded full-song pass.
+
+    n_windows:       valid analysis windows (>= 1; zero-pad-tail windows
+                     beyond this count are masked out of the mean).
+    windows_per_shard: windows each chip evaluates (includes masked pad).
+    chunk_len:       samples per chip in the base (non-halo) layout.
+    halo:            samples each chip needs from its right neighbor.
+    padded_len:      total padded waveform length = n_shards*chunk_len + halo.
+    """
+
+    n_windows: int
+    windows_per_shard: int
+    chunk_len: int
+    halo: int
+    padded_len: int
+    window: int
+    hop: int
+
+    @property
+    def n_shards(self) -> int:
+        return (self.padded_len - self.halo) // self.chunk_len
+
+
+def plan_windows(n_samples: int, n_shards: int, *, window: int,
+                 hop: int | None = None) -> WindowPlan:
+    """Window/shard geometry for a song of ``n_samples``.
+
+    Windows start at ``0, hop, 2*hop, ...``; a window is *valid* if it fits
+    entirely inside the (unpadded) song — matching the reference's crop
+    domain ``start <= T - window`` (``short_cnn.py:376``).  Songs shorter
+    than one window get a single zero-padded window (the audio layer pads
+    short excerpts the same way).
+    """
+    if hop is None:
+        hop = window
+    if not 1 <= hop <= window:
+        raise ValueError(f"need 1 <= hop ({hop}) <= window ({window})")
+    if n_samples >= window:
+        n_valid = (n_samples - window) // hop + 1
+    else:
+        n_valid = 1
+    wps = math.ceil(n_valid / n_shards)
+    halo = window - hop
+    chunk_len = wps * hop
+    if halo > chunk_len:
+        # The ring exchange fetches the halo from ONE right neighbor
+        # (single ppermute hop); a deeper overlap than one chunk would need
+        # multi-hop gathers.  Only reachable when a short song meets a wide
+        # mesh at >50% overlap — fewer shards (or a coarser hop) fixes it.
+        raise ValueError(
+            f"window overlap ({halo} samples) exceeds the per-shard chunk "
+            f"({chunk_len} = {wps} windows x hop {hop}); use fewer shards "
+            f"for this song length or hop >= window - windows_per_shard*hop")
+    return WindowPlan(n_valid, wps, chunk_len, halo,
+                      n_shards * chunk_len + halo, window, hop)
+
+
+def pad_song(wave, plan: WindowPlan):
+    """Fit a ``(T,)`` waveform to the plan's padded length (host-side, once
+    per song): zero-pad the tail, or truncate it when the plan's window grid
+    ends before ``T`` (at most ``hop - 1`` trailing samples fall outside the
+    last full window; they are covered by no valid window either way —
+    stride-grid semantics, vs the reference's uniformly-random crop starts,
+    ``short_cnn.py:376``)."""
+    wave = np.asarray(wave, np.float32)
+    if wave.ndim != 1:
+        raise ValueError(f"expected (T,) waveform, got {wave.shape}")
+    wave = wave[:plan.padded_len]
+    return np.pad(wave, (0, plan.padded_len - wave.shape[0]))
+
+
+def _local_windows(chunk_ext, plan: WindowPlan):
+    """Slice a chip's extended chunk into its ``windows_per_shard`` windows
+    (static offsets — wps and hop are compile-time constants)."""
+    return jnp.stack([
+        lax.dynamic_slice_in_dim(chunk_ext, w * plan.hop, plan.window)
+        for w in range(plan.windows_per_shard)])
+
+
+def make_full_song_scorer(mesh: Mesh, plan: WindowPlan,
+                          config: CNNConfig = CNNConfig()):
+    """Build the jitted sequence-parallel full-song committee scorer.
+
+    Returns ``scorer(stacked_variables, padded_wave) -> (M, C)`` replicated
+    per-member mean sigmoid scores.  ``padded_wave`` is ``(padded_len,)``
+    from :func:`pad_song`; member variables are a stacked pytree
+    (``models.short_cnn.stack_params``), replicated across the mesh.
+
+    Layout: the first ``n_shards * chunk_len`` samples shard contiguously on
+    ``seq``; the global tail of ``halo`` samples rides along replicated (it
+    is at most ``window - hop`` samples) and stands in for the missing right
+    neighbor of the last chip.
+    """
+    if plan.window != config.input_length:
+        raise ValueError(
+            f"plan window {plan.window} != config.input_length "
+            f"{config.input_length}")
+    n_shards = mesh.shape[SEQ_AXIS]
+    if plan.n_shards != n_shards:
+        raise ValueError(f"plan built for {plan.n_shards} shards, mesh has "
+                         f"{n_shards}")
+    model = ShortChunkCNN(config)
+
+    def _shard_fn(stacked, chunks, tail):
+        # chunks: (1, chunk_len) local block; tail: (halo,) replicated.
+        chunk = chunks[0]
+        idx = lax.axis_index(SEQ_AXIS)
+        if plan.halo:
+            # Ring halo exchange: every chip sends the head of its chunk to
+            # its LEFT neighbor (one ICI hop); the last chip's "neighbor" is
+            # the replicated global tail.
+            recv = lax.ppermute(
+                chunk[:plan.halo], SEQ_AXIS,
+                perm=[(i, (i - 1) % n_shards) for i in range(n_shards)])
+            recv = jnp.where(idx == n_shards - 1, tail, recv)
+            chunk_ext = jnp.concatenate([chunk, recv])
+        else:
+            chunk_ext = chunk
+        windows = _local_windows(chunk_ext, plan)        # (wps, window)
+        probs = jax.vmap(
+            lambda v: model.apply(v, windows, train=False))(stacked)
+        # Masked mean over the global window axis: pad windows weigh 0.
+        gid = idx * plan.windows_per_shard + jnp.arange(
+            plan.windows_per_shard)
+        weight = (gid < plan.n_windows).astype(probs.dtype)   # (wps,)
+        local_sum = jnp.einsum("mwc,w->mc", probs, weight)
+        total = lax.psum(local_sum, SEQ_AXIS)
+        count = lax.psum(jnp.sum(weight), SEQ_AXIS)
+        return total / count
+
+    sharded = jax.shard_map(
+        _shard_fn, mesh=mesh,
+        in_specs=(P(), P(SEQ_AXIS), P()),
+        out_specs=P(),
+        check_vma=False)
+
+    body_len = n_shards * plan.chunk_len
+
+    @jax.jit
+    def scorer(stacked_variables, padded_wave):
+        body = padded_wave[:body_len].reshape(n_shards, plan.chunk_len)
+        tail = (padded_wave[body_len:] if plan.halo
+                else jnp.zeros((0,), padded_wave.dtype))
+        return sharded(stacked_variables, body, tail)
+
+    return scorer
+
+
+def full_song_probs_reference(stacked_variables, wave, plan: WindowPlan,
+                              config: CNNConfig = CNNConfig()):
+    """Single-device oracle: the same windows, plain vmap, no sharding.
+    Used by tests and single-chip fallback."""
+    model = ShortChunkCNN(config)
+    padded = jnp.asarray(pad_song(wave, plan))
+    starts = [w * plan.hop for w in range(plan.n_windows)]
+    windows = jnp.stack([padded[s:s + plan.window] for s in starts])
+    probs = jax.vmap(
+        lambda v: model.apply(v, windows, train=False))(stacked_variables)
+    return jnp.mean(probs, axis=1)

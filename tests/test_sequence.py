@@ -1,0 +1,86 @@
+"""Sequence-parallel full-song scoring vs the single-device window oracle,
+on a real 8-way virtual-CPU mesh (conftest.py) — the same GSPMD/halo code
+path a TPU slice runs, minus ICI."""
+
+import jax
+import numpy as np
+import pytest
+
+from consensus_entropy_tpu.config import CNNConfig
+from consensus_entropy_tpu.models.short_cnn import init_variables, stack_params
+from consensus_entropy_tpu.parallel import sequence
+from consensus_entropy_tpu.parallel.mesh import make_seq_mesh
+
+TINY = CNNConfig(n_channels=4, n_fft=64, hop_length=32, n_mels=16,
+                 n_layers=2, input_length=1024)
+
+
+@pytest.fixture(scope="module")
+def committee():
+    members = [init_variables(jax.random.key(i), TINY, batch_size=2)
+               for i in range(2)]
+    return stack_params(members)
+
+
+def _song(rng, n):
+    return (rng.standard_normal(n) * 0.05).astype(np.float32)
+
+
+def test_plan_geometry():
+    p = sequence.plan_windows(10_000, 8, window=1024, hop=1024)
+    assert p.n_windows == 9  # floor((10000-1024)/1024)+1
+    assert p.windows_per_shard == 2 and p.halo == 0
+    assert p.padded_len == 8 * 2 * 1024
+
+    p = sequence.plan_windows(10_000, 8, window=1024, hop=512)
+    assert p.n_windows == (10_000 - 1024) // 512 + 1 == 18
+    assert p.halo == 512
+    assert p.padded_len == 8 * p.windows_per_shard * 512 + 512
+
+    short = sequence.plan_windows(100, 8, window=1024, hop=1024)
+    assert short.n_windows == 1
+
+
+def test_plan_rejects_bad_hop():
+    with pytest.raises(ValueError):
+        sequence.plan_windows(5000, 4, window=1024, hop=2048)
+
+
+@pytest.mark.parametrize("n_samples,hop", [
+    (16 * 1024, 1024),      # exact tiling, no halo
+    (10_000, 1024),         # ragged tail, no halo
+    (10_000, 512),          # 50% overlap -> ppermute halo exchange
+    (7_000, 300),           # non-divisor hop, halo
+    (500, 1024),            # shorter than one window
+])
+def test_sharded_matches_oracle(rng, committee, n_samples, hop):
+    mesh = make_seq_mesh()
+    wave = _song(rng, n_samples)
+    plan = sequence.plan_windows(n_samples, mesh.shape["seq"],
+                                 window=TINY.input_length, hop=hop)
+    scorer = sequence.make_full_song_scorer(mesh, plan, TINY)
+    got = scorer(committee, jax.numpy.asarray(sequence.pad_song(wave, plan)))
+    want = sequence.full_song_probs_reference(committee, wave, plan, TINY)
+    assert got.shape == (2, TINY.n_class)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_scorer_validates_mesh_and_window(committee):
+    mesh = make_seq_mesh()
+    plan = sequence.plan_windows(8192, 4, window=1024)
+    with pytest.raises(ValueError):
+        sequence.make_full_song_scorer(mesh, plan, TINY)  # 4 != 8 shards
+    plan8 = sequence.plan_windows(8192, 8, window=512)
+    with pytest.raises(ValueError):
+        sequence.make_full_song_scorer(mesh, plan8, TINY)  # window mismatch
+
+
+def test_plan_rejects_halo_deeper_than_chunk():
+    # 75% overlap on a short song / wide mesh would need a multi-hop halo;
+    # plan_windows must reject it with a clear error, not crash at trace.
+    with pytest.raises(ValueError, match="overlap"):
+        sequence.plan_windows(2816, 8, window=1024, hop=256)
+    # Same overlap on a long song is fine (chunk covers the halo).
+    p = sequence.plan_windows(200_000, 8, window=1024, hop=256)
+    assert p.halo <= p.chunk_len
