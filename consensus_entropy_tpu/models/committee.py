@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from consensus_entropy_tpu import native
 from consensus_entropy_tpu.config import CNNConfig, NUM_CLASSES, TrainConfig
 from consensus_entropy_tpu.data.audio import DeviceWaveformStore
 from consensus_entropy_tpu.models import short_cnn
@@ -53,12 +54,18 @@ class FramePool:
         self.offsets = change
         self.song_ids = list(sorted_ids[change])
         self.counts = np.diff(np.r_[change, len(sorted_ids)])
+        self._starts = np.r_[change, len(sorted_ids)].astype(np.int64)
 
     @property
     def n_songs(self) -> int:
         return len(self.song_ids)
 
     def mean_by_song(self, frame_values: np.ndarray) -> np.ndarray:
+        frame_values = np.asarray(frame_values)
+        if frame_values.dtype == np.float32 and frame_values.ndim == 2:
+            # Threaded C++ segment mean (native.segment_mean falls back to
+            # numpy when the toolchain is absent).
+            return native.segment_mean(frame_values, self._starts)
         sums = np.add.reduceat(frame_values, self.offsets, axis=0)
         return sums / self.counts[:, None]
 

@@ -28,6 +28,7 @@ from sklearn.ensemble import GradientBoostingClassifier
 from sklearn.linear_model import SGDClassifier
 from sklearn.naive_bayes import GaussianNB
 
+from consensus_entropy_tpu import native
 from consensus_entropy_tpu.config import NUM_CLASSES
 from consensus_entropy_tpu.models.base import Member
 
@@ -60,7 +61,10 @@ class _PickledSklearnMember(Member):
         self.estimator = estimator
 
     def predict_proba(self, X):
-        return self._full_proba(self.estimator.predict_proba(np.asarray(X)),
+        # GNB/SGD route through the OpenMP C++ core (native.member_probs);
+        # other estimators fall back to sklearn transparently.
+        return self._full_proba(native.member_probs(self.estimator,
+                                                    np.asarray(X)),
                                 getattr(self.estimator, "classes_", ALL_CLASSES))
 
     @staticmethod

@@ -1,0 +1,97 @@
+"""On-demand build + ctypes binding of the native host runtime.
+
+No pybind11 in this image, so the ABI is plain ``extern "C"`` + ctypes with
+numpy buffers.  The shared object is compiled once per source hash into
+``<package>/native/_build/`` (override with ``CE_TPU_NATIVE_DIR``); set
+``CE_TPU_NO_NATIVE=1`` to force the numpy fallback (used by tests to cover
+both backends).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+
+import numpy as np
+from numpy.ctypeslib import ndpointer
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(os.path.dirname(_PKG_DIR))
+SOURCE = os.path.join(_REPO_ROOT, "native", "ce_host.cpp")
+
+_f32 = ndpointer(np.float32, flags="C_CONTIGUOUS")
+_f64 = ndpointer(np.float64, flags="C_CONTIGUOUS")
+_i64 = ndpointer(np.int64, flags="C_CONTIGUOUS")
+_pf32 = ctypes.POINTER(ctypes.c_float)
+_int64 = ctypes.c_int64
+
+
+def _build_dir() -> str:
+    return os.environ.get("CE_TPU_NATIVE_DIR",
+                          os.path.join(_PKG_DIR, "_build"))
+
+
+def build_library(verbose: bool = False) -> str | None:
+    """Compile ``ce_host.cpp`` if needed; returns the .so path or None."""
+    if not os.path.exists(SOURCE):
+        return None
+    try:
+        with open(SOURCE, "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+        out_dir = _build_dir()
+        so_path = os.path.join(out_dir, f"libce_host.{tag}.so")
+        if os.path.exists(so_path):
+            return so_path
+        os.makedirs(out_dir, exist_ok=True)
+        # Per-process temp name: concurrent importers (pytest-xdist, parallel
+        # AL drivers) each build privately; os.replace is atomic, last one
+        # wins with an identical artifact.
+        tmp_path = f"{so_path}.{os.getpid()}.tmp"
+        cmd = ["g++", "-O3", "-fopenmp", "-shared", "-fPIC", "-std=c++17",
+               SOURCE, "-o", tmp_path]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+        if proc.returncode != 0:
+            if verbose:
+                print(f"native build failed:\n{proc.stderr}", file=sys.stderr)
+            return None
+        os.replace(tmp_path, so_path)
+        return so_path
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        # Any filesystem/toolchain failure flips the module to numpy.
+        if verbose:
+            print(f"native build failed to run: {exc}", file=sys.stderr)
+        return None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.ce_linear_predict_proba.argtypes = [
+        _f32, _int64, _int64, _f32, _f32, _int64, ctypes.c_int, _pf32]
+    lib.ce_linear_predict_proba.restype = None
+    lib.ce_gnb_predict_proba.argtypes = [
+        _f32, _int64, _int64, _f64, _f64, _f64, _int64, _pf32]
+    lib.ce_gnb_predict_proba.restype = None
+    lib.ce_segment_mean.argtypes = [_f32, _int64, _int64, _i64, _int64, _pf32]
+    lib.ce_segment_mean.restype = None
+    lib.ce_row_entropy.argtypes = [_f32, _int64, _int64, _pf32]
+    lib.ce_row_entropy.restype = None
+    lib.ce_num_threads.argtypes = []
+    lib.ce_num_threads.restype = ctypes.c_int
+    return lib
+
+
+def load_library() -> ctypes.CDLL | None:
+    """Build (if needed) and bind the native library; None on any failure
+    or when ``CE_TPU_NO_NATIVE`` is set."""
+    if os.environ.get("CE_TPU_NO_NATIVE"):
+        return None
+    so_path = build_library()
+    if so_path is None:
+        return None
+    try:
+        return _bind(ctypes.CDLL(so_path))
+    except OSError:
+        return None

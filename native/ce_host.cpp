@@ -1,0 +1,158 @@
+// Native host-member runtime for consensus_entropy_tpu.
+//
+// The classic committee members (GNB, SGD-logistic) stay host-side by design
+// (SURVEY.md §2: trees/tiny generative models don't map to XLA); in a real AL
+// iteration their predict_proba over the pool frames (~95k rows x 260 feats
+// per member) plus the frame->song groupby-mean is the host hot loop that
+// runs concurrently with the TPU graph (SURVEY.md §7 hard part 6).  The
+// reference leaves all of this to single-threaded sklearn inside a Python
+// member loop (amg_test.py:428-438); here it is an OpenMP-threaded C++ core
+// loaded via ctypes (no pybind11 in this image).
+//
+// Numerical contracts (validated against sklearn in tests/test_native.py):
+//  - ce_linear_predict_proba mode=0: softmax over classes (multinomial).
+//    mode=1: per-class sigmoid, L1-normalized rows — sklearn's
+//    one-vs-all SGDClassifier(loss='log_loss') predict_proba semantics.
+//  - ce_gnb_predict_proba: GaussianNB joint log-likelihood
+//    (log prior + sum of Gaussian log pdfs, double accumulation) with
+//    exp(jll - logsumexp(jll)) normalization.
+//  - ce_segment_mean: mean over contiguous runs of equal segment ids —
+//    pandas groupby('s_id').mean() on a sorted index (amg_test.py:437).
+//  - ce_row_entropy: scipy.stats.entropy semantics (normalize rows, nats,
+//    0*log0 = 0).
+//
+// Build: g++ -O3 -march=native -fopenmp -shared -fPIC (see
+// consensus_entropy_tpu/native/build.py; a pure-numpy fallback exists).
+
+#include <cmath>
+#include <cstdint>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// out (n, c) <- row-softmax / row-normalized-sigmoid of X (n, f) @ W (f, c) + b (c)
+void ce_linear_predict_proba(const float* X, int64_t n, int64_t f,
+                             const float* W, const float* b, int64_t c,
+                             int mode, float* out) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    const float* x = X + i * f;
+    float* o = out + i * c;
+    // logits, double accumulation for sklearn-grade parity
+    for (int64_t k = 0; k < c; ++k) {
+      double acc = b[k];
+      const float* w = W + k;  // W is (f, c) row-major: stride c per feature
+      for (int64_t j = 0; j < f; ++j) acc += (double)x[j] * (double)w[j * c];
+      o[k] = (float)acc;
+    }
+    if (mode == 0) {  // multinomial softmax
+      float m = o[0];
+      for (int64_t k = 1; k < c; ++k) m = o[k] > m ? o[k] : m;
+      double s = 0.0;
+      for (int64_t k = 0; k < c; ++k) {
+        double e = std::exp((double)o[k] - (double)m);
+        o[k] = (float)e;
+        s += e;
+      }
+      for (int64_t k = 0; k < c; ++k) o[k] = (float)((double)o[k] / s);
+    } else {  // one-vs-all sigmoids, L1-normalized (sklearn OvA)
+      double s = 0.0;
+      for (int64_t k = 0; k < c; ++k) {
+        double p = 1.0 / (1.0 + std::exp(-(double)o[k]));
+        o[k] = (float)p;
+        s += p;
+      }
+      if (s > 0.0)
+        for (int64_t k = 0; k < c; ++k) o[k] = (float)((double)o[k] / s);
+      else
+        for (int64_t k = 0; k < c; ++k) o[k] = (float)(1.0 / (double)c);
+    }
+  }
+}
+
+// GaussianNB: out (n, c) posterior from theta/var (c, f) and log_prior (c).
+void ce_gnb_predict_proba(const float* X, int64_t n, int64_t f,
+                          const double* theta, const double* var,
+                          const double* log_prior, int64_t c, float* out) {
+  // Per-class constant: log_prior - 0.5 * sum(log(2*pi*var))
+  double* cls_const = new double[c];
+  for (int64_t k = 0; k < c; ++k) {
+    double s = 0.0;
+    for (int64_t j = 0; j < f; ++j)
+      s += std::log(2.0 * M_PI * var[k * f + j]);
+    cls_const[k] = log_prior[k] - 0.5 * s;
+  }
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    const float* x = X + i * f;
+    float* o = out + i * c;
+    double jll[64];  // c <= 64 enforced by the wrapper
+    double m = -1e308;
+    for (int64_t k = 0; k < c; ++k) {
+      const double* th = theta + k * f;
+      const double* va = var + k * f;
+      double s = 0.0;
+      for (int64_t j = 0; j < f; ++j) {
+        double d = (double)x[j] - th[j];
+        s += d * d / va[j];
+      }
+      jll[k] = cls_const[k] - 0.5 * s;
+      if (jll[k] > m) m = jll[k];
+    }
+    double s = 0.0;
+    for (int64_t k = 0; k < c; ++k) {
+      jll[k] = std::exp(jll[k] - m);
+      s += jll[k];
+    }
+    for (int64_t k = 0; k < c; ++k) o[k] = (float)(jll[k] / s);
+  }
+  delete[] cls_const;
+}
+
+// Mean over contiguous equal-id runs. seg_starts (n_segs + 1) gives row
+// offsets of each segment (computed host-side from the sorted id column).
+void ce_segment_mean(const float* X, int64_t n, int64_t c,
+                     const int64_t* seg_starts, int64_t n_segs, float* out) {
+#pragma omp parallel for schedule(static)
+  for (int64_t s = 0; s < n_segs; ++s) {
+    int64_t lo = seg_starts[s], hi = seg_starts[s + 1];
+    float* o = out + s * c;
+    for (int64_t k = 0; k < c; ++k) {
+      double acc = 0.0;
+      for (int64_t i = lo; i < hi; ++i) acc += X[i * c + k];
+      o[k] = hi > lo ? (float)(acc / (double)(hi - lo)) : 0.0f;
+    }
+  }
+  (void)n;
+}
+
+// scipy.stats.entropy per row: normalize, -sum(p log p) in nats.
+void ce_row_entropy(const float* P, int64_t n, int64_t c, float* out) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    const float* p = P + i * c;
+    double tot = 0.0;
+    for (int64_t k = 0; k < c; ++k) tot += p[k];
+    double h = 0.0;
+    for (int64_t k = 0; k < c; ++k) {
+      if (p[k] > 0.0f) {
+        double q = (double)p[k] / tot;
+        h -= q * std::log(q);
+      }
+    }
+    out[i] = (float)h;
+  }
+}
+
+int ce_num_threads() {
+#if defined(_OPENMP)
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+}  // extern "C"

@@ -1,0 +1,132 @@
+"""Native C++ host runtime vs sklearn/scipy/pandas oracles, on BOTH backends
+(the compiled OpenMP library and the numpy fallback)."""
+
+import importlib
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+from scipy.stats import entropy as scipy_entropy
+from sklearn.linear_model import SGDClassifier
+from sklearn.naive_bayes import GaussianNB
+
+from consensus_entropy_tpu import native
+
+
+def _fallback_env():
+    env = dict(os.environ)
+    env["CE_TPU_NO_NATIVE"] = "1"
+    return env
+
+
+def test_native_backend_compiles():
+    # This image ships g++; the native backend must actually build here.
+    assert native.backend() == "native"
+    assert native.num_threads() >= 1
+
+
+def test_numpy_fallback_importable():
+    # Fallback path must import and answer in a clean subprocess.
+    code = ("import numpy as np\n"
+            "from consensus_entropy_tpu import native\n"
+            "assert native.backend() == 'numpy'\n"
+            "p = native.linear_predict_proba(np.ones((3, 4), np.float32),"
+            " np.ones((4, 2), np.float32), np.zeros(2, np.float32))\n"
+            "assert p.shape == (3, 2)\n"
+            "print('fallback ok')\n")
+    out = subprocess.run([sys.executable, "-c", code], env=_fallback_env(),
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "fallback ok" in out.stdout
+
+
+@pytest.fixture
+def problem(rng):
+    X = rng.standard_normal((200, 12)).astype(np.float32)
+    y = rng.integers(0, 4, 200)
+    return X, y
+
+
+def test_gnb_parity(problem):
+    X, y = problem
+    est = GaussianNB().fit(X, y)
+    want = est.predict_proba(X)
+    got = native.gnb_predict_proba(X, est.theta_, est.var_, est.class_prior_)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+    via_member = native.member_probs(est, X)
+    np.testing.assert_array_equal(got, via_member)
+
+
+def test_sgd_ova_parity(problem):
+    X, y = problem
+    est = SGDClassifier(loss="log_loss", random_state=0).fit(X, y)
+    want = est.predict_proba(X)
+    got = native.member_probs(est, X)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+def test_linear_softmax_matches_oracle(rng):
+    X = rng.standard_normal((50, 8)).astype(np.float32)
+    W = rng.standard_normal((8, 4)).astype(np.float32)
+    b = rng.standard_normal(4).astype(np.float32)
+    got = native.linear_predict_proba(X, W, b, mode="softmax")
+    logits = X.astype(np.float64) @ W.astype(np.float64) + b
+    logits -= logits.max(axis=1, keepdims=True)
+    want = np.exp(logits)
+    want /= want.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(got.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_segment_mean_groupby_parity(rng):
+    import pandas as pd
+
+    ids = np.sort(rng.integers(0, 30, 500))
+    X = rng.standard_normal((500, 4)).astype(np.float32)
+    starts = native.segment_starts(ids)
+    got = native.segment_mean(X, starts)
+    want = pd.DataFrame(X).groupby(ids).mean().to_numpy()
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_row_entropy_scipy_parity(rng):
+    P = rng.uniform(0.0, 1.0, (100, 4)).astype(np.float32)
+    P[0] = [1, 0, 0, 0]          # zero-probability classes
+    P[1] = [0.25, 0.25, 0.25, 0.25]
+    got = native.row_entropy(P)
+    want = scipy_entropy(P.astype(np.float64), axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_fallback_matches_native(problem, rng, monkeypatch):
+    # Force the numpy implementations in-process and compare against the
+    # native ones on identical inputs.
+    X, y = problem
+    est = GaussianNB().fit(X, y)
+    native_gnb = native.gnb_predict_proba(X, est.theta_, est.var_,
+                                          est.class_prior_)
+    P = rng.uniform(0.01, 1.0, (64, 4)).astype(np.float32)
+    native_ent = native.row_entropy(P)
+    W = rng.standard_normal((12, 4)).astype(np.float32)
+    b = np.zeros(4, np.float32)
+    native_lin = native.linear_predict_proba(X, W, b, mode="ova")
+
+    monkeypatch.setattr(native, "_lib", None)
+    assert native.backend() == "numpy"
+    np.testing.assert_allclose(
+        native.gnb_predict_proba(X, est.theta_, est.var_, est.class_prior_),
+        native_gnb, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(native.row_entropy(P), native_ent,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(native.linear_predict_proba(X, W, b, "ova"),
+                               native_lin, rtol=1e-5, atol=1e-6)
+
+
+def test_segment_starts_validation():
+    with pytest.raises(ValueError):
+        native.segment_mean(np.ones((4, 2), np.float32),
+                            np.array([1, 4], np.int64))
+    assert native.segment_starts(np.array([])).tolist() == [0]
