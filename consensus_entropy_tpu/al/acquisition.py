@@ -71,12 +71,14 @@ class Acquirer:
 
     # -- the four modes ----------------------------------------------------
 
-    def select(self, member_probs=None) -> list:
+    def select(self, member_probs=None, *, rand_key=None) -> list:
         """Pick the next query batch; returns song ids (≤ ``queries``).
 
         ``member_probs``: ``(M, n_live, C)`` over ``remaining_songs`` — only
-        needed for mc/mix.  Updates pool/hc masks exactly as the reference
-        mutates its tables.
+        needed for mc/mix.  ``rand_key``: explicit PRNG key for ``rand`` mode
+        (the AL loop passes its own resumable stream; without one the
+        acquirer's internal seed-derived stream is used).  Updates pool/hc
+        masks exactly as the reference mutates its tables.
         """
         if self.mode == "mc":
             res = self._fns["mc"](self.pad_probs(member_probs), self.pool_mask)
@@ -97,8 +99,9 @@ class Acquirer:
             q_songs = list(dict.fromkeys(raw))
             self._remove_hc(q_songs)  # amg_test.py:484
         elif self.mode == "rand":
-            self._rand_key, sub = jax.random.split(self._rand_key)
-            res = self._fns["rand"](sub, self.pool_mask)
+            if rand_key is None:
+                self._rand_key, rand_key = jax.random.split(self._rand_key)
+            res = self._fns["rand"](rand_key, self.pool_mask)
             q_songs = self._ids(res)
         else:
             raise ValueError(f"unknown mode {self.mode!r}")
@@ -107,6 +110,17 @@ class Acquirer:
         for s in q_songs:
             self.pool_mask[self._song_row[s]] = False
         return q_songs
+
+    def replay(self, queried_batches) -> None:
+        """Re-apply completed iterations' query batches to the masks
+        (iteration-level resume): every queried song leaves the pool, and in
+        hc/mix modes its hc row is removed exactly as ``select`` did
+        (``amg_test.py:455,484,520-523``)."""
+        for batch in queried_batches:
+            for s in batch:
+                self.pool_mask[self._song_row[s]] = False
+                if self.mode in ("hc", "mix"):
+                    self.hc_mask[self._song_row[s]] = False
 
     def _ids(self, res: scoring.ScoreResult) -> list:
         idx = np.asarray(res.indices)

@@ -18,12 +18,14 @@ from typing import Mapping
 import jax
 import numpy as np
 
+from consensus_entropy_tpu.al import state as al_state
 from consensus_entropy_tpu.al.acquisition import Acquirer
 from consensus_entropy_tpu.al.reporting import UserReport, weighted_f1
 from consensus_entropy_tpu.config import ALConfig
 from consensus_entropy_tpu.data.audio import DeviceWaveformStore
 from consensus_entropy_tpu.labels import one_hot_np
 from consensus_entropy_tpu.models.committee import Committee, FramePool
+from consensus_entropy_tpu.utils.profiling import StepTimer
 
 
 @dataclasses.dataclass
@@ -46,15 +48,9 @@ class SplitData:
     y_test_songs: np.ndarray  # song-level labels (CNN eval, amg_test.py:406-408)
 
 
-def grouped_split(pool: FramePool, labels: Mapping, train_size: float,
-                  rng: np.random.Generator) -> SplitData:
-    """Song-grouped shuffle split (``GroupShuffleSplit`` semantics,
-    ``amg_test.py:363-366``): train_size fraction of *songs*."""
-    songs = list(pool.song_ids)
-    perm = rng.permutation(len(songs))
-    n_train = int(round(train_size * len(songs)))
-    train_songs = [songs[i] for i in sorted(perm[:n_train])]
-    test_songs = [songs[i] for i in sorted(perm[n_train:])]
+def split_from_songs(pool: FramePool, labels: Mapping, train_songs: list,
+                     test_songs: list) -> SplitData:
+    """Materialize SplitData from chosen train/test song lists."""
     rows = pool.rows_for_songs(test_songs)
     X_test = pool.X[rows]
     # per-frame labels repeat the song label (the reference's y_train/y_test
@@ -67,6 +63,18 @@ def grouped_split(pool: FramePool, labels: Mapping, train_size: float,
     y_test_songs = np.array([labels[s] for s in test_songs], np.int32)
     return SplitData(train_songs, test_songs, X_test, y_test_frames,
                      y_test_songs)
+
+
+def grouped_split(pool: FramePool, labels: Mapping, train_size: float,
+                  rng: np.random.Generator) -> SplitData:
+    """Song-grouped shuffle split (``GroupShuffleSplit`` semantics,
+    ``amg_test.py:363-366``): train_size fraction of *songs*."""
+    songs = list(pool.song_ids)
+    perm = rng.permutation(len(songs))
+    n_train = int(round(train_size * len(songs)))
+    train_songs = [songs[i] for i in sorted(perm[:n_train])]
+    test_songs = [songs[i] for i in sorted(perm[n_train:])]
+    return split_from_songs(pool, labels, train_songs, test_songs)
 
 
 class ALLoop:
@@ -93,14 +101,49 @@ class ALLoop:
             f1s.append(report.model_eval(m.name, split.y_test_frames, y_pred))
         return f1s
 
+    @staticmethod
+    def _rebuild_split(data: UserData, st: al_state.ALState) -> SplitData:
+        """Reconstruct SplitData from a resume state's stored song lists."""
+        return split_from_songs(
+            data.pool, data.labels,
+            al_state.remap_songs(st.train_songs, data.pool.song_ids),
+            al_state.remap_songs(st.test_songs, data.pool.song_ids))
+
     def run_user(self, committee: Committee, data: UserData, user_path: str,
-                 *, seed: int | None = None) -> dict:
+                 *, seed: int | None = None, resume: bool = True,
+                 timer: StepTimer | None = None) -> dict:
         cfg = self.config
         seed = cfg.seed if seed is None else seed
-        rng = np.random.default_rng(seed)
-        key = jax.random.key(seed)
+        timer = timer or StepTimer(None)
 
-        split = grouped_split(data.pool, data.labels, cfg.train_size, rng)
+        st = al_state.ALState.load(user_path) if resume else None
+        if st is not None and not st.matches(
+                mode=cfg.mode, seed=seed, queries=cfg.queries,
+                train_size=cfg.train_size):
+            # Fail loud: the workspace holds a committee trained under a
+            # different experiment definition — silently "starting clean"
+            # would contaminate the run (workspace.create_user wipes such
+            # directories when given the experiment parameters).
+            raise ValueError(
+                f"{user_path} holds resume state for a different experiment "
+                f"(mode={st.mode} seed={st.seed} q={st.queries} "
+                f"train_size={st.train_size}); delete the directory or pass "
+                "the experiment to workspace.create_user")
+        if st is not None:
+            split = self._rebuild_split(data, st)
+            key = st.unpack_key()
+            trajectory = list(st.trajectory)
+            queried_hist = [al_state.remap_songs(b, data.pool.song_ids)
+                            for b in st.queried]
+            start_epoch = st.next_epoch
+        else:
+            rng = np.random.default_rng(seed)
+            key = jax.random.key(seed)
+            split = grouped_split(data.pool, data.labels, cfg.train_size, rng)
+            trajectory = []
+            queried_hist = []
+            start_epoch = 0
+
         hc_rows = None
         if data.hc_rows is not None:
             row_of = {s: i for i, s in enumerate(data.pool.song_ids)}
@@ -108,17 +151,40 @@ class ALLoop:
                 [row_of[s] for s in split.train_songs]]
         acq = Acquirer(split.train_songs, hc_rows, queries=cfg.queries,
                        mode=cfg.mode, tie_break=self.tie_break, seed=seed)
+        acq.replay(queried_hist)
 
-        trajectory = []
+        def checkpoint(next_epoch: int, current_key) -> None:
+            """Two-phase commit: stage members -> state write (commit point)
+            -> promote.  A kill anywhere leaves (committee, state) pairs
+            consistent (al_state.recover_workspace)."""
+            committee.save(al_state.staging_dir(user_path, next_epoch))
+            kd, kdt = al_state.ALState.pack_key(current_key)
+            al_state.ALState(
+                next_epoch=next_epoch, trajectory=trajectory,
+                train_songs=[al_state.song_key(s)
+                             for s in split.train_songs],
+                test_songs=[al_state.song_key(s) for s in split.test_songs],
+                queried=[[al_state.song_key(s) for s in b]
+                         for b in queried_hist],
+                key_data=kd, key_dtype=kdt, mode=cfg.mode, seed=seed,
+                queries=cfg.queries, train_size=cfg.train_size,
+            ).save(user_path)
+            al_state.recover_workspace(user_path)  # promote the stage
+
         with UserReport(user_path, cfg.mode) as report:
-            # epoch 0: baseline evaluation (amg_test.py:398-418)
-            report.epoch_header(-1)
-            key, sub = jax.random.split(key)
-            f1s = self._evaluate(committee, data, split, report, sub)
-            report.epoch_summary(-1, f1s)
-            trajectory.append(float(np.mean(f1s)))
+            if st is None:
+                # epoch 0: baseline evaluation (amg_test.py:398-418)
+                report.epoch_header(-1)
+                key, sub = jax.random.split(key)
+                with timer.phase("evaluate"):
+                    f1s = self._evaluate(committee, data, split, report, sub)
+                report.epoch_summary(-1, f1s)
+                trajectory.append(float(np.mean(f1s)))
+                with timer.phase("checkpoint"):
+                    checkpoint(0, key)
+                timer.flush(user=str(data.user_id), epoch=-1)
 
-            for epoch in range(cfg.epochs):
+            for epoch in range(start_epoch, cfg.epochs):
                 report.epoch_header(epoch)
                 live = acq.remaining_songs
                 if len(live) == 0:
@@ -126,9 +192,12 @@ class ALLoop:
                 member_probs = None
                 if cfg.mode in ("mc", "mix"):
                     key, sub = jax.random.split(key)
-                    member_probs = np.asarray(committee.pool_probs(
-                        data.pool, data.store, live, sub))
-                q_songs = acq.select(member_probs)
+                    with timer.phase("score"):
+                        member_probs = np.asarray(committee.pool_probs(
+                            data.pool, data.store, live, sub))
+                key, sub = jax.random.split(key)
+                with timer.phase("select"):
+                    q_songs = acq.select(member_probs, rand_key=sub)
 
                 # reveal labels; build the frame batch (amg_test.py:491-493)
                 rows = data.pool.rows_for_songs(q_songs)
@@ -139,20 +208,30 @@ class ALLoop:
                     frame_labels += [data.labels[s]] * int(n)
                 y_batch = np.asarray(frame_labels, np.int32)
 
-                committee.update_host(X_batch, y_batch)
+                with timer.phase("update_host"):
+                    committee.update_host(X_batch, y_batch)
                 if committee.cnn_members:
                     y_q = one_hot_np([data.labels[s] for s in q_songs])
                     y_t = one_hot_np(split.y_test_songs)
                     key, sub = jax.random.split(key)
-                    committee.retrain_cnns(
-                        data.store, q_songs, y_q, split.test_songs, y_t, sub,
-                        n_epochs=self.retrain_epochs)
+                    with timer.phase("retrain_cnn"):
+                        committee.retrain_cnns(
+                            data.store, q_songs, y_q, split.test_songs, y_t,
+                            sub, n_epochs=self.retrain_epochs)
 
                 key, sub = jax.random.split(key)
-                f1s = self._evaluate(committee, data, split, report, sub)
+                with timer.phase("evaluate"):
+                    f1s = self._evaluate(committee, data, split, report, sub)
                 report.epoch_summary(epoch, f1s, queried=q_songs,
                                      pool_size=len(acq.remaining_songs))
                 trajectory.append(float(np.mean(f1s)))
+
+                # per-iteration persistence (amg_test.py:511) + resume state
+                queried_hist.append(q_songs)
+                with timer.phase("checkpoint"):
+                    checkpoint(epoch + 1, key)
+                timer.flush(user=str(data.user_id), epoch=epoch,
+                            queried=len(q_songs))
 
         return {"user": data.user_id, "mode": cfg.mode,
                 "trajectory": trajectory,

@@ -1,0 +1,138 @@
+"""Iteration-granularity AL resume state.
+
+The reference resumes at USER granularity only: an existing user directory
+skips the whole user, and a run killed mid-user leaves a stale directory
+that must be hand-deleted (``amg_test.py:146-171``; SURVEY.md §5).  The
+framework keeps that surface (workspace DONE markers) and adds a JSON state
+file written atomically after every AL iteration, alongside the per-
+iteration committee persistence the reference already does
+(``amg_test.py:511``).  A killed run therefore restarts mid-user at the
+next iteration with an identical RNG stream, masks, and committee.
+
+Serialized: the grouped split (song ids), per-iteration queried batches
+(replayed into the Acquirer's masks on load), the F1 trajectory, the raw
+JAX PRNG key state, and the experiment parameters that define the run
+(mode/seed/queries/train_size — a mismatch means the state belongs to a
+different experiment).  Song ids round-trip as strings (ids may be numpy
+ints or strings; the loop re-maps them onto the pool's live objects).
+
+Committee persistence uses a two-phase commit so a kill at ANY point leaves
+a consistent pair (committee files, state): the loop writes the updated
+members into a per-generation staging directory, then writes the state file
+(the atomic commit point), then promotes the staged files over the live
+ones.  :func:`recover_workspace` — run before any committee load — finishes
+an interrupted promotion (state generation matches the staging dir) or
+discards a pre-commit stage (it doesn't), so the live files always
+correspond exactly to ``state.next_epoch``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+import shutil
+
+import jax
+import numpy as np
+
+STATE_FILE = "al_state.json"
+STAGING_PREFIX = "_staged_gen"
+
+
+@dataclasses.dataclass
+class ALState:
+    next_epoch: int
+    trajectory: list[float]
+    train_songs: list[str]
+    test_songs: list[str]
+    queried: list[list[str]]  # one batch of song ids per completed iteration
+    key_data: list            # np array of jax.random.key_data, as nested list
+    key_dtype: str
+    mode: str
+    seed: int
+    queries: int = -1         # -1: legacy state, parameter unknown
+    train_size: float = -1.0
+
+    def matches(self, *, mode: str, seed: int, queries: int,
+                train_size: float) -> bool:
+        """Does this state belong to the same experiment definition?"""
+        return (self.mode == mode and self.seed == seed
+                and self.queries in (-1, queries)
+                and self.train_size in (-1.0, train_size))
+
+    def save(self, user_path: str) -> None:
+        path = os.path.join(user_path, STATE_FILE)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(dataclasses.asdict(self), f)
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, user_path: str) -> "ALState | None":
+        path = os.path.join(user_path, STATE_FILE)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return cls(**json.load(f))
+
+    # -- jax key round-trip -------------------------------------------------
+
+    @staticmethod
+    def pack_key(key) -> tuple[list, str]:
+        data = np.asarray(jax.random.key_data(key))
+        return data.tolist(), str(data.dtype)
+
+    def unpack_key(self):
+        data = np.asarray(self.key_data, dtype=np.dtype(self.key_dtype))
+        return jax.random.wrap_key_data(data)
+
+
+def song_key(s) -> str:
+    """Canonical string form of a song id (numpy ints, ints, strings)."""
+    return str(s)
+
+
+def remap_songs(stored: list[str], live_songs) -> list:
+    """Map stored string ids back onto the pool's live id objects."""
+    by_key = {song_key(s): s for s in live_songs}
+    missing = [s for s in stored if s not in by_key]
+    if missing:
+        raise ValueError(f"resume state references songs not in the pool: "
+                         f"{missing[:5]} (pool changed since the run began?)")
+    return [by_key[s] for s in stored]
+
+
+# -- two-phase committee checkpoint --------------------------------------
+
+
+def staging_dir(user_path: str, generation: int) -> str:
+    return os.path.join(user_path, f"{STAGING_PREFIX}{generation}")
+
+
+def recover_workspace(user_path: str) -> None:
+    """Finish or discard a torn committee checkpoint.
+
+    Idempotent; cheap no-op when no staging directory exists.  Must run
+    before loading a committee from ``user_path`` (``workspace.
+    load_committee`` does so automatically).
+    """
+    st = ALState.load(user_path)
+    for d in sorted(glob.glob(os.path.join(user_path, STAGING_PREFIX + "*"))):
+        try:
+            gen = int(os.path.basename(d)[len(STAGING_PREFIX):])
+        except ValueError:
+            shutil.rmtree(d)
+            continue
+        if st is not None and gen == st.next_epoch:
+            # Committed: state references this generation — promote (file
+            # renames are idempotent across repeated recoveries).
+            for fname in sorted(os.listdir(d)):
+                os.replace(os.path.join(d, fname),
+                           os.path.join(user_path, fname))
+            os.rmdir(d)
+        else:
+            # Pre-commit stage from a crash before the state write: the
+            # epoch will re-run against the (unchanged) live files.
+            shutil.rmtree(d)

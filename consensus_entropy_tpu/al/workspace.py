@@ -35,14 +35,34 @@ def user_dir(users_root: str, user, mode: str) -> str:
     return os.path.join(users_root, str(user), mode)
 
 
-def create_user(users_root: str, pretrained_dir: str, user, mode: str):
+def create_user(users_root: str, pretrained_dir: str, user, mode: str,
+                experiment: dict | None = None):
     """Returns ``(path, skip)``; copies the pretrained committee on first
-    creation (``amg_test.py:146-171``)."""
+    creation (``amg_test.py:146-171``).
+
+    A partial directory holding an ``al_state.json`` for the SAME experiment
+    is kept intact — the AL loop resumes it at the next iteration
+    (``al.state``; torn committee checkpoints are recovered first).
+    ``experiment`` is ``{'seed':…, 'queries':…, 'train_size':…}``; state
+    from a different experiment — or any partial directory without state —
+    is redone from pristine models, fixing the reference's skip-forever
+    behavior.
+    """
+    from consensus_entropy_tpu.al import state as al_state
+
     path = user_dir(users_root, user, mode)
     if os.path.exists(os.path.join(path, _DONE)):
         return path, True
-    if os.path.isdir(path):  # stale partial run: redo from pristine models
-        shutil.rmtree(path)
+    if os.path.isdir(path):
+        st = al_state.ALState.load(path)
+        resumable = st is not None and (experiment is None or st.matches(
+            mode=mode, seed=experiment["seed"],
+            queries=experiment["queries"],
+            train_size=experiment["train_size"]))
+        if resumable:
+            al_state.recover_workspace(path)
+            return path, False  # resumable mid-user state
+        shutil.rmtree(path)  # pre-state crash or different experiment
     os.makedirs(path)
     for fname in sorted(os.listdir(pretrained_dir)):
         if fname.endswith((".pkl", ".msgpack")):
@@ -64,6 +84,9 @@ def load_committee(path: str, config: CNNConfig = CNNConfig(),
     ``classifier_{kind}.{name}.pkl`` for host members,
     ``classifier_cnn.{name}.msgpack`` for Flax members.
     """
+    from consensus_entropy_tpu.al.state import recover_workspace
+
+    recover_workspace(path)  # finish/discard any torn checkpoint first
     host: list[Member] = []
     cnns: list[CNNMember] = []
     for fname in sorted(os.listdir(path)):

@@ -37,6 +37,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cap the user count (debug)")
     p.add_argument("--seed", type=int, default=1987)
     p.add_argument("--tie-break", choices=("fast", "numpy"), default="fast")
+    p.add_argument("--trace-dir", default=None,
+                   help="write a jax.profiler device trace here "
+                        "(TensorBoard-loadable)")
     add_path_args(p)
     add_device_arg(p)
     return p
@@ -52,6 +55,7 @@ def main(argv=None) -> int:
     from consensus_entropy_tpu.al.loop import ALLoop, UserData
     from consensus_entropy_tpu.config import ALConfig, CNNConfig, PathsConfig
     from consensus_entropy_tpu.data import amg
+    from consensus_entropy_tpu.utils import profiling
 
     paths = PathsConfig(models_root=args.models_root,
                         deam_root=args.deam_root, amg_root=args.amg_root)
@@ -84,7 +88,9 @@ def main(argv=None) -> int:
     results = []
     for num_user, u_id in enumerate(users[: args.max_users]):
         user_path, skip = workspace.create_user(
-            paths.users_dir, paths.pretrained_dir, u_id, cfg.mode)
+            paths.users_dir, paths.pretrained_dir, u_id, cfg.mode,
+            experiment={"seed": cfg.seed, "queries": cfg.queries,
+                        "train_size": cfg.train_size})
         if skip:
             print(f"Skipping user {u_id}, already exists!")
             continue
@@ -95,7 +101,10 @@ def main(argv=None) -> int:
         print(f"Creating and performing active learning for user {u_id} "
               f"with {len(labels)} annotations.")
         print(f"User {num_user} / {len(users) - 1}")
-        res = loop.run_user(committee, data, user_path, seed=cfg.seed)
+        timer = profiling.StepTimer(os.path.join(user_path, "timings.jsonl"))
+        with profiling.trace(args.trace_dir):
+            res = loop.run_user(committee, data, user_path, seed=cfg.seed,
+                                timer=timer)
         committee.save(user_path)
         workspace.mark_done(user_path)
         results.append(res)

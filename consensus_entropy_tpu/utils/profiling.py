@@ -1,0 +1,73 @@
+"""Tracing / profiling: per-phase step timers + jax.profiler integration.
+
+The reference's only observability is wall-clock prints inside the CNN
+training loop (``deam_classifier.py:294-297``); there is no tracing at all
+(SURVEY.md §5).  Here:
+
+- :class:`StepTimer` — named-phase wall timing with a structured JSONL sink;
+  the AL loop times score / update-host / retrain-cnn / evaluate per
+  iteration, which is exactly the north-star metric surface (pool-scoring
+  wall-clock per iteration).
+- :func:`trace` — context manager around ``jax.profiler`` producing a
+  TensorBoard-loadable device trace when a directory is given, a no-op
+  otherwise (so call sites need no conditionals).
+
+Timers measure *host-observed* wall time; device work launched inside a
+phase is included only up to dispatch unless the phase ends with a blocking
+consume, which the AL loop's phases do (numpy conversions / host metrics).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+
+
+class StepTimer:
+    """Accumulates named phase durations; one JSONL record per flush.
+
+    Usage::
+
+        timer = StepTimer(path)           # or StepTimer(None): in-memory
+        with timer.phase("score"):
+            ...
+        timer.flush(epoch=3)              # writes {"epoch": 3, "score_s": ...}
+    """
+
+    def __init__(self, jsonl_path: str | None = None):
+        self.jsonl_path = jsonl_path
+        self._acc: dict[str, float] = {}
+        self.records: list[dict] = []
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._acc[name] = (self._acc.get(name, 0.0)
+                               + time.perf_counter() - t0)
+
+    def flush(self, **labels) -> dict:
+        """Close the current record: labels + ``{phase}_s`` durations."""
+        rec = dict(labels)
+        rec.update({f"{k}_s": round(v, 6) for k, v in self._acc.items()})
+        self._acc = {}
+        self.records.append(rec)
+        if self.jsonl_path:
+            with open(self.jsonl_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        return rec
+
+
+@contextlib.contextmanager
+def trace(trace_dir: str | None):
+    """``jax.profiler.trace`` when a directory is given; no-op otherwise."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield

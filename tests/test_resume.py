@@ -1,0 +1,179 @@
+"""Iteration-level resume: a run killed mid-user must continue at the next
+AL iteration with identical queries, masks, and final state as an
+uninterrupted run (SURVEY.md §5 failure detection — the reference can only
+skip-or-redo whole users)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from consensus_entropy_tpu.al import state as al_state
+from consensus_entropy_tpu.al import workspace
+from consensus_entropy_tpu.al.loop import ALLoop, UserData
+from consensus_entropy_tpu.config import ALConfig
+from consensus_entropy_tpu.models.committee import Committee, FramePool
+from consensus_entropy_tpu.models.sklearn_members import GNBMember, SGDMember
+from consensus_entropy_tpu.utils.profiling import StepTimer
+
+
+def _make_user(rng, n_songs=30, frames_per_song=3, n_feat=8):
+    centers = rng.standard_normal((4, n_feat)) * 3.0
+    labels = {}
+    X, frame_song = [], []
+    for s in range(n_songs):
+        c = int(rng.integers(0, 4))
+        sid = f"song{s:03d}"
+        labels[sid] = c
+        X.append(centers[c] + rng.standard_normal((frames_per_song, n_feat)))
+        frame_song += [sid] * frames_per_song
+    pool = FramePool(np.concatenate(X).astype(np.float32), frame_song)
+    hc = rng.uniform(0.1, 1.0, (pool.n_songs, 4)).astype(np.float32)
+    hc /= hc.sum(axis=1, keepdims=True)
+    return UserData("u0", pool, labels, hc_rows=hc)
+
+
+def _committee(rng, data):
+    X = data.pool.X
+    y = np.array([data.labels[s] for s in np.repeat(
+        data.pool.song_ids, data.pool.counts)], np.int32)
+    gnb = GNBMember("gnb.it_0").fit(X, y)
+    sgd = SGDMember("sgd.it_0", seed=0).fit(X, y)
+    return Committee([gnb, sgd], [])
+
+
+@pytest.mark.parametrize("mode", ["mc", "hc", "mix", "rand"])
+def test_interrupted_run_matches_straight_run(tmp_path, rng, mode):
+    data = _make_user(rng)
+
+    # Straight run: 4 iterations in one go.
+    d_full = tmp_path / "full"
+    d_full.mkdir()
+    rng_a = np.random.default_rng(0)
+    loop4 = ALLoop(ALConfig(queries=3, epochs=4, mode=mode, seed=11))
+    res_full = loop4.run_user(_committee(rng_a, data), data, str(d_full),
+                              seed=11)
+
+    # Interrupted run: 2 iterations, then resume for the remaining 2 with a
+    # committee reloaded from the per-iteration persistence.
+    d_part = tmp_path / "part"
+    d_part.mkdir()
+    rng_b = np.random.default_rng(0)
+    loop2 = ALLoop(ALConfig(queries=3, epochs=2, mode=mode, seed=11))
+    loop2.run_user(_committee(rng_b, data), data, str(d_part), seed=11)
+    st = al_state.ALState.load(str(d_part))
+    assert st is not None and st.next_epoch == 2
+
+    committee2 = workspace.load_committee(str(d_part))
+    res_resumed = loop4.run_user(committee2, data, str(d_part), seed=11)
+
+    assert res_resumed["trajectory"] == pytest.approx(res_full["trajectory"])
+    full_q = al_state.ALState.load(str(d_full)).queried
+    part_q = al_state.ALState.load(str(d_part)).queried
+    assert full_q == part_q  # identical query sequence across the cut
+
+
+def test_state_mismatch_fails_loud(tmp_path, rng):
+    # run_user must not silently "start clean" on top of a committee that
+    # was trained under a different experiment; the workspace layer is the
+    # one that wipes mismatched directories back to pristine models.
+    data = _make_user(rng)
+    d = tmp_path / "u"
+    d.mkdir()
+    loop = ALLoop(ALConfig(queries=3, epochs=1, mode="mc", seed=11))
+    loop.run_user(_committee(np.random.default_rng(0), data), data, str(d),
+                  seed=11)
+    for bad in (ALConfig(queries=3, epochs=1, mode="hc", seed=11),
+                ALConfig(queries=3, epochs=1, mode="mc", seed=12),
+                ALConfig(queries=5, epochs=1, mode="mc", seed=11)):
+        with pytest.raises(ValueError, match="different experiment"):
+            ALLoop(bad).run_user(
+                _committee(np.random.default_rng(0), data), data, str(d),
+                seed=bad.seed)
+
+
+def test_workspace_wipes_mismatched_experiment(tmp_path, rng):
+    pre = tmp_path / "pretrained"
+    pre.mkdir()
+    (pre / "classifier_gnb.it_0.pkl").write_bytes(b"x")
+    users = str(tmp_path / "users")
+    exp = {"seed": 11, "queries": 3, "train_size": 0.85}
+    path, _ = workspace.create_user(users, str(pre), "u1", "mc", exp)
+    al_state.ALState(1, [0.5], [], [], [[]], [0, 0], "uint32", "mc", 11,
+                     queries=3, train_size=0.85).save(path)
+    (tmp_path / "users" / "u1" / "mc" / "trained").write_text("x")
+    # Same experiment: kept.
+    path2, skip2 = workspace.create_user(users, str(pre), "u1", "mc", exp)
+    assert not skip2 and os.path.exists(os.path.join(path2, "trained"))
+    # Different queries: wiped back to pristine.
+    path3, skip3 = workspace.create_user(users, str(pre), "u1", "mc",
+                                         {**exp, "queries": 7})
+    assert not skip3 and not os.path.exists(os.path.join(path3, "trained"))
+
+
+def test_torn_checkpoint_recovery(tmp_path):
+    # Crash between the staged committee write and the state write: the
+    # stage must be discarded.  Crash after the state write: promoted.
+    d = tmp_path / "u"
+    d.mkdir()
+    (d / "classifier_gnb.m.pkl").write_text("old")
+    al_state.ALState(2, [0.5], [], [], [["s"]], [0, 0], "uint32",
+                     "mc", 11).save(str(d))
+    stale = al_state.staging_dir(str(d), 3)   # pre-commit (state says 2)
+    os.makedirs(stale)
+    with open(os.path.join(stale, "classifier_gnb.m.pkl"), "w") as f:
+        f.write("newer-uncommitted")
+    al_state.recover_workspace(str(d))
+    assert not os.path.exists(stale)
+    assert open(d / "classifier_gnb.m.pkl").read() == "old"
+
+    committed = al_state.staging_dir(str(d), 2)  # matches state: promote
+    os.makedirs(committed)
+    with open(os.path.join(committed, "classifier_gnb.m.pkl"), "w") as f:
+        f.write("committed")
+    al_state.recover_workspace(str(d))
+    assert not os.path.exists(committed)
+    assert open(d / "classifier_gnb.m.pkl").read() == "committed"
+    al_state.recover_workspace(str(d))  # idempotent
+
+
+def test_workspace_keeps_resumable_dirs(tmp_path):
+    pre = tmp_path / "pretrained"
+    pre.mkdir()
+    (pre / "classifier_gnb.it_0.pkl").write_bytes(b"x")
+    users = str(tmp_path / "users")
+
+    path, skip = workspace.create_user(users, str(pre), "u1", "mc")
+    assert not skip
+    # Crash before any state: directory is wiped and recreated.
+    (tmp_path / "users" / "u1" / "mc" / "junk").write_text("partial")
+    path2, skip2 = workspace.create_user(users, str(pre), "u1", "mc")
+    assert not skip2 and not os.path.exists(os.path.join(path2, "junk"))
+    # Crash with state: directory survives for the loop to resume.
+    al_state.ALState(1, [0.5], [], [], [[]], [0, 0], "uint32",
+                     "mc", 11).save(path2)
+    (tmp_path / "users" / "u1" / "mc" / "keepme").write_text("x")
+    path3, skip3 = workspace.create_user(users, str(pre), "u1", "mc")
+    assert not skip3 and os.path.exists(os.path.join(path3, "keepme"))
+    # DONE still short-circuits.
+    workspace.mark_done(path3)
+    _, skip4 = workspace.create_user(users, str(pre), "u1", "mc")
+    assert skip4
+
+
+def test_step_timer_records_phases(tmp_path, rng):
+    data = _make_user(rng, n_songs=16)
+    d = tmp_path / "u"
+    d.mkdir()
+    timer = StepTimer(str(tmp_path / "timings.jsonl"))
+    loop = ALLoop(ALConfig(queries=3, epochs=2, mode="mc", seed=11))
+    loop.run_user(_committee(np.random.default_rng(0), data), data, str(d),
+                  seed=11, timer=timer)
+    recs = [json.loads(l) for l in open(tmp_path / "timings.jsonl")]
+    assert len(recs) == 3  # epoch -1, 0, 1
+    assert recs[0]["epoch"] == -1 and "evaluate_s" in recs[0]
+    for r in recs[1:]:
+        for phase in ("score_s", "select_s", "update_host_s", "evaluate_s",
+                      "checkpoint_s"):
+            assert phase in r, r
