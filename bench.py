@@ -122,13 +122,15 @@ def build_xla_impl(x, w, b, k: int):
 
 
 def build_pallas_impl(x, w, b, k: int, tile_n: int, fuse_topk: bool = False):
-    """Pre-packed pool + the hand-fused Pallas kernel.  Single-chip only:
-    a multi-chip (shard_map-wrapped) variant of the kernel is not
-    implemented — on multi-device hosts ``--impl auto`` uses the sharded
-    XLA path.  Frames are lane-packed (``auto_pack``) so every matmul/VPU
-    op fills the full 128-lane vreg."""
+    """Pre-packed pool + the hand-fused Pallas kernel.  On a single chip the
+    kernel runs directly; on a multi-chip mesh it runs per pool shard under
+    ``shard_map`` with an O(k·D) candidate merge
+    (``parallel.sharding.make_shardmap_pallas_mc_scorer``).  Frames are
+    lane-packed (``auto_pack``) so every matmul/VPU op fills the full
+    128-lane vreg."""
     import jax
     import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from consensus_entropy_tpu.ops.pallas_scoring import (
         auto_pack,
@@ -137,27 +139,56 @@ def build_pallas_impl(x, w, b, k: int, tile_n: int, fuse_topk: bool = False):
         score_mc_linear_fused,
     )
     from consensus_entropy_tpu.ops.scoring import ScoreResult
+    from consensus_entropy_tpu.parallel.mesh import POOL_AXIS, make_pool_mesh
+    from consensus_entropy_tpu.parallel.sharding import (
+        make_shardmap_pallas_mc_scorer,
+    )
 
     n_members, n_pool = w.shape[0], x.shape[0]
     n_frames, n_class = x.shape[1], w.shape[2]
+    n_dev = len(jax.devices())
     pack = auto_pack(n_frames, n_members, n_class)
     x_tiles, _ = pack_pool(x, tile_n, pack)
     w_p, b_p = pack_weights(w, b, pack)
     n_eff = n_members * pack
+    # Pad the tile axis to a device multiple (padding tiles are all-masked).
+    n_tiles = x_tiles.shape[0]
+    n_tiles_pad = -(-n_tiles // n_dev) * n_dev
+    if n_tiles_pad != n_tiles:
+        x_tiles = np.pad(np.asarray(x_tiles),
+                         ((0, n_tiles_pad - n_tiles),) + ((0, 0),) * 3)
     _log(f"[pallas] frame packing x{pack}: {n_eff * n_class} lanes, "
-         f"{n_frames // pack} matmuls/tile, tile_n={tile_n}")
-    n_rows = x_tiles.shape[0] * x_tiles.shape[2]
+         f"{n_frames // pack} matmuls/tile, tile_n={tile_n}, "
+         f"{n_tiles_pad} tiles / {n_dev} device(s)")
+    n_rows = n_tiles_pad * tile_n
     mask = np.zeros(n_rows, bool)
     mask[:n_pool] = True
-    args = (jax.device_put(jnp.asarray(x_tiles)), jnp.asarray(w_p),
-            jnp.asarray(b_p), jnp.asarray(mask))
 
-    def iteration(args, eps):
-        x_tiles, w_packed, b_packed, mask = args
-        ent, values, indices = score_mc_linear_fused(
-            x_tiles, w_packed + eps * 0.0, b_packed, mask,
-            n_members=n_eff, k=k, fuse_topk=fuse_topk)
-        return ScoreResult(ent, values, indices)
+    if n_dev == 1:
+        args = (jax.device_put(jnp.asarray(x_tiles)), jnp.asarray(w_p),
+                jnp.asarray(b_p), jnp.asarray(mask))
+
+        def iteration(args, eps):
+            x_tiles, w_packed, b_packed, mask = args
+            ent, values, indices = score_mc_linear_fused(
+                x_tiles, w_packed + eps * 0.0, b_packed, mask,
+                n_members=n_eff, k=k, fuse_topk=fuse_topk)
+            return ScoreResult(ent, values, indices)
+    else:
+        mesh = make_pool_mesh()
+        tiles_s = NamedSharding(mesh, P(POOL_AXIS, None, None, None))
+        rows_s = NamedSharding(mesh, P(POOL_AXIS))
+        repl = NamedSharding(mesh, P())
+        args = (jax.device_put(jnp.asarray(x_tiles), tiles_s),
+                jax.device_put(jnp.asarray(w_p), repl),
+                jax.device_put(jnp.asarray(b_p), repl),
+                jax.device_put(jnp.asarray(mask), rows_s))
+        scorer = make_shardmap_pallas_mc_scorer(mesh, n_members=n_eff, k=k,
+                                                fuse_topk=fuse_topk)
+
+        def iteration(args, eps):
+            x_tiles, w_packed, b_packed, mask = args
+            return scorer(x_tiles, w_packed + eps * 0.0, b_packed, mask)
 
     return args, iteration
 
@@ -267,15 +298,20 @@ def main(argv=None) -> int:
         impls["xla"] = build_xla_impl(x, w, b, args_ns.k)
     if args_ns.impl in ("auto", "pallas"):
         devices = jax.devices()
-        if len(devices) == 1 and devices[0].platform == "tpu":
+        if devices[0].platform == "tpu":
             impls["pallas"] = build_pallas_impl(x, w, b, args_ns.k,
                                                 args_ns.tile_n,
                                                 args_ns.fuse_topk)
+            if (args_ns.impl == "auto" and not args_ns.fuse_topk
+                    and len(devices) == 1):
+                # auto also races the in-kernel top-k variant; which wins
+                # depends on pool size vs the XLA sort cost.  (The multi-
+                # chip path always fuses top-k for the candidate merge.)
+                impls["pallas-fusedtopk"] = build_pallas_impl(
+                    x, w, b, args_ns.k, args_ns.tile_n, True)
         else:
-            _log("[pallas] skipped: needs a single TPU device (found "
-                 f"{len(devices)} x {devices[0].platform}; the kernel is "
-                 "Mosaic-only and has no multi-chip variant — the sharded "
-                 "XLA path covers multi-device runs)")
+            _log(f"[pallas] skipped: Mosaic kernels need TPU devices "
+                 f"(found {devices[0].platform})")
             if args_ns.impl == "pallas":
                 _log("nothing to run for --impl pallas on this host")
                 return 1

@@ -67,6 +67,18 @@ def make_sharded_scoring_fns(mesh: Mesh, *, k: int, tie_break: str = "fast"):
     return {"mc": mc, "hc": hc, "mix": mix, "rand": rand}
 
 
+def _merge_local_topk(v, i, local_n: int, k: int):
+    """Shared candidate merge: globalize local indices, all_gather the k
+    candidates per chip over ICI (O(k·D) traffic), final replicated top-k.
+    Tiles/rows are gathered in shard order and ``lax.top_k`` is index-stable,
+    so ties resolve to the lowest global index."""
+    gi = i + lax.axis_index(POOL_AXIS) * local_n
+    vg = lax.all_gather(v, POOL_AXIS, tiled=True)
+    ig = lax.all_gather(gi, POOL_AXIS, tiled=True)
+    vv, j = lax.top_k(vg, k)
+    return vv, jnp.take(ig, j)
+
+
 def make_shardmap_mc_scorer(mesh: Mesh, *, k: int):
     """Explicit-collective mc scorer: local top-k → all_gather → global top-k.
 
@@ -80,14 +92,9 @@ def make_shardmap_mc_scorer(mesh: Mesh, *, k: int):
     def _local(probs_local, mask_local):
         consensus = consensus_mean(probs_local)
         ent_local = masked_entropy(consensus, mask_local)
-        local_n = ent_local.shape[0]
         v, i = lax.top_k(ent_local, k)
-        gi = i + lax.axis_index(POOL_AXIS) * local_n
-        # O(k·D) ICI traffic instead of all-gathering the full entropy vector.
-        vg = lax.all_gather(v, POOL_AXIS, tiled=True)
-        ig = lax.all_gather(gi, POOL_AXIS, tiled=True)
-        vv, j = lax.top_k(vg, k)
-        return ent_local, vv, jnp.take(ig, j)
+        vv, gi = _merge_local_topk(v, i, ent_local.shape[0], k)
+        return ent_local, vv, gi
 
     smapped = shard_map(
         _local, mesh=mesh,
@@ -101,6 +108,49 @@ def make_shardmap_mc_scorer(mesh: Mesh, *, k: int):
         return ScoreResult(ent, values, indices)
 
     del n_shards
+    return scorer
+
+
+def make_shardmap_pallas_mc_scorer(mesh: Mesh, *, n_members: int, k: int,
+                                   fuse_topk: bool = True,
+                                   interpret: bool = False):
+    """Multi-chip variant of the hand-fused Pallas scorer
+    (``ops.pallas_scoring``): each chip runs the Mosaic kernel on its own
+    contiguous block of pool tiles, ranks its local candidates (in-kernel
+    when ``fuse_topk``, else one local XLA ``lax.top_k`` — relative speed is
+    pool-size dependent, see ``ops.pallas_scoring``), then the ``k``
+    per-chip candidates merge via ``all_gather`` + a tiny replicated top-k —
+    identical O(k·D) ICI pattern to :func:`make_shardmap_mc_scorer`, with
+    the member forward fused too.
+
+    Returns ``scorer(x_tiles, w_packed, b_packed, pool_mask) -> ScoreResult``
+    for a ``pack_pool``-packed pool whose tile count divides the mesh's pool
+    axis.  Tie semantics are 'fast' (lowest global index wins).  ``interpret``
+    runs the kernel in the Pallas interpreter (CPU-mesh tests).
+    """
+    from consensus_entropy_tpu.ops import pallas_scoring
+
+    def _local(x_tiles_local, w_packed, b_packed, mask_local):
+        ent, v, i = pallas_scoring.packed_score_mc(
+            x_tiles_local, w_packed, b_packed, mask_local,
+            n_members=n_members, k=k, fuse_topk=fuse_topk,
+            interpret=interpret)
+        vv, gi = _merge_local_topk(v, i, mask_local.shape[0], k)
+        return ent, vv, gi
+
+    smapped = shard_map(
+        _local, mesh=mesh,
+        in_specs=(P(POOL_AXIS, None, None, None), P(None, None), P(None),
+                  P(POOL_AXIS)),
+        out_specs=(P(POOL_AXIS), P(), P()),
+        check_vma=False)
+
+    @jax.jit
+    def scorer(x_tiles, w_packed, b_packed, pool_mask) -> ScoreResult:
+        ent, values, indices = smapped(x_tiles, w_packed, b_packed,
+                                       pool_mask)
+        return ScoreResult(ent, values, indices)
+
     return scorer
 
 

@@ -167,3 +167,34 @@ def test_member_far_below_committee_max(rng):
                                                   interpret=True)
     np.testing.assert_allclose(np.asarray(ent), _oracle_entropy(x, w, b),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_shardmap_pallas_scorer_matches_single_device(rng):
+    # The multi-chip Pallas path (kernel per pool shard + all_gather merge)
+    # must reproduce the single-device fused scorer on an 8-way mesh.
+    from consensus_entropy_tpu.parallel.mesh import make_pool_mesh
+    from consensus_entropy_tpu.parallel.sharding import (
+        make_shardmap_pallas_mc_scorer,
+    )
+
+    x, w, b = _make_problem(rng, m=3, n=128, k_frames=2)
+    x_tiles, _ = pallas_scoring.pack_pool(x, tile_n=8)   # 16 tiles / 8 chips
+    w_p, b_p = pallas_scoring.pack_weights(w, b)
+    mask = np.ones(128, bool)
+    mask[100:] = False
+
+    mesh = make_pool_mesh()
+    ent1, v1, i1 = pallas_scoring.packed_score_mc(
+        x_tiles, w_p, b_p, mask, n_members=3, k=6, fuse_topk=True,
+        interpret=True)
+    for fuse in (True, False):
+        scorer = make_shardmap_pallas_mc_scorer(mesh, n_members=3, k=6,
+                                                fuse_topk=fuse,
+                                                interpret=True)
+        res = scorer(x_tiles, w_p, b_p, mask)
+        np.testing.assert_allclose(np.asarray(res.entropy), np.asarray(ent1),
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(res.indices),
+                                      np.asarray(i1))
+        np.testing.assert_allclose(np.asarray(res.values), np.asarray(v1),
+                                   rtol=1e-6)
