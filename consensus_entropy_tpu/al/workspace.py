@@ -77,7 +77,8 @@ def mark_done(path: str) -> None:
 
 
 def load_committee(path: str, config: CNNConfig = CNNConfig(),
-                   train_config: TrainConfig = TrainConfig()) -> Committee:
+                   train_config: TrainConfig = TrainConfig(),
+                   *, device_members: bool = False) -> Committee:
     """Load every model file in a workspace into a Committee.
 
     File naming (written by ``Committee.save``):
@@ -103,7 +104,8 @@ def load_committee(path: str, config: CNNConfig = CNNConfig(),
                 host.append(GenericSklearnMember.load(full))
     if not host and not cnns:
         raise FileNotFoundError(f"no committee members in {path}")
-    return Committee(host, cnns, config, train_config)
+    return Committee(host, cnns, config, train_config,
+                     device_members=device_members)
 
 
 def _load_boosted(path: str) -> Member:

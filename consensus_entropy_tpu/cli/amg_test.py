@@ -40,6 +40,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-dir", default=None,
                    help="write a jax.profiler device trace here "
                         "(TensorBoard-loadable)")
+    p.add_argument("--device-members", action="store_true",
+                   help="run GNB/SGD member inference on device (jnp, fused "
+                        "with the frame->song mean) instead of sklearn")
     add_path_args(p)
     add_device_arg(p)
     return p
@@ -94,7 +97,8 @@ def main(argv=None) -> int:
         if skip:
             print(f"Skipping user {u_id}, already exists!")
             continue
-        committee = workspace.load_committee(user_path, cnn_cfg)
+        committee = workspace.load_committee(
+            user_path, cnn_cfg, device_members=args.device_members)
         sub_pool, labels = amg.user_pool(pool, anno, u_id)
         hc_rows = hc_table.reindex(sub_pool.song_ids).to_numpy(np.float32)
         data = UserData(u_id, sub_pool, labels, hc_rows=hc_rows, store=store)

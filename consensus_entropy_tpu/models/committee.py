@@ -112,15 +112,24 @@ class CNNMember(Member):
 
 
 class Committee:
-    """The user's private committee: M_host sklearn + M_cnn Flax members."""
+    """The user's private committee: M_host sklearn + M_cnn Flax members.
+
+    ``device_members=True`` moves GNB/SGD *inference* on device too
+    (``ops.device_members``): their closed-form probability math runs as
+    jnp inside one jit with the frame→song segment mean, so only boosted
+    trees (and any generic registry members) remain on host.  Training
+    (``partial_fit``) stays in sklearn either way.
+    """
 
     def __init__(self, host_members: list[Member],
                  cnn_members: list[CNNMember],
                  config: CNNConfig = CNNConfig(),
-                 train_config: TrainConfig = TrainConfig()):
+                 train_config: TrainConfig = TrainConfig(),
+                 *, device_members: bool = False):
         self.host_members = host_members
         self.cnn_members = cnn_members
         self.config = config
+        self.device_members = device_members
         self.trainer = CNNTrainer(config, train_config)
         self._infer = jax.jit(
             lambda stacked, x: short_cnn.committee_infer(stacked, x,
@@ -157,13 +166,104 @@ class Committee:
             assert pool is not None
             rowmap = {s: i for i, s in enumerate(pool.song_ids)}
             sel = np.array([rowmap[s] for s in song_ids])
-            host = np.empty((len(self.host_members), len(song_ids),
-                             NUM_CLASSES), np.float32)
-            for i, m in enumerate(self.host_members):
+            on_device, on_host = self._split_members()
+            dev_block = None
+            if on_device["gnb"] or on_device["sgd"]:
+                # Dispatch the device slice FIRST (async) so the remaining
+                # host members compute while the TPU runs.
+                dev_block = self._device_member_probs(pool, on_device)[:, sel]
+            host_np = np.empty((len(on_host), len(song_ids), NUM_CLASSES),
+                               np.float32)
+            for slot, (_, m) in enumerate(on_host):
                 frame_p = m.predict_proba(pool.X)
-                host[i] = pool.mean_by_song(frame_p)[sel]
-            blocks.append(jnp.asarray(host))
+                host_np[slot] = pool.mean_by_song(frame_p)[sel]
+            if dev_block is None:
+                blocks.append(jnp.asarray(host_np))  # one H2D transfer
+            else:
+                # Merge device slice + one host buffer back into committee
+                # member order via a permutation gather on device.
+                combined = jnp.concatenate(
+                    [dev_block, jnp.asarray(host_np)], axis=0)
+                order = np.empty(len(self.host_members), np.int32)
+                for slot, (i, _) in enumerate(on_device["gnb"]
+                                              + on_device["sgd"]):
+                    order[i] = slot
+                n_dev = len(on_device["gnb"]) + len(on_device["sgd"])
+                for slot, (i, _) in enumerate(on_host):
+                    order[i] = n_dev + slot
+                blocks.append(jnp.take(combined, jnp.asarray(order), axis=0))
         return jnp.concatenate(blocks, axis=0) if len(blocks) > 1 else blocks[0]
+
+    # -- device-side GNB/SGD inference (ops.device_members) ----------------
+
+    def _split_members(self):
+        """Partition host members into device-representable GNB/SGD slices
+        and the host remainder (trees, generic registry members, anything
+        not fitted on the full class universe)."""
+        from sklearn.linear_model import SGDClassifier
+        from sklearn.naive_bayes import GaussianNB
+
+        out = {"gnb": [], "sgd": []}
+        rest = []
+        if not self.device_members:
+            return out, list(enumerate(self.host_members))
+        for i, m in enumerate(self.host_members):
+            est = getattr(m, "estimator", None)
+            full = (est is not None
+                    and np.array_equal(getattr(est, "classes_", ()),
+                                       np.arange(NUM_CLASSES)))
+            if full and isinstance(est, GaussianNB):
+                out["gnb"].append((i, est))
+            elif (full and isinstance(est, SGDClassifier)
+                  and est.loss == "log_loss"
+                  and est.coef_.shape[0] == NUM_CLASSES):
+                out["sgd"].append((i, est))
+            else:
+                rest.append((i, m))
+        return out, rest
+
+    def _device_member_probs(self, pool: FramePool, on_device) -> jnp.ndarray:
+        """(G+S, n_songs, C) per-song means for the device slice, one jit.
+
+        The compiled scorer AND the device-resident float32 copy of the
+        (static) pool features are cached ON the pool object, so their
+        lifetime is the pool's (no id-reuse aliasing) and the per-iteration
+        cost is just the few-KB parameter transfer.
+        """
+        from consensus_entropy_tpu.ops.device_members import (
+            make_device_committee_scorer,
+        )
+
+        cache = getattr(pool, "_ce_device_cache", None)
+        if cache is None:
+            frame_song = np.repeat(np.arange(pool.n_songs), pool.counts)
+            cache = {
+                "scorer": make_device_committee_scorer(frame_song,
+                                                       pool.n_songs),
+                "x_dev": jnp.asarray(
+                    np.asarray(pool.X, dtype=np.float32)),
+            }
+            pool._ce_device_cache = cache
+        scorer, x_dev = cache["scorer"], cache["x_dev"]
+        n_feat = pool.X.shape[1]
+        gnb = [e for _, e in on_device["gnb"]]
+        sgd = [e for _, e in on_device["sgd"]]
+        gnb_theta = np.stack([e.theta_ for e in gnb]) if gnb else \
+            np.zeros((0, NUM_CLASSES, n_feat))
+        gnb_var = np.stack([e.var_ for e in gnb]) if gnb else \
+            np.zeros((0, NUM_CLASSES, n_feat))
+        gnb_lp = np.stack([np.log(e.class_prior_) for e in gnb]) if gnb else \
+            np.zeros((0, NUM_CLASSES))
+        sgd_coef = np.stack([e.coef_ for e in sgd]) if sgd else \
+            np.zeros((0, NUM_CLASSES, n_feat))
+        sgd_int = np.stack([e.intercept_ for e in sgd]) if sgd else \
+            np.zeros((0, NUM_CLASSES))
+        return scorer(x_dev,
+                      gnb_theta.astype(np.float32),
+                      gnb_var.astype(np.float32),
+                      gnb_lp.astype(np.float32),
+                      sgd_coef.astype(np.float32),
+                      sgd_int.astype(np.float32))
 
     def update_host(self, X_batch: np.ndarray, y_batch: np.ndarray):
         """Incremental update of every host member (``amg_test.py:503-509``)."""

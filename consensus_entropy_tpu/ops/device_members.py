@@ -1,0 +1,115 @@
+"""On-device classic committee members: GNB + SGD-logistic as jnp math.
+
+The reference's scoring hot loop calls each sklearn member's
+``predict_proba`` per frame on host, then pandas-groupbys per song
+(``amg_test.py:428-438``).  Both paper members that support ``partial_fit``
+are closed-form probabilistic models, so their *inference* needs no sklearn
+at all — it is pure array math that XLA fuses straight into the consensus
+reduction:
+
+- **GaussianNB**: joint log-likelihood ``log prior + Σ_f log N(x_f; θ, σ²)``
+  normalized with a stable softmax — identical math to sklearn's
+  ``_joint_log_likelihood`` + ``logsumexp`` normalization.
+- **SGD-logistic (multiclass)**: sklearn is one-vs-all — per-class sigmoid
+  of the decision function, then L1 row normalization (NOT a softmax).
+
+Training (``partial_fit``) stays on host in sklearn: it runs on tiny
+q-song batches once per AL iteration, while inference runs over the whole
+pool — only the latter is worth the device.  Parameters are re-extracted
+from the fitted estimators each scoring pass (a few KB), so one compiled
+graph serves every iteration of every user.
+
+Frame→song aggregation uses ``jax.ops.segment_sum`` over a static segment
+layout (the pool's frame→song map is fixed per user), replacing the pandas
+groupby with an on-device reduction that feeds ``ops.scoring`` without a
+host round-trip.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def gnb_log_likelihood(x, theta, var, log_prior):
+    """Per-class joint log-likelihood of GaussianNB.
+
+    x: ``(N, F)``; theta/var: ``(C, F)``; log_prior: ``(C,)`` -> ``(N, C)``.
+    """
+    x = jnp.asarray(x)
+    theta = jnp.asarray(theta)
+    var = jnp.asarray(var)
+    const = log_prior - 0.5 * jnp.sum(jnp.log(2.0 * jnp.pi * var), axis=1)
+    # Expanded Mahalanobis sum: Σ_f (x-θ)²/σ² = x²·(1/σ²) − 2x·(θ/σ²) +
+    # Σ θ²/σ² — three MXU matmuls instead of an (N, C, F) broadcast.
+    inv_var = 1.0 / var
+    mahal = ((x * x) @ inv_var.T
+             - 2.0 * (x @ (theta * inv_var).T)
+             + jnp.sum(theta * theta * inv_var, axis=1)[None, :])
+    return const[None, :] - 0.5 * mahal
+
+
+def gnb_probs(x, theta, var, log_prior):
+    """GaussianNB posterior probabilities (softmax of the JLL)."""
+    return jax.nn.softmax(gnb_log_likelihood(x, theta, var, log_prior),
+                          axis=-1)
+
+
+def ova_sigmoid_probs(x, coef, intercept):
+    """sklearn OvA ``SGDClassifier(loss='log_loss')`` predict_proba:
+    per-class sigmoid of ``x @ coef.T + intercept``, L1-normalized rows
+    (uniform fallback for all-zero rows, as sklearn's normalizer yields).
+
+    x: ``(N, F)``; coef: ``(C, F)``; intercept: ``(C,)`` -> ``(N, C)``.
+    """
+    logits = jnp.asarray(x) @ jnp.asarray(coef).T + jnp.asarray(intercept)
+    p = jax.nn.sigmoid(logits)
+    s = jnp.sum(p, axis=-1, keepdims=True)
+    n_class = p.shape[-1]
+    return jnp.where(s > 0, p / jnp.where(s > 0, s, 1.0), 1.0 / n_class)
+
+
+def linear_softmax_probs(x, coef, intercept):
+    """Multinomial-logistic probabilities (the bench's member form)."""
+    return jax.nn.softmax(
+        jnp.asarray(x) @ jnp.asarray(coef).T + jnp.asarray(intercept),
+        axis=-1)
+
+
+def make_device_committee_scorer(frame_song_index, n_songs: int):
+    """Compile a scorer for the device-representable committee slice.
+
+    ``frame_song_index``: ``(n_frames,)`` int array mapping each pool frame
+    to its song row (static per user — baked into the jit graph).  Returns
+
+        ``score(x_frames, gnb_theta, gnb_var, gnb_log_prior,
+                sgd_coef, sgd_intercept) -> (G + S, n_songs, C)``
+
+    per-member per-song mean probabilities (GNB members first, then SGD, in
+    the order of the stacked parameter arrays; either stack may be empty on
+    its leading axis).  One XLA program: member math is ``vmap``'d, the
+    frame→song mean is a pair of ``segment_sum``s — the device analogue of
+    ``groupby('s_id').mean()`` (``amg_test.py:437``).
+    """
+    seg = jnp.asarray(np.asarray(frame_song_index), jnp.int32)
+
+    @jax.jit
+    def score(x_frames, gnb_theta, gnb_var, gnb_log_prior, sgd_coef,
+              sgd_intercept):
+        x_frames = jnp.asarray(x_frames)
+        gnb_frame = jax.vmap(
+            lambda t, v, lp: gnb_probs(x_frames, t, v, lp))(
+                gnb_theta, gnb_var, gnb_log_prior)
+        sgd_frame = jax.vmap(
+            lambda c, b: ova_sigmoid_probs(x_frames, c, b))(
+                sgd_coef, sgd_intercept)
+        frame_probs = jnp.concatenate([gnb_frame, sgd_frame], axis=0)
+        sums = jax.ops.segment_sum(
+            jnp.moveaxis(frame_probs, 0, 1), seg, num_segments=n_songs)
+        counts = jax.ops.segment_sum(
+            jnp.ones((seg.shape[0],), frame_probs.dtype), seg,
+            num_segments=n_songs)
+        return jnp.moveaxis(sums, 0, 1) / counts[None, :, None]
+
+    return score

@@ -1,0 +1,124 @@
+"""Device-side GNB/SGD member inference vs sklearn, and the Committee's
+device-slice scoring path vs its host path."""
+
+import numpy as np
+import pytest
+from sklearn.linear_model import SGDClassifier
+from sklearn.naive_bayes import GaussianNB
+
+from consensus_entropy_tpu.models.committee import Committee, FramePool
+from consensus_entropy_tpu.models.sklearn_members import (
+    BoostedTreesMember,
+    GNBMember,
+    SGDMember,
+)
+from consensus_entropy_tpu.ops import device_members
+
+
+@pytest.fixture
+def problem(rng):
+    X = rng.standard_normal((300, 12)).astype(np.float32)
+    y = rng.integers(0, 4, 300)
+    return X, y
+
+
+def test_gnb_parity_with_sklearn(problem):
+    X, y = problem
+    est = GaussianNB().fit(X, y)
+    got = np.asarray(device_members.gnb_probs(
+        X, est.theta_.astype(np.float32), est.var_.astype(np.float32),
+        np.log(est.class_prior_).astype(np.float32)))
+    np.testing.assert_allclose(got, est.predict_proba(X), rtol=1e-3,
+                               atol=1e-5)
+
+
+def test_sgd_ova_parity_with_sklearn(problem):
+    X, y = problem
+    est = SGDClassifier(loss="log_loss", random_state=0).fit(X, y)
+    got = np.asarray(device_members.ova_sigmoid_probs(
+        X, est.coef_.astype(np.float32), est.intercept_.astype(np.float32)))
+    np.testing.assert_allclose(got, est.predict_proba(X), rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_segment_scorer_matches_pandas_groupby(rng, problem):
+    import pandas as pd
+
+    X, y = problem
+    gnb = GaussianNB().fit(X, y)
+    sgd = SGDClassifier(loss="log_loss", random_state=0).fit(X, y)
+    seg = np.sort(rng.integers(0, 40, 300))
+    scorer = device_members.make_device_committee_scorer(seg, 40)
+    out = np.asarray(scorer(
+        X,
+        gnb.theta_[None].astype(np.float32),
+        gnb.var_[None].astype(np.float32),
+        np.log(gnb.class_prior_)[None].astype(np.float32),
+        sgd.coef_[None].astype(np.float32),
+        sgd.intercept_[None].astype(np.float32)))
+    assert out.shape == (2, 40, 4)
+    want_g = pd.DataFrame(gnb.predict_proba(X)).groupby(seg).mean().to_numpy()
+    want_s = pd.DataFrame(sgd.predict_proba(X)).groupby(seg).mean().to_numpy()
+    np.testing.assert_allclose(out[0], want_g, rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(out[1], want_s, rtol=1e-4, atol=1e-6)
+
+
+def test_empty_member_slices(rng):
+    X = rng.standard_normal((20, 5)).astype(np.float32)
+    scorer = device_members.make_device_committee_scorer(
+        np.repeat(np.arange(4), 5), 4)
+    out = scorer(X,
+                 np.zeros((0, 4, 5), np.float32),
+                 np.zeros((0, 4, 5), np.float32),
+                 np.zeros((0, 4), np.float32),
+                 np.zeros((0, 4, 5), np.float32),
+                 np.zeros((0, 4), np.float32))
+    assert out.shape == (0, 4, 4)
+
+
+def _fitted_committee(rng, X, y, device_members_flag):
+    members = [GNBMember("gnb.it_0").fit(X, y),
+               SGDMember("sgd.it_0", seed=0).fit(X, y),
+               BoostedTreesMember("xgb.it_0", n_estimators=5, seed=0).fit(
+                   X, y)]
+    return Committee(members, [], device_members=device_members_flag)
+
+
+def test_committee_device_path_matches_host_path(rng, problem):
+    X, y = problem
+    frame_song = np.repeat([f"s{i:02d}" for i in range(30)], 10)
+    pool = FramePool(X, frame_song)
+    y_by_song = y[::10]
+    yf = np.repeat(y_by_song, 10)
+
+    host_c = _fitted_committee(np.random.default_rng(0), X, yf, False)
+    dev_c = _fitted_committee(np.random.default_rng(0), X, yf, True)
+
+    songs = pool.song_ids[3:25]
+    p_host = np.asarray(host_c.pool_probs(pool, None, songs, None))
+    p_dev = np.asarray(dev_c.pool_probs(pool, None, songs, None))
+    assert p_host.shape == p_dev.shape == (3, 22, 4)
+    # member order preserved (gnb, sgd, xgb); numerics agree to f32
+    np.testing.assert_allclose(p_dev, p_host, rtol=1e-3, atol=1e-5)
+    # the scorer + device-resident features are cached on the pool itself
+    cache = pool._ce_device_cache
+    dev_c.pool_probs(pool, None, songs, None)
+    assert pool._ce_device_cache is cache
+
+
+def test_device_path_after_partial_fit(rng, problem):
+    # Params are re-extracted each pass, so partial_fit updates must be
+    # reflected without recompilation.
+    X, y = problem
+    frame_song = np.repeat(np.arange(30), 10)
+    pool = FramePool(X, frame_song)
+    yf = np.repeat(y[::10], 10)
+    c = _fitted_committee(np.random.default_rng(0), X, yf, True)
+    before = np.asarray(c.pool_probs(pool, None, pool.song_ids, None))
+    c.update_host(X[:40], yf[:40])
+    after = np.asarray(c.pool_probs(pool, None, pool.song_ids, None))
+    assert not np.allclose(before[:2], after[:2])  # gnb+sgd moved
+    # parity with the freshly-updated sklearn estimators
+    for i, m in enumerate(c.host_members[:2]):
+        want = pool.mean_by_song(m.estimator.predict_proba(pool.X))
+        np.testing.assert_allclose(after[i], want, rtol=1e-3, atol=1e-5)
