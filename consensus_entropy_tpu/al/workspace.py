@@ -78,7 +78,8 @@ def mark_done(path: str) -> None:
 
 def load_committee(path: str, config: CNNConfig = CNNConfig(),
                    train_config: TrainConfig = TrainConfig(),
-                   *, device_members: bool = False) -> Committee:
+                   *, device_members: bool = False,
+                   full_song_hop: int | None = None) -> Committee:
     """Load every model file in a workspace into a Committee.
 
     File naming (written by ``Committee.save``):
@@ -105,7 +106,8 @@ def load_committee(path: str, config: CNNConfig = CNNConfig(),
     if not host and not cnns:
         raise FileNotFoundError(f"no committee members in {path}")
     return Committee(host, cnns, config, train_config,
-                     device_members=device_members)
+                     device_members=device_members,
+                     full_song_hop=full_song_hop)
 
 
 def _load_boosted(path: str) -> Member:

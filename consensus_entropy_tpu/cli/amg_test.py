@@ -43,6 +43,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--device-members", action="store_true",
                    help="run GNB/SGD member inference on device (jnp, fused "
                         "with the frame->song mean) instead of sklearn")
+    p.add_argument("--full-song-hop", type=int, default=None, metavar="HOP",
+                   help="CNN members score each song as the deterministic "
+                        "mean over stride-HOP windows covering the whole "
+                        "waveform, instead of one random crop per pass")
     add_path_args(p)
     add_device_arg(p)
     return p
@@ -98,7 +102,8 @@ def main(argv=None) -> int:
             print(f"Skipping user {u_id}, already exists!")
             continue
         committee = workspace.load_committee(
-            user_path, cnn_cfg, device_members=args.device_members)
+            user_path, cnn_cfg, device_members=args.device_members,
+            full_song_hop=args.full_song_hop)
         sub_pool, labels = amg.user_pool(pool, anno, u_id)
         hc_rows = hc_table.reindex(sub_pool.song_ids).to_numpy(np.float32)
         data = UserData(u_id, sub_pool, labels, hc_rows=hc_rows, store=store)

@@ -66,6 +66,28 @@ class DeviceWaveformStore:
         return _sample_crops(self.data, self.lengths, rows, key,
                              self.input_length)
 
+    def n_windows(self, hop: int) -> int:
+        """Stride-grid window count at the store's max length."""
+        return (self.data.shape[1] - self.input_length) // int(hop) + 1
+
+    def window_batch(self, rows, hop: int):
+        """``(R, W, input_length)`` stride-``hop`` windows + ``(R, W)`` bool
+        validity (a window is valid iff fully inside its song — the
+        deterministic full-coverage grid of ``parallel.sequence``, batched
+        over songs instead of sharded within one).  Window 0 is always
+        valid (store guarantees length >= input_length)."""
+        rows = jnp.asarray(rows)
+        starts = jnp.arange(self.n_windows(hop), dtype=jnp.int32) * int(hop)
+
+        def one(row):
+            return jax.vmap(lambda s: jax.lax.dynamic_slice_in_dim(
+                self.data[row], s, self.input_length))(starts)
+
+        windows = jax.vmap(one)(rows)
+        valid = (starts[None, :] + self.input_length
+                 <= self.lengths[rows][:, None])
+        return windows, valid
+
 
 def _sample_crops(data, lengths, rows, key, input_length: int):
     u = jax.random.uniform(key, (rows.shape[0],))
@@ -108,3 +130,23 @@ class HostWaveformStore:
             start = int(np.floor(uj * (len(a) - self.input_length)))
             out[j] = a[start: start + self.input_length]
         return jnp.asarray(out)
+
+    def n_windows(self, hop: int) -> int:
+        max_len = max(len(a) for a in self._arrays)
+        return (max_len - self.input_length) // int(hop) + 1
+
+    def window_batch(self, rows, hop: int):
+        """Host-assembled equivalent of ``DeviceWaveformStore.window_batch``
+        (one H2D transfer for the whole batch)."""
+        rows = np.asarray(rows)
+        n_w = self.n_windows(hop)
+        out = np.zeros((len(rows), n_w, self.input_length), np.float32)
+        valid = np.zeros((len(rows), n_w), bool)
+        for j, r in enumerate(rows):
+            a = self._arrays[int(r)]
+            for w in range(n_w):
+                s = w * int(hop)
+                if s + self.input_length <= len(a):
+                    out[j, w] = a[s: s + self.input_length]
+                    valid[j, w] = True
+        return jnp.asarray(out), jnp.asarray(valid)

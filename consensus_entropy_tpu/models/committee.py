@@ -125,15 +125,37 @@ class Committee:
                  cnn_members: list[CNNMember],
                  config: CNNConfig = CNNConfig(),
                  train_config: TrainConfig = TrainConfig(),
-                 *, device_members: bool = False):
+                 *, device_members: bool = False,
+                 full_song_hop: int | None = None):
         self.host_members = host_members
         self.cnn_members = cnn_members
         self.config = config
         self.device_members = device_members
+        #: When set, CNN members score each song as the masked mean over
+        #: stride-``full_song_hop`` windows covering the whole waveform
+        #: (deterministic), instead of the reference's ONE random crop per
+        #: pass (``short_cnn.py:376-377`` — stochastic by design).
+        if full_song_hop is not None and not (
+                1 <= full_song_hop <= config.input_length):
+            raise ValueError(
+                f"full_song_hop must be in [1, input_length="
+                f"{config.input_length}], got {full_song_hop}")
+        self.full_song_hop = full_song_hop
         self.trainer = CNNTrainer(config, train_config)
         self._infer = jax.jit(
             lambda stacked, x: short_cnn.committee_infer(stacked, x,
                                                          self.config))
+        self._infer_windows = jax.jit(self._windows_forward)
+
+    def _windows_forward(self, stacked, windows, valid):
+        """(R, W, L) windows + (R, W) mask -> (M, R, C) masked window mean."""
+        r, w, length = windows.shape
+        flat = short_cnn.committee_infer(
+            stacked, windows.reshape(r * w, length), self.config)
+        probs = flat.reshape(flat.shape[0], r, w, flat.shape[-1])
+        weight = valid.astype(probs.dtype)
+        return (jnp.einsum("mrwc,rw->mrc", probs, weight)
+                / jnp.sum(weight, axis=1)[None, :, None])
 
     @property
     def size(self) -> int:
@@ -152,16 +174,19 @@ class Committee:
                    song_ids: Sequence, key) -> jnp.ndarray:
         """Stacked member probabilities ``(M, N, C)`` over ``song_ids``.
 
-        CNN rows first (committee order = member_names).  One random crop per
-        song per scoring pass, as the reference's batch-1 loader does
-        (``amg_test.py:378-382``) — committee entropy is stochastic across
-        passes by design (SURVEY.md §7 hard part 4).
+        CNN rows first (committee order = member_names).  Without
+        ``full_song_hop``: one random crop per song per scoring pass, as the
+        reference's batch-1 loader does (``amg_test.py:378-382``) —
+        committee entropy is stochastic across passes by design (SURVEY.md
+        §7 hard part 4).  With ``full_song_hop`` set the CNN block is the
+        deterministic window-grid mean instead.
         """
         blocks = []
         if self.cnn_members:
             assert store is not None
-            crops = store.sample_crops(key, store.row_of(song_ids))
-            blocks.append(self._infer(self._stacked(), crops))  # async
+            # async dispatch either way; full_song_hop swaps the reference's
+            # stochastic single crop for the deterministic window grid
+            blocks.append(self.predict_songs_cnn(store, song_ids, key))
         if self.host_members:
             assert pool is not None
             rowmap = {s: i for i, s in enumerate(pool.song_ids)}
@@ -284,10 +309,36 @@ class Committee:
             histories.append(hist)
         return histories
 
-    def predict_songs_cnn(self, store: DeviceWaveformStore, song_ids, key):
-        """Per-song CNN scores ``(M_cnn, n, C)`` for evaluation."""
-        crops = store.sample_crops(key, store.row_of(song_ids))
-        return self._infer(self._stacked(), crops)
+    def predict_songs_cnn(self, store: DeviceWaveformStore, song_ids, key,
+                          *, chunk: int = 8):
+        """Per-song CNN scores ``(M_cnn, n, C)``.
+
+        Default: one random crop per song (reference parity).  With
+        ``full_song_hop`` set: deterministic masked mean over the stride
+        grid, processed ``chunk`` songs at a time so the ``(chunk, W, L)``
+        window tensor bounds device memory.  Every batch (including the
+        last and any n < chunk call) is padded to exactly ``chunk`` rows,
+        so ONE program compiles per (chunk, W) shape.
+        """
+        rows = store.row_of(song_ids)
+        if self.full_song_hop is None:
+            return self._infer(self._stacked(), store.sample_crops(key, rows))
+        n = len(rows)
+        stacked = self._stacked()
+        if n == 0:
+            m = len(self.cnn_members)
+            return jnp.zeros((m, 0, self.config.n_class), jnp.float32)
+        blocks = []
+        for lo in range(0, n, chunk):
+            sel = rows[lo: lo + chunk]
+            pad = chunk - len(sel)
+            if pad:
+                sel = np.concatenate([sel, np.repeat(sel[-1:], pad)])
+            windows, valid = store.window_batch(sel, self.full_song_hop)
+            out = self._infer_windows(stacked, windows, valid)
+            blocks.append(out[:, : out.shape[1] - pad])
+        return jnp.concatenate(blocks, axis=1) if len(blocks) > 1 \
+            else blocks[0]
 
     # -- persistence -------------------------------------------------------
 

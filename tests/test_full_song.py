@@ -1,0 +1,123 @@
+"""Full-song (window-grid) committee scoring vs naive oracles — the
+deterministic replacement for the reference's one-random-crop-per-pass CNN
+scoring (short_cnn.py:376-377)."""
+
+import jax
+import numpy as np
+import pytest
+
+from consensus_entropy_tpu.config import CNNConfig
+from consensus_entropy_tpu.data.audio import (
+    DeviceWaveformStore,
+    HostWaveformStore,
+)
+from consensus_entropy_tpu.models.committee import CNNMember, Committee
+from consensus_entropy_tpu.models.short_cnn import (
+    apply_infer,
+    init_variables,
+)
+
+TINY = CNNConfig(n_channels=4, n_fft=64, hop_length=32, n_mels=16,
+                 n_layers=2, input_length=1024)
+
+
+@pytest.fixture(scope="module")
+def waves():
+    rng = np.random.default_rng(5)
+    return {f"s{i}": (rng.standard_normal(1024 + 700 * i) * 0.05
+                      ).astype(np.float32) for i in range(5)}
+
+
+@pytest.fixture(scope="module")
+def store(waves):
+    return DeviceWaveformStore(waves, TINY.input_length)
+
+
+def _naive_windows(wave, hop, length, n_w):
+    out, valid = np.zeros((n_w, length), np.float32), np.zeros(n_w, bool)
+    for w in range(n_w):
+        s = w * hop
+        if s + length <= len(wave):
+            out[w] = wave[s: s + length]
+            valid[w] = True
+    return out, valid
+
+
+def test_window_batch_matches_naive(store, waves):
+    hop = 512
+    rows = store.row_of(["s0", "s3", "s4"])
+    windows, valid = store.window_batch(rows, hop)
+    n_w = store.n_windows(hop)
+    assert windows.shape == (3, n_w, TINY.input_length)
+    for j, sid in enumerate(["s0", "s3", "s4"]):
+        want_w, want_v = _naive_windows(waves[sid], hop, TINY.input_length,
+                                        n_w)
+        np.testing.assert_array_equal(np.asarray(valid)[j], want_v)
+        np.testing.assert_array_equal(
+            np.asarray(windows)[j][want_v], want_w[want_v])
+    assert np.asarray(valid)[:, 0].all()  # window 0 always valid
+
+
+def test_host_store_window_batch_matches_device(tmp_path, waves, store):
+    for sid, w in waves.items():
+        np.save(tmp_path / f"{sid}.npy", w)
+    host = HostWaveformStore(str(tmp_path), list(waves), TINY.input_length)
+    rows_d = store.row_of(["s1", "s4"])
+    rows_h = host.row_of(["s1", "s4"])
+    wd, vd = store.window_batch(rows_d, 300)
+    wh, vh = host.window_batch(rows_h, 300)
+    np.testing.assert_array_equal(np.asarray(vd), np.asarray(vh))
+    np.testing.assert_array_equal(
+        np.asarray(wd)[np.asarray(vd)], np.asarray(wh)[np.asarray(vh)])
+
+
+def _cnn_committee(hop, n_members=2):
+    members = [CNNMember(f"it_{i}",
+                         init_variables(jax.random.key(i), TINY, 2), TINY)
+               for i in range(n_members)]
+    return Committee([], members, TINY, full_song_hop=hop)
+
+
+def test_full_song_scores_match_window_mean_oracle(store, waves):
+    hop = 512
+    committee = _cnn_committee(hop)
+    got = np.asarray(committee.predict_songs_cnn(store, list(waves), None))
+    assert got.shape == (2, 5, TINY.n_class)
+    for mi, m in enumerate(committee.cnn_members):
+        for j, sid in enumerate(waves):
+            w, v = _naive_windows(waves[sid], hop, TINY.input_length,
+                                  store.n_windows(hop))
+            probs = np.asarray(apply_infer(m.variables, w[v], TINY))
+            np.testing.assert_allclose(got[mi, j], probs.mean(axis=0),
+                                       rtol=2e-4, atol=2e-6)
+
+
+def test_full_song_chunking_is_invariant(store, waves):
+    committee = _cnn_committee(512)
+    a = np.asarray(committee.predict_songs_cnn(store, list(waves), None,
+                                               chunk=2))
+    b = np.asarray(committee.predict_songs_cnn(store, list(waves), None,
+                                               chunk=100))
+    np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+
+
+def test_full_song_is_deterministic_and_flows_into_pool_probs(store, waves):
+    committee = _cnn_committee(512)
+    k1, k2 = jax.random.key(1), jax.random.key(2)
+    a = np.asarray(committee.pool_probs(None, store, list(waves), k1))
+    b = np.asarray(committee.pool_probs(None, store, list(waves), k2))
+    np.testing.assert_array_equal(a, b)  # no crop randomness
+    crops = _cnn_committee(None)
+    c = np.asarray(crops.pool_probs(None, store, list(waves), k1))
+    d = np.asarray(crops.pool_probs(None, store, list(waves), k2))
+    assert not np.array_equal(c, d)  # reference behavior stays stochastic
+
+
+def test_hop_validation_and_empty_song_list(store):
+    with pytest.raises(ValueError, match="full_song_hop"):
+        _cnn_committee(0)
+    with pytest.raises(ValueError, match="full_song_hop"):
+        _cnn_committee(TINY.input_length + 1)
+    committee = _cnn_committee(512)
+    out = committee.predict_songs_cnn(store, [], None)
+    assert out.shape == (2, 0, TINY.n_class)
