@@ -1,0 +1,97 @@
+"""Multi-host (DCN) execution support.
+
+The reference has no distributed backend at all (SURVEY.md §2: no
+NCCL/MPI/Gloo; single process, one optional GPU).  The TPU-native framework
+scales the same workload across pod slices with JAX's built-in runtime:
+inside one host collectives ride ICI; across hosts XLA routes them over DCN
+— no hand-written transport.  This module is the thin rim around that:
+
+- :func:`initialize` — `jax.distributed.initialize` from explicit arguments
+  or the environment (no-op for single-process runs, so every entry point
+  can call it unconditionally).
+- :func:`global_pool_mesh` — the 1-D pool mesh over every chip of every
+  host (`jax.devices()` orders devices process-major, so contiguous pool
+  blocks land host-local and the scoring reduction's only cross-host
+  traffic is the O(k·D) top-k candidate gather).
+- :func:`host_pool_slice` / :func:`distribute_pool` — each host feeds only
+  its own rows; `jax.make_array_from_process_local_data` assembles the
+  logically-global sharded array without any host ever materializing the
+  full pool.
+
+Single-process semantics are identical (the test suite exercises this on
+the 8-device virtual mesh); multi-process runs need only `initialize(...)`
+first — same code after that.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from consensus_entropy_tpu.parallel.mesh import POOL_AXIS
+
+
+_initialized = False
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Join (or skip joining) the distributed runtime.
+
+    With no arguments this is a no-op and the process stays single-host.
+    With cluster arguments it must run BEFORE any other jax API touches the
+    backend (``jax.distributed.initialize``'s own contract) — so this
+    function deliberately makes no jax queries on the way in; repeat calls
+    are tracked module-side and ignored.
+    """
+    global _initialized
+    if coordinator_address is None and num_processes is None:
+        return  # single-process run: nothing to join
+    if _initialized:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+
+
+def global_pool_mesh() -> Mesh:
+    """1-D ``pool`` mesh over every addressable chip of every host."""
+    return Mesh(np.asarray(jax.devices()), (POOL_AXIS,))
+
+
+def host_pool_slice(n_rows: int) -> slice:
+    """The contiguous row range this host is responsible for feeding
+    (depends only on process count/index — `jax.devices()` is
+    process-major, so contiguous row blocks are host-local under the pool
+    mesh).
+
+    ``n_rows`` must divide evenly across hosts (the fixed-shape padding the
+    scoring path already performs guarantees a device-multiple, which is a
+    host-multiple too).
+    """
+    n_proc = jax.process_count()
+    if n_rows % n_proc:
+        raise ValueError(f"n_rows {n_rows} not divisible by "
+                         f"{n_proc} processes")
+    per = n_rows // n_proc
+    pid = jax.process_index()
+    return slice(pid * per, (pid + 1) * per)
+
+
+def distribute_pool(local_rows: np.ndarray, n_global_rows: int,
+                    mesh: Mesh | None = None):
+    """Assemble the global pool-sharded array from per-host row blocks.
+
+    ``local_rows``: this host's ``host_pool_slice`` worth of rows (leading
+    axis).  Returns a global jax.Array sharded ``P('pool', None, ...)`` —
+    on a single host this is exactly ``device_put`` with the pool sharding.
+    """
+    mesh = mesh or global_pool_mesh()
+    sharding = NamedSharding(
+        mesh, P(POOL_AXIS, *([None] * (local_rows.ndim - 1))))
+    global_shape = (n_global_rows,) + tuple(local_rows.shape[1:])
+    return jax.make_array_from_process_local_data(sharding, local_rows,
+                                                  global_shape)
