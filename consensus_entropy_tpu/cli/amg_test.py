@@ -86,10 +86,12 @@ def main(argv=None) -> int:
               f"first (looked in {paths.pretrained_dir}).")
         return 1
     if any(f.endswith(".msgpack") for f in pretrained_files):
-        from consensus_entropy_tpu.data.audio import HostWaveformStore
+        from consensus_entropy_tpu.data.audio import device_store_from_npy
 
-        store = HostWaveformStore(paths.amg_npy_dir, pool.song_ids,
-                                  cnn_cfg.input_length)
+        # CNN retraining requires the device store (trainer jit closes over
+        # the device-resident waveform buffer; AMG1608 fits one chip's HBM)
+        store = device_store_from_npy(paths.amg_npy_dir, pool.song_ids,
+                                      cnn_cfg.input_length)
 
     loop = ALLoop(cfg, tie_break=args.tie_break)
     results = []

@@ -28,6 +28,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "'cnn_jax'; there is no torch path)")
     p.add_argument("--epochs", type=int, default=None,
                    help="override CNN epochs (default settings n_epochs_cnn)")
+    p.add_argument("--tb-dir", default=None,
+                   help="write TensorBoard Loss/train, Loss/valid, F1 "
+                        "scalars for CNN pre-training here")
+    p.add_argument("--cnn-config-json", default=None, metavar="JSON",
+                   help="debug: CNNConfig field overrides as a JSON object "
+                        "(e.g. '{\"n_layers\": 2, \"input_length\": 1024}')")
     p.add_argument("--seed", type=int, default=1987)
     add_path_args(p)
     add_device_arg(p)
@@ -62,19 +68,27 @@ def main(argv=None) -> int:
 
     if args.model in ("cnn", "cnn_jax"):
         from consensus_entropy_tpu.config import CNNConfig, TrainConfig
-        from consensus_entropy_tpu.data.audio import HostWaveformStore
+        from consensus_entropy_tpu.data.audio import device_store_from_npy
 
         # song-level label = majority frame quadrant (the reference's
         # groupby('song_id').max() picks the lexicographic max quadrant,
         # deam_classifier.py:253; we keep that exact rule)
         per_song = (df.groupby("song_id")["quadrants"].max())
         labels = {sid: int(q[1]) - 1 for sid, q in per_song.items()}
-        cfg = CNNConfig()
-        store = HostWaveformStore(paths.deam_npy_dir, list(labels),
-                                  cfg.input_length)
+        if args.cnn_config_json:
+            import json
+
+            cfg = CNNConfig(**json.loads(args.cnn_config_json))
+        else:
+            cfg = CNNConfig()
+        # training needs the device store (the trainer jit closes over the
+        # device-resident waveform buffer)
+        store = device_store_from_npy(paths.deam_npy_dir, list(labels),
+                                      cfg.input_length)
         pretrain.pretrain_cnn(labels, store, cv=cv, out_dir=out_dir,
                               config=cfg, train_config=TrainConfig(),
-                              n_epochs=args.epochs, seed=args.seed)
+                              n_epochs=args.epochs, seed=args.seed,
+                              tb_dir=args.tb_dir)
     else:
         X, y, song_ids = deam.training_arrays(df)
         pretrain.pretrain_classic(args.model, X, y, song_ids, cv=cv,

@@ -9,8 +9,10 @@ TPU-native replacement: the pool's waveforms are padded once into a single
 ``(n_songs, max_len)`` device array; per-epoch crop sampling is a ``vmap``'d
 ``dynamic_slice`` with ``jax.random`` starts — zero host↔device traffic per
 epoch and deterministic under explicit keys (the reference's crops depend on
-global numpy RNG state and worker scheduling).  A host-memory variant exists
-for pools too large for HBM (e.g. full DEAM pre-training).
+global numpy RNG state and worker scheduling).  CNN training requires the
+device store (the trainer jit closes over its buffer;
+``device_store_from_npy`` loads one); ``HostWaveformStore`` covers crop
+*scoring* of pools too large for HBM.
 """
 
 from __future__ import annotations
@@ -99,11 +101,30 @@ def _sample_crops(data, lengths, rows, key, input_length: int):
     return jax.vmap(one)(rows, starts)
 
 
-class HostWaveformStore:
-    """Host-memory variant for pools too large for HBM (full DEAM npy dir).
+def device_store_from_npy(npy_dir: str, song_ids: Sequence,
+                          input_length: int) -> "DeviceWaveformStore":
+    """Load ``{song_id}.npy`` waveforms into a :class:`DeviceWaveformStore`.
 
-    Same API; crops assembled in numpy (optionally from mmap'd .npy files)
-    and shipped as one batch array — one transfer per call, not one per song.
+    This is what CNN *training* requires (the trainer's jit signature takes
+    the store's device-resident ``data``/``lengths``); at the reference
+    datasets' scale the padded buffer fits one chip's HBM (DEAM ≈ 1802 x
+    45 s x 16 kHz x 4 B ≈ 5.2 GB; AMG1608 ≈ 3 GB).  Use
+    :class:`HostWaveformStore` only for crop *scoring* of pools that don't.
+    """
+    # mmap: the store ctor copies each row into its padded buffer anyway,
+    # so peak host RAM stays one buffer, not two.
+    waves = {sid: np.load(os.path.join(npy_dir, f"{sid}.npy"), mmap_mode="r")
+             for sid in song_ids}
+    return DeviceWaveformStore(waves, input_length)
+
+
+class HostWaveformStore:
+    """Host-memory variant for crop *scoring* of pools too large for HBM.
+
+    Same sampling API; crops assembled in numpy (optionally from mmap'd
+    .npy files) and shipped as one batch array — one transfer per call, not
+    one per song.  NOT usable for CNN training (no device-resident
+    ``data``/``lengths``; use :func:`device_store_from_npy`).
     """
 
     def __init__(self, npy_dir: str, song_ids: Sequence, input_length: int,

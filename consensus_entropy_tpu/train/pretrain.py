@@ -115,12 +115,15 @@ def pretrain_classic(model: str, X, y, song_ids, *, cv: int,
 def pretrain_cnn(song_labels: dict, store, *, cv: int, out_dir: str,
                  config: CNNConfig = CNNConfig(),
                  train_config: TrainConfig = TrainConfig(),
-                 n_epochs: int | None = None, seed: int = 1987) -> dict:
+                 n_epochs: int | None = None, seed: int = 1987,
+                 tb_dir: str | None = None) -> dict:
     """Per-fold Flax CNN training (``deam_classifier.py:249-316``), saving
     ``classifier_cnn.it_{i}.msgpack`` per fold.
 
     ``song_labels``: song id → class; ``store``: a waveform store holding
-    those songs.
+    those songs.  ``tb_dir`` writes the reference's TensorBoard scalars
+    (``Loss/train``, ``Loss/valid`` per epoch and the fold F1 —
+    ``deam_classifier.py:242,314-316``) alongside the always-on jsonl.
     """
     import jax
 
@@ -156,10 +159,27 @@ def pretrain_cnn(song_labels: dict, store, *, cv: int, out_dir: str,
                                    store.row_of(test_ids))
         preds = np.asarray(apply_infer(best, crops, config)).argmax(axis=1)
         f1s.append(f1_score(y_te.argmax(axis=1), preds, average="weighted"))
+        if tb_dir:
+            _write_tensorboard(os.path.join(tb_dir, f"fold_{i}"), _hist,
+                               f1s[-1])
     summary = {"f1": {"mean": float(np.mean(f1s)), "std": float(np.std(f1s))}}
     _print_cv(summary)
     _append_jsonl(out_dir, {"model": "cnn_jax", "cv": cv, **summary})
     return summary
+
+
+def _write_tensorboard(run_dir: str, history: list[dict], f1: float) -> None:
+    """Reference-parity TB scalars; silently skipped if tensorboard is not
+    importable in the environment."""
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+    except ImportError:  # pragma: no cover - env without tensorboard
+        return
+    with SummaryWriter(run_dir) as w:
+        for rec in history:
+            w.add_scalar("Loss/train", rec["train_loss"], rec["epoch"])
+            w.add_scalar("Loss/valid", rec["val_loss"], rec["epoch"])
+        w.add_scalar("F1/fold", f1, len(history))
 
 
 def _print_cv(summary: dict) -> None:

@@ -145,3 +145,29 @@ def test_missing_pretrained_dir_is_clean_error(synth_roots, capsys):
                         "--amg-root", synth_roots["amg"], "--device", "cpu"])
     assert rc == 1
     assert "No pre-trained models" in capsys.readouterr().out
+
+
+def test_cnn_jax_pretrain_cli(synth_roots, tmp_path, rng):
+    """The cnn_jax registry path end to end through the CLI: npy audio ->
+    device store -> fold training -> msgpack artifact + TensorBoard."""
+    import glob
+
+    pytest.importorskip("torch.utils.tensorboard")
+
+    npy = os.path.join(synth_roots["deam"], "npy")
+    os.makedirs(npy, exist_ok=True)
+    for sid in range(1, 25):
+        np.save(os.path.join(npy, f"{sid}.npy"),
+                (rng.standard_normal(1600) * 0.05).astype(np.float32))
+    tiny = ('{"n_channels": 4, "n_fft": 64, "hop_length": 32, "n_mels": 16,'
+            ' "n_layers": 2, "input_length": 1024}')
+    rc = deam_classifier.main(
+        ["-cv", "1", "-m", "cnn_jax", "--epochs", "2",
+         "--cnn-config-json", tiny, "--tb-dir", str(tmp_path / "tb"),
+         "--models-root", synth_roots["models"],
+         "--deam-root", synth_roots["deam"],
+         "--amg-root", synth_roots["amg"], "--device", "cpu"])
+    assert rc == 0
+    pre = os.path.join(synth_roots["models"], "pretrained")
+    assert glob.glob(os.path.join(pre, "classifier_cnn.it_0.msgpack"))
+    assert glob.glob(str(tmp_path / "tb" / "fold_0" / "events.out.*"))

@@ -2,6 +2,7 @@
 
 import jax
 import numpy as np
+import pytest
 import torch
 
 from consensus_entropy_tpu.config import CNNConfig, TrainConfig
@@ -83,3 +84,27 @@ def test_schedule_transitions_and_best_reload(rng):
     # adam for 2 epochs, then sgd_1 ×2, sgd_2 ×2, then sgd_3 stays
     assert phases == ["adam", "adam", "sgd_1", "sgd_1", "sgd_2", "sgd_2",
                       "sgd_3", "sgd_3", "sgd_3"]
+
+
+def test_pretrain_cnn_writes_tensorboard(tmp_path, rng):
+    # Reference parity: Loss/train, Loss/valid scalars per epoch + fold F1
+    # (deam_classifier.py:242,314-316), written only when tb_dir is given.
+    import glob
+
+    pytest.importorskip("torch.utils.tensorboard")
+
+    import jax
+
+    from consensus_entropy_tpu.data.audio import DeviceWaveformStore
+    from consensus_entropy_tpu.train import pretrain
+
+    waves = {i: (rng.standard_normal(TINY.input_length + 500) * 0.05
+                 ).astype(np.float32) for i in range(8)}
+    labels = {i: i % 4 for i in range(8)}
+    store = DeviceWaveformStore(waves, TINY.input_length)
+    out = pretrain.pretrain_cnn(
+        labels, store, cv=1, out_dir=str(tmp_path / "models"),
+        config=TINY, n_epochs=2, seed=0, tb_dir=str(tmp_path / "tb"))
+    assert "f1" in out
+    events = glob.glob(str(tmp_path / "tb" / "fold_0" / "events.out.*"))
+    assert events, "no tensorboard event file written"
