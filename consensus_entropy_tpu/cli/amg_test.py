@@ -47,6 +47,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="CNN members score each song as the deterministic "
                         "mean over stride-HOP windows covering the whole "
                         "waveform, instead of one random crop per pass")
+    p.add_argument("--retrain-epochs", type=int, default=None,
+                   help="override CNN retrain epochs per AL iteration "
+                        "(default settings n_epochs_retrain)")
+    p.add_argument("--cnn-config-json", default=None, metavar="JSON",
+                   help="debug: CNNConfig field overrides as a JSON object "
+                        "(must match the pre-trained geometry)")
     add_path_args(p)
     add_device_arg(p)
     return p
@@ -77,7 +83,12 @@ def main(argv=None) -> int:
     pool = amg.load_feature_pool(paths.amg_dataset_csv,
                                  paths.amg_features_dir)
 
-    cnn_cfg = CNNConfig()
+    if args.cnn_config_json:
+        import json
+
+        cnn_cfg = CNNConfig(**json.loads(args.cnn_config_json))
+    else:
+        cnn_cfg = CNNConfig()
     store = None
     try:
         pretrained_files = os.listdir(paths.pretrained_dir)
@@ -93,7 +104,8 @@ def main(argv=None) -> int:
         store = device_store_from_npy(paths.amg_npy_dir, pool.song_ids,
                                       cnn_cfg.input_length)
 
-    loop = ALLoop(cfg, tie_break=args.tie_break)
+    loop = ALLoop(cfg, tie_break=args.tie_break,
+                  retrain_epochs=args.retrain_epochs)
     results = []
     for num_user, u_id in enumerate(users[: args.max_users]):
         user_path, skip = workspace.create_user(

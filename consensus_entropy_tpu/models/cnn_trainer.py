@@ -39,12 +39,17 @@ from consensus_entropy_tpu.models.short_cnn import ShortChunkCNN
 PHASES = ("adam", "sgd_1", "sgd_2", "sgd_3")  # amg_test.py:203-231
 
 
-def bce_loss(preds, targets):
-    """torch.nn.BCELoss parity: mean over all elements, log clamped at −100."""
+def bce_per_sample(preds, targets):
+    """Per-sample BCE (mean over the class axis), torch clamp semantics."""
     p = jnp.clip(preds, 0.0, 1.0)
     log_p = jnp.maximum(jnp.log(jnp.maximum(p, 1e-44)), -100.0)
     log_1p = jnp.maximum(jnp.log(jnp.maximum(1.0 - p, 1e-44)), -100.0)
-    return -jnp.mean(targets * log_p + (1.0 - targets) * log_1p)
+    return -jnp.mean(targets * log_p + (1.0 - targets) * log_1p, axis=-1)
+
+
+def bce_loss(preds, targets):
+    """torch.nn.BCELoss parity: mean over all elements, log clamped at −100."""
+    return jnp.mean(bce_per_sample(preds, targets))
 
 
 def make_tx(phase: str, cfg: TrainConfig) -> optax.GradientTransformation:
@@ -80,13 +85,21 @@ class CNNTrainer:
 
     def _epoch_fn(self, phase: str, n_train: int, n_test: int,
                   batch_size: int) -> Callable:
+        # The reference's DataLoader has drop_last=False (short final batch,
+        # every song trains every epoch).  Fixed-shape equivalent: clamp the
+        # batch size to the pool, round batches UP, and pad the tail with
+        # repeated rows at loss weight 0 — all songs contribute gradient
+        # each epoch (padding rows still enter train-mode BatchNorm stats,
+        # the one unavoidable deviation from a genuinely shorter batch).
+        batch_size = max(1, min(batch_size, n_train))
         key_ = (phase, n_train, n_test, batch_size)
         if key_ in self._epoch_fns:
             return self._epoch_fns[key_]
         tx = make_tx(phase, self.train_config)
         model = self.model
-        n_batches = max(n_train // batch_size, 1)
+        n_batches = -(-n_train // batch_size)
         used = n_batches * batch_size
+        pad = used - n_train  # < batch_size <= n_train
 
         def epoch(params, batch_stats, opt_state, best_params, best_stats,
                   best_score, data, lengths, train_rows, train_y, test_rows,
@@ -94,7 +107,8 @@ class CNNTrainer:
             kperm, kcrop, ktest, kdrop = jax.random.split(key, 4)
             # shuffle + crop the training pool (epoch-fresh random crops,
             # matching the reference's shuffling DataLoader).
-            perm = jax.random.permutation(kperm, n_train)[:used]
+            perm = jax.random.permutation(kperm, n_train)
+            perm = jnp.concatenate([perm, perm[:pad]])  # zero-weight tail
             rows = train_rows[perm]
             u = jax.random.uniform(kcrop, (used,))
             starts = jnp.floor(
@@ -107,25 +121,30 @@ class CNNTrainer:
             xs = jax.vmap(crop)(rows, starts).reshape(
                 n_batches, batch_size, model.config.input_length)
             ys = train_y[perm].reshape(n_batches, batch_size, -1)
+            ws = jnp.concatenate(
+                [jnp.ones(n_train), jnp.zeros(pad)]).reshape(
+                    n_batches, batch_size)
             dkeys = jax.random.split(kdrop, n_batches)
 
-            def loss_fn(p, stats, x, y, dk):
+            def loss_fn(p, stats, x, y, w, dk):
                 out, mutated = model.apply(
                     {"params": p, "batch_stats": stats}, x, train=True,
                     rngs={"dropout": dk}, mutable=["batch_stats"])
-                return bce_loss(out, y), mutated["batch_stats"]
+                loss = (jnp.sum(bce_per_sample(out, y) * w)
+                        / jnp.sum(w))
+                return loss, mutated["batch_stats"]
 
             def step(carry, batch):
                 p, stats, opt = carry
-                x, y, dk = batch
+                x, y, w, dk = batch
                 (loss, new_stats), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(p, stats, x, y, dk)
+                    loss_fn, has_aux=True)(p, stats, x, y, w, dk)
                 updates, opt = tx.update(grads, opt, p)
                 p = optax.apply_updates(p, updates)
                 return (p, new_stats, opt), loss
 
             (params, batch_stats, opt_state), losses = jax.lax.scan(
-                step, (params, batch_stats, opt_state), (xs, ys, dkeys))
+                step, (params, batch_stats, opt_state), (xs, ys, ws, dkeys))
 
             # validation with fresh random test crops (the reference's test
             # loader also crops randomly every pass — short_cnn.py:376).
@@ -170,7 +189,7 @@ class CNNTrainer:
         reporting hook).
         """
         cfg = self.train_config
-        n_epochs = n_epochs or cfg.n_epochs
+        n_epochs = cfg.n_epochs if n_epochs is None else n_epochs
         batch_size = batch_size or cfg.batch_size
         adam_patience = adam_patience or cfg.adam_patience
 

@@ -304,7 +304,9 @@ class Committee:
             sub = jax.random.fold_in(key, i)
             best, hist = self.trainer.fit(
                 m.variables, store, train_ids, train_y, test_ids, test_y,
-                sub, n_epochs=n_epochs or self.trainer.train_config.n_epochs_retrain)
+                sub,
+                n_epochs=(self.trainer.train_config.n_epochs_retrain
+                          if n_epochs is None else n_epochs))
             m.variables = best
             histories.append(hist)
         return histories

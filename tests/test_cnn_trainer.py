@@ -108,3 +108,45 @@ def test_pretrain_cnn_writes_tensorboard(tmp_path, rng):
     assert "f1" in out
     events = glob.glob(str(tmp_path / "tb" / "fold_0" / "events.out.*"))
     assert events, "no tensorboard event file written"
+
+
+def test_fit_with_fewer_songs_than_batch_size(rng):
+    # AL query batches can be smaller than TrainConfig.batch_size (q < 5);
+    # the reference DataLoader yields a short batch (drop_last=False).
+    waves, classes = _synthetic_pool(rng, 3)
+    store = DeviceWaveformStore(waves, TINY.input_length)
+    ids = list(waves)
+    y = one_hot_np([classes[s] for s in ids])
+    variables = short_cnn.init_variables(jax.random.key(0), TINY)
+    trainer = CNNTrainer(TINY, TrainConfig(batch_size=5))
+    _, history = trainer.fit(variables, store, ids, y, ids, y,
+                             jax.random.key(1), n_epochs=2)
+    assert len(history) == 2
+    assert np.isfinite(history[-1]["train_loss"])
+
+
+def test_all_songs_train_when_batch_does_not_divide(rng):
+    # q=7 with batch_size=5: drop_last=False parity — every song must get
+    # gradient every epoch (padded tail rows carry loss weight 0).
+    waves, classes = _synthetic_pool(rng, 7)
+    store = DeviceWaveformStore(waves, TINY.input_length)
+    ids = list(waves)
+    y = one_hot_np([classes[s] for s in ids])
+    variables = short_cnn.init_variables(jax.random.key(0), TINY)
+    trainer = CNNTrainer(TINY, TrainConfig(batch_size=5, lr=1e-3))
+    _, history = trainer.fit(variables, store, ids, y, ids, y,
+                             jax.random.key(1), n_epochs=3)
+    assert all(np.isfinite(h["train_loss"]) for h in history)
+
+
+def test_zero_retrain_epochs_respected(rng):
+    # n_epochs=0 must mean "no training", not fall back to the default.
+    waves, classes = _synthetic_pool(rng, 4)
+    store = DeviceWaveformStore(waves, TINY.input_length)
+    ids = list(waves)
+    y = one_hot_np([classes[s] for s in ids])
+    variables = short_cnn.init_variables(jax.random.key(0), TINY)
+    trainer = CNNTrainer(TINY, TrainConfig(batch_size=4))
+    best, history = trainer.fit(variables, store, ids, y, ids, y,
+                                jax.random.key(1), n_epochs=0)
+    assert history == []
