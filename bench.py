@@ -136,7 +136,7 @@ def build_pallas_impl(x, w, b, k: int, tile_n: int, fuse_topk: bool = False):
         auto_pack,
         pack_pool,
         pack_weights,
-        score_mc_linear_fused,
+        packed_score_mc,
     )
     from consensus_entropy_tpu.ops.scoring import ScoreResult
     from consensus_entropy_tpu.parallel.mesh import POOL_AXIS, make_pool_mesh
@@ -170,7 +170,7 @@ def build_pallas_impl(x, w, b, k: int, tile_n: int, fuse_topk: bool = False):
 
         def iteration(args, eps):
             x_tiles, w_packed, b_packed, mask = args
-            ent, values, indices = score_mc_linear_fused(
+            ent, values, indices = packed_score_mc(
                 x_tiles, w_packed + eps * 0.0, b_packed, mask,
                 n_members=n_eff, k=k, fuse_topk=fuse_topk)
             return ScoreResult(ent, values, indices)

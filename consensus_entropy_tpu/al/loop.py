@@ -56,13 +56,28 @@ def split_from_songs(pool: FramePool, labels: Mapping, train_songs: list,
     # per-frame labels repeat the song label (the reference's y_train/y_test
     # are frame-indexed with identical labels per song)
     frame_song = np.concatenate(
-        [[s] * pool.counts[pool.song_ids.index(s)] for s in test_songs]) \
+        [[s] * pool.count_of(s) for s in test_songs]) \
         if test_songs else np.empty(0, object)
     y_test_frames = np.array([labels[s] for s in frame_song], np.int32) \
         if len(frame_song) else np.empty(0, np.int32)
     y_test_songs = np.array([labels[s] for s in test_songs], np.int32)
     return SplitData(train_songs, test_songs, X_test, y_test_frames,
                      y_test_songs)
+
+
+def query_batch(pool: FramePool, labels: Mapping, q_songs):
+    """Frames + per-frame labels for a query batch, rows and labels in the
+    SAME (pool) order — ``rows_for_songs`` iterates ``pool.song_ids``, so
+    the labels must too, regardless of the acquisition ranking's order
+    (the reference's isin-based build is pool-ordered on both sides,
+    ``amg_test.py:491-493``)."""
+    q_set = set(q_songs)
+    ordered = [s for s in pool.song_ids if s in q_set]
+    X = pool.X[pool.rows_for_songs(ordered)]
+    y = np.asarray(
+        [labels[s] for s in ordered for _ in range(pool.count_of(s))],
+        np.int32)
+    return X, y
 
 
 def grouped_split(pool: FramePool, labels: Mapping, train_size: float,
@@ -200,13 +215,8 @@ class ALLoop:
                     q_songs = acq.select(member_probs, rand_key=sub)
 
                 # reveal labels; build the frame batch (amg_test.py:491-493)
-                rows = data.pool.rows_for_songs(q_songs)
-                X_batch = data.pool.X[rows]
-                frame_labels = []
-                for s in q_songs:
-                    n = data.pool.counts[data.pool.song_ids.index(s)]
-                    frame_labels += [data.labels[s]] * int(n)
-                y_batch = np.asarray(frame_labels, np.int32)
+                X_batch, y_batch = query_batch(data.pool, data.labels,
+                                               q_songs)
 
                 with timer.phase("update_host"):
                     committee.update_host(X_batch, y_batch)

@@ -16,6 +16,7 @@ from consensus_entropy_tpu.cli.common import (
     add_device_arg,
     add_path_args,
     configure_device,
+    resolve_cnn_config,
 )
 
 MODES = ("mc", "hc", "mix", "rand")
@@ -66,7 +67,7 @@ def main(argv=None) -> int:
 
     from consensus_entropy_tpu.al import workspace
     from consensus_entropy_tpu.al.loop import ALLoop, UserData
-    from consensus_entropy_tpu.config import ALConfig, CNNConfig, PathsConfig
+    from consensus_entropy_tpu.config import ALConfig, PathsConfig
     from consensus_entropy_tpu.data import amg
     from consensus_entropy_tpu.utils import profiling
 
@@ -83,12 +84,7 @@ def main(argv=None) -> int:
     pool = amg.load_feature_pool(paths.amg_dataset_csv,
                                  paths.amg_features_dir)
 
-    if args.cnn_config_json:
-        import json
-
-        cnn_cfg = CNNConfig(**json.loads(args.cnn_config_json))
-    else:
-        cnn_cfg = CNNConfig()
+    cnn_cfg = resolve_cnn_config(args.cnn_config_json)
     store = None
     try:
         pretrained_files = os.listdir(paths.pretrained_dir)

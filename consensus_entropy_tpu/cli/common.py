@@ -27,3 +27,14 @@ def add_path_args(parser: argparse.ArgumentParser) -> None:
                         help="DEAM dataset root (settings.py:17-21)")
     parser.add_argument("--amg-root", default="./data/amg1608",
                         help="AMG1608 dataset root (settings.py:27-33)")
+
+
+def resolve_cnn_config(cnn_config_json: str | None):
+    """CNNConfig from the debug ``--cnn-config-json`` override (or defaults)."""
+    import json
+
+    from consensus_entropy_tpu.config import CNNConfig
+
+    if cnn_config_json:
+        return CNNConfig(**json.loads(cnn_config_json))
+    return CNNConfig()

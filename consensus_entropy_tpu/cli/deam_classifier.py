@@ -12,6 +12,7 @@ from consensus_entropy_tpu.cli.common import (
     add_device_arg,
     add_path_args,
     configure_device,
+    resolve_cnn_config,
 )
 
 
@@ -67,7 +68,7 @@ def main(argv=None) -> int:
                            cache_csv=paths.deam_dataset_csv)
 
     if args.model in ("cnn", "cnn_jax"):
-        from consensus_entropy_tpu.config import CNNConfig, TrainConfig
+        from consensus_entropy_tpu.config import TrainConfig
         from consensus_entropy_tpu.data.audio import device_store_from_npy
 
         # song-level label = majority frame quadrant (the reference's
@@ -75,12 +76,7 @@ def main(argv=None) -> int:
         # deam_classifier.py:253; we keep that exact rule)
         per_song = (df.groupby("song_id")["quadrants"].max())
         labels = {sid: int(q[1]) - 1 for sid, q in per_song.items()}
-        if args.cnn_config_json:
-            import json
-
-            cfg = CNNConfig(**json.loads(args.cnn_config_json))
-        else:
-            cfg = CNNConfig()
+        cfg = resolve_cnn_config(args.cnn_config_json)
         # training needs the device store (the trainer jit closes over the
         # device-resident waveform buffer)
         store = device_store_from_npy(paths.deam_npy_dir, list(labels),

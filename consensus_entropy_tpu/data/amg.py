@@ -129,6 +129,6 @@ def user_pool(pool: FramePool, anno: pd.DataFrame, user_id) -> tuple:
     songs = [s for s in pool.song_ids if s in labels]
     rows = pool.rows_for_songs(songs)
     frame_song = np.concatenate(
-        [[s] * pool.counts[pool.song_ids.index(s)] for s in songs])
+        [[s] * pool.count_of(s) for s in songs])
     sub = FramePool(pool.X[rows], frame_song)
     return sub, {s: int(labels[s]) for s in songs}

@@ -55,10 +55,15 @@ class FramePool:
         self.song_ids = list(sorted_ids[change])
         self.counts = np.diff(np.r_[change, len(sorted_ids)])
         self._starts = np.r_[change, len(sorted_ids)].astype(np.int64)
+        self._index = {sid: i for i, sid in enumerate(self.song_ids)}
 
     @property
     def n_songs(self) -> int:
         return len(self.song_ids)
+
+    def count_of(self, song) -> int:
+        """Frames in ``song``'s segment (O(1))."""
+        return int(self.counts[self._index[song]])
 
     def mean_by_song(self, frame_values: np.ndarray) -> np.ndarray:
         frame_values = np.asarray(frame_values)

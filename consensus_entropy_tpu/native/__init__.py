@@ -69,13 +69,17 @@ def linear_predict_proba(X, W, b, mode: str = "softmax") -> np.ndarray:
     if imode == 0:
         logits -= logits.max(axis=1, keepdims=True)
         p = np.exp(logits)
-    else:
-        p = 1.0 / (1.0 + np.exp(-logits))
+        return (p / p.sum(axis=1, keepdims=True)).astype(np.float32)
+    return _ova_normalize(1.0 / (1.0 + np.exp(-logits)))
+
+
+def _ova_normalize(p) -> np.ndarray:
+    """sklearn OvA tail: L1-normalize rows, uniform for all-zero rows."""
     s = p.sum(axis=1, keepdims=True)
-    s[s == 0.0] = 1.0
+    zero = (s == 0.0).ravel()
+    s[zero] = 1.0
     p = p / s
-    if imode == 1:
-        p[np.all(p == 0, axis=1)] = 1.0 / c
+    p[zero] = 1.0 / p.shape[1]
     return p.astype(np.float32)
 
 
@@ -181,13 +185,7 @@ def member_probs(estimator, X) -> np.ndarray:
         logits = (np.asarray(X, np.float32)
                   @ estimator.coef_.T.astype(np.float32)
                   + estimator.intercept_.astype(np.float32))
-        p = 1.0 / (1.0 + np.exp(-logits))
-        s = p.sum(axis=1, keepdims=True)
-        zero = (s == 0.0).ravel()
-        s[zero] = 1.0
-        p /= s
-        p[zero] = 1.0 / p.shape[1]
-        return p.astype(np.float32)
+        return _ova_normalize(1.0 / (1.0 + np.exp(-logits)))
     return estimator.predict_proba(np.asarray(X))
 
 

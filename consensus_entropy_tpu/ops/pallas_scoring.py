@@ -343,11 +343,3 @@ def linear_consensus_entropy(x_songs, w, b, *, tile_n: int = DEFAULT_TILE_N,
     return ent[:n_valid]
 
 
-def score_mc_linear_fused(x_tiles, w_packed, b_packed, pool_mask, *,
-                          n_members: int, k: int, tie_break: str = "fast",
-                          fuse_topk: bool = False, interpret: bool = False):
-    """Alias kept for the benchmark/driver surface: fused mc scoring on a
-    pre-packed pool (see :func:`packed_score_mc`)."""
-    return packed_score_mc(x_tiles, w_packed, b_packed, pool_mask,
-                           n_members=n_members, k=k, tie_break=tie_break,
-                           fuse_topk=fuse_topk, interpret=interpret)

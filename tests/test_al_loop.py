@@ -111,3 +111,22 @@ def test_workspace_resume(rng, tmp_path):
     path_b2, skip_b2 = workspace.create_user(users, str(pre), "u1", "mc")
     assert not skip_b2
     assert not os.path.exists(os.path.join(path_b2, "junk.txt"))
+
+
+def test_query_batch_label_alignment(rng):
+    # Acquisition returns songs in entropy order; the frame batch must pair
+    # each frame with ITS song's label even when that order differs from
+    # pool order and frame counts differ per song.
+    from consensus_entropy_tpu.al.loop import query_batch
+    from consensus_entropy_tpu.models.committee import FramePool
+
+    frame_song = ["a"] * 2 + ["b"] * 3 + ["c"] * 1 + ["d"] * 4
+    X = np.arange(len(frame_song), dtype=np.float32)[:, None]
+    pool = FramePool(X, frame_song)
+    labels = {"a": 0, "b": 1, "c": 2, "d": 3}
+
+    Xb, yb = query_batch(pool, labels, ["d", "b"])  # reversed vs pool order
+    assert Xb.shape == (7, 1) and yb.shape == (7,)
+    for x_row, y in zip(Xb[:, 0], yb):
+        song = frame_song[int(x_row)]
+        assert labels[song] == y, (x_row, y)

@@ -72,7 +72,7 @@ def test_fused_score_matches_unfused(rng):
 
     x_tiles, _ = pallas_scoring.pack_pool(x, tile_n=16)
     w_p, b_p = pallas_scoring.pack_weights(w, b)
-    ent, values, idx = pallas_scoring.score_mc_linear_fused(
+    ent, values, idx = pallas_scoring.packed_score_mc(
         x_tiles, w_p, b_p, mask, n_members=4, k=8, interpret=True)
 
     frames = x.reshape(-1, x.shape[-1])
