@@ -1,0 +1,76 @@
+"""bench.py plumbing on the CPU mesh — this script produces the recorded
+benchmark artifact, so its non-TPU-specific paths are pinned here (the
+Pallas/Mosaic impls are TPU-only and covered by ops/parallel tests)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import bench  # repo root is on sys.path via tests/conftest.py
+
+
+def test_cpu_reference_matches_independent_oracle():
+    from scipy.stats import entropy as scipy_entropy
+
+    x, w, b = bench.make_inputs(3, 40, 2, 8, 4)
+    ent, idx = bench.cpu_reference_iteration(x, w, b, 5)
+    # independent float64 recomputation of the whole chain
+    frames = x.reshape(-1, 8).astype(np.float64)
+    per_member = []
+    for m in range(3):
+        lg = frames @ w[m] + b[m]
+        lg -= lg.max(axis=1, keepdims=True)
+        p = np.exp(lg)
+        p /= p.sum(axis=1, keepdims=True)
+        per_member.append(p.reshape(40, 2, -1).mean(axis=1))
+    want = scipy_entropy(np.mean(per_member, axis=0), axis=1)
+    np.testing.assert_allclose(ent, want, rtol=1e-6)
+    assert set(idx) == set(np.argsort(want)[::-1][:5])
+
+
+@pytest.fixture(scope="module")
+def xla_impl():
+    x, w, b = bench.make_inputs(3, 64, 2, 8, 4)
+    args, itfn = bench.build_xla_impl(x, w, b, 5)
+    return x, w, b, args, itfn
+
+
+def test_xla_impl_passes_parity_gate(xla_impl):
+    x, w, b, args, itfn = xla_impl
+    ent_cpu, idx_cpu = bench.cpu_reference_iteration(x, w, b, 5)
+    assert bench.check_parity("xla", args, itfn, ent_cpu, idx_cpu, 5)
+
+
+def test_parity_gate_rejects_wrong_entropy(xla_impl):
+    x, w, b, args, itfn = xla_impl
+    ent_cpu, idx_cpu = bench.cpu_reference_iteration(x, w, b, 5)
+    assert not bench.check_parity("xla", args, itfn, ent_cpu + 0.01,
+                                  idx_cpu, 5)
+
+
+def test_timing_window_runs_on_cpu(xla_impl):
+    _, _, _, args, itfn = xla_impl
+    ms = bench.time_device_impl("xla", args, itfn, chain=3, trials=2)
+    assert ms > 0
+
+
+def test_main_emits_single_json_line(capsys):
+    rc = bench.main(["--impl", "xla", "--pool", "64", "--members", "3",
+                     "--frames", "2", "--features", "8", "--chain", "3",
+                     "--trials", "1", "--cpu-reps", "1"])
+    assert rc == 0
+    out_lines = [l for l in capsys.readouterr().out.splitlines() if l]
+    assert len(out_lines) == 1  # the driver contract: ONE json line
+    rec = json.loads(out_lines[0])
+    assert rec["unit"] == "ms" and rec["value"] > 0
+    assert rec["metric"] == "al_pool_scoring_latency_3m_64"
+    assert rec["vs_baseline"] > 0
+
+
+def test_pallas_suite_skips_cleanly_off_tpu(capsys):
+    # --impl pallas on a CPU host must exit 1 with a clear skip, not crash.
+    rc = bench.main(["--impl", "pallas", "--pool", "64", "--members", "3",
+                     "--frames", "2", "--features", "8", "--cpu-reps", "1"])
+    assert rc == 1
+    assert "Mosaic" in capsys.readouterr().err
