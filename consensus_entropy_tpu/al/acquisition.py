@@ -10,16 +10,15 @@ user-pool size class).
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from consensus_entropy_tpu.config import NUM_CLASSES
 from consensus_entropy_tpu.ops import scoring
-
-
-def _round_up(n: int, multiple: int) -> int:
-    return ((n + multiple - 1) // multiple) * multiple
+from consensus_entropy_tpu.utils import round_up as _round_up
 
 
 class Acquirer:
@@ -28,16 +27,29 @@ class Acquirer:
     ``train_songs``: the user's train-split song ids (pool rows, in order).
     ``hc_rows``: human-consensus frequency table aligned with ``train_songs``
     (the reference restricts hc to train songs at ``amg_test.py:376``).
+
+    ``mesh``: optional pool-axis :class:`jax.sharding.Mesh` — the scorers are
+    then compiled with pool-axis shardings (``parallel.sharding``), so the
+    fused mean→entropy→top-k graph splits the pool across every chip; the
+    pad width is rounded up so each shard is equal-sized.  ``pad_to`` pads
+    every pool to one fixed minimum width (``ScoringConfig.pad_pool_to``), so
+    the scoring graph compiles once across users of differing pool sizes.
     """
 
     def __init__(self, train_songs, hc_rows: np.ndarray | None, *, queries: int,
                  mode: str, tie_break: str = "fast", pad_multiple: int = 8,
-                 seed: int = 0):
+                 seed: int = 0, mesh=None, pad_to: int | None = None):
         self.mode = mode
         self.queries = queries
         self.songs = list(train_songs)
         self.n_valid = len(self.songs)
+        if mesh is not None:
+            from consensus_entropy_tpu.parallel.mesh import POOL_AXIS
+
+            pad_multiple = math.lcm(pad_multiple, mesh.shape[POOL_AXIS])
         self.n_pad = _round_up(max(self.n_valid, queries), pad_multiple)
+        if pad_to:
+            self.n_pad = max(self.n_pad, _round_up(pad_to, pad_multiple))
         self._song_row = {s: i for i, s in enumerate(self.songs)}
 
         self.pool_mask = np.zeros(self.n_pad, bool)
@@ -50,7 +62,16 @@ class Acquirer:
         else:
             self.hc = np.zeros((self.n_pad, NUM_CLASSES), np.float32)
             self.hc_mask[:] = False
-        self._fns = scoring.make_scoring_fns(k=queries, tie_break=tie_break)
+        if mesh is None:
+            self._fns = scoring.make_scoring_fns(k=queries,
+                                                 tie_break=tie_break)
+        else:
+            from consensus_entropy_tpu.parallel.sharding import (
+                make_sharded_scoring_fns,
+            )
+
+            self._fns = make_sharded_scoring_fns(mesh, k=queries,
+                                                 tie_break=tie_break)
         self._rand_key = jax.random.key(seed)
 
     # -- helpers -----------------------------------------------------------

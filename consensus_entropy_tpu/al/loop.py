@@ -93,11 +93,20 @@ def grouped_split(pool: FramePool, labels: Mapping, train_size: float,
 
 
 class ALLoop:
+    """``mesh``: optional pool-axis mesh — acquisition scoring then runs
+    through the sharded scorers (``parallel.sharding``); pair it with a
+    ``Committee(mesh=...)`` so the CNN forward shards too.  ``pad_pool_to``:
+    pad every user's pool to one fixed width (``ScoringConfig.pad_pool_to``)
+    so the scoring graph compiles once across users."""
+
     def __init__(self, config: ALConfig, *, tie_break: str = "fast",
-                 retrain_epochs: int | None = None):
+                 retrain_epochs: int | None = None, mesh=None,
+                 pad_pool_to: int | None = None):
         self.config = config
         self.tie_break = tie_break
         self.retrain_epochs = retrain_epochs
+        self.mesh = mesh
+        self.pad_pool_to = pad_pool_to
 
     def _evaluate(self, committee: Committee, data: UserData,
                   split: SplitData, report: UserReport, key) -> list[float]:
@@ -165,7 +174,8 @@ class ALLoop:
             hc_rows = np.asarray(data.hc_rows)[
                 [row_of[s] for s in split.train_songs]]
         acq = Acquirer(split.train_songs, hc_rows, queries=cfg.queries,
-                       mode=cfg.mode, tie_break=self.tie_break, seed=seed)
+                       mode=cfg.mode, tie_break=self.tie_break, seed=seed,
+                       mesh=self.mesh, pad_to=self.pad_pool_to)
         acq.replay(queried_hist)
 
         def checkpoint(next_epoch: int, current_key) -> None:

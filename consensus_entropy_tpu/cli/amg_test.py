@@ -41,6 +41,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-dir", default=None,
                    help="write a jax.profiler device trace here "
                         "(TensorBoard-loadable)")
+    p.add_argument("--mesh", default=None, metavar="auto|N",
+                   help="shard the scoring path (CNN forward + fused "
+                        "mean->entropy->top-k) over a pool-axis device mesh: "
+                        "'auto' = all visible devices, N = first N devices")
+    p.add_argument("--pad-pool-to", type=int, default=None, metavar="N",
+                   help="pad every user's pool to one fixed width so the "
+                        "scoring graph compiles once across users (see "
+                        "ScoringConfig.pad_pool_to; default: exact per-user "
+                        "padding)")
     p.add_argument("--device-members", action="store_true",
                    help="run GNB/SGD member inference on device (jnp, fused "
                         "with the frame->song mean) instead of sklearn")
@@ -100,8 +109,31 @@ def main(argv=None) -> int:
         store = device_store_from_npy(paths.amg_npy_dir, pool.song_ids,
                                       cnn_cfg.input_length)
 
+    mesh = None
+    if args.mesh:
+        import jax
+
+        from consensus_entropy_tpu.parallel.mesh import make_pool_mesh
+
+        devs = jax.devices()
+        if args.mesh == "auto":
+            n_dev = len(devs)
+        else:
+            try:
+                n_dev = int(args.mesh)
+            except ValueError:
+                print(f"--mesh must be 'auto' or a device count, "
+                      f"got {args.mesh!r}")
+                return 1
+        if not 1 <= n_dev <= len(devs):
+            print(f"--mesh {args.mesh}: have {len(devs)} device(s)")
+            return 1
+        mesh = make_pool_mesh(devs[:n_dev])
+        print(f"Scoring mesh: {n_dev} device(s) on the pool axis")
+
     loop = ALLoop(cfg, tie_break=args.tie_break,
-                  retrain_epochs=args.retrain_epochs)
+                  retrain_epochs=args.retrain_epochs, mesh=mesh,
+                  pad_pool_to=args.pad_pool_to)
     results = []
     for num_user, u_id in enumerate(users[: args.max_users]):
         user_path, skip = workspace.create_user(
@@ -113,7 +145,7 @@ def main(argv=None) -> int:
             continue
         committee = workspace.load_committee(
             user_path, cnn_cfg, device_members=args.device_members,
-            full_song_hop=args.full_song_hop)
+            full_song_hop=args.full_song_hop, mesh=mesh)
         sub_pool, labels = amg.user_pool(pool, anno, u_id)
         hc_rows = hc_table.reindex(sub_pool.song_ids).to_numpy(np.float32)
         data = UserData(u_id, sub_pool, labels, hc_rows=hc_rows, store=store)

@@ -137,9 +137,11 @@ class TrainConfig:
     lr: float = 1e-4
     weight_decay: float = 1e-4  # Adam weight_decay (amg_test.py:281)
     log_step: int = 20
-    #: Stale-epoch counts before each optimizer transition.  Pre-training uses
-    #: 40 for the adam→sgd step (``deam_classifier.py:150``); retraining uses
-    #: 20 (``amg_test.py:205``).  Subsequent lr drops are always 20 epochs.
+    #: Epochs-since-last-transition before each optimizer transition (the
+    #: reference's ``drop_counter`` resets only at transitions, never on
+    #: improvement — ``amg_test.py:203-231``).  Pre-training uses 40 for the
+    #: adam→sgd step (``deam_classifier.py:150``); retraining uses 20
+    #: (``amg_test.py:205``).  Subsequent lr drops are always 20 epochs.
     adam_patience: int = 20
     sgd_patience: int = 20
     sgd_momentum: float = 0.9
@@ -166,7 +168,10 @@ class ScoringConfig:
 
     ``pad_pool_to`` fixes the pool axis so the jit graph never recompiles as
     the pool shrinks by ``queries`` songs per AL iteration — invalidated songs
-    are masked instead (SURVEY.md §7 hard part 1).
+    are masked instead (SURVEY.md §7 hard part 1).  Consumed by
+    ``Acquirer(pad_to=...)`` / the AL CLI's ``--pad-pool-to``: padding every
+    user's pool to this one width makes the scoring graph compile once
+    across users.
     """
 
     pad_pool_to: int = 2048

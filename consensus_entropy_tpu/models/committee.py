@@ -32,6 +32,7 @@ from consensus_entropy_tpu.data.audio import DeviceWaveformStore
 from consensus_entropy_tpu.models import short_cnn
 from consensus_entropy_tpu.models.base import Member
 from consensus_entropy_tpu.models.cnn_trainer import CNNTrainer
+from consensus_entropy_tpu.utils import round_up as _round_up
 from consensus_entropy_tpu.utils.checkpoint import load_variables, save_variables
 
 
@@ -124,6 +125,14 @@ class Committee:
     jnp inside one jit with the frame→song segment mean, so only boosted
     trees (and any generic registry members) remain on host.  Training
     (``partial_fit``) stays in sklearn either way.
+
+    ``mesh``: optional pool-axis :class:`jax.sharding.Mesh`.  When set, the
+    CNN member forward (the committee's heavy op) is compiled with the crop
+    batch sharded across every chip — the production counterpart of the
+    sharded scorers in ``parallel.sharding``.  Crop batches are padded to a
+    shard-divisible width (repeating the last crop) and sliced back, so the
+    random-crop stream and the returned probabilities are identical to the
+    single-device path.
     """
 
     def __init__(self, host_members: list[Member],
@@ -131,7 +140,8 @@ class Committee:
                  config: CNNConfig = CNNConfig(),
                  train_config: TrainConfig = TrainConfig(),
                  *, device_members: bool = False,
-                 full_song_hop: int | None = None):
+                 full_song_hop: int | None = None,
+                 mesh=None):
         self.host_members = host_members
         self.cnn_members = cnn_members
         self.config = config
@@ -147,10 +157,30 @@ class Committee:
                 f"{config.input_length}], got {full_song_hop}")
         self.full_song_hop = full_song_hop
         self.trainer = CNNTrainer(config, train_config)
-        self._infer = jax.jit(
-            lambda stacked, x: short_cnn.committee_infer(stacked, x,
-                                                         self.config))
-        self._infer_windows = jax.jit(self._windows_forward)
+        self.mesh = mesh
+
+        def infer(stacked, x):
+            return short_cnn.committee_infer(stacked, x, self.config)
+
+        if mesh is None:
+            self._n_pool_shards = 1
+            self._infer = jax.jit(infer)
+            self._infer_windows = jax.jit(self._windows_forward)
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from consensus_entropy_tpu.parallel.mesh import POOL_AXIS
+
+            self._n_pool_shards = mesh.shape[POOL_AXIS]
+            repl = NamedSharding(mesh, P())
+            rows_sh = NamedSharding(mesh, P(POOL_AXIS))
+            out_sh = NamedSharding(mesh, P(None, POOL_AXIS, None))
+            self._infer = jax.jit(infer, in_shardings=(repl, rows_sh),
+                                  out_shardings=out_sh)
+            self._infer_windows = jax.jit(
+                self._windows_forward,
+                in_shardings=(repl, rows_sh, rows_sh),
+                out_shardings=out_sh)
 
     def _windows_forward(self, stacked, windows, valid):
         """(R, W, L) windows + (R, W) mask -> (M, R, C) masked window mean."""
@@ -222,7 +252,15 @@ class Committee:
                 for slot, (i, _) in enumerate(on_host):
                     order[i] = n_dev + slot
                 blocks.append(jnp.take(combined, jnp.asarray(order), axis=0))
-        return jnp.concatenate(blocks, axis=0) if len(blocks) > 1 else blocks[0]
+        if len(blocks) == 1:
+            return blocks[0]
+        if self.mesh is not None:
+            # Blocks carry different placements (mesh-sharded CNN block,
+            # host/default-device tables); merge on host — the probs table is
+            # tiny next to the CNN forward, and the sharded scoring fns
+            # re-shard it on upload anyway.
+            return np.concatenate([np.asarray(b) for b in blocks], axis=0)
+        return jnp.concatenate(blocks, axis=0)
 
     # -- device-side GNB/SGD inference (ops.device_members) ----------------
 
@@ -329,8 +367,20 @@ class Committee:
         """
         rows = store.row_of(song_ids)
         if self.full_song_hop is None:
-            return self._infer(self._stacked(), store.sample_crops(key, rows))
+            # Crops are sampled at the UNpadded batch width so the random
+            # stream matches the single-device path bit-for-bit; mesh mode
+            # then pads to a shard-divisible width (repeating the last crop)
+            # and slices the padding back off.
+            crops = store.sample_crops(key, rows)
+            pad = -len(rows) % self._n_pool_shards
+            if pad:
+                crops = jnp.concatenate(
+                    [crops, jnp.repeat(crops[-1:], pad, axis=0)])
+            out = self._infer(self._stacked(), crops)
+            return out[:, : len(rows)] if pad else out
         n = len(rows)
+        # each window chunk is one sharded dispatch; keep it shard-divisible
+        chunk = _round_up(chunk, self._n_pool_shards)
         stacked = self._stacked()
         if n == 0:
             m = len(self.cnn_members)

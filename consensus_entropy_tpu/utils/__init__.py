@@ -1,1 +1,7 @@
 """Host utilities: checkpointing, profiling, structured logging."""
+
+
+def round_up(n: int, multiple: int) -> int:
+    """Smallest multiple of ``multiple`` that is >= ``n`` (fixed-shape
+    padding for the shard-divisibility contract)."""
+    return ((n + multiple - 1) // multiple) * multiple

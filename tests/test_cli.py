@@ -171,3 +171,19 @@ def test_cnn_jax_pretrain_cli(synth_roots, tmp_path, rng):
     pre = os.path.join(synth_roots["models"], "pretrained")
     assert glob.glob(os.path.join(pre, "classifier_cnn.it_0.msgpack"))
     assert glob.glob(str(tmp_path / "tb" / "fold_0" / "events.out.*"))
+
+
+def test_mesh_auto_cli(synth_roots, capsys):
+    """--mesh auto routes the production AL path through the pool-sharded
+    scorers (8 virtual devices under the test harness)."""
+    flags = ["--models-root", synth_roots["models"],
+             "--deam-root", synth_roots["deam"],
+             "--amg-root", synth_roots["amg"], "--device", "cpu"]
+    assert deam_classifier.main(["-cv", "2", "-m", "gnb"] + flags) == 0
+    rc = amg_test.main(["-q", "4", "-e", "2", "-m", "mc", "-n", "10",
+                        "--max-users", "1", "--mesh", "auto",
+                        "--pad-pool-to", "64"] + flags)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Scoring mesh: 8 device(s)" in out
+    assert "final mean F1" in out

@@ -1,0 +1,113 @@
+"""Production AL path through the pool-sharded scorers.
+
+The 8-virtual-device mesh run must reproduce the single-device trajectory
+bit-for-bit (tie_break='fast'): the sharded mean/entropy are row-local (same
+arithmetic per row), the top-k candidate merge is index-stable, and crop
+sampling happens at the unpadded batch width — so sharding changes WHERE the
+work runs, never the result.  Reference scoring chain: amg_test.py:425-447.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from consensus_entropy_tpu.al import state as al_state
+from consensus_entropy_tpu.al.loop import ALLoop, UserData
+from consensus_entropy_tpu.config import ALConfig, CNNConfig, TrainConfig
+from consensus_entropy_tpu.data.audio import DeviceWaveformStore
+from consensus_entropy_tpu.models import short_cnn
+from consensus_entropy_tpu.models.committee import (
+    CNNMember,
+    Committee,
+    FramePool,
+)
+from consensus_entropy_tpu.models.sklearn_members import GNBMember, SGDMember
+from consensus_entropy_tpu.parallel.mesh import make_pool_mesh
+
+TINY = CNNConfig(n_channels=4, n_mels=32, n_layers=5, input_length=8192)
+
+
+def _user_data(seed=3, n_songs=24, f=10, waves=False):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((4, f)).astype(np.float32) * 2.0
+    rows, sids, labels = [], [], {}
+    for i in range(n_songs):
+        sid = f"song{i:03d}"
+        c = int(rng.integers(0, 4))
+        labels[sid] = c
+        k = int(rng.integers(3, 7))
+        rows.append(centers[c]
+                    + rng.standard_normal((k, f)).astype(np.float32))
+        sids += [sid] * k
+    pool = FramePool(np.vstack(rows), sids)
+    counts = rng.integers(1, 30, size=(n_songs, 4))
+    hc = np.round(counts / counts.sum(1, keepdims=True), 3).astype(np.float32)
+    store = None
+    if waves:
+        store = DeviceWaveformStore(
+            {s: rng.standard_normal(9000).astype(np.float32)
+             for s in pool.song_ids}, TINY.input_length)
+    return UserData("u0", pool, labels, hc_rows=hc, store=store)
+
+
+def _host_members(seed=7, f=10):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((60, f)).astype(np.float32)
+    y = np.tile(np.arange(4), 15)
+    return [GNBMember().fit(X, y), SGDMember(seed=0).fit(X, y)]
+
+
+def _run(path, mode, *, mesh=None, pad_to=None, cnn=False, n_songs=24,
+         epochs=3, queries=4):
+    path.mkdir(parents=True, exist_ok=True)
+    data = _user_data(3, n_songs=n_songs, waves=cnn)
+    cnns = []
+    if cnn:
+        cnns = [CNNMember(f"cnn{i}",
+                          short_cnn.init_variables(jax.random.key(i), TINY),
+                          TINY)
+                for i in range(2)]
+    com = Committee(_host_members(), cnns, TINY, TrainConfig(batch_size=2),
+                    mesh=mesh)
+    loop = ALLoop(ALConfig(queries=queries, epochs=epochs, mode=mode,
+                           seed=11),
+                  mesh=mesh, pad_pool_to=pad_to,
+                  retrain_epochs=1 if cnn else None)
+    res = loop.run_user(com, data, str(path))
+    queried = al_state.ALState.load(str(path)).queried
+    return res["trajectory"], queried
+
+
+@pytest.mark.parametrize("mode", ["mc", "hc", "mix", "rand"])
+def test_sharded_loop_bitwise_matches_single_device(tmp_path, mode):
+    traj_a, q_a = _run(tmp_path / "a", mode)
+    traj_b, q_b = _run(tmp_path / "b", mode, mesh=make_pool_mesh())
+    assert q_a == q_b
+    assert traj_a == traj_b  # exact float equality, not allclose
+
+
+def test_sharded_cnn_loop_matches_single_device(tmp_path):
+    traj_a, q_a = _run(tmp_path / "a", "mc", cnn=True, n_songs=10, epochs=2,
+                       queries=3)
+    traj_b, q_b = _run(tmp_path / "b", "mc", mesh=make_pool_mesh(), cnn=True,
+                       n_songs=10, epochs=2, queries=3)
+    assert q_a == q_b
+    assert traj_a == traj_b
+
+
+def test_pad_pool_to_does_not_change_selection(tmp_path):
+    # mc entropy is mask-invariant to padding width (rand is not: its
+    # uniform draw is shaped by the padded pool, documented behavior)
+    traj_a, q_a = _run(tmp_path / "a", "mc")
+    traj_b, q_b = _run(tmp_path / "b", "mc", pad_to=64)
+    assert q_a == q_b
+    assert traj_a == traj_b
+
+
+def test_mesh_pad_width_is_shard_divisible(tmp_path):
+    from consensus_entropy_tpu.al.acquisition import Acquirer
+
+    acq = Acquirer([f"s{i}" for i in range(13)], None, queries=4, mode="mc",
+                   mesh=make_pool_mesh(), pad_to=50)
+    assert acq.n_pad % 8 == 0 and acq.n_pad >= 50
