@@ -335,12 +335,81 @@ def run_cnn_suite(args_ns) -> int:
     return 0
 
 
+def run_retrain_suite(args_ns) -> int:
+    """Committee CNN retraining: ONE vmapped jit per epoch for all M members
+    (``CNNTrainer.fit_many``) vs the sequential per-member loop the reference
+    runs (``amg_test.py:496-502``, hot loop #2).  Reports the vmapped
+    per-epoch latency; ``vs_baseline`` is sequential/vmapped total wall-clock
+    — the factor by which per-iteration retraining stops scaling in M."""
+    import jax
+
+    from consensus_entropy_tpu.config import CNNConfig, TrainConfig
+    from consensus_entropy_tpu.data.audio import DeviceWaveformStore
+    from consensus_entropy_tpu.models import short_cnn
+    from consensus_entropy_tpu.models.cnn_trainer import CNNTrainer
+
+    config = CNNConfig()
+    n_members = 5 if args_ns.members is None else args_ns.members
+    n_epochs = args_ns.retrain_epochs
+    q, n_test = 10, 4  # the reference query batch (-q 10) + a small test set
+    rng = np.random.default_rng(1987)
+    waves = {f"s{i}": (rng.standard_normal(70000) * 0.05).astype(np.float32)
+             for i in range(q + n_test)}
+    store = DeviceWaveformStore(waves, config.input_length)
+    ids = list(waves)
+    train_ids, test_ids = ids[:q], ids[q:]
+    y_tr = np.eye(4, dtype=np.float32)[rng.integers(0, 4, q)]
+    y_te = np.eye(4, dtype=np.float32)[rng.integers(0, 4, n_test)]
+    members = [short_cnn.init_variables(jax.random.key(i), config)
+               for i in range(n_members)]
+    _log(f"devices: {jax.devices()}")
+    _log(f"retrain: {n_members} members x {n_epochs} epochs on q={q} songs "
+         f"(full {config.input_length}-sample geometry)")
+
+    def copies():
+        return [jax.tree.map(lambda a: a.copy(), v) for v in members]
+
+    key = jax.random.key(7)
+    trainer = CNNTrainer(config, TrainConfig())
+    # warm-up: compile both epoch programs outside the timed windows
+    trainer.fit(copies()[0], store, train_ids, y_tr, test_ids, y_te, key,
+                n_epochs=1)
+    trainer.fit_many(copies(), store, train_ids, y_tr, test_ids, y_te, key,
+                     n_epochs=1)
+
+    t0 = time.perf_counter()
+    for i, v in enumerate(copies()):
+        trainer.fit(v, store, train_ids, y_tr, test_ids, y_te,
+                    jax.random.fold_in(key, i), n_epochs=n_epochs)
+    seq_s = time.perf_counter() - t0
+    _log(f"[sequential] {n_members} fit loops: {seq_s * 1e3:.0f} ms "
+         f"({seq_s / n_epochs / n_members * 1e3:.1f} ms/member-epoch)")
+
+    t0 = time.perf_counter()
+    trainer.fit_many(copies(), store, train_ids, y_tr, test_ids, y_te, key,
+                     n_epochs=n_epochs)
+    vmap_s = time.perf_counter() - t0
+    ms_epoch = vmap_s / n_epochs * 1e3
+    _log(f"[vmapped] one lockstep loop: {vmap_s * 1e3:.0f} ms "
+         f"({ms_epoch:.1f} ms/epoch for all {n_members} members)")
+
+    print(json.dumps({
+        "metric": f"cnn_committee_retrain_epoch_{n_members}m_q{q}",
+        "value": round(ms_epoch, 3),
+        "unit": "ms",
+        "vs_baseline": round(seq_s / vmap_s, 2),
+    }))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--suite", choices=("linear", "cnn"), default="linear",
+    ap.add_argument("--suite", choices=("linear", "cnn", "retrain"),
+                    default="linear",
                     help="linear: the north-star fused pool scoring; cnn: "
                          "Flax ShortChunkCNN committee inference "
-                         "(BASELINE configs[3])")
+                         "(BASELINE configs[3]); retrain: vmapped committee "
+                         "retraining vs the sequential member loop")
     ap.add_argument("--members", type=int, default=None,
                     help="committee size (default: 16 linear / 5 cnn)")
     ap.add_argument("--pool", type=int, default=None,
@@ -357,6 +426,8 @@ def main(argv=None) -> int:
                     help="rank queries inside the pallas kernel")
     ap.add_argument("--chain", type=int, default=150,
                     help="iterations per in-program timing window")
+    ap.add_argument("--retrain-epochs", type=int, default=8,
+                    help="epochs per timed window (retrain suite)")
     ap.add_argument("--trials", type=int, default=5)
     ap.add_argument("--cpu-reps", type=int, default=3)
     args_ns = ap.parse_args(argv)
@@ -371,6 +442,8 @@ def main(argv=None) -> int:
         args_ns.members = 5 if args_ns.members is None else args_ns.members
         args_ns.pool = 48 if args_ns.pool is None else args_ns.pool
         return run_cnn_suite(args_ns)
+    if args_ns.suite == "retrain":
+        return run_retrain_suite(args_ns)
     args_ns.members = 16 if args_ns.members is None else args_ns.members
     args_ns.pool = 100_000 if args_ns.pool is None else args_ns.pool
 
@@ -399,11 +472,10 @@ def main(argv=None) -> int:
             impls["pallas"] = build_pallas_impl(x, w, b, args_ns.k,
                                                 args_ns.tile_n,
                                                 args_ns.fuse_topk)
-            if (args_ns.impl == "auto" and not args_ns.fuse_topk
-                    and len(devices) == 1):
-                # auto also races the in-kernel top-k variant; which wins
-                # depends on pool size vs the XLA sort cost.  (The multi-
-                # chip path always fuses top-k for the candidate merge.)
+            if args_ns.impl == "auto" and not args_ns.fuse_topk:
+                # auto also races the in-kernel top-k variant (single- and
+                # multi-chip alike); which wins depends on pool size vs the
+                # XLA sort cost.
                 impls["pallas-fusedtopk"] = build_pallas_impl(
                     x, w, b, args_ns.k, args_ns.tile_n, True)
         else:

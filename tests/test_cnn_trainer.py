@@ -150,3 +150,103 @@ def test_zero_retrain_epochs_respected(rng):
     best, history = trainer.fit(variables, store, ids, y, ids, y,
                                 jax.random.key(1), n_epochs=0)
     assert history == []
+
+
+# -- vmapped multi-member training (fit_many) ------------------------------
+
+
+def test_fit_many_matches_sequential(rng):
+    """Lockstep vmap over members computes the same training as M separate
+    fit loops under the same fold_in key streams (the schedule is
+    epoch-indexed, so lockstep is exact up to XLA's batched-op fusion —
+    the vmapped conv/reduce kernels reassociate float math, so equality is
+    to tolerance, not bitwise)."""
+    waves, classes = _synthetic_pool(rng, 6)
+    store = DeviceWaveformStore(waves, TINY.input_length)
+    ids = list(waves)
+    y = one_hot_np([classes[s] for s in ids])
+    members = [short_cnn.init_variables(jax.random.key(i), TINY)
+               for i in range(2)]
+    key = jax.random.key(42)
+
+    seq_best, seq_hist = [], []
+    trainer_a = CNNTrainer(TINY, TrainConfig(batch_size=3))
+    for i, v in enumerate(members):
+        # fit donates its input buffers; keep `members` alive for fit_many
+        v = jax.tree.map(lambda a: a.copy(), v)
+        best, hist = trainer_a.fit(v, store, ids, y, ids[:2], y[:2],
+                                   jax.random.fold_in(key, i), n_epochs=3)
+        seq_best.append(best)
+        seq_hist.append(hist)
+
+    trainer_b = CNNTrainer(TINY, TrainConfig(batch_size=3))
+    many_best, many_hist = trainer_b.fit_many(
+        members, store, ids, y, ids[:2], y[:2], key, n_epochs=3)
+
+    for m in range(2):
+        for a, b in zip(seq_hist[m], many_hist[m]):
+            np.testing.assert_allclose(a["val_loss"], b["val_loss"],
+                                       rtol=1e-3)
+            np.testing.assert_allclose(a["train_loss"], b["train_loss"],
+                                       rtol=1e-3)
+        # Adam normalizes each step to ~lr, so round-off in a near-zero
+        # gradient can flip a step's sign; params therefore agree to the
+        # accumulated-step scale (3 epochs x 2 batches x lr=1e-4), not rtol.
+        flat_a = jax.tree.leaves(seq_best[m]["params"])
+        flat_b = jax.tree.leaves(many_best[m]["params"])
+        for la, lb in zip(flat_a, flat_b):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                       rtol=0, atol=2e-3)
+
+
+def test_fit_many_member_sharded_mesh(rng):
+    """fit_many over a (dp, member) training mesh: member axis sharded
+    across chips, same results as the unsharded vmap."""
+    from consensus_entropy_tpu.parallel.mesh import make_training_mesh
+
+    waves, classes = _synthetic_pool(rng, 6)
+    store = DeviceWaveformStore(waves, TINY.input_length)
+    ids = list(waves)
+    y = one_hot_np([classes[s] for s in ids])
+    members = [short_cnn.init_variables(jax.random.key(i), TINY)
+               for i in range(4)]
+    key = jax.random.key(7)
+    mesh = make_training_mesh(dp=2, member=4)
+
+    plain_best, plain_hist = CNNTrainer(TINY, TrainConfig(batch_size=3)) \
+        .fit_many(members, store, ids, y, ids[:2], y[:2], key, n_epochs=2)
+    mesh_best, mesh_hist = CNNTrainer(TINY, TrainConfig(batch_size=3)) \
+        .fit_many(members, store, ids, y, ids[:2], y[:2], key, n_epochs=2,
+                  mesh=mesh)
+
+    for m in range(4):
+        for a, b in zip(plain_hist[m], mesh_hist[m]):
+            # GSPMD-partitioned kernels reassociate float math; agreement
+            # is to tolerance, not bitwise
+            np.testing.assert_allclose(a["val_loss"], b["val_loss"],
+                                       rtol=1e-3)
+
+
+def test_bad_retrain_keeps_incoming_member(rng):
+    """Best-checkpoint gate parity (amg_test.py:295): best_metric starts at
+    0, so a retrain where every epoch has val_loss >= 1 (score <= 0) keeps
+    the member's INCOMING weights."""
+    waves, classes = _synthetic_pool(rng, 4)
+    store = DeviceWaveformStore(waves, TINY.input_length)
+    ids = list(waves)
+    # a member biased to predict ~1 for every class ...
+    variables = short_cnn.init_variables(jax.random.key(3), TINY)
+    variables["params"]["dense2"]["bias"] = (
+        variables["params"]["dense2"]["bias"] + 10.0)
+    # ... evaluated against all-zero targets: val BCE ~= 10 >> 1 every epoch
+    y_zero = np.zeros((len(ids), 4), np.float32)
+    trainer = CNNTrainer(TINY, TrainConfig(batch_size=2))
+    incoming = jax.tree.map(lambda a: np.asarray(a).copy(),
+                            variables["params"])  # fit donates its input
+    best, hist = trainer.fit(variables, store, ids, y_zero, ids, y_zero,
+                             jax.random.key(0), n_epochs=2)
+    assert all(h["val_loss"] > 1.0 for h in hist)
+    assert not any(h["improved"] for h in hist)
+    for la, lb in zip(jax.tree.leaves(incoming),
+                      jax.tree.leaves(best["params"])):
+        np.testing.assert_array_equal(la, np.asarray(lb))
