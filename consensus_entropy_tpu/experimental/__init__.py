@@ -1,0 +1,17 @@
+"""Experimental kernels — not on the default execution path.
+
+Modules here are functional and tested but LOSE to (or only tie) the
+plain-XLA implementations at production scale, so nothing selects them by
+default.  Current residents:
+
+- ``pallas_scoring`` — the hand-fused Mosaic pool-scoring kernel.  Measured
+  verdict (BENCH_r01.json, v5e, 16 members x 100k pool): xla 1.365 ms/iter
+  vs pallas 1.439 ms vs pallas-fusedtopk 1.814 ms, with a ~92 s Mosaic
+  compile vs ~14 s for XLA.  The op is HBM-bandwidth-bound and XLA already
+  fuses the einsum→softmax→mean→entropy chain into a single GEMM consumer,
+  so the hand kernel has no traffic left to remove (bf16 feature tiles fail
+  the 1e-3 entropy parity gate).  It still wins on SMALL pools (~2k rows)
+  where its single fused dispatch amortizes better, and remains reachable
+  via ``bench.py --impl pallas``.  Revisit only if the op's balance changes
+  (e.g. more classes/members making it compute-bound).
+"""

@@ -52,6 +52,15 @@ def bce_loss(preds, targets):
     return jnp.mean(bce_per_sample(preds, targets))
 
 
+def _weighted_f1(y_true: np.ndarray, preds) -> float:
+    """Per-epoch validation weighted F1 (``amg_test.py:264``,
+    ``deam_classifier.py:137-138``)."""
+    from sklearn.metrics import f1_score
+
+    return float(f1_score(y_true, np.asarray(preds).argmax(axis=1),
+                          average="weighted", zero_division=0))
+
+
 def make_tx(phase: str, cfg: TrainConfig) -> optax.GradientTransformation:
     """Optimizer for a schedule phase, torch-coupled weight decay."""
     if phase == "adam":
@@ -259,6 +268,7 @@ class CNNTrainer:
         test_rows = jnp.asarray(store.row_of(test_ids))
         train_y = jnp.asarray(train_y)
         test_y = jnp.asarray(test_y)
+        y_true_np = np.asarray(test_y).argmax(axis=1)
 
         params = variables["params"]
         batch_stats = variables["batch_stats"]
@@ -292,6 +302,7 @@ class CNNTrainer:
             info = {"epoch": epoch, "phase": phase,
                     "train_loss": float(train_loss),
                     "val_loss": float(val_loss),
+                    "val_f1": _weighted_f1(y_true_np, preds),
                     "improved": bool(improved)}
             history.append(info)
             if callback is not None:
@@ -340,6 +351,7 @@ class CNNTrainer:
         test_rows = jnp.asarray(store.row_of(test_ids))
         train_y = jnp.asarray(train_y)
         test_y = jnp.asarray(test_y)
+        y_true_np = np.asarray(test_y).argmax(axis=1)
 
         stacked = stack_params(variables_list)
         params = stacked["params"]
@@ -365,7 +377,7 @@ class CNNTrainer:
             state["keys"], subs = splits[:, 0], splits[:, 1]
             (state["params"], state["batch_stats"], state["opt_state"],
              state["best_params"], state["best_stats"], state["best_score"],
-             train_loss, val_loss, _preds, improved) = fn(
+             train_loss, val_loss, preds, improved) = fn(
                 state["params"], state["batch_stats"], state["opt_state"],
                 state["best_params"], state["best_stats"],
                 state["best_score"], store.data, store.lengths, train_rows,
@@ -373,11 +385,13 @@ class CNNTrainer:
             train_loss = np.asarray(train_loss)
             val_loss = np.asarray(val_loss)
             improved = np.asarray(improved)
+            preds = np.asarray(preds)
             infos = []
             for m in range(n_members):
                 info = {"epoch": epoch, "phase": phase,
                         "train_loss": float(train_loss[m]),
                         "val_loss": float(val_loss[m]),
+                        "val_f1": _weighted_f1(y_true_np, preds[m]),
                         "improved": bool(improved[m])}
                 histories[m].append(info)
                 infos.append(info)

@@ -67,13 +67,35 @@ class FramePool:
         return int(self.counts[self._index[song]])
 
     def mean_by_song(self, frame_values: np.ndarray) -> np.ndarray:
+        return self.mean_over_segments(frame_values, self._starts)
+
+    def segment_view(self, songs: Sequence):
+        """``(rows, starts)`` for a packed sub-table holding only ``songs``'
+        frames, in ``songs`` order: ``rows`` indexes ``X``; ``starts`` are
+        the n+1 segment boundaries of the packed table (``segment_mean``
+        layout).  Lets callers score a shrinking pool without touching the
+        removed songs' frames (the reference scores only the live
+        ``X_train`` — ``amg_test.py:435``)."""
+        counts = np.array([self.counts[self._index[s]] for s in songs],
+                          np.int64)
+        rows = np.concatenate(
+            [np.arange(self.offsets[self._index[s]],
+                       self.offsets[self._index[s]] + self.counts[self._index[s]])
+             for s in songs]) if len(songs) else np.empty(0, np.int64)
+        starts = np.r_[0, np.cumsum(counts)].astype(np.int64)
+        return rows, starts
+
+    def mean_over_segments(self, frame_values: np.ndarray,
+                           starts: np.ndarray) -> np.ndarray:
+        """Per-segment mean over n+1 boundaries (``segment_view`` layout;
+        :meth:`mean_by_song` is the full-table case).  float32 2-D tables
+        take the threaded C++ path (``native.segment_mean`` falls back to
+        numpy when the toolchain is absent)."""
         frame_values = np.asarray(frame_values)
         if frame_values.dtype == np.float32 and frame_values.ndim == 2:
-            # Threaded C++ segment mean (native.segment_mean falls back to
-            # numpy when the toolchain is absent).
-            return native.segment_mean(frame_values, self._starts)
-        sums = np.add.reduceat(frame_values, self.offsets, axis=0)
-        return sums / self.counts[:, None]
+            return native.segment_mean(frame_values, starts)
+        sums = np.add.reduceat(frame_values, starts[:-1], axis=0)
+        return sums / np.diff(starts)[:, None]
 
     def rows_for_songs(self, songs: Sequence) -> np.ndarray:
         """Row indices of all frames belonging to ``songs`` (batch build)."""
@@ -234,9 +256,16 @@ class Committee:
                 dev_block = self._device_member_probs(pool, on_device)[:, sel]
             host_np = np.empty((len(on_host), len(song_ids), NUM_CLASSES),
                                np.float32)
-            for slot, (_, m) in enumerate(on_host):
-                frame_p = m.predict_proba(pool.X)
-                host_np[slot] = pool.mean_by_song(frame_p)[sel]
+            if on_host:
+                # host members score ONLY the live songs' frames — the
+                # serial host cost shrinks with the pool, as the reference's
+                # does (amg_test.py:435 scores the live X_train)
+                live_rows, seg_starts = pool.segment_view(song_ids)
+                X_live = pool.X[live_rows]
+                for slot, (_, m) in enumerate(on_host):
+                    frame_p = m.predict_proba(X_live)
+                    host_np[slot] = pool.mean_over_segments(frame_p,
+                                                            seg_starts)
             if dev_block is None:
                 blocks.append(jnp.asarray(host_np))  # one H2D transfer
             else:

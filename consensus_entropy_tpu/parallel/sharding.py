@@ -115,10 +115,10 @@ def make_shardmap_pallas_mc_scorer(mesh: Mesh, *, n_members: int, k: int,
                                    fuse_topk: bool = True,
                                    interpret: bool = False):
     """Multi-chip variant of the hand-fused Pallas scorer
-    (``ops.pallas_scoring``): each chip runs the Mosaic kernel on its own
+    (``experimental.pallas_scoring``): each chip runs the Mosaic kernel on its own
     contiguous block of pool tiles, ranks its local candidates (in-kernel
     when ``fuse_topk``, else one local XLA ``lax.top_k`` — relative speed is
-    pool-size dependent, see ``ops.pallas_scoring``), then the ``k``
+    pool-size dependent, see ``experimental.pallas_scoring``), then the ``k``
     per-chip candidates merge via ``all_gather`` + a tiny replicated top-k —
     identical O(k·D) ICI pattern to :func:`make_shardmap_mc_scorer`, with
     the member forward fused too.
@@ -128,7 +128,7 @@ def make_shardmap_pallas_mc_scorer(mesh: Mesh, *, n_members: int, k: int,
     axis.  Tie semantics are 'fast' (lowest global index wins).  ``interpret``
     runs the kernel in the Pallas interpreter (CPU-mesh tests).
     """
-    from consensus_entropy_tpu.ops import pallas_scoring
+    from consensus_entropy_tpu.experimental import pallas_scoring
 
     def _local(x_tiles_local, w_packed, b_packed, mask_local):
         ent, v, i = pallas_scoring.packed_score_mc(

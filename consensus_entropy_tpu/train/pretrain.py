@@ -179,6 +179,8 @@ def _write_tensorboard(run_dir: str, history: list[dict], f1: float) -> None:
         for rec in history:
             w.add_scalar("Loss/train", rec["train_loss"], rec["epoch"])
             w.add_scalar("Loss/valid", rec["val_loss"], rec["epoch"])
+            if "val_f1" in rec:  # per-epoch F1, deam_classifier.py:314-316
+                w.add_scalar("F1/valid", rec["val_f1"], rec["epoch"])
         w.add_scalar("F1/fold", f1, len(history))
 
 

@@ -250,3 +250,22 @@ def test_bad_retrain_keeps_incoming_member(rng):
     for la, lb in zip(jax.tree.leaves(incoming),
                       jax.tree.leaves(best["params"])):
         np.testing.assert_array_equal(la, np.asarray(lb))
+
+
+def test_history_records_val_f1_per_epoch(rng):
+    """Reference computes weighted F1 every validation pass (amg_test.py:264)
+    and logs it per epoch (deam_classifier.py:314-316)."""
+    waves, classes = _synthetic_pool(rng, 4)
+    store = DeviceWaveformStore(waves, TINY.input_length)
+    ids = list(waves)
+    y = one_hot_np([classes[s] for s in ids])
+    trainer = CNNTrainer(TINY, TrainConfig(batch_size=2))
+    _, hist = trainer.fit(short_cnn.init_variables(jax.random.key(0), TINY),
+                          store, ids, y, ids, y, jax.random.key(1),
+                          n_epochs=2)
+    assert all(0.0 <= h["val_f1"] <= 1.0 for h in hist)
+    _, hists = trainer.fit_many(
+        [short_cnn.init_variables(jax.random.key(i), TINY) for i in range(2)],
+        store, ids, y, ids, y, jax.random.key(2), n_epochs=2)
+    for h in hists:
+        assert all(0.0 <= e["val_f1"] <= 1.0 for e in h)

@@ -123,3 +123,30 @@ def test_committee_save(tmp_path, rng):
     assert any(f.startswith("classifier_cnn") for f in files)
     assert any(f.startswith("classifier_gnb") for f in files)
     assert any(f.startswith("classifier_sgd") for f in files)
+
+
+def test_host_scoring_restricted_to_live_songs(rng):
+    """Host members score ONLY the live songs' frames (amg_test.py:435
+    scores the shrinking X_train), and the per-song means match the
+    full-table-then-slice result exactly."""
+    com = _committee(rng, n_cnn=0)
+    pool = _frame_pool(rng, n_songs=8, f=12)
+    live = pool.song_ids[::2] + pool.song_ids[1:2]  # subset, mixed order
+    probs = np.asarray(com.pool_probs(pool, None, live, jax.random.key(0)))
+    sel = [pool.song_ids.index(s) for s in live]
+    for i, m in enumerate(com.host_members):
+        full = pool.mean_by_song(m.predict_proba(pool.X))
+        np.testing.assert_allclose(probs[i], full[sel], rtol=1e-6)
+
+    # spy member: the frame table it sees must be exactly the live frames
+    seen = {}
+
+    class Spy:
+        def predict_proba(self, X):
+            seen["n"] = len(X)
+            return np.full((len(X), NUM_CLASSES), 0.25, np.float32)
+
+    com.host_members.append(Spy())
+    com.pool_probs(pool, None, live, jax.random.key(0))
+    assert seen["n"] == sum(pool.count_of(s) for s in live)
+    assert seen["n"] < len(pool.X)

@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 from scipy.stats import entropy as scipy_entropy
 
-from consensus_entropy_tpu.ops import pallas_scoring
+from consensus_entropy_tpu.experimental import pallas_scoring
 
 
 def _make_problem(rng, m=3, n=50, k_frames=2, f=12, c=4):
