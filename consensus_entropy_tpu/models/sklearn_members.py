@@ -236,7 +236,20 @@ class BoostedTreesMember(_PickledSklearnMember):
     Class preservation: the estimator is always first fit with all 4 classes
     present (the pre-trainer guarantees this); warm-start updates keep
     ``classes_`` fixed, and query batches are boosted as additional stages.
-    """
+
+    Approximation envelope vs true continued boosting (the reference's
+    patched ``xgboost/sklearn.py:854-860,911-927``): sklearn's warm-start
+    refuses batches missing a class, so class-deficient updates are padded
+    with ONE remembered anchor row per missing class.  When the batch
+    contains every class the update is exact warm-start boosting; when it
+    does not, the anchors re-enter the gradient of the new stages, so stage
+    weights differ slightly from xgboost's (which boosts the raw batch
+    against the preserved 4-class objective).  Under many successive
+    single-class updates the 1-row-per-class anchors are a weak
+    counterweight: drift toward the batch's class is somewhat faster than
+    xgboost's.  Both paths keep ``classes_``/the 4-column probability
+    contract intact (pinned by the shared contract tests in
+    ``tests/test_members.py``)."""
 
     kind = "xgb"  # fills the xgb committee slot
 

@@ -29,16 +29,29 @@ from consensus_entropy_tpu.native.build import load_library
 
 _MAX_CLASSES = 64  # jll scratch bound in ce_gnb_predict_proba
 
-_lib = load_library()
+#: deferred-build sentinel: the g++ subprocess must not run as an import
+#: side effect of models/committee.py etc. — only on first native call.
+_UNBUILT = object()
+
+_lib = _UNBUILT
+
+
+def _get_lib():
+    """Memoized build/load of the C++ core (None = numpy fallback)."""
+    global _lib
+    if _lib is _UNBUILT:
+        _lib = load_library()
+    return _lib
 
 
 def backend() -> str:
     """Which implementation is active: ``'native'`` or ``'numpy'``."""
-    return "native" if _lib is not None else "numpy"
+    return "native" if _get_lib() is not None else "numpy"
 
 
 def num_threads() -> int:
-    return _lib.ce_num_threads() if _lib is not None else 1
+    lib = _get_lib()
+    return lib.ce_num_threads() if lib is not None else 1
 
 
 def _c_f32(a):
@@ -59,9 +72,10 @@ def linear_predict_proba(X, W, b, mode: str = "softmax") -> np.ndarray:
     if f2 != f or b.shape != (c,):
         raise ValueError(f"shape mismatch: X {X.shape} W {W.shape} b {b.shape}")
     imode = {"softmax": 0, "ova": 1}[mode]
-    if _lib is not None:
+    lib = _get_lib()
+    if lib is not None:
         out = np.empty((n, c), np.float32)
-        _lib.ce_linear_predict_proba(
+        lib.ce_linear_predict_proba(
             X, n, f, W, b, c, imode,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
         return out
@@ -96,9 +110,10 @@ def gnb_predict_proba(X, theta, var, class_prior) -> np.ndarray:
                          f"var {var.shape} prior {log_prior.shape}")
     if c > _MAX_CLASSES:
         raise ValueError(f"at most {_MAX_CLASSES} classes (got {c})")
-    if _lib is not None:
+    lib = _get_lib()
+    if lib is not None:
         out = np.empty((n, c), np.float32)
-        _lib.ce_gnb_predict_proba(
+        lib.ce_gnb_predict_proba(
             X, n, f, theta, var, log_prior, c,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
         return out
@@ -136,9 +151,10 @@ def segment_mean(X, starts) -> np.ndarray:
             or (n_segs > 0 and np.any(np.diff(starts) < 0))):
         raise ValueError("starts must be non-decreasing offsets from 0 to "
                          "n_rows")
-    if _lib is not None:
+    lib = _get_lib()
+    if lib is not None:
         out = np.empty((n_segs, c), np.float32)
-        _lib.ce_segment_mean(
+        lib.ce_segment_mean(
             X, n, c, starts, n_segs,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
         return out
@@ -154,9 +170,10 @@ def row_entropy(P) -> np.ndarray:
     """scipy.stats.entropy semantics per row (normalize, nats)."""
     P = _c_f32(P)
     n, c = P.shape
-    if _lib is not None:
+    lib = _get_lib()
+    if lib is not None:
         out = np.empty(n, np.float32)
-        _lib.ce_row_entropy(
+        lib.ce_row_entropy(
             P, n, c, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
         return out
     pd = P.astype(np.float64)

@@ -36,6 +36,15 @@ def gnb_log_likelihood(x, theta, var, log_prior):
     """Per-class joint log-likelihood of GaussianNB.
 
     x: ``(N, F)``; theta/var: ``(C, F)``; log_prior: ``(C,)`` -> ``(N, C)``.
+
+    Numerics: the Mahalanobis term uses the EXPANDED form (three f32
+    matmuls) rather than sklearn's float64 ``(x−θ)²`` — it is subject to
+    catastrophic cancellation when ``|x| >> |x−θ|``, so agreement with
+    sklearn is to ~1e-3 relative on StandardScaler-scaled features (the
+    framework's pools are; tests pin this), NOT "identical math".  Entropy
+    ranks of near-ties (gaps below ~1e-4 nats) can reorder vs the host
+    path.  This trade-off is why ``--device-members`` is opt-in; feed
+    unscaled features at your own risk.
     """
     x = jnp.asarray(x)
     theta = jnp.asarray(theta)
