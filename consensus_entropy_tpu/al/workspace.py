@@ -112,12 +112,17 @@ def load_committee(path: str, config: CNNConfig = CNNConfig(),
 
 
 def _load_boosted(path: str) -> Member:
+    """One unpickle, then dispatch on content (three coexisting formats)."""
     import pickle
 
     with open(path, "rb") as f:
         state = pickle.load(f)
+    if state.get("fmt") == "native_gbdt":
+        from consensus_entropy_tpu.models.gbdt import NativeGBDTMember
+
+        return NativeGBDTMember.from_state(state)
     if "raw" in state:
         from consensus_entropy_tpu.models.sklearn_members import XGBMember
 
-        return XGBMember.load(path)
-    return BoostedTreesMember.load(path)
+        return XGBMember.from_state(state)
+    return BoostedTreesMember.from_state(state)

@@ -14,9 +14,11 @@ Incremental-update semantics reproduced:
   4-class softprob objective survives a query batch that lacks some classes.
   Here that semantics is a thin wrapper around ``xgboost.train`` with
   ``num_class`` pinned — no vendored library fork.  When xgboost is not
-  installed (this image ships without it), ``BoostedTreesMember`` falls back
-  to sklearn ``GradientBoostingClassifier`` warm-start boosting with the same
-  class-preservation contract.
+  installed, :func:`make_boosted_member` fills the slot with the first-party
+  histogram GBDT (``models/gbdt.py`` — exact continued-boosting semantics,
+  C++/OpenMP core); ``BoostedTreesMember`` (sklearn
+  ``GradientBoostingClassifier`` warm-start with anchor-row class padding)
+  remains as an opt-in comparison baseline (``impl='sklearn'``).
 """
 
 from __future__ import annotations
@@ -217,9 +219,7 @@ class XGBMember(Member):
                          "n_estimators": self.n_estimators, "raw": raw}, f)
 
     @classmethod
-    def load(cls, path):
-        with open(path, "rb") as f:
-            state = pickle.load(f)
+    def from_state(cls, state: dict) -> "XGBMember":
         obj = cls(state["name"])
         obj.params = state["params"]
         obj.n_estimators = state["n_estimators"]
@@ -227,6 +227,11 @@ class XGBMember(Member):
             obj.booster = _xgb.Booster(model_file=None)
             obj.booster.load_model(bytearray(state["raw"]))
         return obj
+
+    @classmethod
+    def load(cls, path):
+        with open(path, "rb") as f:
+            return cls.from_state(pickle.load(f))
 
 
 class BoostedTreesMember(_PickledSklearnMember):
@@ -302,9 +307,7 @@ class BoostedTreesMember(_PickledSklearnMember):
                          "class_rows": getattr(self, "_class_rows", {})}, f)
 
     @classmethod
-    def load(cls, path):
-        with open(path, "rb") as f:
-            state = pickle.load(f)
+    def from_state(cls, state: dict) -> "BoostedTreesMember":
         obj = cls.__new__(cls)
         Member.__init__(obj, state["name"])
         obj.estimator = state["estimator"]
@@ -312,9 +315,28 @@ class BoostedTreesMember(_PickledSklearnMember):
         obj._class_rows = state["class_rows"]
         return obj
 
+    @classmethod
+    def load(cls, path):
+        with open(path, "rb") as f:
+            return cls.from_state(pickle.load(f))
 
-def make_boosted_member(name: str = "xgb", seed: int = 0, **kw) -> Member:
-    """The boosted-trees committee slot: xgboost if present, else fallback."""
-    if HAVE_XGBOOST:
+
+def make_boosted_member(name: str = "xgb", seed: int = 0, *,
+                        impl: str = "auto", **kw) -> Member:
+    """The boosted-trees committee slot.
+
+    ``impl='auto'`` prefers xgboost when installed, then the first-party
+    :class:`~consensus_entropy_tpu.models.gbdt.NativeGBDTMember` (exact
+    continued-boosting semantics, C++/OpenMP core with numpy fallback), and
+    only uses the sklearn anchor-row approximation when forced
+    (``impl='sklearn'``, kept for comparison tests).
+    """
+    if impl not in ("auto", "xgboost", "native", "sklearn"):
+        raise ValueError(f"unknown boosted impl {impl!r}")
+    if impl == "xgboost" or (impl == "auto" and HAVE_XGBOOST):
         return XGBMember(name, seed=seed, **kw)
-    return BoostedTreesMember(name, seed=seed, **kw)
+    if impl == "sklearn":
+        return BoostedTreesMember(name, seed=seed, **kw)
+    from consensus_entropy_tpu.models.gbdt import NativeGBDTMember
+
+    return NativeGBDTMember(name, seed=seed, **kw)

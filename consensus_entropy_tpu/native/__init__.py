@@ -184,6 +184,183 @@ def row_entropy(P) -> np.ndarray:
     return (-plogp.sum(axis=1)).astype(np.float32)
 
 
+def gbdt_build_tree(Xb, g, h, *, max_depth: int, n_bins: int,
+                    lam: float = 1.0, min_child_weight: float = 1.0,
+                    min_gain: float = 0.0):
+    """Build one depth-limited regression tree on binned features.
+
+    ``Xb``: ``(n, f)`` uint8 bin codes; ``g``/``h``: float32 gradients and
+    hessians.  Returns ``(feature, threshold, value)`` in the complete-heap
+    layout of ``native/ce_gbdt.cpp`` (``feature[i] == -1`` marks a leaf;
+    rows with ``bin <= threshold`` descend left).  The numpy fallback is the
+    same algorithm with identical double accumulation order, so both
+    backends produce identical trees.
+    """
+    Xb = np.ascontiguousarray(Xb, np.uint8)
+    g = _c_f32(g)
+    h = _c_f32(h)
+    n, f = Xb.shape
+    if g.shape != (n,) or h.shape != (n,):
+        raise ValueError(f"shape mismatch: Xb {Xb.shape} g {g.shape} "
+                         f"h {h.shape}")
+    if not 2 <= n_bins <= 256:
+        raise ValueError(f"n_bins must be in [2, 256], got {n_bins}")
+    if max_depth < 0:
+        raise ValueError(f"max_depth must be >= 0, got {max_depth}")
+    # The C++ core indexes hist[... + code]: codes must fit in n_bins.  At
+    # n_bins=256 uint8 cannot violate this, so skip the O(n*f) scan the
+    # boosting loop would otherwise repeat per tree.
+    if n_bins < 256 and n and Xb.max() >= n_bins:
+        raise ValueError(f"bin codes must be < n_bins={n_bins}; "
+                         f"got max {int(Xb.max())}")
+    n_nodes = 2 ** (max_depth + 1) - 1
+    lib = _get_lib()
+    if lib is not None:
+        feature = np.empty(n_nodes, np.int32)
+        threshold = np.empty(n_nodes, np.int32)
+        value = np.empty(n_nodes, np.float64)
+        lib.ce_gbdt_build_tree(Xb, n, f, g, h, max_depth, n_bins,
+                               lam, min_child_weight, min_gain,
+                               feature, threshold, value)
+        return feature, threshold, value
+    return _gbdt_build_tree_np(Xb, g, h, max_depth, n_bins, lam,
+                               min_child_weight, min_gain)
+
+
+def _gbdt_build_tree_np(Xb, g, h, max_depth, n_bins, lam,
+                        min_child_weight, min_gain):
+    """Level-wise histogram tree build, pure numpy (double accumulation)."""
+    n, f = Xb.shape
+    n_nodes = 2 ** (max_depth + 1) - 1
+    feature = np.full(n_nodes, -1, np.int32)
+    threshold = np.zeros(n_nodes, np.int32)
+    value = np.zeros(n_nodes, np.float64)
+    G = np.zeros(n_nodes)
+    H = np.zeros(n_nodes)
+    # cumsum's last element is the strictly-sequential sum — the same
+    # accumulation order as the C++ core's root loop (np.sum is pairwise
+    # and differs in ULPs, enough to flip near-tie splits across backends)
+    if n:
+        G[0] = np.cumsum(g, dtype=np.float64)[-1]
+        H[0] = np.cumsum(h, dtype=np.float64)[-1]
+    open_ = np.zeros(n_nodes, bool)
+    open_[0] = True
+    node_of_row = np.zeros(n, np.int32)
+    cols = np.arange(f, dtype=np.int64)
+
+    for depth in range(max_depth):
+        level = np.arange(2 ** depth - 1, 2 ** (depth + 1) - 1)
+        act = level[open_[level]]
+        if act.size == 0:
+            break
+        local = np.full(n_nodes, -1, np.int64)
+        local[act] = np.arange(act.size)
+        row_local = local[node_of_row]
+        sel = row_local >= 0
+        rl, Xl = row_local[sel], Xb[sel]
+        gl, hl = g[sel].astype(np.float64), h[sel].astype(np.float64)
+        flat = ((rl[:, None] * f + cols[None, :]) * n_bins
+                + Xl.astype(np.int64))
+        size = act.size * f * n_bins
+        hg = np.bincount(flat.ravel(), weights=np.repeat(gl, f),
+                         minlength=size).reshape(act.size, f, n_bins)
+        hh = np.bincount(flat.ravel(), weights=np.repeat(hl, f),
+                         minlength=size).reshape(act.size, f, n_bins)
+        cg = np.cumsum(hg, axis=2)
+        ch = np.cumsum(hh, axis=2)
+        Gt = G[act][:, None, None]
+        Ht = H[act][:, None, None]
+        GR, HR = Gt - cg, Ht - ch
+        with np.errstate(invalid="ignore"):
+            gain = (cg ** 2 / (ch + lam) + GR ** 2 / (HR + lam)
+                    - Gt ** 2 / (Ht + lam))
+        ok = (ch >= min_child_weight) & (HR >= min_child_weight)
+        ok[..., n_bins - 1] = False  # last bin sends everything left
+        # NaN gains (0/0 when lam=0 on an empty side) must lose the argmax
+        # as they lose the C++ core's `gain > best` comparison; +inf gains
+        # win in both backends.
+        gain = np.where(ok & ~np.isnan(gain), gain, -np.inf)
+        gflat = gain.reshape(act.size, -1)
+        best = gflat.argmax(axis=1)
+        best_gain = gflat[np.arange(act.size), best]
+        bf, bb = best // n_bins, best % n_bins
+        for a, nd in enumerate(act):
+            open_[nd] = False
+            if best_gain[a] > min_gain:  # -inf = no candidate -> leaf
+                feature[nd] = bf[a]
+                threshold[nd] = bb[a]
+                left, right = 2 * nd + 1, 2 * nd + 2
+                G[left] = cg[a, bf[a], bb[a]]
+                H[left] = ch[a, bf[a], bb[a]]
+                G[right] = G[nd] - G[left]
+                H[right] = H[nd] - H[left]
+                open_[left] = open_[right] = True
+            else:
+                value[nd] = -G[nd] / (H[nd] + lam)
+        split = feature[node_of_row] >= 0
+        at_level = (node_of_row >= level[0]) & (node_of_row <= level[-1])
+        move = split & at_level
+        nd_m = node_of_row[move]
+        go_right = (Xb[move, feature[nd_m]]
+                    > threshold[nd_m].astype(np.uint8))
+        node_of_row[move] = 2 * nd_m + 1 + go_right
+    leaves = np.flatnonzero(open_)
+    value[leaves] = -G[leaves] / (H[leaves] + lam)
+    return feature, threshold, value
+
+
+def gbdt_predict_margins(Xb, feature, threshold, value, tree_class,
+                         n_class: int, lr: float,
+                         margins=None) -> np.ndarray:
+    """Accumulate forest margins: ``margins[i, tree_class[t]] += lr *
+    leaf_t(i)``.  ``feature``/``threshold``: ``(T, n_nodes)`` int32;
+    ``value``: ``(T, n_nodes)`` float64.  Returns ``(n, n_class)`` float64.
+    """
+    Xb = np.ascontiguousarray(Xb, np.uint8)
+    feature = np.ascontiguousarray(feature, np.int32)
+    threshold = np.ascontiguousarray(threshold, np.int32)
+    value = np.ascontiguousarray(value, np.float64)
+    tree_class = np.ascontiguousarray(tree_class, np.int32)
+    n, f = Xb.shape
+    n_trees, n_nodes = feature.shape
+    if threshold.shape != (n_trees, n_nodes) or \
+            value.shape != (n_trees, n_nodes):
+        raise ValueError(f"feature/threshold/value shapes disagree: "
+                         f"{feature.shape} {threshold.shape} {value.shape}")
+    if margins is None:
+        margins = np.zeros((n, n_class), np.float64)
+    elif (not isinstance(margins, np.ndarray)
+          or margins.dtype != np.float64 or margins.shape != (n, n_class)
+          or not margins.flags.c_contiguous):
+        raise ValueError(f"margins must be C-contiguous float64 "
+                         f"({n}, {n_class})")
+    if n_trees == 0:
+        return margins
+    if tree_class.shape != (n_trees,) or (n_trees and (
+            tree_class.min() < 0 or tree_class.max() >= n_class)):
+        raise ValueError(f"tree_class must be (n_trees,) indices in "
+                         f"[0, {n_class}); got shape {tree_class.shape}")
+    lib = _get_lib()
+    if lib is not None:
+        lib.ce_gbdt_predict_margins(Xb, n, f, feature, threshold, value,
+                                    n_trees, n_nodes, tree_class, n_class,
+                                    lr, margins)
+        return margins
+    # numpy fallback: vectorized heap traversal, max_depth gather steps
+    depth = int(np.log2(n_nodes + 1)) - 1
+    rows = np.arange(n)
+    for t in range(n_trees):
+        node = np.zeros(n, np.int64)
+        for _ in range(depth):
+            fcur = feature[t, node]
+            internal = fcur >= 0
+            binv = Xb[rows, np.where(internal, fcur, 0)]
+            child = 2 * node + 1 + (binv > threshold[t, node])
+            node = np.where(internal, child, node)
+        margins[:, tree_class[t]] += lr * value[t, node]
+    return margins
+
+
 def member_probs(estimator, X) -> np.ndarray:
     """Fast ``predict_proba`` for fitted sklearn GNB / SGD-logistic
     estimators via the native core; anything else falls back to the
@@ -209,4 +386,5 @@ def member_probs(estimator, X) -> np.ndarray:
 __all__ = [
     "backend", "num_threads", "linear_predict_proba", "gnb_predict_proba",
     "segment_starts", "segment_mean", "row_entropy", "member_probs",
+    "gbdt_build_tree", "gbdt_predict_margins",
 ]

@@ -20,11 +20,15 @@ from numpy.ctypeslib import ndpointer
 
 _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
 _REPO_ROOT = os.path.dirname(os.path.dirname(_PKG_DIR))
-SOURCE = os.path.join(_REPO_ROOT, "native", "ce_host.cpp")
+SOURCES = [os.path.join(_REPO_ROOT, "native", "ce_host.cpp"),
+           os.path.join(_REPO_ROOT, "native", "ce_gbdt.cpp")]
+SOURCE = SOURCES[0]  # kept for back-compat imports
 
 _f32 = ndpointer(np.float32, flags="C_CONTIGUOUS")
 _f64 = ndpointer(np.float64, flags="C_CONTIGUOUS")
+_i32 = ndpointer(np.int32, flags="C_CONTIGUOUS")
 _i64 = ndpointer(np.int64, flags="C_CONTIGUOUS")
+_u8 = ndpointer(np.uint8, flags="C_CONTIGUOUS")
 _pf32 = ctypes.POINTER(ctypes.c_float)
 _int64 = ctypes.c_int64
 
@@ -35,12 +39,15 @@ def _build_dir() -> str:
 
 
 def build_library(verbose: bool = False) -> str | None:
-    """Compile ``ce_host.cpp`` if needed; returns the .so path or None."""
-    if not os.path.exists(SOURCE):
+    """Compile the native sources if needed; returns the .so path or None."""
+    if not all(os.path.exists(s) for s in SOURCES):
         return None
     try:
-        with open(SOURCE, "rb") as f:
-            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+        digest = hashlib.sha256()
+        for src in SOURCES:
+            with open(src, "rb") as f:
+                digest.update(f.read())
+        tag = digest.hexdigest()[:16]
         out_dir = _build_dir()
         so_path = os.path.join(out_dir, f"libce_host.{tag}.so")
         if os.path.exists(so_path):
@@ -51,7 +58,7 @@ def build_library(verbose: bool = False) -> str | None:
         # wins with an identical artifact.
         tmp_path = f"{so_path}.{os.getpid()}.tmp"
         cmd = ["g++", "-O3", "-fopenmp", "-shared", "-fPIC", "-std=c++17",
-               SOURCE, "-o", tmp_path]
+               *SOURCES, "-o", tmp_path]
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=120)
         if proc.returncode != 0:
@@ -80,6 +87,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.ce_row_entropy.restype = None
     lib.ce_num_threads.argtypes = []
     lib.ce_num_threads.restype = ctypes.c_int
+    lib.ce_gbdt_build_tree.argtypes = [
+        _u8, _int64, _int64, _f32, _f32, ctypes.c_int, ctypes.c_int,
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, _i32, _i32, _f64]
+    lib.ce_gbdt_build_tree.restype = None
+    lib.ce_gbdt_predict_margins.argtypes = [
+        _u8, _int64, _int64, _i32, _i32, _f64, _int64, _int64, _i32,
+        _int64, ctypes.c_double, _f64]
+    lib.ce_gbdt_predict_margins.restype = None
     return lib
 
 

@@ -1,0 +1,201 @@
+// Native gradient-boosted-trees core for consensus_entropy_tpu.
+//
+// Fills the committee's boosted slot (the reference trains
+// XGBClassifier(max_depth=5) and continues boosting per AL iteration with
+// its vendored class-preservation patch — amg_test.py:507,
+// xgboost/sklearn.py:854-860).  xgboost is not shipped in every deployment,
+// and sklearn's GradientBoostingClassifier warm-start refuses
+// class-deficient batches, so this is a first-party implementation of the
+// exact capability the AL loop needs: depth-limited regression trees on
+// quantile-binned features, boosted under a K-class softmax objective whose
+// class universe is pinned by the caller — NOT re-derived from each batch.
+//
+// Scope: the tree BUILD and forest PREDICT hot loops only.  Binning,
+// gradients, and the boosting schedule live in Python
+// (consensus_entropy_tpu/models/gbdt.py) where they are cheap and testable;
+// a pure-numpy build/predict fallback exists for toolchain-less hosts.
+//
+// Tree layout: complete binary heap of n_nodes = 2^(max_depth+1) - 1 slots.
+// feature[i] >= 0  -> internal node; rows with bin <= threshold[i] go to
+//                     child 2i+1, else 2i+2.
+// feature[i] == -1 -> leaf (or never-created slot); value[i] is the leaf
+//                     weight (0 for never-created slots, which are
+//                     unreachable by construction).
+//
+// Split objective (second-order, xgboost-style):
+//   gain = GL^2/(HL+lambda) + GR^2/(HR+lambda) - G^2/(H+lambda)
+//   leaf weight = -G/(H+lambda)
+// Ties broken toward the lowest (feature, bin) pair, matching the numpy
+// fallback's argmax-first semantics bit-for-bit (all accumulation in
+// double, same traversal order).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// Build one depth-limited regression tree on pre-binned features.
+//   Xb:   (n, f) uint8 bin codes, row-major
+//   g, h: (n,) float32 gradients / hessians
+//   feature, threshold: (n_nodes,) int32 outputs (caller zero/-1 init NOT
+//     required; fully written here)
+//   value: (n_nodes,) double output
+void ce_gbdt_build_tree(const uint8_t* Xb, int64_t n, int64_t f,
+                        const float* g, const float* h, int max_depth,
+                        int n_bins, double lambda, double min_child_weight,
+                        double min_gain, int32_t* feature, int32_t* threshold,
+                        double* value) {
+  const int64_t n_nodes = ((int64_t)1 << (max_depth + 1)) - 1;
+  for (int64_t i = 0; i < n_nodes; ++i) {
+    feature[i] = -1;
+    threshold[i] = 0;
+    value[i] = 0.0;
+  }
+  double* G = new double[n_nodes]();
+  double* H = new double[n_nodes]();
+  bool* open_ = new bool[n_nodes]();
+  int32_t* node_of_row = new int32_t[n];
+  std::memset(node_of_row, 0, n * sizeof(int32_t));
+
+  {
+    double sg = 0.0, sh = 0.0;
+    for (int64_t i = 0; i < n; ++i) {
+      sg += (double)g[i];
+      sh += (double)h[i];
+    }
+    G[0] = sg;
+    H[0] = sh;
+    open_[0] = true;
+  }
+
+  // local index of each open node at the current level (-1 otherwise)
+  int32_t* local = new int32_t[n_nodes];
+
+  for (int depth = 0; depth < max_depth; ++depth) {
+    const int64_t lo = ((int64_t)1 << depth) - 1;
+    const int64_t hi = ((int64_t)1 << (depth + 1)) - 1;
+    int64_t n_act = 0;
+    for (int64_t i = 0; i < n_nodes; ++i) local[i] = -1;
+    for (int64_t nd = lo; nd < hi; ++nd)
+      if (open_[nd]) local[nd] = (int32_t)n_act++;
+    if (n_act == 0) break;
+
+    // Histograms: (n_act, f, n_bins) of G and H, double accumulation.
+    const int64_t hsize = n_act * f * n_bins;
+    double* hg = new double[hsize]();
+    double* hh = new double[hsize]();
+#pragma omp parallel for schedule(static)
+    for (int64_t j = 0; j < f; ++j) {
+      for (int64_t i = 0; i < n; ++i) {
+        const int32_t nd = node_of_row[i];
+        const int32_t lc = local[nd];
+        if (lc < 0) continue;
+        const int64_t at = ((int64_t)lc * f + j) * n_bins + Xb[i * f + j];
+        hg[at] += (double)g[i];
+        hh[at] += (double)h[i];
+      }
+    }
+
+    // Split search per open node (first-max tie break over (feature, bin)).
+#pragma omp parallel for schedule(static)
+    for (int64_t nd = lo; nd < hi; ++nd) {
+      const int32_t lc = local[nd];
+      if (lc < 0) continue;
+      const double Gt = G[nd], Ht = H[nd];
+      const double parent = Gt * Gt / (Ht + lambda);
+      double best_gain = -1.0 / 0.0;
+      int32_t best_f = -1, best_b = 0;
+      double best_gl = 0.0, best_hl = 0.0;
+      for (int64_t j = 0; j < f; ++j) {
+        const double* cg = hg + ((int64_t)lc * f + j) * n_bins;
+        const double* ch = hh + ((int64_t)lc * f + j) * n_bins;
+        double gl = 0.0, hl = 0.0;
+        for (int b = 0; b < n_bins - 1; ++b) {  // last bin: all-left, skip
+          gl += cg[b];
+          hl += ch[b];
+          const double gr = Gt - gl, hr = Ht - hl;
+          if (hl < min_child_weight || hr < min_child_weight) continue;
+          const double gain =
+              gl * gl / (hl + lambda) + gr * gr / (hr + lambda) - parent;
+          if (gain > best_gain) {
+            best_gain = gain;
+            best_f = (int32_t)j;
+            best_b = b;
+            best_gl = gl;
+            best_hl = hl;
+          }
+        }
+      }
+      if (best_f >= 0 && best_gain > min_gain) {
+        feature[nd] = best_f;
+        threshold[nd] = best_b;
+        const int64_t l = 2 * nd + 1, r = 2 * nd + 2;
+        G[l] = best_gl;
+        H[l] = best_hl;
+        G[r] = G[nd] - best_gl;
+        H[r] = H[nd] - best_hl;
+        open_[l] = true;
+        open_[r] = true;
+      } else {
+        value[nd] = -Gt / (Ht + lambda);
+      }
+      open_[nd] = false;
+    }
+    delete[] hg;
+    delete[] hh;
+
+    // Partition rows of split nodes to their children.
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+      const int32_t nd = node_of_row[i];
+      if (nd >= lo && nd < hi && feature[nd] >= 0)
+        node_of_row[i] = (int32_t)(
+            2 * nd + 1 + (Xb[i * f + feature[nd]] > (uint8_t)threshold[nd]));
+    }
+  }
+
+  // Max-depth level: every still-open node becomes a leaf.
+  for (int64_t nd = 0; nd < n_nodes; ++nd) {
+    if (open_[nd]) {
+      value[nd] = -G[nd] / (H[nd] + lambda);
+      open_[nd] = false;
+    }
+  }
+
+  delete[] G;
+  delete[] H;
+  delete[] open_;
+  delete[] node_of_row;
+  delete[] local;
+}
+
+// Accumulate a forest's margins:
+//   margins[i, tree_class[t]] += lr * leaf_t(row i)   for every tree t.
+// Trees are packed contiguously: feature/threshold (n_trees, n_nodes) int32,
+// value (n_trees, n_nodes) double.  margins is (n, k) float64, caller-init.
+void ce_gbdt_predict_margins(const uint8_t* Xb, int64_t n, int64_t f,
+                             const int32_t* feature, const int32_t* threshold,
+                             const double* value, int64_t n_trees,
+                             int64_t n_nodes, const int32_t* tree_class,
+                             int64_t k, double lr, double* margins) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* x = Xb + i * f;
+    double* m = margins + i * k;
+    for (int64_t t = 0; t < n_trees; ++t) {
+      const int32_t* tf = feature + t * n_nodes;
+      const int32_t* tt = threshold + t * n_nodes;
+      int64_t nd = 0;
+      while (tf[nd] >= 0)
+        nd = 2 * nd + 1 + (x[tf[nd]] > (uint8_t)tt[nd]);
+      m[tree_class[t]] += lr * value[t * n_nodes + nd];
+    }
+  }
+}
+
+}  // extern "C"

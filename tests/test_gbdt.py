@@ -1,0 +1,277 @@
+"""First-party GBDT: C++/numpy backend parity, boosting quality, and the
+exact continued-boosting contract of the reference's patched xgboost
+(``/root/reference/xgboost/sklearn.py:854-860`` — classes/objective pinned
+across warm starts on class-deficient batches, ``amg_test.py:507``)."""
+
+import numpy as np
+import pytest
+
+from consensus_entropy_tpu import native
+from consensus_entropy_tpu.config import NUM_CLASSES
+from consensus_entropy_tpu.models.gbdt import (
+    GBDT,
+    NativeGBDTMember,
+    QuantileBinner,
+)
+
+
+def _clusters(rng, n=300, f=10):
+    X = rng.standard_normal((n, f))
+    centers = rng.standard_normal((NUM_CLASSES, f)) * 3
+    y = rng.integers(0, NUM_CLASSES, size=n)
+    X += centers[y]
+    return X.astype(np.float32), y
+
+
+# -- binner ----------------------------------------------------------------
+
+def test_binner_monotone_and_bounded(rng):
+    X = rng.standard_normal((500, 6)).astype(np.float32)
+    b = QuantileBinner(64).fit(X)
+    codes = b.transform(X)
+    assert codes.dtype == np.uint8 and codes.max() < 64
+    # monotone per feature: sorting raw values sorts the codes
+    j = 3
+    order = np.argsort(X[:, j], kind="stable")
+    assert (np.diff(codes[order, j].astype(int)) >= 0).all()
+
+
+def test_binner_constant_feature(rng):
+    X = np.hstack([np.full((50, 1), 7.0), rng.standard_normal((50, 1))])
+    codes = QuantileBinner(16).fit(X).transform(X)
+    assert (codes[:, 0] == codes[0, 0]).all()
+
+
+def test_binner_rejects_unfitted_and_wrong_width(rng):
+    b = QuantileBinner(8)
+    with pytest.raises(RuntimeError):
+        b.transform(np.zeros((3, 2)))
+    b.fit(np.zeros((10, 2)))
+    with pytest.raises(ValueError):
+        b.transform(np.zeros((3, 5)))
+
+
+# -- tree build: both backends produce identical trees ---------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_build_tree_native_numpy_identical(seed):
+    rng = np.random.default_rng(seed)
+    n, f, n_bins = 400, 8, 32
+    Xb = rng.integers(0, n_bins, size=(n, f)).astype(np.uint8)
+    g = rng.standard_normal(n).astype(np.float32)
+    h = rng.uniform(0.1, 1.0, n).astype(np.float32)
+    kw = dict(max_depth=4, n_bins=n_bins, lam=1.0,
+              min_child_weight=1.0, min_gain=0.0)
+    f_np, t_np, v_np = native._gbdt_build_tree_np(Xb, g, h, **{
+        "max_depth": 4, "n_bins": n_bins, "lam": 1.0,
+        "min_child_weight": 1.0, "min_gain": 0.0})
+    if native.backend() == "numpy":
+        pytest.skip("no native toolchain: single backend only")
+    f_c, t_c, v_c = native.gbdt_build_tree(Xb, g, h, **kw)
+    np.testing.assert_array_equal(f_c, f_np)
+    np.testing.assert_array_equal(t_c, t_np)
+    np.testing.assert_allclose(v_c, v_np, rtol=1e-12, atol=1e-12)
+
+
+def test_build_tree_fits_gradients(rng):
+    """A depth-2 tree on a 1-feature step function recovers the step."""
+    n = 200
+    Xb = np.zeros((n, 1), np.uint8)
+    Xb[n // 2:, 0] = 10
+    g = np.where(np.arange(n) < n // 2, 1.0, -1.0).astype(np.float32)
+    h = np.ones(n, np.float32)
+    feat, thr, val = native.gbdt_build_tree(
+        Xb, g, h, max_depth=2, n_bins=16, lam=0.0)
+    assert feat[0] == 0  # root splits on the only feature
+    m = native.gbdt_predict_margins(Xb, feat[None], thr[None], val[None],
+                                    np.zeros(1, np.int32), 1, 1.0)
+    np.testing.assert_allclose(m[:, 0], -g, atol=1e-12)  # Newton step −g/h
+
+
+def test_min_child_weight_blocks_tiny_splits():
+    Xb = np.zeros((10, 1), np.uint8)
+    Xb[0, 0] = 5  # a 1-row split candidate
+    g = np.r_[5.0, np.zeros(9)].astype(np.float32)
+    h = np.ones(10, np.float32)
+    feat, _, val = native.gbdt_build_tree(
+        Xb, g, h, max_depth=3, n_bins=16, lam=1.0, min_child_weight=2.0)
+    assert feat[0] == -1  # forced leaf: the only useful split is 1-vs-9...
+    assert val[0] != 0.0
+
+
+def test_native_wrappers_reject_corrupt_inputs(rng):
+    """The C++ core indexes by bin code / tree class; the wrappers must
+    reject violating input loudly on BOTH backends (the native path would
+    otherwise write out of bounds)."""
+    Xb = np.full((5, 2), 40, np.uint8)
+    g = np.zeros(5, np.float32)
+    h = np.ones(5, np.float32)
+    with pytest.raises(ValueError, match="bin codes"):
+        native.gbdt_build_tree(Xb, g, h, max_depth=2, n_bins=32)
+    with pytest.raises(ValueError, match="max_depth"):
+        native.gbdt_build_tree(Xb, g, h, max_depth=-1, n_bins=64)
+    feat = np.full((1, 7), -1, np.int32)
+    thr = np.zeros((1, 7), np.int32)
+    val = np.zeros((1, 7), np.float64)
+    with pytest.raises(ValueError, match="tree_class"):
+        native.gbdt_predict_margins(Xb, feat, thr, val,
+                                    np.array([4], np.int32), 4, 0.3)
+
+
+def test_predict_margins_empty_forest(rng):
+    model = GBDT(NUM_CLASSES)
+    Xb = rng.integers(0, 4, size=(7, 3)).astype(np.uint8)
+    np.testing.assert_array_equal(model.margins(Xb),
+                                  np.zeros((7, NUM_CLASSES)))
+    p = model.predict_proba(Xb)
+    np.testing.assert_allclose(p, 0.25, atol=1e-7)
+
+
+# -- boosting quality -------------------------------------------------------
+
+def test_gbdt_learns_separable_clusters(rng):
+    X, y = _clusters(rng)
+    m = NativeGBDTMember(n_estimators=20, max_depth=3)
+    m.fit(X, y)
+    assert (m.predict(X) == y).mean() > 0.95
+    p = m.predict_proba(X)
+    assert p.shape == (len(X), NUM_CLASSES)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_gbdt_quality_tracks_sklearn(rng):
+    """Held-out accuracy within a few points of sklearn's GBDT on the same
+    clustered task (different algorithm details — histogram bins, diagonal
+    softmax hessian — so parity is statistical, not numerical)."""
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    X, y = _clusters(rng, n=600)
+    Xtr, ytr, Xte, yte = X[:400], y[:400], X[400:], y[400:]
+    ours = NativeGBDTMember(n_estimators=30, max_depth=3).fit(Xtr, ytr)
+    ref = GradientBoostingClassifier(n_estimators=30, max_depth=3,
+                                     random_state=0).fit(Xtr, ytr)
+    acc_ours = (ours.predict(Xte) == yte).mean()
+    acc_ref = (ref.predict(Xte) == yte).mean()
+    assert acc_ours >= acc_ref - 0.05, (acc_ours, acc_ref)
+
+
+def test_refit_retrains_from_scratch(rng):
+    """fit() on an already-fitted member must equal a fresh fit (stale trees
+    under replaced bin edges would otherwise be scored on mismatched
+    codes)."""
+    X1, y1 = _clusters(rng)
+    X2, y2 = _clusters(rng)
+    X2 *= 5.0  # very different quantile edges
+    m = NativeGBDTMember(n_estimators=5)
+    m.fit(X1, y1)
+    m.fit(X2, y2)
+    fresh = NativeGBDTMember(n_estimators=5).fit(X2, y2)
+    assert m.model.n_trees == fresh.model.n_trees
+    np.testing.assert_array_equal(m.predict_proba(X2[:15]),
+                                  fresh.predict_proba(X2[:15]))
+
+
+def test_predict_margins_rejects_mismatched_shapes(rng):
+    Xb = rng.integers(0, 8, size=(5, 2)).astype(np.uint8)
+    feat = np.full((2, 7), -1, np.int32)
+    thr = np.zeros((2, 7), np.int32)
+    val = np.zeros((2, 7), np.float64)
+    tc = np.zeros(2, np.int32)
+    with pytest.raises(ValueError, match="disagree"):
+        native.gbdt_predict_margins(Xb, feat, thr[:1], val, tc, 4, 0.3)
+    with pytest.raises(ValueError, match="margins"):
+        native.gbdt_predict_margins(Xb, feat, thr, val, tc, 4, 0.3,
+                                    margins=np.zeros((5, 3)))
+
+
+def test_gbdt_deterministic(rng):
+    X, y = _clusters(rng)
+    a = NativeGBDTMember(n_estimators=5).fit(X, y).predict_proba(X[:20])
+    b = NativeGBDTMember(n_estimators=5).fit(X, y).predict_proba(X[:20])
+    np.testing.assert_array_equal(a, b)
+
+
+# -- continued boosting: the reference-patch semantics, exactly -------------
+
+def test_update_is_true_continued_boosting(rng):
+    """update(X, y) must equal boosting the same rounds on that batch in one
+    model whose forest already holds the pre-training trees — i.e. margins
+    continue, nothing is refit, no padding rows are injected."""
+    X, y = _clusters(rng)
+    Xq, yq = X[y == 1][:10], y[y == 1][:10]  # single-class AL batch
+
+    m = NativeGBDTMember(n_estimators=8, update_estimators=4)
+    m.fit(X, y)
+    trees_before = m.model.n_trees
+    m.update(Xq, yq)
+    assert m.model.n_trees == trees_before + 4 * NUM_CLASSES
+
+    # replay: same pre-train, then boost the query batch directly
+    m2 = NativeGBDTMember(n_estimators=8, update_estimators=4)
+    m2.fit(X, y)
+    m2.model.boost(m2.binner.transform(Xq), yq, 4)
+    np.testing.assert_array_equal(m.predict_proba(X[:25]),
+                                  m2.predict_proba(X[:25]))
+
+
+def test_update_objective_stays_four_class(rng):
+    """Repeated single-class updates drift toward that class but every class
+    keeps probability mass (the pinned K-class softmax objective)."""
+    X, y = _clusters(rng)
+    m = NativeGBDTMember(n_estimators=10, update_estimators=5)
+    m.fit(X, y)
+    sel = y == 3
+    p_before = m.predict_proba(X[sel][:20])
+    for _ in range(3):
+        m.update(X[sel][:10], y[sel][:10])
+    p_after = m.predict_proba(X[sel][:20])
+    assert p_after[:, 3].mean() > p_before[:, 3].mean()
+    assert (p_after > 0).all() and p_after.shape[1] == NUM_CLASSES
+
+
+def test_fit_requires_all_classes(rng):
+    X, y = _clusters(rng)
+    m = NativeGBDTMember(n_estimators=2)
+    with pytest.raises(ValueError, match="all 4 classes"):
+        m.fit(X[y != 2], y[y != 2])
+
+
+def test_update_rejects_out_of_range_labels(rng):
+    """Negative labels must raise, not wrap to the last class via numpy
+    indexing (siblings in the boosted slot raise on unseen labels too)."""
+    X, y = _clusters(rng)
+    m = NativeGBDTMember(n_estimators=2).fit(X, y)
+    with pytest.raises(ValueError, match="labels"):
+        m.update(X[:4], np.full(4, -1))
+    with pytest.raises(ValueError, match="labels"):
+        m.update(X[:4], np.full(4, NUM_CLASSES))
+
+
+def test_member_roundtrip_preserves_binner_and_forest(rng, tmp_path):
+    X, y = _clusters(rng)
+    m = NativeGBDTMember(n_estimators=6, update_estimators=3).fit(X, y)
+    path = str(tmp_path / "classifier_xgb.it_0.pkl")
+    m.save(path)
+    m2 = NativeGBDTMember.load(path)
+    np.testing.assert_array_equal(m.predict_proba(X[:12]),
+                                  m2.predict_proba(X[:12]))
+    m2.update(X[y == 0][:5], y[y == 0][:5])  # still boostable after load
+    assert m2.model.n_trees == m.model.n_trees + 3 * NUM_CLASSES
+
+
+def test_workspace_dispatches_native_gbdt(rng, tmp_path):
+    """load_committee routes the boosted slot to NativeGBDTMember via the
+    pickle's fmt tag (three coexisting formats: xgboost raw, sklearn
+    fallback, native)."""
+    from consensus_entropy_tpu.al.workspace import load_committee
+    from consensus_entropy_tpu.models.sklearn_members import GNBMember
+
+    X, y = _clusters(rng)
+    NativeGBDTMember("it_0", n_estimators=4).fit(X, y).save(
+        str(tmp_path / "classifier_xgb.it_0.pkl"))
+    GNBMember("it_0").fit(X, y).save(
+        str(tmp_path / "classifier_gnb.it_0.pkl"))
+    committee = load_committee(str(tmp_path))
+    by_kind = {m.kind: m for m in committee.host_members}
+    assert isinstance(by_kind["xgb"], NativeGBDTMember)
+    committee.update_host(X[:4], y[:4])  # boosted slot updates in committee

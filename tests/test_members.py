@@ -65,12 +65,20 @@ def test_boosted_fallback_class_preservation(rng):
 
 
 def test_make_boosted_member_gating():
+    from consensus_entropy_tpu.models.gbdt import NativeGBDTMember
+
     m = make_boosted_member(seed=0)
     if HAVE_XGBOOST:
         assert type(m).__name__ == "XGBMember"
-    else:
-        assert isinstance(m, BoostedTreesMember)
+    else:  # first-party GBDT beats the anchor-row approximation
+        assert isinstance(m, NativeGBDTMember)
     assert m.kind == "xgb"
+    assert isinstance(make_boosted_member(seed=0, impl="sklearn"),
+                      BoostedTreesMember)
+    assert isinstance(make_boosted_member(seed=0, impl="native"),
+                      NativeGBDTMember)
+    with pytest.raises(ValueError):
+        make_boosted_member(impl="nope")
 
 
 @pytest.mark.parametrize("factory", [
@@ -160,10 +168,17 @@ def _xgb_factory():
     return XGBMember(n_estimators=10, seed=0)
 
 
+def _native_factory():
+    from consensus_entropy_tpu.models.gbdt import NativeGBDTMember
+
+    return NativeGBDTMember(n_estimators=10, update_estimators=5)
+
+
 BOOSTED_FACTORIES = [
     pytest.param(lambda: BoostedTreesMember(n_estimators=10,
                                             update_estimators=5, seed=0),
                  id="fallback"),
+    pytest.param(_native_factory, id="native"),
     pytest.param(_xgb_factory, id="xgboost",
                  marks=pytest.mark.skipif(not HAVE_XGBOOST,
                                           reason="xgboost not installed")),
