@@ -61,11 +61,33 @@ def make_inputs(n_members: int, n_pool: int, n_frames: int, n_features: int,
     return x, w, b
 
 
-def cpu_reference_iteration(x, w, b, k: int):
-    """Reference-structure scoring on host: per-member Python loop
-    (``amg_test.py:428-438``), then consensus mean → scipy entropy → argsort
-    top-q (``amg_test.py:441-447``)."""
+def make_hc_table(n_pool: int, n_class: int, seed: int = 2021) -> np.ndarray:
+    """Synthetic human-consensus frequency table: per-song annotator
+    quadrant frequencies rounded to 3 decimals (``amg_test.py:109-117``)."""
+    rng = np.random.default_rng(seed)
+    counts = rng.integers(0, 20, size=(n_pool, n_class)).astype(np.float64)
+    counts[:, 0] += 1  # every song has at least one annotator
+    freq = counts / counts.sum(axis=1, keepdims=True)
+    return np.round(freq, 3).astype(np.float32)
+
+
+def cpu_reference_iteration(x, w, b, k: int, mode: str = "mc",
+                            hc_freq=None):
+    """Reference-structure scoring on host for one acquisition iteration.
+
+    mc  (``amg_test.py:428-447``): per-member Python loop, per-frame
+        ``predict_proba``, per-song groupby-mean, consensus mean → scipy
+        entropy → argsort top-q.
+    hc  (``amg_test.py:449-455``): scipy entropy over the HC frequency rows.
+    mix (``amg_test.py:457-484``): mc consensus rows stacked with the HC
+        rows (``pd.concat``), entropy over all rows, top-q in the stacked
+        row space.
+    """
     from scipy.stats import entropy as scipy_entropy
+
+    if mode == "hc":
+        ent = scipy_entropy(hc_freq.astype(np.float64), axis=1)
+        return ent, np.argsort(ent)[::-1][:k]
 
     n_pool, n_frames, n_features = x.shape
     frames = x.reshape(n_pool * n_frames, n_features)
@@ -78,45 +100,89 @@ def cpu_reference_iteration(x, w, b, k: int):
         # groupby('s_id').mean() — frames are contiguous per song here.
         pred_prob.append(p.reshape(n_pool, n_frames, -1).mean(axis=1))
     consensus = np.mean(np.asarray(pred_prob), axis=0)
+    if mode == "mix":
+        consensus = np.concatenate([consensus, hc_freq.astype(np.float64)])
     ent = scipy_entropy(consensus, axis=1)
     q_idx = np.argsort(ent)[::-1][:k]
     return ent, q_idx
 
 
-def build_xla_impl(x, w, b, k: int):
-    """jit'd einsum → score_mc, pool axis sharded across all devices.
+def build_xla_impl(x, w, b, k: int, mode: str = "mc", hc_freq=None,
+                   flat_gemm: bool = False):
+    """jit'd einsum → fused scorer, pool axis sharded across all devices.
 
     Returns ``(iteration_args, iteration_fn)`` where ``iteration_fn(args,
     eps)`` -> ScoreResult; ``eps`` is a scalar folded in as a no-op so timing
     windows can chain iterations through a device-side data dependency.
+
+    ``mode`` picks the acquisition chain (mc / hc / mix — BASELINE configs
+    0-2).  ``flat_gemm`` races an alternative mc layout: one
+    ``(N*K, F) @ (F, M*C)`` GEMM instead of the batched member einsum —
+    identical math, different XLA tiling.
     """
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from consensus_entropy_tpu.ops.scoring import score_mc
+    from consensus_entropy_tpu.ops.scoring import score_hc, score_mc, score_mix
     from consensus_entropy_tpu.parallel.mesh import POOL_AXIS, make_pool_mesh
 
     mesh = make_pool_mesh()
-    n_pool = x.shape[0]
+    n_pool = hc_freq.shape[0] if mode == "hc" else x.shape[0]
     n_dev = mesh.devices.size
     n_pad = -(-n_pool // n_dev) * n_dev
-    x_pad = np.zeros((n_pad,) + x.shape[1:], np.float32)
-    x_pad[:n_pool] = x
     mask = np.zeros(n_pad, bool)
     mask[:n_pool] = True
 
     x_sh = NamedSharding(mesh, P(POOL_AXIS))
+
+    if hc_freq is not None:
+        hc_pad = np.zeros((n_pad, hc_freq.shape[1]), np.float32)
+        hc_pad[:n_pool] = hc_freq
+
+    if mode == "hc":  # no member inputs in the loop — x/w/b never touched
+        args = (jax.device_put(hc_pad, x_sh), jax.device_put(mask, x_sh))
+
+        def iteration(args, eps):
+            hc, hmask = args
+            return score_hc(hc + eps * 0.0, hmask, k=k)
+
+        return args, iteration
+
+    x_pad = np.zeros((n_pad,) + x.shape[1:], np.float32)
+    x_pad[:n_pool] = x
     args = (jax.device_put(x_pad, x_sh), jnp.asarray(w), jnp.asarray(b),
             jax.device_put(mask, x_sh))
+    if mode == "mix":
+        args = args + (jax.device_put(hc_pad, x_sh),)
 
-    def iteration(args, eps):
-        x, w, b, mask = args
-        logits = jnp.einsum("nkf,mfc->mnkc", x, w + eps * 0.0)
+    def member_song_probs(x, w, b):
+        if flat_gemm:
+            n, kf, f = x.shape
+            m, _, c = w.shape
+            w_flat = jnp.transpose(w, (1, 0, 2)).reshape(f, m * c)
+            logits = (x.reshape(n * kf, f) @ w_flat).reshape(n, kf, m, c)
+            logits = logits + b[None, None]
+            probs = jax.nn.softmax(logits, axis=-1)
+            return jnp.transpose(jnp.mean(probs, axis=1), (1, 0, 2))
+        logits = jnp.einsum("nkf,mfc->mnkc", x, w)
         logits = logits + b[:, None, None, :]
         probs = jax.nn.softmax(logits, axis=-1)
-        song_probs = jnp.mean(probs, axis=2)  # groupby(s_id).mean() parity
-        return score_mc(song_probs, mask, k=k)
+        return jnp.mean(probs, axis=2)  # groupby(s_id).mean() parity
+
+    if mode == "mix":
+
+        def iteration(args, eps):
+            x, w, b, mask, hc = args
+            song_probs = member_song_probs(x, w + eps * 0.0, b)
+            return score_mix(song_probs, mask, hc, mask, k=k)
+
+    else:
+
+        def iteration(args, eps):
+            x, w, b, mask = args
+            song_probs = member_song_probs(x, w + eps * 0.0, b)
+            return score_mc(song_probs, mask, k=k)
 
     return args, iteration
 
@@ -223,7 +289,7 @@ def time_device_impl(name: str, args, iteration, *, chain: int, trials: int):
 
 
 def check_parity(name: str, args, iteration, ent_cpu, idx_cpu, k: int,
-                 tol: float = 1e-3) -> bool:
+                 tol: float = 1e-3, n_valid: int | None = None) -> bool:
     """One un-chained evaluation vs the float64 CPU oracle.
 
     The query-set check is boundary-tolerant: when the oracle's rank-k gap
@@ -233,15 +299,27 @@ def check_parity(name: str, args, iteration, ent_cpu, idx_cpu, k: int,
     The principled contract is: every selected song scores within ``tol`` of
     the oracle's k-th-best, and every song clearly above the boundary
     (> kth + tol) is selected.
+
+    ``n_valid``: unpadded pool width.  For the mix mode the oracle row space
+    is ``[consensus (n_valid); hc (n_valid)]`` while the device rows are
+    ``[consensus (n_pad); hc (n_pad)]`` — rows/indices are remapped before
+    comparison.
     """
     import jax.numpy as jnp
 
     result = iteration(args, jnp.float32(0.0))
-    n_pool = ent_cpu.shape[0]
-    ent_dev = np.asarray(result.entropy)[:n_pool]
-    max_err = float(np.max(np.abs(ent_dev - ent_cpu)))
-
+    ent_dev_all = np.asarray(result.entropy)
     idx_dev = np.asarray(result.indices)
+    n_pool = ent_cpu.shape[0]
+    if n_valid is not None and n_pool == 2 * n_valid:  # mix: stacked rows
+        n_pad = ent_dev_all.shape[0] // 2
+        ent_dev = np.concatenate([ent_dev_all[:n_valid],
+                                  ent_dev_all[n_pad: n_pad + n_valid]])
+        idx_dev = np.where(idx_dev >= n_pad,
+                           idx_dev - n_pad + n_valid, idx_dev)
+    else:
+        ent_dev = ent_dev_all[:n_pool]
+    max_err = float(np.max(np.abs(ent_dev - ent_cpu)))
     kth = np.sort(ent_cpu)[-k]
     distinct = len(set(idx_dev.tolist())) == k
     all_near_top = bool(np.all(ent_cpu[idx_dev] >= kth - tol))
@@ -418,6 +496,9 @@ def main(argv=None) -> int:
     ap.add_argument("--features", type=int, default=260)
     ap.add_argument("--classes", type=int, default=4)
     ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--mode", choices=("mc", "hc", "mix"), default="mc",
+                    help="acquisition chain to benchmark (BASELINE configs "
+                         "0-2); hc has no committee in the loop")
     ap.add_argument("--impl", choices=("auto", "xla", "pallas"),
                     default="auto")
     ap.add_argument("--tile-n", type=int, default=512,
@@ -447,17 +528,30 @@ def main(argv=None) -> int:
     args_ns.members = 16 if args_ns.members is None else args_ns.members
     args_ns.pool = 100_000 if args_ns.pool is None else args_ns.pool
 
-    x, w, b = make_inputs(args_ns.members, args_ns.pool, args_ns.frames,
-                          args_ns.features, args_ns.classes)
+    if args_ns.mode == "hc":
+        # no committee in the hc loop (amg_test.py:449-455): don't generate
+        # the ~GB member-input pool it would never read
+        x = w = b = None
+    else:
+        x, w, b = make_inputs(args_ns.members, args_ns.pool, args_ns.frames,
+                              args_ns.features, args_ns.classes)
     _log(f"devices: {jax.devices()}")
     _log(f"pool {args_ns.pool} x {args_ns.frames} frames x "
          f"{args_ns.features} feats, {args_ns.members} members, k={args_ns.k}")
 
+    hc_freq = (make_hc_table(args_ns.pool, args_ns.classes)
+               if args_ns.mode in ("hc", "mix") else None)
+
     # -- CPU reference-structure baseline + oracle ------------------------
+    # untimed warm-up rep: the first call pays the scipy import (~2 s),
+    # which would dominate the cheap hc chain at --cpu-reps 1
+    ent_cpu, idx_cpu = cpu_reference_iteration(x, w, b, args_ns.k,
+                                               args_ns.mode, hc_freq)
     cpu_times = []
     for _ in range(args_ns.cpu_reps):
         t0 = time.perf_counter()
-        ent_cpu, idx_cpu = cpu_reference_iteration(x, w, b, args_ns.k)
+        ent_cpu, idx_cpu = cpu_reference_iteration(x, w, b, args_ns.k,
+                                                   args_ns.mode, hc_freq)
         cpu_times.append(time.perf_counter() - t0)
     cpu_ms = float(np.median(cpu_times) * 1e3)
     _log(f"cpu median over {args_ns.cpu_reps} reps: {cpu_ms:.1f} ms")
@@ -465,7 +559,16 @@ def main(argv=None) -> int:
     # -- device implementations -------------------------------------------
     impls = {}
     if args_ns.impl in ("auto", "xla"):
-        impls["xla"] = build_xla_impl(x, w, b, args_ns.k)
+        impls["xla"] = build_xla_impl(x, w, b, args_ns.k, args_ns.mode,
+                                      hc_freq)
+        if args_ns.impl == "auto" and args_ns.mode == "mc":
+            # race the flat-GEMM layout of the same math; XLA tiles the two
+            # differently and which wins can change with pool geometry
+            impls["xla-flat"] = build_xla_impl(x, w, b, args_ns.k, "mc",
+                                               None, flat_gemm=True)
+    if args_ns.impl == "pallas" and args_ns.mode != "mc":
+        _log("[pallas] the Mosaic kernel implements the mc chain only")
+        return 1
     if args_ns.impl == "pallas":
         # The Mosaic kernel is experimental/opt-in: at north-star scale it
         # only ties XLA (BENCH_r01: xla 1.365 ms vs pallas 1.439 ms) while
@@ -490,7 +593,8 @@ def main(argv=None) -> int:
 
     results = {}
     for name, (iargs, ifn) in impls.items():
-        if not check_parity(name, iargs, ifn, ent_cpu, idx_cpu, args_ns.k):
+        if not check_parity(name, iargs, ifn, ent_cpu, idx_cpu, args_ns.k,
+                            n_valid=args_ns.pool):
             _log(f"[{name}] PARITY FAILURE — implementation excluded")
             continue
         results[name] = time_device_impl(name, iargs, ifn,
@@ -505,8 +609,10 @@ def main(argv=None) -> int:
     dev_ms = results[best]
     _log(f"best impl: {best} ({dev_ms:.3f} ms/iter)")
 
+    mode_tag = "" if args_ns.mode == "mc" else f"{args_ns.mode}_"
     print(json.dumps({
-        "metric": f"al_pool_scoring_latency_{args_ns.members}m_{args_ns.pool}",
+        "metric": f"al_pool_scoring_latency_{mode_tag}"
+                  f"{args_ns.members}m_{args_ns.pool}",
         "value": round(dev_ms, 3),
         "unit": "ms",
         "vs_baseline": round(cpu_ms / dev_ms, 1),

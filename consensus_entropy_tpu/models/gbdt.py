@@ -128,6 +128,8 @@ class GBDT:
         lack classes: the objective stays K-class (one-hot targets are
         zero columns for absent classes).
         """
+        if n_rounds <= 0:
+            raise ValueError(f"n_rounds must be >= 1, got {n_rounds}")
         Xb = np.ascontiguousarray(Xb, np.uint8)
         y = np.asarray(y, np.int64)
         if len(y) and (y.min() < 0 or y.max() >= self.n_class):

@@ -62,6 +62,10 @@ void ce_gbdt_build_tree(const uint8_t* Xb, int64_t n, int64_t f,
   int32_t* node_of_row = new int32_t[n];
   std::memset(node_of_row, 0, n * sizeof(int32_t));
 
+  // Row-order scratch for the per-node histogram pass (counting sort of
+  // rows by node, stable in row index).
+  int64_t* order = new int64_t[n];
+
   {
     double sg = 0.0, sh = 0.0;
     for (int64_t i = 0; i < n; ++i) {
@@ -86,20 +90,45 @@ void ce_gbdt_build_tree(const uint8_t* Xb, int64_t n, int64_t f,
     if (n_act == 0) break;
 
     // Histograms: (n_act, f, n_bins) of G and H, double accumulation.
+    // Rows are first grouped per node (stable counting sort, so each
+    // histogram cell accumulates its rows in ascending row order — the
+    // exact order np.bincount uses, keeping backends bit-identical), then
+    // each node's pass reads rows feature-contiguously into an
+    // L2-resident (f, n_bins) slice — cache-friendly on both sides.
     const int64_t hsize = n_act * f * n_bins;
     double* hg = new double[hsize]();
     double* hh = new double[hsize]();
-#pragma omp parallel for schedule(static)
-    for (int64_t j = 0; j < f; ++j) {
+    int64_t* start = new int64_t[n_act + 1]();
+    for (int64_t i = 0; i < n; ++i) {
+      const int32_t lc = local[node_of_row[i]];
+      if (lc >= 0) ++start[lc + 1];
+    }
+    for (int64_t a = 0; a < n_act; ++a) start[a + 1] += start[a];
+    {
+      int64_t* fill = new int64_t[n_act];
+      for (int64_t a = 0; a < n_act; ++a) fill[a] = start[a];
       for (int64_t i = 0; i < n; ++i) {
-        const int32_t nd = node_of_row[i];
-        const int32_t lc = local[nd];
-        if (lc < 0) continue;
-        const int64_t at = ((int64_t)lc * f + j) * n_bins + Xb[i * f + j];
-        hg[at] += (double)g[i];
-        hh[at] += (double)h[i];
+        const int32_t lc = local[node_of_row[i]];
+        if (lc >= 0) order[fill[lc]++] = i;
+      }
+      delete[] fill;
+    }
+#pragma omp parallel for schedule(dynamic)
+    for (int64_t a = 0; a < n_act; ++a) {
+      double* hga = hg + a * f * n_bins;
+      double* hha = hh + a * f * n_bins;
+      for (int64_t s = start[a]; s < start[a + 1]; ++s) {
+        const int64_t i = order[s];
+        const uint8_t* row = Xb + i * f;
+        const double gi = (double)g[i], hi = (double)h[i];
+        for (int64_t j = 0; j < f; ++j) {
+          const int64_t at = j * n_bins + row[j];
+          hga[at] += gi;
+          hha[at] += hi;
+        }
       }
     }
+    delete[] start;
 
     // Split search per open node (first-max tie break over (feature, bin)).
 #pragma omp parallel for schedule(static)
@@ -172,6 +201,7 @@ void ce_gbdt_build_tree(const uint8_t* Xb, int64_t n, int64_t f,
   delete[] open_;
   delete[] node_of_row;
   delete[] local;
+  delete[] order;
 }
 
 // Accumulate a forest's margins:

@@ -68,6 +68,48 @@ def test_main_emits_single_json_line(capsys):
     assert rec["vs_baseline"] > 0
 
 
+def test_hc_mode_matches_scipy():
+    from scipy.stats import entropy as scipy_entropy
+
+    hc = bench.make_hc_table(50, 4)
+    ent, idx = bench.cpu_reference_iteration(None, None, None, 5, "hc", hc)
+    want = scipy_entropy(hc.astype(np.float64), axis=1)
+    np.testing.assert_allclose(ent, want, rtol=1e-6)
+    args, itfn = bench.build_xla_impl(
+        np.zeros((50, 1, 4), np.float32), np.zeros((1, 4, 4), np.float32),
+        np.zeros((1, 4), np.float32), 5, "hc", hc)
+    assert bench.check_parity("hc", args, itfn, ent, idx, 5, n_valid=50)
+
+
+def test_mix_mode_stacked_rows_parity():
+    """mix = [mc consensus rows; hc rows] with top-k over the stacked
+    space; parity remapping must reconcile the padded device layout."""
+    x, w, b = bench.make_inputs(3, 60, 2, 8, 4)
+    hc = bench.make_hc_table(60, 4)
+    ent, idx = bench.cpu_reference_iteration(x, w, b, 6, "mix", hc)
+    assert ent.shape == (120,)  # stacked rows
+    args, itfn = bench.build_xla_impl(x, w, b, 6, "mix", hc)
+    assert bench.check_parity("mix", args, itfn, ent, idx, 6, n_valid=60)
+
+
+@pytest.mark.parametrize("mode", ["hc", "mix"])
+def test_main_mode_flag_emits_tagged_metric(mode, capsys):
+    rc = bench.main(["--impl", "xla", "--mode", mode, "--pool", "64",
+                     "--members", "3", "--frames", "2", "--features", "8",
+                     "--chain", "3", "--trials", "1", "--cpu-reps", "1"])
+    assert rc == 0
+    out_lines = [l for l in capsys.readouterr().out.splitlines() if l]
+    rec = json.loads(out_lines[0])
+    assert rec["metric"] == f"al_pool_scoring_latency_{mode}_3m_64"
+
+
+def test_flat_gemm_variant_passes_parity():
+    x, w, b = bench.make_inputs(3, 64, 2, 8, 4)
+    ent, idx = bench.cpu_reference_iteration(x, w, b, 5)
+    args, itfn = bench.build_xla_impl(x, w, b, 5, "mc", None, flat_gemm=True)
+    assert bench.check_parity("xla-flat", args, itfn, ent, idx, 5, n_valid=64)
+
+
 def test_pallas_suite_skips_cleanly_off_tpu(capsys):
     # --impl pallas on a CPU host must exit 1 with a clear skip, not crash.
     rc = bench.main(["--impl", "pallas", "--pool", "64", "--members", "3",
