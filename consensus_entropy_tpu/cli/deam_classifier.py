@@ -67,7 +67,9 @@ def main(argv=None) -> int:
                                         "valence.csv"),
                            cache_csv=paths.deam_dataset_csv)
 
-    if args.model in ("cnn", "cnn_jax"):
+    if args.model in ("cnn", "cnn_jax", "cnn_res_jax"):
+        import dataclasses
+
         from consensus_entropy_tpu.config import TrainConfig
         from consensus_entropy_tpu.data.audio import device_store_from_npy
 
@@ -77,6 +79,8 @@ def main(argv=None) -> int:
         per_song = (df.groupby("song_id")["quadrants"].max())
         labels = {sid: int(q[1]) - 1 for sid, q in per_song.items()}
         cfg = resolve_cnn_config(args.cnn_config_json)
+        if args.model == "cnn_res_jax":
+            cfg = dataclasses.replace(cfg, arch="res")
         # training needs the device store (the trainer jit closes over the
         # device-resident waveform buffer)
         store = device_store_from_npy(paths.deam_npy_dir, list(labels),

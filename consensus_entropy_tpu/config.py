@@ -97,8 +97,17 @@ class CNNConfig:
     dropout_rate: float = 0.5
     #: Compute dtype for conv/dense (MXU-friendly); params stay float32.
     compute_dtype: str = "float32"
+    #: Device CNN family: ``vgg`` = conv→BN→ReLU→maxpool blocks (the paper's
+    #: ShortChunkCNN, ``short_cnn.py:278-349``); ``res`` = residual blocks
+    #: with stride-2 downsampling (the ShortChunkCNN_Res family whose
+    #: ``Res_2d`` block the reference vendors unused, ``short_cnn.py:40-66``).
+    arch: str = "vgg"
 
     def __post_init__(self):
+        if self.arch not in ("vgg", "res"):
+            raise ValueError(f"arch must be 'vgg' or 'res', got {self.arch!r}")
+        if self.arch == "res":
+            return  # stride-2 convs ceil-halve dims; they never hit zero
         # Fail fast if the pooling pyramid collapses a spatial dim to zero
         # (the reference hard-codes a geometry where this can't happen:
         # 128 mels × 231 frames through 7 2×2 pools → 1×1).

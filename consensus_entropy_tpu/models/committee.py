@@ -129,12 +129,20 @@ class CNNMember(Member):
 
     def save(self, path):
         save_variables(path, self.variables,
-                       meta={"kind": self.kind, "name": self.name})
+                       meta={"kind": self.kind, "name": self.name,
+                             "arch": self.config.arch})
 
     @classmethod
     def load(cls, path, config: CNNConfig = CNNConfig(),
              train_config: TrainConfig = TrainConfig()):
         variables, meta = load_variables(path)
+        # the checkpoint knows its trunk family; honor it over the caller's
+        # config so vgg/res members coexist in one workspace
+        arch = meta.get("arch", config.arch)
+        if arch != config.arch:
+            import dataclasses
+
+            config = dataclasses.replace(config, arch=arch)
         return cls(meta.get("name", os.path.basename(path)), variables,
                    config, train_config)
 
@@ -166,6 +174,20 @@ class Committee:
                  mesh=None):
         self.host_members = host_members
         self.cnn_members = cnn_members
+        if cnn_members:
+            # the committee scores all CNN members as ONE vmapped pytree, so
+            # they must share a trunk family; the committee config follows
+            # the members' arch (checkpoints know theirs — CNNMember.load)
+            archs = {m.config.arch for m in cnn_members}
+            if len(archs) > 1:
+                raise ValueError(
+                    f"CNN members mix trunk families {sorted(archs)}; a "
+                    f"committee vmaps one stacked pytree and needs one arch")
+            arch = archs.pop()
+            if arch != config.arch:
+                import dataclasses
+
+                config = dataclasses.replace(config, arch=arch)
         self.config = config
         self.device_members = device_members
         #: When set, CNN members score each song as the masked mean over

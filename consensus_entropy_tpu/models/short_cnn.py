@@ -1,4 +1,4 @@
-"""Flax ShortChunkCNN — the TPU-native CNN committee member.
+"""Flax ShortChunkCNN — the TPU-native CNN committee member families.
 
 Architecture parity with the reference's torch model (``short_cnn.py:278-349``):
 log-mel frontend → BatchNorm over the 1-channel spectrogram → 7× [3×3 conv →
@@ -7,6 +7,12 @@ max pool → Dense(512) → BN → ReLU → Dropout(0.5) → Dense(4) → **sigm
 (the reference trains with BCELoss on one-hot targets, ``amg_test.py:294`` —
 outputs are per-class Bernoullis, not a softmax simplex; the downstream
 entropy renormalizes, matching ``scipy.stats.entropy`` semantics).
+
+A second trunk family, ``config.arch='res'``, swaps the pool blocks for
+stride-2 residual blocks (:class:`ResBlock` — the semantics of the
+``Res_2d`` module the reference vendors from the sota-music-tagging model
+zoo but never wires up, ``short_cnn.py:40-66``); frontend, head, trainer,
+and committee machinery are shared between families.
 
 TPU-first choices (vs a line-for-line port):
 
@@ -52,8 +58,44 @@ class ConvBlock(nn.Module):
         return nn.max_pool(x, (2, 2), strides=(2, 2))
 
 
+class ResBlock(nn.Module):
+    """Residual block with stride-2 downsampling: conv(s2) → BN → ReLU →
+    conv → BN, plus a projected shortcut (conv(s2) → BN) whenever shape or
+    width changes; sum → ReLU.  Semantics of the vendored ``Res_2d``
+    (``short_cnn.py:40-66``; reference default stride=2)."""
+
+    features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        def bn(name):
+            return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                epsilon=1e-5, dtype=self.dtype, name=name)
+
+        out = nn.Conv(self.features, (3, 3), strides=(2, 2), padding=1,
+                      dtype=self.dtype, name="conv1")(x)
+        out = nn.relu(bn("bn1")(out))
+        out = nn.Conv(self.features, (3, 3), padding=1, dtype=self.dtype,
+                      name="conv2")(out)
+        out = bn("bn2")(out)
+        # stride 2 always changes shape -> the projection is always needed
+        # (the reference's `diff` flag; short_cnn.py:50-54)
+        short = nn.Conv(self.features, (3, 3), strides=(2, 2), padding=1,
+                        dtype=self.dtype, name="conv_proj")(x)
+        short = bn("bn_proj")(short)
+        return nn.relu(short + out)
+
+
 class ShortChunkCNN(nn.Module):
-    """VGG-ish short-chunk CNN over ~3.69 s mel spectrograms."""
+    """Short-chunk CNN over ~3.69 s mel spectrograms.
+
+    ``config.arch`` picks the trunk: ``vgg`` = conv/BN/ReLU/maxpool blocks
+    (the paper's committee member), ``res`` = stride-2 residual blocks
+    (the ShortChunkCNN_Res family).  Frontend and classifier head are
+    shared — and keep identical parameter paths — so both families plug
+    into the same trainer/committee/checkpoint machinery.
+    """
 
     config: CNNConfig = CNNConfig()
 
@@ -66,8 +108,9 @@ class ShortChunkCNN(nn.Module):
         s = s[..., None].astype(dtype)  # NHWC: (B, n_mels, T, 1)
         s = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          epsilon=1e-5, dtype=dtype, name="spec_bn")(s)
+        block = ResBlock if cfg.arch == "res" else ConvBlock
         for width in cfg.channel_widths:
-            s = ConvBlock(width, dtype=dtype)(s, train)
+            s = block(width, dtype=dtype)(s, train)
         # Global max pool over remaining (freq, time) — the reference squeezes
         # freq (==1 after 7 pools) then MaxPool1d's time (short_cnn.py:334-339).
         s = jnp.max(s, axis=(1, 2))

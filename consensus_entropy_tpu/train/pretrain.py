@@ -63,7 +63,7 @@ def _registry(seed) -> dict[str, Callable[[str], Member]]:
 
 
 MODEL_CHOICES = ("gnb", "sgd", "xgb", "rf", "svc", "knn", "gpc", "gbc",
-                 "cnn", "cnn_jax")
+                 "cnn", "cnn_jax", "cnn_res_jax")
 
 
 def grouped_folds(song_ids, n_splits: int, rng: np.random.Generator,
@@ -149,9 +149,14 @@ def pretrain_cnn(song_labels: dict, store, *, cv: int, out_dir: str,
             variables, store, train_ids, y_tr, test_ids, y_te,
             jax.random.fold_in(key, 1), n_epochs=n_epochs,
             adam_patience=40)  # pre-training patience, deam_classifier.py:150
+        # arch-tagged filename: a res pretrain must not clobber the vgg
+        # family's artifacts in a shared pretrained dir (loading dispatches
+        # on the .msgpack suffix + meta, not the filename)
+        stem = "cnn" if config.arch == "vgg" else f"cnn_{config.arch}"
         save_variables(
-            os.path.join(out_dir, f"classifier_cnn.it_{i}.msgpack"), best,
-            meta={"kind": "cnn_jax", "name": f"it_{i}"})
+            os.path.join(out_dir, f"classifier_{stem}.it_{i}.msgpack"), best,
+            meta={"kind": "cnn_jax", "name": f"it_{i}",
+                  "arch": config.arch})
         # fold eval: one random crop per test song
         from consensus_entropy_tpu.models.short_cnn import apply_infer
 
@@ -164,7 +169,9 @@ def pretrain_cnn(song_labels: dict, store, *, cv: int, out_dir: str,
                                f1s[-1])
     summary = {"f1": {"mean": float(np.mean(f1s)), "std": float(np.std(f1s))}}
     _print_cv(summary)
-    _append_jsonl(out_dir, {"model": "cnn_jax", "cv": cv, **summary})
+    _append_jsonl(out_dir, {"model": ("cnn_jax" if config.arch == "vgg"
+                                      else f"cnn_{config.arch}_jax"),
+                            "cv": cv, "arch": config.arch, **summary})
     return summary
 
 

@@ -1,0 +1,155 @@
+"""The residual CNN family (config.arch='res'): geometry, training, committee
+vmap, trainer integration, checkpoint arch round-trip, and the pretrain CLI
+registry entry.  Reference block semantics: the vendored (unused) ``Res_2d``
+at ``/root/reference/short_cnn.py:40-66``."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from consensus_entropy_tpu.config import CNNConfig
+from consensus_entropy_tpu.models import short_cnn
+
+TINY_RES = CNNConfig(n_channels=4, n_mels=32, n_layers=3, input_length=8192,
+                     arch="res")
+
+
+@pytest.fixture(scope="module")
+def res_vars():
+    return short_cnn.init_variables(jax.random.key(0), TINY_RES)
+
+
+def test_arch_validation():
+    with pytest.raises(ValueError, match="arch"):
+        CNNConfig(arch="transformer")
+
+
+def test_res_geometry_never_collapses():
+    # stride-2 convs ceil-halve; even deep stacks on small inputs are valid
+    CNNConfig(n_channels=2, n_mels=8, n_layers=7, input_length=4096,
+              arch="res")  # must not raise (vgg would collapse here)
+    with pytest.raises(ValueError, match="collapses"):
+        CNNConfig(n_channels=2, n_mels=8, n_layers=7, input_length=4096)
+
+
+def test_res_forward_shape_and_range(res_vars, rng):
+    x = rng.standard_normal((3, TINY_RES.input_length)).astype(np.float32)
+    out = np.asarray(short_cnn.apply_infer(res_vars, x, TINY_RES))
+    assert out.shape == (3, 4)
+    # sigmoid head; at INIT the residual adds can push f32 sigmoid to
+    # saturation (running BN stats haven't adapted), so bounds are closed
+    assert np.isfinite(out).all()
+    assert (out >= 0).all() and (out <= 1).all()
+
+
+def test_res_params_differ_from_vgg():
+    """The two trunks are distinct parameter trees (projection shortcut
+    etc.) while sharing head parameter paths."""
+    vgg_cfg = dataclasses.replace(TINY_RES, arch="vgg")
+    res_p = short_cnn.init_variables(jax.random.key(0), TINY_RES)["params"]
+    vgg_p = short_cnn.init_variables(jax.random.key(0), vgg_cfg)["params"]
+    assert "dense1" in res_p and "dense1" in vgg_p  # shared head paths
+    res_blocks = [k for k in res_p if k.startswith("ResBlock")]
+    assert len(res_blocks) == TINY_RES.n_layers
+    assert "conv_proj" in res_p[res_blocks[0]]  # projected shortcut
+    assert not any(k.startswith("ResBlock") for k in vgg_p)
+
+
+def test_res_train_step_and_committee_vmap(res_vars, rng):
+    x = rng.standard_normal((4, TINY_RES.input_length)).astype(np.float32)
+    out, new_stats = short_cnn.apply_train(
+        res_vars, x, jax.random.key(1), TINY_RES)
+    assert out.shape == (4, 4)
+    assert any(not np.allclose(a, b) for a, b in zip(
+        jax.tree.leaves(res_vars["batch_stats"]),
+        jax.tree.leaves(new_stats)))
+    members = [short_cnn.init_variables(jax.random.key(i), TINY_RES)
+               for i in range(3)]
+    stacked = short_cnn.stack_params(members)
+    probs = np.asarray(short_cnn.committee_infer(stacked, x, TINY_RES))
+    assert probs.shape == (3, 4, 4)
+    # members differ (independent init) but each matches its solo forward
+    np.testing.assert_allclose(
+        probs[1], np.asarray(short_cnn.apply_infer(members[1], x, TINY_RES)),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_res_trainer_fit(rng, tmp_path):
+    """The shared CNNTrainer trains a res member end to end (jitted epochs,
+    best-checkpoint gate) without any family-specific code."""
+    from consensus_entropy_tpu.config import TrainConfig
+    from consensus_entropy_tpu.data.audio import DeviceWaveformStore
+    from consensus_entropy_tpu.models.cnn_trainer import CNNTrainer
+
+    waves = {f"s{i}": (rng.standard_normal(9000) * 0.05).astype(np.float32)
+             for i in range(8)}
+    store = DeviceWaveformStore(waves, TINY_RES.input_length)
+    ids = list(waves)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+    trainer = CNNTrainer(TINY_RES, TrainConfig(batch_size=4))
+    v0 = short_cnn.init_variables(jax.random.key(0), TINY_RES)
+    best, hist = trainer.fit(v0, store, ids[:6], y[:6], ids[6:], y[6:],
+                             jax.random.key(1), n_epochs=2)
+    assert len(hist) == 2
+    assert np.isfinite([h["val_loss"] for h in hist]).all()
+
+
+def test_res_member_checkpoint_arch_roundtrip(res_vars, tmp_path):
+    """CNNMember checkpoints record their trunk family; load honors it even
+    when the caller passes a vgg config, and the committee follows."""
+    from consensus_entropy_tpu.models.committee import CNNMember, Committee
+
+    m = CNNMember("it_0", res_vars, TINY_RES)
+    path = str(tmp_path / "classifier_cnn.it_0.msgpack")
+    m.save(path)
+    vgg_cfg = dataclasses.replace(TINY_RES, arch="vgg")
+    m2 = CNNMember.load(path, vgg_cfg)
+    assert m2.config.arch == "res"
+    c = Committee([], [m2], vgg_cfg)
+    assert c.config.arch == "res"  # committee config follows the members
+
+
+def test_committee_rejects_mixed_cnn_families(res_vars):
+    from consensus_entropy_tpu.models.committee import CNNMember, Committee
+
+    vgg_cfg = dataclasses.replace(TINY_RES, arch="vgg")
+    vgg_vars = short_cnn.init_variables(jax.random.key(1), vgg_cfg)
+    with pytest.raises(ValueError, match="trunk families"):
+        Committee([], [CNNMember("a", res_vars, TINY_RES),
+                       CNNMember("b", vgg_vars, vgg_cfg)], vgg_cfg)
+
+
+def test_cnn_res_jax_registry_choice():
+    from consensus_entropy_tpu.train.pretrain import MODEL_CHOICES
+
+    assert "cnn_res_jax" in MODEL_CHOICES
+
+
+def test_res_pretrain_artifacts_do_not_clobber_vgg(rng, tmp_path):
+    """vgg and res pretrains in one pretrained dir coexist (arch-tagged
+    filenames) and the metrics jsonl labels each family."""
+    import json
+    import os
+
+    from consensus_entropy_tpu.config import TrainConfig
+    from consensus_entropy_tpu.data.audio import DeviceWaveformStore
+    from consensus_entropy_tpu.train.pretrain import pretrain_cnn
+
+    waves = {i: (rng.standard_normal(9000) * 0.05).astype(np.float32)
+             for i in range(10)}
+    labels = {i: i % 4 for i in waves}
+    store = DeviceWaveformStore(waves, TINY_RES.input_length)
+    out = str(tmp_path)
+    vgg_cfg = dataclasses.replace(TINY_RES, arch="vgg")
+    pretrain_cnn(labels, store, cv=1, out_dir=out, config=vgg_cfg,
+                 train_config=TrainConfig(batch_size=4), n_epochs=1)
+    pretrain_cnn(labels, store, cv=1, out_dir=out, config=TINY_RES,
+                 train_config=TrainConfig(batch_size=4), n_epochs=1)
+    files = sorted(f for f in os.listdir(out) if f.endswith(".msgpack"))
+    assert files == ["classifier_cnn.it_0.msgpack",
+                     "classifier_cnn_res.it_0.msgpack"]
+    rows = [json.loads(l)
+            for l in open(os.path.join(out, "pretrain_metrics.jsonl"))]
+    assert [r["model"] for r in rows] == ["cnn_jax", "cnn_res_jax"]
