@@ -202,6 +202,10 @@ class Committee:
         self.full_song_hop = full_song_hop
         self.trainer = CNNTrainer(config, train_config)
         self.mesh = mesh
+        #: compiled sequence-parallel scorers keyed by (geometry, mesh);
+        #: never invalidated — safe because scorers take the stacked member
+        #: params as an argument, so retraining needs no cache flush
+        self._seq_scorers: dict = {}
 
         def infer(stacked, x):
             return short_cnn.committee_infer(stacked, x, self.config)
@@ -449,6 +453,42 @@ class Committee:
             blocks.append(out[:, : out.shape[1] - pad])
         return jnp.concatenate(blocks, axis=1) if len(blocks) > 1 \
             else blocks[0]
+
+    def predict_song_sequence(self, wave, seq_mesh, *, hop: int | None = None):
+        """Sequence-parallel full-song CNN scoring: ``(M_cnn, C)``.
+
+        The long-audio production path (``parallel.sequence``): the song's
+        window axis is sharded over ``seq_mesh``'s ``seq`` axis with a ring
+        halo exchange, so minutes-long waveforms score without replicating
+        the audio per chip.  Compiled scorers are cached per (plan, mesh):
+        songs that fall on the same padded geometry reuse one XLA program.
+        Use :meth:`predict_songs_cnn` for pools of short excerpts — this
+        method is for waveforms that dwarf ``config.input_length``.
+        """
+        from consensus_entropy_tpu.parallel.mesh import SEQ_AXIS
+        from consensus_entropy_tpu.parallel.sequence import (
+            make_full_song_scorer,
+            pad_song,
+            plan_windows,
+        )
+
+        if not self.cnn_members:
+            raise ValueError("committee has no CNN members to score with")
+        wave = np.asarray(wave, np.float32)
+        plan = plan_windows(wave.shape[0], seq_mesh.shape[SEQ_AXIS],
+                            window=self.config.input_length,
+                            hop=self.full_song_hop if hop is None else hop)
+        # Key by compiled geometry (n_windows is a dynamic operand of the
+        # scorer) and by mesh VALUE — Mesh hashes by devices+axes, so
+        # per-call make_seq_mesh() constructions still hit the cache.
+        key = (plan.windows_per_shard, plan.chunk_len, plan.halo,
+               plan.window, plan.hop, seq_mesh)
+        scorer = self._seq_scorers.get(key)
+        if scorer is None:
+            scorer = self._seq_scorers[key] = make_full_song_scorer(
+                seq_mesh, plan, self.config)
+        return scorer(self._stacked(), jnp.asarray(pad_song(wave, plan)),
+                      plan.n_windows)
 
     # -- persistence -------------------------------------------------------
 

@@ -146,8 +146,11 @@ def make_full_song_scorer(mesh: Mesh, plan: WindowPlan,
                          f"{n_shards}")
     model = ShortChunkCNN(config)
 
-    def _shard_fn(stacked, chunks, tail):
-        # chunks: (1, chunk_len) local block; tail: (halo,) replicated.
+    def _shard_fn(stacked, chunks, tail, n_windows):
+        # chunks: (1, chunk_len) local block; tail: (halo,) replicated;
+        # n_windows: dynamic scalar — the only per-song quantity, so every
+        # song in one (windows_per_shard, chunk_len, halo) geometry bucket
+        # shares this compiled program.
         chunk = chunks[0]
         idx = lax.axis_index(SEQ_AXIS)
         if plan.halo:
@@ -167,7 +170,7 @@ def make_full_song_scorer(mesh: Mesh, plan: WindowPlan,
         # Masked mean over the global window axis: pad windows weigh 0.
         gid = idx * plan.windows_per_shard + jnp.arange(
             plan.windows_per_shard)
-        weight = (gid < plan.n_windows).astype(probs.dtype)   # (wps,)
+        weight = (gid < n_windows).astype(probs.dtype)   # (wps,)
         local_sum = jnp.einsum("mwc,w->mc", probs, weight)
         total = lax.psum(local_sum, SEQ_AXIS)
         count = lax.psum(jnp.sum(weight), SEQ_AXIS)
@@ -175,18 +178,23 @@ def make_full_song_scorer(mesh: Mesh, plan: WindowPlan,
 
     sharded = jax.shard_map(
         _shard_fn, mesh=mesh,
-        in_specs=(P(), P(SEQ_AXIS), P()),
+        in_specs=(P(), P(SEQ_AXIS), P(), P()),
         out_specs=P(),
         check_vma=False)
 
     body_len = n_shards * plan.chunk_len
 
     @jax.jit
-    def scorer(stacked_variables, padded_wave):
+    def _scorer(stacked_variables, padded_wave, n_windows):
         body = padded_wave[:body_len].reshape(n_shards, plan.chunk_len)
         tail = (padded_wave[body_len:] if plan.halo
                 else jnp.zeros((0,), padded_wave.dtype))
-        return sharded(stacked_variables, body, tail)
+        return sharded(stacked_variables, body, tail, n_windows)
+
+    def scorer(stacked_variables, padded_wave, n_windows: int | None = None):
+        return _scorer(stacked_variables, padded_wave,
+                       jnp.int32(plan.n_windows if n_windows is None
+                                 else n_windows))
 
     return scorer
 

@@ -84,3 +84,41 @@ def test_plan_rejects_halo_deeper_than_chunk():
     # Same overlap on a long song is fine (chunk covers the halo).
     p = sequence.plan_windows(200_000, 8, window=1024, hop=256)
     assert p.halo <= p.chunk_len
+
+
+def test_committee_predict_song_sequence(rng):
+    """The production Committee surface for long audio: sequence-parallel
+    scoring matches the single-device window oracle, and repeat calls with
+    the same geometry reuse one compiled scorer."""
+    from consensus_entropy_tpu.models.committee import CNNMember, Committee
+
+    members = [CNNMember(f"it_{i}",
+                         init_variables(jax.random.key(i), TINY,
+                                        batch_size=2), TINY)
+               for i in range(2)]
+    c = Committee([], members, TINY, full_song_hop=512)
+    mesh = make_seq_mesh()
+    wave = _song(rng, 50_000)  # ~49x the window length
+    got = np.asarray(c.predict_song_sequence(wave, mesh))
+    assert got.shape == (2, 4)
+    plan = sequence.plan_windows(len(wave), 8, window=1024, hop=512)
+    want = np.asarray(sequence.full_song_probs_reference(
+        c._stacked(), wave, plan, TINY))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+    # compiled-scorer cache: keyed by geometry bucket + mesh VALUE, with
+    # n_windows a dynamic operand — a different length in the same
+    # windows-per-shard bucket and a freshly built (equal) mesh both reuse
+    # the entry; only a new bucket compiles another program
+    assert len(c._seq_scorers) == 1
+    c.predict_song_sequence(_song(rng, 49_000), make_seq_mesh())
+    assert len(c._seq_scorers) == 1
+    c.predict_song_sequence(_song(rng, 80_000), mesh)  # new wps bucket
+    assert len(c._seq_scorers) == 2
+
+
+def test_committee_predict_song_sequence_needs_cnn(rng):
+    from consensus_entropy_tpu.models.committee import Committee
+
+    c = Committee([], [], TINY)
+    with pytest.raises(ValueError, match="no CNN members"):
+        c.predict_song_sequence(_song(rng, 10_000), make_seq_mesh())
