@@ -62,6 +62,7 @@ class Acquirer:
         else:
             self.hc = np.zeros((self.n_pad, NUM_CLASSES), np.float32)
             self.hc_mask[:] = False
+        self._mesh = mesh
         if mesh is None:
             self._fns = scoring.make_scoring_fns(k=queries,
                                                  tie_break=tie_break)
@@ -73,6 +74,39 @@ class Acquirer:
             self._fns = make_sharded_scoring_fns(mesh, k=queries,
                                                  tie_break=tie_break)
         self._rand_key = jax.random.key(seed)
+
+    def _feed(self, arr, axis: int):
+        """Upload one scoring input with its pool sharding.
+
+        Mesh path: per-host feed — each process contributes only its
+        ``host_pool_slice`` block (``multihost.distribute_along``), so no
+        host ships rows it doesn't own; single-process this equals a
+        ``device_put`` and is what the virtual-mesh tests exercise.
+        """
+        if self._mesh is None:
+            return arr
+        from consensus_entropy_tpu.parallel import multihost
+
+        arr = np.asarray(arr)
+        sl = [slice(None)] * arr.ndim
+        sl[axis] = multihost.host_pool_slice(arr.shape[axis])
+        return multihost.distribute_along(arr[tuple(sl)], arr.shape,
+                                          self._mesh, axis)
+
+    def _feed_key(self, key):
+        """Replicated global feed for the rand-mode PRNG key: a committed
+        process-local key cannot be implicitly resharded onto a mesh with
+        non-addressable devices (multi-host), so it rides the same
+        process-local-data path as the pool inputs — every process holds
+        the identical seed-derived key, so the replication is consistent."""
+        if self._mesh is None:
+            return key
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        data = np.asarray(jax.random.key_data(key))
+        arr = jax.make_array_from_process_local_data(
+            NamedSharding(self._mesh, P()), data, data.shape)
+        return jax.random.wrap_key_data(arr)
 
     # -- helpers -----------------------------------------------------------
 
@@ -102,15 +136,19 @@ class Acquirer:
         masks exactly as the reference mutates its tables.
         """
         if self.mode == "mc":
-            res = self._fns["mc"](self.pad_probs(member_probs), self.pool_mask)
+            res = self._fns["mc"](self._feed(self.pad_probs(member_probs), 1),
+                                  self._feed(self.pool_mask, 0))
             q_songs = self._ids(res)
         elif self.mode == "hc":
-            res = self._fns["hc"](self.hc, self.hc_mask)
+            res = self._fns["hc"](self._feed(self.hc, 0),
+                                  self._feed(self.hc_mask, 0))
             q_songs = self._ids(res)
             self._remove_hc(q_songs)  # amg_test.py:455
         elif self.mode == "mix":
-            res = self._fns["mix"](self.pad_probs(member_probs),
-                                   self.pool_mask, self.hc, self.hc_mask)
+            res = self._fns["mix"](self._feed(self.pad_probs(member_probs), 1),
+                                   self._feed(self.pool_mask, 0),
+                                   self._feed(self.hc, 0),
+                                   self._feed(self.hc_mask, 0))
             is_hc, slots = scoring.split_mix_index(res.indices, self.n_pad)
             valid = np.asarray(res.values) > -np.inf
             raw = [self.songs[int(s)]
@@ -122,7 +160,8 @@ class Acquirer:
         elif self.mode == "rand":
             if rand_key is None:
                 self._rand_key, rand_key = jax.random.split(self._rand_key)
-            res = self._fns["rand"](rand_key, self.pool_mask)
+            res = self._fns["rand"](self._feed_key(rand_key),
+                                    self._feed(self.pool_mask, 0))
             q_songs = self._ids(res)
         else:
             raise ValueError(f"unknown mode {self.mode!r}")

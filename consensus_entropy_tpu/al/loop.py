@@ -178,10 +178,16 @@ class ALLoop:
                        mesh=self.mesh, pad_to=self.pad_pool_to)
         acq.replay(queried_hist)
 
+        from consensus_entropy_tpu.parallel import multihost
+
         def checkpoint(next_epoch: int, current_key) -> None:
             """Two-phase commit: stage members -> state write (commit point)
             -> promote.  A kill anywhere leaves (committee, state) pairs
-            consistent (al_state.recover_workspace)."""
+            consistent (al_state.recover_workspace).  Multi-host: only the
+            coordinator touches the workspace (every process carries the
+            same in-memory committee, so nothing is lost)."""
+            if not multihost.is_coordinator():
+                return
             committee.save(al_state.staging_dir(user_path, next_epoch))
             kd, kdt = al_state.ALState.pack_key(current_key)
             al_state.ALState(
@@ -196,7 +202,8 @@ class ALLoop:
             ).save(user_path)
             al_state.recover_workspace(user_path)  # promote the stage
 
-        with UserReport(user_path, cfg.mode) as report:
+        with UserReport(user_path, cfg.mode,
+                        write=multihost.is_coordinator()) as report:
             if st is None:
                 # epoch 0: baseline evaluation (amg_test.py:398-418)
                 report.epoch_header(-1)

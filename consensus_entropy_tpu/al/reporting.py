@@ -24,7 +24,15 @@ def weighted_f1(y_true, y_pred) -> float:
 class UserReport:
     """One user's AL run: text file + jsonl, same cadence as the reference."""
 
-    def __init__(self, user_path: str, mode: str, *, now: str | None = None):
+    def __init__(self, user_path: str, mode: str, *, now: str | None = None,
+                 write: bool = True):
+        """``write=False`` computes metrics but touches no files — the
+        non-coordinator mode of multi-host runs (every process evaluates in
+        lockstep; only the coordinator owns the report files)."""
+        self.write = write
+        if not write:
+            self._txt = self._jsonl = None
+            return
         ts = now or datetime.datetime.now().strftime("%d-%m-%Y.%H-%M-%S")
         self.txt_path = os.path.join(user_path,
                                      f"{mode}.trial.date_{ts}.txt")
@@ -33,18 +41,23 @@ class UserReport:
         self._jsonl = open(self.jsonl_path, "a")
 
     def epoch_header(self, epoch: int) -> None:
+        if not self.write:
+            return
         self._txt.write("---------------------------------")
         self._txt.write(
             f"\n\n~~~~~~~~~\nEpoch {epoch}:~~~~~~~~~\n~~~~~~~~~\n\n\n")
 
     def model_eval(self, model_name: str, y_true, y_pred) -> float:
         f1 = weighted_f1(y_true, y_pred)
-        self._txt.write(f"Model: {model_name}\n")
-        self._txt.write(f"{classification_report(y_true, y_pred)}\n")
+        if self.write:
+            self._txt.write(f"Model: {model_name}\n")
+            self._txt.write(f"{classification_report(y_true, y_pred)}\n")
         return f1
 
     def epoch_summary(self, epoch: int, f1_list, *, queried=None,
                       pool_size=None) -> None:
+        if not self.write:
+            return
         mean_f1 = float(np.mean(f1_list)) if len(f1_list) else float("nan")
         self._txt.write("**\nSummary: F1 mean score over all classifiers = "
                         f"{mean_f1}\n**\n")
@@ -59,6 +72,8 @@ class UserReport:
         self._jsonl.flush()
 
     def close(self) -> None:
+        if not self.write:
+            return
         self._txt.write("---------------------------------")
         self._txt.close()
         self._jsonl.close()

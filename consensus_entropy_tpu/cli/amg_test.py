@@ -45,6 +45,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="shard the scoring path (CNN forward + fused "
                         "mean->entropy->top-k) over a pool-axis device mesh: "
                         "'auto' = all visible devices, N = first N devices")
+    p.add_argument("--distributed", default=None, metavar="COORD,N,ID",
+                   help="join a multi-host run before touching the backend: "
+                        "coordinator host:port, process count, this "
+                        "process's id (parallel.multihost; with --mesh auto "
+                        "the pool then spans every host's chips over DCN)")
     p.add_argument("--pad-pool-to", type=int, default=None, metavar="N",
                    help="pad every user's pool to one fixed width so the "
                         "scoring graph compiles once across users (see "
@@ -71,6 +76,26 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     configure_device(args.device)
+
+    if args.distributed:
+        # must precede every other jax call (jax.distributed contract)
+        from consensus_entropy_tpu.parallel import multihost
+
+        try:
+            coord, n_proc, proc_id = args.distributed.split(",")
+            n_proc, proc_id = int(n_proc), int(proc_id)
+        except ValueError:
+            print(f"--distributed must be COORD,N,ID "
+                  f"(got {args.distributed!r})")
+            return 1
+        if args.mesh not in (None, "auto"):
+            # a numeric --mesh would slice the GLOBAL device list
+            # identically on every process — non-addressable devices on
+            # all but host 0; only the all-devices mesh is meaningful here
+            print("--distributed requires --mesh auto (a numeric mesh "
+                  "cannot span hosts)")
+            return 1
+        multihost.initialize(coord, n_proc, proc_id)
 
     import numpy as np
 
@@ -128,18 +153,37 @@ def main(argv=None) -> int:
         if not 1 <= n_dev <= len(devs):
             print(f"--mesh {args.mesh}: have {len(devs)} device(s)")
             return 1
-        mesh = make_pool_mesh(devs[:n_dev])
-        print(f"Scoring mesh: {n_dev} device(s) on the pool axis")
+        if args.distributed and args.mesh == "auto":
+            # every host's chips; contiguous pool blocks stay host-local
+            from consensus_entropy_tpu.parallel import multihost
+
+            mesh = multihost.global_pool_mesh()
+            print(f"Scoring mesh: {n_dev} device(s) across "
+                  f"{jax.process_count()} host(s) on the pool axis")
+        else:
+            mesh = make_pool_mesh(devs[:n_dev])
+            print(f"Scoring mesh: {n_dev} device(s) on the pool axis")
 
     loop = ALLoop(cfg, tie_break=args.tie_break,
                   retrain_epochs=args.retrain_epochs, mesh=mesh,
                   pad_pool_to=args.pad_pool_to)
+    # Multi-host discipline (no-ops single-process): the coordinator owns
+    # every workspace write; skip decisions are broadcast so control flow
+    # stays in lockstep (divergence would deadlock the next collective).
+    from consensus_entropy_tpu.parallel import multihost
+
     results = []
     for num_user, u_id in enumerate(users[: args.max_users]):
-        user_path, skip = workspace.create_user(
-            paths.users_dir, paths.pretrained_dir, u_id, cfg.mode,
-            experiment={"seed": cfg.seed, "queries": cfg.queries,
-                        "train_size": cfg.train_size})
+        if multihost.is_coordinator():
+            user_path, skip = workspace.create_user(
+                paths.users_dir, paths.pretrained_dir, u_id, cfg.mode,
+                experiment={"seed": cfg.seed, "queries": cfg.queries,
+                            "train_size": cfg.train_size})
+        else:
+            user_path = workspace.user_dir(paths.users_dir, u_id, cfg.mode)
+            skip = False
+        multihost.sync(f"create_user_{num_user}")
+        skip = multihost.broadcast_flag(skip)
         if skip:
             print(f"Skipping user {u_id}, already exists!")
             continue
@@ -152,12 +196,16 @@ def main(argv=None) -> int:
         print(f"Creating and performing active learning for user {u_id} "
               f"with {len(labels)} annotations.")
         print(f"User {num_user} / {len(users) - 1}")
-        timer = profiling.StepTimer(os.path.join(user_path, "timings.jsonl"))
+        timer = profiling.StepTimer(
+            os.path.join(user_path, "timings.jsonl")
+            if multihost.is_coordinator() else None)
         with profiling.trace(args.trace_dir):
             res = loop.run_user(committee, data, user_path, seed=cfg.seed,
                                 timer=timer)
-        committee.save(user_path)
-        workspace.mark_done(user_path)
+        if multihost.is_coordinator():
+            committee.save(user_path)
+            workspace.mark_done(user_path)
+        multihost.sync(f"user_done_{num_user}")
         results.append(res)
         print(f"user {u_id}: final mean F1 = {res['final_mean_f1']:.4f}")
 

@@ -240,6 +240,38 @@ class Committee:
         return (jnp.einsum("mrwc,rw->mrc", probs, weight)
                 / jnp.sum(weight, axis=1)[None, :, None])
 
+    # -- multi-host feeds (no-ops single-process) --------------------------
+
+    def _feed_repl(self, pytree):
+        """Replicated global feed of a host-local pytree (the stacked member
+        params) for jits whose in_shardings span a multi-host mesh —
+        committed process-local arrays cannot be implicitly resharded onto
+        non-addressable devices.  Every process holds identical values, so
+        replication is consistent."""
+        import jax as _jax
+
+        if self.mesh is None or _jax.process_count() == 1:
+            return pytree
+        from jax.experimental import multihost_utils
+        from jax.sharding import PartitionSpec as P
+
+        return multihost_utils.host_local_array_to_global_array(
+            pytree, self.mesh, P())
+
+    def _feed_rows(self, arr):
+        """Pool-row feed: each process contributes its ``host_pool_slice``
+        block (crops / window batches are shard-divisible, hence
+        process-divisible)."""
+        import jax as _jax
+
+        if self.mesh is None or _jax.process_count() == 1:
+            return arr
+        from consensus_entropy_tpu.parallel import multihost
+
+        arr = np.asarray(arr)
+        sl = multihost.host_pool_slice(arr.shape[0])
+        return multihost.distribute_along(arr[sl], arr.shape, self.mesh, 0)
+
     @property
     def size(self) -> int:
         return len(self.host_members) + len(self.cnn_members)
@@ -433,12 +465,13 @@ class Committee:
             if pad:
                 crops = jnp.concatenate(
                     [crops, jnp.repeat(crops[-1:], pad, axis=0)])
-            out = self._infer(self._stacked(), crops)
+            out = self._infer(self._feed_repl(self._stacked()),
+                              self._feed_rows(crops))
             return out[:, : len(rows)] if pad else out
         n = len(rows)
         # each window chunk is one sharded dispatch; keep it shard-divisible
         chunk = _round_up(chunk, self._n_pool_shards)
-        stacked = self._stacked()
+        stacked = self._feed_repl(self._stacked())
         if n == 0:
             m = len(self.cnn_members)
             return jnp.zeros((m, 0, self.config.n_class), jnp.float32)
@@ -449,7 +482,8 @@ class Committee:
             if pad:
                 sel = np.concatenate([sel, np.repeat(sel[-1:], pad)])
             windows, valid = store.window_batch(sel, self.full_song_hop)
-            out = self._infer_windows(stacked, windows, valid)
+            out = self._infer_windows(stacked, self._feed_rows(windows),
+                                      self._feed_rows(valid))
             blocks.append(out[:, : out.shape[1] - pad])
         return jnp.concatenate(blocks, axis=1) if len(blocks) > 1 \
             else blocks[0]

@@ -57,6 +57,33 @@ def initialize(coordinator_address: str | None = None,
     _initialized = True
 
 
+def is_coordinator() -> bool:
+    """True on the process that owns all filesystem writes (reports,
+    checkpoints, workspace mutation); single-process runs are trivially
+    the coordinator."""
+    return jax.process_index() == 0
+
+
+def sync(name: str = "sync") -> None:
+    """Cross-process barrier (no-op single-process).  Used around workspace
+    mutation so non-coordinators never read a directory mid-write."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
+def broadcast_flag(value: bool) -> bool:
+    """Coordinator's boolean, agreed by every process (no-op
+    single-process).  Keeps control-flow decisions (e.g. skip-user) in
+    lockstep — divergent paths would deadlock the next collective."""
+    if jax.process_count() == 1:
+        return bool(value)
+    from jax.experimental import multihost_utils
+
+    return bool(multihost_utils.broadcast_one_to_all(np.asarray(value)))
+
+
 def global_pool_mesh() -> Mesh:
     """1-D ``pool`` mesh over every addressable chip of every host."""
     return Mesh(np.asarray(jax.devices()), (POOL_AXIS,))
@@ -81,17 +108,26 @@ def host_pool_slice(n_rows: int) -> slice:
     return slice(pid * per, (pid + 1) * per)
 
 
-def distribute_pool(local_rows: np.ndarray, n_global_rows: int,
-                    mesh: Mesh | None = None):
-    """Assemble the global pool-sharded array from per-host row blocks.
+def distribute_along(local_block: np.ndarray, global_shape: tuple,
+                     mesh: Mesh | None = None, axis: int = 0):
+    """Assemble a global pool-sharded array from per-host blocks.
 
-    ``local_rows``: this host's ``host_pool_slice`` worth of rows (leading
-    axis).  Returns a global jax.Array sharded ``P('pool', None, ...)`` —
-    on a single host this is exactly ``device_put`` with the pool sharding.
+    ``local_block``: this host's ``host_pool_slice``-worth of the array
+    along ``axis`` (the pool axis — e.g. axis 1 for the ``(M, N, C)``
+    member-probability tables).  Returns a global jax.Array sharded on
+    ``pool`` at ``axis``; on a single host this is exactly ``device_put``
+    with that sharding, so the same feed path serves both.
     """
     mesh = mesh or global_pool_mesh()
-    sharding = NamedSharding(
-        mesh, P(POOL_AXIS, *([None] * (local_rows.ndim - 1))))
-    global_shape = (n_global_rows,) + tuple(local_rows.shape[1:])
-    return jax.make_array_from_process_local_data(sharding, local_rows,
-                                                  global_shape)
+    spec = [None] * len(global_shape)
+    spec[axis] = POOL_AXIS
+    sharding = NamedSharding(mesh, P(*spec))
+    return jax.make_array_from_process_local_data(sharding, local_block,
+                                                  tuple(global_shape))
+
+
+def distribute_pool(local_rows: np.ndarray, n_global_rows: int,
+                    mesh: Mesh | None = None):
+    """Leading-axis convenience wrapper over :func:`distribute_along`."""
+    return distribute_along(
+        local_rows, (n_global_rows,) + tuple(local_rows.shape[1:]), mesh, 0)

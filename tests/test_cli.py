@@ -187,3 +187,46 @@ def test_mesh_auto_cli(synth_roots, capsys):
     out = capsys.readouterr().out
     assert "Scoring mesh: 8 device(s)" in out
     assert "final mean F1" in out
+
+
+def test_distributed_flag_joins_before_mesh(synth_roots, capsys, monkeypatch):
+    """--distributed plumbs to multihost.initialize BEFORE backend use and
+    --mesh auto then takes the global (all-hosts) pool mesh; single-process
+    semantics are identical, so the full AL workflow runs through it."""
+    from consensus_entropy_tpu.parallel import multihost
+
+    calls = []
+    monkeypatch.setattr(
+        multihost, "initialize",
+        lambda coord=None, n=None, pid=None: calls.append((coord, n, pid)))
+    flags = ["--models-root", synth_roots["models"],
+             "--deam-root", synth_roots["deam"],
+             "--amg-root", synth_roots["amg"], "--device", "cpu"]
+    assert deam_classifier.main(["-cv", "2", "-m", "gnb"] + flags) == 0
+    rc = amg_test.main(["-q", "4", "-e", "2", "-m", "mc", "-n", "10",
+                        "--max-users", "1", "--mesh", "auto",
+                        "--distributed", "head:1234,1,0"] + flags)
+    assert rc == 0
+    assert calls == [("head:1234", 1, 0)]
+    out = capsys.readouterr().out
+    assert "across 1 host(s)" in out
+
+
+def test_distributed_flag_rejects_bad_spec(synth_roots, capsys):
+    rc = amg_test.main(["-q", "4", "-e", "2", "-m", "mc", "-n", "10",
+                        "--distributed", "nonsense",
+                        "--models-root", synth_roots["models"],
+                        "--deam-root", synth_roots["deam"],
+                        "--amg-root", synth_roots["amg"], "--device", "cpu"])
+    assert rc == 1
+    assert "COORD,N,ID" in capsys.readouterr().out
+
+
+def test_distributed_rejects_numeric_mesh(synth_roots, capsys):
+    rc = amg_test.main(["-q", "4", "-e", "2", "-m", "mc", "-n", "10",
+                        "--distributed", "head:1234,2,0", "--mesh", "4",
+                        "--models-root", synth_roots["models"],
+                        "--deam-root", synth_roots["deam"],
+                        "--amg-root", synth_roots["amg"], "--device", "cpu"])
+    assert rc == 1
+    assert "requires --mesh auto" in capsys.readouterr().out

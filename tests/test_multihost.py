@@ -38,3 +38,20 @@ def test_distribute_pool_feeds_sharded_scoring(rng):
     want = score_mc(np.moveaxis(local, 1, 0), mask, k=5)
     np.testing.assert_array_equal(np.asarray(res.indices),
                                   np.asarray(want.indices))
+
+
+def test_distribute_along_axis1_matches_device_put(rng):
+    """The Acquirer's probs feed: (M, N, C) with pool on axis 1."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from consensus_entropy_tpu.parallel.mesh import POOL_AXIS
+
+    probs = rng.standard_normal((3, 64, 4)).astype(np.float32)
+    mesh = multihost.global_pool_mesh()
+    got = multihost.distribute_along(
+        probs[:, multihost.host_pool_slice(64)], probs.shape, mesh, axis=1)
+    want = jax.device_put(probs, NamedSharding(mesh, P(None, POOL_AXIS,
+                                                       None)))
+    assert got.sharding == want.sharding
+    np.testing.assert_array_equal(np.asarray(got), probs)
