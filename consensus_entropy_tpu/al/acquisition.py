@@ -87,11 +87,7 @@ class Acquirer:
             return arr
         from consensus_entropy_tpu.parallel import multihost
 
-        arr = np.asarray(arr)
-        sl = [slice(None)] * arr.ndim
-        sl[axis] = multihost.host_pool_slice(arr.shape[axis])
-        return multihost.distribute_along(arr[tuple(sl)], arr.shape,
-                                          self._mesh, axis)
+        return multihost.feed_pool_axis(arr, self._mesh, axis)
 
     def _feed_key(self, key):
         """Replicated global feed for the rand-mode PRNG key: a committed

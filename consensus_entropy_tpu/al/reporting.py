@@ -30,13 +30,13 @@ class UserReport:
         non-coordinator mode of multi-host runs (every process evaluates in
         lockstep; only the coordinator owns the report files)."""
         self.write = write
-        if not write:
-            self._txt = self._jsonl = None
-            return
         ts = now or datetime.datetime.now().strftime("%d-%m-%Y.%H-%M-%S")
         self.txt_path = os.path.join(user_path,
                                      f"{mode}.trial.date_{ts}.txt")
         self.jsonl_path = os.path.join(user_path, "metrics.jsonl")
+        if not write:  # same attribute shape in both modes, no files
+            self._txt = self._jsonl = None
+            return
         self._txt = open(self.txt_path, "a")
         self._jsonl = open(self.jsonl_path, "a")
 

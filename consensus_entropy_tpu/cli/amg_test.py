@@ -88,12 +88,13 @@ def main(argv=None) -> int:
             print(f"--distributed must be COORD,N,ID "
                   f"(got {args.distributed!r})")
             return 1
-        if args.mesh not in (None, "auto"):
+        if args.mesh != "auto":
             # a numeric --mesh would slice the GLOBAL device list
-            # identically on every process — non-addressable devices on
-            # all but host 0; only the all-devices mesh is meaningful here
-            print("--distributed requires --mesh auto (a numeric mesh "
-                  "cannot span hosts)")
+            # identically on every process (non-addressable devices on all
+            # but host 0), and NO mesh would redundantly run the whole
+            # workload per host; only the all-devices mesh is meaningful
+            print("--distributed requires --mesh auto (got "
+                  f"--mesh {args.mesh!r})")
             return 1
         multihost.initialize(coord, n_proc, proc_id)
 

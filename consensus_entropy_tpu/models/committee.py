@@ -261,16 +261,28 @@ class Committee:
     def _feed_rows(self, arr):
         """Pool-row feed: each process contributes its ``host_pool_slice``
         block (crops / window batches are shard-divisible, hence
-        process-divisible)."""
+        process-divisible).  Short-circuits single-process — crops are
+        already device-resident and the helper's host round-trip would cost
+        a transfer for nothing (jit's in_shardings handle placement)."""
         import jax as _jax
 
         if self.mesh is None or _jax.process_count() == 1:
             return arr
         from consensus_entropy_tpu.parallel import multihost
 
-        arr = np.asarray(arr)
-        sl = multihost.host_pool_slice(arr.shape[0])
-        return multihost.distribute_along(arr[sl], arr.shape, self.mesh, 0)
+        return multihost.feed_pool_axis(arr, self.mesh, 0)
+
+    def _gather_rows(self, out):
+        """Inverse of the feeds: host-complete value of a pool-sharded
+        forward output on every process (multi-host ``np.asarray`` on such
+        an array raises — it spans non-addressable devices)."""
+        import jax as _jax
+
+        if self.mesh is None or _jax.process_count() == 1:
+            return out
+        from consensus_entropy_tpu.parallel import multihost
+
+        return multihost.gather_to_host(out)
 
     @property
     def size(self) -> int:
@@ -465,8 +477,8 @@ class Committee:
             if pad:
                 crops = jnp.concatenate(
                     [crops, jnp.repeat(crops[-1:], pad, axis=0)])
-            out = self._infer(self._feed_repl(self._stacked()),
-                              self._feed_rows(crops))
+            out = self._gather_rows(self._infer(
+                self._feed_repl(self._stacked()), self._feed_rows(crops)))
             return out[:, : len(rows)] if pad else out
         n = len(rows)
         # each window chunk is one sharded dispatch; keep it shard-divisible
@@ -482,11 +494,14 @@ class Committee:
             if pad:
                 sel = np.concatenate([sel, np.repeat(sel[-1:], pad)])
             windows, valid = store.window_batch(sel, self.full_song_hop)
-            out = self._infer_windows(stacked, self._feed_rows(windows),
-                                      self._feed_rows(valid))
+            out = self._gather_rows(self._infer_windows(
+                stacked, self._feed_rows(windows), self._feed_rows(valid)))
             blocks.append(out[:, : out.shape[1] - pad])
-        return jnp.concatenate(blocks, axis=1) if len(blocks) > 1 \
-            else blocks[0]
+        if len(blocks) == 1:
+            return blocks[0]
+        if isinstance(blocks[0], np.ndarray):  # multi-host: gathered to
+            return np.concatenate(blocks, axis=1)  # host; stay there
+        return jnp.concatenate(blocks, axis=1)
 
     def predict_song_sequence(self, wave, seq_mesh, *, hop: int | None = None):
         """Sequence-parallel full-song CNN scoring: ``(M_cnn, C)``.

@@ -131,3 +131,28 @@ def distribute_pool(local_rows: np.ndarray, n_global_rows: int,
     """Leading-axis convenience wrapper over :func:`distribute_along`."""
     return distribute_along(
         local_rows, (n_global_rows,) + tuple(local_rows.shape[1:]), mesh, 0)
+
+
+def feed_pool_axis(arr, mesh: Mesh, axis: int = 0):
+    """Slice this host's ``host_pool_slice`` block out of a host-complete
+    array and assemble the global pool-sharded jax.Array — THE feed helper
+    for every pool-sharded scoring input (Acquirer tables/masks, Committee
+    crop/window batches).  Single-process this equals a ``device_put`` with
+    the pool sharding."""
+    arr = np.asarray(arr)
+    sl = [slice(None)] * arr.ndim
+    sl[axis] = host_pool_slice(arr.shape[axis])
+    return distribute_along(arr[tuple(sl)], arr.shape, mesh, axis)
+
+
+def gather_to_host(out):
+    """Bring a (possibly pool-sharded) jax.Array back as a host-complete
+    numpy array on EVERY process.  Multi-host, a sharded output spans
+    non-addressable devices and plain ``np.asarray`` raises; this routes
+    through ``process_allgather``.  Single-process it is just
+    ``np.asarray``."""
+    if jax.process_count() == 1:
+        return np.asarray(out)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(out, tiled=True))

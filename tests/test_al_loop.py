@@ -130,3 +130,49 @@ def test_query_batch_label_alignment(rng):
     for x_row, y in zip(Xb[:, 0], yb):
         song = frame_song[int(x_row)]
         assert labels[song] == y, (x_row, y)
+
+
+def test_non_coordinator_runs_lockstep_without_writes(rng, tmp_path,
+                                                      monkeypatch):
+    """Multi-host discipline: a non-coordinator process executes the full
+    AL computation (it must stay in lockstep for collectives) but touches
+    no workspace files; the returned trajectory matches the coordinator's
+    bit-for-bit (same seed-derived streams)."""
+    from consensus_entropy_tpu.parallel import multihost
+
+    data = _user_data(rng, n_songs=30)
+    committee = _weak_committee(np.random.default_rng(0), data)
+    cfg = ALConfig(queries=4, epochs=2, mode="mc", seed=3)
+    coord_dir = str(tmp_path / "coord")
+    os.makedirs(coord_dir)
+    ALLoop(cfg).run_user(committee, data, coord_dir, seed=3)
+    assert os.path.exists(os.path.join(coord_dir, "metrics.jsonl"))
+
+    monkeypatch.setattr(multihost, "is_coordinator", lambda: False)
+    # identical inputs: rebuild data/committee with the same generators
+    rng2 = np.random.default_rng(12345)
+    dataA = _user_data(rng2, n_songs=30)
+    committeeA = _weak_committee(np.random.default_rng(0), dataA)
+    rng3 = np.random.default_rng(12345)
+    dataB = _user_data(rng3, n_songs=30)
+    committeeB = _weak_committee(np.random.default_rng(0), dataB)
+    nc_dir = str(tmp_path / "nc")
+    os.makedirs(nc_dir)
+    res_nc = ALLoop(cfg).run_user(committeeB, dataB, nc_dir, seed=3)
+    assert os.listdir(nc_dir) == []  # no reports, no state, no checkpoints
+    monkeypatch.setattr(multihost, "is_coordinator", lambda: True)
+    c_dir = str(tmp_path / "c2")
+    os.makedirs(c_dir)
+    res_c = ALLoop(cfg).run_user(committeeA, dataA, c_dir, seed=3)
+    assert res_nc["trajectory"] == res_c["trajectory"]
+
+
+def test_user_report_write_false_touches_nothing(tmp_path):
+    from consensus_entropy_tpu.al.reporting import UserReport
+
+    with UserReport(str(tmp_path), "mc", write=False) as rep:
+        rep.epoch_header(0)
+        f1 = rep.model_eval("m", [0, 1, 2, 3], [0, 1, 2, 2])
+        rep.epoch_summary(0, [f1], queried=["s1"], pool_size=9)
+    assert 0 < f1 < 1
+    assert os.listdir(str(tmp_path)) == []

@@ -230,3 +230,13 @@ def test_distributed_rejects_numeric_mesh(synth_roots, capsys):
                         "--amg-root", synth_roots["amg"], "--device", "cpu"])
     assert rc == 1
     assert "requires --mesh auto" in capsys.readouterr().out
+
+
+def test_distributed_requires_mesh_flag(synth_roots, capsys):
+    rc = amg_test.main(["-q", "4", "-e", "2", "-m", "mc", "-n", "10",
+                        "--distributed", "head:1234,2,0",
+                        "--models-root", synth_roots["models"],
+                        "--deam-root", synth_roots["deam"],
+                        "--amg-root", synth_roots["amg"], "--device", "cpu"])
+    assert rc == 1
+    assert "requires --mesh auto" in capsys.readouterr().out

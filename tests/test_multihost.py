@@ -55,3 +55,12 @@ def test_distribute_along_axis1_matches_device_put(rng):
                                                        None)))
     assert got.sharding == want.sharding
     np.testing.assert_array_equal(np.asarray(got), probs)
+
+
+def test_feed_and_gather_round_trip(rng):
+    """feed_pool_axis -> gather_to_host is the identity on a host-complete
+    array (single-process: device_put + np.asarray equivalents)."""
+    x = rng.standard_normal((32, 3)).astype(np.float32)
+    mesh = multihost.global_pool_mesh()
+    fed = multihost.feed_pool_axis(x, mesh, 0)
+    np.testing.assert_array_equal(multihost.gather_to_host(fed), x)
