@@ -67,7 +67,7 @@ def main(argv=None) -> int:
                                         "valence.csv"),
                            cache_csv=paths.deam_dataset_csv)
 
-    if args.model in ("cnn", "cnn_jax", "cnn_res_jax"):
+    if args.model in ("cnn", "cnn_jax", "cnn_res_jax", "cnn_harm_jax"):
         import dataclasses
 
         from consensus_entropy_tpu.config import TrainConfig
@@ -79,8 +79,9 @@ def main(argv=None) -> int:
         per_song = (df.groupby("song_id")["quadrants"].max())
         labels = {sid: int(q[1]) - 1 for sid, q in per_song.items()}
         cfg = resolve_cnn_config(args.cnn_config_json)
-        if args.model == "cnn_res_jax":
-            cfg = dataclasses.replace(cfg, arch="res")
+        if args.model not in ("cnn", "cnn_jax"):
+            # cnn_{arch}_jax registry names select the trunk family
+            cfg = dataclasses.replace(cfg, arch=args.model[4:-4])
         # training needs the device store (the trainer jit closes over the
         # device-resident waveform buffer)
         store = device_store_from_npy(paths.deam_npy_dir, list(labels),

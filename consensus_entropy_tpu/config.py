@@ -100,26 +100,45 @@ class CNNConfig:
     #: Device CNN family: ``vgg`` = conv→BN→ReLU→maxpool blocks (the paper's
     #: ShortChunkCNN, ``short_cnn.py:278-349``); ``res`` = residual blocks
     #: with stride-2 downsampling (the ShortChunkCNN_Res family whose
-    #: ``Res_2d`` block the reference vendors unused, ``short_cnn.py:40-66``).
+    #: ``Res_2d`` block the reference vendors unused, ``short_cnn.py:40-66``);
+    #: ``harm`` = the vgg trunk over a LEARNABLE harmonic-filterbank frontend
+    #: (the vendored ``HarmonicSTFT``, ``short_cnn.py:166-275``) instead of
+    #: log-mel — harmonics become the trunk's input channels.
     arch: str = "vgg"
+    #: ``harm`` frontend geometry (``short_cnn.py:199-210`` defaults).
+    n_harmonic: int = 6
+    semitone_scale: int = 2
+    bw_q_init: float = 1.0
 
     def __post_init__(self):
-        if self.arch not in ("vgg", "res"):
-            raise ValueError(f"arch must be 'vgg' or 'res', got {self.arch!r}")
+        if self.arch not in ("vgg", "res", "harm"):
+            raise ValueError(f"arch must be 'vgg', 'res', or 'harm', "
+                             f"got {self.arch!r}")
         if self.arch == "res":
             return  # stride-2 convs ceil-halve dims; they never hit zero
         # Fail fast if the pooling pyramid collapses a spatial dim to zero
         # (the reference hard-codes a geometry where this can't happen:
-        # 128 mels × 231 frames through 7 2×2 pools → 1×1).
-        f = self.n_mels
+        # 128 mels × 231 frames through 7 2×2 pools → 1×1).  The harm
+        # frontend's frequency axis is its note-grid level, not n_mels.
+        f = self.n_mels if self.arch == "vgg" else self.harm_level
         t = (self.input_length + 2 * (self.n_fft // 2)) // self.hop_length - 1
         for layer in range(self.n_layers):
             f, t = f // 2, t // 2
             if f == 0 or t == 0:
                 raise ValueError(
                     f"CNN geometry collapses at layer {layer + 1}: "
-                    f"n_mels={self.n_mels}, input_length={self.input_length} "
+                    f"freq={self.n_mels if self.arch == 'vgg' else self.harm_level}, "
+                    f"input_length={self.input_length} "
                     f"survive only {layer} of {self.n_layers} 2x2 pools")
+
+    @property
+    def harm_level(self) -> int:
+        """Frequency-axis height of the ``harm`` frontend (note-grid size;
+        128 at the default sr/harmonics/scale — same as n_mels)."""
+        from consensus_entropy_tpu.ops.harmonic import harmonic_center_freqs
+
+        return harmonic_center_freqs(self.sample_rate, self.n_harmonic,
+                                     self.semitone_scale)[1]
 
     @property
     def channel_widths(self) -> tuple[int, ...]:

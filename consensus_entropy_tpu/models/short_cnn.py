@@ -104,8 +104,23 @@ class ShortChunkCNN(nn.Module):
         """x: waveform ``(B, L)`` float — returns sigmoid scores ``(B, C)``."""
         cfg = self.config
         dtype = jnp.dtype(cfg.compute_dtype)
-        s = log_mel_spectrogram(x, cfg)  # (B, n_mels, T)
-        s = s[..., None].astype(dtype)  # NHWC: (B, n_mels, T, 1)
+        if cfg.arch == "harm":
+            from consensus_entropy_tpu.ops.harmonic import (
+                harmonic_spectrogram,
+            )
+
+            # learnable frontend: gradients flow into the band Q factor
+            # (the reference's learn_bw='only_Q', short_cnn.py:227-231)
+            bw_q = self.param(
+                "bw_q", lambda _: jnp.asarray([cfg.bw_q_init], jnp.float32))
+            s = harmonic_spectrogram(
+                x, bw_q, sample_rate=cfg.sample_rate, n_fft=cfg.n_fft,
+                hop_length=cfg.hop_length, n_harmonic=cfg.n_harmonic,
+                semitone_scale=cfg.semitone_scale)  # (B, H, level, T)
+            s = jnp.transpose(s, (0, 2, 3, 1)).astype(dtype)  # NHWC, C=H
+        else:
+            s = log_mel_spectrogram(x, cfg)  # (B, n_mels, T)
+            s = s[..., None].astype(dtype)  # NHWC: (B, n_mels, T, 1)
         s = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          epsilon=1e-5, dtype=dtype, name="spec_bn")(s)
         block = ResBlock if cfg.arch == "res" else ConvBlock

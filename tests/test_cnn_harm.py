@@ -1,0 +1,128 @@
+"""The harmonic-frontend CNN family (config.arch='harm'): filterbank
+geometry, learnable-Q gradients, forward/training, committee vmap, registry.
+Reference frontend semantics: the vendored (unused) ``HarmonicSTFT`` at
+``/root/reference/short_cnn.py:166-275``."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensus_entropy_tpu.config import CNNConfig
+from consensus_entropy_tpu.models import short_cnn
+from consensus_entropy_tpu.ops import harmonic
+
+# semitone_scale=1 halves the note grid (level 64) so tiny inputs survive
+# the pooling pyramid
+TINY_HARM = CNNConfig(n_channels=4, n_layers=3, input_length=8192,
+                      arch="harm", semitone_scale=1)
+
+
+def test_note_grid_matches_reference_constants():
+    """Defaults: C1 (midi 24) to the note whose 6th harmonic hits Nyquist
+    (E6 = midi 88 at 16 kHz), 2 steps/semitone -> level 128 — the same
+    height as the mel frontend's 128 bands."""
+    centers, level = harmonic.harmonic_center_freqs(16000, 6, 2)
+    assert level == (88 - 24) * 2 == 128
+    assert centers.shape == (6 * 128,)
+    # first center is C1; each harmonic block is an integer multiple
+    np.testing.assert_allclose(centers[0], 32.7032, rtol=1e-4)
+    np.testing.assert_allclose(centers[128], 2 * centers[0], rtol=1e-6)
+    assert CNNConfig(arch="harm").harm_level == 128
+
+
+def test_filterbank_triangles():
+    fb = np.asarray(harmonic.harmonic_filterbank(jnp.asarray([1.0])))
+    n_freqs = 512 // 2 + 1
+    assert fb.shape == (n_freqs, 6 * 128)
+    assert (fb >= 0).all() and fb.max() <= 1.0 + 1e-6
+    # each band peaks at (or adjacent to) its center frequency bin
+    centers, _ = harmonic.harmonic_center_freqs(16000, 6, 2)
+    bins = np.linspace(0.0, 8000.0, n_freqs)
+    band = 300  # an arbitrary mid-range band
+    peak_hz = bins[np.argmax(fb[:, band])]
+    bw = (harmonic.BW_ALPHA * centers[band] + harmonic.BW_BETA)
+    assert abs(peak_hz - centers[band]) <= max(bw, bins[1] - bins[0])
+    # larger Q narrows the bands: fewer nonzero bins per column
+    fb_wide = np.asarray(harmonic.harmonic_filterbank(jnp.asarray([0.5])))
+    fb_narrow = np.asarray(harmonic.harmonic_filterbank(jnp.asarray([4.0])))
+    assert (fb_narrow > 0).sum() < (fb_wide > 0).sum()
+
+
+def test_harmonic_spectrogram_shape(rng):
+    x = rng.standard_normal((2, 4096)).astype(np.float32)
+    out = np.asarray(harmonic.harmonic_spectrogram(
+        x, jnp.asarray([1.0]), semitone_scale=1))
+    from consensus_entropy_tpu.ops.mel import n_frames_for
+
+    assert out.shape == (2, 6, 64, n_frames_for(4096))
+    assert np.isfinite(out).all()
+
+
+def test_harm_forward_and_param(rng):
+    v = short_cnn.init_variables(jax.random.key(0), TINY_HARM)
+    assert "bw_q" in v["params"]  # learnable frontend Q
+    x = rng.standard_normal((3, TINY_HARM.input_length)).astype(np.float32)
+    out = np.asarray(short_cnn.apply_infer(v, x, TINY_HARM))
+    assert out.shape == (3, 4)
+    assert np.isfinite(out).all()
+
+
+def test_harm_frontend_gets_gradients(rng):
+    """The whole point of the learnable frontend: dLoss/d(bw_q) != 0."""
+    v = short_cnn.init_variables(jax.random.key(0), TINY_HARM)
+    x = rng.standard_normal((4, TINY_HARM.input_length)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 4)]
+
+    def loss(params):
+        out, _ = short_cnn.apply_train(
+            {"params": params, "batch_stats": v["batch_stats"]}, x,
+            jax.random.key(1), TINY_HARM)
+        return jnp.mean((out - y) ** 2)
+
+    g = jax.grad(loss)(v["params"])
+    assert float(jnp.abs(g["bw_q"]).sum()) > 0.0
+
+
+def test_harm_committee_vmap_and_trainer(rng):
+    from consensus_entropy_tpu.config import TrainConfig
+    from consensus_entropy_tpu.data.audio import DeviceWaveformStore
+    from consensus_entropy_tpu.models.cnn_trainer import CNNTrainer
+
+    members = [short_cnn.init_variables(jax.random.key(i), TINY_HARM)
+               for i in range(2)]
+    x = rng.standard_normal((3, TINY_HARM.input_length)).astype(np.float32)
+    probs = np.asarray(short_cnn.committee_infer(
+        short_cnn.stack_params(members), x, TINY_HARM))
+    assert probs.shape == (2, 3, 4)
+
+    waves = {f"s{i}": (rng.standard_normal(9000) * 0.05).astype(np.float32)
+             for i in range(8)}
+    store = DeviceWaveformStore(waves, TINY_HARM.input_length)
+    ids = list(waves)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+    trainer = CNNTrainer(TINY_HARM, TrainConfig(batch_size=4))
+    best, hist = trainer.fit(members[0], store, ids[:6], y[:6], ids[6:],
+                             y[6:], jax.random.key(1), n_epochs=2)
+    assert len(hist) == 2 and np.isfinite(
+        [h["val_loss"] for h in hist]).all()
+    # training moved the frontend Q (or at least kept it finite/positive)
+    assert np.isfinite(np.asarray(best["params"]["bw_q"])).all()
+
+
+def test_harm_checkpoint_and_registry(rng, tmp_path):
+    from consensus_entropy_tpu.models.committee import CNNMember, Committee
+    from consensus_entropy_tpu.train.pretrain import MODEL_CHOICES
+
+    assert "cnn_harm_jax" in MODEL_CHOICES
+    v = short_cnn.init_variables(jax.random.key(0), TINY_HARM)
+    m = CNNMember("it_0", v, TINY_HARM)
+    path = str(tmp_path / "classifier_cnn_harm.it_0.msgpack")
+    m.save(path)
+    vgg_cfg = dataclasses.replace(TINY_HARM, arch="vgg", n_mels=64)
+    m2 = CNNMember.load(path, vgg_cfg)
+    assert m2.config.arch == "harm"
+    c = Committee([], [m2], vgg_cfg)
+    assert c.config.arch == "harm"
