@@ -349,7 +349,7 @@ def run_cnn_suite(args_ns) -> int:
 
     import dataclasses
 
-    config = CNNConfig()
+    config = CNNConfig(arch=args_ns.arch)
     n_members, n_songs = args_ns.members, args_ns.pool
     rng = np.random.default_rng(1987)
     crops = rng.standard_normal(
@@ -436,7 +436,8 @@ def run_cnn_suite(args_ns) -> int:
          f"linearly to the full pool")
 
     print(json.dumps({
-        "metric": f"cnn_committee_scoring_{n_members}m_{n_songs}",
+        "metric": (f"cnn_committee_scoring_{n_members}m_{n_songs}"
+                   + ("" if args_ns.arch == "vgg" else f"_{args_ns.arch}")),
         "dtype": winner,
         "value": round(dev_ms, 3),
         "unit": "ms",
@@ -531,6 +532,8 @@ def main(argv=None) -> int:
     ap.add_argument("--mode", choices=("mc", "hc", "mix"), default="mc",
                     help="acquisition chain to benchmark (BASELINE configs "
                          "0-2); hc has no committee in the loop")
+    ap.add_argument("--arch", choices=("vgg", "res", "harm"), default="vgg",
+                    help="CNN trunk family for the cnn suite")
     ap.add_argument("--impl", choices=("auto", "xla", "pallas"),
                     default="auto")
     ap.add_argument("--tile-n", type=int, default=512,

@@ -130,19 +130,25 @@ class CNNMember(Member):
     def save(self, path):
         save_variables(path, self.variables,
                        meta={"kind": self.kind, "name": self.name,
-                             "arch": self.config.arch})
+                             "arch": self.config.arch,
+                             "n_harmonic": self.config.n_harmonic,
+                             "semitone_scale": self.config.semitone_scale})
 
     @classmethod
     def load(cls, path, config: CNNConfig = CNNConfig(),
              train_config: TrainConfig = TrainConfig()):
         variables, meta = load_variables(path)
-        # the checkpoint knows its trunk family; honor it over the caller's
-        # config so vgg/res members coexist in one workspace
-        arch = meta.get("arch", config.arch)
-        if arch != config.arch:
-            import dataclasses
+        # the checkpoint knows its trunk family AND frontend geometry; honor
+        # them over the caller's config — the harm note grid changes no
+        # parameter shape, so a mismatch would restore cleanly and score
+        # with a grid the weights were never trained on
+        import dataclasses
 
-            config = dataclasses.replace(config, arch=arch)
+        override = {k: meta[k] for k in ("arch", "n_harmonic",
+                                         "semitone_scale")
+                    if k in meta and meta[k] != getattr(config, k)}
+        if override:
+            config = dataclasses.replace(config, **override)
         return cls(meta.get("name", os.path.basename(path)), variables,
                    config, train_config)
 
@@ -176,18 +182,22 @@ class Committee:
         self.cnn_members = cnn_members
         if cnn_members:
             # the committee scores all CNN members as ONE vmapped pytree, so
-            # they must share a trunk family; the committee config follows
-            # the members' arch (checkpoints know theirs — CNNMember.load)
-            archs = {m.config.arch for m in cnn_members}
-            if len(archs) > 1:
+            # they must share a trunk family AND frontend geometry; the
+            # committee config follows the members' (checkpoints know
+            # theirs — CNNMember.load)
+            keys = ("arch", "n_harmonic", "semitone_scale")
+            sigs = {tuple(getattr(m.config, k) for k in keys)
+                    for m in cnn_members}
+            if len(sigs) > 1:
                 raise ValueError(
-                    f"CNN members mix trunk families {sorted(archs)}; a "
-                    f"committee vmaps one stacked pytree and needs one arch")
-            arch = archs.pop()
-            if arch != config.arch:
+                    f"CNN members mix trunk families/frontend geometries "
+                    f"{sorted(sigs)}; a committee vmaps one stacked pytree "
+                    f"and needs one architecture")
+            sig = sigs.pop()
+            if sig != tuple(getattr(config, k) for k in keys):
                 import dataclasses
 
-                config = dataclasses.replace(config, arch=arch)
+                config = dataclasses.replace(config, **dict(zip(keys, sig)))
         self.config = config
         self.device_members = device_members
         #: When set, CNN members score each song as the masked mean over

@@ -156,7 +156,8 @@ def pretrain_cnn(song_labels: dict, store, *, cv: int, out_dir: str,
         save_variables(
             os.path.join(out_dir, f"classifier_{stem}.it_{i}.msgpack"), best,
             meta={"kind": "cnn_jax", "name": f"it_{i}",
-                  "arch": config.arch})
+                  "arch": config.arch, "n_harmonic": config.n_harmonic,
+                  "semitone_scale": config.semitone_scale})
         # fold eval: one random crop per test song
         from consensus_entropy_tpu.models.short_cnn import apply_infer
 

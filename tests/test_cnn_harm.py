@@ -121,8 +121,15 @@ def test_harm_checkpoint_and_registry(rng, tmp_path):
     m = CNNMember("it_0", v, TINY_HARM)
     path = str(tmp_path / "classifier_cnn_harm.it_0.msgpack")
     m.save(path)
-    vgg_cfg = dataclasses.replace(TINY_HARM, arch="vgg", n_mels=64)
-    m2 = CNNMember.load(path, vgg_cfg)
+    # caller config differs in arch AND frontend geometry — the checkpoint
+    # meta must win for every frontend-shaping field (a note-grid mismatch
+    # restores cleanly but scores with a grid the weights never saw)
+    other_cfg = dataclasses.replace(TINY_HARM, arch="vgg", n_mels=64,
+                                    semitone_scale=2, n_harmonic=6)
+    m2 = CNNMember.load(path, other_cfg)
     assert m2.config.arch == "harm"
-    c = Committee([], [m2], vgg_cfg)
+    assert m2.config.semitone_scale == TINY_HARM.semitone_scale
+    assert m2.config.n_harmonic == TINY_HARM.n_harmonic
+    c = Committee([], [m2], other_cfg)
     assert c.config.arch == "harm"
+    assert c.config.semitone_scale == TINY_HARM.semitone_scale
