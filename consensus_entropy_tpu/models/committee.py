@@ -127,25 +127,29 @@ class CNNMember(Member):
     def update(self, X, y):
         raise TypeError("CNNMember retrains via Committee.retrain_cnn")
 
+    #: Frontend-shaping config fields that change NO parameter shape — a
+    #: checkpoint restored under different values would load cleanly and
+    #: score through a frontend the weights were never trained on, so they
+    #: ride in checkpoint meta and loading honors them.
+    FRONTEND_META = ("arch", "n_harmonic", "semitone_scale", "n_mels",
+                     "n_fft", "hop_length", "f_min", "f_max", "sample_rate")
+
     def save(self, path):
-        save_variables(path, self.variables,
-                       meta={"kind": self.kind, "name": self.name,
-                             "arch": self.config.arch,
-                             "n_harmonic": self.config.n_harmonic,
-                             "semitone_scale": self.config.semitone_scale})
+        meta = {"kind": self.kind, "name": self.name}
+        meta.update({k: getattr(self.config, k) for k in self.FRONTEND_META})
+        save_variables(path, self.variables, meta=meta)
 
     @classmethod
     def load(cls, path, config: CNNConfig = CNNConfig(),
              train_config: TrainConfig = TrainConfig()):
         variables, meta = load_variables(path)
-        # the checkpoint knows its trunk family AND frontend geometry; honor
-        # them over the caller's config — the harm note grid changes no
-        # parameter shape, so a mismatch would restore cleanly and score
-        # with a grid the weights were never trained on
+        # the checkpoint knows its trunk family AND frontend geometry
+        # (FRONTEND_META); honor them over the caller's config — none of
+        # them changes a parameter shape, so a mismatch would restore
+        # cleanly and score through the wrong frontend
         import dataclasses
 
-        override = {k: meta[k] for k in ("arch", "n_harmonic",
-                                         "semitone_scale")
+        override = {k: meta[k] for k in cls.FRONTEND_META
                     if k in meta and meta[k] != getattr(config, k)}
         if override:
             config = dataclasses.replace(config, **override)
@@ -185,7 +189,7 @@ class Committee:
             # they must share a trunk family AND frontend geometry; the
             # committee config follows the members' (checkpoints know
             # theirs — CNNMember.load)
-            keys = ("arch", "n_harmonic", "semitone_scale")
+            keys = CNNMember.FRONTEND_META
             sigs = {tuple(getattr(m.config, k) for k in keys)
                     for m in cnn_members}
             if len(sigs) > 1:

@@ -153,11 +153,14 @@ def pretrain_cnn(song_labels: dict, store, *, cv: int, out_dir: str,
         # family's artifacts in a shared pretrained dir (loading dispatches
         # on the .msgpack suffix + meta, not the filename)
         stem = "cnn" if config.arch == "vgg" else f"cnn_{config.arch}"
+        from consensus_entropy_tpu.models.committee import CNNMember
+
+        meta = {"kind": "cnn_jax", "name": f"it_{i}"}
+        meta.update({k: getattr(config, k)
+                     for k in CNNMember.FRONTEND_META})
         save_variables(
             os.path.join(out_dir, f"classifier_{stem}.it_{i}.msgpack"), best,
-            meta={"kind": "cnn_jax", "name": f"it_{i}",
-                  "arch": config.arch, "n_harmonic": config.n_harmonic,
-                  "semitone_scale": config.semitone_scale})
+            meta=meta)
         # fold eval: one random crop per test song
         from consensus_entropy_tpu.models.short_cnn import apply_infer
 

@@ -1,0 +1,153 @@
+"""Import reference torch ``.pth`` CNN checkpoints into Flax variables.
+
+The reference persists its committee CNNs as torch ``state_dict``s of the
+``ShortChunkCNN`` at ``/root/reference/short_cnn.py:278-349`` (saved at
+``amg_test.py:267-273``, loaded with the smuggled mel filterbank at
+``amg_test.py:173-177``).  This module maps those checkpoints onto the
+TPU-native model so a user of the reference can carry their trained
+committees over:
+
+- ``spec.*`` buffers (the torchaudio MelSpectrogram window/filterbank) are
+  DROPPED: the Flax frontend computes the same filterbank deterministically
+  from the config (``ops/mel.py``), which is exactly what the smuggled
+  buffer contained.
+- Conv kernels transpose OIHW → HWIO (NCHW torch vs NHWC flax); Linear
+  weights transpose (out, in) → (in, out); BatchNorm weight/bias become
+  scale/bias params and running_mean/var become batch_stats.
+- ``num_batches_tracked`` is torch bookkeeping with no Flax counterpart.
+
+Usage: :func:`import_torch_shortchunk` in code, or as a CLI::
+
+    python -m consensus_entropy_tpu.utils.torch_import IN.pth OUT.msgpack
+
+after which the ``.msgpack`` drops into any workspace / pretrained dir.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+
+from consensus_entropy_tpu.config import CNNConfig
+
+
+def _np(t) -> np.ndarray:
+    return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach")
+                      else t, np.float32)
+
+
+def _bn(state: dict, prefix: str):
+    """(params, stats) of one torch BatchNorm."""
+    return ({"scale": jnp.asarray(_np(state[f"{prefix}.weight"])),
+             "bias": jnp.asarray(_np(state[f"{prefix}.bias"]))},
+            {"mean": jnp.asarray(_np(state[f"{prefix}.running_mean"])),
+             "var": jnp.asarray(_np(state[f"{prefix}.running_var"]))})
+
+
+def import_torch_shortchunk(path_or_state, config: CNNConfig = CNNConfig()):
+    """Convert a reference ``ShortChunkCNN`` state dict (or ``.pth`` path)
+    to Flax ``{'params', 'batch_stats'}`` for ``models.short_cnn``.
+
+    Only the vgg family exists in the reference; ``config.arch`` must be
+    ``'vgg'`` and ``n_layers``/``n_channels`` must match the checkpoint
+    (validated against the actual tensor shapes).
+    """
+    if config.arch != "vgg":
+        raise ValueError("reference checkpoints are the vgg ShortChunkCNN; "
+                         f"config.arch is {config.arch!r}")
+    if isinstance(path_or_state, (str, bytes)):
+        import torch
+
+        state = torch.load(path_or_state, map_location="cpu",
+                           weights_only=True)
+    else:
+        state = path_or_state
+
+    layers = sorted({int(k.split(".")[0][5:]) for k in state
+                     if k.startswith("layer")})
+    if layers != list(range(1, config.n_layers + 1)):
+        raise ValueError(f"checkpoint has conv layers {layers}; config "
+                         f"expects 1..{config.n_layers}")
+    fb = state.get("spec.mel_scale.fb")
+    if fb is not None:
+        want = (config.n_fft // 2 + 1, config.n_mels)
+        if tuple(fb.shape) != want:
+            # the buffer is dropped, but its shape certifies the mel
+            # geometry the weights were trained on
+            raise ValueError(
+                f"checkpoint mel filterbank is {tuple(fb.shape)}; config "
+                f"(n_fft={config.n_fft}, n_mels={config.n_mels}) expects "
+                f"{want}")
+
+    params: dict = {}
+    stats: dict = {}
+    params["spec_bn"], stats["spec_bn"] = _bn(state, "spec_bn")
+
+    for i, width in enumerate(config.channel_widths):
+        kernel = _np(state[f"layer{i + 1}.conv.weight"])  # (O, I, H, W)
+        if kernel.shape[0] != width:
+            raise ValueError(
+                f"layer{i + 1} has {kernel.shape[0]} output channels; "
+                f"config expects {width} (n_channels={config.n_channels})")
+        block = {"Conv_0": {
+            "kernel": jnp.asarray(kernel.transpose(2, 3, 1, 0)),  # HWIO
+            "bias": jnp.asarray(_np(state[f"layer{i + 1}.conv.bias"]))}}
+        bn_p, bn_s = _bn(state, f"layer{i + 1}.bn")
+        block["BatchNorm_0"] = bn_p
+        params[f"ConvBlock_{i}"] = block
+        stats[f"ConvBlock_{i}"] = {"BatchNorm_0": bn_s}
+
+    for torch_name, flax_name in (("dense1", "dense1"), ("dense2", "dense2")):
+        params[flax_name] = {
+            "kernel": jnp.asarray(_np(state[f"{torch_name}.weight"]).T),
+            "bias": jnp.asarray(_np(state[f"{torch_name}.bias"]))}
+    params["head_bn"], stats["head_bn"] = _bn(state, "bn")
+
+    n_class = params["dense2"]["bias"].shape[0]
+    if n_class != config.n_class:
+        raise ValueError(f"checkpoint head has {n_class} classes; config "
+                         f"expects {config.n_class}")
+    return {"params": params, "batch_stats": stats}
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from consensus_entropy_tpu.cli.common import configure_device
+    from consensus_entropy_tpu.utils.checkpoint import save_variables
+
+    ap = argparse.ArgumentParser(
+        description="Convert a reference torch ShortChunkCNN .pth into a "
+                    "TPU-native .msgpack committee member")
+    ap.add_argument("src", help="torch state-dict checkpoint (.pth)")
+    ap.add_argument("dst", help="output .msgpack path (e.g. "
+                                "models/pretrained/classifier_cnn.it_0.msgpack)")
+    ap.add_argument("--name", default=None,
+                    help="member name (default: derived from dst)")
+    args = ap.parse_args(argv)
+    # conversion is pure host array shuffling — never touch an accelerator
+    configure_device("cpu")
+
+    from consensus_entropy_tpu.models.committee import CNNMember
+
+    config = CNNConfig()
+    variables = import_torch_shortchunk(args.src, config)
+    import os
+
+    base = os.path.basename(args.dst)
+    parts = base.split(".")
+    # workspace convention classifier_cnn.<name>.msgpack -> <name>;
+    # any other filename -> its extensionless stem
+    name = args.name or (parts[1] if len(parts) >= 3 else parts[0])
+    meta = {"kind": "cnn_jax", "name": name}
+    meta.update({k: getattr(config, k) for k in CNNMember.FRONTEND_META})
+    save_variables(args.dst, variables, meta=meta)
+    print(f"imported {args.src} -> {args.dst} "
+          f"({config.n_layers} conv blocks, n_channels={config.n_channels})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,157 @@
+"""Torch .pth checkpoint import: the converted Flax model must reproduce the
+reference ShortChunkCNN forward (``/root/reference/short_cnn.py:278-349``)
+numerically.  The oracle below runs the torch side with plain functional ops
+on the same state dict, fed with OUR mel output so the frontend is held
+common (mel-vs-torchaudio parity is pinned separately in test_mel.py)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+
+from consensus_entropy_tpu.config import CNNConfig  # noqa: E402
+from consensus_entropy_tpu.models import short_cnn  # noqa: E402
+from consensus_entropy_tpu.ops.mel import log_mel_spectrogram  # noqa: E402
+from consensus_entropy_tpu.utils.torch_import import (  # noqa: E402
+    import_torch_shortchunk,
+)
+
+# 32 mels / 5 pools -> the freq axis collapses to 1, matching the
+# reference's squeeze(2) + MaxPool1d global-time pooling exactly.
+CFG = CNNConfig(n_channels=4, n_mels=32, n_layers=5, input_length=8192)
+
+
+def _random_state_dict(rng, cfg: CNNConfig) -> dict:
+    """A reference-shaped state dict with random weights and realistic
+    (non-trivial) BN running stats."""
+
+    def t(*shape, scale=0.3):
+        return torch.tensor(
+            rng.standard_normal(shape).astype(np.float32) * scale)
+
+    def bn(prefix, n):
+        return {
+            f"{prefix}.weight": t(n) + 1.0,
+            f"{prefix}.bias": t(n),
+            f"{prefix}.running_mean": t(n),
+            f"{prefix}.running_var": torch.abs(t(n)) + 0.5,
+            f"{prefix}.num_batches_tracked": torch.tensor(7),
+        }
+
+    state = {"spec.mel_scale.fb": t(cfg.n_fft // 2 + 1, cfg.n_mels),
+             **bn("spec_bn", 1)}
+    in_ch = 1
+    for i, width in enumerate(cfg.channel_widths):
+        state[f"layer{i + 1}.conv.weight"] = t(width, in_ch, 3, 3)
+        state[f"layer{i + 1}.conv.bias"] = t(width)
+        state.update(bn(f"layer{i + 1}.bn", width))
+        in_ch = width
+    top = cfg.channel_widths[-1]
+    state["dense1.weight"] = t(top, top)
+    state["dense1.bias"] = t(top)
+    state.update(bn("bn", top))
+    state["dense2.weight"] = t(cfg.n_class, top)
+    state["dense2.bias"] = t(cfg.n_class)
+    return state
+
+
+def _torch_forward(state: dict, spec: torch.Tensor, cfg: CNNConfig):
+    """The reference forward from the spectrogram down (eval mode),
+    expressed with torch functional ops over the raw state dict."""
+    import torch.nn.functional as F
+
+    def bn(x, prefix):
+        return F.batch_norm(x, state[f"{prefix}.running_mean"],
+                            state[f"{prefix}.running_var"],
+                            state[f"{prefix}.weight"],
+                            state[f"{prefix}.bias"], training=False,
+                            eps=1e-5)
+
+    x = spec.unsqueeze(1)  # (B, 1, n_mels, T)
+    x = bn(x, "spec_bn")
+    for i in range(cfg.n_layers):
+        x = F.conv2d(x, state[f"layer{i + 1}.conv.weight"],
+                     state[f"layer{i + 1}.conv.bias"], padding=1)
+        x = F.relu(bn(x, f"layer{i + 1}.bn"))
+        x = F.max_pool2d(x, 2)
+    x = x.squeeze(2)  # freq axis == 1 by construction
+    if x.size(-1) != 1:
+        x = F.max_pool1d(x, x.size(-1))
+    x = x.squeeze(2)
+    x = F.linear(x, state["dense1.weight"], state["dense1.bias"])
+    x = F.relu(bn(x, "bn"))
+    x = F.linear(x, state["dense2.weight"], state["dense2.bias"])
+    return torch.sigmoid(x)
+
+
+def test_imported_checkpoint_matches_torch_forward(rng):
+    state = _random_state_dict(rng, CFG)
+    variables = import_torch_shortchunk(state, CFG)
+    x = rng.standard_normal((3, CFG.input_length)).astype(np.float32) * 0.1
+
+    ours = np.asarray(short_cnn.apply_infer(variables, x, CFG))
+
+    spec = torch.tensor(np.asarray(log_mel_spectrogram(x, CFG)))
+    want = _torch_forward(state, spec, CFG).numpy()
+    np.testing.assert_allclose(ours, want, rtol=1e-4, atol=1e-5)
+
+
+def test_import_validates_geometry(rng):
+    state = _random_state_dict(rng, CFG)
+    with pytest.raises(ValueError, match="conv layers"):
+        import_torch_shortchunk(state, CNNConfig(
+            n_channels=4, n_mels=32, n_layers=3, input_length=8192))
+    with pytest.raises(ValueError, match="output channels"):
+        import_torch_shortchunk(state, CNNConfig(
+            n_channels=8, n_mels=32, n_layers=5, input_length=8192))
+    with pytest.raises(ValueError, match="vgg"):
+        import_torch_shortchunk(state, CNNConfig(
+            n_channels=4, n_layers=5, input_length=8192, arch="res"))
+
+
+def test_mel_geometry_validated_via_fb_shape(rng):
+    """The dropped filterbank buffer still certifies the checkpoint's mel
+    geometry: a wrong-shape fb must refuse to convert."""
+    state = _random_state_dict(rng, CFG)
+    state["spec.mel_scale.fb"] = torch.zeros(CFG.n_fft // 2 + 1, 96)
+    with pytest.raises(ValueError, match="mel filterbank"):
+        import_torch_shortchunk(state, CFG)
+
+
+def test_import_cli_roundtrip(rng, tmp_path):
+    """.pth file -> converter CLI (main()) -> workspace-loadable member."""
+    from consensus_entropy_tpu.models.committee import CNNMember
+    from consensus_entropy_tpu.utils import torch_import
+
+    # main() converts at the DEFAULT reference geometry
+    default_cfg = CNNConfig()
+    state = _random_state_dict(rng, default_cfg)
+    src = str(tmp_path / "best_model.pth")
+    torch.save(state, src)
+    dst = str(tmp_path / "classifier_cnn.it_3.msgpack")
+    assert torch_import.main([src, dst]) == 0
+
+    m = CNNMember.load(dst)
+    assert m.name == "it_3"  # workspace-convention name derivation
+    assert m.config.arch == "vgg" and m.config.n_mels == default_cfg.n_mels
+
+    # non-convention filename falls back to the extensionless stem
+    dst2 = str(tmp_path / "imported.msgpack")
+    assert torch_import.main([src, dst2, "--name", "legacy"]) == 0
+    assert CNNMember.load(dst2).name == "legacy"
+
+
+def test_library_roundtrip_preserves_forward(rng, tmp_path):
+    from consensus_entropy_tpu.models.committee import CNNMember
+
+    state = _random_state_dict(rng, CFG)
+    variables = import_torch_shortchunk(state, CFG)
+    dst = str(tmp_path / "classifier_cnn.it_0.msgpack")
+    CNNMember("it_0", variables, CFG).save(dst)
+    m2 = CNNMember.load(dst, CFG)
+    x = rng.standard_normal((2, CFG.input_length)).astype(np.float32) * 0.1
+    np.testing.assert_array_equal(
+        np.asarray(short_cnn.apply_infer(m2.variables, x, CFG)),
+        np.asarray(short_cnn.apply_infer(variables, x, CFG)))
