@@ -247,6 +247,8 @@ def _gbdt_build_tree_np(Xb, g, h, max_depth, n_bins, lam,
     open_[0] = True
     node_of_row = np.zeros(n, np.int32)
     cols = np.arange(f, dtype=np.int64)
+    prev_hg = prev_hh = None
+    prev_local = np.full(n_nodes, -1, np.int64)
 
     for depth in range(max_depth):
         level = np.arange(2 ** depth - 1, 2 ** (depth + 1) - 1)
@@ -257,15 +259,39 @@ def _gbdt_build_tree_np(Xb, g, h, max_depth, n_bins, lam,
         local[act] = np.arange(act.size)
         row_local = local[node_of_row]
         sel = row_local >= 0
-        rl, Xl = row_local[sel], Xb[sel]
-        gl, hl = g[sel].astype(np.float64), h[sel].astype(np.float64)
-        flat = ((rl[:, None] * f + cols[None, :]) * n_bins
+        rl = row_local[sel]
+        # Sibling subtraction (mirrors ce_gbdt.cpp exactly): accumulate rows
+        # only for the smaller child of each pair (ties -> left); derive the
+        # sibling as parent_hist - built_hist.
+        if depth == 0 or prev_hg is None:
+            direct = np.ones(act.size, bool)
+        else:
+            counts = np.bincount(rl, minlength=act.size)
+            direct = np.empty(act.size, bool)
+            for a, nd in enumerate(act):
+                sib = nd + 1 if nd % 2 else nd - 1
+                cnt, sib_cnt = counts[a], counts[local[sib]]
+                direct[a] = cnt < sib_cnt or (cnt == sib_cnt
+                                              and bool(nd % 2))
+        keep = direct[rl]
+        idx = np.flatnonzero(sel)[keep]  # one gather per array, not two
+        rl_k, Xl = rl[keep], Xb[idx]
+        gl = g[idx].astype(np.float64)
+        hl = h[idx].astype(np.float64)
+        flat = ((rl_k[:, None] * f + cols[None, :]) * n_bins
                 + Xl.astype(np.int64))
         size = act.size * f * n_bins
         hg = np.bincount(flat.ravel(), weights=np.repeat(gl, f),
                          minlength=size).reshape(act.size, f, n_bins)
         hh = np.bincount(flat.ravel(), weights=np.repeat(hl, f),
                          minlength=size).reshape(act.size, f, n_bins)
+        for a, nd in enumerate(act):
+            if direct[a]:
+                continue
+            sib = nd + 1 if nd % 2 else nd - 1
+            parent = (nd - 1) // 2
+            hg[a] = prev_hg[prev_local[parent]] - hg[local[sib]]
+            hh[a] = prev_hh[prev_local[parent]] - hh[local[sib]]
         cg = np.cumsum(hg, axis=2)
         ch = np.cumsum(hh, axis=2)
         Gt = G[act][:, None, None]
@@ -304,6 +330,8 @@ def _gbdt_build_tree_np(Xb, g, h, max_depth, n_bins, lam,
         go_right = (Xb[move, feature[nd_m]]
                     > threshold[nd_m].astype(np.uint8))
         node_of_row[move] = 2 * nd_m + 1 + go_right
+        prev_hg, prev_hh = hg, hh
+        prev_local = local
     leaves = np.flatnonzero(open_)
     value[leaves] = -G[leaves] / (H[leaves] + lam)
     return feature, threshold, value

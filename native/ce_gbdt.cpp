@@ -79,6 +79,10 @@ void ce_gbdt_build_tree(const uint8_t* Xb, int64_t n, int64_t f,
 
   // local index of each open node at the current level (-1 otherwise)
   int32_t* local = new int32_t[n_nodes];
+  // previous level's histograms + local map (sibling-subtraction trick)
+  double* prev_hg = nullptr;
+  double* prev_hh = nullptr;
+  int32_t* prev_local = new int32_t[n_nodes];
 
   for (int depth = 0; depth < max_depth; ++depth) {
     const int64_t lo = ((int64_t)1 << depth) - 1;
@@ -95,7 +99,15 @@ void ce_gbdt_build_tree(const uint8_t* Xb, int64_t n, int64_t f,
     // exact order np.bincount uses, keeping backends bit-identical), then
     // each node's pass reads rows feature-contiguously into an
     // L2-resident (f, n_bins) slice — cache-friendly on both sides.
-    const int64_t hsize = n_act * f * n_bins;
+    //
+    // Sibling subtraction: open nodes at depth >= 1 come in sibling pairs
+    // (a split opens both children), and parent = left + right cell-wise,
+    // so only the SMALLER child is accumulated from rows; the other is
+    // derived as parent_hist - built_hist (ties build the left child).
+    // Halves the expected row traffic per level; the numpy fallback does
+    // the identical subtraction, keeping backends bit-identical.
+    const int64_t fb = f * n_bins;
+    const int64_t hsize = n_act * fb;
     double* hg = new double[hsize]();
     double* hh = new double[hsize]();
     int64_t* start = new int64_t[n_act + 1]();
@@ -113,10 +125,25 @@ void ce_gbdt_build_tree(const uint8_t* Xb, int64_t n, int64_t f,
       }
       delete[] fill;
     }
+    bool* direct = new bool[n_act];
+    for (int64_t nd = lo; nd < hi; ++nd) {
+      const int32_t lc = local[nd];
+      if (lc < 0) continue;
+      if (depth == 0 || prev_hg == nullptr) {
+        direct[lc] = true;
+        continue;
+      }
+      const int64_t sib = (nd & 1) ? nd + 1 : nd - 1;
+      const int32_t sl = local[sib];
+      const int64_t cnt = start[lc + 1] - start[lc];
+      const int64_t sib_cnt = start[sl + 1] - start[sl];
+      direct[lc] = cnt < sib_cnt || (cnt == sib_cnt && (nd & 1));
+    }
 #pragma omp parallel for schedule(dynamic)
     for (int64_t a = 0; a < n_act; ++a) {
-      double* hga = hg + a * f * n_bins;
-      double* hha = hh + a * f * n_bins;
+      if (!direct[a]) continue;
+      double* hga = hg + a * fb;
+      double* hha = hh + a * fb;
       for (int64_t s = start[a]; s < start[a + 1]; ++s) {
         const int64_t i = order[s];
         const uint8_t* row = Xb + i * f;
@@ -128,6 +155,24 @@ void ce_gbdt_build_tree(const uint8_t* Xb, int64_t n, int64_t f,
         }
       }
     }
+#pragma omp parallel for schedule(static)
+    for (int64_t nd = lo; nd < hi; ++nd) {
+      const int32_t lc = local[nd];
+      if (lc < 0 || direct[lc]) continue;
+      const int64_t sib = (nd & 1) ? nd + 1 : nd - 1;
+      const int64_t parent = (nd - 1) / 2;
+      const double* pg = prev_hg + (int64_t)prev_local[parent] * fb;
+      const double* ph = prev_hh + (int64_t)prev_local[parent] * fb;
+      const double* sg_ = hg + (int64_t)local[sib] * fb;
+      const double* sh_ = hh + (int64_t)local[sib] * fb;
+      double* dg = hg + (int64_t)lc * fb;
+      double* dh = hh + (int64_t)lc * fb;
+      for (int64_t k = 0; k < fb; ++k) {
+        dg[k] = pg[k] - sg_[k];
+        dh[k] = ph[k] - sh_[k];
+      }
+    }
+    delete[] direct;
     delete[] start;
 
     // Split search per open node (first-max tie break over (feature, bin)).
@@ -175,8 +220,12 @@ void ce_gbdt_build_tree(const uint8_t* Xb, int64_t n, int64_t f,
       }
       open_[nd] = false;
     }
-    delete[] hg;
-    delete[] hh;
+    // this level's histograms become next level's parents
+    delete[] prev_hg;
+    delete[] prev_hh;
+    prev_hg = hg;
+    prev_hh = hh;
+    std::memcpy(prev_local, local, n_nodes * sizeof(int32_t));
 
     // Partition rows of split nodes to their children.
 #pragma omp parallel for schedule(static)
@@ -202,6 +251,9 @@ void ce_gbdt_build_tree(const uint8_t* Xb, int64_t n, int64_t f,
   delete[] node_of_row;
   delete[] local;
   delete[] order;
+  delete[] prev_hg;
+  delete[] prev_hh;
+  delete[] prev_local;
 }
 
 // Accumulate a forest's margins:
