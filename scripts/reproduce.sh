@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Full reproduction pipeline — the TPU-native counterpart of the reference's
+# README workflow (reference README.md:43-60): pre-train the committee on
+# DEAM, then run per-user consensus-entropy AL on AMG1608 in all four
+# acquisition modes.
+#
+# Data layout (see README "Data layout"): --deam-root / --amg-root must hold
+# the DEAM features+annotations(+npy) and AMG1608 feats+anno(+npy) trees.
+#
+# Usage:
+#   scripts/reproduce.sh [MODELS_ROOT] [DEAM_ROOT] [AMG_ROOT] [DEVICE]
+#
+# The paper's experiment constants can be overridden via env for smoke runs:
+#   CV (5-fold), QUERIES (q=10), EPOCHS (10 AL iterations), NUM_ANNO (150),
+#   MODELS_LIST, MODES, EXTRA (extra amg_test flags, e.g. "--max-users 2").
+set -euo pipefail
+
+MODELS="${1:-./models}"
+DEAM="${2:-./data/deam}"
+AMG="${3:-./data/amg1608}"
+DEVICE="${4:-tpu}"
+FLAGS=(--models-root "$MODELS" --deam-root "$DEAM" --amg-root "$AMG"
+       --device "$DEVICE")
+
+# 1. Pre-train the paper's committee: 5-fold CV per algorithm
+#    (gnb/sgd/xgb classic members + the Flax CNN — 20 members total).
+for model in ${MODELS_LIST:-gnb sgd xgb cnn_jax}; do
+  python -m consensus_entropy_tpu.cli.deam_classifier -cv "${CV:-5}" \
+      -m "$model" "${FLAGS[@]}"
+done
+
+# 2. Personalize per user: 10 AL iterations x q=10 on users with >=150
+#    annotations, one run per acquisition mode (mc = machine consensus,
+#    hc = human consensus, mix = hybrid, rand = control).
+#    --mesh auto shards the scoring path over every visible chip.
+for mode in ${MODES:-mc hc mix rand}; do
+  # shellcheck disable=SC2086
+  python -m consensus_entropy_tpu.cli.amg_test -q "${QUERIES:-10}" \
+      -e "${EPOCHS:-10}" -n "${NUM_ANNO:-150}" -m "$mode" --mesh auto \
+      ${EXTRA:-} "${FLAGS[@]}"
+done
+
+echo "done: per-user reports under $MODELS/users/<uid>/<mode>/"
