@@ -1,0 +1,167 @@
+// Concurrency stress driver for the native host runtime — run by
+// scripts/race_check.sh (SURVEY.md §5: the reference is single-threaded so
+// race detection was N/A; this framework's C++ core is OpenMP-parallel and
+// gets checked).
+//
+// Two modes (GCC's libgomp is not TSAN-instrumented, so its barriers are
+// invisible to TSAN and every post-region read would be a false positive —
+// the standard GCC+TSAN caveat.  Each mode targets what it can verify
+// soundly):
+//
+//   tsan         — OMP_NUM_THREADS=1 (no libgomp parallelism); several
+//                  pthreads call every kernel CONCURRENTLY on shared
+//                  read-only inputs and private outputs.  TSAN then detects
+//                  any hidden shared mutable state across calls (static
+//                  buffers, unprotected globals) — the reentrancy contract
+//                  the AL driver relies on.
+//   determinism  — oversubscribed OpenMP (threads > cores): every kernel
+//                  runs twice and outputs are compared BYTEWISE; a data
+//                  race in a parallel region (overlapping writes, order-
+//                  dependent accumulation) shows up as nondeterminism.
+//
+// Exit 0 = clean.  TSAN reports flip the exit code via halt_on_error.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void ce_linear_predict_proba(const float*, int64_t, int64_t, const float*,
+                             const float*, int64_t, int, float*);
+void ce_gnb_predict_proba(const float*, int64_t, int64_t, const double*,
+                          const double*, const double*, int64_t, float*);
+void ce_segment_mean(const float*, int64_t, int64_t, const int64_t*, int64_t,
+                     float*);
+void ce_row_entropy(const float*, int64_t, int64_t, float*);
+void ce_gbdt_build_tree(const uint8_t*, int64_t, int64_t, const float*,
+                        const float*, int, int, double, double, double,
+                        int32_t*, int32_t*, double*);
+void ce_gbdt_predict_margins(const uint8_t*, int64_t, int64_t, const int32_t*,
+                             const int32_t*, const double*, int64_t, int64_t,
+                             const int32_t*, int64_t, double, double*);
+}
+
+namespace {
+
+constexpr int64_t N = 4096, F = 32, C = 4, N_BINS = 32;
+constexpr int MAX_DEPTH = 5;
+constexpr int64_t N_NODES = ((int64_t)1 << (MAX_DEPTH + 1)) - 1;
+
+uint64_t rng_state = 88172645463325252ull;
+double frand() {
+  rng_state ^= rng_state << 13;
+  rng_state ^= rng_state >> 7;
+  rng_state ^= rng_state << 17;
+  return (double)(rng_state % 10000) / 5000.0 - 1.0;
+}
+
+struct Inputs {
+  std::vector<float> X, W, b, g, h;
+  std::vector<double> theta, var, log_prior;
+  std::vector<uint8_t> Xb;
+  std::vector<int64_t> starts;
+
+  Inputs() {
+    X.resize(N * F);
+    W.resize(F * C);
+    b.resize(C);
+    g.resize(N);
+    h.resize(N);
+    theta.resize(C * F);
+    var.resize(C * F);
+    log_prior.assign(C, -1.4);
+    Xb.resize(N * F);
+    for (auto& v : X) v = (float)frand();
+    for (auto& v : W) v = (float)frand();
+    for (auto& v : b) v = (float)frand();
+    for (auto& v : g) v = (float)frand();
+    for (auto& v : h) v = (float)(frand() * frand() + 0.1);
+    for (auto& v : theta) v = frand();
+    // strictly positive: log(var) feeds the GNB class constant — a
+    // negative draw would NaN the whole output and make the bytewise
+    // comparison vacuous for that kernel
+    for (auto& v : var) v = frand() * frand() * 0.4 + 0.5;
+    for (auto& v : Xb) v = (uint8_t)(rng_state % N_BINS), frand();
+    for (int64_t i = 0; i <= N; i += 64) starts.push_back(i);
+  }
+};
+
+struct Outputs {
+  std::vector<float> probs, gnb, seg, ent;
+  std::vector<int32_t> feat, thr, tree_class;
+  std::vector<double> val, margins;
+
+  Outputs()
+      : probs(N * C), gnb(N * C), seg(64 * C), ent(N),
+        feat(8 * N_NODES), thr(8 * N_NODES), tree_class(8),
+        val(8 * N_NODES), margins(N * C, 0.0) {}
+};
+
+void run_all(const Inputs& in, Outputs& out) {
+  ce_linear_predict_proba(in.X.data(), N, F, in.W.data(), in.b.data(), C, 0,
+                          out.probs.data());
+  ce_gnb_predict_proba(in.X.data(), N, F, in.theta.data(), in.var.data(),
+                       in.log_prior.data(), C, out.gnb.data());
+  ce_segment_mean(out.probs.data(), N, C, in.starts.data(),
+                  (int64_t)in.starts.size() - 1, out.seg.data());
+  ce_row_entropy(out.probs.data(), N, C, out.ent.data());
+  for (int64_t t = 0; t < 8; ++t) {
+    ce_gbdt_build_tree(in.Xb.data(), N, F, in.g.data(), in.h.data(),
+                       MAX_DEPTH, (int)N_BINS, 1.0, 1.0, 0.0,
+                       out.feat.data() + t * N_NODES,
+                       out.thr.data() + t * N_NODES,
+                       out.val.data() + t * N_NODES);
+    out.tree_class[t] = (int32_t)(t % C);
+  }
+  std::fill(out.margins.begin(), out.margins.end(), 0.0);
+  ce_gbdt_predict_margins(in.Xb.data(), N, F, out.feat.data(),
+                          out.thr.data(), out.val.data(), 8, N_NODES,
+                          out.tree_class.data(), C, 0.3,
+                          out.margins.data());
+}
+
+template <typename T>
+bool same(const std::vector<T>& a, const std::vector<T>& b) {
+  return std::memcmp(a.data(), b.data(), a.size() * sizeof(T)) == 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const std::string mode = argc > 1 ? argv[1] : "determinism";
+  Inputs in;
+
+  if (mode == "tsan") {
+    // concurrent kernel invocations: shared inputs, private outputs
+    std::vector<std::thread> threads;
+    std::vector<Outputs> outs(4);
+    for (int t = 0; t < 4; ++t)
+      threads.emplace_back([&in, &outs, t] { run_all(in, outs[t]); });
+    for (auto& th : threads) th.join();
+    for (int t = 1; t < 4; ++t)
+      if (!same(outs[0].probs, outs[t].probs) ||
+          !same(outs[0].val, outs[t].val)) {
+        std::fprintf(stderr, "cross-thread result mismatch\n");
+        return 1;
+      }
+    std::printf("tsan stress ok\n");
+    return 0;
+  }
+
+  // determinism: oversubscribed OpenMP, bytewise-equal repeat runs
+  Outputs a, b;
+  run_all(in, a);
+  run_all(in, b);
+  if (!same(a.probs, b.probs) || !same(a.gnb, b.gnb) ||
+      !same(a.seg, b.seg) || !same(a.ent, b.ent) || !same(a.feat, b.feat) ||
+      !same(a.thr, b.thr) || !same(a.val, b.val) ||
+      !same(a.margins, b.margins)) {
+    std::fprintf(stderr, "nondeterministic outputs across repeat runs\n");
+    return 1;
+  }
+  std::printf("determinism ok\n");
+  return 0;
+}

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Race/sanitizer sweep of the native OpenMP runtime (SURVEY.md §5 race
+# detection: N/A in the single-threaded reference; this framework's C++
+# core is parallel and gets checked).
+#
+# Two passes (GCC's libgomp is not TSAN-instrumented, so its barriers are
+# invisible to TSAN — post-region reads would all be false positives; each
+# pass verifies what it can soundly):
+#   1. TSAN reentrancy: OMP_NUM_THREADS=1, four pthreads invoke every
+#      kernel concurrently on shared inputs — detects hidden shared
+#      mutable state across calls.
+#   2. Determinism: oversubscribed OpenMP (threads > cores), repeat runs
+#      must be BYTEWISE identical — parallel-region races (overlapping
+#      writes, order-dependent accumulation) surface as nondeterminism.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+BUILD="${TMPDIR:-/tmp}/ce_tsan_build"
+mkdir -p "$BUILD"
+SRC="native/ce_host.cpp native/ce_gbdt.cpp native/ce_stress.cpp"
+
+# shellcheck disable=SC2086
+g++ -O1 -g -fsanitize=thread -fopenmp -std=c++17 $SRC -o "$BUILD/ce_tsan"
+echo "== TSAN reentrancy (4 concurrent callers, OMP threads pinned to 1) =="
+TSAN_OPTIONS="halt_on_error=1" OMP_NUM_THREADS=1 "$BUILD/ce_tsan" tsan
+
+# shellcheck disable=SC2086
+g++ -O2 -fopenmp -std=c++17 $SRC -o "$BUILD/ce_det"
+CORES="$(nproc)"
+for threads in 2 "$CORES" "$((CORES * 2))" "$((CORES * 4))"; do
+  [ "$threads" -lt 2 ] && continue
+  echo "== determinism, OMP_NUM_THREADS=$threads (x3) =="
+  for rep in 1 2 3; do
+    OMP_NUM_THREADS="$threads" "$BUILD/ce_det" determinism
+  done
+done
+echo "race check passed"

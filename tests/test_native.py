@@ -130,3 +130,29 @@ def test_segment_starts_validation():
         native.segment_mean(np.ones((4, 2), np.float32),
                             np.array([1, 4], np.int64))
     assert native.segment_starts(np.array([])).tolist() == [0]
+
+
+def test_race_check_script(tmp_path):
+    """The sanitizer sweep (scripts/race_check.sh): TSAN reentrancy over
+    concurrent kernel callers + bytewise determinism under oversubscribed
+    OpenMP.  Skipped where the toolchain lacks libtsan; measured ~5 s
+    total (two small compiles + short stress runs), cheap enough to live
+    in the default suite rather than rot behind an opt-in flag."""
+    import shutil
+    import subprocess
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    probe = subprocess.run(
+        ["g++", "-fsanitize=thread", "-fopenmp", "-x", "c++", "-", "-o",
+         str(tmp_path / "probe")],
+        input="int main(){return 0;}", text=True, capture_output=True)
+    if probe.returncode != 0:
+        pytest.skip("toolchain lacks ThreadSanitizer support")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run(
+        ["bash", os.path.join(repo, "scripts", "race_check.sh")],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "TMPDIR": str(tmp_path)})
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "race check passed" in res.stdout
