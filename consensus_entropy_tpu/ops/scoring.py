@@ -118,17 +118,19 @@ def score_rand(key, pool_mask, *, k: int) -> ScoreResult:
     return ScoreResult(scores, values, indices)
 
 
-def make_scoring_fns(*, k: int, tie_break: str = "fast",
-                     donate: bool = False) -> dict[str, Callable]:
+def make_scoring_fns(*, k: int,
+                     tie_break: str = "fast") -> dict[str, Callable]:
     """Jit-compile the four acquisition scorers with ``k`` baked in.
 
     Returns ``{'mc': fn, 'hc': fn, 'mix': fn, 'rand': fn}``.  Each fn is a
     ``jax.jit`` with static top-k width; callers pass device (or to-be-
     transferred host) arrays and get a :class:`ScoreResult` of device arrays.
+    (Input-buffer donation is deliberately NOT used here: callers pass
+    host numpy tables that jit transfers per call, so there is no device
+    buffer to reuse.)
     """
     mc = jax.jit(functools.partial(score_mc, k=k, tie_break=tie_break))
     hc = jax.jit(functools.partial(score_hc, k=k, tie_break=tie_break))
     mix = jax.jit(functools.partial(score_mix, k=k, tie_break=tie_break))
     rand = jax.jit(functools.partial(score_rand, k=k))
-    del donate  # reserved: buffer donation lands with the pipelined driver
     return {"mc": mc, "hc": hc, "mix": mix, "rand": rand}

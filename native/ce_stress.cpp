@@ -21,11 +21,16 @@
 //
 // Exit 0 = clean.  TSAN reports flip the exit code via halt_on_error.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <string>
 #include <thread>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
 #include <vector>
 
 extern "C" {
@@ -135,6 +140,11 @@ int main(int argc, char** argv) {
   Inputs in;
 
   if (mode == "tsan") {
+#if defined(_OPENMP)
+    // self-enforce the documented precondition: libgomp's barriers are
+    // invisible to TSAN, so in-region parallelism would be all noise
+    omp_set_num_threads(1);
+#endif
     // concurrent kernel invocations: shared inputs, private outputs
     std::vector<std::thread> threads;
     std::vector<Outputs> outs(4);
