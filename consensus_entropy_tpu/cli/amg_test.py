@@ -68,6 +68,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cnn-config-json", default=None, metavar="JSON",
                    help="debug: CNNConfig field overrides as a JSON object "
                         "(must match the pre-trained geometry)")
+    p.add_argument("--cnn-arch", default=None,
+                   choices=("vgg", "res", "harm", "se1d"),
+                   help="trunk family of the pre-trained CNN committee "
+                        "(geometry validation is arch-specific, so a "
+                        "non-vgg geometry needs the arch at config "
+                        "construction; checkpoint meta still wins at load)")
     add_path_args(p)
     add_device_arg(p)
     return p
@@ -119,7 +125,7 @@ def main(argv=None) -> int:
     pool = amg.load_feature_pool(paths.amg_dataset_csv,
                                  paths.amg_features_dir)
 
-    cnn_cfg = resolve_cnn_config(args.cnn_config_json)
+    cnn_cfg = resolve_cnn_config(args.cnn_config_json, arch=args.cnn_arch)
     store = None
     try:
         pretrained_files = os.listdir(paths.pretrained_dir)

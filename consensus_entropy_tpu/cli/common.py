@@ -29,12 +29,20 @@ def add_path_args(parser: argparse.ArgumentParser) -> None:
                         help="AMG1608 dataset root (settings.py:27-33)")
 
 
-def resolve_cnn_config(cnn_config_json: str | None):
-    """CNNConfig from the debug ``--cnn-config-json`` override (or defaults)."""
+def resolve_cnn_config(cnn_config_json: str | None, *,
+                       arch: str | None = None):
+    """CNNConfig from the debug ``--cnn-config-json`` override (or defaults).
+
+    ``arch`` (from a ``cnn_{arch}_jax`` registry name) must be injected at
+    CONSTRUCTION time: the frozen config geometry-validates in
+    ``__post_init__`` under its arch's rules, so building as vgg first and
+    replacing after would reject valid non-vgg geometries.
+    """
     import json
 
     from consensus_entropy_tpu.config import CNNConfig
 
-    if cnn_config_json:
-        return CNNConfig(**json.loads(cnn_config_json))
-    return CNNConfig()
+    kw = json.loads(cnn_config_json) if cnn_config_json else {}
+    if arch is not None:
+        kw["arch"] = arch
+    return CNNConfig(**kw)

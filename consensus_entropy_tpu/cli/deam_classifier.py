@@ -67,9 +67,8 @@ def main(argv=None) -> int:
                                         "valence.csv"),
                            cache_csv=paths.deam_dataset_csv)
 
-    if args.model in ("cnn", "cnn_jax", "cnn_res_jax", "cnn_harm_jax"):
-        import dataclasses
-
+    if args.model in ("cnn", "cnn_jax", "cnn_res_jax", "cnn_harm_jax",
+                      "cnn_se1d_jax"):
         from consensus_entropy_tpu.config import TrainConfig
         from consensus_entropy_tpu.data.audio import device_store_from_npy
 
@@ -78,10 +77,12 @@ def main(argv=None) -> int:
         # deam_classifier.py:253; we keep that exact rule)
         per_song = (df.groupby("song_id")["quadrants"].max())
         labels = {sid: int(q[1]) - 1 for sid, q in per_song.items()}
-        cfg = resolve_cnn_config(args.cnn_config_json)
-        if args.model not in ("cnn", "cnn_jax"):
-            # cnn_{arch}_jax registry names select the trunk family
-            cfg = dataclasses.replace(cfg, arch=args.model[4:-4])
+        # cnn_{arch}_jax registry names select the trunk family; the arch
+        # must reach CNNConfig construction (geometry validates per-arch)
+        cfg = resolve_cnn_config(
+            args.cnn_config_json,
+            arch=(None if args.model in ("cnn", "cnn_jax")
+                  else args.model[4:-4]))
         # training needs the device store (the trainer jit closes over the
         # device-resident waveform buffer)
         store = device_store_from_npy(paths.deam_npy_dir, list(labels),

@@ -103,7 +103,10 @@ class CNNConfig:
     #: ``Res_2d`` block the reference vendors unused, ``short_cnn.py:40-66``);
     #: ``harm`` = the vgg trunk over a LEARNABLE harmonic-filterbank frontend
     #: (the vendored ``HarmonicSTFT``, ``short_cnn.py:166-275``) instead of
-    #: log-mel — harmonics become the trunk's input channels.
+    #: log-mel — harmonics become the trunk's input channels; ``se1d`` =
+    #: sample-level squeeze-excitation residual 1-D trunk on the RAW
+    #: waveform (the vendored ``ResSE_1d``, ``short_cnn.py:85-125``; the
+    #: 59049-sample crop is 3^10, built for its /3-per-stage geometry).
     arch: str = "vgg"
     #: ``harm`` frontend geometry (``short_cnn.py:199-210`` defaults).
     n_harmonic: int = 6
@@ -111,11 +114,23 @@ class CNNConfig:
     bw_q_init: float = 1.0
 
     def __post_init__(self):
-        if self.arch not in ("vgg", "res", "harm"):
-            raise ValueError(f"arch must be 'vgg', 'res', or 'harm', "
-                             f"got {self.arch!r}")
+        if self.arch not in ("vgg", "res", "harm", "se1d"):
+            raise ValueError(f"arch must be 'vgg', 'res', 'harm', or "
+                             f"'se1d', got {self.arch!r}")
         if self.arch == "res":
             return  # stride-2 convs ceil-halve dims; they never hit zero
+        if self.arch == "se1d":
+            # stem (stride 3) + n_layers 3x max-pools each divide time by 3
+            t = self.input_length // 3
+            for layer in range(self.n_layers):
+                t //= 3
+                if t == 0:
+                    raise ValueError(
+                        f"se1d geometry collapses at block {layer + 1}: "
+                        f"input_length={self.input_length} survives only "
+                        f"{layer} of {self.n_layers} 3x pools after the "
+                        f"stride-3 stem")
+            return
         # Fail fast if the pooling pyramid collapses a spatial dim to zero
         # (the reference hard-codes a geometry where this can't happen:
         # 128 mels × 231 frames through 7 2×2 pools → 1×1).  The harm

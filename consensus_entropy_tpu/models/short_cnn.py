@@ -87,6 +87,44 @@ class ResBlock(nn.Module):
         return nn.relu(short + out)
 
 
+class SEBlock1d(nn.Module):
+    """Squeeze-excitation residual 1-D block, sample-level: conv → BN →
+    ReLU → conv → BN, channel SE gate (global-average → dense → ReLU →
+    dense → sigmoid), projected shortcut on width change, then ReLU →
+    3× max-pool.  Semantics of the vendored ``ResSE_1d``
+    (``short_cnn.py:85-125``); laid out NHWC with W=1 so the trunk plugs
+    into the same head as the 2-D families."""
+
+    features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        def bn(name):
+            return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                epsilon=1e-5, dtype=self.dtype, name=name)
+
+        out = nn.Conv(self.features, (3, 1), padding=((1, 1), (0, 0)),
+                      dtype=self.dtype, name="conv1")(x)
+        out = nn.relu(bn("bn1")(out))
+        out = nn.Conv(self.features, (3, 1), padding=((1, 1), (0, 0)),
+                      dtype=self.dtype, name="conv2")(out)
+        out = bn("bn2")(out)
+        # squeeze & excitation: global average over time -> channel gate
+        se = jnp.mean(out, axis=(1, 2))
+        se = nn.relu(nn.Dense(self.features, dtype=self.dtype,
+                              name="se_dense1")(se))
+        se = nn.sigmoid(nn.Dense(self.features, dtype=self.dtype,
+                                 name="se_dense2")(se))
+        out = out * se[:, None, None, :]
+        if x.shape[-1] != self.features:  # projected shortcut (`diff`)
+            x = nn.Conv(self.features, (3, 1), padding=((1, 1), (0, 0)),
+                        dtype=self.dtype, name="conv_proj")(x)
+            x = bn("bn_proj")(x)
+        out = nn.relu(x + out)
+        return nn.max_pool(out, (3, 1), strides=(3, 1))
+
+
 class ShortChunkCNN(nn.Module):
     """Short-chunk CNN over ~3.69 s mel spectrograms.
 
@@ -104,28 +142,46 @@ class ShortChunkCNN(nn.Module):
         """x: waveform ``(B, L)`` float — returns sigmoid scores ``(B, C)``."""
         cfg = self.config
         dtype = jnp.dtype(cfg.compute_dtype)
-        if cfg.arch == "harm":
-            from consensus_entropy_tpu.ops.harmonic import (
-                harmonic_spectrogram,
-            )
 
-            # learnable frontend: gradients flow into the band Q factor
-            # (the reference's learn_bw='only_Q', short_cnn.py:227-231)
-            bw_q = self.param(
-                "bw_q", lambda _: jnp.asarray([cfg.bw_q_init], jnp.float32))
-            s = harmonic_spectrogram(
-                x, bw_q, sample_rate=cfg.sample_rate, n_fft=cfg.n_fft,
-                hop_length=cfg.hop_length, n_harmonic=cfg.n_harmonic,
-                semitone_scale=cfg.semitone_scale)  # (B, H, level, T)
-            s = jnp.transpose(s, (0, 2, 3, 1)).astype(dtype)  # NHWC, C=H
+        def input_bn(s):
+            return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                epsilon=1e-5, dtype=dtype, name="spec_bn")(s)
+
+        if cfg.arch == "se1d":
+            # sample-level trunk on the RAW waveform — no spectrogram
+            # frontend at all (the 59049-sample reference crop is 3^10,
+            # built for exactly this /3-per-stage geometry).  NHWC, W=1.
+            s = input_bn(x[..., None, None].astype(dtype))  # (B, L, 1, 1)
+            s = nn.Conv(cfg.channel_widths[0], (3, 1), strides=(3, 1),
+                        padding="VALID", dtype=dtype, name="stem")(s)
+            s = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             epsilon=1e-5, dtype=dtype, name="stem_bn")(s)
+            s = nn.relu(s)
+            for width in cfg.channel_widths:
+                s = SEBlock1d(width, dtype=dtype)(s, train)
         else:
-            s = log_mel_spectrogram(x, cfg)  # (B, n_mels, T)
-            s = s[..., None].astype(dtype)  # NHWC: (B, n_mels, T, 1)
-        s = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-5, dtype=dtype, name="spec_bn")(s)
-        block = ResBlock if cfg.arch == "res" else ConvBlock
-        for width in cfg.channel_widths:
-            s = block(width, dtype=dtype)(s, train)
+            if cfg.arch == "harm":
+                from consensus_entropy_tpu.ops.harmonic import (
+                    harmonic_spectrogram,
+                )
+
+                # learnable frontend: gradients flow into the band Q factor
+                # (the reference's learn_bw='only_Q', short_cnn.py:227-231)
+                bw_q = self.param(
+                    "bw_q",
+                    lambda _: jnp.asarray([cfg.bw_q_init], jnp.float32))
+                s = harmonic_spectrogram(
+                    x, bw_q, sample_rate=cfg.sample_rate, n_fft=cfg.n_fft,
+                    hop_length=cfg.hop_length, n_harmonic=cfg.n_harmonic,
+                    semitone_scale=cfg.semitone_scale)  # (B, H, level, T)
+                s = jnp.transpose(s, (0, 2, 3, 1)).astype(dtype)  # NHWC
+            else:
+                s = log_mel_spectrogram(x, cfg)  # (B, n_mels, T)
+                s = s[..., None].astype(dtype)  # NHWC: (B, n_mels, T, 1)
+            s = input_bn(s)
+            block = ResBlock if cfg.arch == "res" else ConvBlock
+            for width in cfg.channel_widths:
+                s = block(width, dtype=dtype)(s, train)
         # Global max pool over remaining (freq, time) — the reference squeezes
         # freq (==1 after 7 pools) then MaxPool1d's time (short_cnn.py:334-339).
         s = jnp.max(s, axis=(1, 2))

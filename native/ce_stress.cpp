@@ -140,16 +140,20 @@ int main(int argc, char** argv) {
   Inputs in;
 
   if (mode == "tsan") {
-#if defined(_OPENMP)
-    // self-enforce the documented precondition: libgomp's barriers are
-    // invisible to TSAN, so in-region parallelism would be all noise
-    omp_set_num_threads(1);
-#endif
     // concurrent kernel invocations: shared inputs, private outputs
     std::vector<std::thread> threads;
     std::vector<Outputs> outs(4);
     for (int t = 0; t < 4; ++t)
-      threads.emplace_back([&in, &outs, t] { run_all(in, outs[t]); });
+      threads.emplace_back([&in, &outs, t] {
+#if defined(_OPENMP)
+        // self-enforce the documented precondition PER WORKER (the
+        // nthreads ICV is per-thread; setting it on main would not reach
+        // these initial threads): libgomp's barriers are invisible to
+        // TSAN, so in-region parallelism would be all noise
+        omp_set_num_threads(1);
+#endif
+        run_all(in, outs[t]);
+      });
     for (auto& th : threads) th.join();
     for (int t = 1; t < 4; ++t)
       if (!same(outs[0].probs, outs[t].probs) ||
