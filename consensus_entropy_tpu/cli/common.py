@@ -44,5 +44,9 @@ def resolve_cnn_config(cnn_config_json: str | None, *,
 
     kw = json.loads(cnn_config_json) if cnn_config_json else {}
     if arch is not None:
+        if kw.get("arch", arch) != arch:
+            raise ValueError(
+                f"--cnn-config-json sets arch={kw['arch']!r} but the "
+                f"registry/flag selects {arch!r}; drop one of them")
         kw["arch"] = arch
     return CNNConfig(**kw)

@@ -109,3 +109,12 @@ def test_al_cli_cnn_arch_flag():
         resolve_cnn_config(json_cfg)  # vgg rules reject 729 samples
     cfg = resolve_cnn_config(json_cfg, arch="se1d")
     assert cfg.arch == "se1d" and cfg.input_length == 729
+
+
+def test_arch_conflict_rejected():
+    from consensus_entropy_tpu.cli.common import resolve_cnn_config
+
+    with pytest.raises(ValueError, match="drop one"):
+        resolve_cnn_config('{"arch": "se1d"}', arch="vgg")
+    # agreeing values are fine
+    assert resolve_cnn_config('{"arch": "res"}', arch="res").arch == "res"
