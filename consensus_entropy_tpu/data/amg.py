@@ -112,7 +112,12 @@ def load_feature_pool(dataset_csv: str | None = None,
     else:
         df = _assemble_feature_csvs(features_dir)
         if dataset_csv is not None:
-            df.to_csv(dataset_csv, sep=";", index=False)
+            # atomic write: concurrent processes (multi-host AL shares the
+            # data root) must never read a truncated cache mid-write; the
+            # assembly is deterministic, so last-writer-wins is identical
+            tmp = f"{dataset_csv}.{os.getpid()}.tmp"
+            df.to_csv(tmp, sep=";", index=False)
+            os.replace(tmp, dataset_csv)
     X = df.loc[:, FEATURE_SLICE_START:FEATURE_SLICE_STOP].to_numpy(np.float32)
     if scale:
         from sklearn.preprocessing import StandardScaler

@@ -1,0 +1,70 @@
+"""Shared builder for the miniature on-disk DEAM + AMG1608 layout used by
+the CLI integration tests (single- and multi-process)."""
+
+import numpy as np
+import pandas as pd
+from scipy.io import savemat
+
+FEATURE_COLS = (["F0final_sma_stddev"] + [f"f{i}" for i in range(6)]
+                + ["mfcc_sma_de[14]_amean"])
+
+
+def build_synth_roots(tmp_path, rng) -> dict:
+    """Class-separable synthetic DEAM + AMG1608 trees under ``tmp_path``."""
+    centers = rng.standard_normal((4, len(FEATURE_COLS))) * 3.0
+
+    # --- DEAM: features + dynamic annotations -------------------------
+    deam = tmp_path / "deam"
+    (deam / "features").mkdir(parents=True)
+    (deam / "annotations").mkdir()
+    times = np.arange(15.0, 25.0, 0.5)
+    cols_ms = [f"sample_{int(t * 1000)}ms" for t in times]
+    a_rows, v_rows = [], []
+    for sid in range(1, 25):
+        target = sid % 4  # song's dominant quadrant
+        a_sign = 1.0 if target in (0, 1) else -1.0  # deam geometry
+        v_sign = 1.0 if target in (0, 3) else -1.0
+        a_vals = a_sign * rng.uniform(0.2, 1.0, len(times))
+        v_vals = v_sign * rng.uniform(0.2, 1.0, len(times))
+        feats = centers[target] + rng.standard_normal(
+            (len(times), len(FEATURE_COLS))).astype(np.float32)
+        df = pd.DataFrame(feats, columns=FEATURE_COLS)
+        df.insert(0, "frameTime", times)
+        df.to_csv(deam / "features" / f"{sid}.csv", sep=";", index=False)
+        a_rows.append({"song_id": sid, **dict(zip(cols_ms, a_vals))})
+        v_rows.append({"song_id": sid, **dict(zip(cols_ms, v_vals))})
+    pd.DataFrame(a_rows).to_csv(deam / "annotations" / "arousal.csv",
+                                index=False)
+    pd.DataFrame(v_rows).to_csv(deam / "annotations" / "valence.csv",
+                                index=False)
+
+    # --- AMG: per-song feature csvs + .mat annotations ----------------
+    amg = tmp_path / "amg1608"
+    (amg / "feats").mkdir(parents=True)
+    (amg / "anno").mkdir()
+    n_songs, n_users = 40, 6
+    song_ids = np.arange(201, 201 + n_songs)
+    song_class = rng.integers(0, 4, size=n_songs)
+    for sid, c in zip(song_ids, song_class):
+        k = int(rng.integers(4, 8))
+        feats = centers[c] + rng.standard_normal(
+            (k, len(FEATURE_COLS))).astype(np.float32)
+        df = pd.DataFrame(feats, columns=FEATURE_COLS)
+        df.insert(0, "frameTime", np.arange(k) * 1.0)
+        df.to_csv(amg / "feats" / f"{sid}.csv", sep=";", index=False)
+    # annotations: valence/arousal consistent with each song's class (amg
+    # geometry, [valence, arousal] order), light per-user noise on magnitude
+    lab = np.full((n_songs, n_users, 2), np.nan)
+    for i, c in enumerate(song_class):
+        a_sign = 1.0 if c in (0, 1) else -1.0
+        v_sign = 1.0 if c in (0, 3) else -1.0
+        for u in range(n_users):
+            if rng.uniform() < 0.9:  # most users annotated most songs
+                lab[i, u, 0] = v_sign * rng.uniform(0.3, 1.0)
+                lab[i, u, 1] = a_sign * rng.uniform(0.3, 1.0)
+    savemat(str(amg / "anno" / "AMG1608.mat"), {"song_label": lab})
+    savemat(str(amg / "anno" / "1608_song_id.mat"),
+            {"mat_id2song_id": song_ids.reshape(-1, 1)})
+
+    models = tmp_path / "models"
+    return {"deam": str(deam), "amg": str(amg), "models": str(models)}

@@ -60,7 +60,7 @@ results["cnn_shape"] = list(cnn_probs.shape)
 
 # -- coordination primitives ----------------------------------------------
 results["is_coord"] = multihost.is_coordinator()
-flag = multihost.broadcast_flag(pid == 0 and True)
+flag = multihost.broadcast_flag(pid == 0)
 results["flag"] = bool(flag)
 multihost.sync("done")
 print("RESULT " + json.dumps(results), flush=True)
@@ -73,23 +73,23 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_scoring(tmp_path):
-    worker = tmp_path / "worker.py"
-    worker.write_text(WORKER)
-    port = str(_free_port())
-    env = {**os.environ, "PYTHONPATH": os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))}
+def _worker_env() -> dict:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "PYTHONPATH": repo}
     env.pop("JAX_PLATFORMS", None)
-    procs = [subprocess.Popen([sys.executable, str(worker), str(pid), port],
-                              stdout=subprocess.PIPE,
+    return env
+
+
+def _run_pair(argv_per_pid, env, timeout=300) -> list:
+    """Spawn both workers, reap BOTH on any failure (one worker dying
+    leaves the other blocked in a distributed barrier), return stdouts."""
+    procs = [subprocess.Popen(argv, stdout=subprocess.PIPE,
                               stderr=subprocess.PIPE, text=True, env=env)
-             for pid in range(2)]
+             for argv in argv_per_pid]
     outs = []
     try:
         for p in procs:
-            # one worker dying leaves the other blocked in a distributed
-            # barrier — always reap both (finally) so nothing leaks
-            out, err = p.communicate(timeout=300)
+            out, err = p.communicate(timeout=timeout)
             assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
             outs.append(out)
     finally:
@@ -97,6 +97,15 @@ def test_two_process_distributed_scoring(tmp_path):
             if p.poll() is None:
                 p.kill()
                 p.communicate()
+    return outs
+
+
+def test_two_process_distributed_scoring(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER)
+    port = str(_free_port())
+    outs = _run_pair([[sys.executable, str(worker), str(pid), port]
+                      for pid in range(2)], _worker_env())
 
     parsed = []
     for out in outs:
@@ -118,3 +127,47 @@ def test_two_process_distributed_scoring(tmp_path):
     # coordinator roles + broadcast agreement
     assert r0["is_coord"] is True and r1["is_coord"] is False
     assert r0["flag"] is True and r1["flag"] is True
+
+
+def test_two_process_al_cli_end_to_end(tmp_path):
+    """The FULL AL CLI in two real jax.distributed processes sharing one
+    workspace: coordinator owns every file, skip decisions broadcast, both
+    processes finish rc 0 with identical results."""
+    from tests.synth_data import build_synth_roots
+
+    roots = build_synth_roots(tmp_path, np.random.default_rng(11))
+    env = _worker_env()
+
+    # pre-train (single process; just populates the shared models dir)
+    pre = subprocess.run(
+        [sys.executable, "-m", "consensus_entropy_tpu.cli.deam_classifier",
+         "-cv", "2", "-m", "gnb", "--device", "cpu",
+         "--models-root", roots["models"], "--deam-root", roots["deam"],
+         "--amg-root", roots["amg"]],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert pre.returncode == 0, pre.stdout + pre.stderr
+
+    port = str(_free_port())
+    args = [sys.executable, "-m", "consensus_entropy_tpu.cli.amg_test",
+            "-q", "4", "-e", "2", "-m", "mc", "-n", "10",
+            "--max-users", "2", "--mesh", "auto", "--device", "cpu",
+            "--models-root", roots["models"], "--deam-root", roots["deam"],
+            "--amg-root", roots["amg"]]
+    outs = _run_pair(
+        [args + ["--distributed", f"localhost:{port},2,{pid}"]
+         for pid in range(2)], env)
+
+    # both processes computed in lockstep and report the same final F1
+    finals = [[l for l in out.splitlines() if "final committee F1" in l]
+              for out in outs]
+    assert finals[0] and finals[0] == finals[1]
+    # the coordinator wrote each user's reports/state exactly once; DONE set
+    users_dir = os.path.join(roots["models"], "users")
+    users = sorted(os.listdir(users_dir))
+    assert len(users) == 2
+    for u in users:
+        udir = os.path.join(users_dir, u, "mc")
+        assert os.path.exists(os.path.join(udir, "DONE"))
+        metrics = [json.loads(l)
+                   for l in open(os.path.join(udir, "metrics.jsonl"))]
+        assert len(metrics) == 3  # epoch0 + 2 AL iterations, no duplicates
