@@ -538,7 +538,7 @@ def main(argv=None) -> int:
     ap.add_argument("--mode", choices=("mc", "hc", "mix"), default="mc",
                     help="acquisition chain to benchmark (BASELINE configs "
                          "0-2); hc has no committee in the loop")
-    ap.add_argument("--arch", choices=("vgg", "res", "harm", "se1d"),
+    ap.add_argument("--arch", choices=("vgg", "res", "harm", "se1d", "musicnn"),
                     default="vgg",
                     help="CNN trunk family for the cnn suite")
     ap.add_argument("--impl", choices=("auto", "xla", "pallas"),

@@ -69,7 +69,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="debug: CNNConfig field overrides as a JSON object "
                         "(must match the pre-trained geometry)")
     p.add_argument("--cnn-arch", default=None,
-                   choices=("vgg", "res", "harm", "se1d"),
+                   choices=("vgg", "res", "harm", "se1d", "musicnn"),
                    help="trunk family of the pre-trained CNN committee "
                         "(geometry validation is arch-specific, so a "
                         "non-vgg geometry needs the arch at config "

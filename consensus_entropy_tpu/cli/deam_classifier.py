@@ -68,7 +68,7 @@ def main(argv=None) -> int:
                            cache_csv=paths.deam_dataset_csv)
 
     if args.model in ("cnn", "cnn_jax", "cnn_res_jax", "cnn_harm_jax",
-                      "cnn_se1d_jax"):
+                      "cnn_se1d_jax", "cnn_musicnn_jax"):
         from consensus_entropy_tpu.config import TrainConfig
         from consensus_entropy_tpu.data.audio import device_store_from_npy
 

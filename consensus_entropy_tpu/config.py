@@ -18,6 +18,15 @@ QUADRANT_TO_CLASS = {"Q1": 0, "Q2": 1, "Q3": 2, "Q4": 3}
 CLASS_TO_QUADRANT = {v: k for k, v in QUADRANT_TO_CLASS.items()}
 NUM_CLASSES = 4
 
+
+def stft_frame_count(length: int, n_fft: int, hop: int) -> int:
+    """Frame count of the centered STFT (torchaudio-default geometry):
+    ``(length + 2*(n_fft//2)) // hop - 1`` — 231 for the canonical
+    59049-sample crop.  Canonical definition; ``ops.mel.n_frames_for``
+    delegates here (config must not import ops.mel: its module-level
+    ``CNNConfig()`` defaults would recurse into this file mid-import)."""
+    return (length + 2 * (n_fft // 2)) // hop - 1
+
 #: Feature-column slice bounds used for both DEAM and AMG openSMILE features
 #: (``amg_test.py:64``, ``deam_classifier.py:182-185``).
 FEATURE_SLICE_START = "F0final_sma_stddev"
@@ -114,11 +123,23 @@ class CNNConfig:
     bw_q_init: float = 1.0
 
     def __post_init__(self):
-        if self.arch not in ("vgg", "res", "harm", "se1d"):
-            raise ValueError(f"arch must be 'vgg', 'res', 'harm', or "
-                             f"'se1d', got {self.arch!r}")
+        if self.arch not in ("vgg", "res", "harm", "se1d", "musicnn"):
+            raise ValueError(f"arch must be one of 'vgg', 'res', 'harm', "
+                             f"'se1d', 'musicnn'; got {self.arch!r}")
         if self.arch == "res":
             return  # stride-2 convs ceil-halve dims; they never hit zero
+        if self.arch == "musicnn":
+            # multi-shape front-end keeps time; the mid-end halves it per
+            # layer (frequency is fully pooled by the front-end)
+            t = self._n_frames
+            for layer in range(self.n_layers):
+                t //= 2
+                if t == 0:
+                    raise ValueError(
+                        f"musicnn geometry collapses at mid-end layer "
+                        f"{layer + 1}: input_length={self.input_length} "
+                        f"survives only {layer} of {self.n_layers} 2x pools")
+            return
         if self.arch == "se1d":
             # stem (stride 3) + n_layers 3x max-pools each divide time by 3
             t = self.input_length // 3
@@ -136,7 +157,7 @@ class CNNConfig:
         # 128 mels × 231 frames through 7 2×2 pools → 1×1).  The harm
         # frontend's frequency axis is its note-grid level, not n_mels.
         f = self.n_mels if self.arch == "vgg" else self.harm_level
-        t = (self.input_length + 2 * (self.n_fft // 2)) // self.hop_length - 1
+        t = self._n_frames
         for layer in range(self.n_layers):
             f, t = f // 2, t // 2
             if f == 0 or t == 0:
@@ -145,6 +166,13 @@ class CNNConfig:
                     f"freq={self.n_mels if self.arch == 'vgg' else self.harm_level}, "
                     f"input_length={self.input_length} "
                     f"survive only {layer} of {self.n_layers} 2x2 pools")
+
+    @property
+    def _n_frames(self) -> int:
+        """Spectrogram frame count (single source: :func:`stft_frame_count`;
+        ``ops.mel.n_frames_for`` delegates here)."""
+        return stft_frame_count(self.input_length, self.n_fft,
+                                self.hop_length)
 
     @property
     def harm_level(self) -> int:

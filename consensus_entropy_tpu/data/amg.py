@@ -114,8 +114,15 @@ def load_feature_pool(dataset_csv: str | None = None,
         if dataset_csv is not None:
             # atomic write: concurrent processes (multi-host AL shares the
             # data root) must never read a truncated cache mid-write; the
-            # assembly is deterministic, so last-writer-wins is identical
-            tmp = f"{dataset_csv}.{os.getpid()}.tmp"
+            # assembly is deterministic, so last-writer-wins is identical.
+            # mkstemp (not a pid suffix) keeps tmp names unique across
+            # HOSTS sharing the filesystem, where pids can collide.
+            import tempfile
+
+            fd, tmp = tempfile.mkstemp(
+                dir=os.path.dirname(os.path.abspath(dataset_csv)),
+                suffix=".tmp")
+            os.close(fd)
             df.to_csv(tmp, sep=";", index=False)
             os.replace(tmp, dataset_csv)
     X = df.loc[:, FEATURE_SLICE_START:FEATURE_SLICE_STOP].to_numpy(np.float32)

@@ -125,6 +125,50 @@ class SEBlock1d(nn.Module):
         return nn.max_pool(out, (3, 1), strides=(3, 1))
 
 
+class MusicnnFrontEnd(nn.Module):
+    """Multi-shape timbral/temporal front-end over the log-mel image.
+
+    Vertical branches (the vendored ``Conv_V``, ``short_cnn.py:128-143``):
+    filters spanning a FRACTION of the mel axis (0.4 and 0.7 here, the
+    MusiCNN design the blocks come from), max-pooled over remaining
+    frequency — pitch-invariant timbre detectors.  Horizontal branches
+    (``Conv_H``, ``short_cnn.py:146-160``): frequency-average first, then
+    long 1-D convs over time (lengths 32/64) — tempo/rhythm detectors.
+    Branch outputs concatenate on channels into a ``(B, T, 1, C_total)``
+    map for the mid-end.  The reference vendors only the blocks, not their
+    composition; the composition here follows the MusiCNN front-end they
+    were written for.
+    """
+
+    features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, s, train: bool):
+        def bn(name):
+            return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                epsilon=1e-5, dtype=self.dtype, name=name)
+
+        n_mels = s.shape[1]
+        branches = []
+        for i, frac in enumerate((0.4, 0.7)):  # Conv_V semantics
+            h = max(1, int(n_mels * frac))
+            v = nn.Conv(self.features, (h, 7), padding=((0, 0), (3, 3)),
+                        dtype=self.dtype, name=f"v{i}_conv")(s)
+            v = nn.relu(bn(f"v{i}_bn")(v))
+            branches.append(jnp.max(v, axis=1))  # freq max-pool -> (B,T,C)
+        avg = jnp.mean(s, axis=1)  # Conv_H: freq average -> (B, T, 1)
+        for i, length in enumerate((32, 64)):
+            pad = length // 2
+            hbr = nn.Conv(self.features, (length,),
+                          padding=((pad, pad - (length + 1) % 2),),
+                          dtype=self.dtype, name=f"h{i}_conv")(avg)
+            branches.append(nn.relu(bn(f"h{i}_bn")(hbr)))
+        t = min(b.shape[1] for b in branches)
+        out = jnp.concatenate([b[:, :t] for b in branches], axis=-1)
+        return out[:, :, None, :]  # (B, T, 1, C_total) for the mid-end
+
+
 class ShortChunkCNN(nn.Module):
     """Short-chunk CNN over ~3.69 s mel spectrograms.
 
@@ -159,6 +203,18 @@ class ShortChunkCNN(nn.Module):
             s = nn.relu(s)
             for width in cfg.channel_widths:
                 s = SEBlock1d(width, dtype=dtype)(s, train)
+        elif cfg.arch == "musicnn":
+            s = input_bn(log_mel_spectrogram(x, cfg)[..., None].astype(dtype))
+            s = MusicnnFrontEnd(cfg.n_channels, dtype=dtype)(s, train)
+            for i in range(cfg.n_layers):  # temporal mid-end, /2 per stage
+                s = nn.Conv(cfg.channel_widths[i], (3, 1),
+                            padding=((1, 1), (0, 0)), dtype=dtype,
+                            name=f"mid{i}_conv")(s)
+                s = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 epsilon=1e-5, dtype=dtype,
+                                 name=f"mid{i}_bn")(s)
+                s = nn.relu(s)
+                s = nn.max_pool(s, (2, 1), strides=(2, 1))
         else:
             if cfg.arch == "harm":
                 from consensus_entropy_tpu.ops.harmonic import (

@@ -150,6 +150,8 @@ def log_mel_spectrogram(x, config: CNNConfig = CNNConfig(),
 
 
 def n_frames_for(length: int, n_fft: int = 512, hop: int = 256) -> int:
-    """Frame count for a centered STFT: ``1 + length // hop`` trimmed to the
-    reshape geometry (231 for the canonical 59049-sample crop)."""
-    return (length + 2 * (n_fft // 2)) // hop - 1
+    """Frame count for a centered STFT (231 for the canonical 59049-sample
+    crop); delegates to the canonical ``config.stft_frame_count``."""
+    from consensus_entropy_tpu.config import stft_frame_count
+
+    return stft_frame_count(length, n_fft, hop)

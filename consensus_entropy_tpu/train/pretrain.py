@@ -63,7 +63,8 @@ def _registry(seed) -> dict[str, Callable[[str], Member]]:
 
 
 MODEL_CHOICES = ("gnb", "sgd", "xgb", "rf", "svc", "knn", "gpc", "gbc",
-                 "cnn", "cnn_jax", "cnn_res_jax", "cnn_harm_jax", "cnn_se1d_jax")
+                 "cnn", "cnn_jax", "cnn_res_jax", "cnn_harm_jax", "cnn_se1d_jax",
+                 "cnn_musicnn_jax")
 
 
 def grouped_folds(song_ids, n_splits: int, rng: np.random.Generator,

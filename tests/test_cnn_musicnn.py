@@ -1,0 +1,83 @@
+"""The MusiCNN-style multi-shape family (config.arch='musicnn'):
+vertical-timbral + horizontal-temporal front-end over log-mel, temporal
+mid-end, shared head.  Reference block semantics: the vendored (unused)
+``Conv_V``/``Conv_H`` at ``/root/reference/short_cnn.py:128-160``."""
+
+import jax
+import numpy as np
+import pytest
+
+from consensus_entropy_tpu.config import CNNConfig
+from consensus_entropy_tpu.models import short_cnn
+
+TINY_M = CNNConfig(n_channels=4, n_mels=16, n_fft=64, hop_length=32,
+                   n_layers=3, input_length=2048, arch="musicnn")
+
+
+@pytest.fixture(scope="module")
+def m_vars():
+    return short_cnn.init_variables(jax.random.key(0), TINY_M)
+
+
+def test_musicnn_geometry_validation():
+    with pytest.raises(ValueError, match="collapses"):
+        CNNConfig(n_channels=2, n_mels=16, n_fft=64, hop_length=32,
+                  n_layers=8, input_length=2048, arch="musicnn")
+    CNNConfig(arch="musicnn")  # default geometry is valid
+
+
+def test_musicnn_forward_and_branches(m_vars, rng):
+    x = rng.standard_normal((3, TINY_M.input_length)).astype(np.float32)
+    out = np.asarray(short_cnn.apply_infer(m_vars, x, TINY_M))
+    assert out.shape == (3, 4)
+    assert np.isfinite(out).all()
+    fe = m_vars["params"]["MusicnnFrontEnd_0"]
+    # two vertical (timbral) + two horizontal (temporal) branches
+    assert {"v0_conv", "v1_conv", "h0_conv", "h1_conv"} <= set(fe)
+    # vertical kernels span a fraction of the mel axis (Conv_V)
+    assert fe["v0_conv"]["kernel"].shape[0] == int(16 * 0.4)
+    assert fe["v1_conv"]["kernel"].shape[0] == int(16 * 0.7)
+    # horizontal kernels are long 1-D time filters (Conv_H)
+    assert fe["h0_conv"]["kernel"].shape[0] == 32
+    assert fe["h1_conv"]["kernel"].shape[0] == 64
+    mids = [k for k in m_vars["params"] if k.startswith("mid")]
+    assert len(mids) == 2 * TINY_M.n_layers  # conv + bn per stage
+
+
+def test_musicnn_train_and_committee(m_vars, rng):
+    x = rng.standard_normal((4, TINY_M.input_length)).astype(np.float32)
+    out, new_stats = short_cnn.apply_train(
+        m_vars, x, jax.random.key(1), TINY_M)
+    assert out.shape == (4, 4)
+    assert any(not np.allclose(a, b) for a, b in zip(
+        jax.tree.leaves(m_vars["batch_stats"]),
+        jax.tree.leaves(new_stats)))
+    members = [short_cnn.init_variables(jax.random.key(i), TINY_M)
+               for i in range(2)]
+    probs = np.asarray(short_cnn.committee_infer(
+        short_cnn.stack_params(members), x, TINY_M))
+    assert probs.shape == (2, 4, 4)
+
+
+def test_musicnn_trainer_and_registry(rng, tmp_path):
+    from consensus_entropy_tpu.config import TrainConfig
+    from consensus_entropy_tpu.data.audio import DeviceWaveformStore
+    from consensus_entropy_tpu.models.cnn_trainer import CNNTrainer
+    from consensus_entropy_tpu.models.committee import CNNMember
+    from consensus_entropy_tpu.train.pretrain import MODEL_CHOICES
+
+    assert "cnn_musicnn_jax" in MODEL_CHOICES
+    waves = {f"s{i}": (rng.standard_normal(2500) * 0.05).astype(np.float32)
+             for i in range(8)}
+    store = DeviceWaveformStore(waves, TINY_M.input_length)
+    ids = list(waves)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+    trainer = CNNTrainer(TINY_M, TrainConfig(batch_size=4))
+    v0 = short_cnn.init_variables(jax.random.key(0), TINY_M)
+    best, hist = trainer.fit(v0, store, ids[:6], y[:6], ids[6:], y[6:],
+                             jax.random.key(1), n_epochs=2)
+    assert np.isfinite([h["val_loss"] for h in hist]).all()
+    m = CNNMember("it_0", best, TINY_M)
+    path = str(tmp_path / "classifier_cnn_musicnn.it_0.msgpack")
+    m.save(path)
+    assert CNNMember.load(path).config.arch == "musicnn"
