@@ -44,6 +44,27 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _provenance() -> dict:
+    """Platform / device-count / commit fields stamped into every bench JSON
+    line so cross-round artifacts (BENCH_*_r{N}.json) are comparable."""
+    import os
+    import subprocess
+
+    import jax
+
+    devs = jax.devices()
+    try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__))).stdout.strip() \
+            or None
+    except Exception:
+        commit = None
+    return {"platform": devs[0].platform, "device_kind": devs[0].device_kind,
+            "n_devices": len(devs), "commit": commit}
+
+
 def make_inputs(n_members: int, n_pool: int, n_frames: int, n_features: int,
                 n_class: int, seed: int = 1987):
     """Synthetic pool features + linear committee members.
@@ -445,9 +466,14 @@ def run_cnn_suite(args_ns) -> int:
         "metric": (f"cnn_committee_scoring_{n_members}m_{n_songs}"
                    + ("" if args_ns.arch == "vgg" else f"_{args_ns.arch}")),
         "dtype": winner,
+        # the bf16 gate (prob tol 0.02 vs f32) is evaluated on random-init
+        # weights scoring noise — an upper-bound sanity check, not a bound
+        # on trained-member bf16 error (see README)
+        "bf16_gate": "prob_tol_0.02_random_init",
         "value": round(dev_ms, 3),
         "unit": "ms",
         "vs_baseline": round(cpu_ms / dev_ms, 1),
+        **_provenance(),
     }))
     return 0
 
@@ -515,6 +541,7 @@ def run_retrain_suite(args_ns) -> int:
         "value": round(ms_epoch, 3),
         "unit": "ms",
         "vs_baseline": round(seq_s / vmap_s, 2),
+        **_provenance(),
     }))
     return 0
 
@@ -658,6 +685,7 @@ def main(argv=None) -> int:
         "value": round(dev_ms, 3),
         "unit": "ms",
         "vs_baseline": round(cpu_ms / dev_ms, 1),
+        **_provenance(),
     }))
     return 0
 

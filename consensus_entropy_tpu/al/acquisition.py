@@ -21,6 +21,17 @@ from consensus_entropy_tpu.ops import scoring
 from consensus_entropy_tpu.utils import round_up as _round_up
 
 
+def _scatter_rows_impl(buf, rows, p):
+    """In-place (donated) scatter of live-row probs into the persistent
+    padded buffer.  Module-level so the jit cache is shared across Acquirer
+    instances: under ``pad_to`` a 46-user run compiles one program per
+    live-width, not per (user, width)."""
+    return buf.at[:, rows].set(p)
+
+
+_scatter_rows = jax.jit(_scatter_rows_impl, donate_argnums=0)
+
+
 class Acquirer:
     """Per-user acquisition state over a fixed padded pool.
 
@@ -74,6 +85,19 @@ class Acquirer:
             self._fns = make_sharded_scoring_fns(mesh, k=queries,
                                                  tie_break=tie_break)
         self._rand_key = jax.random.key(seed)
+        # The hc table never changes across iterations (only its mask
+        # shrinks): commit it to the device ONCE; per-iteration uploads are
+        # then just the tiny bool masks.  (Round-1..2 re-uploaded the
+        # (N, C) table every select — the last static input in the loop.)
+        if mode in ("hc", "mix"):
+            self._hc_dev = self._feed(self.hc, 0) if mesh is not None \
+                else jax.device_put(self.hc)
+        else:
+            self._hc_dev = None
+        #: persistent (M, n_pad, C) device buffer for member probs —
+        #: live rows are scattered in-place each iteration (see
+        #: :meth:`_staged_probs`); stale rows stay behind the pool mask
+        self._probs_buf = None
 
     def _feed(self, arr, axis: int):
         """Upload one scoring input with its pool sharding.
@@ -112,13 +136,40 @@ class Acquirer:
 
     def pad_probs(self, member_probs) -> np.ndarray:
         """Pad ``(M, n_live, C)`` member probs (over ``remaining_songs``) out
-        to the fixed ``(M, n_pad, C)`` device shape."""
+        to the fixed ``(M, n_pad, C)`` device shape (host path)."""
         member_probs = np.asarray(member_probs)
         m = member_probs.shape[0]
         out = np.zeros((m, self.n_pad, NUM_CLASSES), np.float32)
         live = np.flatnonzero(self.pool_mask)
         out[:, live] = member_probs
         return out
+
+    def _staged_probs(self, member_probs):
+        """The ``(M, n_pad, C)`` scoring input for mc/mix.
+
+        Single-process device path: scatter the live rows into a persistent
+        device buffer in place (donated), so the committee's device-computed
+        probs never round-trip through the host and the upload per iteration
+        is only the compact ``(M, n_live, C)`` block when the probs came
+        from host members.  Rows of previously-queried songs keep stale
+        values — they sit behind ``pool_mask`` and never reach the entropy.
+        The scatter jit specializes per live-width (one compile per AL
+        iteration count, shared across users under ``pad_to``).
+
+        Multi-host mesh path: the committee already merges its blocks on
+        host (per-process feeding); keep the host pad + per-host feed.
+        """
+        if self._mesh is not None:
+            return self._feed(self.pad_probs(member_probs), 1)
+        member_probs = jnp.asarray(member_probs)
+        m = member_probs.shape[0]
+        if self._probs_buf is None or self._probs_buf.shape[0] != m:
+            self._probs_buf = jnp.zeros((m, self.n_pad, NUM_CLASSES),
+                                        jnp.float32)
+        live = jnp.asarray(np.flatnonzero(self.pool_mask))
+        self._probs_buf = _scatter_rows(
+            self._probs_buf, live, member_probs.astype(jnp.float32))
+        return self._probs_buf
 
     # -- the four modes ----------------------------------------------------
 
@@ -132,18 +183,18 @@ class Acquirer:
         masks exactly as the reference mutates its tables.
         """
         if self.mode == "mc":
-            res = self._fns["mc"](self._feed(self.pad_probs(member_probs), 1),
+            res = self._fns["mc"](self._staged_probs(member_probs),
                                   self._feed(self.pool_mask, 0))
             q_songs = self._ids(res)
         elif self.mode == "hc":
-            res = self._fns["hc"](self._feed(self.hc, 0),
+            res = self._fns["hc"](self._hc_dev,
                                   self._feed(self.hc_mask, 0))
             q_songs = self._ids(res)
             self._remove_hc(q_songs)  # amg_test.py:455
         elif self.mode == "mix":
-            res = self._fns["mix"](self._feed(self.pad_probs(member_probs), 1),
+            res = self._fns["mix"](self._staged_probs(member_probs),
                                    self._feed(self.pool_mask, 0),
-                                   self._feed(self.hc, 0),
+                                   self._hc_dev,
                                    self._feed(self.hc_mask, 0))
             is_hc, slots = scoring.split_mix_index(res.indices, self.n_pad)
             valid = np.asarray(res.values) > -np.inf

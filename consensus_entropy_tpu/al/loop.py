@@ -225,8 +225,11 @@ class ALLoop:
                 if cfg.mode in ("mc", "mix"):
                     key, sub = jax.random.split(key)
                     with timer.phase("score"):
-                        member_probs = np.asarray(committee.pool_probs(
-                            data.pool, data.store, live, sub))
+                        # stays a device array end-to-end: the acquirer
+                        # scatters it into its persistent padded buffer
+                        # (no host round-trip of the probs table)
+                        member_probs = committee.pool_probs(
+                            data.pool, data.store, live, sub)
                 key, sub = jax.random.split(key)
                 with timer.phase("select"):
                     q_songs = acq.select(member_probs, rand_key=sub)

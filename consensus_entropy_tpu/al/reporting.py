@@ -18,7 +18,10 @@ from sklearn.metrics import classification_report, f1_score
 
 
 def weighted_f1(y_true, y_pred) -> float:
-    return float(f1_score(y_true, y_pred, average="weighted"))
+    # zero_division=0 matches the trainer's metric (cnn_trainer.py) and
+    # silences the UndefinedMetricWarning flood on never-predicted classes
+    return float(f1_score(y_true, y_pred, average="weighted",
+                          zero_division=0))
 
 
 class UserReport:
@@ -51,7 +54,8 @@ class UserReport:
         f1 = weighted_f1(y_true, y_pred)
         if self.write:
             self._txt.write(f"Model: {model_name}\n")
-            self._txt.write(f"{classification_report(y_true, y_pred)}\n")
+            self._txt.write(
+                f"{classification_report(y_true, y_pred, zero_division=0)}\n")
         return f1
 
     def epoch_summary(self, epoch: int, f1_list, *, queried=None,

@@ -80,7 +80,7 @@ def load_committee(path: str, config: CNNConfig = CNNConfig(),
                    train_config: TrainConfig = TrainConfig(),
                    *, device_members: bool = False,
                    full_song_hop: int | None = None,
-                   mesh=None) -> Committee:
+                   mesh=None, train_mesh=None) -> Committee:
     """Load every model file in a workspace into a Committee.
 
     File naming (written by ``Committee.save``):
@@ -108,7 +108,8 @@ def load_committee(path: str, config: CNNConfig = CNNConfig(),
         raise FileNotFoundError(f"no committee members in {path}")
     return Committee(host, cnns, config, train_config,
                      device_members=device_members,
-                     full_song_hop=full_song_hop, mesh=mesh)
+                     full_song_hop=full_song_hop, mesh=mesh,
+                     train_mesh=train_mesh)
 
 
 def _load_boosted(path: str) -> Member:

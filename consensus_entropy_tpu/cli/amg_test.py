@@ -142,10 +142,14 @@ def main(argv=None) -> int:
                                       cnn_cfg.input_length)
 
     mesh = None
+    train_mesh = None
     if args.mesh:
         import jax
 
-        from consensus_entropy_tpu.parallel.mesh import make_pool_mesh
+        from consensus_entropy_tpu.parallel.mesh import (
+            make_pool_mesh,
+            make_training_mesh,
+        )
 
         devs = jax.devices()
         if args.mesh == "auto":
@@ -170,6 +174,14 @@ def main(argv=None) -> int:
         else:
             mesh = make_pool_mesh(devs[:n_dev])
             print(f"Scoring mesh: {n_dev} device(s) on the pool axis")
+        if not args.distributed and store is not None:
+            # Retraining dominates the AL iteration wall-clock: give it
+            # every meshed chip on the member axis (fit_many pads a
+            # non-dividing committee).  Multi-host retraining would need
+            # globally-fed member state and is deliberately not wired.
+            train_mesh = make_training_mesh(dp=1, member=n_dev,
+                                            devices=devs[:n_dev])
+            print(f"Training mesh: {n_dev} device(s) on the member axis")
 
     loop = ALLoop(cfg, tie_break=args.tie_break,
                   retrain_epochs=args.retrain_epochs, mesh=mesh,
@@ -196,7 +208,8 @@ def main(argv=None) -> int:
             continue
         committee = workspace.load_committee(
             user_path, cnn_cfg, device_members=args.device_members,
-            full_song_hop=args.full_song_hop, mesh=mesh)
+            full_song_hop=args.full_song_hop, mesh=mesh,
+            train_mesh=train_mesh)
         sub_pool, labels = amg.user_pool(pool, anno, u_id)
         hc_rows = hc_table.reindex(sub_pool.song_ids).to_numpy(np.float32)
         data = UserData(u_id, sub_pool, labels, hc_rows=hc_rows, store=store)

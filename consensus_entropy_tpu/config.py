@@ -35,6 +35,24 @@ FEATURE_SLICE_STOP_FFTMAG = "pcm_fftMag_mfcc_sma_de[14]_amean"
 NUM_FEATURES = 260  # verified from the shipped GNB pickle (n_features_in_=260)
 
 
+def feature_slice(df):
+    """The 260-column openSMILE feature slice of a DEAM/AMG frame table.
+
+    openSMILE emitted two column-name vintages for the same features — the
+    newer prefixes the mfcc block with ``pcm_fftMag_`` — so the stop column
+    is dispatched on whichever is present (shared by ``data/amg.py`` and
+    ``data/deam.py``; the reference hardcodes one vintage per script,
+    ``amg_test.py:64`` / ``deam_classifier.py:182-185``).
+    """
+    if FEATURE_SLICE_STOP_FFTMAG in df.columns:
+        return df.loc[:, FEATURE_SLICE_START:FEATURE_SLICE_STOP_FFTMAG]
+    if FEATURE_SLICE_STOP in df.columns:
+        return df.loc[:, FEATURE_SLICE_START:FEATURE_SLICE_STOP]
+    raise ValueError("unrecognized feature columns (expected the openSMILE "
+                     f"slice to end at {FEATURE_SLICE_STOP!r} or "
+                     f"{FEATURE_SLICE_STOP_FFTMAG!r})")
+
+
 @dataclasses.dataclass(frozen=True)
 class PathsConfig:
     """Dataset / model-store locations (``settings.py:11-33``)."""

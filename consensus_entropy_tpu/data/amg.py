@@ -25,11 +25,7 @@ import os
 import numpy as np
 import pandas as pd
 
-from consensus_entropy_tpu.config import (
-    FEATURE_SLICE_START,
-    FEATURE_SLICE_STOP,
-    NUM_CLASSES,
-)
+from consensus_entropy_tpu.config import NUM_CLASSES, feature_slice
 from consensus_entropy_tpu.labels import quadrant_amg_np
 from consensus_entropy_tpu.models.committee import FramePool
 
@@ -123,9 +119,17 @@ def load_feature_pool(dataset_csv: str | None = None,
                 dir=os.path.dirname(os.path.abspath(dataset_csv)),
                 suffix=".tmp")
             os.close(fd)
-            df.to_csv(tmp, sep=";", index=False)
-            os.replace(tmp, dataset_csv)
-    X = df.loc[:, FEATURE_SLICE_START:FEATURE_SLICE_STOP].to_numpy(np.float32)
+            try:
+                df.to_csv(tmp, sep=";", index=False)
+                os.replace(tmp, dataset_csv)
+            except BaseException:
+                # don't leave orphaned .tmp files in the shared data root
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+    X = feature_slice(df).to_numpy(np.float32)
     if scale:
         from sklearn.preprocessing import StandardScaler
 

@@ -73,19 +73,9 @@ def load_dataset(features_dir: str, arousal_csv: str, valence_csv: str,
 def training_arrays(df: pd.DataFrame, scale: bool = True):
     """(X, y, song_ids) for the pre-trainer (``deam_classifier.py:181-197``):
     feature slice, full-pool StandardScaler, LabelEncoder('Q1'..)→0..3."""
-    from consensus_entropy_tpu.config import (
-        FEATURE_SLICE_START,
-        FEATURE_SLICE_STOP,
-        FEATURE_SLICE_STOP_FFTMAG,
-    )
+    from consensus_entropy_tpu.config import feature_slice
 
-    if FEATURE_SLICE_STOP_FFTMAG in df.columns:
-        X = df.loc[:, FEATURE_SLICE_START:FEATURE_SLICE_STOP_FFTMAG]
-    elif FEATURE_SLICE_STOP in df.columns:
-        X = df.loc[:, FEATURE_SLICE_START:FEATURE_SLICE_STOP]
-    else:
-        raise ValueError("unrecognized feature columns")
-    X = X.to_numpy(np.float32)
+    X = feature_slice(df).to_numpy(np.float32)
     if scale:
         from sklearn.preprocessing import StandardScaler
 

@@ -52,13 +52,45 @@ def bce_loss(preds, targets):
     return jnp.mean(bce_per_sample(preds, targets))
 
 
-def _weighted_f1(y_true: np.ndarray, preds) -> float:
-    """Per-epoch validation weighted F1 (``amg_test.py:264``,
-    ``deam_classifier.py:137-138``)."""
-    from sklearn.metrics import f1_score
+def weighted_f1_in_graph(preds, targets_onehot):
+    """``sklearn.f1_score(average='weighted', zero_division=0)`` on argmax
+    predictions, computed in-graph over the fixed class axis so per-epoch
+    validation never forces a host readback (the reference's per-epoch F1,
+    ``amg_test.py:264`` / ``deam_classifier.py:137-138``, runs on host —
+    here it rides the epoch jit; sklearn parity is pinned by
+    ``tests/test_cnn_trainer.py::test_weighted_f1_in_graph_matches_sklearn``)."""
+    c = targets_onehot.shape[-1]
+    pred_oh = jax.nn.one_hot(jnp.argmax(preds, axis=-1), c,
+                             dtype=targets_onehot.dtype)
+    tp = jnp.sum(targets_onehot * pred_oh, axis=0)
+    pred_n = jnp.sum(pred_oh, axis=0)
+    true_n = jnp.sum(targets_onehot, axis=0)
+    precision = jnp.where(pred_n > 0, tp / jnp.maximum(pred_n, 1.0), 0.0)
+    recall = jnp.where(true_n > 0, tp / jnp.maximum(true_n, 1.0), 0.0)
+    pr = precision + recall
+    f1 = jnp.where(pr > 0, 2.0 * precision * recall / jnp.maximum(pr, 1e-30),
+                   0.0)
+    return jnp.sum(true_n * f1) / jnp.maximum(jnp.sum(true_n), 1.0)
 
-    return float(f1_score(y_true, np.asarray(preds).argmax(axis=1),
-                          average="weighted", zero_division=0))
+
+_HISTORY_DEVICE_KEYS = ("train_loss", "val_loss", "val_f1", "improved")
+
+
+def _materialize_history(history: list[dict]) -> list[dict]:
+    """Resolve deferred device scalars in epoch-info dicts to Python values
+    in ONE bulk transfer.  ``fit``/``fit_many`` queue the whole optimizer
+    schedule asynchronously and only sync here (or per epoch when a caller
+    passed a ``callback``)."""
+    pending = [h for h in history if not isinstance(h["train_loss"], float)]
+    if pending:
+        vals = jax.device_get(
+            [tuple(h[k] for k in _HISTORY_DEVICE_KEYS) for h in pending])
+        for h, v in zip(pending, vals):
+            h["train_loss"] = float(v[0])
+            h["val_loss"] = float(v[1])
+            h["val_f1"] = float(v[2])
+            h["improved"] = bool(v[3])
+    return history
 
 
 def make_tx(phase: str, cfg: TrainConfig) -> optax.GradientTransformation:
@@ -157,6 +189,7 @@ class CNNTrainer:
             preds = model.apply({"params": params, "batch_stats": batch_stats},
                                 xt, train=False)
             val_loss = bce_loss(preds, test_y)
+            val_f1 = weighted_f1_in_graph(preds, test_y)
 
             # best-checkpoint update on device: score = 1 - val_loss
             # (amg_test.py:267-273).
@@ -170,7 +203,8 @@ class CNNTrainer:
                 batch_stats, best_stats)
             best_score = jnp.where(improved, score, best_score)
             return (params, batch_stats, opt_state, best_params, best_stats,
-                    best_score, jnp.mean(losses), val_loss, preds, improved)
+                    best_score, jnp.mean(losses), val_loss, val_f1, preds,
+                    improved)
 
         return epoch
 
@@ -206,22 +240,37 @@ class CNNTrainer:
         epoch = self._build_epoch(phase, n_train, n_test, batch_size)
         # args: params, stats, opt, best_p, best_s, best_score are
         # member-stacked; data, lengths, rows, y broadcast; key per member.
-        vmapped = jax.vmap(
-            epoch,
-            in_axes=(0, 0, 0, 0, 0, 0, None, None, None, None, None, None, 0))
         if mesh is None:
-            fn = jax.jit(vmapped, donate_argnums=(0, 1, 2, 3, 4))
+            # Single chip: run members as a lax.map, not vmap — vmapping
+            # convs over batched kernels lowers to feature-group convs the
+            # TPU runs measurably slower (fwd+bwd at bench geometry:
+            # 60.6 ms vmapped vs 51.1 ms mapped; identical math).  On a
+            # member-sharded mesh the vmap IS the cross-chip parallelism,
+            # so that branch keeps it.
+            def mapped(params, stats, opt, best_p, best_s, best_score,
+                       data, lengths, train_rows, train_y, test_rows,
+                       test_y, keys):
+                return jax.lax.map(
+                    lambda ms: epoch(*ms[:6], data, lengths, train_rows,
+                                     train_y, test_rows, test_y, ms[6]),
+                    (params, stats, opt, best_p, best_s, best_score, keys))
+
+            fn = jax.jit(mapped, donate_argnums=(0, 1, 2, 3, 4))
         else:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from consensus_entropy_tpu.parallel.mesh import MEMBER_AXIS
 
+            vmapped = jax.vmap(
+                epoch,
+                in_axes=(0, 0, 0, 0, 0, 0, None, None, None, None, None,
+                         None, 0))
             member = NamedSharding(mesh, P(MEMBER_AXIS))
             repl = NamedSharding(mesh, P())
             fn = jax.jit(
                 vmapped,
                 in_shardings=(member,) * 6 + (repl,) * 6 + (member,),
-                out_shardings=(member,) * 6 + (member,) * 4,
+                out_shardings=(member,) * 6 + (member,) * 5,
                 donate_argnums=(0, 1, 2, 3, 4))
         self._epoch_fns[key_] = fn
         return fn
@@ -268,7 +317,6 @@ class CNNTrainer:
         test_rows = jnp.asarray(store.row_of(test_ids))
         train_y = jnp.asarray(train_y)
         test_y = jnp.asarray(test_y)
-        y_true_np = np.asarray(test_y).argmax(axis=1)
 
         params = variables["params"]
         batch_stats = variables["batch_stats"]
@@ -294,18 +342,20 @@ class CNNTrainer:
             state["key"], sub = jax.random.split(state["key"])
             (state["params"], state["batch_stats"], state["opt_state"],
              state["best_params"], state["best_stats"], state["best_score"],
-             train_loss, val_loss, preds, improved) = fn(
+             train_loss, val_loss, val_f1, preds, improved) = fn(
                 state["params"], state["batch_stats"], state["opt_state"],
                 state["best_params"], state["best_stats"],
                 state["best_score"], store.data, store.lengths, train_rows,
                 train_y, test_rows, test_y, sub)
-            info = {"epoch": epoch, "phase": phase,
-                    "train_loss": float(train_loss),
-                    "val_loss": float(val_loss),
-                    "val_f1": _weighted_f1(y_true_np, preds),
-                    "improved": bool(improved)}
+            # history holds DEVICE scalars until the end of the schedule —
+            # per-epoch float() would block the dispatch pipeline (a full
+            # host sync per epoch; the retrain hot loop runs 100 of them)
+            info = {"epoch": epoch, "phase": phase, "train_loss": train_loss,
+                    "val_loss": val_loss, "val_f1": val_f1,
+                    "improved": improved}
             history.append(info)
             if callback is not None:
+                _materialize_history([info])
                 callback(epoch, info, np.asarray(preds))
 
         def reload_best(phase):
@@ -317,7 +367,8 @@ class CNNTrainer:
 
         self._run_schedule(n_epochs, adam_patience, run_epoch, reload_best)
         return ({"params": state["best_params"],
-                 "batch_stats": state["best_stats"]}, history)
+                 "batch_stats": state["best_stats"]},
+                _materialize_history(history))
 
     def fit_many(self, variables_list, store: DeviceWaveformStore, train_ids,
                  train_y, test_ids, test_y, key, *, n_epochs: int | None = None,
@@ -333,7 +384,11 @@ class CNNTrainer:
         M independent loops.  Member ``i`` trains under
         ``jax.random.fold_in(key, i)``, the same stream the sequential
         committee path used.  With ``mesh`` (a ``(dp, member)`` training
-        mesh), member state is sharded across chips on the ``member`` axis.
+        mesh), member state is sharded across chips on the ``member`` axis;
+        a committee that doesn't divide the axis is padded with copies of
+        the last member (trained redundantly, never returned), so the
+        reference's 5-member committee runs unchanged on 4- or 8-wide
+        meshes.
 
         Returns ``(best_variables_list, histories)`` with per-member
         histories in ``fit``'s format.  ``callback(epoch, infos)`` gets the
@@ -351,20 +406,55 @@ class CNNTrainer:
         test_rows = jnp.asarray(store.row_of(test_ids))
         train_y = jnp.asarray(train_y)
         test_y = jnp.asarray(test_y)
-        y_true_np = np.asarray(test_y).argmax(axis=1)
 
-        stacked = stack_params(variables_list)
+        # A sharded member axis must divide the mesh's member dimension: pad
+        # the committee by repeating the last member (trained redundantly,
+        # sliced off below) so e.g. 5 reference members run on a 4- or
+        # 8-wide member axis.  Padded slots get distinct key streams but
+        # never surface in the returned best/histories.
+        n_total = n_members
+        if mesh is not None:
+            from consensus_entropy_tpu.parallel.mesh import MEMBER_AXIS
+
+            shards = mesh.shape[MEMBER_AXIS]
+            n_total = -(-n_members // shards) * shards
+        padded = list(variables_list) + \
+            [variables_list[-1]] * (n_total - n_members)
+
+        stacked = stack_params(padded)
         params = stacked["params"]
         batch_stats = stacked["batch_stats"]
         best_params = jax.tree.map(jnp.copy, params)
         best_stats = jax.tree.map(jnp.copy, batch_stats)
         # per-member best gate, same 0-init parity as ``fit``
-        best_score = jnp.zeros(n_members)
+        best_score = jnp.zeros(n_total)
         keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
-            jnp.arange(n_members))
+            jnp.arange(n_total))
 
         opt_state = jax.vmap(make_tx(PHASES[0], cfg).init)(params)
-        histories = [[] for _ in range(n_members)]
+
+        member_sh = None
+        if mesh is not None:
+            # COMMIT the member-stacked state to the member sharding up
+            # front: incoming variables may carry other committed shardings
+            # (e.g. replicated slices of a previous retrain's best params),
+            # and jit raises on a committed-sharding/in_shardings mismatch
+            # rather than resharding.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from consensus_entropy_tpu.parallel.mesh import MEMBER_AXIS
+
+            member_sh = NamedSharding(mesh, P(MEMBER_AXIS))
+            (params, batch_stats, opt_state, best_params, best_stats,
+             best_score, keys) = jax.device_put(
+                (params, batch_stats, opt_state, best_params, best_stats,
+                 best_score, keys), member_sh)
+        #: (epoch, phase, train_loss, val_loss, val_f1, improved) with the
+        #: metric entries as DEVICE member-vectors — the whole schedule is
+        #: queued asynchronously and synced in one bulk transfer at the end
+        #: (per-epoch np.asarray here was the retrain path's pipeline stall:
+        #: a blocking readback × n_epochs)
+        records: list[tuple] = []
         state = {"params": params, "batch_stats": batch_stats,
                  "opt_state": opt_state, "best_params": best_params,
                  "best_stats": best_stats, "best_score": best_score,
@@ -377,35 +467,40 @@ class CNNTrainer:
             state["keys"], subs = splits[:, 0], splits[:, 1]
             (state["params"], state["batch_stats"], state["opt_state"],
              state["best_params"], state["best_stats"], state["best_score"],
-             train_loss, val_loss, preds, improved) = fn(
+             train_loss, val_loss, val_f1, _preds, improved) = fn(
                 state["params"], state["batch_stats"], state["opt_state"],
                 state["best_params"], state["best_stats"],
                 state["best_score"], store.data, store.lengths, train_rows,
                 train_y, test_rows, test_y, subs)
-            train_loss = np.asarray(train_loss)
-            val_loss = np.asarray(val_loss)
-            improved = np.asarray(improved)
-            preds = np.asarray(preds)
-            infos = []
-            for m in range(n_members):
-                info = {"epoch": epoch, "phase": phase,
-                        "train_loss": float(train_loss[m]),
-                        "val_loss": float(val_loss[m]),
-                        "val_f1": _weighted_f1(y_true_np, preds[m]),
-                        "improved": bool(improved[m])}
-                histories[m].append(info)
-                infos.append(info)
+            records.append((epoch, phase, train_loss, val_loss, val_f1,
+                            improved))
             if callback is not None:
-                callback(epoch, infos)
+                tl, vl, f1, imp = jax.device_get(
+                    (train_loss, val_loss, val_f1, improved))
+                callback(epoch, [
+                    {"epoch": epoch, "phase": phase,
+                     "train_loss": float(tl[m]), "val_loss": float(vl[m]),
+                     "val_f1": float(f1[m]), "improved": bool(imp[m])}
+                    for m in range(n_members)])
 
         def reload_best(phase):
             state["params"] = jax.tree.map(jnp.copy, state["best_params"])
             state["batch_stats"] = jax.tree.map(jnp.copy,
                                                 state["best_stats"])
-            state["opt_state"] = jax.vmap(make_tx(phase, cfg).init)(
-                state["params"])
+            opt = jax.vmap(make_tx(phase, cfg).init)(state["params"])
+            if member_sh is not None:
+                opt = jax.device_put(opt, member_sh)
+            state["opt_state"] = opt
 
         self._run_schedule(n_epochs, adam_patience, run_epoch, reload_best)
+        histories = [[] for _ in range(n_members)]
+        metric_vals = jax.device_get([r[2:] for r in records])
+        for (epoch, phase, *_), (tl, vl, f1, imp) in zip(records, metric_vals):
+            for m in range(n_members):
+                histories[m].append(
+                    {"epoch": epoch, "phase": phase,
+                     "train_loss": float(tl[m]), "val_loss": float(vl[m]),
+                     "val_f1": float(f1[m]), "improved": bool(imp[m])})
         best = [{"params": jax.tree.map(lambda a, m=m: a[m],
                                         state["best_params"]),
                  "batch_stats": jax.tree.map(lambda a, m=m: a[m],

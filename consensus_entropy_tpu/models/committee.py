@@ -173,6 +173,16 @@ class Committee:
     shard-divisible width (repeating the last crop) and sliced back, so the
     random-crop stream and the returned probabilities are identical to the
     single-device path.
+
+    ``train_mesh``: optional ``(dp, member)`` :class:`jax.sharding.Mesh` for
+    *retraining* (``parallel.mesh.make_training_mesh``).  When set,
+    :meth:`retrain_cnns` shards the member-stacked training state across the
+    ``member`` axis, so the AL iteration's dominant cost (the reference's
+    100-epoch per-member retrain, ``amg_test.py:496-502``) splits across
+    chips; a non-dividing committee is member-padded inside
+    ``CNNTrainer.fit_many``.  Single-process meshes only (multi-host
+    retraining would need globally-fed member state — the scoring path's
+    ``_feed_repl`` — and is deliberately not wired).
     """
 
     def __init__(self, host_members: list[Member],
@@ -181,7 +191,7 @@ class Committee:
                  train_config: TrainConfig = TrainConfig(),
                  *, device_members: bool = False,
                  full_song_hop: int | None = None,
-                 mesh=None):
+                 mesh=None, train_mesh=None):
         self.host_members = host_members
         self.cnn_members = cnn_members
         if cnn_members:
@@ -216,6 +226,7 @@ class Committee:
         self.full_song_hop = full_song_hop
         self.trainer = CNNTrainer(config, train_config)
         self.mesh = mesh
+        self.train_mesh = train_mesh
         #: compiled sequence-parallel scorers keyed by (geometry, mesh);
         #: never invalidated — safe because scorers take the stacked member
         #: params as an argument, so retraining needs no cache flush
@@ -410,6 +421,15 @@ class Committee:
         (static) pool features are cached ON the pool object, so their
         lifetime is the pool's (no id-reuse aliasing) and the per-iteration
         cost is just the few-KB parameter transfer.
+
+        Deliberate static-graph trade: this path scores the FULL pool every
+        iteration and column-slices the live songs after, while the host
+        path scores live rows only.  Under XLA's static shapes a live-row
+        variant would either recompile per pool width (10 compiles/user) or
+        gather rows into a fixed-width buffer (same FLOPs as scoring them).
+        The whole-table cost is ~1.4 ms at the 100k benchmark scale and
+        microseconds at AMG scale — the "wasted" late-iteration math is
+        cheaper than either alternative, so the fixed shape wins.
         """
         from consensus_entropy_tpu.ops.device_members import (
             make_device_committee_scorer,
@@ -459,12 +479,15 @@ class Committee:
 
         All members train in lockstep as ONE vmapped jit per epoch
         (``CNNTrainer.fit_many``) — the schedule is epoch-indexed, so this
-        is exact, and retrain wall-clock stops scaling linearly in M."""
+        is exact, and retrain wall-clock stops scaling linearly in M.  With
+        ``train_mesh`` set the member-stacked state is additionally sharded
+        across chips on the ``member`` axis."""
         best, histories = self.trainer.fit_many(
             [m.variables for m in self.cnn_members], store, train_ids,
             train_y, test_ids, test_y, key,
             n_epochs=(self.trainer.train_config.n_epochs_retrain
-                      if n_epochs is None else n_epochs))
+                      if n_epochs is None else n_epochs),
+            mesh=self.train_mesh)
         for m, b in zip(self.cnn_members, best):
             m.variables = b
         return histories
@@ -537,6 +560,15 @@ class Committee:
 
         if not self.cnn_members:
             raise ValueError("committee has no CNN members to score with")
+        if jax.process_count() > 1:
+            # the seq scorers take host-local stacked params / padded waves;
+            # multi-host would need global feeds (_feed_repl + a seq-axis
+            # feed) that are deliberately not wired — fail loud rather than
+            # crash inside jit with a resharding error
+            raise NotImplementedError(
+                "predict_song_sequence is single-host-only (shard long "
+                "audio over one host's chips; multi-host pools use "
+                "predict_songs_cnn)")
         wave = np.asarray(wave, np.float32)
         plan = plan_windows(wave.shape[0], seq_mesh.shape[SEQ_AXIS],
                             window=self.config.input_length,

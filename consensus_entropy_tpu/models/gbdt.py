@@ -43,9 +43,10 @@ class QuantileBinner:
 
     ``fit`` computes up to ``n_bins − 1`` interior edges per feature from the
     pre-training data; ``transform`` maps a value to the count of edges
-    strictly below-or-equal (``searchsorted`` left on right-open intervals),
-    so codes are monotone in the raw value and a tree split ``bin <= t``
-    equals a raw-value threshold.
+    strictly below it (``searchsorted`` side='left': a raw value exactly
+    equal to an edge lands in the LOWER bin, i.e. bins are left-open /
+    right-closed ``(lo, hi]``), so codes are monotone in the raw value and a
+    tree split ``bin <= t`` equals a raw-value threshold.
     """
 
     def __init__(self, n_bins: int = 256):

@@ -290,5 +290,16 @@ def num_members(stacked) -> int:
 
 
 def committee_infer(stacked_variables, x, config: CNNConfig = CNNConfig()):
-    """All members score the same crops: ``(M, B, C)`` sigmoid outputs."""
-    return jax.vmap(lambda v: apply_infer(v, x, config))(stacked_variables)
+    """All members score the same crops: ``(M, B, C)`` sigmoid outputs.
+
+    ``lax.map`` over the member axis, NOT ``vmap``: vmapping convolutions
+    over a batched *kernel* lowers to feature-group convs, which the TPU
+    runs ~2.5x slower than the same math as per-member dense convs
+    (measured at the bench geometry, 5 members x 48 reference crops:
+    41.2 ms vmapped vs 16.0 ms mapped — identical outputs; the per-member
+    fwd is HBM-bound, so sequencing members costs nothing on one chip).
+    Under a pool-sharded mesh the map body is itself SPMD over the crop
+    axis, so multi-chip scoring keeps working unchanged.
+    """
+    return jax.lax.map(lambda v: apply_infer(v, x, config),
+                       stacked_variables)

@@ -126,13 +126,18 @@ def main(argv=None) -> int:
                                 "models/pretrained/classifier_cnn.it_0.msgpack)")
     ap.add_argument("--name", default=None,
                     help="member name (default: derived from dst)")
+    ap.add_argument("--cnn-config-json", default=None, metavar="JSON",
+                    help="CNNConfig field overrides as a JSON object, for "
+                         "checkpoints trained at non-default geometry "
+                         "(n_channels, n_mels, n_fft, ...)")
     args = ap.parse_args(argv)
     # conversion is pure host array shuffling — never touch an accelerator
     configure_device("cpu")
 
+    from consensus_entropy_tpu.cli.common import resolve_cnn_config
     from consensus_entropy_tpu.models.committee import CNNMember
 
-    config = CNNConfig()
+    config = resolve_cnn_config(args.cnn_config_json)
     variables = import_torch_shortchunk(args.src, config)
     import os
 

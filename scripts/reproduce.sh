@@ -13,7 +13,29 @@
 # The paper's experiment constants can be overridden via env for smoke runs:
 #   CV (5-fold), QUERIES (q=10), EPOCHS (10 AL iterations), NUM_ANNO (150),
 #   MODELS_LIST, MODES, EXTRA (extra amg_test flags, e.g. "--max-users 2").
+# `--smoke` (as the only argument) proves the FULL pipeline from a pristine
+# tree: it generates a synthetic DEAM+AMG layout in a temp dir (the same
+# builder the CLI integration tests use) and runs pre-train + all-mode AL
+# with tiny budgets on cpu.  Takes ~2 minutes; exits nonzero on any failure.
 set -euo pipefail
+
+if [ "${1:-}" = "--smoke" ]; then
+  SMOKE_ROOT="$(mktemp -d)"
+  trap 'rm -rf "$SMOKE_ROOT"' EXIT
+  REPO="$(cd "$(dirname "$0")/.." && pwd)"
+  PYTHONPATH="$REPO/tests${PYTHONPATH:+:$PYTHONPATH}" python - "$SMOKE_ROOT" <<'PYEOF'
+import sys
+import numpy as np
+from synth_data import build_synth_roots
+from pathlib import Path
+roots = build_synth_roots(Path(sys.argv[1]), np.random.default_rng(0))
+print(f"synthetic tree: deam={roots['deam']} amg={roots['amg']}")
+PYEOF
+  CV=2 QUERIES=2 EPOCHS=2 NUM_ANNO=4 MODELS_LIST="gnb sgd" \
+    MODES="mc rand" EXTRA="--max-users 1" \
+    "$0" "$SMOKE_ROOT/models" "$SMOKE_ROOT/deam" "$SMOKE_ROOT/amg1608" cpu
+  exit $?
+fi
 
 MODELS="${1:-./models}"
 DEAM="${2:-./data/deam}"

@@ -8,6 +8,32 @@ from scipy.io import savemat
 FEATURE_COLS = (["F0final_sma_stddev"] + [f"f{i}" for i in range(6)]
                 + ["mfcc_sma_de[14]_amean"])
 
+#: the newer openSMILE column vintage: the mfcc block carries a
+#: ``pcm_fftMag_`` prefix (the real AMG1608 CSVs ship this layout; the
+#: loaders dispatch on whichever stop column is present)
+FEATURE_COLS_FFTMAG = (["F0final_sma_stddev"] + [f"f{i}" for i in range(6)]
+                       + ["pcm_fftMag_mfcc_sma_de[14]_amean"])
+
+
+def amg_dataset_frame(rng, *, n_songs: int = 1608, n_frames=(4, 8),
+                      feature_cols=None) -> pd.DataFrame:
+    """A real-shape AMG dataset cache table (the ``dataset_feats.csv`` the
+    reference assembles, ``amg_test.py:57-60,128-144``): ``n_songs`` songs
+    (default the true AMG1608 count) x several frames each, feature columns
+    in either openSMILE vintage."""
+    cols = FEATURE_COLS if feature_cols is None else feature_cols
+    centers = rng.standard_normal((4, len(cols))) * 3.0
+    rows, sids = [], []
+    for i in range(n_songs):
+        sid = 201 + i
+        c = int(rng.integers(0, 4))
+        k = int(rng.integers(*n_frames))
+        rows.append(centers[c] + rng.standard_normal((k, len(cols))))
+        sids += [sid] * k
+    df = pd.DataFrame(np.vstack(rows).astype(np.float32), columns=cols)
+    df.insert(0, "s_id", sids)
+    return df
+
 
 def build_synth_roots(tmp_path, rng) -> dict:
     """Class-separable synthetic DEAM + AMG1608 trees under ``tmp_path``."""

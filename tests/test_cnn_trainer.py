@@ -269,3 +269,26 @@ def test_history_records_val_f1_per_epoch(rng):
         store, ids, y, ids, y, jax.random.key(2), n_epochs=2)
     for h in hists:
         assert all(0.0 <= e["val_f1"] <= 1.0 for e in h)
+
+
+def test_weighted_f1_in_graph_matches_sklearn():
+    """In-graph validation F1 == sklearn f1_score(average='weighted',
+    zero_division=0), including all-wrong/missing-class corners (the
+    deferred-history refactor moved the reference's host-side per-epoch F1
+    — amg_test.py:264 — into the epoch jit)."""
+    import jax.numpy as jnp
+    from sklearn.metrics import f1_score
+
+    from consensus_entropy_tpu.models.cnn_trainer import weighted_f1_in_graph
+
+    rng = np.random.default_rng(0)
+    cases = [rng.integers(0, 4, 50) for _ in range(3)]
+    cases.append(np.zeros(10, np.int64))        # single-class truth
+    cases.append(np.full(10, 3, np.int64))      # never-predicted classes
+    for y_true in cases:
+        probs = rng.random((len(y_true), 4)).astype(np.float32)
+        want = f1_score(y_true, probs.argmax(axis=1), average="weighted",
+                        zero_division=0)
+        got = float(weighted_f1_in_graph(jnp.asarray(probs),
+                                         jnp.asarray(one_hot_np(y_true))))
+        np.testing.assert_allclose(got, want, rtol=1e-6)

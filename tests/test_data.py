@@ -171,3 +171,27 @@ def test_deam_training_arrays(deam_fixture):
     assert X.shape[0] == len(df) == len(y) == len(sids)
     assert X.shape[1] == 4  # the feature slice
     np.testing.assert_allclose(X.mean(axis=0), 0.0, atol=1e-4)
+
+
+def test_load_feature_pool_real_amg_shape_fftmag(tmp_path):
+    """The real AMG1608 cache: 1608 songs and the newer openSMILE column
+    vintage (mfcc block prefixed ``pcm_fftMag_``).  The loader must dispatch
+    on whichever stop column is present, exactly as the DEAM side does
+    (``amg_test.py:57-64`` reads the same table)."""
+    from tests.synth_data import FEATURE_COLS_FFTMAG, amg_dataset_frame
+
+    rng = np.random.default_rng(5)
+    df = amg_dataset_frame(rng, n_songs=1608,
+                           feature_cols=FEATURE_COLS_FFTMAG)
+    csv = tmp_path / "dataset_feats.csv"
+    df.to_csv(csv, sep=";", index=False)
+    pool = amg.load_feature_pool(str(csv))
+    assert pool.n_songs == 1608
+    assert pool.X.shape == (len(df), len(FEATURE_COLS_FFTMAG))
+    # full-pool scaling applied (amg_test.py:64)
+    np.testing.assert_allclose(pool.X.mean(axis=0), 0.0, atol=1e-4)
+    # unknown column layouts fail loud, not silently empty
+    bad = df.rename(columns={"pcm_fftMag_mfcc_sma_de[14]_amean": "oops"})
+    bad.to_csv(tmp_path / "bad.csv", sep=";", index=False)
+    with pytest.raises(ValueError, match="unrecognized feature columns"):
+        amg.load_feature_pool(str(tmp_path / "bad.csv"))

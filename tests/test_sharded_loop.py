@@ -58,8 +58,8 @@ def _host_members(seed=7, f=10):
     return [GNBMember().fit(X, y), SGDMember(seed=0).fit(X, y)]
 
 
-def _run(path, mode, *, mesh=None, pad_to=None, cnn=False, n_songs=24,
-         epochs=3, queries=4):
+def _run(path, mode, *, mesh=None, train_mesh=None, pad_to=None, cnn=False,
+         n_songs=24, epochs=3, queries=4):
     path.mkdir(parents=True, exist_ok=True)
     data = _user_data(3, n_songs=n_songs, waves=cnn)
     cnns = []
@@ -69,7 +69,7 @@ def _run(path, mode, *, mesh=None, pad_to=None, cnn=False, n_songs=24,
                           TINY)
                 for i in range(2)]
     com = Committee(_host_members(), cnns, TINY, TrainConfig(batch_size=2),
-                    mesh=mesh)
+                    mesh=mesh, train_mesh=train_mesh)
     loop = ALLoop(ALConfig(queries=queries, epochs=epochs, mode=mode,
                            seed=11),
                   mesh=mesh, pad_pool_to=pad_to,
@@ -94,6 +94,22 @@ def test_sharded_cnn_loop_matches_single_device(tmp_path):
                        n_songs=10, epochs=2, queries=3)
     assert q_a == q_b
     assert traj_a == traj_b
+
+
+def test_member_sharded_retrain_loop_matches_single_device(tmp_path):
+    """Production retrain through a (dp=1, member=8) training mesh: the
+    2-member committee is padded to 8 member slots inside fit_many, each
+    chip trains one slot, and the full AL trajectory matches the
+    single-device run (reference hot loop #2, amg_test.py:496-502)."""
+    from consensus_entropy_tpu.parallel.mesh import make_training_mesh
+
+    traj_a, q_a = _run(tmp_path / "a", "mc", cnn=True, n_songs=10, epochs=2,
+                       queries=3)
+    traj_b, q_b = _run(tmp_path / "b", "mc", cnn=True, n_songs=10, epochs=2,
+                       queries=3,
+                       train_mesh=make_training_mesh(dp=1, member=8))
+    assert q_a == q_b
+    np.testing.assert_allclose(traj_a, traj_b, rtol=1e-5)
 
 
 def test_pad_pool_to_does_not_change_selection(tmp_path):
