@@ -479,11 +479,22 @@ def run_cnn_suite(args_ns) -> int:
 
 
 def run_retrain_suite(args_ns) -> int:
-    """Committee CNN retraining: ONE vmapped jit per epoch for all M members
+    """Committee CNN retraining: ONE lockstep jit per epoch for all M members
     (``CNNTrainer.fit_many``) vs the sequential per-member loop the reference
-    runs (``amg_test.py:496-502``, hot loop #2).  Reports the vmapped
-    per-epoch latency; ``vs_baseline`` is sequential/vmapped total wall-clock
-    — the factor by which per-iteration retraining stops scaling in M."""
+    runs (``amg_test.py:496-502``, hot loop #2).  Reports the lockstep
+    per-epoch latency; ``vs_baseline`` is sequential/lockstep total wall-clock
+    — the factor by which per-iteration retraining stops scaling in M.
+
+    Also races mixed-precision training (``compute_dtype='bfloat16'``: bf16
+    convs, f32 params/optimizer/loss) against f32 in the SAME process —
+    absolute timings on the tunneled chip drift run-to-run, so only the
+    in-process ratio is meaningful.  bf16 becomes the headline only when its
+    training trajectory stays sane (finite, train loss decreasing); the
+    QUALITY equivalence gate on a separable task lives in
+    ``tests/test_cnn_trainer.py::test_bf16_training_quality_parity``.
+    """
+    import dataclasses
+
     import jax
 
     from consensus_entropy_tpu.config import CNNConfig, TrainConfig
@@ -529,15 +540,42 @@ def run_retrain_suite(args_ns) -> int:
          f"({seq_s / n_epochs / n_members * 1e3:.1f} ms/member-epoch)")
 
     t0 = time.perf_counter()
-    trainer.fit_many(copies(), store, train_ids, y_tr, test_ids, y_te, key,
-                     n_epochs=n_epochs)
+    _, hist32 = trainer.fit_many(copies(), store, train_ids, y_tr, test_ids,
+                                 y_te, key, n_epochs=n_epochs)
     vmap_s = time.perf_counter() - t0
     ms_epoch = vmap_s / n_epochs * 1e3
-    _log(f"[vmapped] one lockstep loop: {vmap_s * 1e3:.0f} ms "
+    _log(f"[lockstep f32] one loop: {vmap_s * 1e3:.0f} ms "
          f"({ms_epoch:.1f} ms/epoch for all {n_members} members)")
+
+    # race mixed-precision training (params/opt stay f32; convs in bf16)
+    bf16_cfg = dataclasses.replace(config, compute_dtype="bfloat16")
+    bf16_trainer = CNNTrainer(bf16_cfg, TrainConfig())
+    bf16_trainer.fit_many(copies(), store, train_ids, y_tr, test_ids, y_te,
+                          key, n_epochs=1)  # warm-up
+    t0 = time.perf_counter()
+    _, hist16 = bf16_trainer.fit_many(copies(), store, train_ids, y_tr,
+                                      test_ids, y_te, key, n_epochs=n_epochs)
+    bf16_s = time.perf_counter() - t0
+    bf16_ms = bf16_s / n_epochs * 1e3
+    l32 = np.array([h[-1]["train_loss"] for h in hist32])
+    l16 = np.array([h[-1]["train_loss"] for h in hist16])
+    sane = (np.all(np.isfinite(l16))
+            and np.mean(l16) <= np.mean(
+                [h[0]["train_loss"] for h in hist16]))
+    _log(f"[lockstep bf16] {bf16_s * 1e3:.0f} ms ({bf16_ms:.1f} ms/epoch); "
+         f"final train loss f32 {np.mean(l32):.4f} vs bf16 "
+         f"{np.mean(l16):.4f}; trajectory sane: {sane}")
+    dtype = "float32"
+    if bf16_ms < ms_epoch and sane:
+        _log(f"[bf16] wins ({bf16_ms:.1f} vs {ms_epoch:.1f} ms/epoch, "
+             f"{ms_epoch / bf16_ms:.2f}x)")
+        ms_epoch = bf16_ms
+        vmap_s = bf16_s
+        dtype = "bfloat16"
 
     print(json.dumps({
         "metric": f"cnn_committee_retrain_epoch_{n_members}m_q{q}",
+        "dtype": dtype,
         "value": round(ms_epoch, 3),
         "unit": "ms",
         "vs_baseline": round(seq_s / vmap_s, 2),

@@ -34,11 +34,26 @@ from typing import Sequence
 import numpy as np
 
 from consensus_entropy_tpu.al.loop import ALLoop, UserData
-from consensus_entropy_tpu.config import ALConfig
+from consensus_entropy_tpu.config import ALConfig, CNNConfig, TrainConfig
 from consensus_entropy_tpu.models.committee import Committee, FramePool
 from consensus_entropy_tpu.models.sklearn_members import GNBMember
 
 MODES = ("mc", "hc", "mix", "rand")
+
+#: tiny CNN geometry for the --cnn-members committee species (fast enough
+#: for a CPU sweep; same trunk/trainer as production).  Pretraining runs
+#: hot (1e-3, few epochs); retraining inside the AL loop uses the
+#: reference's 1e-4 (``settings.py`` lr parity) — a hot retrain lr on
+#: entropy-concentrated 5-song batches measurably corrupts weak members.
+CNN_CFG = CNNConfig(n_channels=4, n_fft=256, hop_length=128, n_mels=16,
+                    n_layers=3, input_length=2048)
+CNN_PRETRAIN = TrainConfig(batch_size=4, lr=1e-3)
+CNN_RETRAIN = TrainConfig(batch_size=4)  # reference lr=1e-4
+
+#: per-class tone frequencies for the synthetic waveforms — the confusable
+#: pair (classes 2/3) sits a near-semitone apart, mirroring the feature
+#: geometry's ``hard_delta``
+TONE_FREQS = (220.0, 440.0, 800.0, 872.0)
 
 #: class priors — the confusable pair (classes 2/3) is rare, so random
 #: acquisition spends ~70% of its budget on the easy majority classes
@@ -51,7 +66,8 @@ PRETRAIN_SONGS = {0: 3, 1: 3, 2: 1, 3: 1}
 
 def make_user(seed: int, *, n_songs: int = 250, n_feat: int = 12,
               sep: float = 3.0, hard_delta: float = 0.9, off: float = 0.5,
-              noise: float = 0.7, tau: float = 1.0) -> UserData:
+              noise: float = 0.7, tau: float = 1.0,
+              waves: bool = False) -> UserData:
     """One synthetic user: two easy, abundant classes plus a rare
     *confusable pair* (class 3's center sits ``hard_delta`` from class 2's).
 
@@ -90,11 +106,26 @@ def make_user(seed: int, *, n_songs: int = 250, n_feat: int = 12,
     order = {s: j for j, s in enumerate(f"song{i:04d}"
                                         for i in range(n_songs))}
     hc = hc[[order[s] for s in pool.song_ids]]
-    return UserData(f"seed{seed}", pool, labels, hc_rows=hc)
+    store = None
+    if waves:
+        # class-dependent tones (hard pair near-adjacent in pitch) so CNN
+        # members face the same ambiguity structure as the feature members
+        from consensus_entropy_tpu.data.audio import DeviceWaveformStore
+
+        wave_dict = {}
+        for i, c in enumerate(classes):
+            n = CNN_CFG.input_length + int(rng.integers(200, 1200))
+            t = np.arange(n) / CNN_CFG.sample_rate
+            f = TONE_FREQS[c] * (1.0 + 0.01 * rng.standard_normal())
+            wave_dict[f"song{i:04d}"] = (
+                np.sin(2 * np.pi * f * t)
+                + 0.3 * rng.standard_normal(n)).astype(np.float32)
+        store = DeviceWaveformStore(wave_dict, CNN_CFG.input_length)
+    return UserData(f"seed{seed}", pool, labels, hc_rows=hc, store=store)
 
 
-def make_committee(seed: int, data: UserData, *, folds: int = 5
-                   ) -> Committee:
+def make_committee(seed: int, data: UserData, *, folds: int = 5,
+                   cnn_members: int = 0) -> Committee:
     """Committee of ``folds`` GNB members, each pretrained on its own random
     song subset (the reference's 5-CV-folds-per-algorithm structure,
     ``deam_classifier.py:318-333``), drawn WITHOUT looking at the AL split
@@ -112,25 +143,58 @@ def make_committee(seed: int, data: UserData, *, folds: int = 5
     for s, c in data.labels.items():
         by_class[c].append(s)
     members = []
+    fold_songs = []
     for f in range(folds):
         X, y = [], []
+        picked = []
         for c, songs in by_class.items():
             for s in rng.permutation(songs)[:PRETRAIN_SONGS[c]]:
                 rows = data.pool.rows_for_songs([s])
                 X.append(data.pool.X[rows])
                 y += [c] * len(rows)
+                picked.append(s)
+        fold_songs.append(picked)
         members.append(
             GNBMember(name=f"gnb{f}").fit(np.vstack(X), np.asarray(y)))
-    return Committee(members, [])
+    cnns = []
+    if cnn_members:
+        # Tiny Flax CNN fold-members briefly pretrained on their fold's
+        # songs — the committee then spans both member species, so this
+        # knob exercises the full CNN scoring/retraining path through the
+        # production loop.  Treat it as a MECHANICAL exercise, not a
+        # stronger statistical claim: members this weak degrade under
+        # entropy-concentrated query batches (measured: mc trails rand
+        # with 10-epoch toy CNNs even at the reference retrain lr), which
+        # is a property of the toy members — the committed evidence
+        # artifact uses the stable GNB committee.
+        import jax
+
+        from consensus_entropy_tpu.labels import one_hot_np
+        from consensus_entropy_tpu.models import short_cnn
+        from consensus_entropy_tpu.models.cnn_trainer import CNNTrainer
+        from consensus_entropy_tpu.models.committee import CNNMember
+
+        trainer = CNNTrainer(CNN_CFG, CNN_PRETRAIN)
+        for f in range(cnn_members):
+            songs = fold_songs[f % folds]
+            y1 = one_hot_np([data.labels[s] for s in songs])
+            variables = short_cnn.init_variables(
+                jax.random.key(seed * 131 + f), CNN_CFG)
+            best, _ = trainer.fit(variables, data.store, songs, y1, songs,
+                                  y1, jax.random.key(seed * 7 + f),
+                                  n_epochs=10)
+            cnns.append(CNNMember(f"cnn{f}", best, CNN_CFG, CNN_RETRAIN))
+    return Committee(members, cnns, CNN_CFG, CNN_RETRAIN)
 
 
 def run_one(seed: int, mode: str, workdir: str, *, queries: int = 5,
-            epochs: int = 8, n_songs: int = 250) -> list[list[float]]:
+            epochs: int = 8, n_songs: int = 250,
+            cnn_members: int = 0) -> list[list[float]]:
     """One (seed, mode) AL run through the production loop; returns the
     per-epoch PER-MEMBER F1 lists from metrics.jsonl (epoch0 baseline
     included)."""
-    data = make_user(seed, n_songs=n_songs)
-    committee = make_committee(seed, data)
+    data = make_user(seed, n_songs=n_songs, waves=cnn_members > 0)
+    committee = make_committee(seed, data, cnn_members=cnn_members)
     path = os.path.join(workdir, f"seed{seed}", mode)
     os.makedirs(path, exist_ok=True)
     metrics = os.path.join(path, "metrics.jsonl")
@@ -139,7 +203,8 @@ def run_one(seed: int, mode: str, workdir: str, *, queries: int = 5,
         # same workdir would silently corrupt the statistics
         os.unlink(metrics)
     cfg = ALConfig(queries=queries, epochs=epochs, mode=mode, seed=seed)
-    ALLoop(cfg).run_user(committee, data, path, resume=False)
+    ALLoop(cfg, retrain_epochs=5 if cnn_members else None).run_user(
+        committee, data, path, resume=False)
     per_epoch = []
     with open(metrics) as fh:
         for line in fh:
@@ -149,7 +214,7 @@ def run_one(seed: int, mode: str, workdir: str, *, queries: int = 5,
 
 def sweep(seeds: Sequence[int], workdir: str, *, modes=MODES,
           queries: int = 5, epochs: int = 8, n_songs: int = 250,
-          log=print) -> dict:
+          cnn_members: int = 0, log=print) -> dict:
     """Matched-budget mode sweep: every mode sees the same user, committee
     state, split, and query budget per seed.  Returns
     ``{mode: {seed: [[member f1 per epoch]]}}``."""
@@ -158,7 +223,8 @@ def sweep(seeds: Sequence[int], workdir: str, *, modes=MODES,
         for mode in modes:
             results[mode][seed] = run_one(seed, mode, workdir,
                                           queries=queries, epochs=epochs,
-                                          n_songs=n_songs)
+                                          n_songs=n_songs,
+                                          cnn_members=cnn_members)
             final = float(np.mean(results[mode][seed][-1]))
             log(f"  seed {seed} {mode:4s}: final mean F1 = {final:.4f}")
     return results

@@ -174,11 +174,11 @@ def main(argv=None) -> int:
         else:
             mesh = make_pool_mesh(devs[:n_dev])
             print(f"Scoring mesh: {n_dev} device(s) on the pool axis")
-        if not args.distributed and store is not None:
+        if store is not None:
             # Retraining dominates the AL iteration wall-clock: give it
             # every meshed chip on the member axis (fit_many pads a
-            # non-dividing committee).  Multi-host retraining would need
-            # globally-fed member state and is deliberately not wired.
+            # non-dividing committee; multi-host runs feed each process's
+            # member block and replicate the winning checkpoints back).
             train_mesh = make_training_mesh(dp=1, member=n_dev,
                                             devices=devs[:n_dev])
             print(f"Training mesh: {n_dev} device(s) on the member axis")

@@ -27,6 +27,14 @@ def build_parser() -> argparse.ArgumentParser:
     sw.add_argument("--queries", type=int, default=5)
     sw.add_argument("--epochs", type=int, default=8)
     sw.add_argument("--songs", type=int, default=250)
+    sw.add_argument("--cnn-members", type=int, default=0,
+                    help="add N tiny Flax CNN fold-members (synthetic tone "
+                         "waveforms) so the sweep exercises the CNN "
+                         "scoring/retraining species too — a mechanical "
+                         "exercise of the full committee mix; members this "
+                         "weak are fragile under entropy-concentrated "
+                         "batches, so don't expect mc>rand here (see "
+                         "al/evidence.py make_committee)")
     sw.add_argument("--modes", default="mc,hc,mix,rand")
     sw.add_argument("--baseline", default="rand",
                     help="control mode for the paired tests; tests are "
@@ -79,7 +87,8 @@ def main(argv=None) -> int:
     try:
         results = evidence.sweep(seeds, workdir, modes=modes,
                                  queries=args.queries, epochs=args.epochs,
-                                 n_songs=args.songs)
+                                 n_songs=args.songs,
+                                 cnn_members=args.cnn_members)
     finally:
         if cleanup is not None:
             cleanup.cleanup()
@@ -92,7 +101,9 @@ def main(argv=None) -> int:
         "experiment": {"seeds": len(seeds), "modes": list(modes),
                        "queries": args.queries, "epochs": args.epochs,
                        "songs": args.songs,
-                       "committee": "5x gnb fold-members",
+                       "committee": ("5x gnb fold-members"
+                                     + (f" + {args.cnn_members}x tiny cnn"
+                                        if args.cnn_members else "")),
                        "reference_row": "paper §4.1 (MC>RAND p=0.0291, "
                                         "d.f.=229)"},
         "trajectories": evidence.trajectories(results),

@@ -267,10 +267,14 @@ class CNNTrainer:
                          None, 0))
             member = NamedSharding(mesh, P(MEMBER_AXIS))
             repl = NamedSharding(mesh, P())
+            # metric outputs come back REPLICATED: they are tiny (M,)
+            # vectors / (M, n_test, C) preds, and replication makes them
+            # host-readable on every process of a multi-host mesh (a
+            # member-sharded output would span non-addressable devices)
             fn = jax.jit(
                 vmapped,
                 in_shardings=(member,) * 6 + (repl,) * 6 + (member,),
-                out_shardings=(member,) * 6 + (member,) * 5,
+                out_shardings=(member,) * 6 + (repl,) * 5,
                 donate_argnums=(0, 1, 2, 3, 4))
         self._epoch_fns[key_] = fn
         return fn
@@ -388,7 +392,9 @@ class CNNTrainer:
         a committee that doesn't divide the axis is padded with copies of
         the last member (trained redundantly, never returned), so the
         reference's 5-member committee runs unchanged on 4- or 8-wide
-        meshes.
+        meshes.  Multi-host meshes are supported: every process holds the
+        identical committee, contributes only its member block, trains in
+        lockstep SPMD, and receives the replicated winning checkpoints.
 
         Returns ``(best_variables_list, histories)`` with per-member
         histories in ``fit``'s format.  ``callback(epoch, infos)`` gets the
@@ -433,7 +439,9 @@ class CNNTrainer:
 
         opt_state = jax.vmap(make_tx(PHASES[0], cfg).init)(params)
 
-        member_sh = None
+        member_sh = repl_sh = None
+        multi_host = False
+        data_arg, lengths_arg = store.data, store.lengths
         if mesh is not None:
             # COMMIT the member-stacked state to the member sharding up
             # front: incoming variables may carry other committed shardings
@@ -445,10 +453,45 @@ class CNNTrainer:
             from consensus_entropy_tpu.parallel.mesh import MEMBER_AXIS
 
             member_sh = NamedSharding(mesh, P(MEMBER_AXIS))
-            (params, batch_stats, opt_state, best_params, best_stats,
-             best_score, keys) = jax.device_put(
+            repl_sh = NamedSharding(mesh, P())
+            multi_host = jax.process_count() > 1
+            if multi_host:
+                # every process holds the identical member-stacked state
+                # (the committee is loaded from the shared workspace in
+                # lockstep); each contributes only its own member block —
+                # typed PRNG keys ride as raw key data
+                from consensus_entropy_tpu.parallel import multihost
+
+                def feed(tree):
+                    return jax.tree.map(
+                        lambda a: multihost.feed_axis(
+                            np.asarray(a), mesh, MEMBER_AXIS, 0), tree)
+
                 (params, batch_stats, opt_state, best_params, best_stats,
-                 best_score, keys), member_sh)
+                 best_score) = feed((params, batch_stats, opt_state,
+                                     best_params, best_stats, best_score))
+                keys = jax.random.wrap_key_data(
+                    feed(jax.random.key_data(keys)))
+                # broadcast inputs: process-local device arrays can't be
+                # implicitly resharded onto non-addressable devices, so
+                # feed them as replicated globals (every process holds the
+                # identical store/ids/labels).  The waveform store is
+                # static for the whole run and potentially HBM-sized, so
+                # its replicated feed is cached ON the store (one
+                # D2H+H2D round-trip per run, not per retrain call).
+                cache = getattr(store, "_ce_repl_cache", None)
+                if cache is None or cache[0] is not mesh:
+                    store._ce_repl_cache = (mesh, multihost.feed_replicated(
+                        (store.data, store.lengths), mesh))
+                data_arg, lengths_arg = store._ce_repl_cache[1]
+                train_rows, train_y, test_rows, test_y = \
+                    multihost.feed_replicated(
+                        (train_rows, train_y, test_rows, test_y), mesh)
+            else:
+                (params, batch_stats, opt_state, best_params, best_stats,
+                 best_score, keys) = jax.device_put(
+                    (params, batch_stats, opt_state, best_params,
+                     best_stats, best_score, keys), member_sh)
         #: (epoch, phase, train_loss, val_loss, val_f1, improved) with the
         #: metric entries as DEVICE member-vectors — the whole schedule is
         #: queued asynchronously and synced in one bulk transfer at the end
@@ -470,7 +513,7 @@ class CNNTrainer:
              train_loss, val_loss, val_f1, _preds, improved) = fn(
                 state["params"], state["batch_stats"], state["opt_state"],
                 state["best_params"], state["best_stats"],
-                state["best_score"], store.data, store.lengths, train_rows,
+                state["best_score"], data_arg, lengths_arg, train_rows,
                 train_y, test_rows, test_y, subs)
             records.append((epoch, phase, train_loss, val_loss, val_f1,
                             improved))
@@ -489,10 +532,22 @@ class CNNTrainer:
                                                 state["best_stats"])
             opt = jax.vmap(make_tx(phase, cfg).init)(state["params"])
             if member_sh is not None:
-                opt = jax.device_put(opt, member_sh)
+                # jit identity re-commits to the member sharding (works on
+                # multi-host global arrays, where device_put would not)
+                opt = jax.jit(lambda o: o, out_shardings=member_sh)(opt)
             state["opt_state"] = opt
 
         self._run_schedule(n_epochs, adam_patience, run_epoch, reload_best)
+        if multi_host:
+            # replicate the winning checkpoints (one all-gather over the
+            # member axis) and land them as host numpy so downstream
+            # consumers (scoring feeds, checkpoint writers) see ordinary
+            # process-local values on every host
+            bp, bs = jax.jit(lambda p, s: (p, s),
+                             out_shardings=(repl_sh, repl_sh))(
+                state["best_params"], state["best_stats"])
+            state["best_params"] = jax.device_get(bp)
+            state["best_stats"] = jax.device_get(bs)
         histories = [[] for _ in range(n_members)]
         metric_vals = jax.device_get([r[2:] for r in records])
         for (epoch, phase, *_), (tl, vl, f1, imp) in zip(records, metric_vals):

@@ -180,9 +180,9 @@ class Committee:
     ``member`` axis, so the AL iteration's dominant cost (the reference's
     100-epoch per-member retrain, ``amg_test.py:496-502``) splits across
     chips; a non-dividing committee is member-padded inside
-    ``CNNTrainer.fit_many``.  Single-process meshes only (multi-host
-    retraining would need globally-fed member state — the scoring path's
-    ``_feed_repl`` — and is deliberately not wired).
+    ``CNNTrainer.fit_many``.  Multi-host meshes work too: each process
+    feeds its own member block (``multihost.feed_axis``) and the winning
+    checkpoints are replicated back to every host at the end.
     """
 
     def __init__(self, host_members: list[Member],

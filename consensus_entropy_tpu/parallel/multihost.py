@@ -109,18 +109,24 @@ def host_pool_slice(n_rows: int) -> slice:
 
 
 def distribute_along(local_block: np.ndarray, global_shape: tuple,
-                     mesh: Mesh | None = None, axis: int = 0):
-    """Assemble a global pool-sharded array from per-host blocks.
+                     mesh: Mesh | None = None, axis: int = 0,
+                     axis_name: str = POOL_AXIS):
+    """Assemble a global sharded array from per-host blocks.
 
     ``local_block``: this host's ``host_pool_slice``-worth of the array
-    along ``axis`` (the pool axis — e.g. axis 1 for the ``(M, N, C)``
-    member-probability tables).  Returns a global jax.Array sharded on
-    ``pool`` at ``axis``; on a single host this is exactly ``device_put``
-    with that sharding, so the same feed path serves both.
+    along ``axis`` (e.g. axis 1 for the ``(M, N, C)`` member-probability
+    tables on the ``pool`` axis, or axis 0 of member-stacked training state
+    on the ``member`` axis).  Returns a global jax.Array sharded on
+    ``axis_name`` at ``axis``; on a single host this is exactly
+    ``device_put`` with that sharding, so the same feed path serves both.
+
+    The contiguous-block math assumes the named mesh axis spans all devices
+    in process-major order (true for the 1-D pool/seq meshes and for
+    ``make_training_mesh(dp=1, member=n)`` — the only shapes fed here).
     """
     mesh = mesh or global_pool_mesh()
     spec = [None] * len(global_shape)
-    spec[axis] = POOL_AXIS
+    spec[axis] = axis_name
     sharding = NamedSharding(mesh, P(*spec))
     return jax.make_array_from_process_local_data(sharding, local_block,
                                                   tuple(global_shape))
@@ -139,10 +145,33 @@ def feed_pool_axis(arr, mesh: Mesh, axis: int = 0):
     for every pool-sharded scoring input (Acquirer tables/masks, Committee
     crop/window batches).  Single-process this equals a ``device_put`` with
     the pool sharding."""
+    return feed_axis(arr, mesh, POOL_AXIS, axis)
+
+
+def feed_axis(arr, mesh: Mesh, axis_name: str, axis: int = 0):
+    """Per-host feed of a host-complete array onto any 1-D process-major
+    mesh axis (``feed_pool_axis`` generalized; the ``member`` axis of the
+    training mesh uses this to shard identical per-process committee state
+    without any host shipping members it doesn't own)."""
     arr = np.asarray(arr)
     sl = [slice(None)] * arr.ndim
     sl[axis] = host_pool_slice(arr.shape[axis])
-    return distribute_along(arr[tuple(sl)], arr.shape, mesh, axis)
+    return distribute_along(arr[tuple(sl)], arr.shape, mesh, axis, axis_name)
+
+
+def feed_replicated(tree, mesh: Mesh):
+    """Replicated global feed of a pytree whose values are identical on
+    every process (committed process-local arrays cannot be implicitly
+    resharded onto non-addressable devices).  The shared idiom behind the
+    committee's stacked-params feed, the Acquirer's rand-key feed, and the
+    trainer's broadcast inputs."""
+    sharding = NamedSharding(mesh, P())
+
+    def one(a):
+        a = np.asarray(a)
+        return jax.make_array_from_process_local_data(sharding, a, a.shape)
+
+    return jax.tree.map(one, tree)
 
 
 def gather_to_host(out):

@@ -88,3 +88,24 @@ def test_exhausting_pool(rng):
 def test_unknown_mode():
     with pytest.raises(ValueError):
         Acquirer(SONGS, None, queries=3, mode="zzz").select()
+
+
+def test_staged_device_probs_match_host_numpy(rng):
+    """The persistent device probs buffer (live rows scattered in place,
+    stale rows behind the mask) must select identically to host-numpy
+    feeds, across shrinking iterations and for both mc and mix."""
+    import jax.numpy as jnp
+
+    for mode in ("mc", "mix"):
+        hc = _hc(rng, 37) if mode == "mix" else None
+        a = Acquirer(SONGS, hc, queries=4, mode=mode, seed=1)
+        b = Acquirer(SONGS, hc, queries=4, mode=mode, seed=1)
+        for _ in range(3):
+            live = a.remaining_songs
+            assert live == b.remaining_songs
+            p = _probs(rng, 3, len(live))
+            qa = a.select(np.asarray(p))      # host numpy feed
+            qb = b.select(jnp.asarray(p))     # device-array feed
+            assert qa == qb
+        # the staged buffer never reallocates across iterations
+        assert a._probs_buf.shape == (3, a.n_pad, 4)

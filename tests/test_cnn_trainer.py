@@ -292,3 +292,29 @@ def test_weighted_f1_in_graph_matches_sklearn():
         got = float(weighted_f1_in_graph(jnp.asarray(probs),
                                          jnp.asarray(one_hot_np(y_true))))
         np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_bf16_training_quality_parity(rng):
+    """Mixed-precision training (compute_dtype='bfloat16': bf16 convs, f32
+    params/optimizer/loss) must learn the separable tone task to the same
+    level as f32 — the quality gate behind the bench's bf16 retrain race
+    (``bench.py --suite retrain``)."""
+    import dataclasses
+
+    waves, classes = _synthetic_pool(rng, 8)
+    ids = list(waves)
+    y = one_hot_np([classes[s] for s in ids])
+    finals = {}
+    for dt in ("float32", "bfloat16"):
+        cfg = dataclasses.replace(TINY, compute_dtype=dt)
+        store = DeviceWaveformStore(waves, cfg.input_length)
+        trainer = CNNTrainer(cfg, TrainConfig(batch_size=4, lr=1e-3))
+        variables = short_cnn.init_variables(jax.random.key(0), cfg)
+        best, hist = trainer.fit(variables, store, ids, y, ids, y,
+                                 jax.random.key(1), n_epochs=25)
+        # params stay f32 regardless of compute dtype
+        assert all(np.asarray(a).dtype == np.float32
+                   for a in jax.tree.leaves(best["params"]))
+        finals[dt] = max(h["val_f1"] for h in hist)
+    assert finals["float32"] > 0.8, finals
+    assert finals["bfloat16"] >= finals["float32"] - 0.15, finals

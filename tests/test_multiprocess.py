@@ -58,6 +58,27 @@ cnn_probs = np.asarray(committee.pool_probs(None, store, songs,
 results["cnn_checksum"] = float(np.sum(cnn_probs))
 results["cnn_shape"] = list(cnn_probs.shape)
 
+# -- member-sharded retraining across processes ---------------------------
+# 3 members padded to 8 member slots spanning BOTH processes: per-process
+# member feeds, lockstep SPMD epochs, replicated best checkpoints back.
+from consensus_entropy_tpu.config import TrainConfig
+from consensus_entropy_tpu.models.cnn_trainer import CNNTrainer
+from consensus_entropy_tpu.parallel.mesh import make_training_mesh
+
+train_mesh = make_training_mesh(dp=1, member=8)
+trainer = CNNTrainer(cfg, TrainConfig(batch_size=2))
+tr_y = np.eye(4, dtype=np.float32)[[i % 4 for i in range(6)]]
+te_y = np.eye(4, dtype=np.float32)[[i % 4 for i in range(2)]]
+m3 = [short_cnn.init_variables(jax.random.key(10 + i), cfg)
+      for i in range(3)]
+best3, hist3 = trainer.fit_many(m3, store, songs[:6], tr_y, songs[6:8],
+                                te_y, jax.random.key(9), n_epochs=2,
+                                mesh=train_mesh)
+results["retrain_losses"] = [round(h["val_loss"], 6) for h in hist3[0]]
+results["retrain_checksum"] = float(sum(
+    float(np.sum(np.asarray(l)))
+    for l in jax.tree.leaves(best3[0]["params"])))
+
 # -- coordination primitives ----------------------------------------------
 results["is_coord"] = multihost.is_coordinator()
 flag = multihost.broadcast_flag(pid == 0)
@@ -124,6 +145,13 @@ def test_two_process_distributed_scoring(tmp_path):
     # gather-back: both hold the identical host-complete CNN table
     assert r0["cnn_shape"] == r1["cnn_shape"] == [2, 20, 4]
     assert abs(r0["cnn_checksum"] - r1["cnn_checksum"]) < 1e-5
+    # member-sharded retrain: finite lockstep losses, identical replicated
+    # best params on both processes
+    assert r0["retrain_losses"] == r1["retrain_losses"]
+    assert all(np.isfinite(v) for v in r0["retrain_losses"])
+    assert len(r0["retrain_losses"]) == 2
+    assert abs(r0["retrain_checksum"] - r1["retrain_checksum"]) < 1e-4
+    assert np.isfinite(r0["retrain_checksum"])
     # coordinator roles + broadcast agreement
     assert r0["is_coord"] is True and r1["is_coord"] is False
     assert r0["flag"] is True and r1["flag"] is True
