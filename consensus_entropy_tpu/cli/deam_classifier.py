@@ -36,6 +36,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="debug: CNNConfig field overrides as a JSON object "
                         "(e.g. '{\"n_layers\": 2, \"input_length\": 1024}')")
     p.add_argument("--seed", type=int, default=1987)
+    p.add_argument("--n-jobs", type=int, default=1,
+                   help="joblib process pool over classic-model CV folds "
+                        "(the reference hardcodes n_jobs=10, "
+                        "deam_classifier.py:326; default 1 — fold results "
+                        "are order-stable either way)")
     add_path_args(p)
     add_device_arg(p)
     return p
@@ -94,7 +99,8 @@ def main(argv=None) -> int:
     else:
         X, y, song_ids = deam.training_arrays(df)
         pretrain.pretrain_classic(args.model, X, y, song_ids, cv=cv,
-                                  out_dir=out_dir, seed=args.seed)
+                                  out_dir=out_dir, seed=args.seed,
+                                  n_jobs=args.n_jobs)
     return 0
 
 

@@ -7,8 +7,9 @@ frames with pandas groupby, and ships everything through scipy on host.
 TPU-native shape of the same computation:
 
 - CNN members live as ONE stacked pytree; scoring all of them over all pool
-  songs is a single ``vmap``'d jit dispatch (async — the host thread returns
-  immediately).
+  songs is a single jit dispatch (``lax.map`` over the member axis — dense
+  per-member convs, see ``short_cnn.committee_infer``; async — the host
+  thread returns immediately).
 - While the TPU chews the CNN graph, the host computes sklearn members'
   frame probabilities and segment-means them into per-song tables (numpy
   ``reduceat``, not pandas groupby).
@@ -134,10 +135,13 @@ class CNNMember(Member):
     FRONTEND_META = ("arch", "n_harmonic", "semitone_scale", "n_mels",
                      "n_fft", "hop_length", "f_min", "f_max", "sample_rate")
 
-    def save(self, path):
+    def save(self, path, variables=None):
+        """``variables`` overrides the member's own (the committee's batched
+        checkpoint fetch passes pre-fetched host copies)."""
         meta = {"kind": self.kind, "name": self.name}
         meta.update({k: getattr(self.config, k) for k in self.FRONTEND_META})
-        save_variables(path, self.variables, meta=meta)
+        save_variables(path, self.variables if variables is None
+                       else variables, meta=meta)
 
     @classmethod
     def load(cls, path, config: CNNConfig = CNNConfig(),
@@ -195,7 +199,7 @@ class Committee:
         self.host_members = host_members
         self.cnn_members = cnn_members
         if cnn_members:
-            # the committee scores all CNN members as ONE vmapped pytree, so
+            # the committee scores all CNN members as ONE stacked pytree, so
             # they must share a trunk family AND frontend geometry; the
             # committee config follows the members' (checkpoints know
             # theirs — CNNMember.load)
@@ -205,7 +209,7 @@ class Committee:
             if len(sigs) > 1:
                 raise ValueError(
                     f"CNN members mix trunk families/frontend geometries "
-                    f"{sorted(sigs)}; a committee vmaps one stacked pytree "
+                    f"{sorted(sigs)}; a committee maps one stacked pytree "
                     f"and needs one architecture")
             sig = sigs.pop()
             if sig != tuple(getattr(config, k) for k in keys):
@@ -477,7 +481,7 @@ class Committee:
         ``amg_test.py:496-502``); members get distinct crop/dropout streams
         (member ``i`` under ``fold_in(key, i)``).
 
-        All members train in lockstep as ONE vmapped jit per epoch
+        All members train in lockstep as ONE jit per epoch
         (``CNNTrainer.fit_many``) — the schedule is epoch-indexed, so this
         is exact, and retrain wall-clock stops scaling linearly in M.  With
         ``train_mesh`` set the member-stacked state is additionally sharded
@@ -505,15 +509,29 @@ class Committee:
         """
         rows = store.row_of(song_ids)
         if self.full_song_hop is None:
-            # Crops are sampled at the UNpadded batch width so the random
-            # stream matches the single-device path bit-for-bit; mesh mode
-            # then pads to a shard-divisible width (repeating the last crop)
-            # and slices the padding back off.
-            crops = store.sample_crops(key, rows)
-            pad = -len(rows) % self._n_pool_shards
-            if pad:
-                crops = jnp.concatenate(
-                    [crops, jnp.repeat(crops[-1:], pad, axis=0)])
+            if len(rows) == 0:
+                return jnp.zeros((len(self.cnn_members), 0,
+                                  self.config.n_class), jnp.float32)
+            # The row batch is padded (repeating the last row, sliced back
+            # off) to a shard-divisible COMPILE BUCKET before sampling: the
+            # AL pool shrinks by q songs per iteration, and without
+            # bucketing every iteration's new width recompiled the
+            # full-geometry committee forward (~30 s/compile on the TPU —
+            # measured as the dominant `score` cost in the production
+            # loop) plus the crop-sampling gather.  The real rows' crop
+            # stream is unchanged: threefry draws are prefix-stable in the
+            # batch width (pinned by tests).  A 256-wide bucket bounds a
+            # whole reference run (10 iterations x q=10 = 100 songs
+            # retired) to at most one bucket transition; the waste ceiling
+            # is ~255 crops ≈ 90 ms of forward math per pass — noise next
+            # to one avoided compile.
+            import math
+
+            bucket = math.lcm(256, self._n_pool_shards)
+            pad = -len(rows) % bucket
+            rows_in = np.concatenate([rows, np.repeat(rows[-1:], pad)]) \
+                if pad else rows
+            crops = store.sample_crops(key, rows_in)
             out = self._gather_rows(self._infer(
                 self._feed_repl(self._stacked()), self._feed_rows(crops)))
             return out[:, : len(rows)] if pad else out
@@ -591,5 +609,13 @@ class Committee:
         os.makedirs(directory, exist_ok=True)
         for m in self.host_members:
             m.save(os.path.join(directory, f"classifier_{m.kind}.{m.name}.pkl"))
-        for m in self.cnn_members:
-            m.save(os.path.join(directory, f"classifier_cnn.{m.name}.msgpack"))
+        if self.cnn_members:
+            # ONE batched device→host fetch for ALL members' variables
+            # (members keep their device-resident copies for scoring):
+            # per-member, let alone per-leaf, fetches serialize ~90 ms
+            # tunnel round-trips into the per-iteration checkpoint phase
+            fetched = jax.device_get([m.variables for m in self.cnn_members])
+            for m, v in zip(self.cnn_members, fetched):
+                m.save(os.path.join(directory,
+                                    f"classifier_cnn.{m.name}.msgpack"),
+                       variables=v)

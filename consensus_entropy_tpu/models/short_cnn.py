@@ -22,9 +22,10 @@ TPU-first choices (vs a line-for-line port):
 - BatchNorm uses running statistics for *all* inference (the reference
   evaluates with batch_size=1 where train-mode BN would be degenerate —
   SURVEY.md §7 hard part 3).
-- Committee inference/training is ``vmap`` over stacked parameter pytrees
+- Committee inference/training runs over stacked parameter pytrees
   (``stack_params``) rather than a Python loop that reloads each member from
-  disk per iteration (``amg_test.py:434``).
+  disk per iteration (``amg_test.py:434``) — ``lax.map`` on one chip (dense
+  per-member convs), ``vmap`` where the member axis shards across chips.
 - Optional bfloat16 compute (params/stats stay float32).
 
 Torch-default hyperparameters preserved: BN eps=1e-5, BN momentum 0.1 (flax
@@ -273,9 +274,9 @@ def apply_train(variables, x, dropout_key, config: CNNConfig = CNNConfig()):
 def stack_params(member_variables: list):
     """Stack per-member variable pytrees along a leading committee axis.
 
-    The stacked pytree is what ``vmap``/``shard_map`` consume: committee
-    inference is ``vmap(apply_infer, in_axes=(0, None))`` — one fused graph
-    for all M members instead of M sequential model loads (``amg_test.py:428-438``).
+    The stacked pytree is what ``lax.map``/``vmap``/``shard_map`` consume:
+    committee inference is one fused graph for all M members instead of M
+    sequential model loads (``amg_test.py:428-438``).
     """
     return jax.tree.map(lambda *leaves: jnp.stack(leaves), *member_variables)
 

@@ -83,9 +83,16 @@ def grouped_folds(song_ids, n_splits: int, rng: np.random.Generator,
 
 
 def pretrain_classic(model: str, X, y, song_ids, *, cv: int,
-                     out_dir: str, seed: int = 1987) -> dict:
+                     out_dir: str, seed: int = 1987,
+                     n_jobs: int = 1) -> dict:
     """Train ``cv`` fold estimators of ``model`` and persist each as
-    ``classifier_{model}.it_{i}.pkl`` (``deam_classifier.py:331-333``)."""
+    ``classifier_{model}.it_{i}.pkl`` (``deam_classifier.py:331-333``).
+
+    ``n_jobs > 1`` trains folds in a joblib process pool — the reference's
+    ``cross_validate(n_jobs=10)`` experiment-level data parallelism
+    (``deam_classifier.py:326``); fold results come back in fold order
+    either way, so metrics/artifacts are identical to the sequential run.
+    """
     from sklearn.metrics import f1_score, precision_score, recall_score
 
     registry = _registry(seed)
@@ -93,17 +100,32 @@ def pretrain_classic(model: str, X, y, song_ids, *, cv: int,
         raise ValueError(f"unknown classic model {model!r}")
     os.makedirs(out_dir, exist_ok=True)
     rng = np.random.default_rng(seed)
-    scores = {"precision": [], "recall": [], "f1": []}
-    for i, (tr, te) in enumerate(grouped_folds(song_ids, cv, rng)):
+    folds = list(enumerate(grouped_folds(song_ids, cv, rng)))
+
+    def fit_fold(i, tr, te):
         member = registry[model](f"it_{i}")
         member.fit(X[tr], y[tr])
         y_pred = member.predict(X[te])
-        scores["precision"].append(
+        return member, (
             precision_score(y[te], y_pred, average="weighted",
-                            zero_division=0))
-        scores["recall"].append(
-            recall_score(y[te], y_pred, average="weighted", zero_division=0))
-        scores["f1"].append(f1_score(y[te], y_pred, average="weighted"))
+                            zero_division=0),
+            recall_score(y[te], y_pred, average="weighted",
+                         zero_division=0),
+            f1_score(y[te], y_pred, average="weighted", zero_division=0))
+
+    if n_jobs != 1 and len(folds) > 1:
+        from joblib import Parallel, delayed
+
+        fitted = Parallel(n_jobs=min(n_jobs, len(folds)))(
+            delayed(fit_fold)(i, tr, te) for i, (tr, te) in folds)
+    else:
+        fitted = [fit_fold(i, tr, te) for i, (tr, te) in folds]
+
+    scores = {"precision": [], "recall": [], "f1": []}
+    for member, (p, r, f1) in fitted:
+        scores["precision"].append(p)
+        scores["recall"].append(r)
+        scores["f1"].append(f1)
         member.save(os.path.join(out_dir,
                                  f"classifier_{model}.{member.name}.pkl"))
     summary = {k: {"mean": float(np.mean(v)), "std": float(np.std(v))}

@@ -18,14 +18,18 @@ import os
 import struct
 
 import jax
-import numpy as np
 from flax import serialization
 
 _MAGIC = b"CETPU1\n"
 
 
 def save_variables(path: str, variables, meta: dict | None = None) -> None:
-    payload = serialization.to_bytes(jax.tree.map(np.asarray, variables))
+    # ONE batched device→host fetch of the whole tree before serializing:
+    # per-leaf fetches inside to_bytes would run sequentially, and on the
+    # tunneled TPU each fetch pays ~90 ms latency — ~250 leaves made the
+    # per-iteration committee checkpoint a >50 s phase; device_get overlaps
+    # the transfers and returns a host-numpy pytree
+    payload = serialization.to_bytes(jax.device_get(variables))
     header = json.dumps(meta or {}).encode()
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:

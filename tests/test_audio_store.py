@@ -61,3 +61,29 @@ def test_host_store_matches_api(rng, tmp_path):
         starts = np.flatnonzero(np.isclose(w, c[0]))
         assert any(np.allclose(w[s: s + 700], c) for s in starts
                    if s + 700 <= len(w))
+
+
+def test_sample_crops_prefix_stable_in_batch_width(rng, tmp_path):
+    """Padding the row batch must not change the real rows' crops: the
+    committee pads row batches to a compile bucket before sampling
+    (committee.predict_songs_cnn), which is only sound because threefry
+    draws are prefix-stable in the batch width."""
+    import jax
+
+    from consensus_entropy_tpu.data.audio import (
+        DeviceWaveformStore,
+        HostWaveformStore,
+    )
+
+    waves = {f"s{i}": (rng.standard_normal(3000) * 0.1).astype(np.float32)
+             for i in range(6)}
+    for sid, w in waves.items():
+        np.save(tmp_path / f"{sid}.npy", w)
+    key = jax.random.key(42)
+    for store in (DeviceWaveformStore(waves, 1024),
+                  HostWaveformStore(str(tmp_path), list(waves), 1024)):
+        rows = store.row_of([f"s{i}" for i in range(4)])
+        rows_padded = np.concatenate([rows, np.repeat(rows[-1:], 12)])
+        a = np.asarray(store.sample_crops(key, rows))
+        b = np.asarray(store.sample_crops(key, rows_padded))[:4]
+        np.testing.assert_array_equal(a, b)

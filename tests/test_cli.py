@@ -129,6 +129,41 @@ def test_mesh_auto_cli(synth_roots, capsys):
     assert "final mean F1" in out
 
 
+def test_mesh_auto_cnn_committee_cli(synth_roots, tmp_path, rng, capsys):
+    """CNN committee through the AL CLI with --mesh auto: the CLI derives
+    BOTH the pool scoring mesh and the (dp=1, member) training mesh, and
+    the member-sharded retrain runs inside the production loop."""
+    import glob
+
+    tiny = ('{"n_channels": 4, "n_fft": 64, "hop_length": 32, "n_mels": 16,'
+            ' "n_layers": 2, "input_length": 1024}')
+    flags = ["--models-root", synth_roots["models"],
+             "--deam-root", synth_roots["deam"],
+             "--amg-root", synth_roots["amg"], "--device", "cpu"]
+    for root, ids in ((synth_roots["deam"], range(1, 25)),
+                      (synth_roots["amg"], range(201, 241))):
+        npy = os.path.join(root, "npy")
+        os.makedirs(npy, exist_ok=True)
+        for sid in ids:
+            np.save(os.path.join(npy, f"{sid}.npy"),
+                    (rng.standard_normal(1600) * 0.05).astype(np.float32))
+    rc = deam_classifier.main(["-cv", "1", "-m", "cnn_jax", "--epochs", "1",
+                               "--cnn-config-json", tiny] + flags)
+    assert rc == 0
+    rc = amg_test.main(["-q", "3", "-e", "2", "-m", "mc", "-n", "10",
+                        "--max-users", "1", "--mesh", "auto",
+                        "--retrain-epochs", "1",
+                        "--cnn-config-json", tiny] + flags)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Scoring mesh: 8 device(s)" in out
+    assert "Training mesh: 8 device(s) on the member axis" in out
+    assert "final mean F1" in out
+    users = glob.glob(os.path.join(synth_roots["models"], "users", "*",
+                                   "mc", "DONE"))
+    assert users
+
+
 def test_distributed_flag_joins_before_mesh(synth_roots, capsys, monkeypatch):
     """--distributed plumbs to multihost.initialize BEFORE backend use and
     --mesh auto then takes the global (all-hosts) pool mesh; single-process
@@ -180,3 +215,23 @@ def test_distributed_requires_mesh_flag(synth_roots, capsys):
                         "--amg-root", synth_roots["amg"], "--device", "cpu"])
     assert rc == 1
     assert "requires --mesh auto" in capsys.readouterr().out
+
+
+def test_pretrain_classic_parallel_folds_match_sequential(tmp_path, rng):
+    """n_jobs>1 (the reference's cross_validate(n_jobs=10) fold pool,
+    deam_classifier.py:326) must produce identical metrics and artifacts
+    to the sequential path — fold RNG is drawn before dispatch."""
+    from consensus_entropy_tpu.train import pretrain
+
+    n = 120
+    X = rng.standard_normal((n, 6)).astype(np.float32)
+    y = np.tile(np.arange(4), n // 4)
+    song_ids = np.repeat(np.arange(n // 4), 4)
+    seq = pretrain.pretrain_classic("gnb", X, y, song_ids, cv=3,
+                                    out_dir=str(tmp_path / "a"), seed=5)
+    par = pretrain.pretrain_classic("gnb", X, y, song_ids, cv=3,
+                                    out_dir=str(tmp_path / "b"), seed=5,
+                                    n_jobs=2)
+    assert seq == par
+    assert (sorted(os.listdir(tmp_path / "a"))
+            == sorted(os.listdir(tmp_path / "b")))
