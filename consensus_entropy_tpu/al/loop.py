@@ -28,6 +28,35 @@ from consensus_entropy_tpu.models.committee import Committee, FramePool
 from consensus_entropy_tpu.utils.profiling import StepTimer
 
 
+class AsyncCheckpointer:
+    """One background writer for the loop's per-iteration checkpoints.
+
+    The two-phase commit's ordering (member files → state write → promote)
+    is preserved INSIDE each submitted job; jobs never overlap (``submit``
+    joins the previous one), so crash consistency is exactly the
+    synchronous story — the only change is that serialization + disk I/O
+    overlap the next iteration's device compute.  A single-worker
+    ``ThreadPoolExecutor`` provides the serialization and traceback-correct
+    exception propagation; the pending ``Future`` is cleared before
+    ``result()`` so an error surfaces exactly once.
+    """
+
+    def __init__(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(max_workers=1)
+        self._future = None
+
+    def submit(self, fn) -> None:
+        self.wait()
+        self._future = self._pool.submit(fn)
+
+    def wait(self) -> None:
+        if self._future is not None:
+            future, self._future = self._future, None
+            future.result()
+
+
 @dataclasses.dataclass
 class UserData:
     """Everything the loop needs for one user."""
@@ -180,18 +209,33 @@ class ALLoop:
 
         from consensus_entropy_tpu.parallel import multihost
 
+        ckpt = AsyncCheckpointer()
+
         def checkpoint(next_epoch: int, current_key) -> None:
             """Two-phase commit: stage members -> state write (commit point)
             -> promote.  A kill anywhere leaves (committee, state) pairs
             consistent (al_state.recover_workspace).  Multi-host: only the
             coordinator touches the workspace (every process carries the
-            same in-memory committee, so nothing is lost)."""
+            same in-memory committee, so nothing is lost).
+
+            The mutable state is SNAPSHOT here (host members written, CNN
+            variables fetched, state fields copied); serialization + disk
+            writes + promote then run on the checkpointer thread, hidden
+            behind the next iteration's compute.
+            """
             if not multihost.is_coordinator():
                 return
-            committee.save(al_state.staging_dir(user_path, next_epoch))
+            # Join the PREVIOUS commit before staging the next generation:
+            # its recover_workspace prunes staging dirs of other
+            # generations, so staging concurrently would let it rmtree the
+            # dir being written (submit() also joins, but only AFTER
+            # begin_save — too late).
+            ckpt.wait()
+            finish_members = committee.begin_save(
+                al_state.staging_dir(user_path, next_epoch))
             kd, kdt = al_state.ALState.pack_key(current_key)
-            al_state.ALState(
-                next_epoch=next_epoch, trajectory=trajectory,
+            state_obj = al_state.ALState(
+                next_epoch=next_epoch, trajectory=list(trajectory),
                 train_songs=[al_state.song_key(s)
                              for s in split.train_songs],
                 test_songs=[al_state.song_key(s) for s in split.test_songs],
@@ -199,9 +243,38 @@ class ALLoop:
                          for b in queried_hist],
                 key_data=kd, key_dtype=kdt, mode=cfg.mode, seed=seed,
                 queries=cfg.queries, train_size=cfg.train_size,
-            ).save(user_path)
-            al_state.recover_workspace(user_path)  # promote the stage
+            )
 
+            def commit():
+                finish_members()
+                state_obj.save(user_path)  # the commit point
+                al_state.recover_workspace(user_path)  # promote the stage
+
+            ckpt.submit(commit)
+
+        try:
+            result = self._run_iterations(
+                committee, data, user_path, cfg, seed, timer, st, split, key,
+                trajectory, queried_hist, start_epoch, acq, checkpoint,
+                multihost)
+        except BaseException:
+            # best-effort join so no writer outlives the failure, but the
+            # loop's own error is the root cause and must not be masked by
+            # a deferred write error
+            try:
+                ckpt.wait()
+            except BaseException:
+                pass
+            raise
+        # the last iteration's checkpoint must be durable (and any deferred
+        # write error surfaced) before the caller reads the workspace
+        # (mark_done, resume, final save)
+        ckpt.wait()
+        return result
+
+    def _run_iterations(self, committee, data, user_path, cfg, seed, timer,
+                        st, split, key, trajectory, queried_hist,
+                        start_epoch, acq, checkpoint, multihost):
         with UserReport(user_path, cfg.mode,
                         write=multihost.is_coordinator()) as report:
             if st is None:

@@ -606,16 +606,30 @@ class Committee:
     # -- persistence -------------------------------------------------------
 
     def save(self, directory: str):
+        self.begin_save(directory)()
+
+    def begin_save(self, directory: str):
+        """Split checkpointing into a synchronous SNAPSHOT and a deferred
+        WRITE: host members (KB pickles, mutated in place by the next
+        ``partial_fit``) are written immediately; CNN members only need
+        their variable REFERENCES captured — retraining rebinds
+        ``m.variables`` to new arrays, never mutates the old ones — so the
+        expensive device→host fetch rides the deferred callable too.  The
+        callable does ONE batched ``device_get`` (per-member, let alone
+        per-leaf, fetches serialize ~90 ms tunnel round-trips) and is safe
+        to run on another thread while the committee keeps training — the
+        AL loop overlaps it with the next iteration's compute
+        (``al.loop``)."""
         os.makedirs(directory, exist_ok=True)
         for m in self.host_members:
             m.save(os.path.join(directory, f"classifier_{m.kind}.{m.name}.pkl"))
-        if self.cnn_members:
-            # ONE batched device→host fetch for ALL members' variables
-            # (members keep their device-resident copies for scoring):
-            # per-member, let alone per-leaf, fetches serialize ~90 ms
-            # tunnel round-trips into the per-iteration checkpoint phase
-            fetched = jax.device_get([m.variables for m in self.cnn_members])
-            for m, v in zip(self.cnn_members, fetched):
+        snapshot = [(m, m.variables) for m in self.cnn_members]
+
+        def finish():
+            fetched = jax.device_get([v for _, v in snapshot])
+            for (m, _), v in zip(snapshot, fetched):
                 m.save(os.path.join(directory,
                                     f"classifier_cnn.{m.name}.msgpack"),
                        variables=v)
+
+        return finish

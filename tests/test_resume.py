@@ -177,3 +177,32 @@ def test_step_timer_records_phases(tmp_path, rng):
         for phase in ("score_s", "select_s", "update_host_s", "evaluate_s",
                       "checkpoint_s"):
             assert phase in r, r
+
+
+def test_async_checkpointer_orders_and_raises():
+    """Jobs never overlap (submit joins the previous), and a failed write
+    surfaces on the loop thread at the next wait/submit instead of being
+    swallowed on the writer thread."""
+    import time
+
+    from consensus_entropy_tpu.al.loop import AsyncCheckpointer
+
+    ck = AsyncCheckpointer()
+    order = []
+
+    def slow():
+        time.sleep(0.2)
+        order.append("first")
+
+    ck.submit(slow)
+    ck.submit(lambda: order.append("second"))  # must join `slow` first
+    ck.wait()
+    assert order == ["first", "second"]
+
+    def boom():
+        raise RuntimeError("disk full")
+
+    ck.submit(boom)
+    with pytest.raises(RuntimeError, match="disk full"):
+        ck.wait()
+    ck.wait()  # exception is surfaced once, then cleared
