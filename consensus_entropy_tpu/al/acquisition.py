@@ -147,20 +147,26 @@ class Acquirer:
     def _staged_probs(self, member_probs):
         """The ``(M, n_pad, C)`` scoring input for mc/mix.
 
-        Single-process device path: scatter the live rows into a persistent
-        device buffer in place (donated), so the committee's device-computed
-        probs never round-trip through the host and the upload per iteration
-        is only the compact ``(M, n_live, C)`` block when the probs came
-        from host members.  Rows of previously-queried songs keep stale
-        values — they sit behind ``pool_mask`` and never reach the entropy.
-        The scatter jit specializes per live-width (one compile per AL
-        iteration count, shared across users under ``pad_to``).
+        Host-numpy probs (pure host committees): pad on host and upload the
+        fixed ``(M, n_pad, C)`` table — compile-free (padding in numpy is
+        free, and one program serves every iteration).
+
+        Device-array probs (committees with CNN members): scatter the live
+        rows into a persistent device buffer in place (donated), so the
+        device-computed probs never round-trip through the host.  Rows of
+        previously-queried songs keep stale values — they sit behind
+        ``pool_mask`` and never reach the entropy.  The scatter jit
+        specializes per live-width (one small compile per AL iteration,
+        shared across users under ``pad_to``) — the documented price of
+        skipping the D2H+H2D of the whole table.
 
         Multi-host mesh path: the committee already merges its blocks on
         host (per-process feeding); keep the host pad + per-host feed.
         """
         if self._mesh is not None:
             return self._feed(self.pad_probs(member_probs), 1)
+        if isinstance(member_probs, np.ndarray):
+            return jnp.asarray(self.pad_probs(member_probs))
         member_probs = jnp.asarray(member_probs)
         m = member_probs.shape[0]
         if self._probs_buf is None or self._probs_buf.shape[0] != m:

@@ -309,13 +309,20 @@ def analyze_users(users_root: str, *, modes=MODES,
         shared = sorted(present[mode] & present.get(baseline, set()))
         if not shared:
             continue
+        # pairing must hold PER USER — aggregate-length checks would let
+        # offsetting mismatches slip through and misalign every pair after
+        # the first bad user
+        unpaired = [u for u in shared
+                    if len(per_mode[mode][u][-1])
+                    != len(per_mode[baseline][u][-1])]
+        if unpaired:
+            out["tests"][f"{mode}>{baseline}"] = {
+                "skipped": "unpaired member counts for users "
+                           f"{unpaired}: runs used different committee "
+                           "sizes"}
+            continue
         a = np.concatenate([per_mode[mode][u][-1] for u in shared])
         b = np.concatenate([per_mode[baseline][u][-1] for u in shared])
-        if len(a) != len(b):  # committee sizes must match to pair members
-            out["tests"][f"{mode}>{baseline}"] = {
-                "skipped": f"unpaired member counts ({len(a)} vs {len(b)}): "
-                           "runs used different committee sizes"}
-            continue
         out["tests"][f"{mode}>{baseline}"] = {
             "n_users_paired": len(shared),
             "per_member_final": _paired_one_sided(a, b)}

@@ -56,6 +56,15 @@ class AsyncCheckpointer:
             future, self._future = self._future, None
             future.result()
 
+    def close(self) -> None:
+        """Join the pending job and release the worker thread (one
+        checkpointer is created per ``run_user``; without shutdown a
+        46-user run would park 46 idle workers)."""
+        try:
+            self.wait()
+        finally:
+            self._pool.shutdown(wait=False)
+
 
 @dataclasses.dataclass
 class UserData:
@@ -262,14 +271,14 @@ class ALLoop:
             # loop's own error is the root cause and must not be masked by
             # a deferred write error
             try:
-                ckpt.wait()
+                ckpt.close()
             except BaseException:
                 pass
             raise
         # the last iteration's checkpoint must be durable (and any deferred
         # write error surfaced) before the caller reads the workspace
         # (mark_done, resume, final save)
-        ckpt.wait()
+        ckpt.close()
         return result
 
     def _run_iterations(self, committee, data, user_path, cfg, seed, timer,

@@ -366,7 +366,12 @@ class Committee:
                     host_np[slot] = pool.mean_over_segments(frame_p,
                                                             seg_starts)
             if dev_block is None:
-                blocks.append(jnp.asarray(host_np))  # one H2D transfer
+                # pure-host slice stays NUMPY: for host-only committees the
+                # acquirer then pads on host and uploads one fixed-shape
+                # table (compile-free across the shrinking pool); mixed
+                # committees concatenate on device below
+                blocks.append(host_np if not blocks else
+                              jnp.asarray(host_np))
             else:
                 # Merge device slice + one host buffer back into committee
                 # member order via a permutation gather on device.
