@@ -318,3 +318,29 @@ def test_bf16_training_quality_parity(rng):
         finals[dt] = max(h["val_f1"] for h in hist)
     assert finals["float32"] > 0.8, finals
     assert finals["bfloat16"] >= finals["float32"] - 0.15, finals
+
+
+def test_fit_many_production_shape_5_members_padded_to_8(rng):
+    """The reference committee's exact shape: 5 CNN members on an 8-wide
+    member axis (3 padded slots trained redundantly, sliced off) — the
+    configuration the AL CLI builds under --mesh auto."""
+    from consensus_entropy_tpu.parallel.mesh import make_training_mesh
+
+    waves, classes = _synthetic_pool(rng, 6)
+    store = DeviceWaveformStore(waves, TINY.input_length)
+    ids = list(waves)
+    y = one_hot_np([classes[s] for s in ids])
+    members = [short_cnn.init_variables(jax.random.key(i), TINY)
+               for i in range(5)]
+    key = jax.random.key(3)
+    plain_best, plain_hist = CNNTrainer(TINY, TrainConfig(batch_size=3)) \
+        .fit_many(members, store, ids, y, ids[:2], y[:2], key, n_epochs=2)
+    mesh_best, mesh_hist = CNNTrainer(TINY, TrainConfig(batch_size=3)) \
+        .fit_many(members, store, ids, y, ids[:2], y[:2], key, n_epochs=2,
+                  mesh=make_training_mesh(dp=1, member=8))
+    assert len(mesh_best) == 5 and len(mesh_hist) == 5
+    for m in range(5):
+        for a, b in zip(plain_hist[m], mesh_hist[m]):
+            np.testing.assert_allclose(a["val_loss"], b["val_loss"],
+                                       rtol=1e-3)
+            np.testing.assert_allclose(a["val_f1"], b["val_f1"], atol=1e-6)
