@@ -24,9 +24,14 @@ from consensus_entropy_tpu.utils import round_up as _round_up
 def _scatter_rows_impl(buf, rows, p):
     """In-place (donated) scatter of live-row probs into the persistent
     padded buffer.  Module-level so the jit cache is shared across Acquirer
-    instances: under ``pad_to`` a 46-user run compiles one program per
-    live-width, not per (user, width)."""
-    return buf.at[:, rows].set(p)
+    instances, and called at the fixed :meth:`Acquirer.staging_width` by
+    the AL loop: a 46-user run under ``pad_to`` compiles one program per
+    256-bucket (at most ~n_pad/256 of them), not per live-width.
+
+    ``mode='drop'``: staging-padding slots carry an out-of-bounds row index
+    and are silently discarded — their prob columns (extra crop draws of
+    the last song on the CNN path) never touch the buffer."""
+    return buf.at[:, rows].set(p, mode="drop")
 
 
 _scatter_rows = jax.jit(_scatter_rows_impl, donate_argnums=0)
@@ -134,14 +139,33 @@ class Acquirer:
     def remaining_songs(self) -> list:
         return [s for s, ok in zip(self.songs, self.pool_mask) if ok]
 
+    #: scatter compile-bucket width (matches the committee's crop bucket —
+    #: ``committee.predict_songs_cnn``): a reference run retires 10×q=100
+    #: songs, so the staging width crosses at most one bucket boundary per
+    #: run instead of changing every iteration
+    STAGING_BUCKET = 256
+
+    def staging_width(self, n_live: int) -> int:
+        """The fixed probs-staging width for ``n_live`` remaining songs.
+
+        Pass this as ``Committee.pool_probs(..., pad_to=...)`` so the whole
+        device chain — CNN forward slice, block concat, probs scatter —
+        compiles at ``min(n_pad, round_up(n_live, 256))`` instead of at
+        every distinct live width (round 3 left the scatter specializing
+        per live-width: one small compile every AL iteration; this is the
+        same cure the crop batches got at ``committee.py`` round 3)."""
+        return min(self.n_pad,
+                   _round_up(max(n_live, 1), self.STAGING_BUCKET))
+
     def pad_probs(self, member_probs) -> np.ndarray:
-        """Pad ``(M, n_live, C)`` member probs (over ``remaining_songs``) out
-        to the fixed ``(M, n_pad, C)`` device shape (host path)."""
+        """Pad ``(M, W≥n_live, C)`` member probs (columns ``[0, n_live)``
+        over ``remaining_songs``; any tail is staging padding) out to the
+        fixed ``(M, n_pad, C)`` device shape (host path)."""
         member_probs = np.asarray(member_probs)
         m = member_probs.shape[0]
         out = np.zeros((m, self.n_pad, NUM_CLASSES), np.float32)
         live = np.flatnonzero(self.pool_mask)
-        out[:, live] = member_probs
+        out[:, live] = member_probs[:, : len(live)]
         return out
 
     def _staged_probs(self, member_probs):
@@ -155,10 +179,13 @@ class Acquirer:
         rows into a persistent device buffer in place (donated), so the
         device-computed probs never round-trip through the host.  Rows of
         previously-queried songs keep stale values — they sit behind
-        ``pool_mask`` and never reach the entropy.  The scatter jit
-        specializes per live-width (one small compile per AL iteration,
-        shared across users under ``pad_to``) — the documented price of
-        skipping the D2H+H2D of the whole table.
+        ``pool_mask`` and never reach the entropy.  The scatter runs at the
+        fixed :meth:`staging_width` when the caller staged the probs there
+        (``pool_probs(..., pad_to=...)``): the live-index vector is padded
+        with an out-of-bounds row index, so the staging columns are
+        DROPPED by the scatter (their contents are unspecified — the CNN
+        path's tail holds extra crop draws) and the program compiles once
+        per bucket instead of once per live-width.
 
         Multi-host mesh path: the committee already merges its blocks on
         host (per-process feeding); keep the host pad + per-host feed.
@@ -172,9 +199,17 @@ class Acquirer:
         if self._probs_buf is None or self._probs_buf.shape[0] != m:
             self._probs_buf = jnp.zeros((m, self.n_pad, NUM_CLASSES),
                                         jnp.float32)
-        live = jnp.asarray(np.flatnonzero(self.pool_mask))
+        live = np.flatnonzero(self.pool_mask)
+        w = member_probs.shape[1]
+        if w != len(live):
+            if w < len(live):
+                raise ValueError(
+                    f"member_probs width {w} < {len(live)} live songs")
+            live = np.concatenate(  # OOB slots → scatter mode='drop'
+                [live, np.full(w - len(live), self.n_pad, live.dtype)])
         self._probs_buf = _scatter_rows(
-            self._probs_buf, live, member_probs.astype(jnp.float32))
+            self._probs_buf, jnp.asarray(live),
+            member_probs.astype(jnp.float32))
         return self._probs_buf
 
     # -- the four modes ----------------------------------------------------

@@ -309,9 +309,12 @@ class ALLoop:
                     with timer.phase("score"):
                         # stays a device array end-to-end: the acquirer
                         # scatters it into its persistent padded buffer
-                        # (no host round-trip of the probs table)
+                        # (no host round-trip of the probs table), staged
+                        # at the fixed bucket width so the chain compiles
+                        # once per bucket, not once per live-width
                         member_probs = committee.pool_probs(
-                            data.pool, data.store, live, sub)
+                            data.pool, data.store, live, sub,
+                            pad_to=acq.staging_width(len(live)))
                 key, sub = jax.random.split(key)
                 with timer.phase("select"):
                     q_songs = acq.select(member_probs, rand_key=sub)

@@ -311,6 +311,12 @@ class CNNTrainer:
         ``train_y`` / ``test_y``: one-hot float arrays aligned with the id
         lists.  ``callback(epoch, info_dict)`` is invoked per epoch (metrics /
         reporting hook).
+
+        The caller's ``variables`` tree is COPIED before the first (donated)
+        epoch call — like ``fit_many`` — so the input buffers are never
+        invalidated.  This keeps a pending async checkpoint's deferred
+        ``device_get`` of a live committee member's variables safe even if
+        ``fit`` runs concurrently on the same tree.
         """
         cfg = self.train_config
         n_epochs = cfg.n_epochs if n_epochs is None else n_epochs
@@ -322,8 +328,8 @@ class CNNTrainer:
         train_y = jnp.asarray(train_y)
         test_y = jnp.asarray(test_y)
 
-        params = variables["params"]
-        batch_stats = variables["batch_stats"]
+        params = jax.tree.map(jnp.copy, variables["params"])
+        batch_stats = jax.tree.map(jnp.copy, variables["batch_stats"])
         best_params = jax.tree.map(jnp.copy, params)
         best_stats = jax.tree.map(jnp.copy, batch_stats)
         # The reference starts best_metric at 0 (amg_test.py:295,

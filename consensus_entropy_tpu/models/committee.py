@@ -327,7 +327,8 @@ class Committee:
 
     def pool_probs(self, pool: FramePool | None,
                    store: DeviceWaveformStore | None,
-                   song_ids: Sequence, key) -> jnp.ndarray:
+                   song_ids: Sequence, key,
+                   pad_to: int | None = None) -> jnp.ndarray:
         """Stacked member probabilities ``(M, N, C)`` over ``song_ids``.
 
         CNN rows first (committee order = member_names).  Without
@@ -336,24 +337,53 @@ class Committee:
         committee entropy is stochastic across passes by design (SURVEY.md
         §7 hard part 4).  With ``full_song_hop`` set the CNN block is the
         deterministic window-grid mean instead.
+
+        ``pad_to`` (≥ ``len(song_ids)``): return ``(M, pad_to, C)`` whose
+        tail columns are staging padding — well-formed probability rows of
+        the last song (the CNN block's tail holds extra crop draws of it),
+        but CONTENTS UNSPECIFIED by contract: the acquirer's scatter drops
+        them.  The point is that every device program downstream (block
+        concat, acquirer scatter) compiles at ONE width across the
+        shrinking pool (``Acquirer.staging_width``).
+
+        Return-type contract: a pure-host committee (no CNN members, no
+        eligible ``device_members`` slice) returns ``np.ndarray`` — the
+        acquirer then pads on host and uploads one fixed-shape table,
+        compile-free.  Any committee with a device block returns a
+        ``jax.Array`` that never round-trips through the host (the acquirer
+        scatters it into its persistent padded buffer).  Mesh committees
+        return ``np.ndarray`` (blocks carry different placements; the
+        sharded scoring fns re-shard on upload).
         """
+        n_live = len(song_ids)
+        if pad_to is not None and pad_to < n_live:
+            raise ValueError(f"pad_to={pad_to} < n={n_live}")
+        if pad_to is not None and n_live == 0 and self.host_members:
+            # the host block has no live row to stage from; the AL loop
+            # breaks before scoring an empty pool, so fail loud here
+            raise ValueError("pad_to requires at least one live song")
         blocks = []
         if self.cnn_members:
             assert store is not None
             # async dispatch either way; full_song_hop swaps the reference's
             # stochastic single crop for the deterministic window grid
-            blocks.append(self.predict_songs_cnn(store, song_ids, key))
+            blocks.append(self.predict_songs_cnn(store, song_ids, key,
+                                                 pad_to=pad_to))
+        width = n_live if pad_to is None else pad_to
         if self.host_members:
             assert pool is not None
             rowmap = {s: i for i, s in enumerate(pool.song_ids)}
             sel = np.array([rowmap[s] for s in song_ids])
+            if width > n_live:  # fixed-width tail: repeat the last live row
+                sel = np.concatenate([sel, np.repeat(sel[-1:],
+                                                     width - n_live)])
             on_device, on_host = self._split_members()
             dev_block = None
             if on_device["gnb"] or on_device["sgd"]:
                 # Dispatch the device slice FIRST (async) so the remaining
                 # host members compute while the TPU runs.
                 dev_block = self._device_member_probs(pool, on_device)[:, sel]
-            host_np = np.empty((len(on_host), len(song_ids), NUM_CLASSES),
+            host_np = np.empty((len(on_host), width, NUM_CLASSES),
                                np.float32)
             if on_host:
                 # host members score ONLY the live songs' frames — the
@@ -363,14 +393,15 @@ class Committee:
                 X_live = pool.X[live_rows]
                 for slot, (_, m) in enumerate(on_host):
                     frame_p = m.predict_proba(X_live)
-                    host_np[slot] = pool.mean_over_segments(frame_p,
-                                                            seg_starts)
+                    host_np[slot, :n_live] = pool.mean_over_segments(
+                        frame_p, seg_starts)
+                host_np[:, n_live:] = host_np[:, n_live - 1: n_live]
             if dev_block is None:
                 # pure-host slice stays NUMPY: for host-only committees the
                 # acquirer then pads on host and uploads one fixed-shape
-                # table (compile-free across the shrinking pool); mixed
-                # committees concatenate on device below
-                blocks.append(host_np if not blocks else
+                # table (compile-free across the shrinking pool); committees
+                # WITH a CNN block concatenate on device below
+                blocks.append(host_np if not self.cnn_members else
                               jnp.asarray(host_np))
             else:
                 # Merge device slice + one host buffer back into committee
@@ -502,8 +533,8 @@ class Committee:
         return histories
 
     def predict_songs_cnn(self, store: DeviceWaveformStore, song_ids, key,
-                          *, chunk: int = 8):
-        """Per-song CNN scores ``(M_cnn, n, C)``.
+                          *, chunk: int = 8, pad_to: int | None = None):
+        """Per-song CNN scores ``(M_cnn, n, C)`` — or ``(M_cnn, pad_to, C)``.
 
         Default: one random crop per song (reference parity).  With
         ``full_song_hop`` set: deterministic masked mean over the stride
@@ -511,11 +542,21 @@ class Committee:
         window tensor bounds device memory.  Every batch (including the
         last and any n < chunk call) is padded to exactly ``chunk`` rows,
         so ONE program compiles per (chunk, W) shape.
+
+        ``pad_to`` (≥ n): return a fixed-width block whose columns
+        ``[n, pad_to)`` are the internal compile-bucket padding un-sliced
+        (extra crop draws of song ``n-1``; dropped by the acquirer's
+        scatter).  The acquirer requests its staging width here
+        so the scoring chain — CNN forward, block concat, probs scatter —
+        runs at ONE device shape across the shrinking pool instead of
+        recompiling per live-width (see ``Acquirer.staging_width``).
         """
         rows = store.row_of(song_ids)
+        if pad_to is not None and pad_to < len(rows):
+            raise ValueError(f"pad_to={pad_to} < n={len(rows)}")
         if self.full_song_hop is None:
             if len(rows) == 0:
-                return jnp.zeros((len(self.cnn_members), 0,
+                return jnp.zeros((len(self.cnn_members), pad_to or 0,
                                   self.config.n_class), jnp.float32)
             # The row batch is padded (repeating the last row, sliced back
             # off) to a shard-divisible COMPILE BUCKET before sampling: the
@@ -532,6 +573,19 @@ class Committee:
             # to one avoided compile.
             import math
 
+            # The bucket padding below is only sound when threefry draws
+            # are prefix-stable across batch widths (the modern JAX
+            # default).  Check at the point of reliance — NOT a package
+            # import-time config mutation, which would silently change an
+            # embedding application's unrelated jax.random streams on a
+            # JAX defaulting the flag off — so a config flip fails loudly
+            # instead of silently diverging the crop stream.
+            if not jax.config.jax_threefry_partitionable:
+                raise RuntimeError(
+                    "jax_threefry_partitionable is off; crop "
+                    "compile-buckets require prefix-stable threefry — "
+                    "enable the flag (the modern JAX default) to use the "
+                    "CNN scoring path")
             bucket = math.lcm(256, self._n_pool_shards)
             pad = -len(rows) % bucket
             rows_in = np.concatenate([rows, np.repeat(rows[-1:], pad)]) \
@@ -539,14 +593,25 @@ class Committee:
             crops = store.sample_crops(key, rows_in)
             out = self._gather_rows(self._infer(
                 self._feed_repl(self._stacked()), self._feed_rows(crops)))
-            return out[:, : len(rows)] if pad else out
+            # slice to the STAGING width, not the live width: the bucket
+            # quantizes the slice program to ~n_pad/256 shapes per run
+            keep = len(rows) if pad_to is None else pad_to
+            if keep > out.shape[1]:
+                # out-of-contract pad_to (beyond the internal compile
+                # bucket — Acquirer.staging_width never requests this):
+                # honor the shape contract anyway, at a per-width compile
+                out = jnp.concatenate(
+                    [out, jnp.repeat(out[:, -1:], keep - out.shape[1],
+                                     axis=1)], axis=1)
+            return out[:, :keep] if keep != out.shape[1] else out
         n = len(rows)
         # each window chunk is one sharded dispatch; keep it shard-divisible
         chunk = _round_up(chunk, self._n_pool_shards)
         stacked = self._feed_repl(self._stacked())
         if n == 0:
             m = len(self.cnn_members)
-            return jnp.zeros((m, 0, self.config.n_class), jnp.float32)
+            return jnp.zeros((m, pad_to or 0, self.config.n_class),
+                             jnp.float32)
         blocks = []
         for lo in range(0, n, chunk):
             sel = rows[lo: lo + chunk]
@@ -558,10 +623,19 @@ class Committee:
                 stacked, self._feed_rows(windows), self._feed_rows(valid)))
             blocks.append(out[:, : out.shape[1] - pad])
         if len(blocks) == 1:
-            return blocks[0]
-        if isinstance(blocks[0], np.ndarray):  # multi-host: gathered to
-            return np.concatenate(blocks, axis=1)  # host; stay there
-        return jnp.concatenate(blocks, axis=1)
+            out = blocks[0]
+        elif isinstance(blocks[0], np.ndarray):  # multi-host: gathered to
+            out = np.concatenate(blocks, axis=1)  # host; stay there
+        else:
+            out = jnp.concatenate(blocks, axis=1)
+        if pad_to is not None and pad_to > out.shape[1]:
+            # window-grid path: extend with repeats of the last real column
+            # (same tail contract as the crop path's bucket padding)
+            xp = np if isinstance(out, np.ndarray) else jnp
+            out = xp.concatenate(
+                [out, xp.repeat(out[:, -1:], pad_to - out.shape[1], axis=1)],
+                axis=1)
+        return out
 
     def predict_song_sequence(self, wave, seq_mesh, *, hop: int | None = None):
         """Sequence-parallel full-song CNN scoring: ``(M_cnn, C)``.

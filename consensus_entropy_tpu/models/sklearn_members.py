@@ -79,7 +79,14 @@ class _PickledSklearnMember(Member):
         return full
 
     def predict(self, X):
-        return self.estimator.predict(np.asarray(X))
+        # The per-iteration evaluation hot path (amg_test.py:411-413 scores
+        # every member on the full test frame set every iteration): GNB/SGD
+        # go through the native core's argmax fast path; estimators without
+        # one (trees, SVC — whose Platt-scaled proba argmax can disagree
+        # with its own predict) keep sklearn's predict untouched.
+        X = np.asarray(X)
+        y = native.member_predict(self.estimator, X)
+        return y if y is not None else self.estimator.predict(X)
 
     def save(self, path):
         with open(path, "wb") as f:

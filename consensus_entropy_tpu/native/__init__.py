@@ -84,7 +84,19 @@ def linear_predict_proba(X, W, b, mode: str = "softmax") -> np.ndarray:
         logits -= logits.max(axis=1, keepdims=True)
         p = np.exp(logits)
         return (p / p.sum(axis=1, keepdims=True)).astype(np.float32)
-    return _ova_normalize(1.0 / (1.0 + np.exp(-logits)))
+    return _ova_normalize(_sigmoid(logits))
+
+
+def _sigmoid(x) -> np.ndarray:
+    """Saturation-safe logistic: ``exp(-|x|)`` never overflows (it
+    *underflows* silently, which numpy's default errstate ignores), so this
+    is warning-free at any magnitude while returning the SAME values as the
+    naive form everywhere the naive form doesn't overflow — including
+    deeply negative rows whose relative magnitudes drive the OvA
+    normalization (a clip would collapse those to uniform; the C++ core's
+    double exp keeps them distinct)."""
+    z = np.exp(-np.abs(x))
+    return np.where(x >= 0, 1.0 / (1.0 + z), z / (1.0 + z))
 
 
 def _ova_normalize(p) -> np.ndarray:
@@ -402,17 +414,51 @@ def member_probs(estimator, X) -> np.ndarray:
     if (isinstance(estimator, SGDClassifier) and hasattr(estimator, "coef_")
             and estimator.loss == "log_loss"
             and estimator.coef_.shape[0] > 1):
-        # The matmul goes through BLAS sgemm (beats a scalar C loop
-        # measurably); only the OvA link + normalization is bespoke.
-        logits = (np.asarray(X, np.float32)
-                  @ estimator.coef_.T.astype(np.float32)
-                  + estimator.intercept_.astype(np.float32))
-        return _ova_normalize(1.0 / (1.0 + np.exp(-logits)))
+        return _ova_normalize(_sigmoid(_sgd_logits(estimator, X)))
     return estimator.predict_proba(np.asarray(X))
+
+
+def _sgd_logits(estimator, X) -> np.ndarray:
+    """Float32 OvA decision values for a fitted SGD-logistic estimator —
+    the one numerical kernel shared by ``member_probs`` (sigmoid link) and
+    ``member_predict`` (argmax).  The matmul goes through BLAS sgemm (beats
+    a scalar C loop measurably); only the link/normalization is bespoke."""
+    return (np.asarray(X, np.float32)
+            @ estimator.coef_.T.astype(np.float32)
+            + estimator.intercept_.astype(np.float32))
+
+
+def member_predict(estimator, X) -> np.ndarray | None:
+    """Fast ``predict`` for fitted sklearn GNB / SGD-logistic estimators, or
+    ``None`` when no native fast path applies (caller falls back to the
+    estimator's own ``predict``).
+
+    Matches sklearn's argmax semantics: GNB's ``predict`` is the posterior
+    argmax, and SGD-OvA's is the decision-function argmax — which the
+    per-class sigmoid link preserves (elementwise strictly increasing, then
+    a positive row normalization).  Only the float32 accumulation differs;
+    parity is pinned by ``tests/test_native.py``.  This is the
+    per-iteration evaluation hot path (``al/loop.py _evaluate`` — the
+    reference evaluates every member on the full test frame set every
+    iteration, ``amg_test.py:411-413``).
+    """
+    from sklearn.linear_model import SGDClassifier
+    from sklearn.naive_bayes import GaussianNB
+
+    if isinstance(estimator, GaussianNB) and hasattr(estimator, "theta_"):
+        p = gnb_predict_proba(X, estimator.theta_, estimator.var_,
+                              estimator.class_prior_)
+        return np.asarray(estimator.classes_)[p.argmax(axis=1)]
+    if (isinstance(estimator, SGDClassifier) and hasattr(estimator, "coef_")
+            and estimator.loss == "log_loss"
+            and estimator.coef_.shape[0] > 1):
+        return np.asarray(estimator.classes_)[
+            _sgd_logits(estimator, X).argmax(axis=1)]
+    return None
 
 
 __all__ = [
     "backend", "num_threads", "linear_predict_proba", "gnb_predict_proba",
     "segment_starts", "segment_mean", "row_entropy", "member_probs",
-    "gbdt_build_tree", "gbdt_predict_margins",
+    "member_predict", "gbdt_build_tree", "gbdt_predict_margins",
 ]

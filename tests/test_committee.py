@@ -70,6 +70,34 @@ def test_pool_probs_shape_and_blocks(rng):
     assert ((probs[:2] > 0) & (probs[:2] < 1)).all()
 
 
+def test_pool_probs_pad_to_contract(rng):
+    """``pad_to`` staging: the first n columns must equal the exact-width
+    call bit-for-bit (same key → same crops), the block must be exactly
+    (M, pad_to, C), and host tails must be well-formed rows."""
+    com = _committee(rng)
+    pool = _frame_pool(rng, n_songs=8, f=12)
+    waves = {s: rng.standard_normal(9000).astype(np.float32)
+             for s in pool.song_ids}
+    store = DeviceWaveformStore(waves, TINY.input_length)
+    ids = pool.song_ids[:5]
+    key = jax.random.key(3)
+    exact = np.asarray(com.pool_probs(pool, store, ids, key))
+    padded = np.asarray(com.pool_probs(pool, store, ids, key, pad_to=12))
+    assert padded.shape == (4, 12, NUM_CLASSES)
+    np.testing.assert_array_equal(padded[:, :5], exact)
+    # host-member staging columns are repeats of the last live column
+    np.testing.assert_array_equal(
+        padded[2:, 5:], np.repeat(padded[2:, 4:5], 7, axis=1))
+    # pure-host committees stage on host at the padded width too
+    com2 = _committee(rng, n_cnn=0)
+    p2 = com2.pool_probs(pool, None, ids, key, pad_to=12)
+    assert isinstance(p2, np.ndarray) and p2.shape == (2, 12, NUM_CLASSES)
+    import pytest
+
+    with pytest.raises(ValueError, match="pad_to"):
+        com.pool_probs(pool, store, ids, key, pad_to=3)
+
+
 def test_host_only_committee(rng):
     com = _committee(rng, n_cnn=0)
     pool = _frame_pool(rng, n_songs=6, f=12)

@@ -125,6 +125,74 @@ def test_fallback_matches_native(problem, rng, monkeypatch):
                                native_lin, rtol=1e-5, atol=1e-6)
 
 
+def test_member_predict_parity(problem):
+    # The evaluation hot path (al/loop.py _evaluate via Member.predict)
+    # must agree with sklearn's own predict on both native species.
+    X, y = problem
+    gnb = GaussianNB().fit(X, y)
+    sgd = SGDClassifier(loss="log_loss", random_state=0).fit(X, y)
+    for est in (gnb, sgd):
+        got = native.member_predict(est, X)
+        assert got is not None
+        np.testing.assert_array_equal(got, est.predict(X))
+
+
+def test_member_predict_subset_classes(problem):
+    # classes_ mapping: a member fitted on 2 of the 4 classes must return
+    # the ORIGINAL labels, not argmax slots.
+    X, y = problem
+    keep = np.isin(y, (1, 3))
+    gnb = GaussianNB().fit(X[keep], y[keep])
+    got = native.member_predict(gnb, X)
+    assert set(np.unique(got)) <= {1, 3}
+    np.testing.assert_array_equal(got, gnb.predict(X))
+
+
+def test_member_predict_declines_without_fast_path(problem):
+    from sklearn.tree import DecisionTreeClassifier
+
+    X, y = problem
+    assert native.member_predict(
+        DecisionTreeClassifier(max_depth=2).fit(X, y), X) is None
+
+
+def test_ova_sigmoid_saturates_without_overflow(problem):
+    # Saturated logits (|x| >> 88) used to overflow float32 exp in the
+    # numpy OvA path (63 RuntimeWarnings across the round-3 suite); the
+    # clipped sigmoid must stay warning-free and return exact 0/1 rows.
+    import warnings
+
+    X, y = problem
+    est = SGDClassifier(loss="log_loss", random_state=0).fit(X, y)
+    est.coef_ = est.coef_ * 1e4       # drive |logits| into the thousands
+    est.intercept_ = est.intercept_ * 1e4
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        p = native.member_probs(est, X)
+        lp = native.linear_predict_proba(
+            X * 1e3, est.coef_.T.astype(np.float32),
+            est.intercept_.astype(np.float32), mode="ova")
+    for out in (p, lp):
+        assert np.isfinite(out).all()
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_ova_saturated_rows_keep_relative_magnitudes():
+    # An all-rejecting row with DISTINCT magnitudes must normalize to the
+    # least-rejected class, not collapse to uniform (a naive clip would):
+    # the stable sigmoid preserves exp-scale ratios down to underflow,
+    # matching the C++ core's double-precision behavior within float32.
+    from consensus_entropy_tpu.native import _ova_normalize, _sigmoid
+
+    import warnings
+
+    row = np.array([[-61.0, -100.0, -200.0, -300.0]], np.float32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        p = _ova_normalize(_sigmoid(row))
+    np.testing.assert_allclose(p, [[1.0, 0.0, 0.0, 0.0]], atol=1e-12)
+
+
 def test_segment_starts_validation():
     with pytest.raises(ValueError):
         native.segment_mean(np.ones((4, 2), np.float32),
