@@ -30,11 +30,28 @@ def build_parser() -> argparse.ArgumentParser:
     sw.add_argument("--cnn-members", type=int, default=0,
                     help="add N tiny Flax CNN fold-members (synthetic tone "
                          "waveforms) so the sweep exercises the CNN "
-                         "scoring/retraining species too — a mechanical "
-                         "exercise of the full committee mix; members this "
-                         "weak are fragile under entropy-concentrated "
-                         "batches, so don't expect mc>rand here (see "
+                         "scoring/retraining species through the "
+                         "production loop; pair with enough "
+                         "--cnn-pretrain-epochs that the members are "
+                         "stable under entropy-concentrated batches (see "
                          "al/evidence.py make_committee)")
+    sw.add_argument("--cnn-pretrain-epochs", type=int, default=10,
+                    help="pretraining depth for the CNN fold-members; "
+                         "10-epoch members are weak enough to DEGRADE "
+                         "under uncertainty-targeted batches, deeper "
+                         "pretraining makes them benefit")
+    sw.add_argument("--cnn-retrain-epochs", type=int, default=5,
+                    help="CNN retrain epochs per AL iteration in the "
+                         "cnn-members sweep")
+    sw.add_argument("--cnn-pretrain-songs", type=int, default=None,
+                    metavar="N",
+                    help="pretrain each CNN fold-member on a deeper pool "
+                         "sample: N songs for each ABUNDANT class and "
+                         "~N/3 for each rare class (the GNB folds' 3:1 "
+                         "PRETRAIN_SONGS asymmetry; default: the folds' "
+                         "8-song slices).  The reference's CNN folds see "
+                         "whole DEAM CV folds, so a deeper sample is the "
+                         "closer analogue")
     sw.add_argument("--modes", default="mc,hc,mix,rand")
     sw.add_argument("--baseline", default="rand",
                     help="control mode for the paired tests; tests are "
@@ -85,10 +102,13 @@ def main(argv=None) -> int:
         cleanup = tempfile.TemporaryDirectory(prefix="ce_evidence_")
         workdir = cleanup.name
     try:
-        results = evidence.sweep(seeds, workdir, modes=modes,
-                                 queries=args.queries, epochs=args.epochs,
-                                 n_songs=args.songs,
-                                 cnn_members=args.cnn_members)
+        results = evidence.sweep(
+            seeds, workdir, modes=modes, queries=args.queries,
+            epochs=args.epochs, n_songs=args.songs,
+            cnn_members=args.cnn_members,
+            cnn_pretrain_epochs=args.cnn_pretrain_epochs,
+            cnn_retrain_epochs=args.cnn_retrain_epochs,
+            cnn_pretrain_songs=args.cnn_pretrain_songs)
     finally:
         if cleanup is not None:
             cleanup.cleanup()
@@ -102,7 +122,15 @@ def main(argv=None) -> int:
                        "queries": args.queries, "epochs": args.epochs,
                        "songs": args.songs,
                        "committee": ("5x gnb fold-members"
-                                     + (f" + {args.cnn_members}x tiny cnn"
+                                     + (f" + {args.cnn_members}x tiny cnn "
+                                        f"(pretrain "
+                                        f"{args.cnn_pretrain_epochs} ep"
+                                        + (f" on {args.cnn_pretrain_songs}"
+                                           "/abundant-class (3:1 rare)"
+                                           if args.cnn_pretrain_songs
+                                           else "")
+                                        + f", retrain "
+                                        f"{args.cnn_retrain_epochs} ep)"
                                         if args.cnn_members else "")),
                        "reference_row": "paper §4.1 (MC>RAND p=0.0291, "
                                         "d.f.=229)"},

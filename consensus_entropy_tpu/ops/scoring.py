@@ -84,6 +84,23 @@ def score_hc(hc_freq, hc_mask, *, k: int, tie_break: str = "fast") -> ScoreResul
     return ScoreResult(ent, values, indices)
 
 
+def score_hc_precomputed(hc_ent, hc_mask, *, k: int,
+                         tie_break: str = "fast") -> ScoreResult:
+    """hc acquisition over PRECOMPUTED row entropies.
+
+    The hc frequency table never changes across AL iterations — only its
+    mask shrinks (``amg_test.py:449-455`` recomputes ``scipy.stats.entropy``
+    over the same rows every iteration; the scores are loop-invariant).
+    Computing :func:`shannon_entropy` once at acquirer construction turns
+    the per-iteration hc chain into a pure masked top-k — identical
+    selections, a fraction of the work.  ``hc_ent``: ``(N,)`` from
+    ``shannon_entropy(hc_freq)``.
+    """
+    ent = jnp.where(jnp.asarray(hc_mask), jnp.asarray(hc_ent), -jnp.inf)
+    values, indices = masked_top_k(ent, hc_mask, k, tie_break)
+    return ScoreResult(ent, values, indices)
+
+
 def score_mix(member_probs, pool_mask, hc_freq, hc_mask, *, k: int,
               member_mask=None, tie_break: str = "fast") -> ScoreResult:
     """Hybrid acquisition: entropy over stacked [mc consensus; hc rows].
@@ -120,9 +137,12 @@ def score_rand(key, pool_mask, *, k: int) -> ScoreResult:
 
 def make_scoring_fns(*, k: int,
                      tie_break: str = "fast") -> dict[str, Callable]:
-    """Jit-compile the four acquisition scorers with ``k`` baked in.
+    """Jit-compile the acquisition scorers with ``k`` baked in.
 
-    Returns ``{'mc': fn, 'hc': fn, 'mix': fn, 'rand': fn}``.  Each fn is a
+    Returns ``{'mc', 'hc', 'hc_pre', 'mix', 'rand'}`` → fn; ``hc_pre``
+    (:func:`score_hc_precomputed`, top-k over hoisted entropies) is what
+    the production ``Acquirer`` hc path calls — ``hc`` is the one-shot
+    full chain.  Each fn is a
     ``jax.jit`` with static top-k width; callers pass device (or to-be-
     transferred host) arrays and get a :class:`ScoreResult` of device arrays.
     (Input-buffer donation is deliberately NOT used here: callers pass
@@ -131,6 +151,8 @@ def make_scoring_fns(*, k: int,
     """
     mc = jax.jit(functools.partial(score_mc, k=k, tie_break=tie_break))
     hc = jax.jit(functools.partial(score_hc, k=k, tie_break=tie_break))
+    hc_pre = jax.jit(functools.partial(score_hc_precomputed, k=k,
+                                       tie_break=tie_break))
     mix = jax.jit(functools.partial(score_mix, k=k, tie_break=tie_break))
     rand = jax.jit(functools.partial(score_rand, k=k))
-    return {"mc": mc, "hc": hc, "mix": mix, "rand": rand}
+    return {"mc": mc, "hc": hc, "hc_pre": hc_pre, "mix": mix, "rand": rand}

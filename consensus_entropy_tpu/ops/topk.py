@@ -36,13 +36,50 @@ def masked_top_k(scores, valid_mask, k: int, tie_break: str = "fast"):
     neg_inf = jnp.asarray(-jnp.inf, dtype=scores.dtype)
     masked = jnp.where(valid_mask, scores, neg_inf)
     if tie_break == "fast":
-        return lax.top_k(masked, k)
+        # the two-stage reduction IS lax.top_k semantics (ties included —
+        # see two_stage_top_k) but sort-bound on k·N/row candidates
+        # instead of N rows; it self-falls-back to the flat op when small.
+        # Caveat: slots whose value is -inf (fewer than k valid entries)
+        # may carry different — equally meaningless — indices than the
+        # flat op; callers gate on values > -inf (valid_count).
+        return two_stage_top_k(masked, k)
     if tie_break == "numpy":
         # Stable ascending argsort, reversed == numpy's argsort()[::-1].
         order = jnp.argsort(masked, stable=True)[::-1]
         idx = order[:k]
         return masked[idx], idx
     raise ValueError(f"unknown tie_break: {tie_break!r}")
+
+
+def two_stage_top_k(scores, k: int, *, row: int = 1024):
+    """``lax.top_k`` with 'fast' tie semantics via a candidate reduction.
+
+    Reshape the (padded) score vector to ``(N/row, row)``, take the per-row
+    top-k (at most ``k`` global winners can live in one row), then a final
+    top-k over the ``k·N/row`` candidates.  Same result as a flat
+    ``lax.top_k`` INCLUDING tie order: per-row top-k is index-stable, rows
+    are concatenated in index order, and the final top-k prefers earlier
+    candidates — so the lowest global index still wins among equal scores.
+
+    Exists because XLA's flat ``top_k`` at pool scale (N≈100k) costs ~0.9 ms
+    on one chip while touching only 0.4 MB — sort-bound, not HBM-bound; the
+    two-stage shape cuts the sorted span to ``k·N/row``.
+    """
+    scores = jnp.asarray(scores)
+    n = scores.shape[0]
+    if n <= row or k > row:  # nothing to split / rows too narrow
+        return lax.top_k(scores, k)
+    n_rows = -(-n // row)
+    pad = n_rows * row - n
+    neg_inf = jnp.asarray(-jnp.inf, dtype=scores.dtype)
+    padded = jnp.concatenate(
+        [scores, jnp.full((pad,), neg_inf, scores.dtype)]) if pad else scores
+    vr, ir = lax.top_k(padded.reshape(n_rows, row), k)
+    base = (jnp.arange(n_rows, dtype=ir.dtype) * row)[:, None]
+    flat_v = vr.reshape(-1)
+    flat_i = (ir + base).reshape(-1)
+    vv, j = lax.top_k(flat_v, k)
+    return vv, jnp.take(flat_i, j)
 
 
 def valid_count(values) -> jnp.ndarray:

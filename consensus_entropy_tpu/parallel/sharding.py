@@ -28,6 +28,7 @@ from consensus_entropy_tpu.ops.scoring import (
     ScoreResult,
     consensus_mean,
     score_hc,
+    score_hc_precomputed,
     score_mc,
     score_mix,
     score_rand,
@@ -56,6 +57,9 @@ def make_sharded_scoring_fns(mesh: Mesh, *, k: int, tie_break: str = "fast"):
     hc = jax.jit(
         functools.partial(score_hc, k=k, tie_break=tie_break),
         in_shardings=(table_s, vec_s), out_shardings=out_s)
+    hc_pre = jax.jit(
+        functools.partial(score_hc_precomputed, k=k, tie_break=tie_break),
+        in_shardings=(vec_s, vec_s), out_shardings=out_s)
     # mix concatenates the mc block and hc block along the row axis; the
     # concatenated entropy is left replicated (its layout is irregular).
     mix = jax.jit(
@@ -64,7 +68,8 @@ def make_sharded_scoring_fns(mesh: Mesh, *, k: int, tie_break: str = "fast"):
         out_shardings=mix_out_s)
     rand = jax.jit(functools.partial(score_rand, k=k),
                    in_shardings=(repl, vec_s), out_shardings=out_s)
-    return {"mc": mc, "hc": hc, "mix": mix, "rand": rand}
+    return {"mc": mc, "hc": hc, "hc_pre": hc_pre, "mix": mix,
+            "rand": rand}
 
 
 def _merge_local_topk(v, i, local_n: int, k: int):

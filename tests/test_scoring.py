@@ -75,6 +75,33 @@ def test_hc_parity(rng):
                                   np.argsort(got_ent)[::-1][:10])
 
 
+def test_hc_precomputed_matches_score_hc(rng):
+    """The production hc path (entropy hoisted out of the loop,
+    ``score_hc_precomputed``) must produce identical entropies/selections
+    to the full per-iteration chain across shrinking masks — including
+    all-zero padding rows sitting behind the mask."""
+    from consensus_entropy_tpu.ops.entropy import shannon_entropy
+
+    counts = rng.integers(0, 30, size=(64, 4)) + 1
+    freq = np.zeros((80, 4), np.float32)  # rows 64.. are all-zero padding
+    freq[:64] = np.round(counts / counts.sum(axis=1, keepdims=True), 3)
+    mask = np.zeros(80, bool)
+    mask[:64] = True
+    ent_once = np.asarray(shannon_entropy(freq))
+    # zero padding rows come out finite (-0.0: the 0*log0 clamp) and sit
+    # behind the mask either way
+    assert np.all(ent_once[64:] == 0.0)
+    for _ in range(3):
+        full = scoring.score_hc(freq, mask, k=7, tie_break="numpy")
+        pre = scoring.score_hc_precomputed(ent_once, mask, k=7,
+                                           tie_break="numpy")
+        np.testing.assert_array_equal(np.asarray(pre.indices),
+                                      np.asarray(full.indices))
+        np.testing.assert_allclose(np.asarray(pre.values),
+                                   np.asarray(full.values), rtol=1e-6)
+        mask[np.asarray(pre.indices)] = False
+
+
 def test_hc_query_removal_via_mask(rng):
     # Reference removes queried rows from the hc table (amg_test.py:455);
     # here that's a mask update, and re-scoring must pick the next tier.

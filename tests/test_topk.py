@@ -1,5 +1,6 @@
 """Masked top-k: reference tie semantics (np.argsort reversed) and masking."""
 
+import jax.numpy as jnp
 import numpy as np
 
 from consensus_entropy_tpu.ops.topk import masked_top_k, valid_count
@@ -53,3 +54,49 @@ def test_fewer_valid_than_k():
     v, idx = masked_top_k(scores, mask, 5, tie_break="fast")
     assert int(valid_count(v)) == 3
     np.testing.assert_array_equal(np.asarray(idx)[:3], [2, 1, 0])
+
+
+def test_two_stage_matches_flat_top_k(rng):
+    """two_stage_top_k must equal lax.top_k exactly — values AND indices,
+    tie order included — on pools spanning the split threshold."""
+    from jax import lax
+
+    from consensus_entropy_tpu.ops.topk import two_stage_top_k
+
+    for n in (100, 1024, 1025, 4096, 100_000):
+        scores = rng.uniform(size=n).astype(np.float32)
+        v2, i2 = two_stage_top_k(scores, 10)
+        vf, if_ = lax.top_k(jnp.asarray(scores), 10)
+        np.testing.assert_array_equal(np.asarray(v2), np.asarray(vf))
+        np.testing.assert_array_equal(np.asarray(i2), np.asarray(if_))
+
+
+def test_two_stage_tie_order_matches_flat(rng):
+    """Exact ties are the hc regime (3-decimal-rounded tables → identical
+    entropies): the candidate reduction must keep 'lowest index wins',
+    byte-identical to the flat op — including ties straddling row
+    boundaries and >k ties inside one row."""
+    from jax import lax
+
+    from consensus_entropy_tpu.ops.topk import two_stage_top_k
+
+    n = 5000
+    scores = np.round(rng.uniform(size=n), 2).astype(np.float32)  # ~100 ties/value
+    scores[1020:1030] = 2.0  # >k block of ties straddling the 1024 boundary
+    v2, i2 = two_stage_top_k(scores, 7)
+    vf, if_ = lax.top_k(jnp.asarray(scores), 7)
+    np.testing.assert_array_equal(np.asarray(v2), np.asarray(vf))
+    np.testing.assert_array_equal(np.asarray(i2), np.asarray(if_))
+
+
+def test_masked_fast_path_large_pool(rng):
+    """masked_top_k 'fast' (which routes through the two-stage reduction at
+    pool scale) vs a numpy oracle on a masked 50k pool."""
+    n = 50_000
+    scores = rng.uniform(size=n).astype(np.float32)
+    mask = rng.uniform(size=n) < 0.7
+    v, idx = masked_top_k(scores, mask, 10, tie_break="fast")
+    masked = np.where(mask, scores, -np.inf)
+    want_idx = np.argsort(masked, kind="stable")[::-1][:10]
+    np.testing.assert_allclose(np.asarray(v), masked[want_idx])
+    assert set(np.asarray(idx)) == set(want_idx)
