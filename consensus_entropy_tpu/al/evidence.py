@@ -66,8 +66,16 @@ CLASS_P = (0.35, 0.35, 0.15, 0.15)
 PRETRAIN_SONGS = {0: 3, 1: 3, 2: 1, 3: 1}
 
 
+def familiar_timbre(song_id: str) -> bool:
+    """Even-index songs carry the CNN pretraining corpus's timbre (sine);
+    odd-index songs are the unfamiliar square-wave timbre the committee
+    must discover through acquisition (see ``make_user``)."""
+    return int(song_id[4:]) % 2 == 0
+
+
 def make_user(seed: int, *, n_songs: int = 250, n_feat: int = 12,
-              sep: float = 3.0, hard_delta: float = 0.9, off: float = 0.5,
+              sep: float = 3.0, hard_delta: float = 0.9,
+              easy_delta: float | None = None, off: float = 0.5,
               noise: float = 0.7, tau: float = 1.0,
               waves: bool = False) -> UserData:
     """One synthetic user: two easy, abundant classes plus a rare
@@ -78,10 +86,17 @@ def make_user(seed: int, *, n_songs: int = 250, n_feat: int = 12,
     pair under a tight budget.  Ambiguity from irreducible label noise
     instead (large song offsets) actively punishes uncertainty sampling:
     entropy then selects songs whose labels carry no information, and
-    incremental updates on them corrupt the members.  (A two-pair variant
-    — ambiguity spread across all four classes — was tried in round 4 and
-    rejected: it pushes the abundant pair into the irreducible-noise
-    regime and flips mc<rand even for the GNB committee.)
+    incremental updates on them corrupt the members.
+
+    ``easy_delta`` (CNN-committee sweeps): additionally place class 1's
+    center ``easy_delta`` from class 0's — a MILD, learnable ambiguity in
+    the abundant pair, so committee uncertainty (and hence the query
+    batches) spans all four classes.  Batch class-diversity is what
+    batch-only BCE retraining of CNN members needs: with the single rare
+    pair, every mc batch is classes 2/3 and the CNN's absent sigmoid heads
+    decay (measured in the round-4 pilots).  Keep it well above the
+    irreducible-noise floor (≈1.7 at the default off/noise flips mc<rand
+    even for GNB members; ≥2.0 stays learnable).
 
     The HC table models annotator disagreement tracking genuine ambiguity
     (the AMG1608 situation): per-song quadrant frequencies follow a softmax
@@ -90,6 +105,9 @@ def make_user(seed: int, *, n_songs: int = 250, n_feat: int = 12,
     """
     rng = np.random.default_rng(seed)
     centers = rng.standard_normal((4, n_feat)).astype(np.float32) * sep
+    if easy_delta is not None:
+        d01 = rng.standard_normal(n_feat).astype(np.float32)
+        centers[1] = centers[0] + d01 * (easy_delta / np.linalg.norm(d01))
     d = rng.standard_normal(n_feat).astype(np.float32)
     centers[3] = centers[2] + d * (hard_delta / np.linalg.norm(d))
     rows, sids, labels = [], [], {}
@@ -113,8 +131,22 @@ def make_user(seed: int, *, n_songs: int = 250, n_feat: int = 12,
     hc = hc[[order[s] for s in pool.song_ids]]
     store = None
     if waves:
-        # class-dependent tones (hard pair near-adjacent in pitch) so CNN
-        # members face the same ambiguity structure as the feature members
+        # Class-dependent tones in TWO timbres: even-index songs are pure
+        # sines ("familiar"), odd-index songs are square waves at the SAME
+        # class f0 ("unfamiliar" — rich odd harmonics).  CNN fold-members
+        # pretrain on sine songs only (``make_committee``): the committee
+        # then starts flat on half the pool — clean, perfectly LEARNABLE
+        # material spanning every class.  That is the regime where CNN
+        # members benefit from uncertainty sampling: entropy routes the
+        # label budget to the unfamiliar timbre across all classes (batch
+        # stays class-diverse, gradients are clean), while random spends
+        # half its budget on songs the members already score perfectly.
+        # Round-4 pilots measured the two failure modes this dodges:
+        # class-concentrated hard-pair batches starve the absent BCE
+        # sigmoid heads, and low-SNR "hard songs" are irreducible noise
+        # whose gradients corrupt the trunk.  The analogue is real: the
+        # DEAM pretraining corpus does not cover a personal library's
+        # production styles, and AL must target the unfamiliar material.
         from consensus_entropy_tpu.data.audio import DeviceWaveformStore
 
         wave_dict = {}
@@ -122,9 +154,11 @@ def make_user(seed: int, *, n_songs: int = 250, n_feat: int = 12,
             n = CNN_CFG.input_length + int(rng.integers(200, 1200))
             t = np.arange(n) / CNN_CFG.sample_rate
             f = TONE_FREQS[c] * (1.0 + 0.01 * rng.standard_normal())
+            tone = np.sin(2 * np.pi * f * t)
+            if not familiar_timbre(f"song{i:04d}"):
+                tone = np.sign(tone) * 0.8
             wave_dict[f"song{i:04d}"] = (
-                np.sin(2 * np.pi * f * t)
-                + 0.3 * rng.standard_normal(n)).astype(np.float32)
+                tone + 0.3 * rng.standard_normal(n)).astype(np.float32)
         store = DeviceWaveformStore(wave_dict, CNN_CFG.input_length)
     return UserData(f"seed{seed}", pool, labels, hc_rows=hc, store=store)
 
@@ -180,7 +214,18 @@ def make_committee(seed: int, data: UserData, *, folds: int = 5,
         from consensus_entropy_tpu.models.committee import CNNMember
 
         trainer = CNNTrainer(CNN_CFG, CNN_PRETRAIN)
+        # CNN folds pretrain on the FAMILIAR timbre only — the pretraining
+        # corpus (DEAM in the reference) does not cover the user library's
+        # unfamiliar production styles; discovering those is acquisition's
+        # job (make_user's two-timbre pool).
+        by_class = {c: [s for s in pool_c if familiar_timbre(s)]
+                    for c, pool_c in by_class.items()}
         for f in range(cnn_members):
+            # default branch: the GNB fold's full 8-song slice (all classes
+            # covered; the familiar-timbre restriction applies only to the
+            # per-class-sampled branch below, where max(1, …) guarantees
+            # coverage — filtering the tiny fold slice could empty a rare
+            # class or the whole set)
             songs = fold_songs[f % folds]
             if cnn_pretrain_songs:
                 # The reference's CNN fold-members pretrain on whole DEAM
@@ -210,11 +255,14 @@ def make_committee(seed: int, data: UserData, *, folds: int = 5,
 def run_one(seed: int, mode: str, workdir: str, *, queries: int = 5,
             epochs: int = 8, n_songs: int = 250, cnn_members: int = 0,
             cnn_pretrain_epochs: int = 10, cnn_retrain_epochs: int = 5,
-            cnn_pretrain_songs: int | None = None) -> list[list[float]]:
+            cnn_pretrain_songs: int | None = None,
+            easy_delta: float | None = None,
+            hard_delta: float = 0.9) -> list[list[float]]:
     """One (seed, mode) AL run through the production loop; returns the
     per-epoch PER-MEMBER F1 lists from metrics.jsonl (epoch0 baseline
     included)."""
-    data = make_user(seed, n_songs=n_songs, waves=cnn_members > 0)
+    data = make_user(seed, n_songs=n_songs, waves=cnn_members > 0,
+                     easy_delta=easy_delta, hard_delta=hard_delta)
     committee = make_committee(seed, data, cnn_members=cnn_members,
                                cnn_pretrain_epochs=cnn_pretrain_epochs,
                                cnn_pretrain_songs=cnn_pretrain_songs)
@@ -240,6 +288,7 @@ def sweep(seeds: Sequence[int], workdir: str, *, modes=MODES,
           queries: int = 5, epochs: int = 8, n_songs: int = 250,
           cnn_members: int = 0, cnn_pretrain_epochs: int = 10,
           cnn_retrain_epochs: int = 5, cnn_pretrain_songs: int | None = None,
+          easy_delta: float | None = None, hard_delta: float = 0.9,
           log=print) -> dict:
     """Matched-budget mode sweep: every mode sees the same user, committee
     state, split, and query budget per seed.  Returns
@@ -252,7 +301,8 @@ def sweep(seeds: Sequence[int], workdir: str, *, modes=MODES,
                 n_songs=n_songs, cnn_members=cnn_members,
                 cnn_pretrain_epochs=cnn_pretrain_epochs,
                 cnn_retrain_epochs=cnn_retrain_epochs,
-                cnn_pretrain_songs=cnn_pretrain_songs)
+                cnn_pretrain_songs=cnn_pretrain_songs,
+                easy_delta=easy_delta, hard_delta=hard_delta)
             final = float(np.mean(results[mode][seed][-1]))
             log(f"  seed {seed} {mode:4s}: final mean F1 = {final:.4f}")
     return results

@@ -43,6 +43,14 @@ def build_parser() -> argparse.ArgumentParser:
     sw.add_argument("--cnn-retrain-epochs", type=int, default=5,
                     help="CNN retrain epochs per AL iteration in the "
                          "cnn-members sweep")
+    sw.add_argument("--easy-delta", type=float, default=None,
+                    help="place class 1's center this far from class 0's "
+                         "(mild learnable ambiguity in the abundant pair "
+                         "so query batches span classes; default: off — "
+                         "see al/evidence.py make_user)")
+    sw.add_argument("--hard-delta", type=float, default=0.9,
+                    help="distance between the rare confusable pair's "
+                         "centers (make_user hard_delta)")
     sw.add_argument("--cnn-pretrain-songs", type=int, default=None,
                     metavar="N",
                     help="pretrain each CNN fold-member on a deeper pool "
@@ -108,7 +116,8 @@ def main(argv=None) -> int:
             cnn_members=args.cnn_members,
             cnn_pretrain_epochs=args.cnn_pretrain_epochs,
             cnn_retrain_epochs=args.cnn_retrain_epochs,
-            cnn_pretrain_songs=args.cnn_pretrain_songs)
+            cnn_pretrain_songs=args.cnn_pretrain_songs,
+            easy_delta=args.easy_delta, hard_delta=args.hard_delta)
     finally:
         if cleanup is not None:
             cleanup.cleanup()
@@ -121,6 +130,8 @@ def main(argv=None) -> int:
         "experiment": {"seeds": len(seeds), "modes": list(modes),
                        "queries": args.queries, "epochs": args.epochs,
                        "songs": args.songs,
+                       "easy_delta": args.easy_delta,
+                       "hard_delta": args.hard_delta,
                        "committee": ("5x gnb fold-members"
                                      + (f" + {args.cnn_members}x tiny cnn "
                                         f"(pretrain "

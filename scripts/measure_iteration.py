@@ -238,9 +238,13 @@ def main(argv=None) -> int:
         "note": "two identically shaped users share one process: user 0 "
                 "pays every XLA compile (cold), user 1 reuses the caches "
                 "(warm = steady state); compile_s per phase is the "
-                "cold-warm total delta.  This chip's wall-clock drifts up "
-                "to ~2x run-to-run (tunnel), so compare phase STRUCTURE "
-                "across artifacts, not absolute seconds",
+                "cold-warm total delta.  'score' only DISPATCHES the "
+                "async CNN pool forward; 'select' drains it at its first "
+                "device sync, so the forward's execute time lands in "
+                "select by design (the async overlap is the point).  "
+                "This chip's wall-clock drifts up to ~2x run-to-run "
+                "(tunnel), so compare phase STRUCTURE across artifacts, "
+                "not absolute seconds",
         "settings": {"queries": args.queries, "epochs": args.epochs,
                      "mode": "mc", "songs": args.songs,
                      "retrain_epochs": args.retrain_epochs or "default(100)",
