@@ -112,6 +112,19 @@ class EpochResult:
     improved: bool
 
 
+#: Process-wide jitted epoch programs, keyed by
+#: (config, train_config, phase, shapes[, mesh]).  Module-level, NOT per
+#: trainer: a fresh :class:`CNNTrainer` is built per user (each user's
+#: committee is a new object, ``amg_test.py:146-171`` semantics), and a
+#: per-instance cache made every user re-trace and re-compile the full
+#: retrain program — measured as ~104 s of the warm user's first
+#: ``retrain_cnn`` phase in ``ITERATION_r04``.  The epoch closures are
+#: fully determined by the two frozen configs + shape key (the captured
+#: ``ShortChunkCNN``/optax tx are pure functions of them), so sharing
+#: across trainer instances is sound.
+_EPOCH_FNS: dict[tuple, Callable] = {}
+
+
 class CNNTrainer:
     """Drives pre-training and AL retraining of one CNN member."""
 
@@ -120,7 +133,6 @@ class CNNTrainer:
         self.config = config
         self.train_config = train_config
         self.model = ShortChunkCNN(config)
-        self._epoch_fns: dict[str, Callable] = {}
 
     # -- jitted epoch step (built per phase, cached) -----------------------
 
@@ -217,12 +229,13 @@ class CNNTrainer:
         # each epoch (padding rows still enter train-mode BatchNorm stats,
         # the one unavoidable deviation from a genuinely shorter batch).
         batch_size = max(1, min(batch_size, n_train))
-        key_ = (phase, n_train, n_test, batch_size)
-        if key_ in self._epoch_fns:
-            return self._epoch_fns[key_]
+        key_ = (self.config, self.train_config, phase, n_train, n_test,
+                batch_size)
+        if key_ in _EPOCH_FNS:
+            return _EPOCH_FNS[key_]
         epoch = self._build_epoch(phase, n_train, n_test, batch_size)
         fn = jax.jit(epoch, donate_argnums=(0, 1, 2, 3, 4))
-        self._epoch_fns[key_] = fn
+        _EPOCH_FNS[key_] = fn
         return fn
 
     def _epoch_fn_many(self, phase: str, n_train: int, n_test: int,
@@ -234,9 +247,10 @@ class CNNTrainer:
         the ``member`` axis (each chip trains its member slice)."""
         batch_size = max(1, min(batch_size, n_train))
         # Mesh hashes by value: an equal mesh rebuilt per AL round still hits
-        key_ = ("many", phase, n_train, n_test, batch_size, mesh)
-        if key_ in self._epoch_fns:
-            return self._epoch_fns[key_]
+        key_ = (self.config, self.train_config, "many", phase, n_train,
+                n_test, batch_size, mesh)
+        if key_ in _EPOCH_FNS:
+            return _EPOCH_FNS[key_]
         epoch = self._build_epoch(phase, n_train, n_test, batch_size)
         # args: params, stats, opt, best_p, best_s, best_score are
         # member-stacked; data, lengths, rows, y broadcast; key per member.
@@ -276,7 +290,7 @@ class CNNTrainer:
                 in_shardings=(member,) * 6 + (repl,) * 6 + (member,),
                 out_shardings=(member,) * 6 + (repl,) * 5,
                 donate_argnums=(0, 1, 2, 3, 4))
-        self._epoch_fns[key_] = fn
+        _EPOCH_FNS[key_] = fn
         return fn
 
     # -- host-level loop ---------------------------------------------------

@@ -20,6 +20,7 @@ TPU-native shape of the same computation:
 
 from __future__ import annotations
 
+import functools
 import os
 from typing import Sequence
 
@@ -161,6 +162,65 @@ class CNNMember(Member):
                    config, train_config)
 
 
+def _concat_member_blocks(blocks):
+    """``axis=1`` concat of ``(M, n, C)`` member-prob blocks.
+
+    Blocks are homogeneous: all-numpy when a multi-host gather already
+    brought them to host (stay there — re-uploading just to concat wastes
+    a transfer), all-``jax.Array`` otherwise (concat on device)."""
+    if len(blocks) == 1:
+        return blocks[0]
+    xp = np if isinstance(blocks[0], np.ndarray) else jnp
+    return xp.concatenate(blocks, axis=1)
+
+
+@functools.lru_cache(maxsize=None)
+def _infer_fns(config: CNNConfig, mesh):
+    """Process-wide jitted committee-inference programs for ``config``.
+
+    Returns ``(infer, infer_windows)``: the stacked-member crop forward and
+    the window-grid masked-mean forward, optionally pool-sharded over
+    ``mesh``.  Module-level and ``lru_cache``'d because a fresh
+    :class:`Committee` is built PER USER in the AL run (the reference
+    re-copies the committee per user, ``amg_test.py:146-171``) — per-
+    instance ``jax.jit`` objects made every user re-trace AND re-compile
+    the full-geometry forward (~15-30 s on the TPU, measured as the warm
+    user's entire first-iteration ``score`` phase in ``ITERATION_r04``).
+    The programs close over ``config`` only (frozen dataclass, hashes by
+    value) and take the stacked params as an argument, so sharing across
+    committees is sound and retraining needs no cache flush; ``Mesh``
+    hashes by value, so an equal mesh rebuilt per round still hits.
+    """
+
+    def infer(stacked, x):
+        return short_cnn.committee_infer(stacked, x, config)
+
+    def windows_forward(stacked, windows, valid):
+        # (R, W, L) windows + (R, W) mask -> (M, R, C) masked window mean
+        r, w, length = windows.shape
+        flat = short_cnn.committee_infer(
+            stacked, windows.reshape(r * w, length), config)
+        probs = flat.reshape(flat.shape[0], r, w, flat.shape[-1])
+        weight = valid.astype(probs.dtype)
+        return (jnp.einsum("mrwc,rw->mrc", probs, weight)
+                / jnp.sum(weight, axis=1)[None, :, None])
+
+    if mesh is None:
+        return jax.jit(infer), jax.jit(windows_forward)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from consensus_entropy_tpu.parallel.mesh import POOL_AXIS
+
+    repl = NamedSharding(mesh, P())
+    rows_sh = NamedSharding(mesh, P(POOL_AXIS))
+    out_sh = NamedSharding(mesh, P(None, POOL_AXIS, None))
+    return (jax.jit(infer, in_shardings=(repl, rows_sh),
+                    out_shardings=out_sh),
+            jax.jit(windows_forward, in_shardings=(repl, rows_sh, rows_sh),
+                    out_shardings=out_sh))
+
+
 class Committee:
     """The user's private committee: M_host sklearn + M_cnn Flax members.
 
@@ -236,38 +296,13 @@ class Committee:
         #: params as an argument, so retraining needs no cache flush
         self._seq_scorers: dict = {}
 
-        def infer(stacked, x):
-            return short_cnn.committee_infer(stacked, x, self.config)
-
         if mesh is None:
             self._n_pool_shards = 1
-            self._infer = jax.jit(infer)
-            self._infer_windows = jax.jit(self._windows_forward)
         else:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
             from consensus_entropy_tpu.parallel.mesh import POOL_AXIS
 
             self._n_pool_shards = mesh.shape[POOL_AXIS]
-            repl = NamedSharding(mesh, P())
-            rows_sh = NamedSharding(mesh, P(POOL_AXIS))
-            out_sh = NamedSharding(mesh, P(None, POOL_AXIS, None))
-            self._infer = jax.jit(infer, in_shardings=(repl, rows_sh),
-                                  out_shardings=out_sh)
-            self._infer_windows = jax.jit(
-                self._windows_forward,
-                in_shardings=(repl, rows_sh, rows_sh),
-                out_shardings=out_sh)
-
-    def _windows_forward(self, stacked, windows, valid):
-        """(R, W, L) windows + (R, W) mask -> (M, R, C) masked window mean."""
-        r, w, length = windows.shape
-        flat = short_cnn.committee_infer(
-            stacked, windows.reshape(r * w, length), self.config)
-        probs = flat.reshape(flat.shape[0], r, w, flat.shape[-1])
-        weight = valid.astype(probs.dtype)
-        return (jnp.einsum("mrwc,rw->mrc", probs, weight)
-                / jnp.sum(weight, axis=1)[None, :, None])
+        self._infer, self._infer_windows = _infer_fns(self.config, mesh)
 
     # -- multi-host feeds (no-ops single-process) --------------------------
 
@@ -591,8 +626,22 @@ class Committee:
             rows_in = np.concatenate([rows, np.repeat(rows[-1:], pad)]) \
                 if pad else rows
             crops = store.sample_crops(key, rows_in)
-            out = self._gather_rows(self._infer(
-                self._feed_repl(self._stacked()), self._feed_rows(crops)))
+            stacked = self._feed_repl(self._stacked())
+            # Forward in BUCKET-wide sub-dispatches, not one batch: at full
+            # geometry the first conv block materializes ~15 MB/member-crop,
+            # so a single dispatch over a >=1536-crop pool (a user with
+            # ~1300+ annotated train songs) exceeds the 16 GB HBM and fails
+            # to COMPILE (measured: f32[1536,128,231,128] = 23.3 GB
+            # allocation rejected on v5e).  Bucket-wide slices bound the
+            # transient to ~3.9 GB for ANY pool size, compile ONE forward
+            # program ever (every slice is exactly `bucket` wide), and cost
+            # ~3% vs the fused batch at 512 crops (measured 306 vs 298 ms).
+            # Crops are SAMPLED at the full width first, so the random
+            # stream is identical to the unsliced batch.
+            sub = [self._gather_rows(self._infer(stacked, self._feed_rows(
+                jax.lax.dynamic_slice_in_dim(crops, lo, bucket))))
+                   for lo in range(0, crops.shape[0], bucket)]
+            out = _concat_member_blocks(sub)
             # slice to the STAGING width, not the live width: the bucket
             # quantizes the slice program to ~n_pad/256 shapes per run
             keep = len(rows) if pad_to is None else pad_to
@@ -622,12 +671,7 @@ class Committee:
             out = self._gather_rows(self._infer_windows(
                 stacked, self._feed_rows(windows), self._feed_rows(valid)))
             blocks.append(out[:, : out.shape[1] - pad])
-        if len(blocks) == 1:
-            out = blocks[0]
-        elif isinstance(blocks[0], np.ndarray):  # multi-host: gathered to
-            out = np.concatenate(blocks, axis=1)  # host; stay there
-        else:
-            out = jnp.concatenate(blocks, axis=1)
+        out = _concat_member_blocks(blocks)
         if pad_to is not None and pad_to > out.shape[1]:
             # window-grid path: extend with repeats of the last real column
             # (same tail contract as the crop path's bucket padding)

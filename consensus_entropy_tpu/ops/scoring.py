@@ -148,7 +148,22 @@ def make_scoring_fns(*, k: int,
     (Input-buffer donation is deliberately NOT used here: callers pass
     host numpy tables that jit transfers per call, so there is no device
     buffer to reuse.)
+
+    ``lru_cache``: one ``Acquirer`` is built PER USER in the AL run
+    (``amg_test.py:347`` re-creates per-user state), and a fresh ``jax.jit``
+    object per user would retrace and recompile the scoring graph 46 times
+    per run.  The fns are pure in their array arguments, so sharing them
+    process-wide is sound; callers must not mutate the returned dict.
+    The public wrapper normalizes the call signature before the cache, so
+    ``make_scoring_fns(k=10)`` and ``make_scoring_fns(k=10,
+    tie_break="fast")`` share one entry (a raw ``lru_cache`` keys on the
+    literal argument tuple and would silently duplicate the programs).
     """
+    return _make_scoring_fns_cached(k, tie_break)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_scoring_fns_cached(k: int, tie_break: str) -> dict[str, Callable]:
     mc = jax.jit(functools.partial(score_mc, k=k, tie_break=tie_break))
     hc = jax.jit(functools.partial(score_hc, k=k, tie_break=tie_break))
     hc_pre = jax.jit(functools.partial(score_hc_precomputed, k=k,

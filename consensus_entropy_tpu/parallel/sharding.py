@@ -43,7 +43,18 @@ def make_sharded_scoring_fns(mesh: Mesh, *, k: int, tie_break: str = "fast"):
     sharded; hc table ``(N, C)`` sharded on N.  Results replicate (they are
     ``k``-sized or consumed host-side).  ``N`` must be divisible by the mesh's
     pool-axis size (the pad-to-fixed-shape step guarantees this).
+
+    ``lru_cache`` (``Mesh`` hashes by value, so an equal mesh rebuilt per
+    user still hits): a fresh jit per ``Acquirer`` would recompile the
+    sharded scoring graphs once per user of the 46-user AL run.  Callers
+    must not mutate the returned dict.  The wrapper normalizes the call
+    signature before the cache (see :func:`ops.scoring.make_scoring_fns`).
     """
+    return _make_sharded_scoring_fns_cached(mesh, k, tie_break)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_sharded_scoring_fns_cached(mesh: Mesh, k: int, tie_break: str):
     probs_s = NamedSharding(mesh, P(None, POOL_AXIS, None))
     vec_s = NamedSharding(mesh, P(POOL_AXIS))
     table_s = NamedSharding(mesh, P(POOL_AXIS, None))

@@ -206,12 +206,18 @@ def main(argv=None) -> int:
             "median_s": round(float(np.median(c)), 4),
             "mean_s": round(float(np.mean(c)), 4),
             "total_s": round(float(np.sum(c)), 2),
+            # raw per-iteration series: lets a reader attribute any
+            # mean>median gap to a SPECIFIC iteration (first-touch setup,
+            # bucket transition, tunnel hiccup) instead of guessing from
+            # aggregates
+            "per_iteration_s": [round(float(v), 3) for v in c],
         }
         if w:
             entry.update({
                 "warm_median_s": round(float(np.median(w)), 4),
                 "warm_mean_s": round(float(np.mean(w)), 4),
                 "warm_total_s": round(float(np.sum(w)), 2),
+                "warm_per_iteration_s": [round(float(v), 3) for v in w],
                 # same shapes + same process ⇒ the cold run's excess over
                 # the warm run is (almost entirely) XLA compilation
                 "compile_s": round(float(np.sum(c) - np.sum(w)), 2),
@@ -228,8 +234,30 @@ def main(argv=None) -> int:
 
     configure_device(args.device)  # report the device the CLIs actually used
     import jax
+    import jax.numpy as jnp
+    import time as _time
 
     devs = jax.devices()
+
+    # Device->host bandwidth probe: the per-iteration checkpoint defers a
+    # ~(members x params) device_get to a background thread, so on a
+    # tunneled chip with slow d2h that traffic surfaces inside the NEXT
+    # iteration's first device sync (select/retrain) — measured at
+    # ~9 MB/s on the axon loopback relay vs GB/s on a real TPU host.
+    # Committing the measured bandwidth lets a reader subtract the
+    # environment from the phase numbers.  A fresh buffer per rep: jax
+    # caches the host copy of a fetched array, so re-fetching one array
+    # measures nothing.
+    d2h = []
+    if devs[0].platform != "cpu":  # on cpu the "link" is host memcpy —
+        for rep in range(3):       # recording it would mislead a reader
+            buf = jnp.full((16, 1 << 20), float(rep), jnp.float32)  # 64 MB
+            buf.block_until_ready()
+            t0 = _time.perf_counter()
+            jax.device_get(buf)
+            d2h.append(buf.nbytes / (_time.perf_counter() - t0) / 1e6)
+            del buf
+        d2h = d2h[1:]  # rep 0 pays one-time transfer-path setup
     report = {
         "metric": "al_iteration_wall_clock_production",
         "value": round(warm_mean_iter if warm_mean_iter is not None
@@ -265,6 +293,14 @@ def main(argv=None) -> int:
             if warm_total else None,
         },
         "platform": devs[0].platform, "device_kind": devs[0].device_kind,
+        # median of the post-warmup fresh-buffer reps; the async checkpoint
+        # ships ~5 members' full variables (~75 MB at reference geometry)
+        # per iteration over this path, hidden behind the next iteration's
+        # compute — at GB/s (real host) invisible, at ~9 MB/s (tunnel)
+        # it IS most of the warm select/retrain excess over pure compute.
+        # null on --device cpu (no device link to measure).
+        "d2h_bandwidth_MB_s": round(float(np.median(d2h)), 1) if d2h
+        else None,
     }
     with open(args.out, "w") as fh:
         json.dump(report, fh, indent=2)

@@ -178,3 +178,78 @@ def test_host_scoring_restricted_to_live_songs(rng):
     com.pool_probs(pool, None, live, jax.random.key(0))
     assert seen["n"] == sum(pool.count_of(s) for s in live)
     assert seen["n"] < len(pool.X)
+
+
+def test_jit_programs_shared_across_committee_instances(rng):
+    # A fresh Committee is built per user in the AL run; its inference
+    # programs must be the SAME process-wide jit objects (module-level
+    # lru_cache keyed by the frozen config), or every user re-traces and
+    # re-compiles the full-geometry forward (~15-30 s/user on the TPU —
+    # the warm user's entire first-iteration `score` in ITERATION_r04).
+    c1 = _committee(rng)
+    c2 = _committee(rng)
+    assert c1._infer is c2._infer
+    assert c1._infer_windows is c2._infer_windows
+    # ...and a different architecture must NOT share programs
+    other = CNNConfig(n_channels=8, n_mels=32, n_layers=5, input_length=8192)
+    cnns = [CNNMember("c", short_cnn.init_variables(jax.random.key(9), other),
+                      other)]
+    c3 = Committee([], cnns, other, TrainConfig(batch_size=2))
+    assert c3._infer is not c1._infer
+
+
+def test_epoch_programs_shared_across_trainer_instances():
+    # Same contract for the retrain programs: per-user CNNTrainer instances
+    # (one per committee) must hit one module-level cache — a per-instance
+    # cache cost the warm user ~104 s of re-trace+re-compile on its first
+    # retrain_cnn phase (ITERATION_r04).
+    from consensus_entropy_tpu.models.cnn_trainer import CNNTrainer
+
+    tc = TrainConfig(batch_size=2)
+    t1 = CNNTrainer(TINY, tc)
+    t2 = CNNTrainer(TINY, tc)
+    assert t1._epoch_fn("adam", 4, 2, 2) is t2._epoch_fn("adam", 4, 2, 2)
+    assert (t1._epoch_fn_many("adam", 4, 2, 2)
+            is t2._epoch_fn_many("adam", 4, 2, 2))
+    # distinct shape keys stay distinct programs
+    assert t1._epoch_fn("adam", 6, 2, 2) is not t1._epoch_fn("adam", 4, 2, 2)
+
+
+def test_scoring_fns_shared_across_acquirers():
+    from consensus_entropy_tpu.ops import scoring
+
+    assert (scoring.make_scoring_fns(k=10)
+            is scoring.make_scoring_fns(k=10))
+    # the wrapper normalizes the signature before the cache: an explicit
+    # default must not create a duplicate set of jit programs
+    assert (scoring.make_scoring_fns(k=10)
+            is scoring.make_scoring_fns(k=10, tie_break="fast"))
+    assert (scoring.make_scoring_fns(k=10)
+            is not scoring.make_scoring_fns(k=5))
+
+
+def test_crop_forward_sliced_in_buckets(rng):
+    # The crop forward dispatches in bucket-wide sub-slices so a big pool
+    # can never exceed HBM (a single >=1536-crop dispatch at full geometry
+    # fails to COMPILE on v5e: 23.3 GB layer-1 allocation).  Contract:
+    # (a) crops are sampled at full width first, so a 300-song pool's
+    # first-256 columns equal a 256-song pool's columns exactly (threefry
+    # prefix-stability + per-row inference independence); (b) every slice
+    # is exactly bucket-wide, so ONE forward program serves any pool size.
+    cnns = [CNNMember("c0",
+                      short_cnn.init_variables(jax.random.key(3), TINY),
+                      TINY)]
+    com = Committee([], cnns, TINY, TrainConfig(batch_size=2))
+    songs = [f"s{i:03d}" for i in range(300)]
+    waves = {s: rng.standard_normal(9000).astype(np.float32)
+             for s in songs}
+    store = DeviceWaveformStore(waves, TINY.input_length)
+    size0 = com._infer._cache_size()
+    big = np.asarray(com.predict_songs_cnn(store, songs, jax.random.key(7)))
+    small = np.asarray(com.predict_songs_cnn(store, songs[:256],
+                                             jax.random.key(7)))
+    assert big.shape == (1, 300, NUM_CLASSES)
+    np.testing.assert_allclose(big[:, :256], small, rtol=1e-6, atol=1e-6)
+    # both calls dispatch only bucket-wide (256) batches -> at most one
+    # new program regardless of pool width
+    assert com._infer._cache_size() <= size0 + 1

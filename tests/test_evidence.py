@@ -45,8 +45,12 @@ def test_run_one_with_cnn_members(tmp_path):
     assert all(np.isfinite(e).all() for e in per_epoch)
 
 
+@pytest.mark.filterwarnings(
+    "ignore:Precision loss occurred:RuntimeWarning")
 def test_paired_tests_shapes_and_direction():
-    # synthetic results where "good" dominates "rand" by construction
+    # synthetic results where "good" dominates "rand" by construction;
+    # the paired diffs are EXACTLY constant, so scipy's t-test warns about
+    # catastrophic cancellation in the variance — expected for this input
     rng = np.random.default_rng(0)
     results = {"good": {}, "rand": {}}
     for seed in range(10):
@@ -61,8 +65,11 @@ def test_paired_tests_shapes_and_direction():
     assert t["per_member_final"]["mean_diff"] == pytest.approx(0.05)
 
 
+@pytest.mark.filterwarnings(
+    "ignore:Precision loss occurred:RuntimeWarning")
 def test_analyze_users_round_trip(tmp_path):
     # write the CLI's layout by hand; analyze must pair users and test
+    # (constant paired diffs -> expected scipy precision warning, as above)
     for uid in ("u0", "u1", "u2"):
         for mode, lift in (("mc", 0.05), ("rand", 0.0)):
             d = tmp_path / uid / mode

@@ -157,12 +157,18 @@ def test_rand_uniform_over_valid(rng):
 
 
 def test_jitted_fns_stable_shapes(rng):
+    # the fns are process-shared (make_scoring_fns is lru_cached), so the
+    # jit cache may already hold other tests' shapes — assert the DELTA:
+    # one compile for this shape, zero for the same-shape second call
     fns = scoring.make_scoring_fns(k=4, tie_break="fast")
     p = _probs(rng, 3, 32).astype(np.float32)
     mask = np.ones(32, dtype=bool)
+    before = fns["mc"]._cache_size()
     r1 = fns["mc"](p, mask)
+    after_first = fns["mc"]._cache_size()
+    assert after_first <= before + 1
     mask2 = mask.copy()
     mask2[np.asarray(r1.indices)] = False
     r2 = fns["mc"](p, mask2)  # same shapes → no retrace
     assert not set(np.asarray(r2.indices)) & set(np.asarray(r1.indices))
-    assert fns["mc"]._cache_size() == 1
+    assert fns["mc"]._cache_size() == after_first
