@@ -253,3 +253,29 @@ def test_crop_forward_sliced_in_buckets(rng):
     # both calls dispatch only bucket-wide (256) batches -> at most one
     # new program regardless of pool width
     assert com._infer._cache_size() <= size0 + 1
+
+
+def test_crop_forward_sliced_under_pool_mesh(rng):
+    # The bucket-sliced crop forward must also hold on a pool-sharded
+    # mesh: bucket = lcm(256, n_shards), so every sub-slice stays
+    # shard-divisible and the sharded program is reused across slices.
+    # A >bucket pool (300 songs -> two 256-wide slices on the 8-device
+    # virtual mesh) must score identically to the single-device path.
+    from consensus_entropy_tpu.parallel.mesh import make_pool_mesh
+
+    cnns = [CNNMember("c0",
+                      short_cnn.init_variables(jax.random.key(3), TINY),
+                      TINY)]
+    songs = [f"s{i:03d}" for i in range(300)]
+    waves = {s: rng.standard_normal(9000).astype(np.float32)
+             for s in songs}
+    store = DeviceWaveformStore(waves, TINY.input_length)
+    single = Committee([], cnns, TINY, TrainConfig(batch_size=2))
+    ref = np.asarray(single.predict_songs_cnn(store, songs,
+                                              jax.random.key(7)))
+    meshed = Committee([], cnns, TINY, TrainConfig(batch_size=2),
+                       mesh=make_pool_mesh())
+    got = np.asarray(meshed.predict_songs_cnn(store, songs,
+                                              jax.random.key(7)))
+    assert got.shape == (1, 300, NUM_CLASSES)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
