@@ -238,22 +238,15 @@ class CNNTrainer:
         _EPOCH_FNS[key_] = fn
         return fn
 
-    def _epoch_fn_many(self, phase: str, n_train: int, n_test: int,
-                       batch_size: int, mesh=None) -> Callable:
-        """Lockstep multi-member epoch: the single-member epoch ``vmap``'d
-        over the stacked member axis (per-member params/opt/best/keys; the
-        waveform store and id tables broadcast), one jit dispatch for the
-        whole committee.  With ``mesh``, member-stacked state is sharded on
-        the ``member`` axis (each chip trains its member slice)."""
-        batch_size = max(1, min(batch_size, n_train))
-        # Mesh hashes by value: an equal mesh rebuilt per AL round still hits
-        key_ = (self.config, self.train_config, "many", phase, n_train,
-                n_test, batch_size, mesh)
-        if key_ in _EPOCH_FNS:
-            return _EPOCH_FNS[key_]
+    def _build_epoch_many(self, phase: str, n_train: int, n_test: int,
+                          batch_size: int, mesh=None) -> Callable:
+        """The raw (unjitted) lockstep multi-member epoch — shared by the
+        per-epoch jit (:meth:`_epoch_fn_many`) and the scanned phase jit
+        (:meth:`_phase_fn_many`).
+
+        args: params, stats, opt, best_p, best_s, best_score are
+        member-stacked; data, lengths, rows, y broadcast; key per member."""
         epoch = self._build_epoch(phase, n_train, n_test, batch_size)
-        # args: params, stats, opt, best_p, best_s, best_score are
-        # member-stacked; data, lengths, rows, y broadcast; key per member.
         if mesh is None:
             # Single chip: run members as a lax.map, not vmap — vmapping
             # convs over batched kernels lowers to feature-group convs the
@@ -269,31 +262,130 @@ class CNNTrainer:
                                      train_y, test_rows, test_y, ms[6]),
                     (params, stats, opt, best_p, best_s, best_score, keys))
 
+            return mapped
+        return jax.vmap(
+            epoch,
+            in_axes=(0, 0, 0, 0, 0, 0, None, None, None, None, None,
+                     None, 0))
+
+    @staticmethod
+    def _member_shardings(mesh):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from consensus_entropy_tpu.parallel.mesh import MEMBER_AXIS
+
+        return (NamedSharding(mesh, P(MEMBER_AXIS)),
+                NamedSharding(mesh, P()))
+
+    def _epoch_fn_many(self, phase: str, n_train: int, n_test: int,
+                       batch_size: int, mesh=None) -> Callable:
+        """Lockstep multi-member epoch: the single-member epoch ``vmap``'d
+        over the stacked member axis (per-member params/opt/best/keys; the
+        waveform store and id tables broadcast), one jit dispatch for the
+        whole committee.  With ``mesh``, member-stacked state is sharded on
+        the ``member`` axis (each chip trains its member slice)."""
+        batch_size = max(1, min(batch_size, n_train))
+        # Mesh hashes by value: an equal mesh rebuilt per AL round still hits
+        key_ = (self.config, self.train_config, "many", phase, n_train,
+                n_test, batch_size, mesh)
+        if key_ in _EPOCH_FNS:
+            return _EPOCH_FNS[key_]
+        mapped = self._build_epoch_many(phase, n_train, n_test, batch_size,
+                                        mesh)
+        if mesh is None:
             fn = jax.jit(mapped, donate_argnums=(0, 1, 2, 3, 4))
         else:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            from consensus_entropy_tpu.parallel.mesh import MEMBER_AXIS
-
-            vmapped = jax.vmap(
-                epoch,
-                in_axes=(0, 0, 0, 0, 0, 0, None, None, None, None, None,
-                         None, 0))
-            member = NamedSharding(mesh, P(MEMBER_AXIS))
-            repl = NamedSharding(mesh, P())
+            member, repl = self._member_shardings(mesh)
             # metric outputs come back REPLICATED: they are tiny (M,)
             # vectors / (M, n_test, C) preds, and replication makes them
             # host-readable on every process of a multi-host mesh (a
             # member-sharded output would span non-addressable devices)
             fn = jax.jit(
-                vmapped,
+                mapped,
                 in_shardings=(member,) * 6 + (repl,) * 6 + (member,),
                 out_shardings=(member,) * 6 + (repl,) * 5,
                 donate_argnums=(0, 1, 2, 3, 4))
         _EPOCH_FNS[key_] = fn
         return fn
 
+    def _phase_fn_many(self, phase: str, n_ep: int, n_train: int,
+                       n_test: int, batch_size: int, mesh=None) -> Callable:
+        """A whole schedule phase (``n_ep`` lockstep epochs) as ONE jitted
+        ``lax.scan`` program.
+
+        The schedule is epoch-indexed (transitions never depend on data —
+        ``amg_test.py:203-231``), so a phase's epoch count is known on the
+        host and the per-epoch host loop is pure dispatch overhead: on the
+        tunneled chip each of the retrain path's 100 epoch dispatches costs
+        ~90 ms of round-trip latency (~10 s/retrain measured in
+        ``ITERATION_r04``); the scan collapses that to one dispatch per
+        phase (<=4 per retrain).  The scan body reproduces
+        ``fit_many.run_epoch``'s key chain exactly — ``vmap(split)`` the
+        member keys, feed the subkeys to the epoch — so the random stream
+        is identical to the per-epoch path.  Per-epoch prediction tensors
+        are not stacked (callers that need them — per-epoch callbacks —
+        use the per-epoch path); metrics come back as ``(n_ep, M)`` stacks.
+        """
+        batch_size = max(1, min(batch_size, n_train))
+        key_ = (self.config, self.train_config, "phase", phase, n_ep,
+                n_train, n_test, batch_size, mesh)
+        if key_ in _EPOCH_FNS:
+            return _EPOCH_FNS[key_]
+        mapped = self._build_epoch_many(phase, n_train, n_test, batch_size,
+                                        mesh)
+
+        def phase_run(params, stats, opt, best_p, best_s, best_score,
+                      data, lengths, train_rows, train_y, test_rows,
+                      test_y, keys):
+            def body(carry, _):
+                p, st, op, bp, bs, bsc, ks = carry
+                splits = jax.vmap(jax.random.split)(ks)
+                ks, subs = splits[:, 0], splits[:, 1]
+                (p, st, op, bp, bs, bsc, tl, vl, f1, _preds,
+                 imp) = mapped(p, st, op, bp, bs, bsc, data, lengths,
+                               train_rows, train_y, test_rows, test_y,
+                               subs)
+                return (p, st, op, bp, bs, bsc, ks), (tl, vl, f1, imp)
+
+            carry, metrics = jax.lax.scan(
+                body, (params, stats, opt, best_p, best_s, best_score,
+                       keys), None, length=n_ep)
+            return carry + metrics
+
+        if mesh is None:
+            fn = jax.jit(phase_run, donate_argnums=(0, 1, 2, 3, 4))
+        else:
+            member, repl = self._member_shardings(mesh)
+            fn = jax.jit(
+                phase_run,
+                in_shardings=(member,) * 6 + (repl,) * 6 + (member,),
+                out_shardings=(member,) * 6 + (member,) + (repl,) * 4,
+                donate_argnums=(0, 1, 2, 3, 4))
+        _EPOCH_FNS[key_] = fn
+        return fn
+
     # -- host-level loop ---------------------------------------------------
+
+    def _phase_segments(self, n_epochs: int,
+                        adam_patience: int) -> list[tuple]:
+        """``[(phase, start_epoch, end_epoch), ...]`` — the exact epoch
+        ranges :meth:`_run_schedule` executes, computed up front.  Legal
+        because the schedule is epoch-indexed: ``drop_counter`` resets only
+        at transitions, never on improvement, so phase boundaries are
+        data-independent (``amg_test.py:203-231``).  Derived by REPLAYING
+        ``_run_schedule`` with recording closures — one source of truth, so
+        a future schedule-semantics change cannot desync the scanned fast
+        path from the per-epoch path."""
+        eps: list[tuple] = []
+        self._run_schedule(n_epochs, adam_patience,
+                           lambda e, p: eps.append((e, p)), lambda p: None)
+        segs: list[tuple] = []
+        for e, p in eps:
+            if segs and segs[-1][0] == p:
+                segs[-1] = (p, segs[-1][1], e + 1)
+            else:
+                segs.append((p, e, e + 1))
+        return segs
 
     def _run_schedule(self, n_epochs: int, adam_patience: int,
                       run_epoch, reload_best) -> None:
@@ -557,7 +649,47 @@ class CNNTrainer:
                 opt = jax.jit(lambda o: o, out_shardings=member_sh)(opt)
             state["opt_state"] = opt
 
-        self._run_schedule(n_epochs, adam_patience, run_epoch, reload_best)
+        if callback is None:
+            # Fast path (the production retrain): each schedule phase is
+            # ONE scanned jit dispatch — <=len(PHASES) device round-trips
+            # for the whole schedule instead of one per epoch (the
+            # per-epoch host loop was pure dispatch latency, ~90 ms x 100
+            # epochs on the tunneled chip; measured 2.4x warm retrain).
+            # The scan body chains the same vmap(split) key stream as
+            # run_epoch, so both paths compute identical trajectories
+            # (pinned by test_fit_many_scanned_matches_per_epoch).
+            # Metric stacks stay DEVICE arrays per segment — slicing them
+            # per epoch here would queue ~4 x n_epochs tiny gather
+            # dispatches; they expand host-side after the single bulk
+            # device_get below.
+            seg_records: list[tuple] = []
+            for si, (phase, start, end) in enumerate(
+                    self._phase_segments(n_epochs, adam_patience)):
+                if si:
+                    reload_best(phase)
+                fn = self._phase_fn_many(phase, end - start,
+                                         len(train_ids), len(test_ids),
+                                         batch_size, mesh)
+                (state["params"], state["batch_stats"], state["opt_state"],
+                 state["best_params"], state["best_stats"],
+                 state["best_score"], state["keys"], tl, vl, f1,
+                 imp) = fn(
+                    state["params"], state["batch_stats"],
+                    state["opt_state"], state["best_params"],
+                    state["best_stats"], state["best_score"], data_arg,
+                    lengths_arg, train_rows, train_y, test_rows, test_y,
+                    state["keys"])
+                seg_records.append((phase, start, end, tl, vl, f1, imp))
+            for (phase, start, end, tl, vl, f1, imp), (htl, hvl, hf1,
+                                                       himp) in zip(
+                    seg_records,
+                    jax.device_get([s[3:] for s in seg_records])):
+                for j in range(end - start):
+                    records.append((start + j, phase, htl[j], hvl[j],
+                                    hf1[j], himp[j]))
+        else:
+            self._run_schedule(n_epochs, adam_patience, run_epoch,
+                               reload_best)
         if multi_host:
             # replicate the winning checkpoints (one all-gather over the
             # member axis) and land them as host numpy so downstream

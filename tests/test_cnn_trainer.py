@@ -344,3 +344,58 @@ def test_fit_many_production_shape_5_members_padded_to_8(rng):
             np.testing.assert_allclose(a["val_loss"], b["val_loss"],
                                        rtol=1e-3)
             np.testing.assert_allclose(a["val_f1"], b["val_f1"], atol=1e-6)
+
+
+def test_fit_many_scanned_matches_per_epoch(rng):
+    """The callback-free fit_many path scans each schedule phase as ONE
+    jitted program (<=4 dispatches per retrain instead of one per epoch).
+    It must compute the SAME trajectory as the per-epoch path: the scan
+    body chains the identical vmap(split) key stream, so best params and
+    every per-epoch metric agree."""
+    waves, classes = _synthetic_pool(rng, 6)
+    store = DeviceWaveformStore(waves, TINY.input_length)
+    ids = list(waves)
+    y = one_hot_np([classes[s] for s in ids])
+    members = [short_cnn.init_variables(jax.random.key(i), TINY)
+               for i in range(2)]
+    cfg = TrainConfig(batch_size=4, adam_patience=3, sgd_patience=2)
+
+    def run(callback):
+        trainer = CNNTrainer(TINY, cfg)
+        vs = [jax.tree.map(np.copy, v) for v in members]
+        return trainer.fit_many(vs, store, ids, y, ids, y,
+                                jax.random.key(5), n_epochs=9,
+                                callback=callback)
+
+    best_scan, hist_scan = run(None)           # scanned phases
+    seen = []
+    best_loop, hist_loop = run(lambda e, infos: seen.append(e))  # per-epoch
+    assert seen == list(range(9))
+    assert len(hist_scan) == len(hist_loop) == 2
+    for hs, hl in zip(hist_scan, hist_loop):
+        assert [h["phase"] for h in hs] == [h["phase"] for h in hl]
+        assert [h["epoch"] for h in hs] == [h["epoch"] for h in hl]
+        np.testing.assert_allclose([h["val_loss"] for h in hs],
+                                   [h["val_loss"] for h in hl],
+                                   rtol=1e-5, atol=1e-6)
+        assert ([h["improved"] for h in hs]
+                == [h["improved"] for h in hl])
+    for bs, bl in zip(best_scan, best_loop):
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6), bs, bl)
+
+
+def test_phase_segments_match_run_schedule():
+    cfg = TrainConfig(batch_size=4, adam_patience=2, sgd_patience=2)
+    trainer = CNNTrainer(TINY, cfg)
+    segs = trainer._phase_segments(9, 2)
+    assert segs == [("adam", 0, 2), ("sgd_1", 2, 4), ("sgd_2", 4, 6),
+                    ("sgd_3", 6, 9)]
+    # schedule shorter than the first patience: one segment, no transition
+    assert trainer._phase_segments(2, 5) == [("adam", 0, 2)]
+    # and the expanded segments replay _run_schedule exactly
+    ran = []
+    trainer._run_schedule(9, 2, lambda e, p: ran.append((e, p)),
+                          lambda p: None)
+    flat = [(e, p) for p, s, t in segs for e in range(s, t)]
+    assert ran == flat
