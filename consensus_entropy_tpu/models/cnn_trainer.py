@@ -308,6 +308,57 @@ class CNNTrainer:
         _EPOCH_FNS[key_] = fn
         return fn
 
+    @staticmethod
+    def _make_phase_run(epoch_fn, n_ep: int, split_keys) -> Callable:
+        """The scanned whole-phase program, shared by the single-member and
+        lockstep fast paths (they differ only in the epoch body and how the
+        key carry advances).  ``split_keys`` must reproduce the
+        corresponding per-epoch ``run_epoch``'s key chain exactly, so the
+        scanned and per-epoch paths compute identical trajectories."""
+
+        def phase_run(params, stats, opt, best_p, best_s, best_score,
+                      data, lengths, train_rows, train_y, test_rows,
+                      test_y, keys):
+            def body(carry, _):
+                p, st, op, bp, bs, bsc, ks = carry
+                ks, subs = split_keys(ks)
+                (p, st, op, bp, bs, bsc, tl, vl, f1, _preds,
+                 imp) = epoch_fn(p, st, op, bp, bs, bsc, data, lengths,
+                                 train_rows, train_y, test_rows, test_y,
+                                 subs)
+                return (p, st, op, bp, bs, bsc, ks), (tl, vl, f1, imp)
+
+            carry, metrics = jax.lax.scan(
+                body, (params, stats, opt, best_p, best_s, best_score,
+                       keys), None, length=n_ep)
+            return carry + metrics
+
+        return phase_run
+
+    def _phase_fn(self, phase: str, n_ep: int, n_train: int, n_test: int,
+                  batch_size: int) -> Callable:
+        """Single-member analogue of :meth:`_phase_fn_many`: a whole
+        schedule phase as one scanned jit.  Used by ``fit``'s callback-free
+        fast path (the 200-epoch CNN pre-training calls ``fit`` with no
+        callback — TensorBoard scalars are written from the returned
+        history — so per-epoch dispatch there was pure round-trip latency
+        too)."""
+        batch_size = max(1, min(batch_size, n_train))
+        key_ = (self.config, self.train_config, "phase1", phase, n_ep,
+                n_train, n_test, batch_size)
+        if key_ in _EPOCH_FNS:
+            return _EPOCH_FNS[key_]
+        epoch = self._build_epoch(phase, n_train, n_test, batch_size)
+
+        def split_one(k):
+            k, sub = jax.random.split(k)
+            return k, sub
+
+        fn = jax.jit(self._make_phase_run(epoch, n_ep, split_one),
+                     donate_argnums=(0, 1, 2, 3, 4))
+        _EPOCH_FNS[key_] = fn
+        return fn
+
     def _phase_fn_many(self, phase: str, n_ep: int, n_train: int,
                        n_test: int, batch_size: int, mesh=None) -> Callable:
         """A whole schedule phase (``n_ep`` lockstep epochs) as ONE jitted
@@ -334,24 +385,11 @@ class CNNTrainer:
         mapped = self._build_epoch_many(phase, n_train, n_test, batch_size,
                                         mesh)
 
-        def phase_run(params, stats, opt, best_p, best_s, best_score,
-                      data, lengths, train_rows, train_y, test_rows,
-                      test_y, keys):
-            def body(carry, _):
-                p, st, op, bp, bs, bsc, ks = carry
-                splits = jax.vmap(jax.random.split)(ks)
-                ks, subs = splits[:, 0], splits[:, 1]
-                (p, st, op, bp, bs, bsc, tl, vl, f1, _preds,
-                 imp) = mapped(p, st, op, bp, bs, bsc, data, lengths,
-                               train_rows, train_y, test_rows, test_y,
-                               subs)
-                return (p, st, op, bp, bs, bsc, ks), (tl, vl, f1, imp)
+        def split_members(ks):
+            splits = jax.vmap(jax.random.split)(ks)
+            return splits[:, 0], splits[:, 1]
 
-            carry, metrics = jax.lax.scan(
-                body, (params, stats, opt, best_p, best_s, best_score,
-                       keys), None, length=n_ep)
-            return carry + metrics
-
+        phase_run = self._make_phase_run(mapped, n_ep, split_members)
         if mesh is None:
             fn = jax.jit(phase_run, donate_argnums=(0, 1, 2, 3, 4))
         else:
@@ -363,6 +401,36 @@ class CNNTrainer:
                 donate_argnums=(0, 1, 2, 3, 4))
         _EPOCH_FNS[key_] = fn
         return fn
+
+    def _run_scanned_schedule(self, n_epochs: int, adam_patience: int,
+                              get_fn, reload_best, state, key_field: str,
+                              fixed_args: tuple) -> list[tuple]:
+        """Execute the schedule as one scanned jit per phase (the
+        callback-free fast path shared by ``fit`` and ``fit_many``).
+        Returns host-side per-epoch rows ``[(epoch, phase, tl, vl, f1,
+        imp), ...]``.  Metric stacks stay DEVICE arrays until the single
+        bulk ``device_get`` at the end — slicing them per epoch while the
+        schedule runs would queue ~4 x n_epochs tiny gather dispatches."""
+        seg_records: list[tuple] = []
+        for si, (phase, start, end) in enumerate(
+                self._phase_segments(n_epochs, adam_patience)):
+            if si:
+                reload_best(phase)
+            fn = get_fn(phase, end - start)
+            (state["params"], state["batch_stats"], state["opt_state"],
+             state["best_params"], state["best_stats"],
+             state["best_score"], state[key_field], tl, vl, f1, imp) = fn(
+                state["params"], state["batch_stats"], state["opt_state"],
+                state["best_params"], state["best_stats"],
+                state["best_score"], *fixed_args, state[key_field])
+            seg_records.append((phase, start, end, tl, vl, f1, imp))
+        rows: list[tuple] = []
+        for (phase, start, end, *_), (tl, vl, f1, imp) in zip(
+                seg_records, jax.device_get([s[3:] for s in seg_records])):
+            for j in range(end - start):
+                rows.append((start + j, phase, tl[j], vl[j], f1[j],
+                             imp[j]))
+        return rows
 
     # -- host-level loop ---------------------------------------------------
 
@@ -481,7 +549,26 @@ class CNNTrainer:
                                                 state["best_stats"])
             state["opt_state"] = make_tx(phase, cfg).init(state["params"])
 
-        self._run_schedule(n_epochs, adam_patience, run_epoch, reload_best)
+        if callback is None:
+            # Scanned fast path — one jit per schedule phase instead of one
+            # per epoch; same contract as fit_many's (key chain identical
+            # to run_epoch, parity pinned by
+            # test_fit_scanned_matches_per_epoch)
+            for epoch, phase, tl, vl, f1, imp in self._run_scanned_schedule(
+                    n_epochs, adam_patience,
+                    lambda phase, n_ep: self._phase_fn(
+                        phase, n_ep, len(train_ids), len(test_ids),
+                        batch_size),
+                    reload_best, state, "key",
+                    (store.data, store.lengths, train_rows, train_y,
+                     test_rows, test_y)):
+                history.append(
+                    {"epoch": epoch, "phase": phase,
+                     "train_loss": float(tl), "val_loss": float(vl),
+                     "val_f1": float(f1), "improved": bool(imp)})
+        else:
+            self._run_schedule(n_epochs, adam_patience, run_epoch,
+                               reload_best)
         return ({"params": state["best_params"],
                  "batch_stats": state["best_stats"]},
                 _materialize_history(history))
@@ -658,35 +745,14 @@ class CNNTrainer:
             # The scan body chains the same vmap(split) key stream as
             # run_epoch, so both paths compute identical trajectories
             # (pinned by test_fit_many_scanned_matches_per_epoch).
-            # Metric stacks stay DEVICE arrays per segment — slicing them
-            # per epoch here would queue ~4 x n_epochs tiny gather
-            # dispatches; they expand host-side after the single bulk
-            # device_get below.
-            seg_records: list[tuple] = []
-            for si, (phase, start, end) in enumerate(
-                    self._phase_segments(n_epochs, adam_patience)):
-                if si:
-                    reload_best(phase)
-                fn = self._phase_fn_many(phase, end - start,
-                                         len(train_ids), len(test_ids),
-                                         batch_size, mesh)
-                (state["params"], state["batch_stats"], state["opt_state"],
-                 state["best_params"], state["best_stats"],
-                 state["best_score"], state["keys"], tl, vl, f1,
-                 imp) = fn(
-                    state["params"], state["batch_stats"],
-                    state["opt_state"], state["best_params"],
-                    state["best_stats"], state["best_score"], data_arg,
-                    lengths_arg, train_rows, train_y, test_rows, test_y,
-                    state["keys"])
-                seg_records.append((phase, start, end, tl, vl, f1, imp))
-            for (phase, start, end, tl, vl, f1, imp), (htl, hvl, hf1,
-                                                       himp) in zip(
-                    seg_records,
-                    jax.device_get([s[3:] for s in seg_records])):
-                for j in range(end - start):
-                    records.append((start + j, phase, htl[j], hvl[j],
-                                    hf1[j], himp[j]))
+            records.extend(self._run_scanned_schedule(
+                n_epochs, adam_patience,
+                lambda phase, n_ep: self._phase_fn_many(
+                    phase, n_ep, len(train_ids), len(test_ids), batch_size,
+                    mesh),
+                reload_best, state, "keys",
+                (data_arg, lengths_arg, train_rows, train_y, test_rows,
+                 test_y)))
         else:
             self._run_schedule(n_epochs, adam_patience, run_epoch,
                                reload_best)

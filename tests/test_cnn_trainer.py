@@ -399,3 +399,32 @@ def test_phase_segments_match_run_schedule():
                           lambda p: None)
     flat = [(e, p) for p, s, t in segs for e in range(s, t)]
     assert ran == flat
+
+
+def test_fit_scanned_matches_per_epoch(rng):
+    """fit's callback-free path scans schedule phases like fit_many's;
+    trajectories and best params must match the per-epoch path exactly."""
+    waves, classes = _synthetic_pool(rng, 6)
+    store = DeviceWaveformStore(waves, TINY.input_length)
+    ids = list(waves)
+    y = one_hot_np([classes[s] for s in ids])
+    variables = short_cnn.init_variables(jax.random.key(0), TINY)
+    cfg = TrainConfig(batch_size=4, adam_patience=3, sgd_patience=2)
+
+    def run(callback):
+        trainer = CNNTrainer(TINY, cfg)
+        v = jax.tree.map(np.copy, variables)
+        return trainer.fit(v, store, ids, y, ids, y, jax.random.key(5),
+                           n_epochs=9, callback=callback)
+
+    best_scan, hist_scan = run(None)
+    best_loop, hist_loop = run(lambda e, info, preds: None)
+    assert [h["phase"] for h in hist_scan] == [h["phase"] for h in hist_loop]
+    np.testing.assert_allclose([h["val_loss"] for h in hist_scan],
+                               [h["val_loss"] for h in hist_loop],
+                               rtol=1e-5, atol=1e-6)
+    assert ([h["improved"] for h in hist_scan]
+            == [h["improved"] for h in hist_loop])
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        best_scan, best_loop)
