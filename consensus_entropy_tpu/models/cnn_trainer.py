@@ -360,9 +360,10 @@ class CNNTrainer:
         return fn
 
     def _phase_fn_many(self, phase: str, n_ep: int, n_train: int,
-                       n_test: int, batch_size: int, mesh=None) -> Callable:
+                       n_test: int, batch_size: int) -> Callable:
         """A whole schedule phase (``n_ep`` lockstep epochs) as ONE jitted
-        ``lax.scan`` program.
+        ``lax.scan`` program — single-chip only (see ``fit_many`` for why
+        the mesh path stays per-epoch).
 
         The schedule is epoch-indexed (transitions never depend on data —
         ``amg_test.py:203-231``), so a phase's epoch count is known on the
@@ -379,26 +380,17 @@ class CNNTrainer:
         """
         batch_size = max(1, min(batch_size, n_train))
         key_ = (self.config, self.train_config, "phase", phase, n_ep,
-                n_train, n_test, batch_size, mesh)
+                n_train, n_test, batch_size)
         if key_ in _EPOCH_FNS:
             return _EPOCH_FNS[key_]
-        mapped = self._build_epoch_many(phase, n_train, n_test, batch_size,
-                                        mesh)
+        mapped = self._build_epoch_many(phase, n_train, n_test, batch_size)
 
         def split_members(ks):
             splits = jax.vmap(jax.random.split)(ks)
             return splits[:, 0], splits[:, 1]
 
-        phase_run = self._make_phase_run(mapped, n_ep, split_members)
-        if mesh is None:
-            fn = jax.jit(phase_run, donate_argnums=(0, 1, 2, 3, 4))
-        else:
-            member, repl = self._member_shardings(mesh)
-            fn = jax.jit(
-                phase_run,
-                in_shardings=(member,) * 6 + (repl,) * 6 + (member,),
-                out_shardings=(member,) * 6 + (member,) + (repl,) * 4,
-                donate_argnums=(0, 1, 2, 3, 4))
+        fn = jax.jit(self._make_phase_run(mapped, n_ep, split_members),
+                     donate_argnums=(0, 1, 2, 3, 4))
         _EPOCH_FNS[key_] = fn
         return fn
 
@@ -691,11 +683,14 @@ class CNNTrainer:
                  best_score, keys) = jax.device_put(
                     (params, batch_stats, opt_state, best_params,
                      best_stats, best_score, keys), member_sh)
-        #: (epoch, phase, train_loss, val_loss, val_f1, improved) with the
-        #: metric entries as DEVICE member-vectors — the whole schedule is
-        #: queued asynchronously and synced in one bulk transfer at the end
-        #: (per-epoch np.asarray here was the retrain path's pipeline stall:
-        #: a blocking readback × n_epochs)
+        #: (epoch, phase, train_loss, val_loss, val_f1, improved).  On the
+        #: per-epoch (callback / mesh) path the metric entries are DEVICE
+        #: member-vectors — the whole schedule is queued asynchronously and
+        #: synced in one bulk transfer at the end (per-epoch np.asarray
+        #: here was the retrain path's pipeline stall: a blocking readback
+        #: x n_epochs).  The scanned fast path appends already
+        #: host-materialized rows (its own single bulk get); the final
+        #: device_get passes those through untouched.
         records: list[tuple] = []
         state = {"params": params, "batch_stats": batch_stats,
                  "opt_state": opt_state, "best_params": best_params,
@@ -736,20 +731,31 @@ class CNNTrainer:
                 opt = jax.jit(lambda o: o, out_shardings=member_sh)(opt)
             state["opt_state"] = opt
 
-        if callback is None:
-            # Fast path (the production retrain): each schedule phase is
-            # ONE scanned jit dispatch — <=len(PHASES) device round-trips
-            # for the whole schedule instead of one per epoch (the
-            # per-epoch host loop was pure dispatch latency, ~90 ms x 100
-            # epochs on the tunneled chip; measured 2.4x warm retrain).
+        if callback is None and mesh is None:
+            # Fast path (the production single-chip retrain): each schedule
+            # phase is ONE scanned jit dispatch — <=len(PHASES) device
+            # round-trips for the whole schedule instead of one per epoch
+            # (the per-epoch host loop was pure dispatch latency, ~90 ms x
+            # 100 epochs on the tunneled chip; measured 2.4x warm retrain).
             # The scan body chains the same vmap(split) key stream as
             # run_epoch, so both paths compute identical trajectories
             # (pinned by test_fit_many_scanned_matches_per_epoch).
+            #
+            # The MESH path deliberately stays per-epoch: compiling
+            # scan(vmap(epoch)) with member shardings + donation segfaulted
+            # the virtual-CPU XLA backend (SIGSEGV inside
+            # backend_compile_and_load) deterministically in full-suite
+            # process state — and that backend is exactly what validates
+            # multi-chip correctness without hardware, so it must never be
+            # the fragile construct.  On a real pod the per-epoch dispatch
+            # cost also amortizes differently (one host drives many chips
+            # doing more work per epoch), so the scan's win is smaller
+            # there to begin with.
             records.extend(self._run_scanned_schedule(
                 n_epochs, adam_patience,
                 lambda phase, n_ep: self._phase_fn_many(
-                    phase, n_ep, len(train_ids), len(test_ids), batch_size,
-                    mesh),
+                    phase, n_ep, len(train_ids), len(test_ids),
+                    batch_size),
                 reload_best, state, "keys",
                 (data_arg, lengths_arg, train_rows, train_y, test_rows,
                  test_y)))
