@@ -576,7 +576,7 @@ def run_retrain_suite(args_ns) -> int:
     # its program cache keys on the segment length, so an n_epochs=1
     # warm-up would leave every timed phase program compiling in-window
     trainer.fit(copies()[0], store, train_ids, y_tr, test_ids, y_te, key,
-                n_epochs=1)
+                n_epochs=n_epochs)
     trainer.fit_many(copies(), store, train_ids, y_tr, test_ids, y_te, key,
                      n_epochs=n_epochs)
 

@@ -24,6 +24,7 @@ process and per-batch host↔device transfers.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 from typing import Any, Callable
 
@@ -122,7 +123,37 @@ class EpochResult:
 #: fully determined by the two frozen configs + shape key (the captured
 #: ``ShortChunkCNN``/optax tx are pure functions of them), so sharing
 #: across trainer instances is sound.
-_EPOCH_FNS: dict[tuple, Callable] = {}
+#: Bounded LRU: in a production AL run ``n_train`` grows every iteration, so
+#: (phase, n_train)-keyed programs would otherwise accumulate for the process
+#: lifetime (a slow leak, and the same executable-accumulation mode that
+#: destabilises the virtual-CPU test backend — see tests/conftest.py).  One
+#: retrain touches <=4 phase programs per (n_train, n_epochs) key, so 128
+#: entries hold the full working set of a 46-user run with headroom; evicting
+#: an entry drops only the Python jit wrapper — in-flight executions keep
+#: their executable alive through the runtime, and a re-visited key simply
+#: re-traces.
+_EPOCH_FNS: collections.OrderedDict[tuple, Callable] = collections.OrderedDict()
+_EPOCH_FNS_MAX = 128
+
+
+def _split_member_keys(ks):
+    """Advance the stacked member key carry exactly as ``fit_many``'s
+    per-epoch ``run_epoch`` does (``vmap(split)``), so the scanned and
+    per-epoch paths share one random stream."""
+    splits = jax.vmap(jax.random.split)(ks)
+    return splits[:, 0], splits[:, 1]
+
+
+def _epoch_fns_cached(key_: tuple, build: Callable[[], Callable]) -> Callable:
+    fn = _EPOCH_FNS.get(key_)
+    if fn is None:
+        fn = build()
+        _EPOCH_FNS[key_] = fn
+        while len(_EPOCH_FNS) > _EPOCH_FNS_MAX:
+            _EPOCH_FNS.popitem(last=False)
+    else:
+        _EPOCH_FNS.move_to_end(key_)
+    return fn
 
 
 class CNNTrainer:
@@ -231,12 +262,9 @@ class CNNTrainer:
         batch_size = max(1, min(batch_size, n_train))
         key_ = (self.config, self.train_config, phase, n_train, n_test,
                 batch_size)
-        if key_ in _EPOCH_FNS:
-            return _EPOCH_FNS[key_]
-        epoch = self._build_epoch(phase, n_train, n_test, batch_size)
-        fn = jax.jit(epoch, donate_argnums=(0, 1, 2, 3, 4))
-        _EPOCH_FNS[key_] = fn
-        return fn
+        return _epoch_fns_cached(key_, lambda: jax.jit(
+            self._build_epoch(phase, n_train, n_test, batch_size),
+            donate_argnums=(0, 1, 2, 3, 4)))
 
     def _build_epoch_many(self, phase: str, n_train: int, n_test: int,
                           batch_size: int, mesh=None) -> Callable:
@@ -288,25 +316,24 @@ class CNNTrainer:
         # Mesh hashes by value: an equal mesh rebuilt per AL round still hits
         key_ = (self.config, self.train_config, "many", phase, n_train,
                 n_test, batch_size, mesh)
-        if key_ in _EPOCH_FNS:
-            return _EPOCH_FNS[key_]
-        mapped = self._build_epoch_many(phase, n_train, n_test, batch_size,
-                                        mesh)
-        if mesh is None:
-            fn = jax.jit(mapped, donate_argnums=(0, 1, 2, 3, 4))
-        else:
+
+        def build():
+            mapped = self._build_epoch_many(phase, n_train, n_test,
+                                            batch_size, mesh)
+            if mesh is None:
+                return jax.jit(mapped, donate_argnums=(0, 1, 2, 3, 4))
             member, repl = self._member_shardings(mesh)
             # metric outputs come back REPLICATED: they are tiny (M,)
             # vectors / (M, n_test, C) preds, and replication makes them
             # host-readable on every process of a multi-host mesh (a
             # member-sharded output would span non-addressable devices)
-            fn = jax.jit(
+            return jax.jit(
                 mapped,
                 in_shardings=(member,) * 6 + (repl,) * 6 + (member,),
                 out_shardings=(member,) * 6 + (repl,) * 5,
                 donate_argnums=(0, 1, 2, 3, 4))
-        _EPOCH_FNS[key_] = fn
-        return fn
+
+        return _epoch_fns_cached(key_, build)
 
     @staticmethod
     def _make_phase_run(epoch_fn, n_ep: int, split_keys) -> Callable:
@@ -346,24 +373,26 @@ class CNNTrainer:
         batch_size = max(1, min(batch_size, n_train))
         key_ = (self.config, self.train_config, "phase1", phase, n_ep,
                 n_train, n_test, batch_size)
-        if key_ in _EPOCH_FNS:
-            return _EPOCH_FNS[key_]
-        epoch = self._build_epoch(phase, n_train, n_test, batch_size)
 
-        def split_one(k):
-            k, sub = jax.random.split(k)
-            return k, sub
+        def build():
+            epoch = self._build_epoch(phase, n_train, n_test, batch_size)
 
-        fn = jax.jit(self._make_phase_run(epoch, n_ep, split_one),
-                     donate_argnums=(0, 1, 2, 3, 4))
-        _EPOCH_FNS[key_] = fn
-        return fn
+            def split_one(k):
+                k, sub = jax.random.split(k)
+                return k, sub
+
+            return jax.jit(self._make_phase_run(epoch, n_ep, split_one),
+                           donate_argnums=(0, 1, 2, 3, 4))
+
+        return _epoch_fns_cached(key_, build)
 
     def _phase_fn_many(self, phase: str, n_ep: int, n_train: int,
-                       n_test: int, batch_size: int) -> Callable:
+                       n_test: int, batch_size: int, mesh=None) -> Callable:
         """A whole schedule phase (``n_ep`` lockstep epochs) as ONE jitted
-        ``lax.scan`` program — single-chip only (see ``fit_many`` for why
-        the mesh path stays per-epoch).
+        ``lax.scan`` program.  Default single-chip; with ``mesh`` (opt-in
+        via ``TrainConfig.scan_mesh_phases`` — see ``fit_many`` for why the
+        mesh path defaults to per-epoch) the scanned program carries the
+        same member shardings as the per-epoch mesh jit.
 
         The schedule is epoch-indexed (transitions never depend on data —
         ``amg_test.py:203-231``), so a phase's epoch count is known on the
@@ -380,19 +409,26 @@ class CNNTrainer:
         """
         batch_size = max(1, min(batch_size, n_train))
         key_ = (self.config, self.train_config, "phase", phase, n_ep,
-                n_train, n_test, batch_size)
-        if key_ in _EPOCH_FNS:
-            return _EPOCH_FNS[key_]
-        mapped = self._build_epoch_many(phase, n_train, n_test, batch_size)
+                n_train, n_test, batch_size, mesh)
 
-        def split_members(ks):
-            splits = jax.vmap(jax.random.split)(ks)
-            return splits[:, 0], splits[:, 1]
+        def build():
+            mapped = self._build_epoch_many(phase, n_train, n_test,
+                                            batch_size, mesh)
+            phase_run = self._make_phase_run(mapped, n_ep,
+                                             _split_member_keys)
+            if mesh is None:
+                return jax.jit(phase_run, donate_argnums=(0, 1, 2, 3, 4))
+            member, repl = self._member_shardings(mesh)
+            # carry (params..keys) keeps the member sharding; the (n_ep, M)
+            # metric stacks come back replicated like the per-epoch mesh
+            # jit's scalar metrics (host-readable on every process)
+            return jax.jit(
+                phase_run,
+                in_shardings=(member,) * 6 + (repl,) * 6 + (member,),
+                out_shardings=(member,) * 7 + (repl,) * 4,
+                donate_argnums=(0, 1, 2, 3, 4))
 
-        fn = jax.jit(self._make_phase_run(mapped, n_ep, split_members),
-                     donate_argnums=(0, 1, 2, 3, 4))
-        _EPOCH_FNS[key_] = fn
-        return fn
+        return _epoch_fns_cached(key_, build)
 
     def _run_scanned_schedule(self, n_epochs: int, adam_patience: int,
                               get_fn, reload_best, state, key_field: str,
@@ -731,7 +767,7 @@ class CNNTrainer:
                 opt = jax.jit(lambda o: o, out_shardings=member_sh)(opt)
             state["opt_state"] = opt
 
-        if callback is None and mesh is None:
+        if callback is None and (mesh is None or cfg.scan_mesh_phases):
             # Fast path (the production single-chip retrain): each schedule
             # phase is ONE scanned jit dispatch — <=len(PHASES) device
             # round-trips for the whole schedule instead of one per epoch
@@ -741,21 +777,23 @@ class CNNTrainer:
             # run_epoch, so both paths compute identical trajectories
             # (pinned by test_fit_many_scanned_matches_per_epoch).
             #
-            # The MESH path deliberately stays per-epoch: compiling
-            # scan(vmap(epoch)) with member shardings + donation segfaulted
-            # the virtual-CPU XLA backend (SIGSEGV inside
+            # The MESH path defaults to per-epoch and takes the scanned
+            # program only when ``TrainConfig.scan_mesh_phases`` opts in:
+            # compiling scan(vmap(epoch)) with member shardings + donation
+            # segfaulted the virtual-CPU XLA backend (SIGSEGV inside
             # backend_compile_and_load) deterministically in full-suite
             # process state — and that backend is exactly what validates
-            # multi-chip correctness without hardware, so it must never be
-            # the fragile construct.  On a real pod the per-epoch dispatch
-            # cost also amortizes differently (one host drives many chips
-            # doing more work per epoch), so the scan's win is smaller
-            # there to begin with.
+            # multi-chip correctness without hardware, so the default mesh
+            # construct must never be the fragile one.  Real TPU meshes
+            # don't share that bug; production multi-chip retrains should
+            # set the flag and get <=4 dispatches instead of ~n_epochs
+            # (1-device-mesh numeric parity pinned by
+            # test_fit_many_scanned_mesh_matches_per_epoch).
             records.extend(self._run_scanned_schedule(
                 n_epochs, adam_patience,
                 lambda phase, n_ep: self._phase_fn_many(
                     phase, n_ep, len(train_ids), len(test_ids),
-                    batch_size),
+                    batch_size, mesh),
                 reload_best, state, "keys",
                 (data_arg, lengths_arg, train_rows, train_y, test_rows,
                  test_y)))

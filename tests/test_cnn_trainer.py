@@ -428,3 +428,71 @@ def test_fit_scanned_matches_per_epoch(rng):
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
         best_scan, best_loop)
+
+
+def test_fit_many_scanned_mesh_matches_per_epoch(rng):
+    """``TrainConfig.scan_mesh_phases`` opts the member-sharded MESH retrain
+    into the scanned per-phase program (<=4 dispatches instead of one per
+    epoch on a real pod).  On a 1-device mesh — the simplest sharded
+    construct, safe on the virtual-CPU validation backend — its trajectory
+    and best params must match the per-epoch mesh path."""
+    import dataclasses
+
+    from consensus_entropy_tpu.parallel.mesh import make_training_mesh
+
+    waves, classes = _synthetic_pool(rng, 6)
+    store = DeviceWaveformStore(waves, TINY.input_length)
+    ids = list(waves)
+    y = one_hot_np([classes[s] for s in ids])
+    members = [short_cnn.init_variables(jax.random.key(i), TINY)
+               for i in range(2)]
+    cfg = TrainConfig(batch_size=4, adam_patience=3, sgd_patience=2)
+    mesh = make_training_mesh(dp=1, member=1, devices=jax.devices()[:1])
+
+    def run(train_cfg):
+        trainer = CNNTrainer(TINY, train_cfg)
+        vs = [jax.tree.map(np.copy, v) for v in members]
+        return trainer.fit_many(vs, store, ids, y, ids, y,
+                                jax.random.key(5), n_epochs=9, mesh=mesh)
+
+    best_loop, hist_loop = run(cfg)  # per-epoch mesh path (default)
+    best_scan, hist_scan = run(
+        dataclasses.replace(cfg, scan_mesh_phases=True))
+    assert len(hist_scan) == len(hist_loop) == 2
+    for hs, hl in zip(hist_scan, hist_loop):
+        assert [h["phase"] for h in hs] == [h["phase"] for h in hl]
+        np.testing.assert_allclose([h["val_loss"] for h in hs],
+                                   [h["val_loss"] for h in hl],
+                                   rtol=1e-5, atol=1e-6)
+        assert ([h["improved"] for h in hs]
+                == [h["improved"] for h in hl])
+    for bs, bl in zip(best_scan, best_loop):
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6), bs, bl)
+
+
+def test_epoch_fns_cache_bounded(rng, monkeypatch):
+    """_EPOCH_FNS is a bounded LRU: in a production AL run n_train grows
+    every iteration, so unbounded (phase, n_train)-keyed programs would
+    leak for the process lifetime (round-4 advisor finding)."""
+    from consensus_entropy_tpu.models import cnn_trainer as ct
+
+    waves, classes = _synthetic_pool(rng, 8)
+    store = DeviceWaveformStore(waves, TINY.input_length)
+    ids = list(waves)
+    y = one_hot_np([classes[s] for s in ids])
+    trainer = CNNTrainer(TINY, TrainConfig(batch_size=4))
+    monkeypatch.setattr(ct, "_EPOCH_FNS_MAX", 3)
+    ct._EPOCH_FNS.clear()
+    # growing n_train (the AL pool growth pattern) — 5 distinct keys
+    for n in range(4, 9):
+        trainer._epoch_fn("adam", n, len(ids), 4)
+    assert len(ct._EPOCH_FNS) == 3
+    kept = [k[3] for k in ct._EPOCH_FNS]  # n_train slot of the key
+    assert kept == [6, 7, 8]  # least-recently-used evicted first
+    # a cache hit refreshes recency instead of re-tracing
+    fn = trainer._epoch_fn("adam", 6, len(ids), 4)
+    trainer._epoch_fn("adam", 9, len(ids), 4)  # evicts 7, not 6
+    assert trainer._epoch_fn("adam", 6, len(ids), 4) is fn
+    assert [k[3] for k in ct._EPOCH_FNS] == [8, 9, 6]
+    ct._EPOCH_FNS.clear()
