@@ -507,6 +507,61 @@ def run_cnn_suite(args_ns) -> int:
          f"{cpu_elapsed * 1e3:.0f} ms -> {cpu_ms:.0f} ms extrapolated "
          f"linearly to the full pool")
 
+    # Roofline/MFU accounting from XLA's OWN cost model on the compiled
+    # winning-dtype program (round-4 VERDICT: the README's prose roofline
+    # applied f32 byte accounting to a bf16 run and claimed a floor ABOVE
+    # the measured time — impossible; the artifact, not prose, now carries
+    # dtype-correct numbers).  cost_analysis() reflects the optimized
+    # post-fusion HLO, so fused elementwise traffic isn't double-counted.
+    roofline = None
+    try:
+        it_win = it_bf16 if winner == "bfloat16" else it_f32
+        ca = (jax.jit(it_win).lower(sd, cd, jnp.float32(0.0))
+              .compile().cost_analysis())
+        if isinstance(ca, list):  # older jax returns [dict]
+            ca = ca[0]
+        flops = float(ca.get("flops", 0.0))
+        gbytes = float(ca.get("bytes accessed", 0.0)) / 1e9
+        roofline = {
+            "source": "XLA cost_analysis on the compiled "
+                      f"{winner} program",
+            "flops_G": round(flops / 1e9, 1),
+            "bytes_accessed_GB": round(gbytes, 3),
+        }
+        dev0 = jax.devices()[0]
+        # Peak constants are DEVICE-SPECIFIC; only v5e's are known here.
+        # Emitting v5e floors from another chip (or the CPU validation
+        # backend) would be exactly the mismatched-accounting error this
+        # block exists to prevent, so floor/MFU attach only on v5 lite.
+        if dev0.platform == "tpu" and "v5 lite" in dev0.device_kind \
+                and gbytes > 0 and flops > 0:
+            # v5e: 197 TFLOP/s bf16 peak, ~819 GB/s HBM.  MFU is always
+            # quoted against the bf16 peak — the hardware maximum — so an
+            # f32 winner reads as a lower fraction rather than flattering
+            # itself against a softer denominator.
+            peak_tf, hbm_gbps = 197.0, 819.0
+            floor_ms = gbytes / hbm_gbps * 1e3
+            roofline.update({
+                "peaks_device": dev0.device_kind,
+                "hbm_GBps_peak": hbm_gbps,
+                "peak_tflops_bf16": peak_tf,
+                "hbm_roofline_floor_ms": round(floor_ms, 2),
+                "measured_over_floor": round(dev_ms / floor_ms, 2),
+                "mfu": round(flops / (dev_ms * 1e-3) / (peak_tf * 1e12),
+                             3),
+            })
+            _log(f"[roofline] {gbytes:.2f} GB accessed -> "
+                 f"{floor_ms:.2f} ms HBM floor; measured {dev_ms:.2f} ms "
+                 f"({dev_ms / floor_ms:.2f}x floor), "
+                 f"MFU {roofline['mfu']:.1%} of {peak_tf:.0f} TF/s bf16")
+        else:
+            _log(f"[roofline] cost model only ({gbytes:.2f} GB, "
+                 f"{flops / 1e9:.1f} GFLOP): no peak constants for "
+                 f"{dev0.platform}/{dev0.device_kind}")
+    except Exception as e:  # cost model unavailable on some backends
+        roofline = None
+        _log(f"[roofline] cost_analysis unavailable: {e}")
+
     print(json.dumps({
         "metric": (f"cnn_committee_scoring_{n_members}m_{n_songs}"
                    + ("" if args_ns.arch == "vgg" else f"_{args_ns.arch}")),
@@ -516,6 +571,7 @@ def run_cnn_suite(args_ns) -> int:
         "bf16_gate": f"prob_tol_0.02_{args_ns.gate_weights}",
         "bf16_max_prob_err": round(bf16_err, 6),
         "bf16_top1_agreement": round(agree, 4),
+        "roofline": roofline,
         "value": round(dev_ms, 3),
         "unit": "ms",
         "vs_baseline": round(cpu_ms / dev_ms, 1),
@@ -670,6 +726,9 @@ def main(argv=None) -> int:
                     default="auto")
     ap.add_argument("--tile-n", type=int, default=512,
                     help="pallas pool tile (pool rows per grid step)")
+    ap.add_argument("--tile-sweep", type=int, nargs="*", default=None,
+                    help="extra pallas pool tiles to race alongside "
+                         "--tile-n (each costs one Mosaic compile)")
     ap.add_argument("--fuse-topk", action="store_true",
                     help="rank queries inside the pallas kernel")
     ap.add_argument("--chain", type=int, default=150,
@@ -725,7 +784,9 @@ def main(argv=None) -> int:
 
     # -- device implementations -------------------------------------------
     impls = {}
-    if args_ns.impl in ("auto", "xla"):
+    if args_ns.impl in ("auto", "xla", "pallas"):
+        # the pallas run keeps the xla build too: the committed artifact
+        # must carry the comparison, not just the kernel's own number
         impls["xla"] = build_xla_impl(x, w, b, args_ns.k, args_ns.mode,
                                       hc_freq)
         if args_ns.impl == "auto" and args_ns.mode == "mc":
@@ -752,6 +813,10 @@ def main(argv=None) -> int:
                 # sort cost.
                 impls["pallas-fusedtopk"] = build_pallas_impl(
                     x, w, b, args_ns.k, args_ns.tile_n, True)
+            for tile in (args_ns.tile_sweep or []):
+                if tile != args_ns.tile_n:
+                    impls[f"pallas-tile{tile}"] = build_pallas_impl(
+                        x, w, b, args_ns.k, tile, args_ns.fuse_topk)
         else:
             _log(f"[pallas] skipped: Mosaic kernels need TPU devices "
                  f"(found {devices[0].platform})")
@@ -814,6 +879,11 @@ def main(argv=None) -> int:
         "value": round(dev_ms, 3),
         "unit": "ms",
         "vs_baseline": round(cpu_ms / dev_ms, 1),
+        # every parity-passing implementation's ms/iter: the race itself
+        # is the evidence (which impl won, by how much), not just the
+        # winner's number
+        "impls": {k: round(v, 3) for k, v in sorted(results.items())},
+        "best_impl": best,
         **extra,
         **_provenance(),
     }))

@@ -119,9 +119,23 @@ class CNNMember(Member):
     def __init__(self, name: str, variables, config: CNNConfig = CNNConfig(),
                  train_config: TrainConfig = TrainConfig()):
         super().__init__(name)
-        self.variables = variables
+        self.variables = variables  # property setter marks ckpt_dirty
         self.config = config
         self.train_config = train_config
+
+    @property
+    def variables(self):
+        return self._variables
+
+    @variables.setter
+    def variables(self, value):
+        """Rebinding the variables marks the member checkpoint-dirty: the
+        committee's ``begin_save`` fetches only members whose weights
+        changed since the last snapshot (retraining rebinds, never mutates
+        in place), so unchanged members cost zero device→host traffic on
+        the per-iteration checkpoint cadence."""
+        self._variables = value
+        self.ckpt_dirty = True
 
     def predict_proba(self, X):  # feature-table API doesn't apply
         raise TypeError("CNNMember scores audio crops via Committee")
@@ -158,8 +172,30 @@ class CNNMember(Member):
                     if k in meta and meta[k] != getattr(config, k)}
         if override:
             config = dataclasses.replace(config, **override)
-        return cls(meta.get("name", os.path.basename(path)), variables,
-                   config, train_config)
+        # Checkpoints may carry bf16 leaves (ALConfig.ckpt_dtype): restore
+        # to f32 — training/optimizer state and the scoring path are f32
+        # with an explicit compute_dtype gate, not mixed-storage.
+        variables = jax.tree.map(
+            lambda a: a.astype(np.float32)
+            if a.dtype == jnp.bfloat16
+            or (a.dtype.kind == "f" and a.dtype != np.float32)
+            else a, variables)
+        member = cls(meta.get("name", os.path.basename(path)), variables,
+                     config, train_config)
+        # freshly loaded == content of the file it came from: if that file
+        # (or a byte-identical workspace copy) is the checkpoint target,
+        # begin_save may skip the fetch until the member retrains
+        member.ckpt_dirty = False
+        return member
+
+
+@jax.jit
+def _cast_tree_bf16(tree):
+    """f32 leaves → bf16 on device (checkpoint-fetch shrink; non-float and
+    non-f32 leaves pass through untouched)."""
+    return jax.tree.map(
+        lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a,
+        tree)
 
 
 def _concat_member_blocks(blocks):
@@ -563,8 +599,13 @@ class Committee:
             n_epochs=(self.trainer.train_config.n_epochs_retrain
                       if n_epochs is None else n_epochs),
             mesh=self.train_mesh)
-        for m, b in zip(self.cnn_members, best):
-            m.variables = b
+        for m, b, h in zip(self.cnn_members, best, histories):
+            # A member with no improved epoch returns its incoming weights
+            # (best-checkpoint gate starts at score 0, amg_test.py:295):
+            # keep the old tree so the member stays checkpoint-clean and
+            # the next begin_save skips its device→host fetch entirely.
+            if any(e["improved"] for e in h):
+                m.variables = b
         return histories
 
     def predict_songs_cnn(self, store: DeviceWaveformStore, song_ids, key,
@@ -731,7 +772,8 @@ class Committee:
     def save(self, directory: str):
         self.begin_save(directory)()
 
-    def begin_save(self, directory: str):
+    def begin_save(self, directory: str, *, reuse_dir: str | None = None,
+                   dtype: str | None = None):
         """Split checkpointing into a synchronous SNAPSHOT and a deferred
         WRITE: host members (KB pickles, mutated in place by the next
         ``partial_fit``) are written immediately; CNN members only need
@@ -742,17 +784,59 @@ class Committee:
         per-leaf, fetches serialize ~90 ms tunnel round-trips) and is safe
         to run on another thread while the committee keeps training — the
         AL loop overlaps it with the next iteration's compute
-        (``al.loop``)."""
+        (``al.loop``).
+
+        ``reuse_dir``: the directory whose files this checkpoint's promote
+        will leave in place for anything not written here — i.e. the live
+        workspace the committee was loaded from / last checkpointed into.
+        Members whose variables have not been rebound since their last
+        snapshot (``ckpt_dirty`` false) and whose file already exists
+        there are SKIPPED: the existing file already holds their exact
+        content, so the fetch costs nothing.  Callers persisting to a
+        fresh directory (pretrain registry ``save``) leave it ``None`` and
+        every member is written.
+
+        ``dtype="bfloat16"``: cast the fetch on device before the
+        device→host copy — halves checkpoint traffic; restore casts back
+        to f32 (see ``ALConfig.ckpt_dtype`` for the resume-rounding
+        contract)."""
         os.makedirs(directory, exist_ok=True)
         for m in self.host_members:
             m.save(os.path.join(directory, f"classifier_{m.kind}.{m.name}.pkl"))
-        snapshot = [(m, m.variables) for m in self.cnn_members]
+
+        def fname(m):
+            return f"classifier_cnn.{m.name}.msgpack"
+
+        to_write = [m for m in self.cnn_members
+                    if m.ckpt_dirty or reuse_dir is None
+                    or not os.path.exists(os.path.join(reuse_dir, fname(m)))]
+        if dtype in (None, "float32"):
+            snapshot = [(m, m.variables) for m in to_write]
+        elif dtype == "bfloat16":
+            # one tiny async dispatch per member; the halved bytes are
+            # what the deferred device_get moves over the link
+            snapshot = [(m, _cast_tree_bf16(m.variables)) for m in to_write]
+        else:
+            raise ValueError(f"unsupported checkpoint dtype {dtype!r}")
+        for m in to_write:
+            # synchronous clear (single-threaded with retrain_cnns): the
+            # submitted job's failure is surfaced by the checkpointer's
+            # next wait(), which aborts the run — so a cleared flag never
+            # silently outlives a lost write
+            m.ckpt_dirty = False
 
         def finish():
+            import time
+
+            t0 = time.perf_counter()
             fetched = jax.device_get([v for _, v in snapshot])
+            t1 = time.perf_counter()
             for (m, _), v in zip(snapshot, fetched):
-                m.save(os.path.join(directory,
-                                    f"classifier_cnn.{m.name}.msgpack"),
-                       variables=v)
+                m.save(os.path.join(directory, fname(m)), variables=v)
+            # self-timed so the AL loop can surface the background fetch
+            # (tunnel-bound d2h) separately from foreground phase time
+            return {"fetch_s": t1 - t0,
+                    "write_s": time.perf_counter() - t1,
+                    "n_members_fetched": len(snapshot)}
 
         return finish

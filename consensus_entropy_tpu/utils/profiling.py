@@ -49,6 +49,13 @@ class StepTimer:
             self._acc[name] = (self._acc.get(name, 0.0)
                                + time.perf_counter() - t0)
 
+    def add(self, name: str, seconds: float) -> None:
+        """Accumulate an externally measured duration into the current
+        record (e.g. a background thread's self-timed work — such phases
+        OVERLAP the foreground ones and must not be summed into iteration
+        wall-clock)."""
+        self._acc[name] = self._acc.get(name, 0.0) + seconds
+
     def flush(self, **labels) -> dict:
         """Close the current record: labels + ``{phase}_s`` durations."""
         rec = dict(labels)

@@ -279,3 +279,89 @@ def test_crop_forward_sliced_under_pool_mesh(rng):
                                               jax.random.key(7)))
     assert got.shape == (1, 300, NUM_CLASSES)
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_begin_save_skips_clean_members(tmp_path, rng):
+    """Per-iteration checkpoint traffic: a CNN member whose variables were
+    not rebound since its last snapshot is NOT re-fetched or re-written
+    when the live workspace already holds its file (promote leaves
+    non-staged files in place, so the old file stays exactly current)."""
+    import os
+
+    from consensus_entropy_tpu.al import workspace
+
+    com = _committee(rng, n_cnn=2)
+    live = tmp_path / "user0"
+    com.save(str(live))  # fresh dir: everything written
+    loaded = workspace.load_committee(str(live), TINY,
+                                      TrainConfig(batch_size=2))
+    assert all(not m.ckpt_dirty for m in loaded.cnn_members)
+
+    stage = tmp_path / "stage1"
+    loaded.begin_save(str(stage), reuse_dir=str(live))()
+    staged = sorted(os.listdir(stage))
+    assert not any(f.startswith("classifier_cnn") for f in staged)
+    assert any(f.startswith("classifier_gnb") for f in staged)
+
+    # rebinding one member's variables marks it dirty -> it (and only it)
+    # is written by the next checkpoint
+    loaded.cnn_members[0].variables = loaded.cnn_members[0].variables
+    stage2 = tmp_path / "stage2"
+    loaded.begin_save(str(stage2), reuse_dir=str(live))()
+    cnn_files = [f for f in os.listdir(stage2)
+                 if f.startswith("classifier_cnn")]
+    assert cnn_files == [f"classifier_cnn.{loaded.cnn_members[0].name}"
+                         ".msgpack"]
+    assert not loaded.cnn_members[0].ckpt_dirty
+    # without reuse_dir (pretrain-registry save) everything is written
+    stage3 = tmp_path / "stage3"
+    loaded.begin_save(str(stage3))()
+    assert len([f for f in os.listdir(stage3)
+                if f.startswith("classifier_cnn")]) == 2
+
+
+def test_bf16_checkpoint_roundtrip(tmp_path, rng):
+    """dtype='bfloat16' halves the checkpoint fetch; restore comes back
+    f32 within bf16 rounding and scores within the committed bf16 gate's
+    tolerance."""
+    com = _committee(rng, n_cnn=1)
+    d = tmp_path / "user0"
+    com.begin_save(str(d), dtype="bfloat16")()
+    m2 = CNNMember.load(
+        str(d / f"classifier_cnn.{com.cnn_members[0].name}.msgpack"), TINY)
+    assert not m2.ckpt_dirty
+    v1, v2 = com.cnn_members[0].variables, m2.variables
+    for a, b in zip(jax.tree.leaves(v1), jax.tree.leaves(v2)):
+        b = np.asarray(b)
+        assert b.dtype == np.float32
+        np.testing.assert_allclose(np.asarray(a), b, rtol=1 / 128, atol=1e-6)
+    x = rng.standard_normal((3, TINY.input_length)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(short_cnn.apply_infer(v1, x, TINY)),
+        np.asarray(short_cnn.apply_infer(v2, x, TINY)), atol=2e-2)
+
+
+def test_retrain_keeps_clean_member_unbound(tmp_path, rng):
+    """A retrain in which NO epoch improves (score = 1 - val_loss never
+    clears the 0-init gate) returns the incoming weights; the member must
+    keep its old tree and stay checkpoint-clean so the next begin_save
+    skips its fetch."""
+    com = _committee(rng, n_cnn=1)
+    m = com.cnn_members[0]
+    # bias the head to predict ~1 everywhere, then validate against
+    # all-zero targets: val BCE ~= 10 >> 1 every epoch -> never improves
+    v = m.variables
+    v["params"]["dense2"]["bias"] = v["params"]["dense2"]["bias"] + 10.0
+    m.variables = v
+    com.save(str(tmp_path / "live"))
+    m.ckpt_dirty = False  # as after a load from the live workspace
+    old_tree = m.variables
+    waves = {f"s{i}": rng.standard_normal(9500).astype(np.float32)
+             for i in range(4)}
+    store = DeviceWaveformStore(waves, TINY.input_length)
+    y_zero = np.zeros((4, NUM_CLASSES), np.float32)
+    hists = com.retrain_cnns(store, list(waves), y_zero, list(waves),
+                             y_zero, jax.random.key(0), n_epochs=2)
+    assert not any(h["improved"] for h in hists[0])
+    assert m.variables is old_tree
+    assert not m.ckpt_dirty

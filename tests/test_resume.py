@@ -175,8 +175,15 @@ def test_step_timer_records_phases(tmp_path, rng):
     assert recs[0]["epoch"] == -1 and "evaluate_s" in recs[0]
     for r in recs[1:]:
         for phase in ("score_s", "select_s", "update_host_s", "evaluate_s",
-                      "checkpoint_s"):
+                      "ckpt_join_s", "checkpoint_s"):
             assert phase in r, r
+    # the background checkpoint job self-times; its durations surface on
+    # the NEXT record (one-record offset), tagged ckpt_bg_* so artifact
+    # consumers can exclude them from wall-clock totals
+    for r in recs[1:]:
+        assert "ckpt_bg_fetch_s" in r and "ckpt_bg_commit_s" in r, r
+        assert "ckpt_members_fetched" in r  # 0: host-only committee
+        assert r["ckpt_members_fetched"] == 0
 
 
 def test_async_checkpointer_orders_and_raises():
