@@ -66,6 +66,27 @@ CLASS_P = (0.35, 0.35, 0.15, 0.15)
 PRETRAIN_SONGS = {0: 3, 1: 3, 2: 1, 3: 1}
 
 
+def synth_tone(class_c: int, n: int, rng: np.random.Generator, *,
+               sample_rate: float, timbre: str = "sine",
+               noise: float = 0.3) -> np.ndarray:
+    """The experiment family's class-conditional waveform: a detuned class
+    tone (``TONE_FREQS``) in one of two timbres, plus white noise.  ONE
+    generator shared by the sweep pools, the full-geometry DEAM-scale
+    pretraining corpus (``scripts/realdata_run.py``), and the pilots — a
+    committee pretrained on the sine timbre transfers to any pool drawn
+    from this family."""
+    t = np.arange(n) / sample_rate
+    f = TONE_FREQS[class_c] * (1.0 + 0.01 * rng.standard_normal())
+    tone = np.sin(2 * np.pi * f * t)
+    if timbre == "square":
+        tone = np.sign(tone) * 0.8
+    elif timbre != "sine":
+        raise ValueError(f"unknown timbre {timbre!r}")
+    amp = float(rng.uniform(0.8, 1.2))
+    return (amp * tone
+            + noise * rng.standard_normal(n)).astype(np.float32)
+
+
 def familiar_timbre(song_id: str) -> bool:
     """Even-index songs carry the CNN pretraining corpus's timbre (sine);
     odd-index songs are the unfamiliar square-wave timbre the committee
@@ -77,7 +98,8 @@ def make_user(seed: int, *, n_songs: int = 250, n_feat: int = 12,
               sep: float = 3.0, hard_delta: float = 0.9,
               easy_delta: float | None = None, off: float = 0.5,
               noise: float = 0.7, tau: float = 1.0,
-              waves: bool = False) -> UserData:
+              waves: bool = False,
+              cnn_cfg: CNNConfig = CNN_CFG) -> UserData:
     """One synthetic user: two easy, abundant classes plus a rare
     *confusable pair* (class 3's center sits ``hard_delta`` from class 2's).
 
@@ -151,21 +173,22 @@ def make_user(seed: int, *, n_songs: int = 250, n_feat: int = 12,
 
         wave_dict = {}
         for i, c in enumerate(classes):
-            n = CNN_CFG.input_length + int(rng.integers(200, 1200))
-            t = np.arange(n) / CNN_CFG.sample_rate
-            f = TONE_FREQS[c] * (1.0 + 0.01 * rng.standard_normal())
-            tone = np.sin(2 * np.pi * f * t)
-            if not familiar_timbre(f"song{i:04d}"):
-                tone = np.sign(tone) * 0.8
-            wave_dict[f"song{i:04d}"] = (
-                tone + 0.3 * rng.standard_normal(n)).astype(np.float32)
-        store = DeviceWaveformStore(wave_dict, CNN_CFG.input_length)
+            n = cnn_cfg.input_length + int(rng.integers(200, 1200))
+            wave_dict[f"song{i:04d}"] = synth_tone(
+                c, n, rng, sample_rate=cnn_cfg.sample_rate,
+                timbre=("sine" if familiar_timbre(f"song{i:04d}")
+                        else "square"))
+        store = DeviceWaveformStore(wave_dict, cnn_cfg.input_length)
     return UserData(f"seed{seed}", pool, labels, hc_rows=hc, store=store)
 
 
 def make_committee(seed: int, data: UserData, *, folds: int = 5,
                    cnn_members: int = 0, cnn_pretrain_epochs: int = 10,
-                   cnn_pretrain_songs: int | None = None) -> Committee:
+                   cnn_pretrain_songs: int | None = None,
+                   sgd_members: int = 0,
+                   cnn_registry: str | None = None,
+                   cnn_cfg: CNNConfig = CNN_CFG,
+                   cnn_retrain: TrainConfig = CNN_RETRAIN) -> Committee:
     """Committee of ``folds`` GNB members, each pretrained on its own random
     song subset (the reference's 5-CV-folds-per-algorithm structure,
     ``deam_classifier.py:318-333``), drawn WITHOUT looking at the AL split
@@ -196,7 +219,37 @@ def make_committee(seed: int, data: UserData, *, folds: int = 5,
         fold_songs.append(picked)
         members.append(
             GNBMember(name=f"gnb{f}").fit(np.vstack(X), np.asarray(y)))
+    for f in range(sgd_members):
+        # SGD fold-members on the same per-fold slices (reference committee
+        # species #2; its partial_fit instability under concentrated
+        # batches is a documented property of the member — see the GNB
+        # design note above — so sgd_members is opt-in for the
+        # full-committee sweeps)
+        from consensus_entropy_tpu.models.sklearn_members import SGDMember
+
+        sl = fold_songs[f % folds]
+        rows = np.concatenate([data.pool.rows_for_songs([s]) for s in sl])
+        y = np.concatenate([[data.labels[s]] * data.pool.count_of(s)
+                            for s in sl])
+        members.append(SGDMember(name=f"sgd{f}", seed=seed * 31 + f).fit(
+            data.pool.X[rows], y))
     cnns = []
+    if cnn_registry is not None:
+        # Full-geometry fold-members pretrained ONCE at DEAM scale
+        # (scripts/realdata_run.py: 1802 songs under the real
+        # deam_annotations label pipeline, this experiment family's sine
+        # timbre) and copied into every (seed, mode) run — the reference's
+        # structure exactly: one DEAM-pretrained committee, copied per
+        # user (amg_test.py:146-171), personalized by AL.
+        from consensus_entropy_tpu.models.committee import CNNMember
+
+        for f in range(cnn_members or 5):
+            path = os.path.join(cnn_registry,
+                                f"classifier_cnn.it_{f}.msgpack")
+            m = CNNMember.load(path, cnn_cfg, cnn_retrain)
+            m.name = f"cnn{f}"
+            cnns.append(m)
+        return Committee(members, cnns, cnn_cfg, cnn_retrain)
     if cnn_members:
         # Tiny Flax CNN fold-members pretrained on their fold's songs — the
         # committee then spans both member species, exercising the full CNN
@@ -213,7 +266,7 @@ def make_committee(seed: int, data: UserData, *, folds: int = 5,
         from consensus_entropy_tpu.models.cnn_trainer import CNNTrainer
         from consensus_entropy_tpu.models.committee import CNNMember
 
-        trainer = CNNTrainer(CNN_CFG, CNN_PRETRAIN)
+        trainer = CNNTrainer(cnn_cfg, CNN_PRETRAIN)
         # CNN folds pretrain on the FAMILIAR timbre only — the pretraining
         # corpus (DEAM in the reference) does not cover the user library's
         # unfamiliar production styles; discovering those is acquisition's
@@ -244,12 +297,12 @@ def make_committee(seed: int, data: UserData, *, folds: int = 5,
                                       * PRETRAIN_SONGS[c] / 3))]]
             y1 = one_hot_np([data.labels[s] for s in songs])
             variables = short_cnn.init_variables(
-                jax.random.key(seed * 131 + f), CNN_CFG)
+                jax.random.key(seed * 131 + f), cnn_cfg)
             best, _ = trainer.fit(variables, data.store, songs, y1, songs,
                                   y1, jax.random.key(seed * 7 + f),
                                   n_epochs=cnn_pretrain_epochs)
-            cnns.append(CNNMember(f"cnn{f}", best, CNN_CFG, CNN_RETRAIN))
-    return Committee(members, cnns, CNN_CFG, CNN_RETRAIN)
+            cnns.append(CNNMember(f"cnn{f}", best, cnn_cfg, cnn_retrain))
+    return Committee(members, cnns, cnn_cfg, cnn_retrain)
 
 
 def run_one(seed: int, mode: str, workdir: str, *, queries: int = 5,
@@ -257,15 +310,23 @@ def run_one(seed: int, mode: str, workdir: str, *, queries: int = 5,
             cnn_pretrain_epochs: int = 10, cnn_retrain_epochs: int = 5,
             cnn_pretrain_songs: int | None = None,
             easy_delta: float | None = None,
-            hard_delta: float = 0.9) -> list[list[float]]:
+            hard_delta: float = 0.9, sgd_members: int = 0,
+            cnn_registry: str | None = None,
+            cnn_cfg: CNNConfig = CNN_CFG,
+            cnn_retrain: TrainConfig = CNN_RETRAIN) -> list[list[float]]:
     """One (seed, mode) AL run through the production loop; returns the
     per-epoch PER-MEMBER F1 lists from metrics.jsonl (epoch0 baseline
     included)."""
-    data = make_user(seed, n_songs=n_songs, waves=cnn_members > 0,
-                     easy_delta=easy_delta, hard_delta=hard_delta)
+    data = make_user(seed, n_songs=n_songs,
+                     waves=cnn_members > 0 or cnn_registry is not None,
+                     easy_delta=easy_delta, hard_delta=hard_delta,
+                     cnn_cfg=cnn_cfg)
     committee = make_committee(seed, data, cnn_members=cnn_members,
                                cnn_pretrain_epochs=cnn_pretrain_epochs,
-                               cnn_pretrain_songs=cnn_pretrain_songs)
+                               cnn_pretrain_songs=cnn_pretrain_songs,
+                               sgd_members=sgd_members,
+                               cnn_registry=cnn_registry, cnn_cfg=cnn_cfg,
+                               cnn_retrain=cnn_retrain)
     path = os.path.join(workdir, f"seed{seed}", mode)
     os.makedirs(path, exist_ok=True)
     metrics = os.path.join(path, "metrics.jsonl")
@@ -274,7 +335,8 @@ def run_one(seed: int, mode: str, workdir: str, *, queries: int = 5,
         # same workdir would silently corrupt the statistics
         os.unlink(metrics)
     cfg = ALConfig(queries=queries, epochs=epochs, mode=mode, seed=seed)
-    ALLoop(cfg, retrain_epochs=(cnn_retrain_epochs if cnn_members
+    has_cnns = bool(cnn_members) or cnn_registry is not None
+    ALLoop(cfg, retrain_epochs=(cnn_retrain_epochs if has_cnns
                                 else None)).run_user(
         committee, data, path, resume=False)
     per_epoch = []
@@ -289,6 +351,9 @@ def sweep(seeds: Sequence[int], workdir: str, *, modes=MODES,
           cnn_members: int = 0, cnn_pretrain_epochs: int = 10,
           cnn_retrain_epochs: int = 5, cnn_pretrain_songs: int | None = None,
           easy_delta: float | None = None, hard_delta: float = 0.9,
+          sgd_members: int = 0, cnn_registry: str | None = None,
+          cnn_cfg: CNNConfig = CNN_CFG,
+          cnn_retrain: TrainConfig = CNN_RETRAIN,
           log=print) -> dict:
     """Matched-budget mode sweep: every mode sees the same user, committee
     state, split, and query budget per seed.  Returns
@@ -302,7 +367,9 @@ def sweep(seeds: Sequence[int], workdir: str, *, modes=MODES,
                 cnn_pretrain_epochs=cnn_pretrain_epochs,
                 cnn_retrain_epochs=cnn_retrain_epochs,
                 cnn_pretrain_songs=cnn_pretrain_songs,
-                easy_delta=easy_delta, hard_delta=hard_delta)
+                easy_delta=easy_delta, hard_delta=hard_delta,
+                sgd_members=sgd_members, cnn_registry=cnn_registry,
+                cnn_cfg=cnn_cfg, cnn_retrain=cnn_retrain)
             final = float(np.mean(results[mode][seed][-1]))
             log(f"  seed {seed} {mode:4s}: final mean F1 = {final:.4f}")
     return results

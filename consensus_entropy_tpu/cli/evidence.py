@@ -60,6 +60,24 @@ def build_parser() -> argparse.ArgumentParser:
                          "8-song slices).  The reference's CNN folds see "
                          "whole DEAM CV folds, so a deeper sample is the "
                          "closer analogue")
+    sw.add_argument("--sgd-members", type=int, default=0,
+                    help="add N SGD fold-members (full-committee sweeps; "
+                         "SGD's partial_fit instability under concentrated "
+                         "batches is a member property — see "
+                         "al/evidence.py make_committee)")
+    sw.add_argument("--cnn-registry", default=None, metavar="DIR",
+                    help="load CNN fold-members from this pretrained "
+                         "registry (classifier_cnn.it_{i}.msgpack) instead "
+                         "of pretraining tiny members per seed — the "
+                         "reference's copy-the-DEAM-committee-per-user "
+                         "structure.  Pair with --full-geometry when the "
+                         "registry holds reference-geometry members")
+    sw.add_argument("--full-geometry", action="store_true",
+                    help="pool waveforms + CNN config at the reference "
+                         "geometry (59049 samples, 128 mels, 7 blocks) "
+                         "and production retrain config; requires "
+                         "--cnn-registry (pretraining full-geometry "
+                         "members per seed is a wall-clock non-starter)")
     sw.add_argument("--modes", default="mc,hc,mix,rand")
     sw.add_argument("--baseline", default="rand",
                     help="control mode for the paired tests; tests are "
@@ -109,6 +127,14 @@ def main(argv=None) -> int:
     else:  # per-run AL workspaces are scratch unless the user keeps them
         cleanup = tempfile.TemporaryDirectory(prefix="ce_evidence_")
         workdir = cleanup.name
+    cnn_cfg, cnn_retrain = evidence.CNN_CFG, evidence.CNN_RETRAIN
+    if args.full_geometry:
+        if not args.cnn_registry:
+            print("--full-geometry requires --cnn-registry")
+            return 2
+        from consensus_entropy_tpu.config import CNNConfig, TrainConfig
+
+        cnn_cfg, cnn_retrain = CNNConfig(), TrainConfig()
     try:
         results = evidence.sweep(
             seeds, workdir, modes=modes, queries=args.queries,
@@ -117,7 +143,9 @@ def main(argv=None) -> int:
             cnn_pretrain_epochs=args.cnn_pretrain_epochs,
             cnn_retrain_epochs=args.cnn_retrain_epochs,
             cnn_pretrain_songs=args.cnn_pretrain_songs,
-            easy_delta=args.easy_delta, hard_delta=args.hard_delta)
+            easy_delta=args.easy_delta, hard_delta=args.hard_delta,
+            sgd_members=args.sgd_members, cnn_registry=args.cnn_registry,
+            cnn_cfg=cnn_cfg, cnn_retrain=cnn_retrain)
     finally:
         if cleanup is not None:
             cleanup.cleanup()
@@ -132,17 +160,23 @@ def main(argv=None) -> int:
                        "songs": args.songs,
                        "easy_delta": args.easy_delta,
                        "hard_delta": args.hard_delta,
-                       "committee": ("5x gnb fold-members"
-                                     + (f" + {args.cnn_members}x tiny cnn "
-                                        f"(pretrain "
-                                        f"{args.cnn_pretrain_epochs} ep"
-                                        + (f" on {args.cnn_pretrain_songs}"
-                                           "/abundant-class (3:1 rare)"
-                                           if args.cnn_pretrain_songs
-                                           else "")
-                                        + f", retrain "
-                                        f"{args.cnn_retrain_epochs} ep)"
-                                        if args.cnn_members else "")),
+                       "committee": (
+                           "5x gnb fold-members"
+                           + (f" + {args.sgd_members}x sgd fold-members"
+                              if args.sgd_members else "")
+                           + (f" + {args.cnn_members or 5}x "
+                              f"{'full-geometry ' if args.full_geometry else ''}"
+                              f"cnn from registry {args.cnn_registry} "
+                              "(DEAM-scale pretraining, copied per seed; "
+                              f"retrain {args.cnn_retrain_epochs} ep)"
+                              if args.cnn_registry else
+                              (f" + {args.cnn_members}x tiny cnn "
+                               f"(pretrain {args.cnn_pretrain_epochs} ep"
+                               + (f" on {args.cnn_pretrain_songs}"
+                                  "/abundant-class (3:1 rare)"
+                                  if args.cnn_pretrain_songs else "")
+                               + f", retrain {args.cnn_retrain_epochs} ep)"
+                               if args.cnn_members else ""))),
                        "reference_row": "paper §4.1 (MC>RAND p=0.0291, "
                                         "d.f.=229)"},
         "trajectories": evidence.trajectories(results),

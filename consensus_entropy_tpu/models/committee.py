@@ -133,9 +133,13 @@ class CNNMember(Member):
         committee's ``begin_save`` fetches only members whose weights
         changed since the last snapshot (retraining rebinds, never mutates
         in place), so unchanged members cost zero device→host traffic on
-        the per-iteration checkpoint cadence."""
+        the per-iteration checkpoint cadence.  ``ckpt_clean_path`` records
+        WHICH file a clean member's weights correspond to — clean relative
+        to the registry it was loaded from is not clean relative to a
+        workspace that happens to hold a same-named stale file."""
         self._variables = value
         self.ckpt_dirty = True
+        self.ckpt_clean_path: str | None = None
 
     def predict_proba(self, X):  # feature-table API doesn't apply
         raise TypeError("CNNMember scores audio crops via Committee")
@@ -182,10 +186,12 @@ class CNNMember(Member):
             else a, variables)
         member = cls(meta.get("name", os.path.basename(path)), variables,
                      config, train_config)
-        # freshly loaded == content of the file it came from: if that file
-        # (or a byte-identical workspace copy) is the checkpoint target,
-        # begin_save may skip the fetch until the member retrains
+        # freshly loaded == content of the file it came from: if that SAME
+        # file is the checkpoint target, begin_save may skip the fetch
+        # until the member retrains (a same-named file elsewhere proves
+        # nothing — see ckpt_clean_path)
         member.ckpt_dirty = False
+        member.ckpt_clean_path = os.path.abspath(path)
         return member
 
 
@@ -790,11 +796,15 @@ class Committee:
         will leave in place for anything not written here — i.e. the live
         workspace the committee was loaded from / last checkpointed into.
         Members whose variables have not been rebound since their last
-        snapshot (``ckpt_dirty`` false) and whose file already exists
-        there are SKIPPED: the existing file already holds their exact
-        content, so the fetch costs nothing.  Callers persisting to a
-        fresh directory (pretrain registry ``save``) leave it ``None`` and
-        every member is written.
+        snapshot (``ckpt_dirty`` false) AND whose recorded
+        ``ckpt_clean_path`` is exactly ``reuse_dir``'s file are SKIPPED:
+        that file provably holds their current content.  A clean member
+        loaded from a DIFFERENT directory (e.g. a pretrain registry) is
+        still written — a same-named file already in the workspace could
+        be a stale leftover, and adopting it would silently commit the
+        wrong weights.  Callers persisting to a fresh directory (pretrain
+        registry ``save``) leave ``reuse_dir`` ``None`` and every member
+        is written.
 
         ``dtype="bfloat16"``: cast the fetch on device before the
         device→host copy — halves checkpoint traffic; restore casts back
@@ -807,9 +817,14 @@ class Committee:
         def fname(m):
             return f"classifier_cnn.{m.name}.msgpack"
 
-        to_write = [m for m in self.cnn_members
-                    if m.ckpt_dirty or reuse_dir is None
-                    or not os.path.exists(os.path.join(reuse_dir, fname(m)))]
+        def provably_current(m):
+            if reuse_dir is None or m.ckpt_dirty:
+                return False
+            target = os.path.abspath(os.path.join(reuse_dir, fname(m)))
+            return (getattr(m, "ckpt_clean_path", None) == target
+                    and os.path.exists(target))
+
+        to_write = [m for m in self.cnn_members if not provably_current(m)]
         if dtype in (None, "float32"):
             snapshot = [(m, m.variables) for m in to_write]
         elif dtype == "bfloat16":
@@ -822,8 +837,13 @@ class Committee:
             # synchronous clear (single-threaded with retrain_cnns): the
             # submitted job's failure is surfaced by the checkpointer's
             # next wait(), which aborts the run — so a cleared flag never
-            # silently outlives a lost write
+            # silently outlives a lost write.  The clean provenance is the
+            # POST-PROMOTE location (reuse_dir) when known; a direct save
+            # (no staging) is clean against the directory written.
             m.ckpt_dirty = False
+            m.ckpt_clean_path = os.path.abspath(os.path.join(
+                reuse_dir if reuse_dir is not None else directory,
+                fname(m)))
 
         def finish():
             import time

@@ -131,7 +131,9 @@ def pretrain_classic(model: str, X, y, song_ids, *, cv: int,
     summary = {k: {"mean": float(np.mean(v)), "std": float(np.std(v))}
                for k, v in scores.items()}
     _print_cv(summary)
-    _append_jsonl(out_dir, {"model": model, "cv": cv, **summary})
+    _append_jsonl(out_dir, {"model": model, "cv": cv, **summary,
+                            "fold_f1": [round(float(v), 4)
+                                        for v in scores["f1"]]})
     return summary
 
 
@@ -198,7 +200,8 @@ def pretrain_cnn(song_labels: dict, store, *, cv: int, out_dir: str,
     _print_cv(summary)
     _append_jsonl(out_dir, {"model": ("cnn_jax" if config.arch == "vgg"
                                       else f"cnn_{config.arch}_jax"),
-                            "cv": cv, "arch": config.arch, **summary})
+                            "cv": cv, "arch": config.arch, **summary,
+                            "fold_f1": [round(float(v), 4) for v in f1s]})
     return summary
 
 

@@ -1,0 +1,275 @@
+"""Maximal real-data artifact: pretrain the committee on the REAL DEAM
+dynamic annotations (round-4 VERDICT #8).
+
+This image mounts exactly one piece of the reference's real data:
+``/root/reference/deam_annotations/{arousal,valence}.csv`` (1802 songs of
+per-500ms dynamic annotations — consumed by the reference at
+``deam_classifier.py:64-87``).  Per-song openSMILE feature CSVs, AMG1608
+``.mat`` annotations, and audio are NOT mounted, so full quality parity
+with the paper's Table (BASELINE.md: CNN mu=0.48, SGD mu=0.457,
+XGB mu=0.39, GNB mu=0.238 over 46 users) is environment-blocked.
+
+What this script commits instead — the closest attainable artifact:
+
+- REAL labels, real pipeline: the arousal/valence rows drive per-frame
+  quadrant labels through the exact reference rules (dropna per row, keep
+  the shorter annotation when lengths disagree, quadrant geometry,
+  lexicographic-max song label — ``data/deam.py`` / ``labels.py``).
+- SYNTHETIC features/audio, schema-exact: per-frame 260-column openSMILE-
+  schema features from a class-conditional generative model (10
+  informative columns, per-song offsets, frame noise), and full-length
+  class-tone waveforms from the experiment family's SINE timbre
+  (``al.evidence.synth_tone``) — the same family the EVIDENCE_r05 sweep
+  pools draw from, so the full-geometry CNN fold-members this run
+  produces are the sweep's pretrained committee.
+- The full pretraining surface: gnb / sgd / xgb / cnn_jax through the
+  production ``deam_classifier`` pipeline (5 grouped CV folds each, every
+  fold estimator kept — the committee registry).
+
+Usage:
+  python scripts/realdata_run.py [--root DIR] [--cnn-epochs 100]
+      [--out REALDATA_r05.json] [--skip-cnn] [--songs N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+REF_ANNO = "/root/reference/deam_annotations"
+
+#: class-conditional feature model: 10 informative columns out of the
+#: 260-column openSMILE schema; per-song offset comparable to the class
+#: separation and per-frame noise on top, so fold F1 lands in a
+#: mid-range, non-saturated band (still NOT real-data difficulty — the
+#: caveat in the committed artifact is explicit)
+N_INFORMATIVE = 10
+CLASS_SEP = 2.2
+SONG_OFF = 1.3
+FRAME_NOISE = 1.2
+
+
+def build_tree(root: str, n_songs: int | None, rng) -> tuple[dict, dict]:
+    """Synthesize the DEAM tree from the REAL annotation CSVs; returns
+    (paths dict, stats dict)."""
+    import pandas as pd
+
+    from consensus_entropy_tpu.al.evidence import synth_tone
+    from consensus_entropy_tpu.config import CNNConfig
+    from consensus_entropy_tpu.config import (
+        FEATURE_SLICE_START,
+        FEATURE_SLICE_STOP_FFTMAG,
+    )
+    from consensus_entropy_tpu.labels import quadrant_deam_np
+
+    # 260-column openSMILE slice at the REAL width: sentinel start/stop
+    # column names exact (config.feature_slice pins them); the 258
+    # interior names are synthetic — the real openSMILE CSVs (and hence
+    # their column names) are not mounted in this image
+    FEATURE_COLS_FFTMAG = ([FEATURE_SLICE_START]
+                           + [f"synth_col_{i}" for i in range(258)]
+                           + [FEATURE_SLICE_STOP_FFTMAG])
+
+    cfg = CNNConfig()  # full reference geometry (sample_rate for tones)
+    deam = os.path.join(root, "deam")
+    for sub in ("features", "annotations", "npy"):
+        os.makedirs(os.path.join(deam, sub), exist_ok=True)
+    # the REAL annotation tables, verbatim
+    for f in ("arousal.csv", "valence.csv"):
+        shutil.copy(os.path.join(REF_ANNO, f),
+                    os.path.join(deam, "annotations", f))
+    arousal = pd.read_csv(os.path.join(deam, "annotations", "arousal.csv"))
+    valence = pd.read_csv(os.path.join(deam, "annotations", "valence.csv"))
+    valence_ids = set(int(s) for s in valence.song_id)
+
+    centers = np.zeros((4, len(FEATURE_COLS_FFTMAG)), np.float32)
+    centers[:, :N_INFORMATIVE] = (
+        rng.standard_normal((4, N_INFORMATIVE)) * CLASS_SEP)
+
+    n_frames_total = 0
+    song_labels: dict[int, int] = {}
+    song_ids = [int(s) for s in arousal.song_id]
+    if n_songs:
+        song_ids = song_ids[:n_songs]
+    for sid in song_ids:
+        if sid not in valence_ids:
+            continue
+        a_row = arousal[arousal.song_id == sid].dropna(axis=1)
+        v_row = valence[valence.song_id == sid].dropna(axis=1)
+        t_a = [int("".join(filter(str.isdigit, c))) / 1000.0
+               for c in a_row.columns[1:]]
+        t_v = [int("".join(filter(str.isdigit, c))) / 1000.0
+               for c in v_row.columns[1:]]
+        # keep the shorter annotation (deam_classifier.py:75-83)
+        t_common = t_a if len(t_a) <= len(t_v) else t_v
+        if not t_common:
+            continue
+        cols = [f"sample_{int(t * 1000)}ms" for t in t_common]
+        a_vals = a_row.loc[:, cols].values[0].astype(np.float64)
+        v_vals = v_row.loc[:, cols].values[0].astype(np.float64)
+        q = quadrant_deam_np(a_vals, v_vals)  # per-frame class 0..3
+        # song-level label: lexicographic MAX quadrant — the reference's
+        # groupby('song_id')['quadrants'].max() rule (deam_classifier.py:253)
+        song_labels[sid] = int(q.max())
+        song_off = (rng.standard_normal(len(FEATURE_COLS_FFTMAG))
+                    .astype(np.float32) * SONG_OFF)
+        feats = (centers[q] + song_off
+                 + rng.standard_normal(
+                     (len(q), len(FEATURE_COLS_FFTMAG))).astype(np.float32)
+                 * FRAME_NOISE)
+        df = pd.DataFrame(feats, columns=FEATURE_COLS_FFTMAG)
+        df.insert(0, "frameTime", t_common)
+        df.to_csv(os.path.join(deam, "features", f"{sid}.csv"), sep=";",
+                  index=False)
+        n = cfg.input_length + 10000 + int(rng.integers(0, 2000))
+        np.save(os.path.join(deam, "npy", f"{sid}.npy"),
+                synth_tone(song_labels[sid], n, rng,
+                           sample_rate=cfg.sample_rate, timbre="sine"))
+        n_frames_total += len(q)
+    stats = {
+        "songs": len(song_labels),
+        "frames": n_frames_total,
+        "song_class_counts": {int(c): int(n) for c, n in zip(
+            *np.unique(list(song_labels.values()), return_counts=True))},
+    }
+    return ({"deam": deam, "models": os.path.join(root, "models")}, stats)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", default="/tmp/ce_realdata")
+    ap.add_argument("--out", default="REALDATA_r05.json")
+    ap.add_argument("--songs", type=int, default=None,
+                    help="limit songs (smoke); default: all 1802")
+    ap.add_argument("--cnn-epochs", type=int, default=100,
+                    help="CNN pretrain epochs per fold.  The reference "
+                         "default is 200 (settings n_epochs_cnn); the "
+                         "adam(40)->sgd schedule completes all transitions "
+                         "at epoch 100, and the last 100 sgd_3 epochs at "
+                         "lr=1e-5 move validation loss marginally — 100 is "
+                         "the wall-clock-bounded choice, recorded in the "
+                         "artifact")
+    ap.add_argument("--skip-cnn", action="store_true")
+    ap.add_argument("--skip-classic", action="store_true")
+    args = ap.parse_args(argv)
+
+    t_start = time.time()
+    rng = np.random.default_rng(1987)
+    os.makedirs(args.root, exist_ok=True)
+    print(f"building DEAM tree from REAL annotations under {args.root} ...",
+          flush=True)
+    roots, stats = build_tree(args.root, args.songs, rng)
+    print(f"  {stats['songs']} songs, {stats['frames']} frames, "
+          f"class counts {stats['song_class_counts']}", flush=True)
+
+    from consensus_entropy_tpu.config import PathsConfig, TrainConfig
+    from consensus_entropy_tpu.data import deam
+    from consensus_entropy_tpu.train import pretrain
+
+    paths = PathsConfig(models_root=roots["models"],
+                        deam_root=roots["deam"], amg_root=roots["deam"])
+    out_dir = paths.pretrained_dir
+    df = deam.load_dataset(paths.deam_features_dir,
+                           os.path.join(roots["deam"], "annotations",
+                                        "arousal.csv"),
+                           os.path.join(roots["deam"], "annotations",
+                                        "valence.csv"),
+                           cache_csv=paths.deam_dataset_csv)
+    print(f"joined frame table: {len(df)} rows", flush=True)
+
+    results: dict = {}
+    if not args.skip_classic:
+        X, y, song_ids = deam.training_arrays(df)
+        for model in ("gnb", "sgd", "xgb"):
+            t0 = time.time()
+            print(f"pretraining {model} (5 folds) ...", flush=True)
+            results[model] = pretrain.pretrain_classic(
+                model, X, y, song_ids, cv=5, out_dir=out_dir, seed=1987)
+            results[model]["wall_s"] = round(time.time() - t0, 1)
+    if not args.skip_cnn:
+        from consensus_entropy_tpu.data.audio import device_store_from_npy
+
+        per_song = df.groupby("song_id")["quadrants"].max()
+        labels = {sid: int(q[1]) - 1 for sid, q in per_song.items()}
+        store = device_store_from_npy(paths.deam_npy_dir, list(labels),
+                                      59049)
+        t0 = time.time()
+        print(f"pretraining cnn_jax (5 folds x {args.cnn_epochs} epochs, "
+              f"full geometry) ...", flush=True)
+        results["cnn_jax"] = pretrain.pretrain_cnn(
+            labels, store, cv=5, out_dir=out_dir,
+            train_config=TrainConfig(), n_epochs=args.cnn_epochs,
+            seed=1987)
+        results["cnn_jax"]["wall_s"] = round(time.time() - t0, 1)
+
+    # per-fold detail from the pretrainer's own jsonl
+    fold_detail = {}
+    jsonl = os.path.join(out_dir, "pretrain_metrics.jsonl")
+    if os.path.exists(jsonl):
+        for line in open(jsonl):
+            rec = json.loads(line)
+            fold_detail[rec["model"]] = rec
+
+    report = {
+        "metric": "realdata_pretrain_f1",
+        "what": "committee pretraining on the REAL DEAM dynamic "
+                "annotations (the only real reference data mounted in "
+                "this image) joined to schema-exact SYNTHETIC features "
+                "and class-tone audio",
+        "real": {
+            "files": [os.path.join(REF_ANNO, "arousal.csv"),
+                      os.path.join(REF_ANNO, "valence.csv")],
+            "label_pipeline": "dropna per row; shorter annotation kept on "
+                              "length mismatch (deam_classifier.py:75-83); "
+                              "quadrant geometry (labels.py); "
+                              "lexicographic-max song label "
+                              "(deam_classifier.py:253)",
+            **stats,
+        },
+        "synthetic": {
+            "features": f"260-col openSMILE schema, {N_INFORMATIVE} "
+                        f"informative cols, class sep {CLASS_SEP}, song "
+                        f"offset {SONG_OFF}, frame noise {FRAME_NOISE}",
+            "audio": "full-length class tones, sine timbre "
+                     "(al.evidence.synth_tone family)",
+            "caveat": "F1 here measures the synthetic features'/audio's "
+                      "class separability under the REAL label structure "
+                      "(incl. genuine frame-level label dynamics and the "
+                      "real class imbalance) — NOT real-data difficulty. "
+                      "Only the openSMILE/audio mounts block the "
+                      "remaining gap.",
+        },
+        "results": results,
+        "fold_detail": fold_detail,
+        "paper_reference_f1": {
+            "note": "BASELINE.md paper §5 final F1 after AL over 46 real "
+                    "users — different data AND different stage (post-AL "
+                    "vs pretrain CV); juxtaposed for orientation only",
+            "cnn": 0.48, "sgd": 0.457, "xgb": 0.39, "gnb": 0.238,
+        },
+        "registry_dir": out_dir,
+        "cnn_epochs": args.cnn_epochs,
+        "wall_s_total": round(time.time() - t_start, 1),
+    }
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=2)
+    print(json.dumps({"metric": report["metric"],
+                      "value": {m: results[m]["f1"]["mean"]
+                                for m in results},
+                      "unit": "weighted F1 (5-fold CV mean)"}))
+    print(f"wrote {args.out}; registry at {out_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,3 +108,55 @@ def test_committed_evidence_artifact_claims_hold():
     for name in ("mc>rand", "mix>rand", "hc>rand"):
         assert report["tests"][name]["per_member_final"]["p"] < 0.05, name
     assert report["tests"]["mc>rand"]["per_member_final"]["p"] < 1e-4
+
+
+def test_make_committee_from_registry(tmp_path):
+    """Registry-loaded CNN fold-members (the reference's copy-the-DEAM-
+    committee-per-user structure) + SGD fold-members: members load clean,
+    carry sweep names, and score through the committee."""
+    import jax
+
+    from consensus_entropy_tpu.models import short_cnn
+    from consensus_entropy_tpu.utils.checkpoint import save_variables
+
+    for i in range(3):
+        v = short_cnn.init_variables(jax.random.key(i), evidence.CNN_CFG)
+        save_variables(str(tmp_path / f"classifier_cnn.it_{i}.msgpack"), v,
+                       meta={"kind": "cnn_jax", "name": f"it_{i}"})
+    # enough songs that every class appears (SGD fit requires the full
+    # class universe; CLASS_P's rare classes can vanish from tiny pools)
+    data = evidence.make_user(0, n_songs=40, waves=True)
+    com = evidence.make_committee(0, data, cnn_members=3, sgd_members=2,
+                                  cnn_registry=str(tmp_path))
+    assert [m.name for m in com.cnn_members] == ["cnn0", "cnn1", "cnn2"]
+    assert not any(m.ckpt_dirty for m in com.cnn_members)
+    assert sum(m.name.startswith("sgd") for m in com.host_members) == 2
+    assert sum(m.name.startswith("gnb") for m in com.host_members) == 5
+    probs = np.asarray(com.pool_probs(data.pool, data.store,
+                                      data.pool.song_ids[:4],
+                                      jax.random.key(1)))
+    assert probs.shape == (10, 4, 4)  # (3 cnn + 7 host, songs, classes)
+    assert np.isfinite(probs).all()
+
+
+def test_sweep_with_registry_runs_production_loop(tmp_path):
+    """A 1-seed mc/rand sweep with a registry committee exercises the full
+    production path (scoring, 100-epoch default would be slow — pass
+    cnn_members to control retrain depth)."""
+    import jax
+
+    from consensus_entropy_tpu.models import short_cnn
+    from consensus_entropy_tpu.utils.checkpoint import save_variables
+
+    reg = tmp_path / "reg"
+    reg.mkdir()
+    for i in range(2):
+        v = short_cnn.init_variables(jax.random.key(i), evidence.CNN_CFG)
+        save_variables(str(reg / f"classifier_cnn.it_{i}.msgpack"), v,
+                       meta={"kind": "cnn_jax", "name": f"it_{i}"})
+    per_epoch = evidence.run_one(
+        0, "mc", str(tmp_path / "wk"), queries=3, epochs=2, n_songs=40,
+        cnn_members=2, cnn_retrain_epochs=2, cnn_registry=str(reg))
+    # epoch0 baseline + 2 AL iterations; 5 gnb + 2 cnn members
+    assert len(per_epoch) == 3
+    assert all(len(e) == 7 for e in per_epoch)
