@@ -57,6 +57,16 @@ CNN_RETRAIN = TrainConfig(batch_size=4)  # reference lr=1e-4
 #: labeled examples an uncertainty-targeted budget delivers
 TONE_FREQS = (220.0, 440.0, 784.0, 831.0)
 
+#: the "unfamiliar production style" class→frequency mapping for the
+#: full-geometry pools: a DIFFERENT f0 per class (same confusable-pair
+#: structure: classes 2/3 one semitone apart, ratio 1.06).  A
+#: full-geometry mel CNN pretrained on the TONE_FREQS sine corpus
+#: generalizes trivially across mere timbre at the SAME f0 (the round-5
+#: pilot measured epoch-0 F1 = 1.0 on square waves — zero headroom), so
+#: unfamiliarity worth labeling must shift the class-sound mapping
+#: itself, exactly as a personal library's unseen genres do vs DEAM.
+USER_FREQS = (311.1, 587.3, 987.8, 1046.5)
+
 #: class priors — the confusable pair (classes 2/3) is rare, so random
 #: acquisition spends ~70% of its budget on the easy majority classes
 CLASS_P = (0.35, 0.35, 0.15, 0.15)
@@ -68,15 +78,15 @@ PRETRAIN_SONGS = {0: 3, 1: 3, 2: 1, 3: 1}
 
 def synth_tone(class_c: int, n: int, rng: np.random.Generator, *,
                sample_rate: float, timbre: str = "sine",
-               noise: float = 0.3) -> np.ndarray:
+               noise: float = 0.3, freqs=TONE_FREQS) -> np.ndarray:
     """The experiment family's class-conditional waveform: a detuned class
-    tone (``TONE_FREQS``) in one of two timbres, plus white noise.  ONE
-    generator shared by the sweep pools, the full-geometry DEAM-scale
-    pretraining corpus (``scripts/realdata_run.py``), and the pilots — a
-    committee pretrained on the sine timbre transfers to any pool drawn
-    from this family."""
+    tone (``freqs``, default the pretraining corpus's ``TONE_FREQS``) in
+    one of two timbres, plus white noise.  ONE generator shared by the
+    sweep pools, the full-geometry DEAM-scale pretraining corpus
+    (``scripts/realdata_run.py``), and the pilots — a committee pretrained
+    on the sine timbre transfers to any pool drawn from this family."""
     t = np.arange(n) / sample_rate
-    f = TONE_FREQS[class_c] * (1.0 + 0.01 * rng.standard_normal())
+    f = freqs[class_c] * (1.0 + 0.01 * rng.standard_normal())
     tone = np.sin(2 * np.pi * f * t)
     if timbre == "square":
         tone = np.sign(tone) * 0.8
@@ -99,7 +109,8 @@ def make_user(seed: int, *, n_songs: int = 250, n_feat: int = 12,
               easy_delta: float | None = None, off: float = 0.5,
               noise: float = 0.7, tau: float = 1.0,
               waves: bool = False,
-              cnn_cfg: CNNConfig = CNN_CFG) -> UserData:
+              cnn_cfg: CNNConfig = CNN_CFG,
+              unfamiliar_freqs=None) -> UserData:
     """One synthetic user: two easy, abundant classes plus a rare
     *confusable pair* (class 3's center sits ``hard_delta`` from class 2's).
 
@@ -174,10 +185,18 @@ def make_user(seed: int, *, n_songs: int = 250, n_feat: int = 12,
         wave_dict = {}
         for i, c in enumerate(classes):
             n = cnn_cfg.input_length + int(rng.integers(200, 1200))
+            fam = familiar_timbre(f"song{i:04d}")
+            # ``unfamiliar_freqs`` (e.g. USER_FREQS) additionally shifts
+            # the unfamiliar songs' class→sound MAPPING — the
+            # mapping-novelty axis of the round-5 full-geometry mechanism
+            # study (timbre novelty alone is transparent to a
+            # full-geometry mel CNN: measured epoch-0 F1 = 1.0 on square
+            # waves at the pretrained f0s)
             wave_dict[f"song{i:04d}"] = synth_tone(
                 c, n, rng, sample_rate=cnn_cfg.sample_rate,
-                timbre=("sine" if familiar_timbre(f"song{i:04d}")
-                        else "square"))
+                timbre=("sine" if fam else "square"),
+                freqs=(TONE_FREQS if fam or unfamiliar_freqs is None
+                       else unfamiliar_freqs))
         store = DeviceWaveformStore(wave_dict, cnn_cfg.input_length)
     return UserData(f"seed{seed}", pool, labels, hc_rows=hc, store=store)
 
@@ -313,14 +332,15 @@ def run_one(seed: int, mode: str, workdir: str, *, queries: int = 5,
             hard_delta: float = 0.9, sgd_members: int = 0,
             cnn_registry: str | None = None,
             cnn_cfg: CNNConfig = CNN_CFG,
-            cnn_retrain: TrainConfig = CNN_RETRAIN) -> list[list[float]]:
+            cnn_retrain: TrainConfig = CNN_RETRAIN,
+            unfamiliar_freqs=None) -> list[list[float]]:
     """One (seed, mode) AL run through the production loop; returns the
     per-epoch PER-MEMBER F1 lists from metrics.jsonl (epoch0 baseline
     included)."""
     data = make_user(seed, n_songs=n_songs,
                      waves=cnn_members > 0 or cnn_registry is not None,
                      easy_delta=easy_delta, hard_delta=hard_delta,
-                     cnn_cfg=cnn_cfg)
+                     cnn_cfg=cnn_cfg, unfamiliar_freqs=unfamiliar_freqs)
     committee = make_committee(seed, data, cnn_members=cnn_members,
                                cnn_pretrain_epochs=cnn_pretrain_epochs,
                                cnn_pretrain_songs=cnn_pretrain_songs,
@@ -354,7 +374,7 @@ def sweep(seeds: Sequence[int], workdir: str, *, modes=MODES,
           sgd_members: int = 0, cnn_registry: str | None = None,
           cnn_cfg: CNNConfig = CNN_CFG,
           cnn_retrain: TrainConfig = CNN_RETRAIN,
-          log=print) -> dict:
+          unfamiliar_freqs=None, log=print) -> dict:
     """Matched-budget mode sweep: every mode sees the same user, committee
     state, split, and query budget per seed.  Returns
     ``{mode: {seed: [[member f1 per epoch]]}}``."""
@@ -369,7 +389,8 @@ def sweep(seeds: Sequence[int], workdir: str, *, modes=MODES,
                 cnn_pretrain_songs=cnn_pretrain_songs,
                 easy_delta=easy_delta, hard_delta=hard_delta,
                 sgd_members=sgd_members, cnn_registry=cnn_registry,
-                cnn_cfg=cnn_cfg, cnn_retrain=cnn_retrain)
+                cnn_cfg=cnn_cfg, cnn_retrain=cnn_retrain,
+                unfamiliar_freqs=unfamiliar_freqs)
             final = float(np.mean(results[mode][seed][-1]))
             log(f"  seed {seed} {mode:4s}: final mean F1 = {final:.4f}")
     return results
@@ -414,6 +435,28 @@ def paired_tests(results: dict, *, baseline: str = "rand") -> dict:
             "per_seed_final": _paired_one_sided(a_s, b_s),
             "per_seed_auc": _paired_one_sided(a_auc, b_auc),
         }
+    return out
+
+
+def species_tests(results: dict, slices: dict[str, slice], *,
+                  baseline: str = "rand") -> dict:
+    """The per-member paired finals restricted to one member SPECIES at a
+    time (committee order: CNN members first, then hosts — ``ALLoop.
+    _evaluate``).  The committee-pooled test answers "does acquisition
+    help the committee"; the species slice answers the round-4 open
+    question "do the CNN members themselves benefit" separately from the
+    host species' signal."""
+    out: dict = {}
+    base = results[baseline]
+    seeds = sorted(base)
+    for name, sl in slices.items():
+        for mode, by_seed in results.items():
+            if mode == baseline:
+                continue
+            a = np.concatenate([np.asarray(by_seed[s][-1])[sl]
+                                for s in seeds])
+            b = np.concatenate([np.asarray(base[s][-1])[sl] for s in seeds])
+            out[f"{name}:{mode}>{baseline}"] = _paired_one_sided(a, b)
     return out
 
 

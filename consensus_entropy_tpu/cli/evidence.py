@@ -78,6 +78,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "and production retrain config; requires "
                          "--cnn-registry (pretraining full-geometry "
                          "members per seed is a wall-clock non-starter)")
+    sw.add_argument("--unfamiliar-mapping", action="store_true",
+                    help="shift the unfamiliar songs' class→frequency "
+                         "mapping (USER_FREQS) on top of the timbre "
+                         "change — the full-geometry mechanism-study "
+                         "axis (mapping novelty creates CNN headroom; "
+                         "timbre novelty alone is transparent to a "
+                         "full-geometry mel CNN)")
     sw.add_argument("--modes", default="mc,hc,mix,rand")
     sw.add_argument("--baseline", default="rand",
                     help="control mode for the paired tests; tests are "
@@ -145,7 +152,9 @@ def main(argv=None) -> int:
             cnn_pretrain_songs=args.cnn_pretrain_songs,
             easy_delta=args.easy_delta, hard_delta=args.hard_delta,
             sgd_members=args.sgd_members, cnn_registry=args.cnn_registry,
-            cnn_cfg=cnn_cfg, cnn_retrain=cnn_retrain)
+            cnn_cfg=cnn_cfg, cnn_retrain=cnn_retrain,
+            unfamiliar_freqs=(evidence.USER_FREQS
+                              if args.unfamiliar_mapping else None))
     finally:
         if cleanup is not None:
             cleanup.cleanup()
@@ -160,6 +169,7 @@ def main(argv=None) -> int:
                        "songs": args.songs,
                        "easy_delta": args.easy_delta,
                        "hard_delta": args.hard_delta,
+                       "unfamiliar_mapping": args.unfamiliar_mapping,
                        "committee": (
                            "5x gnb fold-members"
                            + (f" + {args.sgd_members}x sgd fold-members"
@@ -181,7 +191,22 @@ def main(argv=None) -> int:
                                         "d.f.=229)"},
         "trajectories": evidence.trajectories(results),
         "tests": tests,
+        # raw per-(mode, seed, epoch, member) F1s: the artifact must let a
+        # reader re-slice (species, AUC, any pairing) without re-running
+        "raw": {m: {str(s): v for s, v in by_seed.items()}
+                for m, by_seed in results.items()},
     }
+    if args.cnn_registry and args.baseline in results:
+        n_cnn = args.cnn_members or 5
+        slices = {"cnn": slice(0, n_cnn),
+                  "gnb": slice(n_cnn, n_cnn + 5)}
+        if args.sgd_members:
+            slices["sgd"] = slice(n_cnn + 5, n_cnn + 5 + args.sgd_members)
+        report["species_tests"] = evidence.species_tests(
+            results, slices, baseline=args.baseline)
+        for name, t in report["species_tests"].items():
+            print(f"  {name}: t={t['t']:.3f} p={t['p']:.4f} "
+                  f"(Δ={t['mean_diff']:+.4f})")
     for name, t in tests.items():
         if not isinstance(t, dict):
             continue

@@ -141,7 +141,7 @@ def pretrain_cnn(song_labels: dict, store, *, cv: int, out_dir: str,
                  config: CNNConfig = CNNConfig(),
                  train_config: TrainConfig = TrainConfig(),
                  n_epochs: int | None = None, seed: int = 1987,
-                 tb_dir: str | None = None) -> dict:
+                 tb_dir: str | None = None, resume: bool = False) -> dict:
     """Per-fold Flax CNN training (``deam_classifier.py:249-316``), saving
     ``classifier_cnn.it_{i}.msgpack`` per fold.
 
@@ -165,33 +165,79 @@ def pretrain_cnn(song_labels: dict, store, *, cv: int, out_dir: str,
     f1s = []
     for i, (tr, te) in enumerate(grouped_folds(songs, cv, rng)):
         key = jax.random.key(seed + i)
-        variables = init_variables(jax.random.fold_in(key, 0), config)
         train_ids = [songs[j] for j in tr]
         test_ids = [songs[j] for j in te]
         y_tr = one_hot_np([song_labels[s] for s in train_ids])
         y_te = one_hot_np([song_labels[s] for s in test_ids])
-        best, _hist = trainer.fit(
-            variables, store, train_ids, y_tr, test_ids, y_te,
-            jax.random.fold_in(key, 1), n_epochs=n_epochs,
-            adam_patience=40)  # pre-training patience, deam_classifier.py:150
         # arch-tagged filename: a res pretrain must not clobber the vgg
         # family's artifacts in a shared pretrained dir (loading dispatches
         # on the .msgpack suffix + meta, not the filename)
         stem = "cnn" if config.arch == "vgg" else f"cnn_{config.arch}"
-        from consensus_entropy_tpu.models.committee import CNNMember
+        fold_path = os.path.join(out_dir,
+                                 f"classifier_{stem}.it_{i}.msgpack")
+        if resume and os.path.exists(fold_path):
+            # OPT-IN fold-level resume (a multi-hour 5-fold full-geometry
+            # run killed mid-way must not retrain finished folds): the
+            # fold SPLITS come from the rng's deterministic sequence, so
+            # skipping the training of a saved fold leaves every later
+            # fold's split and keys identical.  Existence alone is not
+            # freshness — the checkpoint's recorded fingerprint (epochs,
+            # seed, fold, train size, frontend geometry) must match this
+            # call, else fail loud rather than silently adopt stale
+            # weights.
+            from consensus_entropy_tpu.models.committee import CNNMember
+            from consensus_entropy_tpu.utils.checkpoint import load_variables
 
-        meta = {"kind": "cnn_jax", "name": f"it_{i}"}
-        meta.update({k: getattr(config, k)
-                     for k in CNNMember.FRONTEND_META})
-        save_variables(
-            os.path.join(out_dir, f"classifier_{stem}.it_{i}.msgpack"), best,
-            meta=meta)
-        # fold eval: one random crop per test song
+            best, meta = load_variables(fold_path)
+            want = {"n_epochs": n_epochs, "seed": seed, "fold": i,
+                    "n_train_songs": len(train_ids)}
+            want.update({k: getattr(config, k)
+                         for k in CNNMember.FRONTEND_META})
+            mismatch = {k: (meta.get(k), v) for k, v in want.items()
+                        if meta.get(k) != v}
+            if mismatch:
+                raise ValueError(
+                    f"{fold_path} exists but its fingerprint does not "
+                    f"match this pretraining call: {mismatch} — delete "
+                    "the stale checkpoint or run without resume")
+            print(f"fold {i}: resuming from {fold_path}")
+            _hist = []
+        else:
+            variables = init_variables(jax.random.fold_in(key, 0), config)
+            best, _hist = trainer.fit(
+                variables, store, train_ids, y_tr, test_ids, y_te,
+                jax.random.fold_in(key, 1), n_epochs=n_epochs,
+                adam_patience=40)  # pre-training patience, deam_classifier.py:150
+            from consensus_entropy_tpu.models.committee import CNNMember
+
+            meta = {"kind": "cnn_jax", "name": f"it_{i}",
+                    # resume fingerprint (see the resume branch above)
+                    "n_epochs": n_epochs, "seed": seed, "fold": i,
+                    "n_train_songs": len(train_ids)}
+            meta.update({k: getattr(config, k)
+                         for k in CNNMember.FRONTEND_META})
+            save_variables(fold_path, best, meta=meta)
+        # fold eval: one random crop per test song, forwarded in BOUNDED
+        # batches — a single full-geometry dispatch over a whole 20% test
+        # fold (360 songs at DEAM scale) allocates ~5 GB in the first conv
+        # block alone and OOMs next to the training program's live buffers
+        # (same failure class as the committee crop forward, fixed there
+        # with bucket slices)
         from consensus_entropy_tpu.models.short_cnn import apply_infer
 
         crops = store.sample_crops(jax.random.fold_in(key, 2),
                                    store.row_of(test_ids))
-        preds = np.asarray(apply_infer(best, crops, config)).argmax(axis=1)
+        chunk = 64
+        pad = -len(crops) % chunk
+        if pad:
+            import jax.numpy as jnp
+
+            crops = jnp.concatenate([crops, jnp.repeat(crops[-1:], pad,
+                                                       axis=0)])
+        preds = np.concatenate(
+            [np.asarray(apply_infer(best, crops[lo: lo + chunk], config))
+             for lo in range(0, crops.shape[0], chunk)])
+        preds = preds[: len(test_ids)].argmax(axis=1)
         f1s.append(f1_score(y_te.argmax(axis=1), preds, average="weighted"))
         if tb_dir:
             _write_tensorboard(os.path.join(tb_dir, f"fold_{i}"), _hist,

@@ -166,9 +166,34 @@ def main(argv=None) -> int:
     t_start = time.time()
     rng = np.random.default_rng(1987)
     os.makedirs(args.root, exist_ok=True)
-    print(f"building DEAM tree from REAL annotations under {args.root} ...",
-          flush=True)
-    roots, stats = build_tree(args.root, args.songs, rng)
+    stats_path = os.path.join(args.root, "tree_stats.json")
+    #: everything that determines the generated tree's content — a cached
+    #: tree is only reusable when ALL of it matches (existence alone is
+    #: not freshness: a --songs 12 smoke tree must never be silently
+    #: pretrained into a full-scale artifact, nor vice versa)
+    fingerprint = {"songs_arg": args.songs, "seed": 1987,
+                   "n_informative": N_INFORMATIVE, "class_sep": CLASS_SEP,
+                   "song_off": SONG_OFF, "frame_noise": FRAME_NOISE}
+    stats = None
+    if os.path.exists(stats_path):
+        with open(stats_path) as fh:
+            stats = json.load(fh)
+        if stats.get("fingerprint") != fingerprint:
+            raise SystemExit(
+                f"{args.root} holds a tree built with "
+                f"{stats.get('fingerprint')}, but this run wants "
+                f"{fingerprint} — pass a fresh --root or delete the old "
+                "tree")
+        print(f"reusing existing tree under {args.root}", flush=True)
+        roots = {"deam": os.path.join(args.root, "deam"),
+                 "models": os.path.join(args.root, "models")}
+    else:
+        print(f"building DEAM tree from REAL annotations under "
+              f"{args.root} ...", flush=True)
+        roots, stats = build_tree(args.root, args.songs, rng)
+        stats["fingerprint"] = fingerprint
+        with open(stats_path, "w") as fh:
+            json.dump(stats, fh)
     print(f"  {stats['songs']} songs, {stats['frames']} frames, "
           f"class counts {stats['song_class_counts']}", flush=True)
 
@@ -209,7 +234,7 @@ def main(argv=None) -> int:
         results["cnn_jax"] = pretrain.pretrain_cnn(
             labels, store, cv=5, out_dir=out_dir,
             train_config=TrainConfig(), n_epochs=args.cnn_epochs,
-            seed=1987)
+            seed=1987, resume=True)
         results["cnn_jax"]["wall_s"] = round(time.time() - t0, 1)
 
     # per-fold detail from the pretrainer's own jsonl

@@ -160,3 +160,23 @@ def test_sweep_with_registry_runs_production_loop(tmp_path):
     # epoch0 baseline + 2 AL iterations; 5 gnb + 2 cnn members
     assert len(per_epoch) == 3
     assert all(len(e) == 7 for e in per_epoch)
+
+
+def test_species_tests_slices_members():
+    """species_tests restricts the per-member pairing to one committee
+    slice; a species that improves under mc and one that doesn't must
+    separate."""
+    results = {
+        "mc": {s: [[0.9, 0.9, 0.5, 0.5]] for s in range(6)},
+        "rand": {s: [[0.6, 0.6, 0.5, 0.5]] for s in range(6)},
+    }
+    # add per-seed jitter so the paired t-test is defined (non-zero var)
+    for s in range(6):
+        results["mc"][s] = [[v + 0.001 * s for v in results["mc"][s][0]]]
+        results["rand"][s] = [[v + 0.001 * s
+                               for v in results["rand"][s][0]]]
+    out = evidence.species_tests(
+        results, {"cnn": slice(0, 2), "host": slice(2, 4)})
+    assert out["cnn:mc>rand"]["p"] < 0.01
+    assert out["cnn:mc>rand"]["mean_diff"] == pytest.approx(0.3)
+    assert out["host:mc>rand"]["mean_diff"] == pytest.approx(0.0)
