@@ -824,17 +824,39 @@ def main(argv=None) -> int:
             return 1
 
     results = {}
+    failures = {}
     for name, (iargs, ifn) in impls.items():
-        if not check_parity(name, iargs, ifn, ent_cpu, idx_cpu, args_ns.k,
-                            n_valid=args_ns.pool):
-            _log(f"[{name}] PARITY FAILURE — implementation excluded")
-            continue
-        results[name] = time_device_impl(name, iargs, ifn,
-                                         chain=args_ns.chain,
-                                         trials=args_ns.trials)
+        try:
+            if not check_parity(name, iargs, ifn, ent_cpu, idx_cpu,
+                                args_ns.k, n_valid=args_ns.pool):
+                _log(f"[{name}] PARITY FAILURE — implementation excluded")
+                failures[name] = "parity failure"
+                continue
+            results[name] = time_device_impl(name, iargs, ifn,
+                                             chain=args_ns.chain,
+                                             trials=args_ns.trials)
+        except Exception as e:
+            # a variant that fails to COMPILE (e.g. a pallas tile past the
+            # VMEM ceiling) is a data point, not a reason to lose the
+            # whole artifact.  Keep the first AND last non-empty lines:
+            # compile errors bury the root cause (VMEM overflow, etc.)
+            # below a transport wrapper.
+            lines = [ln for ln in str(e).split("\n") if ln.strip()]
+            msg = lines[0] if lines else repr(e)
+            if len(lines) > 1 and lines[-1] != lines[0]:
+                msg += " | " + lines[-1]
+            msg = msg[:500]
+            _log(f"[{name}] FAILED: {msg}")
+            failures[name] = msg
 
     if not results:
-        _log("every candidate implementation failed the parity gate")
+        _log("every candidate implementation failed (parity or compile) — "
+             "emitting the failure record")
+        print(json.dumps({
+            "metric": f"al_pool_scoring_latency_"
+                      f"{args_ns.members}m_{args_ns.pool}",
+            "value": None, "unit": "ms", "vs_baseline": None,
+            "impl_failures": failures, **_provenance()}))
         return 1
 
     extra = {}
@@ -884,6 +906,7 @@ def main(argv=None) -> int:
         # winner's number
         "impls": {k: round(v, 3) for k, v in sorted(results.items())},
         "best_impl": best,
+        **({"impl_failures": failures} if failures else {}),
         **extra,
         **_provenance(),
     }))
