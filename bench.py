@@ -297,6 +297,21 @@ def build_pallas_impl(x, w, b, k: int, tile_n: int, fuse_topk: bool = False):
     return args, iteration
 
 
+def failure_message(e: BaseException) -> str:
+    """First AND last non-empty lines of an error, bounded: compile errors
+    bury the root cause (VMEM overflow, etc.) below a transport wrapper
+    (the axon tunnel surfaces server-side compile failures as an opaque
+    HTTP-500 first line), so neither line alone substantiates the
+    committed ``impl_failures`` entry."""
+    lines = [ln for ln in str(e).split("\n") if ln.strip()]
+    # bound each line SEPARATELY: one overlong transport wrapper must not
+    # truncate away the root-cause tail this helper exists to preserve
+    msg = (lines[0] if lines else repr(e))[:250]
+    if len(lines) > 1 and lines[-1] != lines[0]:
+        msg += " | " + lines[-1][:250]
+    return msg
+
+
 def time_device_impl(name: str, args, iteration, *, chain: int, trials: int):
     """Median per-iteration latency of ``iteration`` chained ``chain`` times
     inside one compiled program (one dispatch + one sync per window)."""
@@ -838,14 +853,8 @@ def main(argv=None) -> int:
         except Exception as e:
             # a variant that fails to COMPILE (e.g. a pallas tile past the
             # VMEM ceiling) is a data point, not a reason to lose the
-            # whole artifact.  Keep the first AND last non-empty lines:
-            # compile errors bury the root cause (VMEM overflow, etc.)
-            # below a transport wrapper.
-            lines = [ln for ln in str(e).split("\n") if ln.strip()]
-            msg = lines[0] if lines else repr(e)
-            if len(lines) > 1 and lines[-1] != lines[0]:
-                msg += " | " + lines[-1]
-            msg = msg[:500]
+            # whole artifact
+            msg = failure_message(e)
             _log(f"[{name}] FAILED: {msg}")
             failures[name] = msg
 

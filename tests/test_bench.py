@@ -116,3 +116,41 @@ def test_pallas_suite_skips_cleanly_off_tpu(capsys):
                      "--frames", "2", "--features", "8", "--cpu-reps", "1"])
     assert rc == 1
     assert "Mosaic" in capsys.readouterr().err
+
+
+def test_failure_message_keeps_first_and_last_lines():
+    """Committed impl_failures entries must carry the ROOT CAUSE, not just
+    the transport wrapper (the axon tunnel fronts server-side compile
+    errors with an opaque HTTP-500 line)."""
+    import bench
+
+    e = RuntimeError("INTERNAL: http 500 wrapper\n\nstack frame\n"
+                     "Scoped allocation with size 20.05M exceeded limit")
+    msg = bench.failure_message(e)
+    assert msg.startswith("INTERNAL: http 500 wrapper")
+    assert msg.endswith("Scoped allocation with size 20.05M exceeded limit")
+    assert bench.failure_message(RuntimeError("one line")) == "one line"
+    assert len(bench.failure_message(RuntimeError("x" * 900))) == 250
+
+
+def test_committed_r05_evidence_claims_hold():
+    """EVIDENCE_r05.json must actually contain the claims README/ROUND5
+    state: committee-pooled null with the species decomposition — gnb
+    significantly positive, cnn exactly zero, sgd negative."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "EVIDENCE_r05.json")
+    with open(path) as fh:
+        r = json.load(fh)
+    sp = r["species_tests"]
+    assert sp["gnb:mc>rand"]["p"] < 0.05
+    assert sp["gnb:mc>rand"]["mean_diff"] > 0
+    assert sp["cnn:mc>rand"]["mean_diff"] == 0.0
+    assert sp["sgd:mc>rand"]["mean_diff"] < 0
+    pooled = r["tests"]["mc>rand"]["per_member_final"]
+    assert abs(pooled["mean_diff"]) < 0.01  # the committed null
+    # the mechanism run measures the mapping-novelty corruption
+    mech = r["mechanism_study"]["committed_mapping_novelty_run"]
+    assert mech["species_tests"]["cnn:mc>rand"]["mean_diff"] < 0
