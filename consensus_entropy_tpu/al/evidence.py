@@ -333,7 +333,8 @@ def run_one(seed: int, mode: str, workdir: str, *, queries: int = 5,
             cnn_registry: str | None = None,
             cnn_cfg: CNNConfig = CNN_CFG,
             cnn_retrain: TrainConfig = CNN_RETRAIN,
-            unfamiliar_freqs=None) -> list[list[float]]:
+            unfamiliar_freqs=None,
+            gate_host_updates: bool = False) -> list[list[float]]:
     """One (seed, mode) AL run through the production loop; returns the
     per-epoch PER-MEMBER F1 lists from metrics.jsonl (epoch0 baseline
     included)."""
@@ -354,7 +355,8 @@ def run_one(seed: int, mode: str, workdir: str, *, queries: int = 5,
         # UserReport appends; stale records from a previous sweep in the
         # same workdir would silently corrupt the statistics
         os.unlink(metrics)
-    cfg = ALConfig(queries=queries, epochs=epochs, mode=mode, seed=seed)
+    cfg = ALConfig(queries=queries, epochs=epochs, mode=mode, seed=seed,
+                   gate_host_updates=gate_host_updates)
     has_cnns = bool(cnn_members) or cnn_registry is not None
     ALLoop(cfg, retrain_epochs=(cnn_retrain_epochs if has_cnns
                                 else None)).run_user(
@@ -374,7 +376,8 @@ def sweep(seeds: Sequence[int], workdir: str, *, modes=MODES,
           sgd_members: int = 0, cnn_registry: str | None = None,
           cnn_cfg: CNNConfig = CNN_CFG,
           cnn_retrain: TrainConfig = CNN_RETRAIN,
-          unfamiliar_freqs=None, log=print) -> dict:
+          unfamiliar_freqs=None, gate_host_updates: bool = False,
+          log=print) -> dict:
     """Matched-budget mode sweep: every mode sees the same user, committee
     state, split, and query budget per seed.  Returns
     ``{mode: {seed: [[member f1 per epoch]]}}``."""
@@ -390,7 +393,8 @@ def sweep(seeds: Sequence[int], workdir: str, *, modes=MODES,
                 easy_delta=easy_delta, hard_delta=hard_delta,
                 sgd_members=sgd_members, cnn_registry=cnn_registry,
                 cnn_cfg=cnn_cfg, cnn_retrain=cnn_retrain,
-                unfamiliar_freqs=unfamiliar_freqs)
+                unfamiliar_freqs=unfamiliar_freqs,
+                gate_host_updates=gate_host_updates)
             final = float(np.mean(results[mode][seed][-1]))
             log(f"  seed {seed} {mode:4s}: final mean F1 = {final:.4f}")
     return results

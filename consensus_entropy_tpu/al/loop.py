@@ -317,12 +317,20 @@ class ALLoop:
 
         with UserReport(user_path, cfg.mode,
                         write=multihost.is_coordinator()) as report:
+            #: host members' F1s from the LAST evaluation on the gating
+            #: split — reused as the gate's before-scores (same split,
+            #: same metric, member state unchanged between an epoch's
+            #: evaluate and the next epoch's update); None forces the
+            #: gate to compute them (resume, or gating disabled)
+            last_host_f1s = None
+            n_cnn = len(committee.cnn_members)
             if st is None:
                 # epoch 0: baseline evaluation (amg_test.py:398-418)
                 report.epoch_header(-1)
                 key, sub = jax.random.split(key)
                 with timer.phase("evaluate"):
                     f1s = self._evaluate(committee, data, split, report, sub)
+                last_host_f1s = f1s[n_cnn:]
                 report.epoch_summary(-1, f1s)
                 trajectory.append(float(np.mean(f1s)))
                 labels = join_and_drain()
@@ -356,7 +364,13 @@ class ALLoop:
                                                q_songs)
 
                 with timer.phase("update_host"):
-                    committee.update_host(X_batch, y_batch)
+                    if cfg.gate_host_updates and len(split.X_test):
+                        committee.update_host_gated(
+                            X_batch, y_batch, split.X_test,
+                            split.y_test_frames,
+                            before_scores=last_host_f1s)
+                    else:
+                        committee.update_host(X_batch, y_batch)
                 if committee.cnn_members:
                     y_q = one_hot_np([data.labels[s] for s in q_songs])
                     y_t = one_hot_np(split.y_test_songs)
@@ -369,6 +383,7 @@ class ALLoop:
                 key, sub = jax.random.split(key)
                 with timer.phase("evaluate"):
                     f1s = self._evaluate(committee, data, split, report, sub)
+                last_host_f1s = f1s[n_cnn:]
                 report.epoch_summary(epoch, f1s, queried=q_songs,
                                      pool_size=len(acq.remaining_songs))
                 trajectory.append(float(np.mean(f1s)))

@@ -85,6 +85,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "axis (mapping novelty creates CNN headroom; "
                          "timbre novelty alone is transparent to a "
                          "full-geometry mel CNN)")
+    sw.add_argument("--gate-host-updates", action="store_true",
+                    help="validation-gate host-member incremental updates "
+                         "(ALConfig.gate_host_updates) — the host analogue "
+                         "of the reference's CNN best-checkpoint gate; an "
+                         "opt-in extension the reference lacks")
     sw.add_argument("--modes", default="mc,hc,mix,rand")
     sw.add_argument("--baseline", default="rand",
                     help="control mode for the paired tests; tests are "
@@ -154,7 +159,8 @@ def main(argv=None) -> int:
             sgd_members=args.sgd_members, cnn_registry=args.cnn_registry,
             cnn_cfg=cnn_cfg, cnn_retrain=cnn_retrain,
             unfamiliar_freqs=(evidence.USER_FREQS
-                              if args.unfamiliar_mapping else None))
+                              if args.unfamiliar_mapping else None),
+            gate_host_updates=args.gate_host_updates)
     finally:
         if cleanup is not None:
             cleanup.cleanup()
@@ -170,6 +176,7 @@ def main(argv=None) -> int:
                        "easy_delta": args.easy_delta,
                        "hard_delta": args.hard_delta,
                        "unfamiliar_mapping": args.unfamiliar_mapping,
+                       "gate_host_updates": args.gate_host_updates,
                        "committee": (
                            "5x gnb fold-members"
                            + (f" + {args.sgd_members}x sgd fold-members"

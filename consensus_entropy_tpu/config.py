@@ -269,6 +269,15 @@ class ALConfig:
     #: gate — BENCH_cnn bf16_gate), while an uninterrupted run is
     #: unaffected.  Set "float32" for bit-exact resume.
     ckpt_dtype: str = "bfloat16"
+    #: Validation-gate the host members' incremental updates (keep an
+    #: update only if the member's weighted F1 on the user's test split
+    #: does not drop) — the host analogue of the reference's CNN
+    #: best-checkpoint gate (``amg_test.py:267-273``, which scores on the
+    #: same split).  Off by default: the reference applies every
+    #: partial_fit/boost unconditionally (``amg_test.py:503-509``), and
+    #: the round-5 evidence measures what that costs under
+    #: uncertainty-dense batches (EVIDENCE_r05 mechanism_study).
+    gate_host_updates: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -588,6 +588,48 @@ class Committee:
         for m in self.host_members:
             m.update(X_batch, y_batch)
 
+    def update_host_gated(self, X_batch: np.ndarray, y_batch: np.ndarray,
+                          X_val: np.ndarray, y_val,
+                          before_scores=None) -> dict:
+        """Validation-gated incremental update: each host member's update
+        is KEPT only if its weighted F1 on ``(X_val, y_val)`` does not
+        drop; otherwise the member's pre-update state is restored.
+
+        This is the host-member analogue of the best-checkpoint gate the
+        reference already applies to its CNN members (``amg_test.py:
+        267-273`` refuses to keep a worse epoch, scored on the same test
+        split this gate uses) — extended to ``partial_fit``/boosting
+        members, whose corruption by uncertainty-dense query batches the
+        round-5 evidence measured directly (``EVIDENCE_r05.json``
+        mechanism_study: sgd Δ down to −0.26 under mc).  An extension the
+        reference lacks, opt-in via ``ALConfig.gate_host_updates``; both
+        acquisition arms of any comparison get the identical gate, so
+        matched-budget statistics stay matched.
+
+        ``before_scores``: optional per-member pre-update F1s on the SAME
+        (X_val, y_val) in ``host_members`` order — the AL loop passes the
+        previous iteration's evaluation scores (identical split, identical
+        metric, member state unchanged in between), saving one full
+        test-split predict per member per iteration.
+
+        Returns ``{member name: kept}``."""
+        import copy
+
+        from consensus_entropy_tpu.al.reporting import weighted_f1
+
+        kept: dict = {}
+        for i, m in enumerate(self.host_members):
+            before = copy.deepcopy(m)
+            f1_before = (before_scores[i] if before_scores is not None
+                         else weighted_f1(y_val, m.predict(X_val)))
+            m.update(X_batch, y_batch)
+            if weighted_f1(y_val, m.predict(X_val)) < f1_before:
+                self.host_members[i] = before
+                kept[m.name] = False
+            else:
+                kept[m.name] = True
+        return kept
+
     def retrain_cnns(self, store: DeviceWaveformStore, train_ids, train_y,
                      test_ids, test_y, key, *, n_epochs: int | None = None):
         """Retrain every CNN member on the queried songs (hot loop #2,
