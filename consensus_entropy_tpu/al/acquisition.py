@@ -42,6 +42,35 @@ _scatter_rows = jax.jit(_scatter_rows_impl, donate_argnums=0)
 _row_entropy = jax.jit(shannon_entropy)
 
 
+def _sanitize_member_rows_impl(p):
+    """Neutralize degenerate member rows before the entropy reduction.
+
+    A row (one member's class distribution for one song) is invalid when
+    it carries a non-finite value or sums to zero — one NaN row would
+    otherwise poison the consensus mean for that song and propagate
+    through ``ops.entropy`` into the mc/mix ranking (zero rows NaN there
+    too).  Invalid rows are replaced by the mean of the song's VALID rows,
+    so the downstream mean-over-members equals the mean renormalized over
+    surviving members — the same masking semantics member quarantine uses,
+    applied row-wise.  A song with no valid row at all becomes uniform
+    (maximally uncertain; behind ``pool_mask`` for padding rows, so only a
+    fully-degenerate live song is affected).  With every row valid the
+    output is bit-identical to the input, so unfaulted rankings are
+    unchanged.
+    """
+    p = jnp.asarray(p)
+    valid = (jnp.all(jnp.isfinite(p), axis=-1)
+             & (jnp.sum(p, axis=-1) > 0))[..., None]
+    safe = jnp.where(valid, p, 0.0)
+    cnt = jnp.sum(valid, axis=0)
+    fallback = jnp.where(cnt > 0, jnp.sum(safe, axis=0)
+                         / jnp.maximum(cnt, 1), 1.0 / p.shape[-1])
+    return jnp.where(valid, p, fallback[None])
+
+
+_sanitize_member_rows = jax.jit(_sanitize_member_rows_impl)
+
+
 class Acquirer:
     """Per-user acquisition state over a fixed padded pool.
 
@@ -237,8 +266,9 @@ class Acquirer:
         masks exactly as the reference mutates its tables.
         """
         if self.mode == "mc":
-            res = self._fns["mc"](self._staged_probs(member_probs),
-                                  self._feed(self.pool_mask, 0))
+            res = self._fns["mc"](
+                _sanitize_member_rows(self._staged_probs(member_probs)),
+                self._feed(self.pool_mask, 0))
             q_songs = self._ids(res)
         elif self.mode == "hc":
             res = self._fns["hc_pre"](self._hc_ent_dev,
@@ -246,10 +276,11 @@ class Acquirer:
             q_songs = self._ids(res)
             self._remove_hc(q_songs)  # amg_test.py:455
         elif self.mode == "mix":
-            res = self._fns["mix"](self._staged_probs(member_probs),
-                                   self._feed(self.pool_mask, 0),
-                                   self._hc_dev,
-                                   self._feed(self.hc_mask, 0))
+            res = self._fns["mix"](
+                _sanitize_member_rows(self._staged_probs(member_probs)),
+                self._feed(self.pool_mask, 0),
+                self._hc_dev,
+                self._feed(self.hc_mask, 0))
             is_hc, slots = scoring.split_mix_index(res.indices, self.n_pad)
             valid = np.asarray(res.values) > -np.inf
             raw = [self.songs[int(s)]

@@ -65,6 +65,24 @@ class AsyncCheckpointer:
         finally:
             self._pool.shutdown(wait=False)
 
+    def __enter__(self) -> "AsyncCheckpointer":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        """Close on the way out.  On the success path a deferred write
+        error must surface (the last iteration's checkpoint has to be
+        durable before the caller reads the workspace); on the error path
+        close is best-effort — the loop's own error is the root cause and
+        must not be masked by a deferred write error."""
+        if exc_type is None:
+            self.close()
+        else:
+            try:
+                self.close()
+            except BaseException:
+                pass
+        return False
+
 
 @dataclasses.dataclass
 class UserData:
@@ -148,18 +166,30 @@ class ALLoop:
 
     def _evaluate(self, committee: Committee, data: UserData,
                   split: SplitData, report: UserReport, key) -> list[float]:
-        """Evaluate every member on the user's test set; returns F1 list in
-        committee order (CNN members first, as ``member_names``)."""
+        """Evaluate every ACTIVE member on the user's test set; returns F1
+        list in committee order (CNN members first, as ``member_names``).
+        A member that fails here — predict raises, or its probabilities go
+        non-finite — is quarantined and dropped from the mean, so one
+        degenerate member can't sink the trajectory or kill the user."""
         f1s = []
-        if committee.cnn_members:
+        cnns = committee.active_cnn_members
+        if cnns:
             probs = np.asarray(committee.predict_songs_cnn(
                 data.store, split.test_songs, key))
-            for m, p in zip(committee.cnn_members, probs):
+            for m, p in zip(cnns, probs):
+                if not np.all(np.isfinite(p)):
+                    committee.quarantine(
+                        m.name, "non-finite eval probabilities")
+                    continue
                 y_pred = p.argmax(axis=1)
                 f1s.append(report.model_eval(m.name, split.y_test_songs,
                                              y_pred))
-        for m in committee.host_members:
-            y_pred = m.predict(split.X_test)
+        for m in committee.active_host_members:
+            try:
+                y_pred = m.predict(split.X_test)
+            except Exception as e:
+                committee.quarantine(m.name, f"eval predict failed: {e!r}")
+                continue
             f1s.append(report.model_eval(m.name, split.y_test_frames, y_pred))
         return f1s
 
@@ -173,10 +203,17 @@ class ALLoop:
 
     def run_user(self, committee: Committee, data: UserData, user_path: str,
                  *, seed: int | None = None, resume: bool = True,
-                 timer: StepTimer | None = None) -> dict:
+                 timer: StepTimer | None = None, preemption=None) -> dict:
+        """``preemption``: optional object with a boolean ``requested``
+        attribute (``resilience.preemption.PreemptionGuard``).  When it
+        goes true, the loop finishes the in-flight iteration's two-phase
+        commit at the next iteration boundary and raises ``Preempted`` —
+        a resumable clean handoff, not a failure."""
         cfg = self.config
         seed = cfg.seed if seed is None else seed
         timer = timer or StepTimer(None)
+        # the config's survivor floor never weakens a stricter committee
+        committee.min_members = max(committee.min_members, cfg.min_members)
 
         st = al_state.ALState.load(user_path) if resume else None
         if st is not None and not st.matches(
@@ -269,30 +306,43 @@ class ALLoop:
 
             ckpt.submit(commit)
 
-        try:
+        # AsyncCheckpointer as context manager: on the success path close
+        # surfaces any deferred write error before the caller reads the
+        # workspace (mark_done, resume, final save); on the error path it
+        # is best-effort so the worker thread and pending future are
+        # released without masking the loop's own error.
+        with ckpt:
             result = self._run_iterations(
                 committee, data, user_path, cfg, seed, timer, st, split, key,
                 trajectory, queried_hist, start_epoch, acq, checkpoint,
-                multihost, ckpt, bg_times)
-        except BaseException:
-            # best-effort join so no writer outlives the failure, but the
-            # loop's own error is the root cause and must not be masked by
-            # a deferred write error
-            try:
-                ckpt.close()
-            except BaseException:
-                pass
-            raise
-        # the last iteration's checkpoint must be durable (and any deferred
-        # write error surfaced) before the caller reads the workspace
-        # (mark_done, resume, final save)
-        ckpt.close()
+                multihost, ckpt, bg_times, preemption)
+        # every write is durable here; the barrier keeps non-coordinators
+        # from reading the workspace before the coordinator's last commit
+        multihost.sync(f"run_user_done_{data.user_id}")
         return result
 
     def _run_iterations(self, committee, data, user_path, cfg, seed, timer,
                         st, split, key, trajectory, queried_hist,
                         start_epoch, acq, checkpoint, multihost, ckpt,
-                        bg_times):
+                        bg_times, preemption=None):
+        from consensus_entropy_tpu.resilience import faults
+        from consensus_entropy_tpu.resilience.preemption import Preempted
+        from consensus_entropy_tpu.resilience.retry import retry_transient
+
+        def preempt_check(boundary: str) -> None:
+            """Iteration-boundary preemption check.  The flag is agreed
+            across processes (broadcast_flag) so every host leaves the
+            collective program at the same boundary, and the in-flight
+            two-phase commit is joined first — the handoff leaves the
+            workspace durable and resumable, which is what separates
+            ``Preempted`` (exit EXIT_PREEMPTED, reschedule) from a crash."""
+            if preemption is not None and multihost.broadcast_flag(
+                    bool(preemption.requested)):
+                ckpt.wait()
+                raise Preempted(
+                    f"preempted after {boundary}; workspace committed — "
+                    "rerun to resume at the next iteration")
+
         def join_and_drain():
             """Join the previous iteration's background checkpoint job in
             its OWN timed phase, then surface that job's self-timed
@@ -323,20 +373,33 @@ class ALLoop:
             #: evaluate and the next epoch's update); None forces the
             #: gate to compute them (resume, or gating disabled)
             last_host_f1s = None
-            n_cnn = len(committee.cnn_members)
+
+            def drain_events(epoch: int) -> list:
+                """Forward quarantine events into the per-user report.
+                Returns them so callers can invalidate anything aligned
+                with the pre-quarantine member list."""
+                events = committee.drain_quarantine_events()
+                for ev in events:
+                    report.quarantine_event(epoch, ev)
+                return events
+
             if st is None:
                 # epoch 0: baseline evaluation (amg_test.py:398-418)
                 report.epoch_header(-1)
                 key, sub = jax.random.split(key)
                 with timer.phase("evaluate"):
                     f1s = self._evaluate(committee, data, split, report, sub)
-                last_host_f1s = f1s[n_cnn:]
+                if drain_events(-1):
+                    last_host_f1s = None  # member set shifted mid-eval
+                else:
+                    last_host_f1s = f1s[len(committee.active_cnn_members):]
                 report.epoch_summary(-1, f1s)
                 trajectory.append(float(np.mean(f1s)))
                 labels = join_and_drain()
                 with timer.phase("checkpoint"):
                     checkpoint(0, key)
                 timer.flush(user=str(data.user_id), epoch=-1, **labels)
+                preempt_check("baseline evaluation")
 
             for epoch in range(start_epoch, cfg.epochs):
                 report.epoch_header(epoch)
@@ -351,10 +414,19 @@ class ALLoop:
                         # scatters it into its persistent padded buffer
                         # (no host round-trip of the probs table), staged
                         # at the fixed bucket width so the chain compiles
-                        # once per bucket, not once per live-width
-                        member_probs = committee.pool_probs(
-                            data.pool, data.store, live, sub,
-                            pad_to=acq.staging_width(len(live)))
+                        # once per bucket, not once per live-width.
+                        # Scoring is pure (committee state is read-only
+                        # and the crop key is fixed), so a transient
+                        # device/RPC error retries the identical pass.
+                        member_probs = retry_transient(
+                            lambda sub=sub, live=live: faults.fire(
+                                "pool.score",
+                                payload=committee.pool_probs(
+                                    data.pool, data.store, live, sub,
+                                    pad_to=acq.staging_width(len(live)))),
+                            attempts=cfg.retry_attempts,
+                            base_delay=cfg.retry_base_delay,
+                            seed=seed + epoch, what="pool.score")
                 key, sub = jax.random.split(key)
                 with timer.phase("select"):
                     q_songs = acq.select(member_probs, rand_key=sub)
@@ -371,19 +443,31 @@ class ALLoop:
                             before_scores=last_host_f1s)
                     else:
                         committee.update_host(X_batch, y_batch)
-                if committee.cnn_members:
+                if committee.active_cnn_members:
                     y_q = one_hot_np([data.labels[s] for s in q_songs])
                     y_t = one_hot_np(split.y_test_songs)
                     key, sub = jax.random.split(key)
                     with timer.phase("retrain_cnn"):
-                        committee.retrain_cnns(
-                            data.store, q_songs, y_q, split.test_songs, y_t,
-                            sub, n_epochs=self.retrain_epochs)
+                        # fit_many rebinds member variables only on return,
+                        # so a transient failure mid-fit left no partial
+                        # mutation and the retry replays the identical fit
+                        retry_transient(
+                            lambda sub=sub, y_q=y_q, y_t=y_t:
+                            committee.retrain_cnns(
+                                data.store, q_songs, y_q, split.test_songs,
+                                y_t, sub, n_epochs=self.retrain_epochs),
+                            attempts=cfg.retry_attempts,
+                            base_delay=cfg.retry_base_delay,
+                            seed=seed + 7919 * (epoch + 1),
+                            what="member.retrain")
 
                 key, sub = jax.random.split(key)
                 with timer.phase("evaluate"):
                     f1s = self._evaluate(committee, data, split, report, sub)
-                last_host_f1s = f1s[n_cnn:]
+                if drain_events(epoch):
+                    last_host_f1s = None  # member set shifted mid-iteration
+                else:
+                    last_host_f1s = f1s[len(committee.active_cnn_members):]
                 report.epoch_summary(epoch, f1s, queried=q_songs,
                                      pool_size=len(acq.remaining_songs))
                 trajectory.append(float(np.mean(f1s)))
@@ -395,6 +479,7 @@ class ALLoop:
                     checkpoint(epoch + 1, key)
                 timer.flush(user=str(data.user_id), epoch=epoch,
                             queried=len(q_songs), **labels)
+                preempt_check(f"iteration {epoch}")
 
         return {"user": data.user_id, "mode": cfg.mode,
                 "trajectory": trajectory,

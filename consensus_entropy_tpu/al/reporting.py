@@ -58,6 +58,19 @@ class UserReport:
                 f"{classification_report(y_true, y_pred, zero_division=0)}\n")
         return f1
 
+    def quarantine_event(self, epoch: int, event: dict) -> None:
+        """Record a member quarantine (``Committee.quarantine``) in both
+        report surfaces, so a degraded run is diagnosable from the user
+        directory alone."""
+        if not self.write:
+            return
+        self._txt.write(f"!! quarantined member {event['member']}: "
+                        f"{event['reason']}\n")
+        self._txt.flush()
+        self._jsonl.write(json.dumps(
+            {"event": "quarantine", "epoch": epoch, **event}) + "\n")
+        self._jsonl.flush()
+
     def epoch_summary(self, epoch: int, f1_list, *, queried=None,
                       pool_size=None) -> None:
         if not self.write:

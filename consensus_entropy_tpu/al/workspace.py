@@ -86,24 +86,72 @@ def load_committee(path: str, config: CNNConfig = CNNConfig(),
     File naming (written by ``Committee.save``):
     ``classifier_{kind}.{name}.pkl`` for host members,
     ``classifier_cnn.{name}.msgpack`` for Flax members.
+
+    A member file that fails to parse (CRC mismatch on a msgpack
+    checkpoint, unreadable pickle — bit-rot the atomic-write discipline
+    cannot prevent) triggers ONE last-good fallback: the workspace rolls
+    back to the retained previous generation (``al.state
+    .rollback_workspace``) and the load retries, so the AL loop replays
+    that one iteration instead of the user aborting.  Without a complete
+    previous-generation snapshot the corruption error propagates.
     """
-    from consensus_entropy_tpu.al.state import recover_workspace
+    from consensus_entropy_tpu.al.state import (
+        recover_workspace,
+        rollback_workspace,
+    )
+    from consensus_entropy_tpu.utils.checkpoint import CheckpointCorruptError
 
     recover_workspace(path)  # finish/discard any torn checkpoint first
+    try:
+        return _load_committee_once(path, config, train_config,
+                                    device_members=device_members,
+                                    full_song_hop=full_song_hop, mesh=mesh,
+                                    train_mesh=train_mesh)
+    except CheckpointCorruptError as e:
+        if not rollback_workspace(path):
+            raise
+        import warnings
+
+        warnings.warn(f"{path}: corrupt live checkpoint ({e}); rolled back "
+                      "to the previous generation — one AL iteration will "
+                      "be replayed")
+        return _load_committee_once(path, config, train_config,
+                                    device_members=device_members,
+                                    full_song_hop=full_song_hop, mesh=mesh,
+                                    train_mesh=train_mesh)
+
+
+def _load_committee_once(path: str, config: CNNConfig,
+                         train_config: TrainConfig, *,
+                         device_members: bool, full_song_hop: int | None,
+                         mesh, train_mesh) -> Committee:
+    from consensus_entropy_tpu.utils.checkpoint import CheckpointCorruptError
+
     host: list[Member] = []
     cnns: list[CNNMember] = []
     for fname in sorted(os.listdir(path)):
         full = os.path.join(path, fname)
-        if fname.endswith(".msgpack"):
-            cnns.append(CNNMember.load(full, config, train_config))
-        elif fname.endswith(".pkl"):
-            kind = fname.split(".")[0].replace("classifier_", "")
-            if kind == "xgb":  # boosted slot: dispatch on pickle content
-                host.append(_load_boosted(full))
-            elif kind in _HOST_LOADERS:
-                host.append(_HOST_LOADERS[kind].load(full))
-            else:  # rf/svc/knn/gpc/gbc: frozen-during-AL generic members
-                host.append(GenericSklearnMember.load(full))
+        try:
+            if fname.endswith(".msgpack"):
+                cnns.append(CNNMember.load(full, config, train_config))
+            elif fname.endswith(".pkl"):
+                kind = fname.split(".")[0].replace("classifier_", "")
+                if kind == "xgb":  # boosted slot: dispatch on pickle content
+                    host.append(_load_boosted(full))
+                elif kind in _HOST_LOADERS:
+                    host.append(_HOST_LOADERS[kind].load(full))
+                else:  # rf/svc/knn/gpc/gbc: frozen-during-AL generic members
+                    host.append(GenericSklearnMember.load(full))
+        except CheckpointCorruptError:
+            raise
+        except Exception as e:
+            # a member FILE that fails to parse is corruption as far as
+            # recovery is concerned (a flipped byte in a pickle surfaces as
+            # any of UnpicklingError/EOFError/Attribute-soup); classify it
+            # so the caller's last-good fallback can engage — a genuine
+            # loader bug still surfaces, carried in the chained cause
+            raise CheckpointCorruptError(
+                f"{full}: failed to load member file ({e!r})") from e
     if not host and not cnns:
         raise FileNotFoundError(f"no committee members in {path}")
     return Committee(host, cnns, config, train_config,

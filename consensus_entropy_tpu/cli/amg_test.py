@@ -106,11 +106,9 @@ def main(argv=None) -> int:
 
     import numpy as np
 
-    from consensus_entropy_tpu.al import workspace
-    from consensus_entropy_tpu.al.loop import ALLoop, UserData
+    from consensus_entropy_tpu.al.loop import ALLoop
     from consensus_entropy_tpu.config import ALConfig, PathsConfig
     from consensus_entropy_tpu.data import amg
-    from consensus_entropy_tpu.utils import profiling
 
     paths = PathsConfig(models_root=args.models_root,
                         deam_root=args.deam_root, amg_root=args.amg_root)
@@ -190,9 +188,48 @@ def main(argv=None) -> int:
     # every workspace write; skip decisions are broadcast so control flow
     # stays in lockstep (divergence would deadlock the next collective).
     from consensus_entropy_tpu.parallel import multihost
+    from consensus_entropy_tpu.resilience.preemption import (
+        EXIT_PREEMPTED,
+        Preempted,
+        PreemptionGuard,
+    )
 
     results = []
+    try:
+        with PreemptionGuard() as guard:
+            _run_users(args, cfg, paths, users, pool, anno, hc_table, store,
+                       cnn_cfg, mesh, train_mesh, loop, multihost, guard,
+                       results)
+    except Preempted as e:
+        # SIGTERM/SIGINT landed: the loop finished the in-flight
+        # iteration's two-phase commit before raising, so the workspace is
+        # resumable — tell the scheduler to run us again, distinctly from
+        # an error exit.
+        print(f"preempted: {e}")
+        return EXIT_PREEMPTED
+
+    if results:
+        finals = [r["final_mean_f1"] for r in results]
+        print(f"\n{len(results)} users; final committee F1 "
+              f"μ={np.mean(finals):.4f} σ={np.std(finals):.4f}")
+    return 0
+
+
+def _run_users(args, cfg, paths, users, pool, anno, hc_table, store,
+               cnn_cfg, mesh, train_mesh, loop, multihost, guard,
+               results) -> None:
+    import numpy as np
+
+    from consensus_entropy_tpu.al import workspace
+    from consensus_entropy_tpu.al.loop import UserData
+    from consensus_entropy_tpu.data import amg
+    from consensus_entropy_tpu.resilience.preemption import Preempted
+    from consensus_entropy_tpu.utils import profiling
+
     for num_user, u_id in enumerate(users[: args.max_users]):
+        if multihost.broadcast_flag(guard.requested):
+            # between users there is nothing in flight to drain
+            raise Preempted(f"stopping before user {u_id}")
         if multihost.is_coordinator():
             user_path, skip = workspace.create_user(
                 paths.users_dir, paths.pretrained_dir, u_id, cfg.mode,
@@ -221,19 +258,13 @@ def main(argv=None) -> int:
             if multihost.is_coordinator() else None)
         with profiling.trace(args.trace_dir):
             res = loop.run_user(committee, data, user_path, seed=cfg.seed,
-                                timer=timer)
+                                timer=timer, preemption=guard)
         if multihost.is_coordinator():
             committee.save(user_path)
             workspace.mark_done(user_path)
         multihost.sync(f"user_done_{num_user}")
         results.append(res)
         print(f"user {u_id}: final mean F1 = {res['final_mean_f1']:.4f}")
-
-    if results:
-        finals = [r["final_mean_f1"] for r in results]
-        print(f"\n{len(results)} users; final committee F1 "
-              f"μ={np.mean(finals):.4f} σ={np.std(finals):.4f}")
-    return 0
 
 
 if __name__ == "__main__":

@@ -269,6 +269,18 @@ class ALConfig:
     #: gate — BENCH_cnn bf16_gate), while an uninterrupted run is
     #: unaffected.  Set "float32" for bit-exact resume.
     ckpt_dtype: str = "bfloat16"
+    #: Survivor floor for member quarantine: a member whose retrain/predict
+    #: raises (or emits non-finite probabilities) is quarantined for the
+    #: rest of the user's run and the consensus renormalizes over the
+    #: survivors; the run aborts (CommitteeExhaustedError) only when fewer
+    #: than this many members remain.  The committee-ensemble argument for
+    #: tolerating member loss is "Wisdom of Committees" (PAPERS.md).
+    min_members: int = 1
+    #: Bounded retry for transient device/RPC errors at the (pure) scoring
+    #: and CNN-retrain call sites: attempts and base backoff delay; the
+    #: exponential backoff is jittered and seeded (resilience.retry).
+    retry_attempts: int = 3
+    retry_base_delay: float = 0.05
     #: Validation-gate the host members' incremental updates (keep an
     #: update only if the member's weighted F1 on the user's test split
     #: does not drop) — the host analogue of the reference's CNN

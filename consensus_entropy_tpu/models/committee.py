@@ -30,12 +30,19 @@ import numpy as np
 
 from consensus_entropy_tpu import native
 from consensus_entropy_tpu.config import CNNConfig, NUM_CLASSES, TrainConfig
+from consensus_entropy_tpu.resilience import faults
 from consensus_entropy_tpu.data.audio import DeviceWaveformStore
 from consensus_entropy_tpu.models import short_cnn
 from consensus_entropy_tpu.models.base import Member
 from consensus_entropy_tpu.models.cnn_trainer import CNNTrainer
 from consensus_entropy_tpu.utils import round_up as _round_up
 from consensus_entropy_tpu.utils.checkpoint import load_variables, save_variables
+
+
+class CommitteeExhaustedError(RuntimeError):
+    """Quarantine has eaten into the configured survivor floor
+    (``Committee.min_members``): too few members remain for the consensus
+    to mean anything, so the user's run aborts instead of limping on."""
 
 
 class FramePool:
@@ -297,9 +304,21 @@ class Committee:
                  train_config: TrainConfig = TrainConfig(),
                  *, device_members: bool = False,
                  full_song_hop: int | None = None,
-                 mesh=None, train_mesh=None):
+                 mesh=None, train_mesh=None, min_members: int = 1):
         self.host_members = host_members
         self.cnn_members = cnn_members
+        #: member quarantine ("Wisdom of Committees": an ensemble tolerates
+        #: member loss by construction — exploit it).  A member whose
+        #: retrain/predict raises, or whose probability rows go non-finite,
+        #: is quarantined for the rest of the user's run: it stops scoring,
+        #: updating, and checkpointing (its on-disk file keeps the last
+        #: good state), and the consensus mean renormalizes over the
+        #: survivors.  The run aborts (CommitteeExhaustedError) only when
+        #: fewer than ``min_members`` members survive.
+        self.min_members = min_members
+        self.quarantined: dict[str, str] = {}   # member name → reason
+        self.quarantine_log: list[dict] = []    # full audit trail
+        self._pending_events: list[dict] = []   # drained by the AL loop
         if cnn_members:
             # the committee scores all CNN members as ONE stacked pytree, so
             # they must share a trunk family AND frontend geometry; the
@@ -399,8 +418,56 @@ class Committee:
         return ([m.name for m in self.cnn_members]
                 + [m.name for m in self.host_members])
 
+    # -- quarantine --------------------------------------------------------
+
+    @staticmethod
+    def _member_name(m) -> str:
+        """Quarantine key for a member; duck-typed scoring-only members
+        without a ``name`` (allowed by ``pool_probs``) key by type."""
+        return getattr(m, "name", type(m).__name__)
+
+    @property
+    def active_host_members(self) -> list[Member]:
+        """Host members still participating (quarantined ones excluded);
+        identical to ``host_members`` until a quarantine fires, so the
+        unfaulted path is behavior-identical."""
+        return [m for m in self.host_members
+                if self._member_name(m) not in self.quarantined]
+
+    @property
+    def active_cnn_members(self) -> list[CNNMember]:
+        return [m for m in self.cnn_members
+                if self._member_name(m) not in self.quarantined]
+
+    @property
+    def active_size(self) -> int:
+        return len(self.active_host_members) + len(self.active_cnn_members)
+
+    def quarantine(self, name: str, reason: str) -> None:
+        """Remove ``name`` from the run (idempotent).  Raises
+        :class:`CommitteeExhaustedError` when the survivor count drops
+        below ``min_members`` — degradation has a floor."""
+        if name in self.quarantined:
+            return
+        self.quarantined[name] = reason
+        event = {"member": name, "reason": reason}
+        self.quarantine_log.append(event)
+        self._pending_events.append(event)
+        if self.active_size < self.min_members:
+            raise CommitteeExhaustedError(
+                f"{self.active_size} committee member(s) survive after "
+                f"quarantining {name!r} ({reason}); floor is "
+                f"min_members={self.min_members}")
+
+    def drain_quarantine_events(self) -> list[dict]:
+        """Events since the last drain (the AL loop forwards them into the
+        per-user report)."""
+        events, self._pending_events = self._pending_events, []
+        return events
+
     def _stacked(self):
-        return short_cnn.stack_params([m.variables for m in self.cnn_members])
+        return short_cnn.stack_params(
+            [m.variables for m in self.active_cnn_members])
 
     def pool_probs(self, pool: FramePool | None,
                    store: DeviceWaveformStore | None,
@@ -435,19 +502,21 @@ class Committee:
         n_live = len(song_ids)
         if pad_to is not None and pad_to < n_live:
             raise ValueError(f"pad_to={pad_to} < n={n_live}")
-        if pad_to is not None and n_live == 0 and self.host_members:
+        active_host = self.active_host_members
+        active_cnn = self.active_cnn_members
+        if pad_to is not None and n_live == 0 and active_host:
             # the host block has no live row to stage from; the AL loop
             # breaks before scoring an empty pool, so fail loud here
             raise ValueError("pad_to requires at least one live song")
         blocks = []
-        if self.cnn_members:
+        if active_cnn:
             assert store is not None
             # async dispatch either way; full_song_hop swaps the reference's
             # stochastic single crop for the deterministic window grid
             blocks.append(self.predict_songs_cnn(store, song_ids, key,
                                                  pad_to=pad_to))
         width = n_live if pad_to is None else pad_to
-        if self.host_members:
+        if active_host:
             assert pool is not None
             rowmap = {s: i for i, s in enumerate(pool.song_ids)}
             sel = np.array([rowmap[s] for s in song_ids])
@@ -469,23 +538,42 @@ class Committee:
                 live_rows, seg_starts = pool.segment_view(song_ids)
                 X_live = pool.X[live_rows]
                 for slot, (_, m) in enumerate(on_host):
-                    frame_p = m.predict_proba(X_live)
-                    host_np[slot, :n_live] = pool.mean_over_segments(
-                        frame_p, seg_starts)
+                    # A member whose predict raises or whose rows go
+                    # non-finite is quarantined for the rest of the user's
+                    # run; its slot is NaN'd so the acquirer's sanitizer
+                    # renormalizes this iteration's consensus over the
+                    # survivors (next iteration it isn't scored at all).
+                    mname = self._member_name(m)
+                    row = None
+                    try:
+                        frame_p = faults.fire(
+                            "member.predict",
+                            payload=m.predict_proba(X_live), member=mname)
+                        row = pool.mean_over_segments(frame_p, seg_starts)
+                    except Exception as e:
+                        self.quarantine(mname, f"predict failed: {e!r}")
+                    if row is not None and not np.all(np.isfinite(row)):
+                        self.quarantine(mname,
+                                        "non-finite probability rows")
+                        row = None
+                    if row is None:
+                        host_np[slot] = np.nan
+                    else:
+                        host_np[slot, :n_live] = row
                 host_np[:, n_live:] = host_np[:, n_live - 1: n_live]
             if dev_block is None:
                 # pure-host slice stays NUMPY: for host-only committees the
                 # acquirer then pads on host and uploads one fixed-shape
                 # table (compile-free across the shrinking pool); committees
                 # WITH a CNN block concatenate on device below
-                blocks.append(host_np if not self.cnn_members else
+                blocks.append(host_np if not active_cnn else
                               jnp.asarray(host_np))
             else:
                 # Merge device slice + one host buffer back into committee
                 # member order via a permutation gather on device.
                 combined = jnp.concatenate(
                     [dev_block, jnp.asarray(host_np)], axis=0)
-                order = np.empty(len(self.host_members), np.int32)
+                order = np.empty(len(active_host), np.int32)
                 for slot, (i, _) in enumerate(on_device["gnb"]
                                               + on_device["sgd"]):
                     order[i] = slot
@@ -514,9 +602,10 @@ class Committee:
 
         out = {"gnb": [], "sgd": []}
         rest = []
+        active = self.active_host_members
         if not self.device_members:
-            return out, list(enumerate(self.host_members))
-        for i, m in enumerate(self.host_members):
+            return out, list(enumerate(active))
+        for i, m in enumerate(active):
             est = getattr(m, "estimator", None)
             full = (est is not None
                     and np.array_equal(getattr(est, "classes_", ()),
@@ -584,9 +673,18 @@ class Committee:
                       sgd_int.astype(np.float32))
 
     def update_host(self, X_batch: np.ndarray, y_batch: np.ndarray):
-        """Incremental update of every host member (``amg_test.py:503-509``)."""
-        for m in self.host_members:
-            m.update(X_batch, y_batch)
+        """Incremental update of every active host member
+        (``amg_test.py:503-509``).  A member whose update raises is
+        quarantined (its checkpoint file keeps the last good state — the
+        member is skipped by ``begin_save`` from here on) instead of one
+        failing ``partial_fit`` killing the whole user sweep."""
+        for m in self.active_host_members:
+            mname = self._member_name(m)
+            try:
+                faults.fire("member.retrain", member=mname)
+                m.update(X_batch, y_batch)
+            except Exception as e:
+                self.quarantine(mname, f"retrain failed: {e!r}")
 
     def update_host_gated(self, X_batch: np.ndarray, y_batch: np.ndarray,
                           X_val: np.ndarray, y_val,
@@ -617,13 +715,31 @@ class Committee:
 
         from consensus_entropy_tpu.al.reporting import weighted_f1
 
+        active = [(i, m) for i, m in enumerate(self.host_members)
+                  if self._member_name(m) not in self.quarantined]
+        if before_scores is not None and len(before_scores) != len(active):
+            # a quarantine between the evaluation that produced the scores
+            # and this update shifted the member list; recompute rather
+            # than pair scores with the wrong members
+            before_scores = None
         kept: dict = {}
-        for i, m in enumerate(self.host_members):
+        for pos, (i, m) in enumerate(active):
             before = copy.deepcopy(m)
-            f1_before = (before_scores[i] if before_scores is not None
-                         else weighted_f1(y_val, m.predict(X_val)))
-            m.update(X_batch, y_batch)
-            if weighted_f1(y_val, m.predict(X_val)) < f1_before:
+            try:
+                f1_before = (before_scores[pos]
+                             if before_scores is not None
+                             else weighted_f1(y_val, m.predict(X_val)))
+                faults.fire("member.retrain", member=m.name)
+                m.update(X_batch, y_batch)
+                worse = weighted_f1(y_val, m.predict(X_val)) < f1_before
+            except Exception as e:
+                # restore the pre-update state so the quarantined member's
+                # next checkpoint (none — begin_save skips it) and any
+                # in-memory reads see the last good weights
+                self.host_members[i] = before
+                self.quarantine(m.name, f"retrain failed: {e!r}")
+                continue
+            if worse:
                 self.host_members[i] = before
                 kept[m.name] = False
             else:
@@ -641,13 +757,15 @@ class Committee:
         is exact, and retrain wall-clock stops scaling linearly in M.  With
         ``train_mesh`` set the member-stacked state is additionally sharded
         across chips on the ``member`` axis."""
+        faults.fire("member.retrain", member="__cnn_stack__")
+        active_cnn = self.active_cnn_members
         best, histories = self.trainer.fit_many(
-            [m.variables for m in self.cnn_members], store, train_ids,
+            [m.variables for m in active_cnn], store, train_ids,
             train_y, test_ids, test_y, key,
             n_epochs=(self.trainer.train_config.n_epochs_retrain
                       if n_epochs is None else n_epochs),
             mesh=self.train_mesh)
-        for m, b, h in zip(self.cnn_members, best, histories):
+        for m, b, h in zip(active_cnn, best, histories):
             # A member with no improved epoch returns its incoming weights
             # (best-checkpoint gate starts at score 0, amg_test.py:295):
             # keep the old tree so the member stays checkpoint-clean and
@@ -680,7 +798,7 @@ class Committee:
             raise ValueError(f"pad_to={pad_to} < n={len(rows)}")
         if self.full_song_hop is None:
             if len(rows) == 0:
-                return jnp.zeros((len(self.cnn_members), pad_to or 0,
+                return jnp.zeros((len(self.active_cnn_members), pad_to or 0,
                                   self.config.n_class), jnp.float32)
             # The row batch is padded (repeating the last row, sliced back
             # off) to a shard-divisible COMPILE BUCKET before sampling: the
@@ -747,7 +865,7 @@ class Committee:
         chunk = _round_up(chunk, self._n_pool_shards)
         stacked = self._feed_repl(self._stacked())
         if n == 0:
-            m = len(self.cnn_members)
+            m = len(self.active_cnn_members)
             return jnp.zeros((m, pad_to or 0, self.config.n_class),
                              jnp.float32)
         blocks = []
@@ -788,7 +906,7 @@ class Committee:
             plan_windows,
         )
 
-        if not self.cnn_members:
+        if not self.active_cnn_members:
             raise ValueError("committee has no CNN members to score with")
         if jax.process_count() > 1:
             # the seq scorers take host-local stacked params / padded waves;
@@ -853,8 +971,12 @@ class Committee:
         to f32 (see ``ALConfig.ckpt_dtype`` for the resume-rounding
         contract)."""
         os.makedirs(directory, exist_ok=True)
-        for m in self.host_members:
-            m.save(os.path.join(directory, f"classifier_{m.kind}.{m.name}.pkl"))
+        # quarantined members are skipped: their in-memory state may be
+        # mid-failure, and skipping leaves their last-good file live
+        for m in self.active_host_members:
+            p = os.path.join(directory, f"classifier_{m.kind}.{m.name}.pkl")
+            m.save(p)
+            faults.fire("checkpoint.write", payload=p, member=m.name)
 
         def fname(m):
             return f"classifier_cnn.{m.name}.msgpack"
@@ -866,7 +988,8 @@ class Committee:
             return (getattr(m, "ckpt_clean_path", None) == target
                     and os.path.exists(target))
 
-        to_write = [m for m in self.cnn_members if not provably_current(m)]
+        to_write = [m for m in self.active_cnn_members
+                    if not provably_current(m)]
         if dtype in (None, "float32"):
             snapshot = [(m, m.variables) for m in to_write]
         elif dtype == "bfloat16":

@@ -1,0 +1,25 @@
+"""JAX version compatibility shims for the parallel package.
+
+The framework targets the modern ``jax.shard_map`` surface (top-level
+export, ``check_vma`` keyword).  Older runtimes (this container ships jax
+0.4.37) only have ``jax.experimental.shard_map.shard_map`` with the
+``check_rep`` spelling of the same knob.  One adapter here keeps every
+call site on the modern signature instead of sprinkling try/except through
+the scoring/sequence modules.
+"""
+
+from __future__ import annotations
+
+try:  # modern jax: top-level export with check_vma
+    from jax import shard_map as _modern
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _modern(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=check_vma)
+
+except ImportError:  # pre-export jax: experimental module, check_rep
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _legacy(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=check_vma)

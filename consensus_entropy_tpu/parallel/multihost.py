@@ -66,7 +66,13 @@ def is_coordinator() -> bool:
 
 def sync(name: str = "sync") -> None:
     """Cross-process barrier (no-op single-process).  Used around workspace
-    mutation so non-coordinators never read a directory mid-write."""
+    mutation so non-coordinators never read a directory mid-write.  The
+    fault point fires on the way in — a kill here models a host preempted
+    at a barrier, the boundary where divergent control flow would deadlock
+    the surviving processes."""
+    from consensus_entropy_tpu.resilience import faults
+
+    faults.fire("multihost.sync", barrier=name)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 

@@ -176,7 +176,9 @@ def make_full_song_scorer(mesh: Mesh, plan: WindowPlan,
         count = lax.psum(jnp.sum(weight), SEQ_AXIS)
         return total / count
 
-    sharded = jax.shard_map(
+    from consensus_entropy_tpu.parallel._compat import shard_map
+
+    sharded = shard_map(
         _shard_fn, mesh=mesh,
         in_specs=(P(), P(SEQ_AXIS), P(), P()),
         out_specs=P(),

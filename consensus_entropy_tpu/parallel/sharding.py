@@ -20,8 +20,9 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from consensus_entropy_tpu.parallel._compat import shard_map
 
 from consensus_entropy_tpu.ops.entropy import masked_entropy
 from consensus_entropy_tpu.ops.scoring import (

@@ -1,0 +1,43 @@
+"""Fault tolerance for long-running AL workloads.
+
+The paper's committee pipeline (20 members x 46 users x 10 AL iterations)
+is a long-lived stateful job; at production scale it must survive preempted
+TPU slices, bit-rotted checkpoints, and degenerate committee members
+without losing the run.  This package holds the three host-side pillars:
+
+- :mod:`~consensus_entropy_tpu.resilience.faults` — a deterministic,
+  seedable fault injector with named fault points threaded through the
+  checkpoint / committee / scoring / multihost layers, so every recovery
+  path is exercised by tier-1 tests instead of trusted on faith.
+- :mod:`~consensus_entropy_tpu.resilience.retry` — bounded
+  retry-with-jittered-exponential-backoff for transient device/RPC errors
+  at the scoring and retrain call sites.
+- :mod:`~consensus_entropy_tpu.resilience.preemption` — SIGTERM/SIGINT
+  handling that finishes the in-flight iteration's two-phase commit and
+  exits with a distinct, rescheduler-friendly exit code.
+
+The fourth pillar — checkpoint integrity (CRC) with a last-good
+previous-generation fallback, and committee member quarantine — lives at
+its point of use (``utils.checkpoint``, ``al.state``,
+``models.committee``), instrumented with this package's fault points.
+"""
+
+from consensus_entropy_tpu.resilience.faults import (  # noqa: F401
+    FAULT_POINTS,
+    FaultInjector,
+    FaultRule,
+    InjectedFault,
+    InjectedKill,
+    TransientFault,
+    fire,
+    inject,
+)
+from consensus_entropy_tpu.resilience.preemption import (  # noqa: F401
+    EXIT_PREEMPTED,
+    Preempted,
+    PreemptionGuard,
+)
+from consensus_entropy_tpu.resilience.retry import (  # noqa: F401
+    TRANSIENT_ERRORS,
+    retry_transient,
+)

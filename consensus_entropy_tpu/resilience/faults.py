@@ -1,0 +1,251 @@
+"""Deterministic fault injection at named pipeline boundaries.
+
+Every recovery path in the framework — two-phase checkpoint recovery,
+last-good rollback, member quarantine, transient retry — is exercised by
+injecting faults at the exact boundaries where real runs die: checkpoint
+writes, member retrain/predict calls, pool scoring, state commits, and
+multihost barriers.  The injector is:
+
+- **deterministic**: rules fire on the Nth hit of a point (a per-point
+  counter, thread-safe — checkpoint writes run on the AsyncCheckpointer
+  thread), and corruption flips fixed byte positions; a faulted run is
+  exactly reproducible.
+- **zero-overhead when inactive**: every instrumented call site costs one
+  module-attribute check when no injector is installed.
+- **env/config-activated**: tests install rules via the :func:`inject`
+  context manager; operators can activate via ``CETPU_FAULTS`` (e.g.
+  ``CETPU_FAULTS="checkpoint.write:kill@3,member.predict:corrupt@1"``)
+  to drill recovery on a real deployment.
+
+Fault actions model distinct failure species:
+
+- ``kill`` raises :class:`InjectedKill` (a ``BaseException``) — simulated
+  process death; no ``except Exception`` handler (quarantine, retry) may
+  absorb it, exactly like SIGKILL at that boundary.
+- ``raise`` raises :class:`InjectedFault` — a member-level error that the
+  quarantine machinery is expected to absorb.
+- ``transient`` raises :class:`TransientFault` — a transient device/RPC
+  error that bounded backoff retry is expected to absorb.
+- ``corrupt`` mutates the payload passed to :func:`fire`: a file path gets
+  its last byte flipped in place (bit-rot: breaks the checkpoint CRC and
+  pickle STOP opcode), an ndarray gets its first row set to NaN
+  (degenerate member output).
+- ``delay`` sleeps ``delay_s`` (slow-I/O / straggler simulation).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import threading
+import time
+
+import numpy as np
+
+#: The named fault points threaded through the framework.  Each maps to one
+#: instrumented boundary (see README "Failure handling" for the site list).
+FAULT_POINTS = frozenset({
+    "checkpoint.write",   # utils.checkpoint.save_variables / host pickles
+    "member.retrain",     # Committee.update_host / retrain_cnns
+    "member.predict",     # Committee.pool_probs per-member scoring
+    "pool.score",         # ALLoop score phase (whole-pool probs table)
+    "state.save",         # al.state.ALState.save (the commit point)
+    "multihost.sync",     # parallel.multihost.sync barriers
+})
+
+ACTIONS = ("kill", "raise", "transient", "corrupt", "delay")
+
+
+class InjectedFault(Exception):
+    """A recoverable injected member/IO failure (quarantine paths)."""
+
+
+class TransientFault(InjectedFault):
+    """An injected transient device/RPC error (retry-with-backoff paths)."""
+
+
+class InjectedKill(BaseException):
+    """Simulated process death.  Derives from ``BaseException`` so no
+    ``except Exception`` recovery handler can absorb it — the run dies at
+    the boundary, exactly like SIGKILL, and only a fresh process's resume
+    path may bring the workload back."""
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """Fire ``action`` at hits ``[at, at + times)`` of ``point``.
+
+    ``at`` is 1-based over the per-point hit counter; ``times=-1`` fires
+    forever from ``at`` on.  ``member`` restricts the rule to fault-point
+    invocations carrying that ``member=`` context (per-member targeting for
+    quarantine tests)."""
+
+    point: str
+    action: str
+    at: int = 1
+    times: int = 1
+    delay_s: float = 0.01
+    member: str | None = None
+
+    def __post_init__(self):
+        if self.point not in FAULT_POINTS:
+            raise ValueError(f"unknown fault point {self.point!r} "
+                             f"(have {sorted(FAULT_POINTS)})")
+        if self.action not in ACTIONS:
+            raise ValueError(f"unknown fault action {self.action!r} "
+                             f"(have {ACTIONS})")
+        if self.at < 1:
+            raise ValueError(f"at must be >= 1 (1-based hit), got {self.at}")
+
+    def matches(self, hit: int, ctx: dict) -> bool:
+        if self.member is not None and ctx.get("member") != self.member:
+            return False
+        if hit < self.at:
+            return False
+        return self.times < 0 or hit < self.at + self.times
+
+
+def _corrupt_file(path: str) -> None:
+    """Flip the last byte in place — deterministic bit-rot.  The last byte
+    sits in the checkpoint payload (CRC-covered) and is a pickle's STOP
+    opcode, so both formats fail loudly on the next load."""
+    with open(path, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        if size == 0:
+            return
+        f.seek(size - 1)
+        byte = f.read(1)
+        f.seek(size - 1)
+        f.write(bytes([byte[0] ^ 0xFF]))
+
+
+class FaultInjector:
+    """Rule store + per-point hit counters.  ``seed`` feeds any stochastic
+    corruption (reserved; the default corruptions are position-fixed so
+    faulted runs replay bit-identically)."""
+
+    def __init__(self, rules, *, seed: int = 0):
+        self.rules = [r if isinstance(r, FaultRule) else FaultRule(**r)
+                      for r in rules]
+        self.hits: dict[str, int] = {}
+        self.fired: list[dict] = []  # (point, action, hit) audit trail
+        self.rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+
+    def fire(self, point: str, payload=None, **ctx):
+        with self._lock:
+            hit = self.hits.get(point, 0) + 1
+            self.hits[point] = hit
+            todo = [r for r in self.rules
+                    if r.point == point and r.matches(hit, ctx)]
+            for r in todo:
+                self.fired.append({"point": point, "action": r.action,
+                                   "hit": hit, **ctx})
+        for r in todo:
+            where = f"{point} hit {hit}" + (
+                f" ({ctx['member']})" if "member" in ctx else "")
+            if r.action == "kill":
+                raise InjectedKill(f"injected kill at {where}")
+            if r.action == "raise":
+                raise InjectedFault(f"injected fault at {where}")
+            if r.action == "transient":
+                raise TransientFault(f"injected transient error at {where}")
+            if r.action == "delay":
+                time.sleep(r.delay_s)
+            elif r.action == "corrupt":
+                payload = self._corrupt(payload, where)
+        return payload
+
+    def _corrupt(self, payload, where: str):
+        if isinstance(payload, (str, os.PathLike)):
+            _corrupt_file(os.fspath(payload))
+            return payload
+        if isinstance(payload, np.ndarray):
+            out = payload.astype(np.float64 if payload.dtype.kind != "f"
+                                 else payload.dtype, copy=True)
+            out[(0,) * max(out.ndim - 1, 0)] = np.nan  # first row → NaN
+            return out
+        raise InjectedFault(f"injected corruption at {where} "
+                            f"(payload {type(payload).__name__} is not "
+                            "corruptible; treating as a hard fault)")
+
+
+_injector: FaultInjector | None = None
+
+
+def install(injector: FaultInjector) -> None:
+    global _injector
+    _injector = injector
+
+
+def uninstall() -> None:
+    global _injector
+    _injector = None
+
+
+def active() -> FaultInjector | None:
+    return _injector
+
+
+def fire(point: str, payload=None, **ctx):
+    """The instrumented-site hook: no-op (returns ``payload`` unchanged)
+    unless an injector is installed and a rule matches this hit."""
+    inj = _injector
+    if inj is None:
+        return payload
+    return inj.fire(point, payload=payload, **ctx)
+
+
+@contextlib.contextmanager
+def inject(*rules, seed: int = 0):
+    """Install an injector for the block; yields it (``.fired`` is the
+    audit trail).  Nested installs are not supported — the innermost wins
+    and the previous injector is restored on exit."""
+    prev = _injector
+    inj = FaultInjector(rules, seed=seed)
+    install(inj)
+    try:
+        yield inj
+    finally:
+        install(prev) if prev is not None else uninstall()
+
+
+def parse_spec(spec: str) -> list[FaultRule]:
+    """Parse the ``CETPU_FAULTS`` grammar: comma-separated
+    ``point:action[@at][xTIMES]`` — e.g.
+    ``checkpoint.write:kill@3,member.predict:corrupt@1x2``."""
+    rules = []
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        try:
+            point, rest = part.split(":", 1)
+            times = 1
+            if "x" in rest:
+                rest, times_s = rest.rsplit("x", 1)
+                times = int(times_s)
+            at = 1
+            if "@" in rest:
+                rest, at_s = rest.split("@", 1)
+                at = int(at_s)
+            rules.append(FaultRule(point=point, action=rest, at=at,
+                                   times=times))
+        except ValueError as e:
+            raise ValueError(
+                f"bad CETPU_FAULTS entry {part!r} (want "
+                f"point:action[@at][xTIMES]): {e}") from e
+    return rules
+
+
+def install_from_env(env: str = "CETPU_FAULTS") -> FaultInjector | None:
+    """Activate the injector from the environment (called once at package
+    import; harmless no-op when the variable is unset)."""
+    spec = os.environ.get(env)
+    if not spec:
+        return None
+    inj = FaultInjector(parse_spec(spec))
+    install(inj)
+    return inj
+
+
+install_from_env()

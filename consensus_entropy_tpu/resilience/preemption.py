@@ -1,0 +1,74 @@
+"""Preemption-safe execution: drain the in-flight iteration, exit clean.
+
+TPU slices are preempted with a SIGTERM and a grace window.  The AL loop's
+two-phase commit already makes a SIGKILL recoverable; this module upgrades
+SIGTERM/SIGINT from "recoverable crash" to "clean handoff": the handler
+only sets a flag, the loop checks it at iteration boundaries (after the
+iteration's checkpoint has been submitted), joins the in-flight two-phase
+commit, and raises :class:`Preempted`.  Drivers catch it and exit with
+:data:`EXIT_PREEMPTED` so the scheduler can tell "reschedule me" from
+"this run is broken".
+
+Multi-host: the flag is process-local (each host gets its own signal);
+the loop agrees on it via ``multihost.broadcast_flag`` so every process
+leaves the collective program at the same boundary — one preempted host
+must not leave the others blocked in a collective.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+#: Distinct exit code for a preempted-but-cleanly-checkpointed run
+#: (EX_TEMPFAIL from sysexits.h: "try again later" — rescheduler-friendly,
+#: disjoint from error exits and from shells' 128+signum kill codes).
+EXIT_PREEMPTED = 75
+
+
+class Preempted(BaseException):
+    """Raised at an iteration boundary after the in-flight two-phase
+    commit has been joined.  Derives from ``BaseException`` (like
+    ``KeyboardInterrupt``) so quarantine/retry handlers cannot absorb it;
+    drivers catch it explicitly and exit :data:`EXIT_PREEMPTED`."""
+
+
+class PreemptionGuard:
+    """Context manager installing SIGTERM/SIGINT handlers that request a
+    graceful stop.
+
+    The handler is deliberately trivial (sets an ``Event``): all real work
+    — finishing the iteration, joining the checkpoint commit — happens on
+    the loop thread at the next boundary check.  ``request()`` triggers
+    the same path programmatically (tests, external schedulers).  Signal
+    installation silently degrades to programmatic-only when not on the
+    main thread (``signal.signal`` raises there).
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self._signals = tuple(signals)
+        self._old: dict = {}
+        self._event = threading.Event()
+
+    @property
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def request(self) -> None:
+        self._event.set()
+
+    def _handler(self, signum, frame):  # noqa: ARG002 (signal signature)
+        self._event.set()
+
+    def __enter__(self) -> "PreemptionGuard":
+        for s in self._signals:
+            try:
+                self._old[s] = signal.signal(s, self._handler)
+            except ValueError:  # not the main thread: programmatic-only
+                pass
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for s, old in self._old.items():
+            signal.signal(s, old)
+        self._old.clear()

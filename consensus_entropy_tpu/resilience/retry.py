@@ -1,0 +1,73 @@
+"""Bounded retry with seeded, jittered exponential backoff.
+
+Transient device/RPC errors (a TPU slice briefly unreachable over the
+tunnel, a DCN hiccup mid-collective) should not kill a 46-user AL sweep
+when the failed call is pure — scoring and CNN retraining both are: they
+read committee state and return fresh arrays, so re-invoking them replays
+the identical computation.  The AL loop wraps exactly those call sites.
+
+The backoff is seeded (``np.random.default_rng``) so a faulted run's
+timing is reproducible, and jittered so a fleet of preempted hosts does
+not retry in lockstep.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, TypeVar
+
+import numpy as np
+
+from consensus_entropy_tpu.resilience.faults import TransientFault
+
+T = TypeVar("T")
+
+
+def _transient_types() -> tuple:
+    """Error types worth a bounded retry: injected transients plus the
+    runtime's device/RPC error (jax.errors.JaxRuntimeError wraps
+    XlaRuntimeError — what a dropped TPU tunnel or DCN RPC surfaces as)."""
+    types: tuple = (TransientFault,)
+    try:
+        from jax.errors import JaxRuntimeError
+        types += (JaxRuntimeError,)
+    except ImportError:  # very old jax: fall back to the xla_client name
+        try:
+            from jaxlib.xla_extension import XlaRuntimeError
+            types += (XlaRuntimeError,)
+        except ImportError:
+            pass
+    return types
+
+
+TRANSIENT_ERRORS: tuple = _transient_types()
+
+
+def retry_transient(fn: Callable[[], T], *, attempts: int = 3,
+                    base_delay: float = 0.05, max_delay: float = 2.0,
+                    seed: int = 0, what: str = "op",
+                    on: tuple | None = None,
+                    sleep: Callable[[float], None] = time.sleep) -> T:
+    """Call ``fn`` up to ``attempts`` times, sleeping
+    ``min(max_delay, base_delay * 2**k) * uniform(0.5, 1.5)`` between
+    tries.  Only errors in ``on`` (default :data:`TRANSIENT_ERRORS`) are
+    retried; anything else — including :class:`InjectedKill` — propagates
+    immediately.  The final failure re-raises the last transient error.
+
+    ``fn`` must be safe to re-invoke (pure, or idempotent up to its own
+    commit point); the AL loop's scoring/retrain closures are.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    on = TRANSIENT_ERRORS if on is None else on
+    rng = np.random.default_rng(seed)
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except on as e:
+            if attempt == attempts - 1:
+                raise
+            delay = min(max_delay, base_delay * (2 ** attempt))
+            delay *= 0.5 + rng.random()  # jitter in [0.5, 1.5)x
+            sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover

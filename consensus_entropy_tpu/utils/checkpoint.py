@@ -8,6 +8,12 @@ restored before ``load_state_dict`` (``amg_test.py:176-177,273``).  Here:
   meta sidecar header in the same file;
 - writes are atomic (tmp + rename) so a killed run can't leave a torn
   best-checkpoint — the reference can (SURVEY.md §5 failure detection);
+- the payload's CRC32 rides in the header and is verified on read, so
+  bit-rot surfaces as :class:`CheckpointCorruptError` at load time instead
+  of as silently-wrong weights (``al.workspace.load_committee`` then falls
+  back to the retained previous generation — ``al.state
+  .rollback_workspace``).  Pre-CRC checkpoints (no ``crc32`` header key)
+  still load;
 - no frontend constants are stored (the mel fb is config-derived).
 """
 
@@ -16,11 +22,20 @@ from __future__ import annotations
 import json
 import os
 import struct
+import zlib
 
 import jax
 from flax import serialization
 
+from consensus_entropy_tpu.resilience import faults
+
 _MAGIC = b"CETPU1\n"
+
+
+class CheckpointCorruptError(ValueError):
+    """The file is a cetpu checkpoint but its content fails integrity
+    verification (CRC mismatch, truncated header/payload).  Distinct from
+    "not a checkpoint at all" so recovery can roll back rather than abort."""
 
 
 def save_variables(path: str, variables, meta: dict | None = None) -> None:
@@ -30,7 +45,9 @@ def save_variables(path: str, variables, meta: dict | None = None) -> None:
     # per-iteration committee checkpoint a >50 s phase; device_get overlaps
     # the transfers and returns a host-numpy pytree
     payload = serialization.to_bytes(jax.device_get(variables))
-    header = json.dumps(meta or {}).encode()
+    meta = dict(meta or {})
+    meta["crc32"] = zlib.crc32(payload)
+    header = json.dumps(meta).encode()
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(_MAGIC)
@@ -38,16 +55,36 @@ def save_variables(path: str, variables, meta: dict | None = None) -> None:
         f.write(header)
         f.write(payload)
     os.replace(tmp, path)
+    # post-write boundary: `kill` here models dying with the file durable
+    # (any earlier kill leaves only the .tmp, which no reader touches);
+    # `corrupt` flips a payload byte in place — bit-rot the CRC must catch
+    faults.fire("checkpoint.write", payload=path)
 
 
 def load_variables(path: str):
-    """Returns ``(variables, meta)``."""
+    """Returns ``(variables, meta)``.  Verifies the payload CRC when the
+    header carries one; raises :class:`CheckpointCorruptError` on mismatch
+    or on a truncated file."""
     with open(path, "rb") as f:
         magic = f.read(len(_MAGIC))
         if magic != _MAGIC:
             raise ValueError(f"{path}: not a cetpu checkpoint")
-        (hlen,) = struct.unpack("<I", f.read(4))
-        meta = json.loads(f.read(hlen).decode())
+        raw_len = f.read(4)
+        if len(raw_len) != 4:
+            raise CheckpointCorruptError(f"{path}: truncated header")
+        (hlen,) = struct.unpack("<I", raw_len)
+        raw_meta = f.read(hlen)
+        if len(raw_meta) != hlen:
+            raise CheckpointCorruptError(f"{path}: truncated header")
+        try:
+            meta = json.loads(raw_meta.decode())
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise CheckpointCorruptError(f"{path}: corrupt header") from e
         payload = f.read()
+    crc = meta.get("crc32")
+    if crc is not None and zlib.crc32(payload) != crc:
+        raise CheckpointCorruptError(
+            f"{path}: payload CRC mismatch (expected {crc}, got "
+            f"{zlib.crc32(payload)}) — checkpoint is corrupt")
     variables = serialization.msgpack_restore(payload)
     return variables, meta

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Kill-at-every-boundary matrix (SURVEY.md §5 failure detection; mirrors
+# scripts/race_check.sh for the resilience layer).
+#
+# Runs EVERY fault-injection test, including the slow full matrix that
+# tier-1 skips: for each named fault point (checkpoint.write,
+# member.retrain, member.predict, pool.score, state.save, multihost.sync)
+# x each acquisition mode (mc/hc/mix/rand), a run killed at that boundary
+# and resumed must reproduce the unfaulted F1 trajectory bit-for-bit, and
+# a corrupted live checkpoint must roll back one generation and converge
+# to the same trajectory.
+#
+# Extra pytest args pass through, e.g.:
+#   scripts/fault_matrix.sh -k kill_at_every_boundary
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -v -m faults \
+  -p no:cacheprovider "$@"
+echo "fault matrix passed"

@@ -13,6 +13,13 @@ import sys
 # TPU plugin and ignores JAX_PLATFORMS/XLA_FLAGS env vars, so the config API
 # is the only reliable override.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Older jax builds (this container ships 0.4.37) have no jax_num_cpu_devices
+# config option; the XLA flag is the portable spelling and must be in the
+# environment before the backend initializes.
+_FLAGS = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _FLAGS:
+    os.environ["XLA_FLAGS"] = \
+        (_FLAGS + " --xla_force_host_platform_device_count=8").strip()
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
@@ -21,7 +28,14 @@ if _REPO_ROOT not in sys.path:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # pre-0.4.38 jax: the XLA_FLAGS fallback above applies
+    pass
+# The crop compile-buckets rely on prefix-stable threefry draws
+# (committee.predict_songs_cnn checks at the point of reliance); newer jax
+# defaults this on, this build defaults it off.
+jax.config.update("jax_threefry_partitionable", True)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -310,8 +310,13 @@ def test_bf16_training_quality_parity(rng):
         store = DeviceWaveformStore(waves, cfg.input_length)
         trainer = CNNTrainer(cfg, TrainConfig(batch_size=4, lr=1e-3))
         variables = short_cnn.init_variables(jax.random.key(0), cfg)
+        # 40 epochs (not 25): the tiny 8-sample run must CONVERGE under
+        # any jax build's threefry stream for the parity gap to be
+        # meaningful — at 25 epochs the gate measured luck-of-the-draw
+        # (this image's 0.4.37 partitionable threefry lands bf16 at 0.67
+        # mid-descent; by 40 both dtypes plateau and the gap is real)
         best, hist = trainer.fit(variables, store, ids, y, ids, y,
-                                 jax.random.key(1), n_epochs=25)
+                                 jax.random.key(1), n_epochs=40)
         # params stay f32 regardless of compute dtype
         assert all(np.asarray(a).dtype == np.float32
                    for a in jax.tree.leaves(best["params"]))
@@ -346,6 +351,7 @@ def test_fit_many_production_shape_5_members_padded_to_8(rng):
             np.testing.assert_allclose(a["val_f1"], b["val_f1"], atol=1e-6)
 
 
+@pytest.mark.slow  # ~50-65s numerical-parity pin; tier-1 budget (870s) excludes it — run via `pytest -m slow` or the full matrix
 def test_fit_many_scanned_matches_per_epoch(rng):
     """The callback-free fit_many path scans each schedule phase as ONE
     jitted program (<=4 dispatches per retrain instead of one per epoch).
@@ -401,6 +407,7 @@ def test_phase_segments_match_run_schedule():
     assert ran == flat
 
 
+@pytest.mark.slow  # ~50-65s numerical-parity pin; tier-1 budget (870s) excludes it — run via `pytest -m slow` or the full matrix
 def test_fit_scanned_matches_per_epoch(rng):
     """fit's callback-free path scans schedule phases like fit_many's;
     trajectories and best params must match the per-epoch path exactly."""
@@ -430,6 +437,7 @@ def test_fit_scanned_matches_per_epoch(rng):
         best_scan, best_loop)
 
 
+@pytest.mark.slow  # ~50-65s numerical-parity pin; tier-1 budget (870s) excludes it — run via `pytest -m slow` or the full matrix
 def test_fit_many_scanned_mesh_matches_per_epoch(rng):
     """``TrainConfig.scan_mesh_phases`` opts the member-sharded MESH retrain
     into the scanned per-phase program (<=4 dispatches instead of one per

@@ -1,0 +1,630 @@
+"""Resilience layer: kill-at-every-boundary, checkpoint integrity +
+last-good rollback, member quarantine, transient retry, preemption.
+
+The headline suite injects a simulated process death (``InjectedKill``) at
+each named fault point in turn and asserts the resumed run reproduces the
+unfaulted F1 trajectory BIT-FOR-BIT — recovery paths are exercised, not
+trusted.  The fast subset (mc mode) runs in tier-1; the full
+mode x boundary matrix is ``slow`` and runs via ``scripts/fault_matrix.sh``.
+"""
+
+import json
+import os
+import signal
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from consensus_entropy_tpu.al import state as al_state
+from consensus_entropy_tpu.al import workspace
+from consensus_entropy_tpu.al.acquisition import Acquirer, \
+    _sanitize_member_rows
+from consensus_entropy_tpu.al.loop import ALLoop, AsyncCheckpointer, UserData
+from consensus_entropy_tpu.config import ALConfig
+from consensus_entropy_tpu.models.committee import (
+    Committee,
+    CommitteeExhaustedError,
+    FramePool,
+)
+from consensus_entropy_tpu.models.sklearn_members import GNBMember, SGDMember
+from consensus_entropy_tpu.resilience import faults
+from consensus_entropy_tpu.resilience.faults import (
+    FaultRule,
+    InjectedFault,
+    InjectedKill,
+    TransientFault,
+)
+from consensus_entropy_tpu.resilience.preemption import (
+    EXIT_PREEMPTED,
+    Preempted,
+    PreemptionGuard,
+)
+from consensus_entropy_tpu.resilience.retry import retry_transient
+from consensus_entropy_tpu.utils.checkpoint import (
+    _MAGIC,
+    CheckpointCorruptError,
+    load_variables,
+    save_variables,
+)
+
+pytestmark = pytest.mark.faults
+
+
+def _make_user(rng, n_songs=30, frames_per_song=3, n_feat=8):
+    centers = rng.standard_normal((4, n_feat)) * 3.0
+    labels = {}
+    X, frame_song = [], []
+    for s in range(n_songs):
+        c = int(rng.integers(0, 4))
+        sid = f"song{s:03d}"
+        labels[sid] = c
+        X.append(centers[c] + rng.standard_normal((frames_per_song, n_feat)))
+        frame_song += [sid] * frames_per_song
+    pool = FramePool(np.concatenate(X).astype(np.float32), frame_song)
+    hc = rng.uniform(0.1, 1.0, (pool.n_songs, 4)).astype(np.float32)
+    hc /= hc.sum(axis=1, keepdims=True)
+    return UserData("u0", pool, labels, hc_rows=hc)
+
+
+def _committee(rng, data, *, extra_sgd: int = 0, min_members: int = 1):
+    X = data.pool.X
+    y = np.array([data.labels[s] for s in np.repeat(
+        data.pool.song_ids, data.pool.counts)], np.int32)
+    members = [GNBMember("gnb.it_0").fit(X, y),
+               SGDMember("sgd.it_0", seed=0).fit(X, y)]
+    for i in range(extra_sgd):
+        members.append(SGDMember(f"sgd.extra{i}", seed=i + 1).fit(X, y))
+    return Committee(members, [], min_members=min_members)
+
+
+def _run(data, path, mode="mc", epochs=4, seed=11, committee=None, **kw):
+    loop = ALLoop(ALConfig(queries=3, epochs=epochs, mode=mode, seed=seed))
+    com = committee if committee is not None \
+        else _committee(np.random.default_rng(0), data)
+    return loop.run_user(com, data, str(path), seed=seed, **kw)
+
+
+# -- kill-at-every-boundary ----------------------------------------------
+
+#: fault point → per-point hit index that lands the kill mid-run for the
+#: host-only committee (2 members; checkpoint.write/member.* fire per
+#: member, pool.score once per scored iteration, state.save once per
+#: commit, multihost.sync once at run end), and the modes where the point
+#: fires at all (member.predict / pool.score only exist on mc/mix paths).
+BOUNDARIES = {
+    "checkpoint.write": (3, ("mc", "hc", "mix", "rand")),
+    "member.retrain": (3, ("mc", "hc", "mix", "rand")),
+    "member.predict": (3, ("mc", "mix")),
+    "pool.score": (2, ("mc", "mix")),
+    "state.save": (2, ("mc", "hc", "mix", "rand")),
+    "multihost.sync": (1, ("mc", "hc", "mix", "rand")),
+}
+
+_MATRIX = [
+    pytest.param(mode, point, at,
+                 marks=() if mode == "mc" else pytest.mark.slow,
+                 id=f"{mode}-{point}")
+    for point, (at, modes) in sorted(BOUNDARIES.items())
+    for mode in modes
+]
+
+
+@pytest.mark.parametrize("mode,point,at", _MATRIX)
+def test_kill_at_every_boundary(tmp_path, rng, mode, point, at):
+    """A run killed at the named boundary, then resumed from the
+    workspace, reproduces the unfaulted run's F1 trajectory bit-for-bit
+    (and the identical query sequence)."""
+    data = _make_user(rng)
+    base = tmp_path / "base"
+    base.mkdir()
+    res_base = _run(data, base, mode=mode)
+
+    d = tmp_path / "faulted"
+    d.mkdir()
+    with faults.inject(FaultRule(point=point, action="kill", at=at)) as inj:
+        with pytest.raises(InjectedKill):
+            _run(data, d, mode=mode)
+        assert inj.fired, f"{point} never fired — boundary not exercised"
+
+    committee2 = workspace.load_committee(str(d))
+    res2 = _run(data, d, mode=mode, committee=committee2)
+    assert res2["trajectory"] == res_base["trajectory"]
+    assert (al_state.ALState.load(str(d)).queried
+            == al_state.ALState.load(str(base)).queried)
+
+
+# -- checkpoint integrity + last-good rollback ---------------------------
+
+
+def test_checkpoint_crc_roundtrip_and_corruption(tmp_path):
+    tree = {"params": {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}}
+    p = str(tmp_path / "v.msgpack")
+    save_variables(p, tree, meta={"kind": "cnn_jax"})
+    v, meta = load_variables(p)
+    assert "crc32" in meta
+    np.testing.assert_array_equal(v["params"]["w"], tree["params"]["w"])
+
+    faults._corrupt_file(p)  # flip the last (payload) byte: bit-rot
+    with pytest.raises(CheckpointCorruptError, match="CRC mismatch"):
+        load_variables(p)
+
+
+def test_legacy_checkpoint_without_crc_still_loads(tmp_path):
+    from flax import serialization
+
+    tree = {"params": {"w": np.ones((2, 2), np.float32)}}
+    payload = serialization.to_bytes(tree)
+    header = json.dumps({"kind": "cnn_jax"}).encode()  # no crc32 key
+    p = str(tmp_path / "legacy.msgpack")
+    with open(p, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<I", len(header)))
+        f.write(header)
+        f.write(payload)
+    v, meta = load_variables(p)
+    assert "crc32" not in meta
+    np.testing.assert_array_equal(v["params"]["w"], tree["params"]["w"])
+
+    truncated = str(tmp_path / "trunc.msgpack")
+    with open(truncated, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<I", 1000))
+        f.write(b"{}")
+    with pytest.raises(CheckpointCorruptError, match="truncated"):
+        load_variables(truncated)
+
+
+@pytest.mark.parametrize("how", ["bit_rot", "injected"])
+def test_corrupt_live_checkpoint_rolls_back_one_generation(tmp_path, rng,
+                                                           how):
+    """A corrupt LIVE member checkpoint rolls the workspace back to the
+    retained previous generation; the replayed iteration converges to the
+    unfaulted trajectory exactly."""
+    data = _make_user(rng)
+    base = tmp_path / "base"
+    base.mkdir()
+    res_base = _run(data, base, epochs=4)
+
+    d = tmp_path / "part"
+    d.mkdir()
+    if how == "injected":
+        # corrupt the gen-2 staging write of the first member pickle via
+        # the injector (hits: gen0 1-2, gen1 3-4, gen2 5); the run itself
+        # completes — bit-rot is silent until the next load
+        with faults.inject(FaultRule("checkpoint.write", "corrupt", at=5)):
+            _run(data, d, epochs=2)
+    else:
+        _run(data, d, epochs=2)
+        faults._corrupt_file(
+            os.path.join(str(d), "classifier_gnb.gnb.it_0.pkl"))
+    assert al_state.ALState.load(str(d)).next_epoch == 2
+
+    with pytest.warns(UserWarning, match="rolled back"):
+        committee2 = workspace.load_committee(str(d))
+    st = al_state.ALState.load(str(d))
+    assert st.next_epoch == 1  # stepped back exactly one generation
+    res2 = _run(data, d, epochs=4, committee=committee2)
+    assert res2["trajectory"] == res_base["trajectory"]
+
+
+def test_corruption_without_snapshot_fails_loud(tmp_path, rng):
+    """No complete previous-generation snapshot → the corruption error
+    propagates (never a silent mixed-generation restore)."""
+    data = _make_user(rng)
+    d = tmp_path / "u"
+    d.mkdir()
+    _run(data, d, epochs=2)
+    # invalidate the snapshot the way a crash mid-promote would
+    marker = os.path.join(str(d), al_state.PREV_DIR, al_state.PREV_MARKER)
+    os.remove(marker)
+    faults._corrupt_file(os.path.join(str(d), "classifier_gnb.gnb.it_0.pkl"))
+    with pytest.raises(CheckpointCorruptError):
+        workspace.load_committee(str(d))
+
+
+# -- member quarantine ---------------------------------------------------
+
+
+@pytest.mark.parametrize("action,reason_match", [
+    ("raise", "predict failed"),
+    ("corrupt", "non-finite"),
+])
+def test_member_quarantine_degrades_gracefully(tmp_path, rng, action,
+                                               reason_match):
+    """A member whose predict raises (or emits NaN rows) is quarantined;
+    the run completes over the survivors and the event is recorded in the
+    per-user report."""
+    data = _make_user(rng)
+    com = _committee(np.random.default_rng(0), data, extra_sgd=1)
+    d = tmp_path / "u"
+    d.mkdir()
+    with faults.inject(FaultRule("member.predict", action, at=1, times=-1,
+                                 member="sgd.extra0")):
+        res = _run(data, d, committee=com)
+    assert list(com.quarantined) == ["sgd.extra0"]
+    assert reason_match in com.quarantined["sgd.extra0"]
+    assert len(res["trajectory"]) == 5 and np.isfinite(res["trajectory"]).all()
+    events = [json.loads(l) for l in open(os.path.join(str(d),
+                                                       "metrics.jsonl"))
+              if "\"event\"" in l]
+    assert events and events[0]["event"] == "quarantine"
+    assert events[0]["member"] == "sgd.extra0"
+    assert reason_match in events[0]["reason"]
+
+
+def test_retrain_failure_quarantines_member(tmp_path, rng):
+    data = _make_user(rng)
+    com = _committee(np.random.default_rng(0), data, extra_sgd=1)
+    d = tmp_path / "u"
+    d.mkdir()
+    with faults.inject(FaultRule("member.retrain", "raise", at=1, times=-1,
+                                 member="gnb.it_0")):
+        res = _run(data, d, committee=com)
+    assert list(com.quarantined) == ["gnb.it_0"]
+    assert "retrain failed" in com.quarantined["gnb.it_0"]
+    assert len(res["trajectory"]) == 5
+    # the quarantined member's checkpoint is skipped: its live file keeps
+    # the state from before the quarantine, and a reloaded committee still
+    # carries all members (quarantine is per-run, not persisted)
+    reloaded = workspace.load_committee(str(d))
+    assert len(reloaded.host_members) == 3
+
+
+def test_committee_exhaustion_aborts(tmp_path, rng):
+    data = _make_user(rng)
+    com = _committee(np.random.default_rng(0), data, min_members=2)
+    d = tmp_path / "u"
+    d.mkdir()
+    with faults.inject(FaultRule("member.retrain", "raise", at=1, times=-1,
+                                 member="gnb.it_0")):
+        with pytest.raises(CommitteeExhaustedError, match="min_members=2"):
+            _run(data, d, committee=com)
+
+
+def test_quarantined_rows_match_survivor_consensus(rng):
+    """Acceptance: a quarantined member's rows are masked out of the
+    consensus-entropy reduction and the mean renormalizes over survivors —
+    selections equal a committee that never had the member."""
+    songs = [f"s{i}" for i in range(20)]
+    probs = rng.uniform(0.05, 1.0, (3, 20, 4)).astype(np.float32)
+    probs /= probs.sum(axis=-1, keepdims=True)
+    poisoned = probs.copy()
+    poisoned[0] = np.nan  # the quarantined member's slot
+
+    acq_a = Acquirer(songs, None, queries=5, mode="mc", seed=0)
+    acq_b = Acquirer(songs, None, queries=5, mode="mc", seed=0)
+    assert acq_a.select(poisoned) == acq_b.select(probs[1:])
+
+
+def test_sanitizer_is_bit_identical_when_clean(rng):
+    p = rng.uniform(0.01, 1.0, (4, 16, 4)).astype(np.float32)
+    p /= p.sum(axis=-1, keepdims=True)
+    out = np.asarray(_sanitize_member_rows(p))
+    assert np.array_equal(out, p)  # unfaulted rankings cannot move
+
+
+# -- transient retry -----------------------------------------------------
+
+
+def test_retry_transient_bounds_and_jitter():
+    calls, sleeps = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientFault("blip")
+        return 42
+
+    assert retry_transient(flaky, attempts=3, seed=7,
+                           sleep=sleeps.append) == 42
+    assert len(calls) == 3 and len(sleeps) == 2
+    assert all(d > 0 for d in sleeps)
+    # seeded jitter: same seed → same backoff schedule
+    calls2, sleeps2 = [], []
+
+    def flaky2():
+        calls2.append(1)
+        if len(calls2) < 3:
+            raise TransientFault("blip")
+        return 0
+
+    retry_transient(flaky2, attempts=3, seed=7, sleep=sleeps2.append)
+    assert sleeps == sleeps2
+
+    def always():
+        raise TransientFault("down")
+
+    with pytest.raises(TransientFault):
+        retry_transient(always, attempts=2, sleep=lambda _: None)
+
+    def hard():
+        raise ValueError("not transient")
+
+    calls3 = []
+    with pytest.raises(ValueError):
+        retry_transient(lambda: (calls3.append(1), hard()),
+                        attempts=5, sleep=lambda _: None)
+    assert len(calls3) == 1  # no retry on non-transient errors
+
+
+def test_transient_scoring_fault_is_absorbed(tmp_path, rng):
+    """A transient error in the (pure) scoring pass retries and the run's
+    trajectory is identical to the unfaulted one."""
+    data = _make_user(rng)
+    base = tmp_path / "base"
+    base.mkdir()
+    res_base = _run(data, base)
+    d = tmp_path / "u"
+    d.mkdir()
+    with faults.inject(FaultRule("pool.score", "transient", at=2)) as inj:
+        res = _run(data, d)
+    assert inj.fired
+    assert res["trajectory"] == res_base["trajectory"]
+
+
+# -- preemption ----------------------------------------------------------
+
+
+class _CountingGuard:
+    """Requests preemption after the Nth boundary check (stands in for a
+    SIGTERM landing mid-run)."""
+
+    def __init__(self, after: int):
+        self.checks = 0
+        self.after = after
+
+    @property
+    def requested(self) -> bool:
+        self.checks += 1
+        return self.checks > self.after
+
+
+def test_preemption_finishes_commit_and_resumes(tmp_path, rng):
+    data = _make_user(rng)
+    base = tmp_path / "base"
+    base.mkdir()
+    res_base = _run(data, base)
+
+    d = tmp_path / "u"
+    d.mkdir()
+    with pytest.raises(Preempted):
+        _run(data, d, preemption=_CountingGuard(2))
+    st = al_state.ALState.load(str(d))
+    assert st is not None and st.next_epoch == 2  # committed, not torn
+    assert not any(f.startswith(al_state.STAGING_PREFIX)
+                   for f in os.listdir(str(d)))
+
+    committee2 = workspace.load_committee(str(d))
+    res2 = _run(data, d, committee=committee2)
+    assert res2["trajectory"] == res_base["trajectory"]
+
+
+def test_preemption_guard_catches_sigterm():
+    assert EXIT_PREEMPTED == 75
+    old = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard() as g:
+        assert not g.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        for _ in range(200):  # delivery is async; bounded wait
+            if g.requested:
+                break
+            time.sleep(0.005)
+        assert g.requested
+    assert signal.getsignal(signal.SIGTERM) == old  # handler restored
+
+
+# -- fault injector mechanics -------------------------------------------
+
+
+def test_fault_rule_spec_parsing():
+    rules = faults.parse_spec("checkpoint.write:kill@3,"
+                              "member.predict:corrupt@1x-1,"
+                              "pool.score:delay")
+    assert [(r.point, r.action, r.at, r.times) for r in rules] == [
+        ("checkpoint.write", "kill", 3, 1),
+        ("member.predict", "corrupt", 1, -1),
+        ("pool.score", "delay", 1, 1),
+    ]
+    with pytest.raises(ValueError, match="unknown fault point"):
+        faults.parse_spec("nope:kill")
+    with pytest.raises(ValueError, match="bad CETPU_FAULTS entry"):
+        faults.parse_spec("checkpoint.write")
+
+
+def test_injector_counts_hits_deterministically():
+    with faults.inject(FaultRule("pool.score", "raise", at=2)) as inj:
+        faults.fire("pool.score")  # hit 1: below `at`
+        with pytest.raises(InjectedFault):
+            faults.fire("pool.score")  # hit 2: fires
+        faults.fire("pool.score")  # hit 3: window passed
+    assert inj.hits["pool.score"] == 3
+    assert [f["hit"] for f in inj.fired] == [2]
+    assert faults.active() is None  # uninstalled on exit
+
+
+# -- satellites: state + recovery edge cases ----------------------------
+
+
+def test_corrupt_state_file_loads_as_none_and_user_redoes(tmp_path):
+    d = tmp_path / "u"
+    d.mkdir()
+    (d / al_state.STATE_FILE).write_text('{"next_epoch": 3, "trunc')
+    with pytest.warns(UserWarning, match="unreadable AL state"):
+        assert al_state.ALState.load(str(d)) is None
+
+    # the existing redo path treats it as a pre-state crash: wiped clean
+    pre = tmp_path / "pretrained"
+    pre.mkdir()
+    (pre / "classifier_gnb.it_0.pkl").write_bytes(b"x")
+    users = str(tmp_path / "users")
+    path, _ = workspace.create_user(users, str(pre), "u1", "mc")
+    (tmp_path / "users" / "u1" / "mc" / al_state.STATE_FILE).write_text("{")
+    (tmp_path / "users" / "u1" / "mc" / "junk").write_text("partial")
+    with pytest.warns(UserWarning, match="unreadable AL state"):
+        path2, skip2 = workspace.create_user(users, str(pre), "u1", "mc")
+    assert not skip2 and not os.path.exists(os.path.join(path2, "junk"))
+
+
+def test_schema_drift_state_fails_loud(tmp_path):
+    """Valid JSON that doesn't fit the dataclass is a version mismatch,
+    not bit-rot: it must fail loud instead of silently wiping the user."""
+    d = tmp_path / "u"
+    d.mkdir()
+    (d / al_state.STATE_FILE).write_text(
+        '{"next_epoch": 3, "no_such_field": 1}')
+    with pytest.raises(ValueError, match="cannot read"):
+        al_state.ALState.load(str(d))
+
+
+def _mk_state(d, gen):
+    al_state.ALState(gen, [0.5], [], [], [["s"]], [0, 0], "uint32",
+                     "mc", 11).save(str(d))
+
+
+def test_recover_non_integer_suffix_alongside_valid(tmp_path):
+    d = tmp_path / "u"
+    d.mkdir()
+    (d / "classifier_gnb.m.pkl").write_text("old")
+    _mk_state(d, 2)
+    junk = d / f"{al_state.STAGING_PREFIX}foo"
+    junk.mkdir()
+    (junk / "classifier_gnb.m.pkl").write_text("junk")
+    good = al_state.staging_dir(str(d), 2)
+    os.makedirs(good)
+    with open(os.path.join(good, "classifier_gnb.m.pkl"), "w") as f:
+        f.write("gen2")
+    al_state.recover_workspace(str(d))
+    assert not junk.exists() and not os.path.exists(good)
+    assert open(d / "classifier_gnb.m.pkl").read() == "gen2"
+
+
+def test_recover_repeated_recovery_idempotent(tmp_path):
+    d = tmp_path / "u"
+    d.mkdir()
+    (d / "classifier_gnb.m.pkl").write_text("old")
+    _mk_state(d, 2)
+    good = al_state.staging_dir(str(d), 2)
+    os.makedirs(good)
+    with open(os.path.join(good, "classifier_gnb.m.pkl"), "w") as f:
+        f.write("gen2")
+    for _ in range(3):
+        al_state.recover_workspace(str(d))
+        assert open(d / "classifier_gnb.m.pkl").read() == "gen2"
+        # the last-good snapshot survives repeated recovery untouched
+        prev = os.path.join(str(d), al_state.PREV_DIR)
+        assert open(os.path.join(prev, "classifier_gnb.m.pkl")).read() \
+            == "old"
+        assert open(os.path.join(prev, al_state.PREV_MARKER)).read() == "2"
+
+
+def test_recover_generation_mismatch_discards_stage(tmp_path):
+    d = tmp_path / "u"
+    d.mkdir()
+    (d / "classifier_gnb.m.pkl").write_text("live")
+    _mk_state(d, 2)
+    stale = al_state.staging_dir(str(d), 5)  # neither st.next_epoch nor junk
+    os.makedirs(stale)
+    with open(os.path.join(stale, "classifier_gnb.m.pkl"), "w") as f:
+        f.write("wrong-gen")
+    al_state.recover_workspace(str(d))
+    assert not os.path.exists(stale)
+    assert open(d / "classifier_gnb.m.pkl").read() == "live"
+
+
+def test_reentered_promotion_keeps_partial_snapshot(tmp_path):
+    """Crash mid-promote, then recovery re-enters the promote: the
+    already-accumulated previous-generation copies must be KEPT (wiping
+    them and re-marking COMPLETE would let a later rollback restore a
+    mixed-generation committee).  Constructed state: file A was already
+    promoted (its gen-1 copy lives only in the snapshot), file B was not."""
+    d = tmp_path / "u"
+    d.mkdir()
+    _mk_state(d, 1)
+    os.replace(os.path.join(str(d), al_state.STATE_FILE),
+               os.path.join(str(d), al_state.STATE_FILE
+                            + al_state.PREV_STATE_SUFFIX))
+    _mk_state(d, 2)
+    (d / "classifier_gnb.a.pkl").write_text("A2")  # promoted before crash
+    (d / "classifier_gnb.b.pkl").write_text("B1")  # not yet promoted
+    prev = d / al_state.PREV_DIR
+    prev.mkdir()
+    (prev / al_state.PREV_GEN_MARKER).write_text("2")
+    (prev / "classifier_gnb.a.pkl").write_text("A1")
+    stage = al_state.staging_dir(str(d), 2)
+    os.makedirs(stage)
+    with open(os.path.join(stage, "classifier_gnb.b.pkl"), "w") as f:
+        f.write("B2")
+
+    al_state.recover_workspace(str(d))  # re-entered promote completes
+    assert open(d / "classifier_gnb.a.pkl").read() == "A2"
+    assert open(d / "classifier_gnb.b.pkl").read() == "B2"
+    assert open(prev / al_state.PREV_MARKER).read() == "2"
+    assert open(prev / "classifier_gnb.a.pkl").read() == "A1"  # kept!
+
+    assert al_state.rollback_workspace(str(d))  # snapshot is truly complete
+    assert open(d / "classifier_gnb.a.pkl").read() == "A1"
+    assert open(d / "classifier_gnb.b.pkl").read() == "B1"
+    assert al_state.ALState.load(str(d)).next_epoch == 1
+
+
+def test_stale_snapshot_of_other_generation_is_replaced(tmp_path):
+    d = tmp_path / "u"
+    d.mkdir()
+    (d / "classifier_gnb.m.pkl").write_text("g1")
+    _mk_state(d, 2)
+    prev = d / al_state.PREV_DIR
+    prev.mkdir()
+    (prev / al_state.PREV_GEN_MARKER).write_text("1")  # older generation
+    (prev / "classifier_gnb.m.pkl").write_text("g0-stale")
+    stage = al_state.staging_dir(str(d), 2)
+    os.makedirs(stage)
+    with open(os.path.join(stage, "classifier_gnb.m.pkl"), "w") as f:
+        f.write("g2")
+    al_state.recover_workspace(str(d))
+    assert open(d / "classifier_gnb.m.pkl").read() == "g2"
+    # the stale gen-0 copy was dropped; the snapshot now holds gen 1
+    assert open(prev / "classifier_gnb.m.pkl").read() == "g1"
+    assert open(prev / al_state.PREV_GEN_MARKER).read() == "2"
+
+
+def test_rollback_refuses_incomplete_or_mismatched_snapshot(tmp_path):
+    d = tmp_path / "u"
+    d.mkdir()
+    _mk_state(d, 2)
+    assert not al_state.rollback_workspace(str(d))  # nothing retained
+    prev = d / al_state.PREV_DIR
+    prev.mkdir()
+    (prev / "classifier_gnb.m.pkl").write_text("g1")
+    assert not al_state.rollback_workspace(str(d))  # no COMPLETE marker
+    (prev / al_state.PREV_MARKER).write_text("7")   # wrong generation
+    (d / (al_state.STATE_FILE + al_state.PREV_STATE_SUFFIX)).write_text(
+        (d / al_state.STATE_FILE).read_text())
+    assert not al_state.rollback_workspace(str(d))
+    assert (prev / "classifier_gnb.m.pkl").exists()  # untouched
+
+
+# -- AsyncCheckpointer context manager (satellite) -----------------------
+
+
+def test_async_checkpointer_context_manager_releases_worker():
+    done = []
+    with AsyncCheckpointer() as ck:
+        ck.submit(lambda: done.append(1))
+    assert done == [1]
+    with pytest.raises(RuntimeError):  # worker released: pool is shut down
+        ck.submit(lambda: None)
+
+
+def test_async_checkpointer_surfaces_deferred_error_on_clean_exit():
+    with pytest.raises(RuntimeError, match="disk full"):
+        with AsyncCheckpointer() as ck:
+            ck.submit(lambda: (_ for _ in ()).throw(RuntimeError("disk full")))
+
+
+def test_async_checkpointer_does_not_mask_loop_error():
+    with pytest.raises(KeyError, match="root cause"):
+        with AsyncCheckpointer() as ck:
+            ck.submit(lambda: (_ for _ in ()).throw(RuntimeError("deferred")))
+            raise KeyError("root cause")
