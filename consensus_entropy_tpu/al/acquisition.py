@@ -256,6 +256,73 @@ class Acquirer:
 
     # -- the four modes ----------------------------------------------------
 
+    def scoring_inputs(self, member_probs=None, *, rand_key=None):
+        """Stage this iteration's device-scoring call: ``(fn_key, inputs)``.
+
+        ``fn_key`` names the jitted scorer (the key into
+        ``make_scoring_fns`` / ``make_fleet_scoring_fns``); ``inputs`` is
+        its positional argument tuple.  The split exists for the fleet
+        engine: a scheduler can collect same-shaped ``(fn_key, inputs)``
+        pairs from a cohort of users, stack them on a leading user axis,
+        and run ONE vmapped dispatch — then hand each user's row to
+        :meth:`finish_select`.  :meth:`select` composes the three steps,
+        so the single-user path is unchanged.
+
+        Mask updates are deferred to :meth:`finish_select`; the staged
+        inputs reference the acquirer's live mask arrays, so callers must
+        score before finishing (the jit call copies on transfer).
+        """
+        if self.mode == "mc":
+            return "mc", (
+                _sanitize_member_rows(self._staged_probs(member_probs)),
+                self._feed(self.pool_mask, 0))
+        if self.mode == "hc":
+            return "hc_pre", (self._hc_ent_dev,
+                              self._feed(self.hc_mask, 0))
+        if self.mode == "mix":
+            return "mix", (
+                _sanitize_member_rows(self._staged_probs(member_probs)),
+                self._feed(self.pool_mask, 0),
+                self._hc_dev,
+                self._feed(self.hc_mask, 0))
+        if self.mode == "rand":
+            if rand_key is None:
+                self._rand_key, rand_key = jax.random.split(self._rand_key)
+            return "rand", (self._feed_key(rand_key),
+                            self._feed(self.pool_mask, 0))
+        raise ValueError(f"unknown mode {self.mode!r}")
+
+    def run_scoring(self, fn_key: str, inputs) -> scoring.ScoreResult:
+        """Run one staged scoring call through this acquirer's compiled
+        (single-user) fns — the sequential path, and the fleet's fallback
+        for a batch of one."""
+        return self._fns[fn_key](*inputs)
+
+    def finish_select(self, res: scoring.ScoreResult) -> list:
+        """Map a scoring result back to song ids and apply the reference's
+        mask mutations (pool shrink + hc row removal)."""
+        if self.mode in ("mc", "rand"):
+            q_songs = self._ids(res)
+        elif self.mode == "hc":
+            q_songs = self._ids(res)
+            self._remove_hc(q_songs)  # amg_test.py:455
+        elif self.mode == "mix":
+            is_hc, slots = scoring.split_mix_index(res.indices, self.n_pad)
+            valid = np.asarray(res.values) > -np.inf
+            raw = [self.songs[int(s)]
+                   for s, ok in zip(np.asarray(slots), valid) if ok]
+            # the same song can surface from both blocks; the reference's
+            # isin-based batch build dedups implicitly (amg_test.py:491)
+            q_songs = list(dict.fromkeys(raw))
+            self._remove_hc(q_songs)  # amg_test.py:484
+        else:
+            raise ValueError(f"unknown mode {self.mode!r}")
+
+        # remove the batch from the unlabeled pool (amg_test.py:520-523)
+        for s in q_songs:
+            self.pool_mask[self._song_row[s]] = False
+        return q_songs
+
     def select(self, member_probs=None, *, rand_key=None) -> list:
         """Pick the next query batch; returns song ids (≤ ``queries``).
 
@@ -265,43 +332,8 @@ class Acquirer:
         acquirer's internal seed-derived stream is used).  Updates pool/hc
         masks exactly as the reference mutates its tables.
         """
-        if self.mode == "mc":
-            res = self._fns["mc"](
-                _sanitize_member_rows(self._staged_probs(member_probs)),
-                self._feed(self.pool_mask, 0))
-            q_songs = self._ids(res)
-        elif self.mode == "hc":
-            res = self._fns["hc_pre"](self._hc_ent_dev,
-                                      self._feed(self.hc_mask, 0))
-            q_songs = self._ids(res)
-            self._remove_hc(q_songs)  # amg_test.py:455
-        elif self.mode == "mix":
-            res = self._fns["mix"](
-                _sanitize_member_rows(self._staged_probs(member_probs)),
-                self._feed(self.pool_mask, 0),
-                self._hc_dev,
-                self._feed(self.hc_mask, 0))
-            is_hc, slots = scoring.split_mix_index(res.indices, self.n_pad)
-            valid = np.asarray(res.values) > -np.inf
-            raw = [self.songs[int(s)]
-                   for s, ok in zip(np.asarray(slots), valid) if ok]
-            # the same song can surface from both blocks; the reference's
-            # isin-based batch build dedups implicitly (amg_test.py:491)
-            q_songs = list(dict.fromkeys(raw))
-            self._remove_hc(q_songs)  # amg_test.py:484
-        elif self.mode == "rand":
-            if rand_key is None:
-                self._rand_key, rand_key = jax.random.split(self._rand_key)
-            res = self._fns["rand"](self._feed_key(rand_key),
-                                    self._feed(self.pool_mask, 0))
-            q_songs = self._ids(res)
-        else:
-            raise ValueError(f"unknown mode {self.mode!r}")
-
-        # remove the batch from the unlabeled pool (amg_test.py:520-523)
-        for s in q_songs:
-            self.pool_mask[self._song_row[s]] = False
-        return q_songs
+        fn_key, inputs = self.scoring_inputs(member_probs, rand_key=rand_key)
+        return self.finish_select(self.run_scoring(fn_key, inputs))
 
     def replay(self, queried_batches) -> None:
         """Re-apply completed iterations' query batches to the masks

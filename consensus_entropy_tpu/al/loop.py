@@ -8,6 +8,14 @@ Per user: grouped 85/15 song split → per-iteration [score pool → query top-q
 What moved on device: committee scoring + consensus entropy + top-k (one jit
 graph, fixed shapes, mask-shrunk pool), CNN retraining epochs, crop sampling.
 What stays host: sklearn partial_fit/boosting, frame bookkeeping, metrics.
+
+The iteration body itself lives in ``fleet.session.UserSession`` — a
+steppable coroutine shared verbatim between this sequential driver and the
+multi-user fleet scheduler (``fleet.scheduler``), so fleet runs reproduce
+sequential trajectories by construction.  This module keeps the sequential
+surface (``ALLoop``), the per-user data contracts (``UserData`` /
+``SplitData`` / ``grouped_split`` / ``query_batch``) and the checkpoint
+writer (``AsyncCheckpointer``).
 """
 
 from __future__ import annotations
@@ -15,39 +23,48 @@ from __future__ import annotations
 import dataclasses
 from typing import Mapping
 
-import jax
 import numpy as np
 
-from consensus_entropy_tpu.al import state as al_state
-from consensus_entropy_tpu.al.acquisition import Acquirer
-from consensus_entropy_tpu.al.reporting import UserReport, weighted_f1
 from consensus_entropy_tpu.config import ALConfig
 from consensus_entropy_tpu.data.audio import DeviceWaveformStore
-from consensus_entropy_tpu.labels import one_hot_np
 from consensus_entropy_tpu.models.committee import Committee, FramePool
 from consensus_entropy_tpu.utils.profiling import StepTimer
 
 
 class AsyncCheckpointer:
-    """One background writer for the loop's per-iteration checkpoints.
+    """One background writer PER USER SESSION for per-iteration checkpoints.
 
     The two-phase commit's ordering (member files → state write → promote)
-    is preserved INSIDE each submitted job; jobs never overlap (``submit``
-    joins the previous one), so crash consistency is exactly the
+    is preserved INSIDE each submitted job; a session's jobs never overlap
+    (``submit`` joins the previous one), so crash consistency is exactly the
     synchronous story — the only change is that serialization + disk I/O
-    overlap the next iteration's device compute.  A single-worker
-    ``ThreadPoolExecutor`` provides the serialization and traceback-correct
-    exception propagation; the pending ``Future`` is cleared before
-    ``result()`` so an error surfaces exactly once.
+    overlap the next iteration's device compute.  The pending ``Future`` is
+    cleared before ``result()`` so an error surfaces exactly once.
+
+    ``executor``: optional SHARED ``ThreadPoolExecutor``.  Sequential runs
+    leave it ``None`` and get a private single-worker pool (identical to the
+    original design).  The fleet engine runs N user sessions concurrently;
+    funneling all of them through one global worker would serialize every
+    user's checkpoint I/O behind every other's, so each session gets its own
+    ``AsyncCheckpointer`` backed by one bounded shared pool — per-session
+    ordering still holds (the per-instance future chain), but different
+    sessions' writes overlap.  A shared executor is NOT shut down by
+    ``close`` (its owner does that); ``close`` only fences this session's
+    pending job and refuses further submits.
     """
 
-    def __init__(self):
+    def __init__(self, executor=None):
         from concurrent.futures import ThreadPoolExecutor
 
-        self._pool = ThreadPoolExecutor(max_workers=1)
+        self._owns_pool = executor is None
+        self._pool = ThreadPoolExecutor(max_workers=1) \
+            if executor is None else executor
         self._future = None
+        self._closed = False
 
     def submit(self, fn) -> None:
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointer is closed")
         self.wait()
         self._future = self._pool.submit(fn)
 
@@ -58,12 +75,15 @@ class AsyncCheckpointer:
 
     def close(self) -> None:
         """Join the pending job and release the worker thread (one
-        checkpointer is created per ``run_user``; without shutdown a
-        46-user run would park 46 idle workers)."""
+        checkpointer is created per user session; without shutdown a
+        46-user run would park 46 idle workers).  Shared executors are
+        left running for their owner to shut down."""
+        self._closed = True
         try:
             self.wait()
         finally:
-            self._pool.shutdown(wait=False)
+            if self._owns_pool:
+                self._pool.shutdown(wait=False)
 
     def __enter__(self) -> "AsyncCheckpointer":
         return self
@@ -164,43 +184,6 @@ class ALLoop:
         self.mesh = mesh
         self.pad_pool_to = pad_pool_to
 
-    def _evaluate(self, committee: Committee, data: UserData,
-                  split: SplitData, report: UserReport, key) -> list[float]:
-        """Evaluate every ACTIVE member on the user's test set; returns F1
-        list in committee order (CNN members first, as ``member_names``).
-        A member that fails here — predict raises, or its probabilities go
-        non-finite — is quarantined and dropped from the mean, so one
-        degenerate member can't sink the trajectory or kill the user."""
-        f1s = []
-        cnns = committee.active_cnn_members
-        if cnns:
-            probs = np.asarray(committee.predict_songs_cnn(
-                data.store, split.test_songs, key))
-            for m, p in zip(cnns, probs):
-                if not np.all(np.isfinite(p)):
-                    committee.quarantine(
-                        m.name, "non-finite eval probabilities")
-                    continue
-                y_pred = p.argmax(axis=1)
-                f1s.append(report.model_eval(m.name, split.y_test_songs,
-                                             y_pred))
-        for m in committee.active_host_members:
-            try:
-                y_pred = m.predict(split.X_test)
-            except Exception as e:
-                committee.quarantine(m.name, f"eval predict failed: {e!r}")
-                continue
-            f1s.append(report.model_eval(m.name, split.y_test_frames, y_pred))
-        return f1s
-
-    @staticmethod
-    def _rebuild_split(data: UserData, st: al_state.ALState) -> SplitData:
-        """Reconstruct SplitData from a resume state's stored song lists."""
-        return split_from_songs(
-            data.pool, data.labels,
-            al_state.remap_songs(st.train_songs, data.pool.song_ids),
-            al_state.remap_songs(st.test_songs, data.pool.song_ids))
-
     def run_user(self, committee: Committee, data: UserData, user_path: str,
                  *, seed: int | None = None, resume: bool = True,
                  timer: StepTimer | None = None, preemption=None) -> dict:
@@ -208,279 +191,20 @@ class ALLoop:
         attribute (``resilience.preemption.PreemptionGuard``).  When it
         goes true, the loop finishes the in-flight iteration's two-phase
         commit at the next iteration boundary and raises ``Preempted`` —
-        a resumable clean handoff, not a failure."""
-        cfg = self.config
-        seed = cfg.seed if seed is None else seed
-        timer = timer or StepTimer(None)
-        # the config's survivor floor never weakens a stricter committee
-        committee.min_members = max(committee.min_members, cfg.min_members)
+        a resumable clean handoff, not a failure.
 
-        st = al_state.ALState.load(user_path) if resume else None
-        if st is not None and not st.matches(
-                mode=cfg.mode, seed=seed, queries=cfg.queries,
-                train_size=cfg.train_size):
-            # Fail loud: the workspace holds a committee trained under a
-            # different experiment definition — silently "starting clean"
-            # would contaminate the run (workspace.create_user wipes such
-            # directories when given the experiment parameters).
-            raise ValueError(
-                f"{user_path} holds resume state for a different experiment "
-                f"(mode={st.mode} seed={st.seed} q={st.queries} "
-                f"train_size={st.train_size}); delete the directory or pass "
-                "the experiment to workspace.create_user")
-        if st is not None:
-            split = self._rebuild_split(data, st)
-            key = st.unpack_key()
-            trajectory = list(st.trajectory)
-            queried_hist = [al_state.remap_songs(b, data.pool.song_ids)
-                            for b in st.queried]
-            start_epoch = st.next_epoch
-        else:
-            rng = np.random.default_rng(seed)
-            key = jax.random.key(seed)
-            split = grouped_split(data.pool, data.labels, cfg.train_size, rng)
-            trajectory = []
-            queried_hist = []
-            start_epoch = 0
+        The iteration body lives in ``fleet.session.UserSession`` — one
+        generator shared verbatim with the fleet scheduler, so a
+        sequential run IS the inline driving of the same session a fleet
+        run interleaves (equality by construction; see ``fleet``)."""
+        from consensus_entropy_tpu.fleet.session import (
+            UserSession,
+            drive_inline,
+        )
 
-        hc_rows = None
-        if data.hc_rows is not None:
-            row_of = {s: i for i, s in enumerate(data.pool.song_ids)}
-            hc_rows = np.asarray(data.hc_rows)[
-                [row_of[s] for s in split.train_songs]]
-        acq = Acquirer(split.train_songs, hc_rows, queries=cfg.queries,
-                       mode=cfg.mode, tie_break=self.tie_break, seed=seed,
-                       mesh=self.mesh, pad_to=self.pad_pool_to)
-        acq.replay(queried_hist)
-
-        from consensus_entropy_tpu.parallel import multihost
-
-        ckpt = AsyncCheckpointer()
-        #: last finished background job's self-timed durations (fetch/write)
-        bg_times: dict = {}
-
-        def checkpoint(next_epoch: int, current_key) -> None:
-            """Two-phase commit: stage members -> state write (commit point)
-            -> promote.  A kill anywhere leaves (committee, state) pairs
-            consistent (al_state.recover_workspace).  Multi-host: only the
-            coordinator touches the workspace (every process carries the
-            same in-memory committee, so nothing is lost).
-
-            The mutable state is SNAPSHOT here (host members written, CNN
-            variables fetched, state fields copied); serialization + disk
-            writes + promote then run on the checkpointer thread, hidden
-            behind the next iteration's compute.
-            """
-            if not multihost.is_coordinator():
-                return
-            # Join the PREVIOUS commit before staging the next generation:
-            # its recover_workspace prunes staging dirs of other
-            # generations, so staging concurrently would let it rmtree the
-            # dir being written (submit() also joins, but only AFTER
-            # begin_save — too late).
-            ckpt.wait()
-            finish_members = committee.begin_save(
-                al_state.staging_dir(user_path, next_epoch),
-                reuse_dir=user_path, dtype=cfg.ckpt_dtype)
-            kd, kdt = al_state.ALState.pack_key(current_key)
-            state_obj = al_state.ALState(
-                next_epoch=next_epoch, trajectory=list(trajectory),
-                train_songs=[al_state.song_key(s)
-                             for s in split.train_songs],
-                test_songs=[al_state.song_key(s) for s in split.test_songs],
-                queried=[[al_state.song_key(s) for s in b]
-                         for b in queried_hist],
-                key_data=kd, key_dtype=kdt, mode=cfg.mode, seed=seed,
-                queries=cfg.queries, train_size=cfg.train_size,
-            )
-
-            def commit():
-                import time
-
-                bg = finish_members() or {}
-                t0 = time.perf_counter()
-                state_obj.save(user_path)  # the commit point
-                al_state.recover_workspace(user_path)  # promote the stage
-                bg["commit_s"] = time.perf_counter() - t0
-                bg_times.update(bg)
-
-            ckpt.submit(commit)
-
-        # AsyncCheckpointer as context manager: on the success path close
-        # surfaces any deferred write error before the caller reads the
-        # workspace (mark_done, resume, final save); on the error path it
-        # is best-effort so the worker thread and pending future are
-        # released without masking the loop's own error.
-        with ckpt:
-            result = self._run_iterations(
-                committee, data, user_path, cfg, seed, timer, st, split, key,
-                trajectory, queried_hist, start_epoch, acq, checkpoint,
-                multihost, ckpt, bg_times, preemption)
-        # every write is durable here; the barrier keeps non-coordinators
-        # from reading the workspace before the coordinator's last commit
-        multihost.sync(f"run_user_done_{data.user_id}")
-        return result
-
-    def _run_iterations(self, committee, data, user_path, cfg, seed, timer,
-                        st, split, key, trajectory, queried_hist,
-                        start_epoch, acq, checkpoint, multihost, ckpt,
-                        bg_times, preemption=None):
-        from consensus_entropy_tpu.resilience import faults
-        from consensus_entropy_tpu.resilience.preemption import Preempted
-        from consensus_entropy_tpu.resilience.retry import retry_transient
-
-        def preempt_check(boundary: str) -> None:
-            """Iteration-boundary preemption check.  The flag is agreed
-            across processes (broadcast_flag) so every host leaves the
-            collective program at the same boundary, and the in-flight
-            two-phase commit is joined first — the handoff leaves the
-            workspace durable and resumable, which is what separates
-            ``Preempted`` (exit EXIT_PREEMPTED, reschedule) from a crash."""
-            if preemption is not None and multihost.broadcast_flag(
-                    bool(preemption.requested)):
-                ckpt.wait()
-                raise Preempted(
-                    f"preempted after {boundary}; workspace committed — "
-                    "rerun to resume at the next iteration")
-
-        def join_and_drain():
-            """Join the previous iteration's background checkpoint job in
-            its OWN timed phase, then surface that job's self-timed
-            durations as ``ckpt_bg_*`` entries.  ``ckpt_join`` is the only
-            part that adds to this iteration's wall-clock; the ``ckpt_bg``
-            phases ran on the checkpointer thread OVERLAPPING the previous
-            iteration's compute (on a thin d2h link they contend with it)
-            and must not be summed into iteration totals.  The bg numbers
-            describe the job SUBMITTED by the previous flush's record —
-            a one-record offset, noted here rather than hidden."""
-            with timer.phase("ckpt_join"):
-                ckpt.wait()
-            labels = {}
-            if bg_times:
-                for k in ("fetch", "write", "commit"):
-                    if f"{k}_s" in bg_times:
-                        timer.add(f"ckpt_bg_{k}", bg_times.pop(f"{k}_s"))
-                if "n_members_fetched" in bg_times:
-                    labels["ckpt_members_fetched"] = \
-                        bg_times.pop("n_members_fetched")
-            return labels
-
-        with UserReport(user_path, cfg.mode,
-                        write=multihost.is_coordinator()) as report:
-            #: host members' F1s from the LAST evaluation on the gating
-            #: split — reused as the gate's before-scores (same split,
-            #: same metric, member state unchanged between an epoch's
-            #: evaluate and the next epoch's update); None forces the
-            #: gate to compute them (resume, or gating disabled)
-            last_host_f1s = None
-
-            def drain_events(epoch: int) -> list:
-                """Forward quarantine events into the per-user report.
-                Returns them so callers can invalidate anything aligned
-                with the pre-quarantine member list."""
-                events = committee.drain_quarantine_events()
-                for ev in events:
-                    report.quarantine_event(epoch, ev)
-                return events
-
-            if st is None:
-                # epoch 0: baseline evaluation (amg_test.py:398-418)
-                report.epoch_header(-1)
-                key, sub = jax.random.split(key)
-                with timer.phase("evaluate"):
-                    f1s = self._evaluate(committee, data, split, report, sub)
-                if drain_events(-1):
-                    last_host_f1s = None  # member set shifted mid-eval
-                else:
-                    last_host_f1s = f1s[len(committee.active_cnn_members):]
-                report.epoch_summary(-1, f1s)
-                trajectory.append(float(np.mean(f1s)))
-                labels = join_and_drain()
-                with timer.phase("checkpoint"):
-                    checkpoint(0, key)
-                timer.flush(user=str(data.user_id), epoch=-1, **labels)
-                preempt_check("baseline evaluation")
-
-            for epoch in range(start_epoch, cfg.epochs):
-                report.epoch_header(epoch)
-                live = acq.remaining_songs
-                if len(live) == 0:
-                    break
-                member_probs = None
-                if cfg.mode in ("mc", "mix"):
-                    key, sub = jax.random.split(key)
-                    with timer.phase("score"):
-                        # stays a device array end-to-end: the acquirer
-                        # scatters it into its persistent padded buffer
-                        # (no host round-trip of the probs table), staged
-                        # at the fixed bucket width so the chain compiles
-                        # once per bucket, not once per live-width.
-                        # Scoring is pure (committee state is read-only
-                        # and the crop key is fixed), so a transient
-                        # device/RPC error retries the identical pass.
-                        member_probs = retry_transient(
-                            lambda sub=sub, live=live: faults.fire(
-                                "pool.score",
-                                payload=committee.pool_probs(
-                                    data.pool, data.store, live, sub,
-                                    pad_to=acq.staging_width(len(live)))),
-                            attempts=cfg.retry_attempts,
-                            base_delay=cfg.retry_base_delay,
-                            seed=seed + epoch, what="pool.score")
-                key, sub = jax.random.split(key)
-                with timer.phase("select"):
-                    q_songs = acq.select(member_probs, rand_key=sub)
-
-                # reveal labels; build the frame batch (amg_test.py:491-493)
-                X_batch, y_batch = query_batch(data.pool, data.labels,
-                                               q_songs)
-
-                with timer.phase("update_host"):
-                    if cfg.gate_host_updates and len(split.X_test):
-                        committee.update_host_gated(
-                            X_batch, y_batch, split.X_test,
-                            split.y_test_frames,
-                            before_scores=last_host_f1s)
-                    else:
-                        committee.update_host(X_batch, y_batch)
-                if committee.active_cnn_members:
-                    y_q = one_hot_np([data.labels[s] for s in q_songs])
-                    y_t = one_hot_np(split.y_test_songs)
-                    key, sub = jax.random.split(key)
-                    with timer.phase("retrain_cnn"):
-                        # fit_many rebinds member variables only on return,
-                        # so a transient failure mid-fit left no partial
-                        # mutation and the retry replays the identical fit
-                        retry_transient(
-                            lambda sub=sub, y_q=y_q, y_t=y_t:
-                            committee.retrain_cnns(
-                                data.store, q_songs, y_q, split.test_songs,
-                                y_t, sub, n_epochs=self.retrain_epochs),
-                            attempts=cfg.retry_attempts,
-                            base_delay=cfg.retry_base_delay,
-                            seed=seed + 7919 * (epoch + 1),
-                            what="member.retrain")
-
-                key, sub = jax.random.split(key)
-                with timer.phase("evaluate"):
-                    f1s = self._evaluate(committee, data, split, report, sub)
-                if drain_events(epoch):
-                    last_host_f1s = None  # member set shifted mid-iteration
-                else:
-                    last_host_f1s = f1s[len(committee.active_cnn_members):]
-                report.epoch_summary(epoch, f1s, queried=q_songs,
-                                     pool_size=len(acq.remaining_songs))
-                trajectory.append(float(np.mean(f1s)))
-
-                # per-iteration persistence (amg_test.py:511) + resume state
-                queried_hist.append(q_songs)
-                labels = join_and_drain()
-                with timer.phase("checkpoint"):
-                    checkpoint(epoch + 1, key)
-                timer.flush(user=str(data.user_id), epoch=epoch,
-                            queried=len(q_songs), **labels)
-                preempt_check(f"iteration {epoch}")
-
-        return {"user": data.user_id, "mode": cfg.mode,
-                "trajectory": trajectory,
-                "final_mean_f1": trajectory[-1] if trajectory else None}
+        session = UserSession(
+            self.config, committee, data, user_path, seed=seed,
+            tie_break=self.tie_break, retrain_epochs=self.retrain_epochs,
+            mesh=self.mesh, pad_pool_to=self.pad_pool_to, resume=resume,
+            timer=timer, preemption=preemption)
+        return drive_inline(session)

@@ -36,6 +36,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "consensus [hc], both [mix], random [rand]")
     p.add_argument("--max-users", type=int, default=None,
                    help="cap the user count (debug)")
+    p.add_argument("--fleet", type=int, default=None, metavar="N",
+                   help="run users through the fleet engine, N concurrent "
+                        "AL sessions per cohort: phase-aligned sessions "
+                        "share one vmapped scoring dispatch and host "
+                        "retraining overlaps device scoring "
+                        "(fleet.scheduler); per-user results are identical "
+                        "to the sequential run")
+    p.add_argument("--fleet-host-workers", type=int, default=None,
+                   help="bounded worker pool for fleet host-side "
+                        "sklearn retraining/evaluation (default: "
+                        "min(N, cpus, 8))")
     p.add_argument("--seed", type=int, default=1987)
     p.add_argument("--tie-break", choices=("fast", "numpy"), default="fast")
     p.add_argument("--trace-dir", default=None,
@@ -82,6 +93,18 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     configure_device(args.device)
+
+    if args.fleet is not None:
+        if args.fleet < 1:
+            print(f"--fleet must be >= 1, got {args.fleet}")
+            return 1
+        if args.distributed or args.mesh:
+            # the fleet batches by vmapping the single-device scorers; the
+            # pool-sharded fns carry per-user mesh placements that cannot
+            # be stacked — multi-host/mesh fleets are a ROADMAP open item
+            print("--fleet is single-process/single-mesh only (drop "
+                  "--distributed/--mesh)")
+            return 1
 
     if args.distributed:
         # must precede every other jax call (jax.distributed contract)
@@ -215,6 +238,90 @@ def main(argv=None) -> int:
     return 0
 
 
+def _run_users_fleet(args, cfg, paths, users, pool, anno, hc_table, store,
+                     cnn_cfg, guard, results) -> None:
+    """Fleet path: cohorts of ``--fleet N`` users run concurrently through
+    ``fleet.FleetScheduler``; per-user workspaces/results are identical to
+    the sequential path (same session generator, same seeds)."""
+    import numpy as np
+
+    from consensus_entropy_tpu.al import workspace
+    from consensus_entropy_tpu.al.loop import UserData
+    from consensus_entropy_tpu.data import amg
+    from consensus_entropy_tpu.fleet import (
+        FleetReport,
+        FleetScheduler,
+        FleetUser,
+    )
+    from consensus_entropy_tpu.fleet.report import bench_line
+
+    experiment = {"seed": cfg.seed, "queries": cfg.queries,
+                  "train_size": cfg.train_size}
+    report = FleetReport(os.path.join(paths.users_dir,
+                                      "fleet_metrics.jsonl"))
+    scheduler = FleetScheduler(
+        cfg, tie_break=args.tie_break, retrain_epochs=args.retrain_epochs,
+        host_workers=args.fleet_host_workers, preemption=guard,
+        pad_pool_to=args.pad_pool_to, report=report)
+    todo = list(users[: args.max_users])
+    n_cohorts = 0
+    failed = []
+    for lo in range(0, len(todo), args.fleet):
+        cohort = todo[lo: lo + args.fleet]
+        entries = []
+        for u_id in cohort:
+            user_path, skip = workspace.create_user(
+                paths.users_dir, paths.pretrained_dir, u_id, cfg.mode,
+                experiment=experiment)
+            if skip:
+                print(f"Skipping user {u_id}, already exists!")
+                continue
+
+            def factory(user_path=user_path):
+                return workspace.load_committee(
+                    user_path, cnn_cfg, device_members=args.device_members,
+                    full_song_hop=args.full_song_hop)
+
+            committee = factory()
+            sub_pool, labels = amg.user_pool(pool, anno, u_id)
+            hc_rows = hc_table.reindex(sub_pool.song_ids).to_numpy(
+                np.float32)
+            data = UserData(u_id, sub_pool, labels, hc_rows=hc_rows,
+                            store=store)
+            entries.append(FleetUser(u_id, committee, data, user_path,
+                                     seed=cfg.seed,
+                                     committee_factory=factory))
+        if not entries:
+            continue
+        n_cohorts += 1
+        print(f"Fleet cohort of {len(entries)} users "
+              f"({lo}..{lo + len(cohort) - 1} of {len(todo)})")
+        for rec in scheduler.run(entries):
+            if rec["error"] is not None:
+                print(f"user {rec['user']} FAILED: {rec['error']}")
+                failed.append(rec["user"])
+                continue
+            user_path = workspace.user_dir(paths.users_dir, rec["user"],
+                                           cfg.mode)
+            rec["committee"].save(user_path)
+            workspace.mark_done(user_path)
+            results.append(rec["result"])
+            print(f"user {rec['user']}: final mean F1 = "
+                  f"{rec['result']['final_mean_f1']:.4f}")
+    import json
+
+    summary = report.write_summary(cohort=min(args.fleet, len(todo) or 1))
+    print("fleet summary: "
+          + json.dumps(bench_line(summary), sort_keys=True))
+    if failed:
+        # parity with the sequential path, where a user's terminal error
+        # crashes the sweep with a nonzero exit — a fleet run that quietly
+        # dropped users must not look successful to CI/scripts
+        raise RuntimeError(
+            f"{len(failed)} fleet user(s) failed terminally after "
+            f"eviction/resume: {failed}")
+
+
 def _run_users(args, cfg, paths, users, pool, anno, hc_table, store,
                cnn_cfg, mesh, train_mesh, loop, multihost, guard,
                results) -> None:
@@ -225,6 +332,11 @@ def _run_users(args, cfg, paths, users, pool, anno, hc_table, store,
     from consensus_entropy_tpu.data import amg
     from consensus_entropy_tpu.resilience.preemption import Preempted
     from consensus_entropy_tpu.utils import profiling
+
+    if args.fleet is not None:
+        _run_users_fleet(args, cfg, paths, users, pool, anno, hc_table,
+                         store, cnn_cfg, guard, results)
+        return
 
     for num_user, u_id in enumerate(users[: args.max_users]):
         if multihost.broadcast_flag(guard.requested):

@@ -1,0 +1,37 @@
+"""Fleet engine: batched multi-user AL scheduling.
+
+The paper's workload is embarrassingly per-user — a private committee, a
+private pool, a private AL trajectory — but the repo's north star is heavy
+traffic from MILLIONS of users, and the acquisition math already permits
+cross-user batching: the fused scoring graphs in ``ops.scoring`` are
+row-local, so stacking U users' padded pool tables on a leading axis and
+``vmap``-ing turns U device round-trips per iteration into one (the
+multitask-committee argument of PAPERS.md: share committee compute across
+users; "Wisdom of Committees" makes the batched-ensemble case).
+
+Pieces:
+
+- :mod:`fleet.session` — the per-user AL loop as a steppable coroutine.
+  ``ALLoop.run_user`` and the fleet scheduler drive the SAME generator, so
+  a fleet run reproduces each user's sequential trajectory by
+  construction (pinned bit-for-bit by ``tests/test_fleet.py``).
+- :mod:`fleet.scheduler` — runs N sessions concurrently: phase-aligned
+  sessions' scoring calls are stacked into one vmapped dispatch
+  (``ops.scoring.make_fleet_scoring_fns``), host sklearn retraining runs
+  on a bounded worker pool overlapping device scoring, and a faulted user
+  is evicted + resumed from its workspace without touching the cohort.
+- :mod:`fleet.report` — users/sec, device-batch occupancy, per-phase
+  wall-clock; ``metrics.jsonl`` events + a BENCH-compatible summary.
+"""
+
+from consensus_entropy_tpu.fleet.report import FleetReport
+from consensus_entropy_tpu.fleet.scheduler import FleetScheduler, FleetUser
+from consensus_entropy_tpu.fleet.session import (
+    HostStep,
+    ScoreStep,
+    UserSession,
+    drive_inline,
+)
+
+__all__ = ["FleetReport", "FleetScheduler", "FleetUser", "HostStep",
+           "ScoreStep", "UserSession", "drive_inline"]

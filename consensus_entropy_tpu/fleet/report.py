@@ -1,0 +1,147 @@
+"""Fleet throughput reporting: users/sec, device-batch occupancy, phases.
+
+The per-user surfaces (text report, ``metrics.jsonl``, ``timings.jsonl``)
+are unchanged — each session writes its own, exactly as a sequential run
+would.  This module adds the COHORT-level view a serving operator needs:
+
+- one ``metrics.jsonl`` event stream for the fleet itself (dispatches,
+  evictions, resumes, per-user completions) at the users root,
+- an end-of-run summary with users/sec, device-batch occupancy (how full
+  the vmapped scoring dispatches ran relative to the cohort), and summed
+  per-phase wall-clock across sessions,
+- a BENCH-compatible one-line JSON (``bench.py --suite fleet`` writes the
+  ``BENCH_fleet_*.json`` artifact from it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+class FleetReport:
+    """Collects fleet-run telemetry; optionally streams events to JSONL.
+
+    ``jsonl_path``: fleet-level ``metrics.jsonl`` (the per-user files live
+    in the user workspaces).  All methods are called from the scheduler's
+    main thread only, so no locking is needed.
+    """
+
+    def __init__(self, jsonl_path: str | None = None):
+        self.jsonl_path = jsonl_path
+        self.dispatches: list[dict] = []
+        self.events: list[dict] = []
+        self.phase_totals: dict[str, float] = {}
+        self.users_done = 0
+        self.users_failed = 0
+        self._t0 = time.perf_counter()
+        if jsonl_path:
+            os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
+
+    # -- recording ---------------------------------------------------------
+
+    def _emit(self, rec: dict) -> None:
+        self.events.append(rec)
+        if self.jsonl_path:
+            with open(self.jsonl_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
+    def dispatch(self, fn_key: str, batch: int, cohort: int,
+                 wall_s: float) -> None:
+        """One device scoring dispatch: ``batch`` sessions scored together
+        out of a ``cohort`` concurrently-live sessions."""
+        self.dispatches.append({"fn": fn_key, "batch": batch,
+                                "cohort": cohort, "wall_s": wall_s})
+
+    def event(self, kind: str, **fields) -> None:
+        """Cohort-level event (evict / resume / user_done / user_failed)."""
+        self._emit({"event": kind, "t_s": round(self.elapsed_s(), 3),
+                    **fields})
+
+    def user_done(self, user, result: dict, phases: dict) -> None:
+        """A session finished; ``phases`` are its summed ``{phase}_s``
+        durations (from the session's ``StepTimer`` records)."""
+        self.users_done += 1
+        for k, v in phases.items():
+            self.phase_totals[k] = self.phase_totals.get(k, 0.0) + v
+        self.event("user_done", user=str(user),
+                   final_mean_f1=result.get("final_mean_f1"),
+                   epochs=len(result.get("trajectory", [])))
+
+    def user_failed(self, user, error: str) -> None:
+        self.users_failed += 1
+        self.event("user_failed", user=str(user), error=error)
+
+    def elapsed_s(self) -> float:
+        return time.perf_counter() - self._t0
+
+    # -- summaries ---------------------------------------------------------
+
+    @property
+    def occupancy(self) -> float | None:
+        """Mean scored-sessions per dispatch over the concurrently-live
+        cohort at that moment: 1.0 = every dispatch scored every live
+        session at once (perfect phase alignment); 1/cohort = fully
+        serialized (the sequential shape)."""
+        per = [d["batch"] / d["cohort"] for d in self.dispatches
+               if d["cohort"]]
+        return sum(per) / len(per) if per else None
+
+    def summary(self, *, cohort: int, wall_s: float | None = None) -> dict:
+        """Cohort roll-up.  ``phase_wall_s`` sums the sessions' OWN timers
+        — session-observed latency, so in fleet mode a phase that spans a
+        scheduler hand-off (notably ``select_s``, which covers staging →
+        batched dispatch → id mapping) includes scheduling/batch-window
+        wait.  ``dispatch_wall_s`` is the scheduler-side device dispatch
+        time alone — compare the two to attribute queueing vs compute."""
+        wall = self.elapsed_s() if wall_s is None else wall_s
+        batches = [d["batch"] for d in self.dispatches]
+        out = {
+            "cohort": cohort,
+            "users_done": self.users_done,
+            "users_failed": self.users_failed,
+            "wall_s": round(wall, 3),
+            "users_per_sec": round(self.users_done / wall, 4) if wall
+            else None,
+            "score_dispatches": len(batches),
+            "dispatch_wall_s": round(sum(d["wall_s"]
+                                         for d in self.dispatches), 3),
+            "mean_device_batch": round(sum(batches) / len(batches), 2)
+            if batches else None,
+            "occupancy": round(self.occupancy, 3)
+            if self.occupancy is not None else None,
+            "phase_wall_s": {k: round(v, 3)
+                             for k, v in sorted(self.phase_totals.items())},
+            "evictions": sum(e["event"] == "evict" for e in self.events),
+            "resumes": sum(e["event"] == "resume" for e in self.events),
+        }
+        return out
+
+    def write_summary(self, *, cohort: int, wall_s: float | None = None) -> dict:
+        """Emit the summary as the final JSONL event and return it."""
+        s = self.summary(cohort=cohort, wall_s=wall_s)
+        self._emit({"event": "fleet_summary", **s})
+        return s
+
+
+def bench_line(summary: dict, *, baseline_users_per_sec: float | None = None,
+               extra: dict | None = None) -> dict:
+    """Shape a fleet summary into the repo's BENCH JSON-line schema
+    (``{"metric", "value", "unit", "vs_baseline", ...}``) so
+    ``BENCH_fleet_*.json`` artifacts sit beside the scoring/retrain ones."""
+    ups = summary.get("users_per_sec")
+    line = {
+        "metric": f"fleet_users_per_sec_n{summary.get('cohort')}",
+        "value": ups,
+        "unit": "users/s",
+        "vs_baseline": (round(ups / baseline_users_per_sec, 2)
+                        if ups and baseline_users_per_sec else None),
+        "occupancy": summary.get("occupancy"),
+        "users_done": summary.get("users_done"),
+        "evictions": summary.get("evictions"),
+        "phase_wall_s": summary.get("phase_wall_s"),
+    }
+    if extra:
+        line.update(extra)
+    return line

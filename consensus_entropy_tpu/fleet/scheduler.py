@@ -1,0 +1,341 @@
+"""Multi-user AL scheduling: N concurrent sessions, one device batch.
+
+The scheduler drives N ``UserSession`` coroutines (``fleet.session``)
+through their SCORE → QUERY → RETRAIN → EVAL state machines:
+
+- **Batched device scoring** — sessions blocked on a ``ScoreStep`` are
+  grouped by (scorer, input shapes) and each group runs as ONE vmapped
+  dispatch (``ops.scoring.make_fleet_scoring_fns``); each session receives
+  its row, bit-identical to its own single-user jitted call (pinned by
+  ``tests/test_fleet_scoring.py``).  Groups of one fall back to the
+  session's own fns — literally the sequential path.
+- **Host/device overlap** — ``HostStep`` blocks (sklearn ``predict_proba``
+  / ``partial_fit`` / evaluation for jax-free committees) run on a bounded
+  worker pool; while user A retrains on host threads, users B..Z score on
+  the device.
+- **Isolation** — every session keeps its own workspace, resume state,
+  report files, quarantine ledger and ``AsyncCheckpointer`` (all backed by
+  one bounded shared executor, so concurrent sessions' checkpoint I/O
+  overlaps instead of serializing).  A session that raises is EVICTED:
+  its resources are torn down through the generator's own error path and —
+  when the entry provides a ``committee_factory`` — the user is resumed
+  from its (durable, two-phase-committed) workspace while the rest of the
+  cohort keeps running.  ``Preempted`` / ``InjectedKill`` are
+  ``BaseException``: they stop the whole fleet, exactly like the signal /
+  process death they model; every other session's generator is closed
+  first so all workspaces stay durable and resumable.
+
+Determinism: each user's trajectory is produced by the same statements in
+the same per-user order as ``ALLoop.run_user`` (shared generator), so a
+fleet run reproduces N sequential runs' results exactly — scheduling only
+changes which wall-clock instant each user's next step runs at.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from typing import Callable
+
+import jax.numpy as jnp
+
+from consensus_entropy_tpu.config import ALConfig
+from consensus_entropy_tpu.fleet.report import FleetReport
+from consensus_entropy_tpu.fleet.session import (
+    HostStep,
+    ScoreStep,
+    UserSession,
+)
+from consensus_entropy_tpu.ops import scoring as ops_scoring
+from consensus_entropy_tpu.utils.profiling import StepTimer
+
+
+@dataclasses.dataclass
+class FleetUser:
+    """One cohort member.  ``committee_factory`` (nullary, reloads the
+    committee from ``user_path``) enables resume-after-eviction; without it
+    a faulted user is evicted terminally."""
+
+    user_id: object
+    committee: object
+    data: object  # al.loop.UserData
+    user_path: str
+    seed: int | None = None
+    committee_factory: Callable | None = None
+
+
+@dataclasses.dataclass(eq=False)  # identity hash: states live in sets
+class _SessionState:
+    entry: FleetUser
+    session: UserSession
+    gen: object
+    started: bool = False
+    resumes: int = 0
+
+
+class FleetScheduler:
+    """Run a cohort of user AL sessions concurrently.
+
+    ``host_workers``: bounded pool for jax-free ``HostStep`` blocks
+    (default ``min(cohort, os.cpu_count(), 8)``).  ``ckpt_workers``: shared
+    checkpoint-writer pool (default ``min(cohort, 4)``).  ``max_resumes``:
+    eviction→resume attempts per user before recording a failure.
+    ``pad_pool_to``: fixed pool width for the whole cohort — defaults to
+    the cohort's largest song pool, so every session's scoring inputs share
+    one padded shape and batch into one vmapped dispatch (padding never
+    changes selections; see ``Acquirer``/``test_mc_with_padding``).
+    ``user_timings``: write each session's ``timings.jsonl`` into its
+    workspace (the sequential CLI's surface)."""
+
+    def __init__(self, config: ALConfig, *, tie_break: str = "fast",
+                 retrain_epochs: int | None = None,
+                 host_workers: int | None = None,
+                 ckpt_workers: int | None = None, max_resumes: int = 1,
+                 pad_pool_to: int | None = None, preemption=None,
+                 report: FleetReport | None = None,
+                 user_timings: bool = True,
+                 batch_window_s: float = 0.0):
+        self.config = config
+        self.tie_break = tie_break
+        self.retrain_epochs = retrain_epochs
+        self.host_workers = host_workers
+        self.ckpt_workers = ckpt_workers
+        self.max_resumes = max_resumes
+        self.pad_pool_to = pad_pool_to
+        self.preemption = preemption
+        self.report = report or FleetReport()
+        self.user_timings = user_timings
+        #: before dispatching a partially-full score batch while host work
+        #: is still in flight, wait up to this long for more sessions to
+        #: reach their ScoreStep — trades latency for device-batch
+        #: occupancy.  Default 0 (eager dispatch): on a host-bound CPU box
+        #: overlap beats amortization.  On a dispatch-expensive device
+        #: (the ~2 ms tunneled-TPU round-trip BENCH_r01 measured) a few ms
+        #: of window buys near-full cohort batches — measured occupancy
+        #: 0.17→1.0 at cohort 6 with a 10 ms window.
+        self.batch_window_s = batch_window_s
+
+    # -- session plumbing --------------------------------------------------
+
+    def _make_session(self, entry: FleetUser, committee) -> _SessionState:
+        timer = StepTimer(
+            os.path.join(entry.user_path, "timings.jsonl")
+            if self.user_timings else None)
+        session = UserSession(
+            self.config, committee, entry.data, entry.user_path,
+            seed=entry.seed, tie_break=self.tie_break,
+            retrain_epochs=self.retrain_epochs,
+            pad_pool_to=self._pad, timer=timer,
+            preemption=self.preemption, ckpt_executor=self._ckpt_pool)
+        st = _SessionState(entry, session, session.steps())
+        return st
+
+    def _advance(self, state: _SessionState, value=None, exc=None):
+        """Step a session's generator; returns the next step, or ``None``
+        when the session finished or was evicted (both recorded)."""
+        try:
+            if exc is not None:
+                step = state.gen.throw(exc)
+            elif not state.started:
+                state.started = True
+                step = next(state.gen)
+            else:
+                step = state.gen.send(value)
+            return step
+        except StopIteration as stop:
+            self._finish(state, stop.value)
+            return None
+        except Exception as e:  # Preempted/InjectedKill are BaseException
+            self._evict(state, e)
+            return None
+
+    def _finish(self, state: _SessionState, result: dict) -> None:
+        phases = {}
+        for rec in state.session.timer.records:
+            for k, v in rec.items():
+                if k.endswith("_s"):
+                    phases[k] = phases.get(k, 0.0) + v
+        self.report.user_done(state.entry.user_id, result, phases)
+        self._results[id(state.entry)] = {
+            "user": state.entry.user_id, "result": result,
+            "committee": state.session.committee,
+            "resumes": state.resumes, "error": None}
+
+    def _evict(self, state: _SessionState, exc: Exception) -> None:
+        """Tear one faulted session down and (when possible) resume the
+        user from its workspace — the cohort never sees the fault.  By the
+        time the exception escaped the generator, the session's
+        checkpointer was closed through its own error path, so the
+        workspace is quiescent and durable for the resume's recovery."""
+        entry = state.entry
+        self.report.event("evict", user=str(entry.user_id),
+                          error=repr(exc), resumes=state.resumes)
+        if (entry.committee_factory is not None
+                and state.resumes < self.max_resumes):
+            try:
+                committee = entry.committee_factory()
+            except Exception as load_err:
+                self.report.user_failed(
+                    entry.user_id,
+                    f"resume reload failed: {load_err!r} "
+                    f"(after {exc!r})")
+                self._results[id(entry)] = {
+                    "user": entry.user_id, "result": None,
+                    "committee": None, "resumes": state.resumes,
+                    "error": f"{exc!r}; resume reload failed: {load_err!r}"}
+                return
+            new = self._make_session(entry, committee)
+            new.resumes = state.resumes + 1
+            self.report.event("resume", user=str(entry.user_id),
+                              attempt=new.resumes)
+            self._ready.append((new, None, None))
+        else:
+            self.report.user_failed(entry.user_id, repr(exc))
+            self._results[id(entry)] = {
+                "user": entry.user_id, "result": None, "committee": None,
+                "resumes": state.resumes, "error": repr(exc)}
+
+    # -- batched scoring ---------------------------------------------------
+
+    @staticmethod
+    def _sig(x):
+        if ops_scoring.is_key_array(x):
+            return ("key", x.shape)
+        arr = jnp.asarray(x) if not hasattr(x, "shape") else x
+        return (tuple(arr.shape), str(arr.dtype))
+
+    @staticmethod
+    def _stack(vals):
+        if ops_scoring.is_key_array(vals[0]):
+            return ops_scoring.stack_user_keys(vals)
+        return jnp.stack([jnp.asarray(v) for v in vals])
+
+    def _dispatch_scores(self, steps: list[ScoreStep], n_live: int):
+        """Service a round of ScoreSteps: group by (scorer, shapes), run
+        each multi-session group as ONE vmapped dispatch, singletons
+        through the session's own single-user fns.  Returns
+        ``[(session_state, ScoreResult), ...]``."""
+        groups = collections.defaultdict(list)
+        for st, step in steps:
+            key = (step.fn_key,) + tuple(self._sig(x) for x in step.inputs)
+            groups[key].append((st, step))
+        out = []
+        for group in groups.values():
+            t0 = time.perf_counter()
+            if len(group) == 1:
+                st, step = group[0]
+                res = step.session.acq.run_scoring(step.fn_key, step.inputs)
+                out.append((st, res))
+            else:
+                fn_key = group[0][1].fn_key
+                stacked = [self._stack([step.inputs[pos]
+                                        for _, step in group])
+                           for pos in range(len(group[0][1].inputs))]
+                batched = self._fleet_fns[fn_key](*stacked)
+                for i, (st, _) in enumerate(group):
+                    out.append((st, ops_scoring.ScoreResult(
+                        batched.entropy[i], batched.values[i],
+                        batched.indices[i])))
+            self.report.dispatch(group[0][1].fn_key, len(group), n_live,
+                                 time.perf_counter() - t0)
+        return out
+
+    # -- the scheduling loop -----------------------------------------------
+
+    def run(self, users: list[FleetUser]) -> list[dict]:
+        """Run the cohort to completion; returns one record per input user
+        (input order): ``{"user", "result", "committee", "resumes",
+        "error"}`` — ``result``/``committee`` are the finished session's
+        (after any resumes), ``error`` is set for terminally failed users.
+        """
+        if not users:
+            return []
+        self._pad = self.pad_pool_to
+        if self._pad is None:
+            # one fixed width across the cohort: every user's scoring
+            # inputs then share a shape and batch into one dispatch
+            self._pad = max(u.data.pool.n_songs for u in users)
+        self._fleet_fns = ops_scoring.make_fleet_scoring_fns(
+            k=self.config.queries, tie_break=self.tie_break)
+        n = len(users)
+        host_n = self.host_workers or min(n, os.cpu_count() or 4, 8)
+        ckpt_n = self.ckpt_workers or min(n, 4)
+        self._results = {}
+        host_pool = ThreadPoolExecutor(max_workers=host_n,
+                                       thread_name_prefix="fleet-host")
+        self._ckpt_pool = ThreadPoolExecutor(max_workers=ckpt_n,
+                                             thread_name_prefix="fleet-ckpt")
+        #: (state, value, exc) triples whose generator can be stepped now
+        self._ready = collections.deque()
+        live_states: set = set()
+        try:
+            for u in users:
+                st = self._make_session(u, u.committee)
+                self._ready.append((st, None, None))
+            score_wait: list = []   # (state, ScoreStep)
+            host_wait: dict = {}    # Future -> (state, HostStep)
+
+            def track(state, step):
+                if step is None:
+                    live_states.discard(state)
+                elif isinstance(step, ScoreStep):
+                    score_wait.append((state, step))
+                else:
+                    fut = host_pool.submit(step.fn)
+                    host_wait[fut] = (state, step)
+
+            def drain_host(timeout):
+                """Move completed host futures back to the ready queue;
+                returns how many completed within ``timeout``."""
+                if not host_wait:
+                    return 0
+                done, _ = wait(list(host_wait), timeout=timeout,
+                               return_when=FIRST_COMPLETED)
+                for fut in done:
+                    state, _step = host_wait.pop(fut)
+                    err = fut.exception()
+                    if err is None:
+                        self._ready.append((state, fut.result(), None))
+                    else:
+                        # throw INTO the generator: the session's own
+                        # error path runs (report + checkpointer close),
+                        # exactly as if the block had raised inline
+                        self._ready.append((state, None, err))
+                return len(done)
+
+            while self._ready or score_wait or host_wait:
+                while self._ready:
+                    state, value, exc = self._ready.popleft()
+                    live_states.add(state)
+                    track(state, self._advance(state, value, exc))
+                if score_wait:
+                    if host_wait and drain_host(self.batch_window_s):
+                        # sessions finishing host work may be one step from
+                        # their own ScoreStep — let them join this batch
+                        continue
+                    # the blocked ScoreSteps are this round's device batch
+                    n_live = len(live_states)
+                    batch, score_wait = score_wait, []
+                    for state, res in self._dispatch_scores(batch, n_live):
+                        self._ready.append((state, res, None))
+                    continue
+                drain_host(None)
+        except BaseException:
+            # Preempted / InjectedKill / KeyboardInterrupt: stop the fleet.
+            # Drain workers first (they touch session state), then close
+            # every live generator so each session's checkpointer joins —
+            # all workspaces end durable and resumable.
+            host_pool.shutdown(wait=True)
+            for state in list(live_states):
+                try:
+                    state.gen.close()
+                except Exception:
+                    pass
+            raise
+        finally:
+            host_pool.shutdown(wait=True)
+            self._ckpt_pool.shutdown(wait=True)
+        return [self._results[id(u)] for u in users]

@@ -1,0 +1,506 @@
+"""The per-user AL loop as a steppable coroutine.
+
+This is ``ALLoop.run_user``'s iteration body (``amg_test.py:344-539``
+semantics — see ``al.loop``) restructured as a generator that YIELDS at the
+two points where a multi-user scheduler can interleave work:
+
+- :class:`ScoreStep` — the staged device-scoring call
+  (``Acquirer.scoring_inputs``).  The sequential driver services it with
+  the single-user jitted fns; the fleet scheduler stacks same-shaped steps
+  from a whole cohort into one vmapped dispatch.
+- :class:`HostStep` — a pure-host block (sklearn ``predict_proba`` /
+  ``partial_fit`` / evaluation) for committees with no device members.
+  The sequential driver runs it inline; the fleet scheduler runs it on a
+  bounded worker pool so host retraining overlaps device scoring.
+
+Single-writer-per-driver contract: between a yield and the corresponding
+resume, only the step's servicer touches the session (the generator is
+suspended), so session state needs no locks.
+
+Equality by construction: both drivers execute the SAME statements in the
+SAME order with the same per-user PRNG stream — the sequential path is
+``drive_inline`` (which ``ALLoop.run_user`` delegates to), so a fleet run
+reproduces each user's sequential F1 trajectory bit-for-bit
+(``tests/test_fleet.py`` pins this; the resilience kill-matrix pins the
+sequential semantics themselves).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import numpy as np
+
+from consensus_entropy_tpu.al import state as al_state
+from consensus_entropy_tpu.al.acquisition import Acquirer
+from consensus_entropy_tpu.al.reporting import UserReport
+from consensus_entropy_tpu.config import ALConfig
+from consensus_entropy_tpu.labels import one_hot_np
+from consensus_entropy_tpu.parallel import multihost
+from consensus_entropy_tpu.utils.profiling import StepTimer
+
+
+@dataclasses.dataclass
+class ScoreStep:
+    """Request: run ``session.acq``'s staged scoring call.
+
+    ``fn_key``/``inputs`` come from ``Acquirer.scoring_inputs``; the
+    servicer must answer with the resulting ``ScoreResult`` (single-user
+    ``acq.run_scoring(fn_key, inputs)``, or one row of a vmapped batch)."""
+
+    session: "UserSession"
+    fn_key: str
+    inputs: tuple
+
+
+@dataclasses.dataclass
+class HostStep:
+    """Request: call ``fn()`` (pure host work — no jax) and answer with its
+    return value.  ``label`` names the phase for scheduler telemetry."""
+
+    session: "UserSession"
+    fn: Callable
+    label: str = ""
+
+
+def drive_inline(session: "UserSession") -> dict:
+    """Service a session synchronously — the sequential execution of
+    ``run_user``: every ``HostStep`` runs inline, every ``ScoreStep`` goes
+    through the session's own single-user jitted fns.
+
+    A servicer failure is THROWN INTO the generator (exactly as the fleet
+    scheduler does for worker errors), so the session's own error path
+    runs — checkpointer joined+closed, report closed — before the error
+    propagates.  Without that, the suspended generator would keep the
+    pending background commit alive past the caller's except handler (the
+    traceback pins the frame), racing a subsequent resume's workspace
+    recovery.  ``finally: close()`` covers servicers raising through
+    ``gen.throw`` handlers and any future driver refactors."""
+    gen = session.steps()
+    try:
+        step = next(gen)
+        while True:
+            try:
+                if isinstance(step, ScoreStep):
+                    value = step.session.acq.run_scoring(step.fn_key,
+                                                         step.inputs)
+                else:
+                    value = step.fn()
+            except BaseException as e:
+                step = gen.throw(e)
+            else:
+                step = gen.send(value)
+    except StopIteration as stop:
+        return stop.value
+    finally:
+        gen.close()
+
+
+class UserSession:
+    """One user's AL run, initialized exactly as ``run_user`` would.
+
+    Construction performs the resume-state load, split rebuild, acquirer
+    setup and checkpoint plumbing; :meth:`steps` is the iteration
+    generator.  ``ckpt_executor``: optional shared ``ThreadPoolExecutor``
+    backing this session's ``AsyncCheckpointer`` — the fleet passes one
+    bounded pool so N concurrent sessions get overlapping checkpoint I/O
+    with per-session ordering (see ``AsyncCheckpointer``)."""
+
+    def __init__(self, config: ALConfig, committee, data, user_path: str, *,
+                 seed: int | None = None, tie_break: str = "fast",
+                 retrain_epochs: int | None = None, mesh=None,
+                 pad_pool_to: int | None = None, resume: bool = True,
+                 timer: StepTimer | None = None, preemption=None,
+                 ckpt_executor=None):
+        from consensus_entropy_tpu.al.loop import AsyncCheckpointer
+
+        cfg = config
+        self.config = cfg
+        self.committee = committee
+        self.data = data
+        self.user_path = user_path
+        self.seed = cfg.seed if seed is None else seed
+        self.timer = timer or StepTimer(None)
+        self.preemption = preemption
+        self.retrain_epochs = retrain_epochs
+        self.mesh = mesh
+        self.result: dict | None = None
+        # the config's survivor floor never weakens a stricter committee
+        committee.min_members = max(committee.min_members, cfg.min_members)
+
+        st = al_state.ALState.load(user_path) if resume else None
+        if st is not None and not st.matches(
+                mode=cfg.mode, seed=self.seed, queries=cfg.queries,
+                train_size=cfg.train_size):
+            # Fail loud: the workspace holds a committee trained under a
+            # different experiment definition — silently "starting clean"
+            # would contaminate the run (workspace.create_user wipes such
+            # directories when given the experiment parameters).
+            raise ValueError(
+                f"{user_path} holds resume state for a different experiment "
+                f"(mode={st.mode} seed={st.seed} q={st.queries} "
+                f"train_size={st.train_size}); delete the directory or pass "
+                "the experiment to workspace.create_user")
+        self._fresh = st is None
+        if st is not None:
+            self.split = self._rebuild_split(data, st)
+            self.key = st.unpack_key()
+            self.trajectory = list(st.trajectory)
+            self.queried_hist = [al_state.remap_songs(b, data.pool.song_ids)
+                                 for b in st.queried]
+            self.start_epoch = st.next_epoch
+        else:
+            from consensus_entropy_tpu.al.loop import grouped_split
+
+            rng = np.random.default_rng(self.seed)
+            self.key = jax.random.key(self.seed)
+            self.split = grouped_split(data.pool, data.labels,
+                                       cfg.train_size, rng)
+            self.trajectory = []
+            self.queried_hist = []
+            self.start_epoch = 0
+
+        hc_rows = None
+        if data.hc_rows is not None:
+            row_of = {s: i for i, s in enumerate(data.pool.song_ids)}
+            hc_rows = np.asarray(data.hc_rows)[
+                [row_of[s] for s in self.split.train_songs]]
+        self.acq = Acquirer(self.split.train_songs, hc_rows,
+                            queries=cfg.queries, mode=cfg.mode,
+                            tie_break=tie_break, seed=self.seed, mesh=mesh,
+                            pad_to=pad_pool_to)
+        self.acq.replay(self.queried_hist)
+
+        self.ckpt = AsyncCheckpointer(executor=ckpt_executor)
+        #: last finished background job's self-timed durations (fetch/write)
+        self.bg_times: dict = {}
+        #: host steps may run on fleet worker threads only when the whole
+        #: block is guaranteed jax-free: no CNN members, no device-resident
+        #: GNB/SGD inference, no mesh feeds
+        self.host_offloadable = (not committee.cnn_members
+                                 and not committee.device_members
+                                 and mesh is None)
+
+    @staticmethod
+    def _rebuild_split(data, st: al_state.ALState):
+        """Reconstruct SplitData from a resume state's stored song lists."""
+        from consensus_entropy_tpu.al.loop import split_from_songs
+
+        return split_from_songs(
+            data.pool, data.labels,
+            al_state.remap_songs(st.train_songs, data.pool.song_ids),
+            al_state.remap_songs(st.test_songs, data.pool.song_ids))
+
+    def _evaluate(self, report: UserReport, key) -> list[float]:
+        """Evaluate every ACTIVE member on the user's test set; returns F1
+        list in committee order (CNN members first, as ``member_names``).
+        A member that fails here — predict raises, or its probabilities go
+        non-finite — is quarantined and dropped from the mean, so one
+        degenerate member can't sink the trajectory or kill the user."""
+        committee, split = self.committee, self.split
+        f1s = []
+        cnns = committee.active_cnn_members
+        if cnns:
+            probs = np.asarray(committee.predict_songs_cnn(
+                self.data.store, split.test_songs, key))
+            for m, p in zip(cnns, probs):
+                if not np.all(np.isfinite(p)):
+                    committee.quarantine(
+                        m.name, "non-finite eval probabilities")
+                    continue
+                y_pred = p.argmax(axis=1)
+                f1s.append(report.model_eval(m.name, split.y_test_songs,
+                                             y_pred))
+        for m in committee.active_host_members:
+            try:
+                y_pred = m.predict(split.X_test)
+            except Exception as e:
+                committee.quarantine(m.name, f"eval predict failed: {e!r}")
+                continue
+            f1s.append(report.model_eval(m.name, split.y_test_frames, y_pred))
+        return f1s
+
+    def _checkpoint(self, next_epoch: int, current_key) -> None:
+        """Two-phase commit: stage members -> state write (commit point)
+        -> promote.  A kill anywhere leaves (committee, state) pairs
+        consistent (al_state.recover_workspace).  Multi-host: only the
+        coordinator touches the workspace (every process carries the
+        same in-memory committee, so nothing is lost).
+
+        The mutable state is SNAPSHOT here (host members written, CNN
+        variables fetched, state fields copied); serialization + disk
+        writes + promote then run on the checkpointer thread, hidden
+        behind the next iteration's compute.
+        """
+        if not multihost.is_coordinator():
+            return
+        cfg, committee, split = self.config, self.committee, self.split
+        user_path = self.user_path
+        # Join the PREVIOUS commit before staging the next generation:
+        # its recover_workspace prunes staging dirs of other
+        # generations, so staging concurrently would let it rmtree the
+        # dir being written (submit() also joins, but only AFTER
+        # begin_save — too late).
+        self.ckpt.wait()
+        finish_members = committee.begin_save(
+            al_state.staging_dir(user_path, next_epoch),
+            reuse_dir=user_path, dtype=cfg.ckpt_dtype)
+        kd, kdt = al_state.ALState.pack_key(current_key)
+        state_obj = al_state.ALState(
+            next_epoch=next_epoch, trajectory=list(self.trajectory),
+            train_songs=[al_state.song_key(s)
+                         for s in split.train_songs],
+            test_songs=[al_state.song_key(s) for s in split.test_songs],
+            queried=[[al_state.song_key(s) for s in b]
+                     for b in self.queried_hist],
+            key_data=kd, key_dtype=kdt, mode=cfg.mode, seed=self.seed,
+            queries=cfg.queries, train_size=cfg.train_size,
+        )
+        bg_times = self.bg_times
+
+        def commit():
+            import time
+
+            bg = finish_members() or {}
+            t0 = time.perf_counter()
+            state_obj.save(user_path)  # the commit point
+            al_state.recover_workspace(user_path)  # promote the stage
+            bg["commit_s"] = time.perf_counter() - t0
+            bg_times.update(bg)
+
+        self.ckpt.submit(commit)
+
+    def _join_and_drain(self) -> dict:
+        """Join the previous iteration's background checkpoint job in
+        its OWN timed phase, then surface that job's self-timed
+        durations as ``ckpt_bg_*`` entries.  ``ckpt_join`` is the only
+        part that adds to this iteration's wall-clock; the ``ckpt_bg``
+        phases ran on the checkpointer thread OVERLAPPING the previous
+        iteration's compute (on a thin d2h link they contend with it)
+        and must not be summed into iteration totals.  The bg numbers
+        describe the job SUBMITTED by the previous flush's record —
+        a one-record offset, noted here rather than hidden."""
+        with self.timer.phase("ckpt_join"):
+            self.ckpt.wait()
+        labels = {}
+        if self.bg_times:
+            for k in ("fetch", "write", "commit"):
+                if f"{k}_s" in self.bg_times:
+                    self.timer.add(f"ckpt_bg_{k}",
+                                   self.bg_times.pop(f"{k}_s"))
+            if "n_members_fetched" in self.bg_times:
+                labels["ckpt_members_fetched"] = \
+                    self.bg_times.pop("n_members_fetched")
+        return labels
+
+    def _preempt_check(self, boundary: str) -> None:
+        """Iteration-boundary preemption check.  The flag is agreed
+        across processes (broadcast_flag) so every host leaves the
+        collective program at the same boundary, and the in-flight
+        two-phase commit is joined first — the handoff leaves the
+        workspace durable and resumable, which is what separates
+        ``Preempted`` (exit EXIT_PREEMPTED, reschedule) from a crash."""
+        from consensus_entropy_tpu.resilience.preemption import Preempted
+
+        if self.preemption is not None and multihost.broadcast_flag(
+                bool(self.preemption.requested)):
+            self.ckpt.wait()
+            raise Preempted(
+                f"preempted after {boundary}; workspace committed — "
+                "rerun to resume at the next iteration")
+
+    def steps(self):
+        """The iteration generator (see module docstring for the protocol).
+        Returns the ``run_user`` result dict via ``StopIteration.value``."""
+        from consensus_entropy_tpu.resilience import faults
+        from consensus_entropy_tpu.resilience.retry import retry_transient
+
+        cfg, committee, data = self.config, self.committee, self.data
+        split, acq, timer = self.split, self.acq, self.timer
+        trajectory, queried_hist = self.trajectory, self.queried_hist
+        seed = self.seed
+
+        # AsyncCheckpointer as context manager: on the success path close
+        # surfaces any deferred write error before the caller reads the
+        # workspace (mark_done, resume, final save); on the error path it
+        # is best-effort so the worker thread and pending future are
+        # released without masking the loop's own error.  A scheduler that
+        # abandons the generator (eviction / preemption of a peer) closes
+        # it, which exits this block on the GeneratorExit path.
+        with self.ckpt, UserReport(
+                self.user_path, cfg.mode,
+                write=multihost.is_coordinator()) as report:
+            #: host members' F1s from the LAST evaluation on the gating
+            #: split — reused as the gate's before-scores (same split,
+            #: same metric, member state unchanged between an epoch's
+            #: evaluate and the next epoch's update); None forces the
+            #: gate to compute them (resume, or gating disabled)
+            last_host_f1s = None
+
+            def drain_events(epoch: int) -> list:
+                """Forward quarantine events into the per-user report.
+                Returns them so callers can invalidate anything aligned
+                with the pre-quarantine member list."""
+                events = committee.drain_quarantine_events()
+                for ev in events:
+                    report.quarantine_event(epoch, ev)
+                return events
+
+            if self._fresh:
+                # epoch 0: baseline evaluation (amg_test.py:398-418)
+                report.epoch_header(-1)
+                self.key, sub = jax.random.split(self.key)
+
+                def baseline(sub=sub):
+                    with timer.phase("evaluate"):
+                        f1s = self._evaluate(report, sub)
+                    if drain_events(-1):
+                        f1_prev = None  # member set shifted mid-eval
+                    else:
+                        f1_prev = f1s[len(committee.active_cnn_members):]
+                    report.epoch_summary(-1, f1s)
+                    trajectory.append(float(np.mean(f1s)))
+                    return f1_prev
+
+                if self.host_offloadable:
+                    last_host_f1s = yield HostStep(self, baseline,
+                                                   "baseline")
+                else:
+                    last_host_f1s = baseline()
+
+                def boundary0():
+                    labels = self._join_and_drain()
+                    with timer.phase("checkpoint"):
+                        self._checkpoint(0, self.key)
+                    timer.flush(user=str(data.user_id), epoch=-1, **labels)
+
+                # the iteration boundary (previous-commit join + checkpoint
+                # staging + pickle writes) is pure host work: offloading it
+                # keeps a slow join/commit from stalling the scheduler's
+                # main thread — and with it every other session
+                if self.host_offloadable:
+                    yield HostStep(self, boundary0, "checkpoint")
+                else:
+                    boundary0()
+                self._preempt_check("baseline evaluation")
+
+            for epoch in range(self.start_epoch, cfg.epochs):
+                report.epoch_header(epoch)
+                live = acq.remaining_songs
+                if len(live) == 0:
+                    break
+                member_probs = None
+                if cfg.mode in ("mc", "mix"):
+                    self.key, sub = jax.random.split(self.key)
+
+                    def score(sub=sub, live=live):
+                        # stays a device array end-to-end: the acquirer
+                        # scatters it into its persistent padded buffer
+                        # (no host round-trip of the probs table), staged
+                        # at the fixed bucket width so the chain compiles
+                        # once per bucket, not once per live-width.
+                        # Scoring is pure (committee state is read-only
+                        # and the crop key is fixed), so a transient
+                        # device/RPC error retries the identical pass.
+                        with timer.phase("score"):
+                            return retry_transient(
+                                lambda: faults.fire(
+                                    "pool.score",
+                                    payload=committee.pool_probs(
+                                        data.pool, data.store, live, sub,
+                                        pad_to=acq.staging_width(
+                                            len(live)))),
+                                attempts=cfg.retry_attempts,
+                                base_delay=cfg.retry_base_delay,
+                                seed=seed + epoch, what="pool.score")
+
+                    if self.host_offloadable:
+                        member_probs = yield HostStep(self, score, "score")
+                    else:
+                        member_probs = score()
+                self.key, sub = jax.random.split(self.key)
+                with timer.phase("select"):
+                    fn_key, inputs = acq.scoring_inputs(member_probs,
+                                                        rand_key=sub)
+                    res = yield ScoreStep(self, fn_key, inputs)
+                    q_songs = acq.finish_select(res)
+
+                def update_and_eval(epoch=epoch, q_songs=q_songs,
+                                    before=last_host_f1s):
+                    from consensus_entropy_tpu.al.loop import query_batch
+
+                    # reveal labels; build the frame batch (amg_test.py:
+                    # 491-493)
+                    X_batch, y_batch = query_batch(data.pool, data.labels,
+                                                   q_songs)
+                    with timer.phase("update_host"):
+                        if cfg.gate_host_updates and len(split.X_test):
+                            committee.update_host_gated(
+                                X_batch, y_batch, split.X_test,
+                                split.y_test_frames, before_scores=before)
+                        else:
+                            committee.update_host(X_batch, y_batch)
+                    if committee.active_cnn_members:
+                        y_q = one_hot_np([data.labels[s] for s in q_songs])
+                        y_t = one_hot_np(split.y_test_songs)
+                        self.key, sub = jax.random.split(self.key)
+                        with timer.phase("retrain_cnn"):
+                            # fit_many rebinds member variables only on
+                            # return, so a transient failure mid-fit left no
+                            # partial mutation and the retry replays the
+                            # identical fit
+                            retry_transient(
+                                lambda sub=sub, y_q=y_q, y_t=y_t:
+                                committee.retrain_cnns(
+                                    data.store, q_songs, y_q,
+                                    split.test_songs, y_t, sub,
+                                    n_epochs=self.retrain_epochs),
+                                attempts=cfg.retry_attempts,
+                                base_delay=cfg.retry_base_delay,
+                                seed=seed + 7919 * (epoch + 1),
+                                what="member.retrain")
+                    self.key, sub = jax.random.split(self.key)
+                    with timer.phase("evaluate"):
+                        f1s = self._evaluate(report, sub)
+                    if drain_events(epoch):
+                        f1_prev = None  # member set shifted mid-iteration
+                    else:
+                        f1_prev = f1s[len(committee.active_cnn_members):]
+                    report.epoch_summary(epoch, f1s, queried=q_songs,
+                                         pool_size=len(acq.remaining_songs))
+                    trajectory.append(float(np.mean(f1s)))
+                    return f1_prev
+
+                if self.host_offloadable:
+                    last_host_f1s = yield HostStep(self, update_and_eval,
+                                                   "update_eval")
+                else:
+                    last_host_f1s = update_and_eval()
+
+                # per-iteration persistence (amg_test.py:511) + resume state
+                queried_hist.append(q_songs)
+
+                def boundary(epoch=epoch, q_songs=q_songs):
+                    labels = self._join_and_drain()
+                    with timer.phase("checkpoint"):
+                        self._checkpoint(epoch + 1, self.key)
+                    timer.flush(user=str(data.user_id), epoch=epoch,
+                                queried=len(q_songs), **labels)
+
+                if self.host_offloadable:  # see boundary0 above
+                    yield HostStep(self, boundary, "checkpoint")
+                else:
+                    boundary()
+                self._preempt_check(f"iteration {epoch}")
+
+            result = {"user": data.user_id, "mode": cfg.mode,
+                      "trajectory": trajectory,
+                      "final_mean_f1": trajectory[-1] if trajectory
+                      else None}
+        # every write is durable here; the barrier keeps non-coordinators
+        # from reading the workspace before the coordinator's last commit
+        multihost.sync(f"run_user_done_{data.user_id}")
+        self.result = result
+        return result

@@ -7,5 +7,6 @@ from consensus_entropy_tpu.ops.scoring import (  # noqa: F401
     score_hc,
     score_mc,
     score_mix,
+    make_fleet_scoring_fns,
     make_scoring_fns,
 )

@@ -171,3 +171,80 @@ def _make_scoring_fns_cached(k: int, tie_break: str) -> dict[str, Callable]:
     mix = jax.jit(functools.partial(score_mix, k=k, tie_break=tie_break))
     rand = jax.jit(functools.partial(score_rand, k=k))
     return {"mc": mc, "hc": hc, "hc_pre": hc_pre, "mix": mix, "rand": rand}
+
+
+def make_fleet_scoring_fns(*, k: int,
+                           tie_break: str = "fast") -> dict[str, Callable]:
+    """Cross-user batched variants of the acquisition scorers.
+
+    Each fn is ``jax.jit(jax.vmap(score_*))`` over a leading USER axis: one
+    device round-trip scores a whole cohort of same-shaped user pools
+    (``fleet.scheduler`` stacks per-user pool tables / masks / HC tables and
+    dispatches once per phase-aligned batch).  Input shapes gain a leading
+    ``U``: mc ``(U, M, N, C), (U, N)``; hc/hc_pre ``(U, N[, C]), (U, N)``;
+    mix ``(U, M, N, C), (U, N), (U, N, C), (U, N)``; rand ``(U,) keys
+    (see :func:`stack_user_keys`), (U, N)``.  The ``*_masked`` variants
+    additionally take a per-user ``(U, M)`` member mask for fixed-``M``
+    cohorts with quarantined members.
+
+    Parity contract (pinned by ``tests/test_fleet_scoring.py``): every row
+    of the batched result is BIT-IDENTICAL to the jitted single-user fn
+    from :func:`make_scoring_fns` on that user's inputs — the scoring math
+    is row-local, so vmap only changes the dispatch granularity.  rand
+    relies on ``jax_threefry_partitionable`` (checked at the committee's
+    crop buckets too) for per-key draws that are independent of batching.
+
+    Same ``lru_cache`` rationale as :func:`make_scoring_fns`: one compiled
+    graph per (k, tie_break) process-wide; callers must not mutate the
+    returned dict.
+    """
+    return _make_fleet_scoring_fns_cached(k, tie_break)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_fleet_scoring_fns_cached(k: int, tie_break: str) -> dict[str, Callable]:
+    def _mc(probs, pool_mask):
+        return score_mc(probs, pool_mask, k=k, tie_break=tie_break)
+
+    def _mc_masked(probs, pool_mask, member_mask):
+        return score_mc(probs, pool_mask, k=k, member_mask=member_mask,
+                        tie_break=tie_break)
+
+    def _hc(hc_freq, hc_mask):
+        return score_hc(hc_freq, hc_mask, k=k, tie_break=tie_break)
+
+    def _hc_pre(hc_ent, hc_mask):
+        return score_hc_precomputed(hc_ent, hc_mask, k=k, tie_break=tie_break)
+
+    def _mix(probs, pool_mask, hc_freq, hc_mask):
+        return score_mix(probs, pool_mask, hc_freq, hc_mask, k=k,
+                         tie_break=tie_break)
+
+    def _mix_masked(probs, pool_mask, hc_freq, hc_mask, member_mask):
+        return score_mix(probs, pool_mask, hc_freq, hc_mask, k=k,
+                         member_mask=member_mask, tie_break=tie_break)
+
+    def _rand(key, pool_mask):
+        return score_rand(key, pool_mask, k=k)
+
+    def vj(fn):
+        return jax.jit(jax.vmap(fn))
+
+    return {"mc": vj(_mc), "mc_masked": vj(_mc_masked), "hc": vj(_hc),
+            "hc_pre": vj(_hc_pre), "mix": vj(_mix),
+            "mix_masked": vj(_mix_masked), "rand": vj(_rand)}
+
+
+def stack_user_keys(keys) -> jax.Array:
+    """Stack per-user typed PRNG keys into one batched key array for the
+    fleet ``rand`` scorer (typed keys cannot be ``jnp.stack``'d directly on
+    every jax version; round-tripping through key data is the portable
+    spelling)."""
+    data = jnp.stack([jnp.asarray(jax.random.key_data(k)) for k in keys])
+    return jax.random.wrap_key_data(data)
+
+
+def is_key_array(x) -> bool:
+    """True for typed PRNG key arrays (the fleet batcher dispatches them to
+    :func:`stack_user_keys` instead of ``jnp.stack``)."""
+    return isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jax.dtypes.prng_key)

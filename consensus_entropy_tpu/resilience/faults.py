@@ -76,10 +76,13 @@ class InjectedKill(BaseException):
 class FaultRule:
     """Fire ``action`` at hits ``[at, at + times)`` of ``point``.
 
-    ``at`` is 1-based over the per-point hit counter; ``times=-1`` fires
-    forever from ``at`` on.  ``member`` restricts the rule to fault-point
-    invocations carrying that ``member=`` context (per-member targeting for
-    quarantine tests)."""
+    ``at`` is 1-based over the hit counter; ``times=-1`` fires forever from
+    ``at`` on.  ``member`` restricts the rule to fault-point invocations
+    carrying that ``member=`` context (per-member targeting for quarantine
+    and fleet-eviction tests) — and the rule then counts hits on the
+    (point, member) pair, not the global point, so ``at=2`` means "that
+    member's second hit" regardless of how many other members (or other
+    users' committees, in a fleet cohort) hit the point in between."""
 
     point: str
     action: str
@@ -130,6 +133,9 @@ class FaultInjector:
         self.rules = [r if isinstance(r, FaultRule) else FaultRule(**r)
                       for r in rules]
         self.hits: dict[str, int] = {}
+        #: (point, member) hit counters — member-filtered rules index these
+        #: so their ``at`` window is stable under fleet interleaving
+        self.member_hits: dict[tuple, int] = {}
         self.fired: list[dict] = []  # (point, action, hit) audit trail
         self.rng = np.random.default_rng(seed)
         self._lock = threading.Lock()
@@ -138,8 +144,13 @@ class FaultInjector:
         with self._lock:
             hit = self.hits.get(point, 0) + 1
             self.hits[point] = hit
-            todo = [r for r in self.rules
-                    if r.point == point and r.matches(hit, ctx)]
+            mhit = None
+            if "member" in ctx:
+                mkey = (point, ctx["member"])
+                mhit = self.member_hits.get(mkey, 0) + 1
+                self.member_hits[mkey] = mhit
+            todo = [r for r in self.rules if r.point == point
+                    and r.matches(hit if r.member is None else mhit, ctx)]
             for r in todo:
                 self.fired.append({"point": point, "action": r.action,
                                    "hit": hit, **ctx})

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Fleet engine throughput sweep (ISSUE 2 acceptance: >1.5x users/sec vs
+# the sequential loop on the synthetic workload).
+#
+# Runs `bench.py --suite fleet`: N concurrent AL sessions through
+# fleet.FleetScheduler — one vmapped scoring dispatch per phase-aligned
+# cohort, host sklearn retraining on a bounded worker pool — against the
+# sequential ALLoop.run_user baseline over the identical users and seeds.
+# Parity with the sequential trajectories is asserted inside the suite, so
+# the reported speedup is for bit-identical results.
+#
+# The JSON line goes to stdout (redirect to BENCH_fleet_r<N>.json to
+# commit an artifact); the per-cohort log goes to stderr.  Extra bench
+# args pass through, e.g.:
+#   scripts/fleet_bench.sh --users 8 --pool 600 --fleet 2 4 8
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+if [ "$#" -gt 0 ]; then
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --suite fleet "$@"
+else
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --suite fleet \
+        --users 6 --pool 400 --fleet 2 6
+fi

@@ -1,0 +1,348 @@
+"""Fleet scheduler: interleaved multi-user runs vs sequential ground truth.
+
+Tier-1 (un-marked) keeps only the 2-user scheduler smoke and the shared
+checkpointer units, per the tier-1 budget; the full mode matrix, the
+eviction+resume drill and the 4-user acceptance run are ``slow``
+(``scripts/fleet_bench.sh`` exercises throughput).
+
+Trajectory equality is exact (``==`` on float lists): the fleet drives the
+SAME session generator as ``ALLoop.run_user`` and the batched scorers are
+bit-identical to the single-user jitted fns, so there is no tolerance to
+grant.  ``ckpt_dtype="float32"`` keeps resume-after-eviction bit-exact too.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from consensus_entropy_tpu.al import workspace
+from consensus_entropy_tpu.al.loop import ALLoop, AsyncCheckpointer, UserData
+from consensus_entropy_tpu.config import ALConfig
+from consensus_entropy_tpu.fleet import FleetReport, FleetScheduler, FleetUser
+from consensus_entropy_tpu.models.committee import Committee, FramePool
+from consensus_entropy_tpu.models.sklearn_members import GNBMember, SGDMember
+from consensus_entropy_tpu.resilience import faults
+from consensus_entropy_tpu.resilience.faults import FaultRule
+
+pytestmark = pytest.mark.fleet
+
+
+def _user_data(seed, uid, n_songs=30, f=10):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((4, f)).astype(np.float32) * 2.5
+    rows, sids, labels = [], [], {}
+    for i in range(n_songs):
+        sid = f"song{i:03d}"
+        c = int(rng.integers(0, 4))
+        labels[sid] = c
+        k = int(rng.integers(3, 7))
+        rows.append(centers[c]
+                    + rng.standard_normal((k, f)).astype(np.float32))
+        sids += [sid] * k
+    pool = FramePool(np.vstack(rows), sids)
+    counts = rng.integers(1, 30, size=(n_songs, 4))
+    hc = np.round(counts / counts.sum(1, keepdims=True),
+                  3).astype(np.float32)
+    return UserData(uid, pool, labels, hc_rows=hc)
+
+
+def _committee(data, *, sgd_name="sgd.it_0", min_members=1):
+    X = data.pool.X
+    y = np.array([data.labels[s] for s in np.repeat(
+        data.pool.song_ids, data.pool.counts)], np.int32)
+    return Committee([GNBMember("gnb.it_0").fit(X, y),
+                      SGDMember(sgd_name, seed=0).fit(X, y)], [],
+                     min_members=min_members)
+
+
+def _cfg(mode="mc", epochs=2, queries=4):
+    # float32 checkpoints: resume (and resume-after-eviction) replays
+    # bit-exactly, so faulted trajectories can be compared with ==
+    return ALConfig(queries=queries, epochs=epochs, mode=mode, seed=7,
+                    ckpt_dtype="float32")
+
+
+def _run_pair(tmp_path, cfg, n_users, *, committee_fn=_committee,
+              scheduler_kw=None, data_fn=_user_data):
+    """Sequential baselines + a fleet cohort over identical inputs.
+    Returns (sequential results, fleet records, scheduler)."""
+    seq, entries = [], []
+    for i in range(n_users):
+        data = data_fn(100 + i, f"u{i}")
+        p = tmp_path / f"seq_u{i}"
+        p.mkdir()
+        seq.append(ALLoop(cfg).run_user(committee_fn(data), data, str(p)))
+        fp = tmp_path / f"fleet_u{i}"
+        fp.mkdir()
+        entries.append(FleetUser(
+            f"u{i}", committee_fn(data), data, str(fp), seed=cfg.seed,
+            committee_factory=lambda fp=fp: workspace.load_committee(
+                str(fp))))
+    sched = FleetScheduler(cfg, **(scheduler_kw or {}))
+    recs = sched.run(entries)
+    return seq, recs, sched
+
+
+def test_fleet_two_user_smoke_matches_sequential(tmp_path):
+    """2-user cohort: per-user trajectories identical to two sequential
+    ``run_user`` runs; cohort telemetry lands in the fleet metrics.jsonl."""
+    cfg = _cfg(mode="mc", epochs=2)
+    jsonl = tmp_path / "fleet_metrics.jsonl"
+    seq, recs, sched = _run_pair(
+        tmp_path, cfg, 2,
+        scheduler_kw={"report": FleetReport(str(jsonl))})
+    for s, r in zip(seq, recs):
+        assert r["error"] is None
+        assert r["result"]["trajectory"] == s["trajectory"]
+    summary = sched.report.write_summary(cohort=2)
+    assert summary["users_done"] == 2 and summary["users_failed"] == 0
+    assert summary["score_dispatches"] >= cfg.epochs  # scoring happened
+    assert 0 < summary["occupancy"] <= 1.0
+    assert summary["users_per_sec"] > 0
+    assert set(summary["phase_wall_s"]) >= {"select_s", "update_host_s",
+                                            "evaluate_s"}
+    events = [json.loads(l) for l in open(jsonl)]
+    assert any(e["event"] == "user_done" for e in events)
+    assert events[-1]["event"] == "fleet_summary"
+    # per-user surfaces unchanged: workspace state + reports exist
+    for i in range(2):
+        d = str(tmp_path / f"fleet_u{i}")
+        assert os.path.exists(os.path.join(d, "al_state.json"))
+        assert os.path.exists(os.path.join(d, "metrics.jsonl"))
+        assert os.path.exists(os.path.join(d, "timings.jsonl"))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["mc", "hc", "mix", "rand"])
+def test_fleet_matches_sequential_all_modes(tmp_path, mode):
+    cfg = _cfg(mode=mode, epochs=3)
+    seq, recs, _ = _run_pair(tmp_path, cfg, 3)
+    for s, r in zip(seq, recs):
+        assert r["error"] is None
+        assert r["result"]["trajectory"] == s["trajectory"]
+
+
+@pytest.mark.slow
+def test_fleet_four_user_acceptance(tmp_path):
+    """Acceptance: a 4-user fleet on CPU-virtual devices produces per-user
+    results identical to four sequential ``run_user`` runs (same seeds),
+    with genuinely batched device dispatches."""
+    cfg = _cfg(mode="mc", epochs=3, queries=5)
+    seq, recs, sched = _run_pair(tmp_path, cfg, 4)
+    for s, r in zip(seq, recs):
+        assert r["error"] is None
+        assert r["result"]["trajectory"] == s["trajectory"]
+    summary = sched.report.summary(cohort=4)
+    assert summary["mean_device_batch"] > 1.0  # cross-user batching engaged
+    # per-user metrics.jsonl matches the sequential run's records exactly
+    for i in range(4):
+        seq_recs = [json.loads(l) for l in
+                    open(tmp_path / f"seq_u{i}" / "metrics.jsonl")]
+        fleet_recs = [json.loads(l) for l in
+                      open(tmp_path / f"fleet_u{i}" / "metrics.jsonl")]
+        assert fleet_recs == seq_recs
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_fleet_eviction_and_resume(tmp_path):
+    """One user's committee exhausts mid-run (injected member failure under
+    a min_members=2 floor): that session is evicted, resumed from its
+    workspace, and every user — including the faulted one — finishes with
+    the sequential unfaulted trajectory; the cohort never stalls."""
+    cfg = _cfg(mode="mc", epochs=3)
+
+    def committee_fn(data):
+        if data.user_id == "u1":  # the victim: uniquely-named member
+            return _committee(data, sgd_name="sgd.victim", min_members=2)
+        return _committee(data)
+
+    seq, entries = [], []
+    for i in range(3):  # unfaulted sequential ground truth
+        data = _user_data(100 + i, f"u{i}")
+        sp = tmp_path / f"seq_u{i}"
+        sp.mkdir()
+        seq.append(ALLoop(cfg).run_user(committee_fn(data), data, str(sp)))
+        fp = tmp_path / f"fleet_u{i}"
+        fp.mkdir()
+        entries.append(FleetUser(
+            f"u{i}", committee_fn(data), data, str(fp), seed=cfg.seed,
+            committee_factory=lambda fp=fp: workspace.load_committee(
+                str(fp))))
+    jsonl = tmp_path / "fleet_metrics.jsonl"
+    sched = FleetScheduler(cfg, report=FleetReport(str(jsonl)))
+    # member-filtered rules count per-(point, member) hits: this fires on
+    # the victim's FIRST retrain only, so the resumed session runs clean
+    with faults.inject(FaultRule("member.retrain", "raise", at=1,
+                                 member="sgd.victim")) as inj:
+        recs = sched.run(entries)
+    assert inj.fired, "the victim member's retrain fault never fired"
+    events = [json.loads(l) for l in open(jsonl)]
+    assert [e["user"] for e in events if e["event"] == "evict"] == ["u1"]
+    assert [e["user"] for e in events if e["event"] == "resume"] == ["u1"]
+    for s, r in zip(seq, recs):
+        assert r["error"] is None, r
+        assert r["result"]["trajectory"] == s["trajectory"]
+    assert recs[1]["resumes"] == 1
+    assert sched.report.users_failed == 0
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_fleet_eviction_without_factory_fails_only_that_user(tmp_path):
+    cfg = _cfg(mode="mc", epochs=2)
+    entries, seq = [], []
+    for i in range(2):
+        data = _user_data(100 + i, f"u{i}")
+        committee = (_committee(data, sgd_name="sgd.victim", min_members=2)
+                     if i == 0 else _committee(data))
+        p = tmp_path / f"fleet_u{i}"
+        p.mkdir()
+        entries.append(FleetUser(f"u{i}", committee, data, str(p),
+                                 seed=cfg.seed))  # no committee_factory
+        sp = tmp_path / f"seq_u{i}"
+        sp.mkdir()
+        seq.append(ALLoop(cfg).run_user(_committee(data), data, str(sp)))
+    with faults.inject(FaultRule("member.retrain", "raise", at=1,
+                                 member="sgd.victim")) as inj:
+        recs = FleetScheduler(cfg).run(entries)
+    assert inj.fired
+    assert recs[0]["error"] is not None and recs[0]["result"] is None
+    assert recs[1]["error"] is None
+    assert recs[1]["result"]["trajectory"] == seq[1]["trajectory"]
+
+
+@pytest.mark.slow
+def test_fleet_preemption_leaves_all_workspaces_resumable(tmp_path):
+    """A preemption request stops the WHOLE fleet at iteration boundaries;
+    every workspace ends durable, and a rerun completes each user to the
+    sequential trajectory."""
+    from consensus_entropy_tpu.resilience.preemption import Preempted
+
+    class CountingGuard:
+        def __init__(self, after):
+            self.checks, self.after = 0, after
+
+        @property
+        def requested(self):
+            self.checks += 1
+            return self.checks > self.after
+
+    cfg = _cfg(mode="mc", epochs=3)
+    seq, entries = [], []
+    for i in range(2):
+        data = _user_data(100 + i, f"u{i}")
+        sp = tmp_path / f"seq_u{i}"
+        sp.mkdir()
+        seq.append(ALLoop(cfg).run_user(_committee(data), data, str(sp)))
+        fp = tmp_path / f"fleet_u{i}"
+        fp.mkdir()
+        entries.append(FleetUser(f"u{i}", _committee(data), data, str(fp),
+                                 seed=cfg.seed))
+    with pytest.raises(Preempted):
+        FleetScheduler(cfg, preemption=CountingGuard(2)).run(entries)
+    # rerun: resumed sessions complete to the sequential trajectories
+    entries2 = [FleetUser(e.user_id, workspace.load_committee(e.user_path),
+                          e.data, e.user_path, seed=cfg.seed)
+                for e in entries]
+    recs = FleetScheduler(cfg).run(entries2)
+    for s, r in zip(seq, recs):
+        assert r["error"] is None
+        assert r["result"]["trajectory"] == s["trajectory"]
+
+
+@pytest.mark.slow
+def test_fleet_cnn_committee_matches_sequential(tmp_path, rng):
+    """Device committees ride the fleet too: CNN members' stacked-variable
+    scoring/retraining runs inline on the scheduler thread (jax stays on
+    the main thread), only the acquisition scoring batches across users —
+    and the per-user trajectories still match the sequential run exactly."""
+    import jax
+
+    from consensus_entropy_tpu.config import CNNConfig, TrainConfig
+    from consensus_entropy_tpu.data.audio import DeviceWaveformStore
+    from consensus_entropy_tpu.models import short_cnn
+    from consensus_entropy_tpu.models.committee import CNNMember
+
+    tiny = CNNConfig(n_channels=4, n_mels=32, n_layers=5, input_length=8192)
+    tc = TrainConfig(batch_size=2)
+
+    def data_fn(seed, uid):
+        data = _user_data(seed, uid, n_songs=10)
+        wrng = np.random.default_rng(seed + 7)
+        waves = {s: wrng.standard_normal(9000).astype(np.float32)
+                 for s in data.pool.song_ids}
+        data.store = DeviceWaveformStore(waves, tiny.input_length)
+        return data
+
+    def committee_fn(data):
+        X = data.pool.X
+        y = np.array([data.labels[s] for s in np.repeat(
+            data.pool.song_ids, data.pool.counts)], np.int32)
+        cnns = [CNNMember(f"cnn{i}",
+                          short_cnn.init_variables(jax.random.key(i), tiny),
+                          tiny, tc)
+                for i in range(2)]
+        return Committee([GNBMember("gnb.it_0").fit(X, y)], cnns, tiny, tc)
+
+    cfg = _cfg(mode="mc", epochs=2, queries=3)
+    seq, entries = [], []
+    for i in range(2):
+        data = data_fn(100 + i, f"u{i}")
+        sp = tmp_path / f"seq_u{i}"
+        sp.mkdir()
+        seq.append(ALLoop(cfg, retrain_epochs=2).run_user(
+            committee_fn(data), data, str(sp)))
+        fp = tmp_path / f"fleet_u{i}"
+        fp.mkdir()
+        entries.append(FleetUser(f"u{i}", committee_fn(data), data, str(fp),
+                                 seed=cfg.seed))
+    recs = FleetScheduler(cfg, retrain_epochs=2).run(entries)
+    for s, r in zip(seq, recs):
+        assert r["error"] is None
+        assert r["result"]["trajectory"] == s["trajectory"]
+
+
+# -- AsyncCheckpointer concurrent-session fix (satellite) -----------------
+
+
+def test_async_checkpointer_shared_executor_preserves_order():
+    """Per-session job ordering holds on a shared pool, and ``close``
+    leaves the shared pool running for its owner (the fleet scheduler)."""
+    from concurrent.futures import ThreadPoolExecutor
+    import threading
+
+    pool = ThreadPoolExecutor(max_workers=4)
+    try:
+        log = []
+        gate = threading.Event()
+        a = AsyncCheckpointer(executor=pool)
+        b = AsyncCheckpointer(executor=pool)
+        a.submit(lambda: (gate.wait(2), log.append("a1")))
+        b.submit(lambda: log.append("b1"))  # b runs while a's job blocks
+        b.wait()
+        assert log == ["b1"]
+        gate.set()
+        a.submit(lambda: log.append("a2"))  # joins a1 first
+        a.wait()
+        assert log == ["b1", "a1", "a2"]
+        a.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            a.submit(lambda: None)
+        # the shared pool must survive a session's close
+        b.submit(lambda: log.append("b2"))
+        b.close()
+        assert log[-1] == "b2"
+    finally:
+        pool.shutdown(wait=True)
+
+
+def test_async_checkpointer_owned_pool_unchanged():
+    done = []
+    with AsyncCheckpointer() as ck:
+        ck.submit(lambda: done.append(1))
+    assert done == [1]
+    with pytest.raises(RuntimeError):
+        ck.submit(lambda: None)

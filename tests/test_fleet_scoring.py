@@ -1,0 +1,156 @@
+"""Fleet batched scoring vs the single-user production fns.
+
+The contract the fleet engine rests on: every row of a vmapped
+``make_fleet_scoring_fns`` result is BIT-IDENTICAL to the jitted
+single-user fn from ``make_scoring_fns`` on that user's inputs — for all
+four acquisition modes, including quarantine member masks and padded pool
+rows.  (Equality is against the jitted single-user fns — the production
+path ``Acquirer.run_scoring`` calls — not the unjitted python functions,
+whose fusion can differ by 1 ulp.)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensus_entropy_tpu.ops import scoring
+
+pytestmark = pytest.mark.fleet
+
+
+def _probs(rng, u, m, n, c=4):
+    p = rng.uniform(0.01, 1.0, size=(u, m, n, c)).astype(np.float32)
+    return p / p.sum(axis=-1, keepdims=True)
+
+
+def _masks(rng, u, n, n_live):
+    """Per-user pool masks with padded tail rows plus a few random
+    quarantine-style holes mid-pool."""
+    mask = np.zeros((u, n), bool)
+    mask[:, :n_live] = True
+    for i in range(u):
+        holes = rng.choice(n_live, size=3, replace=False)
+        mask[i, holes] = False
+    return mask
+
+
+def _assert_rows_equal(batched, single, i, mask_row):
+    """Bit-for-bit row equality: full values/indices, entropies on live
+    rows (padding rows are -inf on both sides; compare them too via
+    array_equal, which treats equal infs as equal)."""
+    np.testing.assert_array_equal(np.asarray(batched.values[i]),
+                                  np.asarray(single.values))
+    np.testing.assert_array_equal(np.asarray(batched.indices[i]),
+                                  np.asarray(single.indices))
+    np.testing.assert_array_equal(np.asarray(batched.entropy[i]),
+                                  np.asarray(single.entropy))
+
+
+def test_fleet_mc_matches_single(rng):
+    u, m, n, k = 4, 5, 96, 6
+    p = _probs(rng, u, m, n)
+    mask = _masks(rng, u, n, 80)
+    fleet = scoring.make_fleet_scoring_fns(k=k)
+    single = scoring.make_scoring_fns(k=k)
+    res = fleet["mc"](p, mask)
+    for i in range(u):
+        _assert_rows_equal(res, single["mc"](p[i], mask[i]), i, mask[i])
+
+
+def test_fleet_mc_member_mask_matches_single(rng):
+    """Quarantine masks: a per-user (U, M) member mask batched must equal
+    the single-user masked call — fixed-M cohorts with quarantined
+    members ride the ``*_masked`` variants."""
+    u, m, n, k = 3, 6, 64, 5
+    p = _probs(rng, u, m, n)
+    mask = _masks(rng, u, n, 60)
+    mmask = np.ones((u, m), bool)
+    mmask[0, 2] = False
+    mmask[2, 0] = mmask[2, 5] = False
+    fleet = scoring.make_fleet_scoring_fns(k=k)
+
+    def one(pp, pm, mm):
+        return scoring.score_mc(pp, pm, k=k, member_mask=mm,
+                                tie_break="fast")
+
+    single = jax.jit(one)
+    res = fleet["mc_masked"](p, mask, mmask)
+    for i in range(u):
+        _assert_rows_equal(res, single(p[i], mask[i], mmask[i]), i, mask[i])
+
+
+def test_fleet_hc_matches_single(rng):
+    u, n, k = 4, 80, 7
+    counts = rng.integers(1, 30, size=(u, n, 4))
+    freq = np.round(counts / counts.sum(-1, keepdims=True),
+                    3).astype(np.float32)
+    freq[:, 70:] = 0.0  # padded rows (all-zero, behind the mask)
+    mask = _masks(rng, u, n, 70)
+    fleet = scoring.make_fleet_scoring_fns(k=k)
+    single = scoring.make_scoring_fns(k=k)
+    res = fleet["hc"](freq, mask)
+    for i in range(u):
+        _assert_rows_equal(res, single["hc"](freq[i], mask[i]), i, mask[i])
+    # the production hc path: precomputed row entropies + masked top-k
+    from consensus_entropy_tpu.ops.entropy import shannon_entropy
+
+    ent = jax.jit(jax.vmap(shannon_entropy))(freq)
+    res_pre = fleet["hc_pre"](ent, mask)
+    for i in range(u):
+        s = single["hc_pre"](np.asarray(ent[i]), mask[i])
+        _assert_rows_equal(res_pre, s, i, mask[i])
+
+
+def test_fleet_mix_matches_single(rng):
+    u, m, n, k = 3, 4, 72, 6
+    p = _probs(rng, u, m, n)
+    pool_mask = _masks(rng, u, n, 64)
+    counts = rng.integers(1, 25, size=(u, n, 4))
+    hc = np.round(counts / counts.sum(-1, keepdims=True),
+                  3).astype(np.float32)
+    hc_mask = pool_mask.copy()
+    hc_mask[:, 40:] = False  # hc rows already queried in earlier iterations
+    fleet = scoring.make_fleet_scoring_fns(k=k)
+    single = scoring.make_scoring_fns(k=k)
+    res = fleet["mix"](p, pool_mask, hc, hc_mask)
+    for i in range(u):
+        s = single["mix"](p[i], pool_mask[i], hc[i], hc_mask[i])
+        _assert_rows_equal(res, s, i, pool_mask[i])
+
+    mmask = np.ones((u, m), bool)
+    mmask[1, 3] = False
+
+    def one(pp, pm, hf, hm, mm):
+        return scoring.score_mix(pp, pm, hf, hm, k=k, member_mask=mm,
+                                 tie_break="fast")
+
+    jone = jax.jit(one)
+    res_m = fleet["mix_masked"](p, pool_mask, hc, hc_mask, mmask)
+    for i in range(u):
+        s = jone(p[i], pool_mask[i], hc[i], hc_mask[i], mmask[i])
+        _assert_rows_equal(res_m, s, i, pool_mask[i])
+
+
+def test_fleet_rand_matches_single(rng):
+    """rand relies on partitionable threefry: a batched key array's
+    per-user draws equal each key's own draws regardless of batching."""
+    u, n, k = 4, 56, 5
+    mask = _masks(rng, u, n, 48)
+    keys = [jax.random.key(100 + i) for i in range(u)]
+    batched_keys = scoring.stack_user_keys(keys)
+    assert scoring.is_key_array(batched_keys)
+    assert not scoring.is_key_array(jnp.zeros(3))
+    assert not scoring.is_key_array(mask)
+    fleet = scoring.make_fleet_scoring_fns(k=k)
+    single = scoring.make_scoring_fns(k=k)
+    res = fleet["rand"](batched_keys, mask)
+    for i in range(u):
+        _assert_rows_equal(res, single["rand"](keys[i], mask[i]), i, mask[i])
+
+
+def test_fleet_fns_cached_per_k():
+    a = scoring.make_fleet_scoring_fns(k=5)
+    b = scoring.make_fleet_scoring_fns(k=5, tie_break="fast")
+    c = scoring.make_fleet_scoring_fns(k=6)
+    assert a is b and a is not c  # same normalization as make_scoring_fns

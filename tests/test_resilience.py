@@ -444,6 +444,28 @@ def test_injector_counts_hits_deterministically():
     assert faults.active() is None  # uninstalled on exit
 
 
+def test_member_filtered_rules_count_per_member_hits():
+    """A member-filtered rule's ``at`` window indexes that member's OWN
+    hits, not the global point counter — so "member m1's 2nd retrain"
+    stays targeted no matter how many other members (or other users'
+    committees in a fleet cohort) hit the point in between."""
+    with faults.inject(FaultRule("member.retrain", "raise", at=2,
+                                 member="m1")) as inj:
+        faults.fire("member.retrain", member="m0")  # global hit 1
+        faults.fire("member.retrain", member="m1")  # m1 hit 1: below at
+        faults.fire("member.retrain", member="m0")
+        with pytest.raises(InjectedFault):
+            faults.fire("member.retrain", member="m1")  # m1 hit 2: fires
+        faults.fire("member.retrain", member="m1")  # window passed
+    assert inj.member_hits[("member.retrain", "m1")] == 3
+    assert inj.hits["member.retrain"] == 5
+    # a member-filtered rule never fires on a context-free hit
+    with faults.inject(FaultRule("member.retrain", "raise", at=1, times=-1,
+                                 member="m1")) as inj2:
+        faults.fire("member.retrain")  # no member ctx: not m1's hit
+    assert not inj2.fired
+
+
 # -- satellites: state + recovery edge cases ----------------------------
 
 
