@@ -707,13 +707,14 @@ def run_retrain_suite(args_ns) -> int:
     return 0
 
 
-def _fleet_workload(n_users: int, n_songs: int, n_feat: int, seed: int):
+def _sized_fleet_workload(sizes: list[int], n_feat: int, seed: int):
     """Synthetic multi-user AL workload: class-separable per-user song
-    pools + a fresh 3-member host committee per run (GNB + 2 SGD — the
-    paper's partial_fit species), mirroring the AMG per-user shape.
-    Returns ``[(UserData, committee_factory), ...]``; the factory builds
-    an identical fresh committee each call so sequential and fleet runs
-    start from the same state."""
+    pools (``sizes[u]`` songs for user u) + a fresh 3-member host
+    committee per run (GNB + 2 SGD — the paper's partial_fit species),
+    mirroring the AMG per-user shape.  Returns
+    ``[(UserData, committee_factory), ...]``; the factory builds an
+    identical fresh committee each call so sequential, fleet and serve
+    runs start from the same state."""
     from consensus_entropy_tpu.al.loop import UserData
     from consensus_entropy_tpu.models.committee import Committee, FramePool
     from consensus_entropy_tpu.models.sklearn_members import (
@@ -722,7 +723,7 @@ def _fleet_workload(n_users: int, n_songs: int, n_feat: int, seed: int):
     )
 
     users = []
-    for u in range(n_users):
+    for u, n_songs in enumerate(sizes):
         rng = np.random.default_rng(seed + u)
         centers = rng.standard_normal((4, n_feat)).astype(np.float32) * 2.5
         rows, sids, labels = [], [], {}
@@ -750,6 +751,11 @@ def _fleet_workload(n_users: int, n_songs: int, n_feat: int, seed: int):
 
         users.append((data, factory))
     return users
+
+
+def _fleet_workload(n_users: int, n_songs: int, n_feat: int, seed: int):
+    """Uniform-size workload (the fleet suite's shape)."""
+    return _sized_fleet_workload([n_songs] * n_users, n_feat, seed)
 
 
 def run_fleet_suite(args_ns) -> int:
@@ -871,15 +877,218 @@ def run_fleet_suite(args_ns) -> int:
     return 0
 
 
+def _skewed_fleet_workload(n_users: int, small: int, n_feat: int,
+                           seed: int, *, large_every: int = 4,
+                           skew_factor: int = 4):
+    """Tail-heavy multi-user workload: most users carry ``small``-song
+    pools, every ``large_every``-th carries ``skew_factor * small`` —
+    the size distribution where cohort-max padding wastes the most (every
+    small user scores the large user's padded rows all run long).
+    Returns ``([(UserData, committee_factory), ...], sizes)``."""
+    sizes = [small * (skew_factor if (u % large_every == large_every - 1)
+                      else 1) for u in range(n_users)]
+    return _sized_fleet_workload(sizes, n_feat, seed), sizes
+
+
+def run_serve_suite(args_ns) -> int:
+    """Serve layer vs fleet cohorts vs sequential, on a SKEWED workload.
+
+    The fleet's fixed cohorts pay (a) the cohort-max pool pad on every
+    user and (b) the occupancy drain at each cohort's tail; the serve
+    layer (``serve.FleetServer``) pads per power-of-two-ish bucket and
+    refills slots the moment a session finishes.  This suite races the
+    three drivers over IDENTICAL tail-heavy users (every 4th pool is 4×
+    the rest) with interleaved best-of-reps timing (2-vCPU drift
+    protocol), asserts per-user trajectory parity against the sequential
+    loop on EVERY rep, and reports users/sec + per-bucket occupancy +
+    admission telemetry.
+    """
+    import os
+    import shutil
+    import tempfile
+
+    from consensus_entropy_tpu.al.loop import ALLoop
+    from consensus_entropy_tpu.config import ALConfig
+    from consensus_entropy_tpu.fleet import FleetReport, FleetScheduler, \
+        FleetUser
+    from consensus_entropy_tpu.serve import FleetServer, ServeConfig
+    from consensus_entropy_tpu.utils import round_up
+
+    cfg = ALConfig(queries=args_ns.k, epochs=args_ns.al_epochs, mode="mc",
+                   seed=1987, ckpt_dtype="float32")
+    n_users = args_ns.users
+    small = args_ns.pool or 120
+    users, sizes = _skewed_fleet_workload(n_users, small, 96, cfg.seed)
+    # operator-tuned bucket edges: one per distinct pool size class (the
+    # realistic deployment; power-of-two is the untuned default)
+    widths = tuple(sorted({round_up(s, 8) for s in sizes}))
+    _log(f"serve workload: {n_users} users, pool sizes {sizes} "
+         f"(bucket edges {list(widths)}), 3 host members, q={cfg.queries}, "
+         f"{cfg.epochs} AL iterations")
+
+    root = tempfile.mkdtemp(prefix="serve_bench_")
+    reps = args_ns.reps
+    sweep_ns = sorted(set(args_ns.fleet))
+    try:
+        loop = ALLoop(cfg)
+        seq_results = None
+        seq_s = float("inf")
+        fleet_sweep: dict = {}
+        serve_sweep: dict = {}
+        for rep in range(reps):
+            # interleaved: sequential, then fleet-N and serve-N per N —
+            # the throttled box slows under sustained load, so ordering
+            # a full sweep per side would bias whichever ran first
+            t0 = time.perf_counter()
+            results = []
+            for i, (data, factory) in enumerate(users):
+                p = os.path.join(root, f"seq{rep}_{i}")
+                os.makedirs(p)
+                results.append(loop.run_user(factory(), data, p,
+                                             seed=cfg.seed))
+            seq_s = min(seq_s, time.perf_counter() - t0)
+            if seq_results is None:
+                seq_results = results
+            elif [r["trajectory"] for r in results] \
+                    != [r["trajectory"] for r in seq_results]:
+                raise AssertionError("sequential reps diverged")
+            traj_of = {r["user"]: r["trajectory"] for r in seq_results}
+
+            def check_parity(recs):
+                return all(
+                    r["error"] is None
+                    and r["result"]["trajectory"] == traj_of[r["user"]]
+                    for r in recs) and len(recs) == n_users
+
+            def keep_best(sweep, n, s):
+                prev = sweep.get(n)
+                if prev is not None and not prev["parity_with_sequential"]:
+                    return
+                if not s["parity_with_sequential"] or prev is None \
+                        or s["users_per_sec"] > prev["users_per_sec"]:
+                    sweep[n] = s
+
+            for n in sweep_ns:
+                # fleet: fixed cohorts of n, cohort-max padding
+                report = FleetReport()
+                sched = FleetScheduler(cfg, report=report,
+                                       host_workers=args_ns.host_workers,
+                                       user_timings=False)
+                t0 = time.perf_counter()
+                recs = []
+                for lo in range(0, n_users, n):
+                    entries = [
+                        FleetUser(data.user_id, factory(), data,
+                                  _mkdir(root, f"fleet{n}_{rep}_{i}"),
+                                  seed=cfg.seed)
+                        for i, (data, factory) in
+                        list(enumerate(users))[lo:lo + n]]
+                    recs.extend(sched.run(entries))
+                wall = time.perf_counter() - t0
+                s = report.summary(cohort=n, wall_s=wall)
+                s["parity_with_sequential"] = check_parity(recs)
+                keep_best(fleet_sweep, n, s)
+
+                # serve: continuous admission at target occupancy n,
+                # bucketed padding
+                report = FleetReport()
+                sched = FleetScheduler(cfg, report=report,
+                                       host_workers=args_ns.host_workers,
+                                       user_timings=False,
+                                       scoring_by_width=True)
+                server = FleetServer(sched, ServeConfig(
+                    target_live=n, max_queue=max(n_users, 1),
+                    bucket_widths=widths))
+                entries = [
+                    FleetUser(data.user_id, factory(), data,
+                              _mkdir(root, f"serve{n}_{rep}_{i}"),
+                              seed=cfg.seed)
+                    for i, (data, factory) in enumerate(users)]
+                t0 = time.perf_counter()
+                recs = server.serve(iter(entries))
+                wall = time.perf_counter() - t0
+                s = report.summary(cohort=n, wall_s=wall)
+                s["parity_with_sequential"] = check_parity(recs)
+                keep_best(serve_sweep, n, s)
+
+        seq_ups = n_users / seq_s
+        _log(f"[sequential] {n_users} users in {seq_s:.1f}s best of "
+             f"{reps} ({seq_ups:.3f} users/s)")
+        for n in sweep_ns:
+            f, s = fleet_sweep[n], serve_sweep[n]
+            for name, best in (("fleet", f), ("serve", s)):
+                best["speedup_vs_sequential"] = round(
+                    best["users_per_sec"] / seq_ups, 2)
+            _log(f"[n={n}] fleet {f['users_per_sec']:.3f} u/s (occ "
+                 f"{f['occupancy']}, parity={f['parity_with_sequential']})"
+                 f" | serve {s['users_per_sec']:.3f} u/s (occ "
+                 f"{s['occupancy']}, per-bucket "
+                 f"{s.get('per_bucket')}, "
+                 f"parity={s['parity_with_sequential']}) -> serve/fleet "
+                 f"{s['users_per_sec'] / f['users_per_sec']:.2f}x")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    best_n = max(serve_sweep,
+                 key=lambda n: serve_sweep[n]["users_per_sec"] or 0)
+    best = serve_sweep[best_n]
+    best_fleet = max(fleet_sweep.values(),
+                     key=lambda s: s["users_per_sec"] or 0)
+    print(json.dumps({
+        "metric": f"serve_users_per_sec_{n_users}u_skewed",
+        "value": best["users_per_sec"],
+        "unit": "users/s",
+        # the acceptance ratio: serve vs the best fleet cohort config on
+        # the same skewed workload (>= 1.0 means continuous admission +
+        # bucketing beat fixed cohorts + cohort-max padding)
+        "vs_baseline": round(best["users_per_sec"]
+                             / best_fleet["users_per_sec"], 2),
+        "target_live": best_n,
+        "vs_sequential": best["speedup_vs_sequential"],
+        "sequential_users_per_sec": round(seq_ups, 4),
+        "fleet_users_per_sec": best_fleet["users_per_sec"],
+        "fleet_vs_sequential": best_fleet["speedup_vs_sequential"],
+        "pool_sizes": sizes,
+        "bucket_widths": list(widths),
+        "per_bucket": best.get("per_bucket"),
+        "occupancy": best.get("occupancy"),
+        "admission_wait_s": best.get("admission_wait_s"),
+        "queue_depth": best.get("queue_depth"),
+        "parity_with_sequential": all(
+            s["parity_with_sequential"]
+            for s in list(serve_sweep.values()) + list(fleet_sweep.values())),
+        "sweep": {str(n): {
+            "serve_users_per_sec": serve_sweep[n]["users_per_sec"],
+            "fleet_users_per_sec": fleet_sweep[n]["users_per_sec"],
+            "serve_occupancy": serve_sweep[n]["occupancy"],
+            "fleet_occupancy": fleet_sweep[n]["occupancy"],
+            "serve_per_bucket": serve_sweep[n].get("per_bucket"),
+        } for n in sweep_ns},
+        **_provenance(),
+    }))
+    return 0
+
+
+def _mkdir(root, name):
+    import os
+
+    p = os.path.join(root, name)
+    os.makedirs(p)
+    return p
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--suite", choices=("linear", "cnn", "retrain", "fleet"),
+    ap.add_argument("--suite", choices=("linear", "cnn", "retrain", "fleet",
+                                        "serve"),
                     default="linear",
                     help="linear: the north-star fused pool scoring; cnn: "
                          "Flax ShortChunkCNN committee inference "
                          "(BASELINE configs[3]); retrain: vmapped committee "
                          "retraining vs the sequential member loop; fleet: "
-                         "multi-user AL users/sec vs the sequential loop")
+                         "multi-user AL users/sec vs the sequential loop; "
+                         "serve: continuous-batching admission + bucketed "
+                         "padding vs fleet cohorts on a skewed workload")
     ap.add_argument("--members", type=int, default=None,
                     help="committee size (default: 16 linear / 5 cnn)")
     ap.add_argument("--pool", type=int, default=None,
@@ -936,6 +1145,9 @@ def main(argv=None) -> int:
     if args_ns.suite == "fleet":
         # fleet reuses --pool as songs-per-user (default 150 inside)
         return run_fleet_suite(args_ns)
+    if args_ns.suite == "serve":
+        # serve reuses --pool as the SMALL pool size (every 4th user 4x)
+        return run_serve_suite(args_ns)
     if args_ns.suite == "cnn":
         # cnn-suite defaults: 5 members (paper committee), 48 crops per
         # pass — the first conv block's activations are ~75 MB per

@@ -47,6 +47,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bounded worker pool for fleet host-side "
                         "sklearn retraining/evaluation (default: "
                         "min(N, cpus, 8))")
+    p.add_argument("--serve", type=int, default=None, metavar="N",
+                   help="serving mode: continuous-batching admission on "
+                        "top of the fleet engine — keep N AL sessions "
+                        "live, admitting a queued user the moment a "
+                        "session finishes (no cohort-tail drain), each "
+                        "user padded to its --bucket-widths bucket "
+                        "instead of the cohort max; SIGTERM drains "
+                        "(in-flight users finish, queued users wait for "
+                        "the rerun, exit 75); per-user results identical "
+                        "to the sequential run")
+    p.add_argument("--admit-window-ms", type=float, default=0.0,
+                   help="serve mode: with free slots and an empty queue, "
+                        "wait up to this long for more arrivals so "
+                        "admissions gang up and phase-align into one "
+                        "bucket dispatch (default 0: admit eagerly)")
+    p.add_argument("--bucket-widths", default=None, metavar="W1,W2,...",
+                   help="serve mode: explicit pool-width bucket edges "
+                        "(comma-separated ints, ascending); users pad to "
+                        "the smallest edge that fits their pool, "
+                        "oversized pools fall through to the next power "
+                        "of two (default: power-of-two buckets)")
     p.add_argument("--seed", type=int, default=1987)
     p.add_argument("--tie-break", choices=("fast", "numpy"), default="fast")
     p.add_argument("--trace-dir", default=None,
@@ -94,17 +115,45 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     configure_device(args.device)
 
-    if args.fleet is not None:
-        if args.fleet < 1:
-            print(f"--fleet must be >= 1, got {args.fleet}")
+    if args.fleet is not None and args.serve is not None:
+        print("--fleet and --serve are exclusive: --fleet runs fixed "
+              "cohorts, --serve runs continuous admission")
+        return 1
+    if args.fleet is not None or args.serve is not None:
+        n_flag, n_val = (("--fleet", args.fleet) if args.fleet is not None
+                         else ("--serve", args.serve))
+        if n_val < 1:
+            print(f"{n_flag} must be >= 1, got {n_val}")
             return 1
         if args.distributed or args.mesh:
             # the fleet batches by vmapping the single-device scorers; the
             # pool-sharded fns carry per-user mesh placements that cannot
             # be stacked — multi-host/mesh fleets are a ROADMAP open item
-            print("--fleet is single-process/single-mesh only (drop "
+            print(f"{n_flag} is single-process/single-mesh only (drop "
                   "--distributed/--mesh)")
             return 1
+    if args.serve is not None and args.pad_pool_to is not None:
+        print("--serve pads per bucket; use --bucket-widths instead of "
+              "--pad-pool-to")
+        return 1
+    if args.admit_window_ms and args.serve is None:
+        print("--admit-window-ms requires --serve")
+        return 1
+    bucket_widths = None
+    if args.bucket_widths is not None:
+        if args.serve is None:
+            print("--bucket-widths requires --serve")
+            return 1
+        try:
+            bucket_widths = tuple(int(w) for w in
+                                  args.bucket_widths.split(",") if w)
+            if not bucket_widths or min(bucket_widths) < 1:
+                raise ValueError
+        except ValueError:
+            print(f"--bucket-widths must be comma-separated positive ints, "
+                  f"got {args.bucket_widths!r}")
+            return 1
+    args._bucket_widths = bucket_widths
 
     if args.distributed:
         # must precede every other jax call (jax.distributed contract)
@@ -322,6 +371,100 @@ def _run_users_fleet(args, cfg, paths, users, pool, anno, hc_table, store,
             f"eviction/resume: {failed}")
 
 
+def _run_users_serve(args, cfg, paths, users, pool, anno, hc_table, store,
+                     cnn_cfg, guard, results) -> None:
+    """Serving path: continuous-batching admission (``serve.FleetServer``)
+    — keep ``--serve N`` sessions live, refill freed slots from the
+    waiting queue, pad per bucket.  Per-user workspaces/results are
+    identical to the sequential path; finished users are persisted the
+    moment they complete, so a drain (SIGTERM → exit 75) loses nothing."""
+    import json
+
+    import numpy as np
+
+    from consensus_entropy_tpu.al import workspace
+    from consensus_entropy_tpu.al.loop import UserData
+    from consensus_entropy_tpu.data import amg
+    from consensus_entropy_tpu.fleet import (
+        FleetReport,
+        FleetScheduler,
+        FleetUser,
+    )
+    from consensus_entropy_tpu.fleet.report import bench_line
+    from consensus_entropy_tpu.serve import FleetServer, ServeConfig
+
+    experiment = {"seed": cfg.seed, "queries": cfg.queries,
+                  "train_size": cfg.train_size}
+    report = FleetReport(os.path.join(paths.users_dir,
+                                      "fleet_metrics.jsonl"))
+    scheduler = FleetScheduler(
+        cfg, tie_break=args.tie_break, retrain_epochs=args.retrain_epochs,
+        host_workers=args.fleet_host_workers, report=report,
+        scoring_by_width=True)
+    server = FleetServer(
+        scheduler,
+        ServeConfig(target_live=args.serve,
+                    admit_window_s=args.admit_window_ms / 1000.0,
+                    bucket_widths=args._bucket_widths),
+        preemption=guard)
+
+    def source():
+        # pulled lazily as queue room frees: per-user workspace creation
+        # and committee loads happen just-in-time at admission pressure,
+        # and a drain leaves un-pulled users completely untouched
+        for u_id in users[: args.max_users]:
+            user_path, skip = workspace.create_user(
+                paths.users_dir, paths.pretrained_dir, u_id, cfg.mode,
+                experiment=experiment)
+            if skip:
+                print(f"Skipping user {u_id}, already exists!")
+                continue
+
+            def factory(user_path=user_path):
+                return workspace.load_committee(
+                    user_path, cnn_cfg, device_members=args.device_members,
+                    full_song_hop=args.full_song_hop)
+
+            committee = factory()
+            sub_pool, labels = amg.user_pool(pool, anno, u_id)
+            hc_rows = hc_table.reindex(sub_pool.song_ids).to_numpy(
+                np.float32)
+            data = UserData(u_id, sub_pool, labels, hc_rows=hc_rows,
+                            store=store)
+            yield FleetUser(u_id, committee, data, user_path,
+                            seed=cfg.seed, committee_factory=factory)
+
+    failed = []
+
+    def on_result(rec):
+        # persist each user the moment its session finishes — serving
+        # semantics: completion is durable immediately, not at end-of-run
+        if rec["error"] is not None:
+            print(f"user {rec['user']} FAILED: {rec['error']}")
+            failed.append(rec["user"])
+            return
+        user_path = workspace.user_dir(paths.users_dir, rec["user"],
+                                       cfg.mode)
+        rec["committee"].save(user_path)
+        workspace.mark_done(user_path)
+        results.append(rec["result"])
+        print(f"user {rec['user']}: final mean F1 = "
+              f"{rec['result']['final_mean_f1']:.4f}")
+
+    try:
+        server.serve(source(), on_result=on_result)
+    finally:
+        summary = report.write_summary(cohort=args.serve)
+        print("serve summary: "
+              + json.dumps(bench_line(summary), sort_keys=True))
+    if failed:
+        # parity with the fleet path: users dropped after eviction/resume
+        # must not let the sweep look successful to CI/scripts
+        raise RuntimeError(
+            f"{len(failed)} serve user(s) failed terminally after "
+            f"eviction/resume: {failed}")
+
+
 def _run_users(args, cfg, paths, users, pool, anno, hc_table, store,
                cnn_cfg, mesh, train_mesh, loop, multihost, guard,
                results) -> None:
@@ -335,6 +478,10 @@ def _run_users(args, cfg, paths, users, pool, anno, hc_table, store,
 
     if args.fleet is not None:
         _run_users_fleet(args, cfg, paths, users, pool, anno, hc_table,
+                         store, cnn_cfg, guard, results)
+        return
+    if args.serve is not None:
+        _run_users_serve(args, cfg, paths, users, pool, anno, hc_table,
                          store, cnn_cfg, guard, results)
         return
 

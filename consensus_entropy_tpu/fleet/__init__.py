@@ -22,6 +22,11 @@ Pieces:
   is evicted + resumed from its workspace without touching the cohort.
 - :mod:`fleet.report` — users/sec, device-batch occupancy, per-phase
   wall-clock; ``metrics.jsonl`` events + a BENCH-compatible summary.
+
+The scheduler's lifecycle surface (``open``/``admit``/``pump``/``close``)
+is public: ``consensus_entropy_tpu.serve`` drives it as a long-running
+admission service (continuous batching + bucketed padding) instead of a
+fixed-cohort batch job.
 """
 
 from consensus_entropy_tpu.fleet.report import FleetReport

@@ -5,27 +5,45 @@ are unchanged — each session writes its own, exactly as a sequential run
 would.  This module adds the COHORT-level view a serving operator needs:
 
 - one ``metrics.jsonl`` event stream for the fleet itself (dispatches,
-  evictions, resumes, per-user completions) at the users root,
+  evictions, resumes, per-user completions — and, under the serve layer,
+  enqueue/admit events with queue depth and admission wait) at the users
+  root,
 - an end-of-run summary with users/sec, device-batch occupancy (how full
-  the vmapped scoring dispatches ran relative to the cohort), and summed
-  per-phase wall-clock across sessions,
+  the stacked scoring dispatches ran relative to the sessions that could
+  have joined them), per-bucket occupancy for bucketed admission, and
+  summed per-phase wall-clock across sessions,
 - a BENCH-compatible one-line JSON (``bench.py --suite fleet`` writes the
-  ``BENCH_fleet_*.json`` artifact from it).
+  ``BENCH_fleet_*.json`` artifact from it; ``--suite serve`` the
+  ``BENCH_serve_*.json`` one).
+
+Occupancy accounting: every dispatch records the number of ACTIVE slots —
+sessions currently holding a seat in the engine (scoring, retraining, or
+between steps), with finished, evicted and terminally-failed sessions
+excluded from the moment their generator returned.  A cohort that loses a
+user therefore stops being graded against the dead slot for the remainder
+of the run (``test_fleet_occupancy_excludes_finished_and_evicted`` pins
+this), and under bucketed admission the denominator is the active
+sessions of that dispatch's OWN bucket.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 import time
+
+from consensus_entropy_tpu.utils.profiling import RollingStat
 
 
 class FleetReport:
     """Collects fleet-run telemetry; optionally streams events to JSONL.
 
     ``jsonl_path``: fleet-level ``metrics.jsonl`` (the per-user files live
-    in the user workspaces).  All methods are called from the scheduler's
-    main thread only, so no locking is needed.
+    in the user workspaces).  Engine-side methods run on the scheduler's
+    main thread; :meth:`enqueued` may ALSO run on producer threads
+    (``FleetServer.submit``), so the event stream and the admission stats
+    are guarded by one small lock.
     """
 
     def __init__(self, jsonl_path: str | None = None):
@@ -35,29 +53,57 @@ class FleetReport:
         self.phase_totals: dict[str, float] = {}
         self.users_done = 0
         self.users_failed = 0
+        #: serve-layer admission telemetry (empty outside serve mode)
+        self.queue_depth = RollingStat()
+        self.admission_wait = RollingStat()
         self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
         if jsonl_path:
             os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
 
     # -- recording ---------------------------------------------------------
 
     def _emit(self, rec: dict) -> None:
-        self.events.append(rec)
-        if self.jsonl_path:
-            with open(self.jsonl_path, "a") as f:
-                f.write(json.dumps(rec) + "\n")
+        with self._lock:
+            self.events.append(rec)
+            if self.jsonl_path:
+                with open(self.jsonl_path, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
 
-    def dispatch(self, fn_key: str, batch: int, cohort: int,
-                 wall_s: float) -> None:
+    def dispatch(self, fn_key: str, batch: int, active: int,
+                 wall_s: float, width: int | None = None) -> None:
         """One device scoring dispatch: ``batch`` sessions scored together
-        out of a ``cohort`` concurrently-live sessions."""
-        self.dispatches.append({"fn": fn_key, "batch": batch,
-                                "cohort": cohort, "wall_s": wall_s})
+        out of ``active`` live slots (cohort-wide, or this bucket's when
+        ``width`` identifies a bucketed dispatch)."""
+        rec = {"fn": fn_key, "batch": batch, "active": active,
+               "wall_s": wall_s}
+        if width is not None:
+            rec["width"] = width
+        self.dispatches.append(rec)
 
     def event(self, kind: str, **fields) -> None:
-        """Cohort-level event (evict / resume / user_done / user_failed)."""
+        """Cohort-level event (evict / resume / user_done / user_failed /
+        enqueue / admit / drain)."""
         self._emit({"event": kind, "t_s": round(self.elapsed_s(), 3),
                     **fields})
+
+    def enqueued(self, user, depth: int) -> None:
+        """A user entered the serve-layer waiting queue (depth AFTER).
+        May be called from producer threads (``FleetServer.submit``)."""
+        with self._lock:
+            self.queue_depth.add(depth)
+        self.event("enqueue", user=str(user), depth=depth)
+
+    def admitted(self, user, *, width: int, wait_s: float, depth: int,
+                 live: int) -> None:
+        """A queued user was admitted into the engine: its bucket width,
+        how long it waited in the queue, the queue depth left behind and
+        the live-session count after admission."""
+        with self._lock:
+            self.admission_wait.add(wait_s)
+            self.queue_depth.add(depth)
+        self.event("admit", user=str(user), width=width,
+                   wait_s=round(wait_s, 4), depth=depth, live=live)
 
     def user_done(self, user, result: dict, phases: dict) -> None:
         """A session finished; ``phases`` are its summed ``{phase}_s``
@@ -80,13 +126,35 @@ class FleetReport:
 
     @property
     def occupancy(self) -> float | None:
-        """Mean scored-sessions per dispatch over the concurrently-live
-        cohort at that moment: 1.0 = every dispatch scored every live
-        session at once (perfect phase alignment); 1/cohort = fully
-        serialized (the sequential shape)."""
-        per = [d["batch"] / d["cohort"] for d in self.dispatches
-               if d["cohort"]]
+        """Mean scored-sessions per dispatch over the slots ACTIVE at that
+        moment: 1.0 = every dispatch scored every active session at once
+        (perfect phase alignment); 1/active = fully serialized (the
+        sequential shape).  Finished/evicted sessions stopped counting
+        when their generator returned (see module docstring)."""
+        per = [d["batch"] / d["active"] for d in self.dispatches
+               if d["active"]]
         return sum(per) / len(per) if per else None
+
+    @property
+    def per_bucket_occupancy(self) -> dict | None:
+        """``{width: {"occupancy", "dispatches", "mean_batch"}}`` for
+        bucketed (width-tagged) dispatches; ``None`` when none were."""
+        buckets: dict[int, list[dict]] = {}
+        for d in self.dispatches:
+            if "width" in d:
+                buckets.setdefault(d["width"], []).append(d)
+        if not buckets:
+            return None
+        out = {}
+        for w, ds in sorted(buckets.items()):
+            per = [d["batch"] / d["active"] for d in ds if d["active"]]
+            out[w] = {
+                "dispatches": len(ds),
+                "mean_batch": round(sum(d["batch"] for d in ds) / len(ds),
+                                    2),
+                "occupancy": round(sum(per) / len(per), 3) if per else None,
+            }
+        return out
 
     def summary(self, *, cohort: int, wall_s: float | None = None) -> dict:
         """Cohort roll-up.  ``phase_wall_s`` sums the sessions' OWN timers
@@ -116,6 +184,13 @@ class FleetReport:
             "evictions": sum(e["event"] == "evict" for e in self.events),
             "resumes": sum(e["event"] == "resume" for e in self.events),
         }
+        per_bucket = self.per_bucket_occupancy
+        if per_bucket is not None:
+            out["per_bucket"] = per_bucket
+        if self.admission_wait.n:
+            out["admissions"] = self.admission_wait.n
+            out["admission_wait_s"] = self.admission_wait.snapshot()
+            out["queue_depth"] = self.queue_depth.snapshot()
         return out
 
     def write_summary(self, *, cohort: int, wall_s: float | None = None) -> dict:
@@ -142,6 +217,8 @@ def bench_line(summary: dict, *, baseline_users_per_sec: float | None = None,
         "evictions": summary.get("evictions"),
         "phase_wall_s": summary.get("phase_wall_s"),
     }
+    if summary.get("per_bucket") is not None:
+        line["per_bucket"] = summary["per_bucket"]
     if extra:
         line.update(extra)
     return line

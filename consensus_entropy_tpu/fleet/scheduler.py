@@ -29,6 +29,19 @@ Determinism: each user's trajectory is produced by the same statements in
 the same per-user order as ``ALLoop.run_user`` (shared generator), so a
 fleet run reproduces N sequential runs' results exactly — scheduling only
 changes which wall-clock instant each user's next step runs at.
+
+**The engine surface.**  :meth:`FleetScheduler.run` is a thin composition
+of four lifecycle methods — :meth:`open` / :meth:`admit` / :meth:`pump` /
+:meth:`close` (plus :meth:`abort` on the error path) — that are public so
+a long-running driver can hold the engine open and feed it continuously.
+``serve.FleetServer`` is that driver: it admits a new user the moment a
+session finishes (the device batch never drains at cohort tails) and pins
+each user's pool pad to a power-of-two BUCKET width at admission, so each
+bucket dispatches as its own stacked call (``scoring_by_width=True``
+routes multi-session groups through the per-width jit families of
+``ops.scoring.fleet_scoring_fns_for_width``).  A user's pad is pinned for
+the whole run — eviction+resume rebuilds the session at the same width
+(asserted in ``UserSession``), so bucket routing is stable across faults.
 """
 
 from __future__ import annotations
@@ -72,6 +85,12 @@ class _SessionState:
     entry: FleetUser
     session: UserSession
     gen: object
+    #: the ``pad_pool_to`` this user was admitted at — pinned for the whole
+    #: run; resume-after-eviction rebuilds at exactly this width
+    pad: int | None = None
+    #: the acquirer's realized padded width (``acq.n_pad``) — the dispatch
+    #: bucket this session's scoring calls group under
+    n_pad: int = 0
     started: bool = False
     resumes: int = 0
 
@@ -88,7 +107,11 @@ class FleetScheduler:
     one padded shape and batch into one vmapped dispatch (padding never
     changes selections; see ``Acquirer``/``test_mc_with_padding``).
     ``user_timings``: write each session's ``timings.jsonl`` into its
-    workspace (the sequential CLI's surface)."""
+    workspace (the sequential CLI's surface).  ``scoring_by_width``: route
+    multi-session score groups through the per-bucket jit families
+    (``ops.scoring.fleet_scoring_fns_for_width``) instead of the shared
+    fleet fns — the serve layer turns this on so each admission bucket owns
+    its compiled programs and mis-routed widths fail loudly."""
 
     def __init__(self, config: ALConfig, *, tie_break: str = "fast",
                  retrain_epochs: int | None = None,
@@ -97,7 +120,8 @@ class FleetScheduler:
                  pad_pool_to: int | None = None, preemption=None,
                  report: FleetReport | None = None,
                  user_timings: bool = True,
-                 batch_window_s: float = 0.0):
+                 batch_window_s: float = 0.0,
+                 scoring_by_width: bool = False):
         self.config = config
         self.tie_break = tie_break
         self.retrain_epochs = retrain_epochs
@@ -108,6 +132,7 @@ class FleetScheduler:
         self.preemption = preemption
         self.report = report or FleetReport()
         self.user_timings = user_timings
+        self.scoring_by_width = scoring_by_width
         #: before dispatching a partially-full score batch while host work
         #: is still in flight, wait up to this long for more sessions to
         #: reach their ScoreStep — trades latency for device-batch
@@ -117,10 +142,116 @@ class FleetScheduler:
         #: of window buys near-full cohort batches — measured occupancy
         #: 0.17→1.0 at cohort 6 with a 10 ms window.
         self.batch_window_s = batch_window_s
+        self._opened = False
+
+    # -- engine lifecycle --------------------------------------------------
+
+    def open(self, capacity: int) -> None:
+        """Stand the engine up for up to ``capacity`` concurrently-live
+        sessions: worker pools, the ready/score/host queues, the results
+        map.  ``run`` opens at the cohort size; a serving driver opens at
+        its target occupancy and keeps the engine open across admissions.
+        """
+        if self._opened:
+            raise RuntimeError("engine already open")
+        capacity = max(1, capacity)
+        host_n = self.host_workers or min(capacity, os.cpu_count() or 4, 8)
+        ckpt_n = self.ckpt_workers or min(capacity, 4)
+        self._fleet_fns = ops_scoring.make_fleet_scoring_fns(
+            k=self.config.queries, tie_break=self.tie_break)
+        self._results: dict = {}
+        self._host_pool = ThreadPoolExecutor(max_workers=host_n,
+                                             thread_name_prefix="fleet-host")
+        self._ckpt_pool = ThreadPoolExecutor(max_workers=ckpt_n,
+                                             thread_name_prefix="fleet-ckpt")
+        #: (state, value, exc) triples whose generator can be stepped now
+        self._ready: collections.deque = collections.deque()
+        self._live: set = set()
+        self._score_wait: list = []   # (state, ScoreStep)
+        self._host_wait: dict = {}    # Future -> (state, HostStep)
+        self._opened = True
+
+    def admit(self, entry: FleetUser, *, pad: int | None = None
+              ) -> _SessionState:
+        """Add one user to the running engine.  ``pad``: this user's
+        ``pad_pool_to`` — pinned for the whole run (resume-after-eviction
+        rebuilds at the same width); a serving driver passes the user's
+        bucket width here."""
+        st = self._make_session(entry, entry.committee, pad=pad)
+        self._ready.append((st, None, None))
+        return st
+
+    def pump(self) -> bool:
+        """One scheduling round: step every ready session, then either
+        dispatch the blocked score batch or (when only host work remains)
+        block until a host future completes.  Returns False when the
+        engine is idle — no ready, waiting or in-flight session."""
+        if not (self._ready or self._score_wait or self._host_wait):
+            return False
+        while self._ready:
+            state, value, exc = self._ready.popleft()
+            self._live.add(state)
+            self._track(state, self._advance(state, value, exc))
+        if self._score_wait:
+            if self._host_wait and self._drain_host(self.batch_window_s):
+                # sessions finishing host work may be one step from their
+                # own ScoreStep — let them join this batch
+                return True
+            batch, self._score_wait = self._score_wait, []
+            for state, res in self._dispatch_scores(batch):
+                self._ready.append((state, res, None))
+            return True
+        if self._host_wait:
+            self._drain_host(None)
+        return True
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._ready or self._score_wait or self._host_wait)
+
+    @property
+    def n_live(self) -> int:
+        """Sessions currently holding a slot: stepped at least once and
+        neither finished nor evicted (a resumed replacement re-counts when
+        it is first stepped), plus admissions waiting for their first
+        step."""
+        return len(self._live) + sum(1 for s, _, _ in self._ready
+                                     if s not in self._live)
+
+    @property
+    def results(self) -> dict:
+        """``id(entry)`` → result record for every finished or terminally
+        failed user so far (see :meth:`run` for the record schema)."""
+        return self._results
+
+    def abort(self) -> None:
+        """Error-path teardown (``Preempted`` / ``InjectedKill`` /
+        ``KeyboardInterrupt``): drain workers first (they touch session
+        state), then close every live generator so each session's
+        checkpointer joins — all workspaces end durable and resumable."""
+        self._host_pool.shutdown(wait=True)
+        for state in list(self._live):
+            try:
+                state.gen.close()
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        """Join both worker pools and retire the engine.  Generator close
+        (session checkpointer join) precedes the checkpoint pool's
+        shutdown on every path: finished sessions joined their own
+        checkpointer inside the generator, aborted ones in :meth:`abort` —
+        so ``_ckpt_pool.shutdown(wait=True)`` only ever reaps an idle or
+        draining pool, never strands a pending two-phase commit."""
+        self._host_pool.shutdown(wait=True)
+        self._ckpt_pool.shutdown(wait=True)
+        self._opened = False
 
     # -- session plumbing --------------------------------------------------
 
-    def _make_session(self, entry: FleetUser, committee) -> _SessionState:
+    def _make_session(self, entry: FleetUser, committee, *,
+                      pad: int | None = None,
+                      pin_pad: int | None = None) -> _SessionState:
         timer = StepTimer(
             os.path.join(entry.user_path, "timings.jsonl")
             if self.user_timings else None)
@@ -128,9 +259,11 @@ class FleetScheduler:
             self.config, committee, entry.data, entry.user_path,
             seed=entry.seed, tie_break=self.tie_break,
             retrain_epochs=self.retrain_epochs,
-            pad_pool_to=self._pad, timer=timer,
-            preemption=self.preemption, ckpt_executor=self._ckpt_pool)
-        st = _SessionState(entry, session, session.steps())
+            pad_pool_to=pad, timer=timer,
+            preemption=self.preemption, ckpt_executor=self._ckpt_pool,
+            pin_pad=pin_pad)
+        st = _SessionState(entry, session, session.steps(), pad=pad,
+                           n_pad=session.acq.n_pad)
         return st
 
     def _advance(self, state: _SessionState, value=None, exc=None):
@@ -151,6 +284,34 @@ class FleetScheduler:
         except Exception as e:  # Preempted/InjectedKill are BaseException
             self._evict(state, e)
             return None
+
+    def _track(self, state: _SessionState, step) -> None:
+        if step is None:
+            self._live.discard(state)
+        elif isinstance(step, ScoreStep):
+            self._score_wait.append((state, step))
+        else:
+            fut = self._host_pool.submit(step.fn)
+            self._host_wait[fut] = (state, step)
+
+    def _drain_host(self, timeout) -> int:
+        """Move completed host futures back to the ready queue; returns
+        how many completed within ``timeout``."""
+        if not self._host_wait:
+            return 0
+        done, _ = wait(list(self._host_wait), timeout=timeout,
+                       return_when=FIRST_COMPLETED)
+        for fut in done:
+            state, _step = self._host_wait.pop(fut)
+            err = fut.exception()
+            if err is None:
+                self._ready.append((state, fut.result(), None))
+            else:
+                # throw INTO the generator: the session's own error path
+                # runs (report + checkpointer close), exactly as if the
+                # block had raised inline
+                self._ready.append((state, None, err))
+        return len(done)
 
     def _finish(self, state: _SessionState, result: dict) -> None:
         phases = {}
@@ -187,7 +348,11 @@ class FleetScheduler:
                     "committee": None, "resumes": state.resumes,
                     "error": f"{exc!r}; resume reload failed: {load_err!r}"}
                 return
-            new = self._make_session(entry, committee)
+            # the pad is pinned per RUN, not per attempt: the resumed
+            # session must land in the same dispatch bucket (UserSession
+            # asserts the realized width)
+            new = self._make_session(entry, committee, pad=state.pad,
+                                     pin_pad=state.n_pad)
             new.resumes = state.resumes + 1
             self.report.event("resume", user=str(entry.user_id),
                               attempt=new.resumes)
@@ -213,7 +378,24 @@ class FleetScheduler:
             return ops_scoring.stack_user_keys(vals)
         return jnp.stack([jnp.asarray(v) for v in vals])
 
-    def _dispatch_scores(self, steps: list[ScoreStep], n_live: int):
+    def _group_fns(self, width: int) -> dict:
+        """The vmapped scorer family for one dispatch group: the shared
+        fleet fns, or the per-bucket width-guarded family when the driver
+        admits by bucket."""
+        if not self.scoring_by_width:
+            return self._fleet_fns
+        return ops_scoring.fleet_scoring_fns_for_width(
+            k=self.config.queries, tie_break=self.tie_break, width=width)
+
+    def _active_in_bucket(self, width: int) -> int:
+        """Live sessions padded to ``width`` — the denominator a bucket's
+        dispatch occupancy is measured against.  Only sessions still
+        holding a slot count: finished and evicted sessions left
+        ``_live`` the moment their generator returned, so a drained or
+        faulted user never dilutes later dispatches' occupancy."""
+        return sum(1 for s in self._live if s.n_pad == width)
+
+    def _dispatch_scores(self, steps: list):
         """Service a round of ScoreSteps: group by (scorer, shapes), run
         each multi-session group as ONE vmapped dispatch, singletons
         through the session's own single-user fns.  Returns
@@ -222,8 +404,10 @@ class FleetScheduler:
         for st, step in steps:
             key = (step.fn_key,) + tuple(self._sig(x) for x in step.inputs)
             groups[key].append((st, step))
+        n_live = len(self._live)
         out = []
         for group in groups.values():
+            width = group[0][0].n_pad
             t0 = time.perf_counter()
             if len(group) == 1:
                 st, step = group[0]
@@ -234,16 +418,23 @@ class FleetScheduler:
                 stacked = [self._stack([step.inputs[pos]
                                         for _, step in group])
                            for pos in range(len(group[0][1].inputs))]
-                batched = self._fleet_fns[fn_key](*stacked)
+                batched = self._group_fns(width)[fn_key](*stacked)
                 for i, (st, _) in enumerate(group):
                     out.append((st, ops_scoring.ScoreResult(
                         batched.entropy[i], batched.values[i],
                         batched.indices[i])))
-            self.report.dispatch(group[0][1].fn_key, len(group), n_live,
-                                 time.perf_counter() - t0)
+            # width tags only BUCKETED dispatches: a plain fleet cohort is
+            # one width by construction and its summaries/BENCH artifacts
+            # must not grow a per-bucket section
+            self.report.dispatch(
+                group[0][1].fn_key, len(group),
+                self._active_in_bucket(width) if self.scoring_by_width
+                else n_live,
+                time.perf_counter() - t0,
+                width=width if self.scoring_by_width else None)
         return out
 
-    # -- the scheduling loop -----------------------------------------------
+    # -- the cohort driver -------------------------------------------------
 
     def run(self, users: list[FleetUser]) -> list[dict]:
         """Run the cohort to completion; returns one record per input user
@@ -253,89 +444,22 @@ class FleetScheduler:
         """
         if not users:
             return []
-        self._pad = self.pad_pool_to
-        if self._pad is None:
+        pad = self.pad_pool_to
+        if pad is None:
             # one fixed width across the cohort: every user's scoring
             # inputs then share a shape and batch into one dispatch
-            self._pad = max(u.data.pool.n_songs for u in users)
-        self._fleet_fns = ops_scoring.make_fleet_scoring_fns(
-            k=self.config.queries, tie_break=self.tie_break)
-        n = len(users)
-        host_n = self.host_workers or min(n, os.cpu_count() or 4, 8)
-        ckpt_n = self.ckpt_workers or min(n, 4)
-        self._results = {}
-        host_pool = ThreadPoolExecutor(max_workers=host_n,
-                                       thread_name_prefix="fleet-host")
-        self._ckpt_pool = ThreadPoolExecutor(max_workers=ckpt_n,
-                                             thread_name_prefix="fleet-ckpt")
-        #: (state, value, exc) triples whose generator can be stepped now
-        self._ready = collections.deque()
-        live_states: set = set()
+            pad = max(u.data.pool.n_songs for u in users)
+        self.open(len(users))
         try:
             for u in users:
-                st = self._make_session(u, u.committee)
-                self._ready.append((st, None, None))
-            score_wait: list = []   # (state, ScoreStep)
-            host_wait: dict = {}    # Future -> (state, HostStep)
-
-            def track(state, step):
-                if step is None:
-                    live_states.discard(state)
-                elif isinstance(step, ScoreStep):
-                    score_wait.append((state, step))
-                else:
-                    fut = host_pool.submit(step.fn)
-                    host_wait[fut] = (state, step)
-
-            def drain_host(timeout):
-                """Move completed host futures back to the ready queue;
-                returns how many completed within ``timeout``."""
-                if not host_wait:
-                    return 0
-                done, _ = wait(list(host_wait), timeout=timeout,
-                               return_when=FIRST_COMPLETED)
-                for fut in done:
-                    state, _step = host_wait.pop(fut)
-                    err = fut.exception()
-                    if err is None:
-                        self._ready.append((state, fut.result(), None))
-                    else:
-                        # throw INTO the generator: the session's own
-                        # error path runs (report + checkpointer close),
-                        # exactly as if the block had raised inline
-                        self._ready.append((state, None, err))
-                return len(done)
-
-            while self._ready or score_wait or host_wait:
-                while self._ready:
-                    state, value, exc = self._ready.popleft()
-                    live_states.add(state)
-                    track(state, self._advance(state, value, exc))
-                if score_wait:
-                    if host_wait and drain_host(self.batch_window_s):
-                        # sessions finishing host work may be one step from
-                        # their own ScoreStep — let them join this batch
-                        continue
-                    # the blocked ScoreSteps are this round's device batch
-                    n_live = len(live_states)
-                    batch, score_wait = score_wait, []
-                    for state, res in self._dispatch_scores(batch, n_live):
-                        self._ready.append((state, res, None))
-                    continue
-                drain_host(None)
+                self.admit(u, pad=pad)
+            while self.pump():
+                pass
         except BaseException:
-            # Preempted / InjectedKill / KeyboardInterrupt: stop the fleet.
-            # Drain workers first (they touch session state), then close
-            # every live generator so each session's checkpointer joins —
-            # all workspaces end durable and resumable.
-            host_pool.shutdown(wait=True)
-            for state in list(live_states):
-                try:
-                    state.gen.close()
-                except Exception:
-                    pass
+            # Preempted / InjectedKill / KeyboardInterrupt: stop the fleet
+            # with every workspace durable and resumable.
+            self.abort()
             raise
         finally:
-            host_pool.shutdown(wait=True)
-            self._ckpt_pool.shutdown(wait=True)
+            self.close()
         return [self._results[id(u)] for u in users]

@@ -113,7 +113,7 @@ class UserSession:
                  retrain_epochs: int | None = None, mesh=None,
                  pad_pool_to: int | None = None, resume: bool = True,
                  timer: StepTimer | None = None, preemption=None,
-                 ckpt_executor=None):
+                 ckpt_executor=None, pin_pad: int | None = None):
         from consensus_entropy_tpu.al.loop import AsyncCheckpointer
 
         cfg = config
@@ -171,6 +171,17 @@ class UserSession:
                             queries=cfg.queries, mode=cfg.mode,
                             tie_break=tie_break, seed=self.seed, mesh=mesh,
                             pad_to=pad_pool_to)
+        if pin_pad is not None and self.acq.n_pad != pin_pad:
+            # A user's padded pool width is part of its run identity: the
+            # scheduler pins it at first admission, and a resumed session
+            # (eviction, preemption) must land on the SAME width — a
+            # drifted pad would re-route the user to a different dispatch
+            # bucket mid-run and retrace its scoring graphs.  Fail loud:
+            # this is a scheduler bug, not a recoverable fault.
+            raise ValueError(
+                f"pinned pool pad drifted on resume: this run admitted "
+                f"user {data.user_id!r} at width {pin_pad}, rebuild "
+                f"padded to {self.acq.n_pad}")
         self.acq.replay(self.queried_hist)
 
         self.ckpt = AsyncCheckpointer(executor=ckpt_executor)

@@ -201,8 +201,10 @@ def make_fleet_scoring_fns(*, k: int,
     return _make_fleet_scoring_fns_cached(k, tie_break)
 
 
-@functools.lru_cache(maxsize=None)
-def _make_fleet_scoring_fns_cached(k: int, tie_break: str) -> dict[str, Callable]:
+def _fleet_base_fns(k: int, tie_break: str) -> dict[str, Callable]:
+    """The un-jitted per-user scorer family every fleet variant vmaps —
+    ONE definition shared by the process-wide fleet fns and the per-width
+    bucket families, so the two can never diverge."""
     def _mc(probs, pool_mask):
         return score_mc(probs, pool_mask, k=k, tie_break=tie_break)
 
@@ -227,12 +229,70 @@ def _make_fleet_scoring_fns_cached(k: int, tie_break: str) -> dict[str, Callable
     def _rand(key, pool_mask):
         return score_rand(key, pool_mask, k=k)
 
-    def vj(fn):
-        return jax.jit(jax.vmap(fn))
+    return {"mc": _mc, "mc_masked": _mc_masked, "hc": _hc,
+            "hc_pre": _hc_pre, "mix": _mix, "mix_masked": _mix_masked,
+            "rand": _rand}
 
-    return {"mc": vj(_mc), "mc_masked": vj(_mc_masked), "hc": vj(_hc),
-            "hc_pre": vj(_hc_pre), "mix": vj(_mix),
-            "mix_masked": vj(_mix_masked), "rand": vj(_rand)}
+
+@functools.lru_cache(maxsize=None)
+def _make_fleet_scoring_fns_cached(k: int, tie_break: str) -> dict[str, Callable]:
+    return {key: jax.jit(jax.vmap(fn))
+            for key, fn in _fleet_base_fns(k, tie_break).items()}
+
+
+#: which positional operand of each fleet scorer carries the (U, N) pool
+#: mask — the operand whose trailing dim IS the padded pool width (the
+#: member mask of the ``*_masked`` variants is (U, M) and must not be used)
+_POOL_MASK_POS = {"mc": 1, "mc_masked": 1, "hc": 1, "hc_pre": 1,
+                  "mix": 1, "mix_masked": 1, "rand": 1}
+
+
+def fleet_scoring_fns_for_width(*, k: int, tie_break: str = "fast",
+                                width: int) -> dict[str, Callable]:
+    """Per-BUCKET fleet scorers: the :func:`make_fleet_scoring_fns` graphs,
+    but one SEPARATE family of jit wrappers per padded pool ``width``.
+
+    The serve layer admits users into power-of-two pool-width buckets and
+    dispatches one stacked scoring call per bucket per mode
+    (``serve.FleetServer``).  Sharing one jit object across buckets would
+    work — jit specializes on shapes — but keying the fns on the width
+    buys two things a long-running admission service needs:
+
+    - **bucket-routing guard**: every call host-checks that the pool-mask
+      operand's trailing dim equals the bucket width, so a mis-routed
+      session fails loudly at dispatch instead of silently compiling (and
+      forever re-dispatching) an off-bucket program;
+    - **independent executable lifetime**: each bucket's compiled programs
+      live in their own jit caches, so retiring a bucket (or bounding a
+      serve process's compile memory) never touches the other buckets'
+      hot executables.
+
+    Cached per (k, tie_break, width) process-wide — one wrapper family per
+    bucket, not per admission.  Callers must not mutate the returned dict.
+    """
+    return _fleet_fns_for_width_cached(k, tie_break, width)
+
+
+@functools.lru_cache(maxsize=None)
+def _fleet_fns_for_width_cached(k: int, tie_break: str,
+                                width: int) -> dict[str, Callable]:
+    base = {key: jax.jit(jax.vmap(fn))
+            for key, fn in _fleet_base_fns(k, tie_break).items()}
+
+    def guarded(fn_key, fn):
+        pos = _POOL_MASK_POS[fn_key]
+
+        def call(*args):
+            got = args[pos].shape[-1]
+            if got != width:
+                raise ValueError(
+                    f"bucket routing error: {fn_key!r} scorer for pool "
+                    f"width {width} got inputs of width {got}")
+            return fn(*args)
+
+        return call
+
+    return {key: guarded(key, fn) for key, fn in base.items()}
 
 
 def stack_user_keys(keys) -> jax.Array:

@@ -1,0 +1,40 @@
+"""Serve layer: continuous-batching admission on top of the fleet engine.
+
+The fleet scheduler (PR 2) runs FIXED cohorts: the device batch drains as
+each cohort's tail finishes, and every user pays the cohort-max pool pad.
+Both are batch-job artifacts — committee-based AL at many-user scale is a
+long-lived multi-tenant service (PAPERS.md: "Active Multitask Learning
+with Committees"; "Wisdom of Committees" on amortizing committee cost),
+and this package runs it like one:
+
+- :mod:`serve.buckets` — pool-width BUCKETING: users are padded to a
+  power-of-two (or operator-chosen) bucket edge at admission instead of
+  the cohort max, so a 150-song user in a fleet with one 600-song user no
+  longer scores 600 padded rows; each bucket dispatches as its own
+  stacked vmapped call per mode (``ops.scoring.fleet_scoring_fns_for_width``).
+- :mod:`serve.server` — the admission layer: a bounded waiting queue with
+  backpressure, top-up admission the moment a session finishes (the
+  engine never drains below the occupancy target at tails), an admission
+  window for gang phase-alignment, and drain semantics — SIGTERM stops
+  admission, finishes the in-flight sessions, and surfaces ``Preempted``
+  so the CLI exits ``EXIT_PREEMPTED`` (75) with every queued user
+  untouched and every finished user durable.  Terminally-failed users are
+  recorded without stalling admission.
+
+Parity is inherited, not re-proven: the server drives the SAME engine
+(``FleetScheduler.open/admit/pump``) over the SAME session generators,
+and padding never changes selections, so per-user results under ``--serve``
+are bit-identical to the sequential loop (pinned for all four modes,
+including eviction+resume, by ``tests/test_serve.py``).
+"""
+
+from consensus_entropy_tpu.serve.buckets import BucketRouter
+from consensus_entropy_tpu.serve.server import (
+    AdmissionQueue,
+    FleetServer,
+    QueueFull,
+    ServeConfig,
+)
+
+__all__ = ["AdmissionQueue", "BucketRouter", "FleetServer", "QueueFull",
+           "ServeConfig"]

@@ -1,0 +1,65 @@
+"""Pool-width bucketing for admission-time padding.
+
+The fleet's cohort-max pad (``FleetScheduler.run``) makes every user's
+scoring inputs share ONE shape — maximal batching, but on skewed user
+sizes the small users carry the big users' padding for the whole run
+(ROADMAP: "fleet-aware bucketing").  The serve layer instead pins each
+user, at admission, to the smallest BUCKET edge that fits its pool;
+same-bucket sessions still stack into one vmapped dispatch per mode
+(shapes equal ⇒ same dispatch group), and cross-bucket waste is bounded
+by the bucket geometry instead of the cohort's largest user.
+
+Power-of-two edges (the default) bound per-user padding waste below 2×
+its own pool — never the cohort max — while keeping the number of
+distinct compiled widths logarithmic in the size spread.  Operators with
+a known size distribution pass explicit edges (``--bucket-widths``) to
+cut the waste further.
+"""
+
+from __future__ import annotations
+
+from consensus_entropy_tpu.utils import round_up as _round_up
+
+#: every bucket edge is a multiple of this, matching the acquirer's
+#: ``pad_multiple`` — the realized ``Acquirer.n_pad`` then EQUALS the
+#: bucket width, so dispatch grouping, the per-width jit families and the
+#: report's bucket labels all agree on one number
+PAD_MULTIPLE = 8
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= max(n, PAD_MULTIPLE)."""
+    return max(PAD_MULTIPLE, 1 << (max(int(n), 1) - 1).bit_length())
+
+
+class BucketRouter:
+    """Maps a user's pool size to its admission bucket width.
+
+    ``widths``: explicit ascending bucket edges (each rounded up to
+    ``PAD_MULTIPLE``); a pool larger than every edge falls through to the
+    next power of two, so routing is total — an oversized user gets a
+    private width rather than an error or a silent cohort-max fallback.
+    ``None`` (default): pure power-of-two edges.
+    """
+
+    def __init__(self, widths=None):
+        if widths is None:
+            self.widths: tuple[int, ...] = ()
+        else:
+            edges = sorted({_round_up(int(w), PAD_MULTIPLE)
+                            for w in widths})
+            if not edges or edges[0] <= 0:
+                raise ValueError(f"bucket widths must be positive ints, "
+                                 f"got {widths!r}")
+            self.widths = tuple(edges)
+
+    def width_for(self, n_songs: int) -> int:
+        """The bucket edge this pool size pads to."""
+        for w in self.widths:
+            if w >= n_songs:
+                return w
+        return next_pow2(n_songs)
+
+    def __repr__(self) -> str:
+        return (f"BucketRouter(widths={list(self.widths)})" if self.widths
+                else "BucketRouter(pow2)")

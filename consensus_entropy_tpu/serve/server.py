@@ -1,0 +1,334 @@
+"""The admission layer: a long-running driver for the fleet engine.
+
+``FleetServer`` holds a :class:`~consensus_entropy_tpu.fleet.scheduler.
+FleetScheduler` open (``open``/``admit``/``pump``/``close``) and feeds it
+continuously:
+
+- **Continuous batching** — the moment a session finishes (or fails
+  terminally), the freed slot is refilled from the waiting queue, so the
+  stacked device dispatches never drain below the occupancy target the
+  way fixed cohorts drain at their tails.
+- **Bucketed padding** — each user's pool pad is pinned at admission to a
+  :class:`~consensus_entropy_tpu.serve.buckets.BucketRouter` edge; the
+  engine's shape-grouping then dispatches one stacked call per bucket per
+  mode through the per-width jit families
+  (``FleetScheduler(scoring_by_width=True)``).
+- **Backpressure** — the waiting queue is bounded
+  (:class:`AdmissionQueue`); a full queue rejects ``submit`` with
+  :class:`QueueFull` instead of buffering unboundedly, and the pull-path
+  (``serve(source)``) simply stops drawing from the iterator until a slot
+  frees, so a slow fleet propagates backpressure to the producer.
+- **Drain** — when the preemption guard trips (SIGTERM/SIGINT), admission
+  stops, in-flight sessions run to completion (their workspaces are then
+  durable AND final — no resume debt), queued users are left untouched,
+  and ``Preempted`` is raised so the CLI exits ``EXIT_PREEMPTED`` (75);
+  a rerun picks the queued users up from their unstarted workspaces.
+
+Sessions run WITHOUT the guard (the server owns preemption), so a drain
+finishes in-flight work instead of tearing it down mid-iteration — the
+constructor rejects a scheduler that would hand the guard to sessions.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+
+from consensus_entropy_tpu.fleet.scheduler import FleetScheduler, FleetUser
+from consensus_entropy_tpu.serve.buckets import BucketRouter
+
+
+class QueueFull(RuntimeError):
+    """The bounded waiting queue rejected an enqueue (backpressure)."""
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Admission policy knobs.
+
+    ``target_live``: occupancy target — the server tops the engine up to
+    this many concurrently-live sessions whenever slots free.
+    ``max_queue``: waiting-room bound (backpressure past it).
+    ``admit_window_s``: with free slots and an EMPTY queue while intake is
+    still open, wait up to this long for arrivals before idling on — a
+    gang of users admitted together phase-aligns into one bucket dispatch,
+    where one-at-a-time trickle admission would stagger them (the
+    admission-side sibling of the engine's ``batch_window_s``).
+    ``bucket_widths``: explicit bucket edges, or ``None`` for powers of
+    two (see :class:`BucketRouter`).
+    """
+
+    target_live: int = 4
+    max_queue: int = 64
+    admit_window_s: float = 0.0
+    bucket_widths: tuple | None = None
+
+    def __post_init__(self):
+        if self.target_live < 1:
+            raise ValueError(f"target_live must be >= 1, "
+                             f"got {self.target_live}")
+        if self.max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
+
+
+class AdmissionQueue:
+    """Bounded FIFO waiting room; thread-safe (producers may ``put`` from
+    other threads while the serve loop pops).  Entries carry their
+    enqueue timestamp so admission latency is measurable."""
+
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self._q: collections.deque = collections.deque()
+        self._cond = threading.Condition()
+
+    def put(self, entry: FleetUser) -> int:
+        """Enqueue; returns the depth AFTER.  Raises :class:`QueueFull`
+        at the bound — the caller (a producer) must back off."""
+        with self._cond:
+            if len(self._q) >= self.maxsize:
+                raise QueueFull(
+                    f"admission queue is at its bound ({self.maxsize}); "
+                    "retry after sessions drain")
+            self._q.append((entry, time.perf_counter()))
+            self._cond.notify_all()
+            return len(self._q)
+
+    def try_put(self, entry: FleetUser) -> int | None:
+        """:meth:`put` that returns ``None`` instead of raising at the
+        bound — the check and the append are one critical section, so a
+        concurrent producer filling the last slot cannot turn the serve
+        loop's own refill into an exception."""
+        try:
+            return self.put(entry)
+        except QueueFull:
+            return None
+
+    def pop(self):
+        """``(entry, enqueue_t)`` or ``None`` when empty."""
+        with self._cond:
+            return self._q.popleft() if self._q else None
+
+    def wait_nonempty(self, timeout: float) -> bool:
+        with self._cond:
+            return self._cond.wait_for(lambda: bool(self._q),
+                                       timeout=timeout)
+
+    def wait_at_least(self, n: int, timeout: float) -> bool:
+        """Block until the queue holds ``n`` entries or ``timeout``
+        elapses — the admission-window primitive: arrivals landing within
+        the window gang into one admission (and thus phase-align into one
+        bucket dispatch) instead of trickling in one at a time."""
+        with self._cond:
+            return self._cond.wait_for(lambda: len(self._q) >= n,
+                                       timeout=timeout)
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+
+class FleetServer:
+    """Drive one fleet engine as a continuously-admitted service.
+
+    ``scheduler``: a :class:`FleetScheduler` built for serving —
+    ``scoring_by_width=True``, ``preemption=None`` (the server owns the
+    guard; a scheduler that would hand it to sessions is rejected, see
+    module docstring).  ``preemption``: optional guard object with a
+    boolean ``requested`` (``resilience.preemption.PreemptionGuard``).
+
+    After :meth:`serve` returns (or raises ``Preempted`` post-drain),
+    ``self.results`` holds the per-user records in admission order —
+    the same schema as ``FleetScheduler.run``.
+    """
+
+    def __init__(self, scheduler: FleetScheduler, config: ServeConfig, *,
+                 preemption=None):
+        if scheduler.preemption is not None:
+            raise ValueError(
+                "serve mode owns preemption: build the FleetScheduler with "
+                "preemption=None and pass the guard to FleetServer — "
+                "sessions holding the guard would abort mid-drain instead "
+                "of finishing")
+        self.scheduler = scheduler
+        self.config = config
+        self.preemption = preemption
+        self.router = BucketRouter(config.bucket_widths)
+        self.queue = AdmissionQueue(config.max_queue)
+        self.report = scheduler.report
+        self.results: list[dict] = []
+        self._admitted: list[FleetUser] = []
+        self._pending: set[int] = set()
+        #: one pulled-but-unqueued entry held when a concurrent submit()
+        #: filled the queue's last slot between our pull and our put
+        self._spill: FleetUser | None = None
+        self._draining = False
+        self._intake_open = True
+
+    # -- producer surface --------------------------------------------------
+
+    def submit(self, entry: FleetUser) -> int:
+        """Thread-safe enqueue for external producers; returns queue depth.
+        Raises :class:`QueueFull` at the bound and ``RuntimeError`` once
+        the server is draining or its intake closed."""
+        if self._draining or not self._intake_open:
+            raise RuntimeError("server is draining; not accepting users")
+        depth = self.queue.put(entry)
+        self.report.enqueued(entry.user_id, depth)
+        return depth
+
+    def close_intake(self) -> None:
+        """No further ``submit``s: :meth:`serve` returns once the queue
+        and the engine drain."""
+        self._intake_open = False
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # -- the serve loop ----------------------------------------------------
+
+    def serve(self, source=(), *, on_result=None,
+              keep_open: bool = False) -> list[dict]:
+        """Run until every admitted and queued user finished.
+
+        ``source``: iterator of :class:`FleetUser` — pulled LAZILY as queue
+        room frees (expensive per-user setup like workspace creation then
+        happens just-in-time, and backpressure reaches the producer).
+        ``on_result``: called with each user's record the moment it
+        finishes (success or terminal failure) — a serving driver persists
+        completed users immediately instead of at end-of-run.
+        ``keep_open``: leave intake open after ``source`` exhausts
+        (threaded producers; pair with :meth:`close_intake`).
+
+        On preemption: finishes in-flight sessions, then raises
+        ``Preempted`` (queued users untouched, ``self.results`` complete
+        for every admitted user).
+        """
+        from consensus_entropy_tpu.resilience.preemption import Preempted
+
+        sched = self.scheduler
+        cfg = self.config
+        src = iter(source)
+        src_live = True
+        sched.open(cfg.target_live)
+        try:
+            while True:
+                if (self.preemption is not None
+                        and self.preemption.requested
+                        and not self._draining):
+                    self._draining = True
+                    self.report.event(
+                        "drain", queued=len(self.queue),
+                        live=sched.n_live,
+                        reason="preemption requested; finishing in-flight "
+                               "sessions, queue left for the rerun")
+                if not self._draining:
+                    src_live = self._refill(src, src_live)
+                    if not src_live and not keep_open:
+                        self._intake_open = False
+                    if (cfg.admit_window_s > 0 and not sched.has_work
+                            and self._intake_open
+                            and len(self.queue) < cfg.target_live):
+                        # idle engine, open intake, short queue: hold the
+                        # admission window open so arrivals GANG into one
+                        # phase-aligned admission (one stacked bucket
+                        # dispatch) instead of trickling in one at a time.
+                        # Bounded, so a drain request is seen at worst one
+                        # window later; a busy engine never waits here.
+                        self.queue.wait_at_least(cfg.target_live,
+                                                 cfg.admit_window_s)
+                    self._admit_up_to_target()
+                if sched.has_work:
+                    sched.pump()
+                    self._collect(on_result)
+                    continue
+                # engine idle: anything left to wait for?  (a held spill
+                # entry counts as queued — it must not be dropped)
+                if self._draining or (not len(self.queue)
+                                      and self._spill is None
+                                      and not self._intake_open):
+                    break
+                if not len(self.queue):
+                    # free slots, empty queue, open intake: wait (bounded,
+                    # so a drain request is never missed) for an arrival,
+                    # which the next round's admission window may gang
+                    self.queue.wait_nonempty(max(cfg.admit_window_s, 0.05))
+        except BaseException:
+            sched.abort()
+            raise
+        finally:
+            sched.close()
+            self._collect(on_result)
+            # admission-ordered, whatever order completions landed in
+            self.results = [sched.results[id(e)] for e in self._admitted
+                            if id(e) in sched.results]
+        if self._draining:
+            queued = len(self.queue) + (1 if self._spill is not None else 0)
+            raise Preempted(
+                f"drained: {len(self.results)} user(s) finished in-flight, "
+                f"{queued} left queued — rerun to serve them")
+        return self.results
+
+    # -- internals ---------------------------------------------------------
+
+    def _refill(self, src, src_live: bool) -> bool:
+        """Top the waiting queue up from the pull source — never past the
+        producer bound, and no further than one engine's worth
+        (``target_live``), so the source's per-user setup (workspace
+        creation, committee loads) stays just-in-time instead of
+        materializing the whole user list behind a small engine.  A held
+        spill entry is flushed FIRST, unconditionally — it must reach the
+        queue (or keep being held) even after the source exhausts, never
+        be dropped."""
+        want = min(self.queue.maxsize, self.config.target_live)
+        while True:
+            if self._spill is not None:
+                depth = self.queue.try_put(self._spill)
+                if depth is None:  # producers still hold the last slot
+                    return src_live
+                self.report.enqueued(self._spill.user_id, depth)
+                self._spill = None
+            if not src_live or len(self.queue) >= want:
+                return src_live
+            try:
+                self._spill = next(src)
+            except StopIteration:
+                return False
+
+    def _admit_up_to_target(self) -> None:
+        """Refill freed engine slots from the queue — the continuous-
+        batching core: admission happens the moment occupancy dips, not at
+        cohort boundaries."""
+        sched = self.scheduler
+        while sched.n_live < self.config.target_live:
+            item = self.queue.pop()
+            if item is None:
+                return
+            entry, t_enq = item
+            width = self.router.width_for(entry.data.pool.n_songs)
+            sched.admit(entry, pad=width)
+            self._admitted.append(entry)
+            self._pending.add(id(entry))
+            self.report.admitted(
+                entry.user_id, width=width,
+                wait_s=time.perf_counter() - t_enq,
+                depth=len(self.queue), live=sched.n_live)
+
+    def _collect(self, on_result) -> None:
+        """Surface newly-finished users (done or terminally failed) to
+        ``on_result`` the moment they complete, in completion order —
+        the serving driver persists each immediately; the admission-
+        ordered ``self.results`` is assembled once at end of run.
+        Failures release their slot like completions — admission never
+        stalls on a failed user.  Cost is O(in-flight), not O(everything
+        ever admitted)."""
+        if not self._pending:
+            return
+        finished = [eid for eid in self._pending
+                    if eid in self.scheduler.results]
+        for eid in finished:
+            self._pending.discard(eid)
+            if on_result is not None:
+                on_result(self.scheduler.results[eid])

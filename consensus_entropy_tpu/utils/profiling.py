@@ -68,6 +68,45 @@ class StepTimer:
         return rec
 
 
+class RollingStat:
+    """Streaming count/mean/min/max/last aggregator for unbounded event
+    streams (serve-layer queue depth, admission wait): a long-running
+    admission service cannot keep every sample the way :class:`StepTimer`
+    keeps per-iteration records, so this folds each observation into O(1)
+    state and snapshots to a compact dict for the metrics stream."""
+
+    __slots__ = ("n", "total", "min", "max", "last")
+
+    def __init__(self):
+        self.n = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self.last = None
+
+    def add(self, value: float) -> None:
+        v = float(value)
+        self.n += 1
+        self.total += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        self.last = v
+
+    @property
+    def mean(self) -> float | None:
+        return self.total / self.n if self.n else None
+
+    def snapshot(self, ndigits: int = 4) -> dict | None:
+        """``{"n", "mean", "min", "max", "last"}``, or ``None`` before the
+        first observation (absent beats a row of nulls in JSONL)."""
+        if not self.n:
+            return None
+        return {"n": self.n, "mean": round(self.mean, ndigits),
+                "min": round(self.min, ndigits),
+                "max": round(self.max, ndigits),
+                "last": round(self.last, ndigits)}
+
+
 @contextlib.contextmanager
 def trace(trace_dir: str | None):
     """``jax.profiler.trace`` when a directory is given; no-op otherwise."""

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Serve-layer throughput sweep (ISSUE 3 acceptance: users/sec >= the fleet
+# cohort mode on a skewed-pool workload, with per-bucket occupancy).
+#
+# Runs `bench.py --suite serve`: continuous-batching admission
+# (serve.FleetServer — freed slots refilled from the waiting queue the
+# moment a session finishes, each user padded to its bucket edge instead
+# of the cohort max) against BOTH the fixed-cohort fleet scheduler and the
+# sequential ALLoop over identical tail-heavy users (every 4th pool is 4x
+# the rest).  Per the 2-vCPU drift protocol the reps are INTERLEAVED
+# (sequential, fleet-N, serve-N per rep) and each side reports its best
+# (min-wall) rep; per-user trajectory parity with the sequential loop is
+# asserted on every rep before any users/sec number is reported.
+#
+# The JSON line goes to stdout (redirect to BENCH_serve_r<N>.json to
+# commit an artifact); the per-rep log goes to stderr.  Extra bench args
+# pass through, e.g.:
+#   scripts/serve_bench.sh --users 8 --pool 150 --fleet 2 4
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+if [ "$#" -gt 0 ]; then
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --suite serve "$@"
+else
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --suite serve \
+        --users 8 --pool 120 --fleet 4
+fi

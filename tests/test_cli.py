@@ -275,6 +275,76 @@ def test_fleet_rejects_mesh_and_distributed(synth_roots, capsys):
     assert ">= 1" in capsys.readouterr().out
 
 
+def test_serve_flag_validation(synth_roots, capsys):
+    base = ["-q", "4", "-e", "2", "-m", "mc", "-n", "10",
+            "--models-root", synth_roots["models"],
+            "--deam-root", synth_roots["deam"],
+            "--amg-root", synth_roots["amg"], "--device", "cpu"]
+    assert amg_test.main(base + ["--serve", "2", "--fleet", "2"]) == 1
+    assert "exclusive" in capsys.readouterr().out
+    assert amg_test.main(base + ["--serve", "0"]) == 1
+    assert ">= 1" in capsys.readouterr().out
+    assert amg_test.main(base + ["--serve", "2", "--mesh", "auto"]) == 1
+    assert "single-process" in capsys.readouterr().out
+    assert amg_test.main(base + ["--serve", "2", "--pad-pool-to", "64"]) == 1
+    assert "--bucket-widths" in capsys.readouterr().out
+    assert amg_test.main(base + ["--serve", "2",
+                                 "--bucket-widths", "64,abc"]) == 1
+    assert "comma-separated" in capsys.readouterr().out
+    assert amg_test.main(base + ["--bucket-widths", "64"]) == 1
+    assert "requires --serve" in capsys.readouterr().out
+    assert amg_test.main(base + ["--admit-window-ms", "10"]) == 1
+    assert "requires --serve" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+@pytest.mark.serve
+def test_serve_cli_matches_sequential(synth_roots, capsys):
+    """``--serve N`` end to end: identical per-user workspaces/metrics to
+    the sequential CLI (same pretrained committee, same seeds), admission
+    telemetry in fleet_metrics.jsonl; a rerun skips completed users."""
+    import shutil
+
+    flags = ["--deam-root", synth_roots["deam"],
+             "--amg-root", synth_roots["amg"], "--device", "cpu"]
+    seq_mr = os.path.join(synth_roots["models"], "seq")
+    serve_mr = os.path.join(synth_roots["models"], "serve")
+    for model in ("gnb", "sgd"):
+        assert deam_classifier.main(
+            ["-cv", "2", "-m", model, "--models-root", seq_mr] + flags) == 0
+    shutil.copytree(os.path.join(seq_mr, "pretrained"),
+                    os.path.join(serve_mr, "pretrained"))
+    al = ["-q", "4", "-e", "2", "-m", "mc", "-n", "10", "--max-users", "3"]
+    assert amg_test.main(al + ["--models-root", seq_mr] + flags) == 0
+    assert amg_test.main(al + ["--serve", "2", "--bucket-widths", "32,64",
+                               "--models-root", serve_mr] + flags) == 0
+    out = capsys.readouterr().out
+    assert "serve summary:" in out
+    seq_users = os.path.join(seq_mr, "users")
+    serve_users = os.path.join(serve_mr, "users")
+    uids = sorted(os.listdir(seq_users))
+    assert sorted(f for f in os.listdir(serve_users)
+                  if f != "fleet_metrics.jsonl") == uids
+    for uid in uids:
+        sd = os.path.join(seq_users, uid, "mc")
+        fd = os.path.join(serve_users, uid, "mc")
+        assert os.path.exists(os.path.join(fd, "DONE"))
+        seq_recs = [json.loads(l)
+                    for l in open(os.path.join(sd, "metrics.jsonl"))]
+        serve_recs = [json.loads(l)
+                      for l in open(os.path.join(fd, "metrics.jsonl"))]
+        assert serve_recs == seq_recs
+    events = [json.loads(l) for l in
+              open(os.path.join(serve_users, "fleet_metrics.jsonl"))]
+    assert sum(e["event"] == "admit" for e in events) == len(uids)
+    assert sum(e["event"] == "user_done" for e in events) == len(uids)
+    assert events[-1]["event"] == "fleet_summary"
+    # rerun skips every completed user
+    assert amg_test.main(al + ["--serve", "2", "--bucket-widths", "32,64",
+                               "--models-root", serve_mr] + flags) == 0
+    assert "Skipping user" in capsys.readouterr().out
+
+
 def test_pretrain_classic_parallel_folds_match_sequential(tmp_path, rng):
     """n_jobs>1 (the reference's cross_validate(n_jobs=10) fold pool,
     deam_classifier.py:326) must produce identical metrics and artifacts

@@ -305,6 +305,83 @@ def test_fleet_cnn_committee_matches_sequential(tmp_path, rng):
         assert r["result"]["trajectory"] == s["trajectory"]
 
 
+# -- occupancy accounting (active slots only) -----------------------------
+
+
+@pytest.mark.faults
+def test_fleet_occupancy_excludes_finished_and_evicted(tmp_path):
+    """Regression: dispatch records grade occupancy against the slots
+    still ACTIVE at dispatch time — a terminally-failed (or finished)
+    session stops counting the moment its generator returns, instead of
+    diluting every later dispatch for the remainder of the cohort."""
+    cfg = _cfg(mode="mc", epochs=2)
+    entries = []
+    for i in range(3):
+        data = _user_data(100 + i, f"u{i}")
+        committee = (_committee(data, sgd_name="sgd.victim", min_members=2)
+                     if i == 0 else _committee(data))
+        p = tmp_path / f"fleet_u{i}"
+        p.mkdir()
+        entries.append(FleetUser(f"u{i}", committee, data, str(p),
+                                 seed=cfg.seed))  # no factory: terminal
+    sched = FleetScheduler(cfg)
+    with faults.inject(FaultRule("member.retrain", "raise", at=1,
+                                 member="sgd.victim")) as inj:
+        recs = sched.run(entries)
+    assert inj.fired
+    assert recs[0]["error"] is not None
+    ds = sched.report.dispatches
+    # u0 died during epoch 0 (after its only select): no later dispatch
+    # may grade itself against its dead slot
+    assert all(d["active"] <= 3 for d in ds)
+    assert ds[-1]["active"] <= 2
+    assert ds[-1]["batch"] <= ds[-1]["active"]
+    assert 0 < sched.report.occupancy <= 1.0
+
+
+# -- engine teardown ordering ---------------------------------------------
+
+
+@pytest.mark.faults
+def test_abort_teardown_joins_checkpointers_before_pool_shutdown(tmp_path):
+    """Scheduler teardown ordering: on the abort path (one session raises
+    ``Preempted``), every OTHER live generator is closed — joining its
+    session's ``AsyncCheckpointer`` even mid-commit (slowed here with a
+    checkpoint-write delay fault) — BEFORE the shared checkpoint pool is
+    shut down, so every workspace ends durable and resumable."""
+    from consensus_entropy_tpu.al import state as al_state
+    from consensus_entropy_tpu.resilience.preemption import Preempted
+
+    class CountingGuard:
+        def __init__(self, after):
+            self.checks, self.after = 0, after
+
+        @property
+        def requested(self):
+            self.checks += 1
+            return self.checks > self.after
+
+    cfg = _cfg(mode="mc", epochs=2)
+    entries = []
+    for i in range(2):
+        data = _user_data(100 + i, f"u{i}")
+        p = tmp_path / f"fleet_u{i}"
+        p.mkdir()
+        entries.append(FleetUser(f"u{i}", _committee(data), data, str(p),
+                                 seed=cfg.seed))
+    sched = FleetScheduler(cfg, preemption=CountingGuard(1))
+    with faults.inject(FaultRule("checkpoint.write", "delay", at=1,
+                                 times=16, delay_s=0.05)):
+        with pytest.raises(Preempted):
+            sched.run(entries)
+    # the shared pool was reaped only after the joins: nothing pending
+    assert sched._ckpt_pool._shutdown
+    for i in range(2):
+        # each workspace's last two-phase commit landed and is loadable
+        st = al_state.ALState.load(str(tmp_path / f"fleet_u{i}"))
+        assert st is not None
+
+
 # -- AsyncCheckpointer concurrent-session fix (satellite) -----------------
 
 

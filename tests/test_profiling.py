@@ -1,0 +1,89 @@
+"""StepTimer / RollingStat units (utils.profiling).
+
+The timers are the metrics surface every bench and fleet/serve report is
+built on; these pin the accumulation semantics (phase nesting, re-entry,
+flush schema, the ``None``-path no-op) that the AL loop and the serve
+telemetry rely on.
+"""
+
+import json
+import time
+
+from consensus_entropy_tpu.utils.profiling import RollingStat, StepTimer
+
+
+def test_step_timer_accumulates_reentrant_phases(tmp_path):
+    t = StepTimer(str(tmp_path / "t.jsonl"))
+    for _ in range(3):
+        with t.phase("score"):
+            time.sleep(0.002)
+    rec = t.flush(epoch=0)
+    assert rec["epoch"] == 0
+    assert rec["score_s"] >= 3 * 0.002  # three entries summed into one key
+
+
+def test_step_timer_nested_phases_time_independently(tmp_path):
+    """An inner phase's wall-clock is ALSO inside the outer's (phases are
+    plain wall windows, not exclusive self-time) — the AL loop nests
+    ``checkpoint`` inside iteration boundaries and sums them knowingly."""
+    t = StepTimer(None)
+    with t.phase("outer"):
+        time.sleep(0.002)
+        with t.phase("inner"):
+            time.sleep(0.004)
+    rec = t.flush()
+    assert rec["inner_s"] >= 0.004
+    assert rec["outer_s"] >= rec["inner_s"]
+
+
+def test_step_timer_phase_records_on_exception(tmp_path):
+    t = StepTimer(None)
+    try:
+        with t.phase("boom"):
+            time.sleep(0.002)
+            raise RuntimeError("x")
+    except RuntimeError:
+        pass
+    assert t.flush()["boom_s"] >= 0.002  # finally-path accumulation
+
+
+def test_step_timer_flush_schema_and_reset(tmp_path):
+    path = tmp_path / "t.jsonl"
+    t = StepTimer(str(path))
+    with t.phase("a"):
+        pass
+    t.add("bg", 1.5)
+    rec1 = t.flush(user="u0", epoch=3, queried=10)
+    # labels verbatim, durations suffixed _s and rounded to 6 places
+    assert set(rec1) == {"user", "epoch", "queried", "a_s", "bg_s"}
+    assert rec1["bg_s"] == 1.5
+    assert rec1["a_s"] == round(rec1["a_s"], 6)
+    # the accumulator resets per flush; records list keeps history
+    rec2 = t.flush(epoch=4)
+    assert "a_s" not in rec2 and "bg_s" not in rec2
+    assert t.records == [rec1, rec2]
+    lines = [json.loads(l) for l in open(path)]
+    assert lines == [rec1, rec2]
+
+
+def test_step_timer_none_path_writes_nothing(tmp_path, monkeypatch):
+    """StepTimer(None) is the in-memory no-op sink: no file I/O at all
+    (fleet sessions run with user_timings=False on every bench rep)."""
+    monkeypatch.chdir(tmp_path)
+    t = StepTimer(None)
+    with t.phase("a"):
+        pass
+    rec = t.flush(epoch=0)
+    assert rec["a_s"] >= 0
+    assert t.records == [rec]
+    assert list(tmp_path.iterdir()) == []  # nothing written anywhere
+
+
+def test_rolling_stat_folds_and_snapshots():
+    s = RollingStat()
+    assert s.snapshot() is None and s.mean is None  # pre-observation
+    for v in (3.0, 1.0, 2.0):
+        s.add(v)
+    snap = s.snapshot()
+    assert snap == {"n": 3, "mean": 2.0, "min": 1.0, "max": 3.0,
+                    "last": 2.0}

@@ -1,0 +1,424 @@
+"""Serve layer: continuous-batching admission vs sequential ground truth.
+
+Tier-1 (un-marked) keeps only the 3-user admission smoke, the bucket-
+parity test and the pure-host units, per the tier-1 budget; the full mode
+matrix, the eviction+resume drill, the drain drill and the threaded-
+producer test are ``slow`` (``scripts/serve_bench.sh`` exercises
+throughput).
+
+Parity is exact (``==`` on float lists): the server drives the SAME
+engine over the SAME session generators as the fleet/sequential paths,
+and padding (bucket edges included) never changes selections — so there
+is no tolerance to grant.
+"""
+
+import json
+import os
+
+import pytest
+
+from consensus_entropy_tpu.al import workspace
+from consensus_entropy_tpu.al.loop import ALLoop
+from consensus_entropy_tpu.config import ALConfig
+from consensus_entropy_tpu.fleet import FleetReport, FleetScheduler, FleetUser
+from consensus_entropy_tpu.ops import scoring
+from consensus_entropy_tpu.resilience import faults
+from consensus_entropy_tpu.resilience.faults import FaultRule
+from consensus_entropy_tpu.serve import (
+    AdmissionQueue,
+    BucketRouter,
+    FleetServer,
+    QueueFull,
+    ServeConfig,
+)
+from tests.test_fleet import _cfg, _committee, _user_data
+
+pytestmark = pytest.mark.serve
+
+
+def _baselines_and_entries(tmp_path, cfg, specs, *, committee_fn=_committee,
+                           run_seq=True):
+    """Sequential ground-truth runs + fresh serve entries over identical
+    inputs.  ``specs``: list of (seed, uid, n_songs)."""
+    seq, entries = [], []
+    for seed, uid, n_songs in specs:
+        data = _user_data(seed, uid, n_songs=n_songs)
+        if run_seq:
+            p = tmp_path / f"seq_{uid}"
+            p.mkdir()
+            seq.append(ALLoop(cfg).run_user(committee_fn(data), data,
+                                            str(p)))
+        fp = tmp_path / f"serve_{uid}"
+        fp.mkdir()
+        entries.append(FleetUser(
+            uid, committee_fn(data), data, str(fp), seed=cfg.seed,
+            committee_factory=lambda fp=fp: workspace.load_committee(
+                str(fp))))
+    return seq, entries
+
+
+def _serve(cfg, entries, *, serve_cfg=None, preemption=None, report=None,
+           scheduler_kw=None):
+    sched = FleetScheduler(cfg, report=report or FleetReport(),
+                           scoring_by_width=True, **(scheduler_kw or {}))
+    server = FleetServer(sched, serve_cfg or ServeConfig(target_live=2),
+                         preemption=preemption)
+    recs = server.serve(iter(entries))
+    return recs, server
+
+
+# -- pure-host units (no jax) ---------------------------------------------
+
+
+def test_bucket_router_pow2_and_explicit_edges():
+    pow2 = BucketRouter()
+    assert [pow2.width_for(n) for n in (1, 8, 9, 100, 257)] == \
+        [8, 8, 16, 128, 512]
+    r = BucketRouter(widths=(30, 100))  # edges round up to multiples of 8
+    assert r.widths == (32, 104)
+    assert r.width_for(20) == 32
+    assert r.width_for(33) == 104
+    assert r.width_for(200) == 256  # overflow falls through to pow2
+    with pytest.raises(ValueError):
+        BucketRouter(widths=(0,))
+
+
+def test_admission_queue_backpressure_and_fifo():
+    q = AdmissionQueue(2)
+    assert q.put("a") == 1
+    assert q.put("b") == 2
+    with pytest.raises(QueueFull):
+        q.put("c")  # the bound IS the backpressure surface
+    assert q.pop()[0] == "a"  # FIFO
+    assert q.put("c") == 2  # a pop frees room
+    assert [q.pop()[0], q.pop()[0]] == ["b", "c"]
+    assert q.pop() is None
+
+
+def test_admission_queue_try_put_and_wait_at_least():
+    import threading
+
+    q = AdmissionQueue(2)
+    assert q.try_put("a") == 1
+    assert q.try_put("b") == 2
+    assert q.try_put("c") is None  # full: the serve loop holds, not raises
+    assert q.wait_at_least(2, timeout=0.01) is True
+    q.pop()
+    assert q.wait_at_least(2, timeout=0.05) is False  # window elapses
+    t = threading.Timer(0.05, lambda: q.put("c"))
+    t.start()
+    try:
+        assert q.wait_at_least(2, timeout=2.0) is True  # arrival wakes it
+    finally:
+        t.join()
+
+
+def test_serve_config_validation():
+    with pytest.raises(ValueError):
+        ServeConfig(target_live=0)
+    with pytest.raises(ValueError):
+        ServeConfig(max_queue=0)
+    with pytest.raises(ValueError, match="owns preemption"):
+        FleetServer(FleetScheduler(ALConfig(queries=2, epochs=1, mode="mc"),
+                                   preemption=object()),
+                    ServeConfig())
+
+
+def test_per_width_scoring_fns_cached_and_guarded():
+    """One jit family per (k, tie_break, width) — and a mis-routed batch
+    fails loudly instead of silently compiling an off-bucket program."""
+    import numpy as np
+
+    a = scoring.fleet_scoring_fns_for_width(k=3, width=32)
+    b = scoring.fleet_scoring_fns_for_width(k=3, width=32)
+    c = scoring.fleet_scoring_fns_for_width(k=3, width=64)
+    assert a is b and a is not c  # cached per width, distinct across
+    probs = np.full((2, 2, 32, 4), 0.25, np.float32)
+    mask = np.ones((2, 32), bool)
+    res = a["mc"](probs, mask)
+    assert res.indices.shape == (2, 3)
+    with pytest.raises(ValueError, match="bucket routing"):
+        c["mc"](probs, mask)  # width-64 family fed width-32 inputs
+
+
+# -- tier-1 admission smoke + bucket parity -------------------------------
+
+
+def test_serve_three_user_admission_smoke(tmp_path):
+    """3 users through a target-occupancy-2 server: the third user is
+    admitted the moment a slot frees (continuous batching — never more
+    than 2 live), every trajectory matches its sequential run, and the
+    admission telemetry (enqueue/admit events, queue depth, admission
+    wait) lands in the fleet metrics stream."""
+    cfg = _cfg(mode="mc", epochs=2)
+    specs = [(100 + i, f"u{i}", 30) for i in range(3)]
+    seq, entries = _baselines_and_entries(tmp_path, cfg, specs)
+    jsonl = tmp_path / "fleet_metrics.jsonl"
+    recs, server = _serve(cfg, entries, report=FleetReport(str(jsonl)))
+    assert [r["error"] for r in recs] == [None] * 3
+    for s, r in zip(seq, recs):
+        assert r["result"]["trajectory"] == s["trajectory"]
+    events = [json.loads(l) for l in open(jsonl)]
+    admits = [e for e in events if e["event"] == "admit"]
+    assert [a["user"] for a in admits] == ["u0", "u1", "u2"]  # FIFO
+    # occupancy target respected: never more than target_live live slots
+    assert max(a["live"] for a in admits) <= 2
+    # the third admission happened AFTER a completion freed its slot
+    done_t = min(e["t_s"] for e in events if e["event"] == "user_done")
+    assert admits[2]["t_s"] >= done_t
+    summary = server.report.write_summary(cohort=2)
+    assert summary["users_done"] == 3 and summary["users_failed"] == 0
+    assert summary["admissions"] == 3
+    assert summary["admission_wait_s"]["n"] == 3
+    assert summary["queue_depth"]["max"] >= 1  # u2 actually waited
+    assert 0 < summary["occupancy"] <= 1.0
+    # per-user surfaces unchanged: workspace state + reports exist
+    for i in range(3):
+        d = str(tmp_path / f"serve_u{i}")
+        assert os.path.exists(os.path.join(d, "al_state.json"))
+        assert os.path.exists(os.path.join(d, "metrics.jsonl"))
+
+
+def test_serve_bucket_parity_across_skewed_pools(tmp_path):
+    """Users of different pool sizes pad to DIFFERENT bucket edges (not a
+    shared max), dispatch as separate width-tagged stacked groups, and
+    still reproduce their sequential trajectories bit-for-bit."""
+    cfg = _cfg(mode="mc", epochs=2)
+    specs = [(100, "small0", 20), (101, "small1", 24), (102, "big0", 70),
+             (103, "big1", 65)]
+    seq, entries = _baselines_and_entries(tmp_path, cfg, specs)
+    # a generous batch window makes the phase alignment deterministic:
+    # the engine waits out in-flight host work before dispatching a
+    # partial batch, so same-bucket sessions stack
+    recs, server = _serve(
+        cfg, entries,
+        serve_cfg=ServeConfig(target_live=4, bucket_widths=(32, 80)),
+        scheduler_kw={"batch_window_s": 5.0})
+    assert [r["error"] for r in recs] == [None] * 4
+    for s, r in zip(seq, recs):
+        assert r["result"]["trajectory"] == s["trajectory"]
+    widths = {d.get("width") for d in server.report.dispatches}
+    assert widths == {32, 80}  # both buckets dispatched, no cohort max
+    # same-bucket sessions stacked into shared dispatches
+    assert any(d["batch"] > 1 for d in server.report.dispatches)
+    per_bucket = server.report.per_bucket_occupancy
+    assert set(per_bucket) == {32, 80}
+    for stats in per_bucket.values():
+        assert 0 < stats["occupancy"] <= 1.0
+        assert stats["dispatches"] >= cfg.epochs
+
+
+# -- slow drills ----------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["mc", "hc", "mix", "rand"])
+def test_serve_matches_sequential_all_modes(tmp_path, mode):
+    """Acceptance parity: per-user selections and final metrics under the
+    serve layer are bit-identical to the sequential loop in all four
+    acquisition modes, across mixed bucket widths."""
+    cfg = _cfg(mode=mode, epochs=3)
+    specs = [(100, "u0", 30), (101, "u1", 30), (102, "u2", 55)]
+    seq, entries = _baselines_and_entries(tmp_path, cfg, specs)
+    recs, _ = _serve(
+        cfg, entries,
+        serve_cfg=ServeConfig(target_live=2, bucket_widths=(32, 64)))
+    for s, r in zip(seq, recs):
+        assert r["error"] is None
+        assert r["result"]["trajectory"] == s["trajectory"]
+        # final metrics, not just the curve
+        assert r["result"]["final_mean_f1"] == s["final_mean_f1"]
+        assert r["result"]["mode"] == mode
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_serve_eviction_resume_keeps_bucket_and_parity(tmp_path):
+    """A faulted user is evicted, resumed from its workspace AT ITS PINNED
+    BUCKET WIDTH, and finishes with the sequential unfaulted trajectory;
+    admission never stalls on the fault."""
+    cfg = _cfg(mode="mc", epochs=3)
+
+    def committee_fn(data):
+        if data.user_id == "u1":  # the victim: uniquely-named member
+            return _committee(data, sgd_name="sgd.victim", min_members=2)
+        return _committee(data)
+
+    specs = [(100 + i, f"u{i}", 30) for i in range(3)]
+    # sequential baselines run OUTSIDE the injection window (the rule
+    # would fire on the baseline's victim retrain instead)
+    seq, entries = _baselines_and_entries(tmp_path, cfg, specs,
+                                          committee_fn=committee_fn)
+    jsonl = tmp_path / "fleet_metrics.jsonl"
+    with faults.inject(FaultRule("member.retrain", "raise", at=1,
+                                 member="sgd.victim")) as inj:
+        recs, server = _serve(
+            cfg, entries,
+            serve_cfg=ServeConfig(target_live=2, bucket_widths=(32,)),
+            report=FleetReport(str(jsonl)))
+    assert inj.fired, "the victim member's retrain fault never fired"
+    events = [json.loads(l) for l in open(jsonl)]
+    assert [e["user"] for e in events if e["event"] == "evict"] == ["u1"]
+    assert [e["user"] for e in events if e["event"] == "resume"] == ["u1"]
+    for s, r in zip(seq, recs):
+        assert r["error"] is None, r
+        assert r["result"]["trajectory"] == s["trajectory"]
+    assert {d["width"] for d in server.report.dispatches} == {32}
+    assert server.report.users_failed == 0
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_serve_terminal_failure_never_stalls_admission(tmp_path):
+    """A user that fails terminally (no committee_factory, committee
+    exhausted) releases its slot like a completion: later queued users
+    are still admitted, and the failure is recorded in the results."""
+    cfg = _cfg(mode="mc", epochs=2)
+    specs = [(100 + i, f"u{i}", 30) for i in range(3)]
+    seq, _ = _baselines_and_entries(tmp_path, cfg, specs)
+    entries = []
+    for i, (seed, uid, n_songs) in enumerate(specs):
+        data = _user_data(seed, uid, n_songs=n_songs)
+        committee = (_committee(data, sgd_name="sgd.victim", min_members=2)
+                     if i == 0 else _committee(data))
+        fp = tmp_path / f"serve2_{uid}"
+        fp.mkdir()
+        entries.append(FleetUser(uid, committee, data, str(fp),
+                                 seed=cfg.seed))  # no committee_factory
+    with faults.inject(FaultRule("member.retrain", "raise", at=1,
+                                 member="sgd.victim")) as inj:
+        recs, server = _serve(
+            cfg, entries, serve_cfg=ServeConfig(target_live=1))
+    assert inj.fired
+    by_user = {r["user"]: r for r in recs}
+    assert by_user["u0"]["error"] is not None
+    for i in (1, 2):  # admitted AFTER the failure freed the only slot
+        assert by_user[f"u{i}"]["error"] is None
+        assert by_user[f"u{i}"]["result"]["trajectory"] \
+            == seq[i]["trajectory"]
+    assert server.report.users_failed == 1
+
+
+@pytest.mark.slow
+def test_serve_drain_finishes_in_flight_and_leaves_queue(tmp_path):
+    """Drain semantics: when the guard trips, in-flight sessions FINISH
+    (durable, final, sequential-identical), queued users are never
+    admitted (workspaces untouched for the rerun), and ``Preempted``
+    surfaces so the CLI exits 75."""
+    from consensus_entropy_tpu.resilience.preemption import Preempted
+
+    class TripAfter:
+        def __init__(self, after):
+            self.checks, self.after = 0, after
+
+        @property
+        def requested(self):
+            self.checks += 1
+            return self.checks > self.after
+
+    cfg = _cfg(mode="mc", epochs=2)
+    specs = [(100 + i, f"u{i}", 30) for i in range(4)]
+    seq, entries = _baselines_and_entries(tmp_path, cfg, specs)
+    jsonl = tmp_path / "fleet_metrics.jsonl"
+    sched = FleetScheduler(cfg, report=FleetReport(str(jsonl)),
+                           scoring_by_width=True)
+    server = FleetServer(sched, ServeConfig(target_live=2),
+                         preemption=TripAfter(1))
+    with pytest.raises(Preempted, match="drained"):
+        server.serve(iter(entries))
+    # the first admissions ran to completion with sequential results
+    assert 1 <= len(server.results) < 4
+    for rec in server.results:
+        assert rec["error"] is None
+        i = int(rec["user"][1:])
+        assert rec["result"]["trajectory"] == seq[i]["trajectory"]
+    done_users = {r["user"] for r in server.results}
+    events = [json.loads(l) for l in open(jsonl)]
+    assert any(e["event"] == "drain" for e in events)
+    admits = {e["user"] for e in events if e["event"] == "admit"}
+    assert admits == done_users  # every admitted session finished
+    # queued users were never touched: no workspace state written
+    for _, uid, _ in specs:
+        touched = os.path.exists(tmp_path / f"serve_{uid}" / "al_state.json")
+        assert touched == (uid in done_users)
+    # a rerun (no guard) serves the leftovers to the same trajectories
+    leftovers = [e for e in entries if e.user_id not in done_users]
+    recs2, _ = _serve(cfg, leftovers)
+    for rec in recs2:
+        i = int(rec["user"][1:])
+        assert rec["error"] is None
+        assert rec["result"]["trajectory"] == seq[i]["trajectory"]
+
+
+@pytest.mark.slow
+def test_serve_admission_window_gangs_arrivals(tmp_path):
+    """With ``admit_window_s`` set, an arrival landing on an idle server
+    holds the window open so later arrivals GANG into one admission
+    (phase-aligned into one bucket dispatch) instead of trickling in."""
+    import threading
+    import time as _time
+
+    cfg = _cfg(mode="mc", epochs=1)
+    specs = [(100, "u0", 20), (101, "u1", 20)]
+    seq, entries = _baselines_and_entries(tmp_path, cfg, specs)
+    sched = FleetScheduler(cfg, scoring_by_width=True)
+    server = FleetServer(sched, ServeConfig(target_live=2,
+                                            admit_window_s=2.0))
+
+    def producer():
+        server.submit(entries[0])
+        _time.sleep(0.15)  # well inside the window
+        server.submit(entries[1])
+        server.close_intake()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    try:
+        recs = server.serve((), keep_open=True)
+    finally:
+        t.join()
+    kinds = [(e["event"], e.get("user")) for e in server.report.events]
+    # u1's enqueue precedes u0's admission: the window held the gang open
+    assert kinds.index(("enqueue", "u1")) < kinds.index(("admit", "u0"))
+    for s, r in zip(seq, recs):
+        assert r["error"] is None
+        assert r["result"]["trajectory"] == s["trajectory"]
+
+
+@pytest.mark.slow
+def test_serve_threaded_producer_backpressure(tmp_path):
+    """External producers submit() from another thread against the bounded
+    queue (retrying on QueueFull — real backpressure); close_intake()
+    ends the run once the engine drains."""
+    import threading
+    import time as _time
+
+    cfg = _cfg(mode="mc", epochs=1)
+    specs = [(100 + i, f"u{i}", 20) for i in range(3)]
+    seq, entries = _baselines_and_entries(tmp_path, cfg, specs)
+    sched = FleetScheduler(cfg, scoring_by_width=True)
+    server = FleetServer(sched, ServeConfig(target_live=2, max_queue=2,
+                                            admit_window_s=0.02))
+    done = {}
+
+    def producer():
+        for e in entries:
+            while True:
+                try:
+                    server.submit(e)
+                    break
+                except QueueFull:  # backpressure: retry as slots drain
+                    _time.sleep(0.01)
+        server.close_intake()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    try:
+        recs = server.serve((), on_result=lambda r: done.update(
+            {r["user"]: r}), keep_open=True)
+    finally:
+        t.join()
+    assert len(recs) == 3
+    for s, (_, uid, _) in zip(seq, specs):
+        assert done[uid]["error"] is None
+        assert done[uid]["result"]["trajectory"] == s["trajectory"]
