@@ -707,14 +707,18 @@ def run_retrain_suite(args_ns) -> int:
     return 0
 
 
-def _sized_fleet_workload(sizes: list[int], n_feat: int, seed: int):
+def _sized_fleet_workload(sizes: list[int], n_feat: int, seed: int,
+                          sgd1_names: list | None = None):
     """Synthetic multi-user AL workload: class-separable per-user song
     pools (``sizes[u]`` songs for user u) + a fresh 3-member host
     committee per run (GNB + 2 SGD — the paper's partial_fit species),
     mirroring the AMG per-user shape.  Returns
     ``[(UserData, committee_factory), ...]``; the factory builds an
     identical fresh committee each call so sequential, fleet and serve
-    runs start from the same state."""
+    runs start from the same state.  ``sgd1_names[u]`` overrides user u's
+    second SGD member name (the serve-faults suite gives flaky users
+    uniquely-named victims so member-filtered fault rules hit per
+    user)."""
     from consensus_entropy_tpu.al.loop import UserData
     from consensus_entropy_tpu.models.committee import Committee, FramePool
     from consensus_entropy_tpu.models.sklearn_members import (
@@ -744,10 +748,12 @@ def _sized_fleet_workload(sizes: list[int], n_feat: int, seed: int):
         y = np.array([labels[s] for s in np.repeat(
             pool.song_ids, pool.counts)], np.int32)
 
-        def factory(X=X, y=y):
+        sgd1 = sgd1_names[u] if sgd1_names else "sgd.it_1"
+
+        def factory(X=X, y=y, sgd1=sgd1):
             return Committee([GNBMember("gnb.it_0").fit(X, y),
                               SGDMember("sgd.it_0", seed=0).fit(X, y),
-                              SGDMember("sgd.it_1", seed=1).fit(X, y)], [])
+                              SGDMember(sgd1, seed=1).fit(X, y)], [])
 
         users.append((data, factory))
     return users
@@ -1069,6 +1075,174 @@ def run_serve_suite(args_ns) -> int:
     return 0
 
 
+def run_serve_faults_suite(args_ns) -> int:
+    """Crash-safe serving under a FLAKY user mix: recovered-users/sec.
+
+    Every ``flaky_every``-th user carries a uniquely-named victim member
+    whose retrain raises on its first two hits (per-member fault
+    counting), so that user burns its initial session AND its in-engine
+    resume, then recovers through serve-layer backoff re-admission; a
+    straggler ``pool.score`` delay trips the session watchdog once, and a
+    transient stacked-dispatch fault opens the per-bucket circuit breaker
+    (per-user fallback, half-open recovery).  Sequential UNFAULTED runs
+    are the ground truth: the suite asserts every user still finishes
+    with bit-identical trajectories, then reports the faulted serve
+    side's users/sec (the price of recovery) with watchdog/breaker/
+    requeue counts.  Reps are interleaved best-of (2-vCPU drift
+    protocol); the injector is re-installed per rep so hit counts are
+    rep-local.
+    """
+    import os
+    import shutil
+    import tempfile
+
+    from consensus_entropy_tpu.al.loop import ALLoop
+    from consensus_entropy_tpu.config import ALConfig
+    from consensus_entropy_tpu.fleet import FleetReport, FleetScheduler, \
+        FleetUser
+    from consensus_entropy_tpu.resilience import faults
+    from consensus_entropy_tpu.resilience.faults import FaultRule
+    from consensus_entropy_tpu.serve import FleetServer, ServeConfig
+    from consensus_entropy_tpu.utils import round_up
+
+    # min_members=3: ANY quarantined member exhausts the 3-member
+    # committee, so a flaky user's faulted session terminates (instead of
+    # being silently absorbed) and the serve-layer recovery ladder —
+    # evict -> resume -> backoff re-admission — actually runs
+    cfg = ALConfig(queries=args_ns.k, epochs=args_ns.al_epochs, mode="mc",
+                   seed=1987, ckpt_dtype="float32", min_members=3)
+    n_users = args_ns.users
+    small = args_ns.pool or 120
+    flaky_every = 3
+    sizes = [small * (4 if (u % 4 == 3) else 1) for u in range(n_users)]
+    flaky = [u % flaky_every == flaky_every - 1 for u in range(n_users)]
+    sgd1_names = [f"sgd.flaky{u}" if flaky[u] else "sgd.it_1"
+                  for u in range(n_users)]
+    users = _sized_fleet_workload(sizes, 96, cfg.seed,
+                                  sgd1_names=sgd1_names)
+    widths = tuple(sorted({round_up(s, 8) for s in sizes}))
+    n = args_ns.fleet[0] if args_ns.fleet else 4
+
+    def rules():
+        return ([FaultRule("member.retrain", "raise", at=1, times=2,
+                           member=f"sgd.flaky{u}")
+                 for u in range(n_users) if flaky[u]]
+                + [FaultRule("pool.score", "delay", at=5, delay_s=1.2),
+                   FaultRule("serve.dispatch", "transient", at=3)])
+
+    _log(f"serve-faults workload: {n_users} users (flaky every "
+         f"{flaky_every}th: {sum(flaky)}), pool sizes {sizes}, bucket "
+         f"edges {list(widths)}, target_live={n}, q={cfg.queries}, "
+         f"{cfg.epochs} AL iterations")
+
+    root = tempfile.mkdtemp(prefix="serve_faults_bench_")
+    reps = args_ns.reps
+    try:
+        loop = ALLoop(cfg)
+        seq_results = None
+        seq_s = float("inf")
+        best = None
+        for rep in range(reps):
+            # interleaved: unfaulted sequential ground truth, then the
+            # fault-injected serve run, per rep (2-vCPU drift protocol)
+            t0 = time.perf_counter()
+            results = []
+            for i, (data, factory) in enumerate(users):
+                p = _mkdir(root, f"seq{rep}_{i}")
+                results.append(loop.run_user(factory(), data, p,
+                                             seed=cfg.seed))
+            seq_s = min(seq_s, time.perf_counter() - t0)
+            if seq_results is None:
+                seq_results = results
+            traj_of = {r["user"]: r["trajectory"] for r in seq_results}
+
+            from consensus_entropy_tpu.al import workspace as _ws
+
+            entries = [
+                FleetUser(data.user_id, factory(), data,
+                          (p := _mkdir(root, f"serve{rep}_{i}")),
+                          seed=cfg.seed,
+                          # resume-after-eviction reloads the committee
+                          # from the workspace's durable checkpoints (the
+                          # members' mid-run partial_fit state — a
+                          # pristine rebuild would diverge)
+                          committee_factory=lambda p=p:
+                          _ws.load_committee(p))
+                for i, (data, factory) in enumerate(users)]
+            report = FleetReport()
+            with faults.inject(*rules()) as inj:
+                sched = FleetScheduler(
+                    cfg, report=report, host_workers=args_ns.host_workers,
+                    user_timings=False, scoring_by_width=True,
+                    # a small batch window phase-aligns same-bucket
+                    # sessions so the dispatch fault lands on a STACKED
+                    # call — the breaker's trigger — instead of a
+                    # singleton
+                    batch_window_s=0.05)
+                server = FleetServer(sched, ServeConfig(
+                    target_live=n, max_queue=max(n_users, 1),
+                    bucket_widths=widths, watchdog_s=0.6,
+                    failure_budget=3, backoff_base_s=0.02,
+                    backoff_max_s=0.2, breaker_threshold=1,
+                    breaker_cooldown_s=0.5))
+                t0 = time.perf_counter()
+                recs = server.serve(iter(entries))
+                wall = time.perf_counter() - t0
+            s = report.summary(cohort=n, wall_s=wall)
+            s["parity_with_sequential"] = (
+                len(recs) == n_users and all(
+                    r["error"] is None
+                    and r["result"]["trajectory"] == traj_of[r["user"]]
+                    for r in recs))
+            s["faults_fired"] = len(inj.fired)
+            _log(f"[rep {rep}] serve+faults {s['users_done']}/{n_users} "
+                 f"users in {wall:.1f}s ({s['users_per_sec']:.3f} u/s, "
+                 f"parity={s['parity_with_sequential']}, "
+                 f"fired={s['faults_fired']}, "
+                 f"evictions={s['evictions']}, resumes={s['resumes']}, "
+                 f"requeues={s.get('requeues', 0)}, "
+                 f"watchdog={s.get('watchdog_evictions', 0)}, "
+                 f"breaker={s.get('breaker_trips', 0)})")
+            if not s["parity_with_sequential"]:
+                raise AssertionError(
+                    f"faulted serve rep {rep} lost parity: "
+                    + repr([r["user"] for r in recs
+                            if r["error"] is not None]))
+            if best is None or s["users_per_sec"] > best["users_per_sec"]:
+                best = s
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    seq_ups = n_users / seq_s
+    print(json.dumps({
+        "metric": f"serve_faults_recovered_users_per_sec_{n_users}u",
+        "value": best["users_per_sec"],
+        "unit": "users/s",
+        # the acceptance ratio: faulted-serve throughput vs UNFAULTED
+        # sequential — how much of the raw throughput survives a flaky
+        # user mix plus watchdog/breaker drills, with zero lost users
+        "vs_baseline": round(best["users_per_sec"] / seq_ups, 2),
+        "target_live": n,
+        "sequential_unfaulted_users_per_sec": round(seq_ups, 4),
+        "users_done": best["users_done"],
+        "users_failed": best["users_failed"],
+        "flaky_users": sum(flaky),
+        "faults_fired": best["faults_fired"],
+        "evictions": best["evictions"],
+        "resumes": best["resumes"],
+        "requeues": best.get("requeues", 0),
+        "watchdog_evictions": best.get("watchdog_evictions", 0),
+        "breaker_trips": best.get("breaker_trips", 0),
+        "dispatch_failures": best.get("dispatch_failures", 0),
+        "users_poisoned": best.get("users_poisoned", 0),
+        "occupancy": best.get("occupancy"),
+        "per_bucket": best.get("per_bucket"),
+        "parity_with_sequential": True,
+        **_provenance(),
+    }))
+    return 0
+
+
 def _mkdir(root, name):
     import os
 
@@ -1080,7 +1254,7 @@ def _mkdir(root, name):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--suite", choices=("linear", "cnn", "retrain", "fleet",
-                                        "serve"),
+                                        "serve", "serve-faults"),
                     default="linear",
                     help="linear: the north-star fused pool scoring; cnn: "
                          "Flax ShortChunkCNN committee inference "
@@ -1088,7 +1262,10 @@ def main(argv=None) -> int:
                          "retraining vs the sequential member loop; fleet: "
                          "multi-user AL users/sec vs the sequential loop; "
                          "serve: continuous-batching admission + bucketed "
-                         "padding vs fleet cohorts on a skewed workload")
+                         "padding vs fleet cohorts on a skewed workload; "
+                         "serve-faults: recovered-users/sec under a "
+                         "fault-injected flaky user mix (watchdog, "
+                         "backoff re-admission, circuit breaker)")
     ap.add_argument("--members", type=int, default=None,
                     help="committee size (default: 16 linear / 5 cnn)")
     ap.add_argument("--pool", type=int, default=None,
@@ -1148,6 +1325,9 @@ def main(argv=None) -> int:
     if args_ns.suite == "serve":
         # serve reuses --pool as the SMALL pool size (every 4th user 4x)
         return run_serve_suite(args_ns)
+    if args_ns.suite == "serve-faults":
+        # same skewed sizing as serve; every 3rd user is flaky
+        return run_serve_faults_suite(args_ns)
     if args_ns.suite == "cnn":
         # cnn-suite defaults: 5 members (paper committee), 48 crops per
         # pass — the first conv block's activations are ~75 MB per

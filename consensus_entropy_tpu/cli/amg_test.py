@@ -68,6 +68,35 @@ def build_parser() -> argparse.ArgumentParser:
                         "the smallest edge that fits their pool, "
                         "oversized pools fall through to the next power "
                         "of two (default: power-of-two buckets)")
+    p.add_argument("--no-serve-journal", action="store_true",
+                   help="serve mode: disable the crash-safety admission "
+                        "journal (users/serve_journal.jsonl, on by "
+                        "default: a killed --serve run restarted from the "
+                        "journal skips finished users, re-admits "
+                        "in-flight ones and re-queues waiting ones — no "
+                        "submitted user is lost)")
+    p.add_argument("--watchdog-s", type=float, default=0.0, metavar="S",
+                   help="serve mode: wall-clock deadline per engine step "
+                        "(host retrain block or device dispatch); a hung "
+                        "step's session is evicted and resumed from its "
+                        "workspace, its slot refilled (default 0: off)")
+    p.add_argument("--failure-budget", type=int, default=3, metavar="N",
+                   help="serve mode: total admissions per user — a "
+                        "terminally failed session re-enters the queue "
+                        "with seeded-jitter exponential backoff until the "
+                        "budget is spent, then lands in the persisted "
+                        "poison list (users/serve_poison.jsonl) and is "
+                        "skipped on future submits (1 disables "
+                        "re-admission; default 3)")
+    p.add_argument("--breaker-threshold", type=int, default=2, metavar="N",
+                   help="serve mode: consecutive stacked-dispatch "
+                        "failures that open a bucket's circuit breaker — "
+                        "the width degrades to per-user dispatch until a "
+                        "half-open probe succeeds (0 disables; default 2)")
+    p.add_argument("--breaker-cooldown-s", type=float, default=30.0,
+                   metavar="S",
+                   help="serve mode: how long an open bucket stays "
+                        "degraded before the half-open probe (default 30)")
     p.add_argument("--seed", type=int, default=1987)
     p.add_argument("--tie-break", choices=("fast", "numpy"), default="fast")
     p.add_argument("--trace-dir", default=None,
@@ -138,6 +167,22 @@ def main(argv=None) -> int:
         return 1
     if args.admit_window_ms and args.serve is None:
         print("--admit-window-ms requires --serve")
+        return 1
+    for flag, is_set in (("--no-serve-journal", args.no_serve_journal),
+                         ("--watchdog-s", args.watchdog_s),
+                         ("--failure-budget", args.failure_budget != 3),
+                         ("--breaker-threshold",
+                          args.breaker_threshold != 2),
+                         ("--breaker-cooldown-s",
+                          args.breaker_cooldown_s != 30.0)):
+        if is_set and args.serve is None:
+            print(f"{flag} requires --serve")
+            return 1
+    if args.serve is not None and (args.watchdog_s < 0
+                                   or args.failure_budget < 1
+                                   or args.breaker_threshold < 0):
+        print("--watchdog-s must be >= 0, --failure-budget >= 1, "
+              "--breaker-threshold >= 0")
         return 1
     bucket_widths = None
     if args.bucket_widths is not None:
@@ -377,7 +422,14 @@ def _run_users_serve(args, cfg, paths, users, pool, anno, hc_table, store,
     — keep ``--serve N`` sessions live, refill freed slots from the
     waiting queue, pad per bucket.  Per-user workspaces/results are
     identical to the sequential path; finished users are persisted the
-    moment they complete, so a drain (SIGTERM → exit 75) loses nothing."""
+    moment they complete, so a drain (SIGTERM → exit 75) loses nothing.
+
+    Crash safety: admission transitions go through the WAL at
+    ``users/serve_journal.jsonl`` (unless ``--no-serve-journal``), so a
+    KILLED run restarted with the same flags re-admits in-flight users
+    first (resuming their workspaces), re-queues waiting users in order
+    and skips finished ones; users past ``--failure-budget`` live in
+    ``users/serve_poison.jsonl`` and are skipped on every future run."""
     import json
 
     import numpy as np
@@ -391,12 +443,21 @@ def _run_users_serve(args, cfg, paths, users, pool, anno, hc_table, store,
         FleetUser,
     )
     from consensus_entropy_tpu.fleet.report import bench_line
-    from consensus_entropy_tpu.serve import FleetServer, ServeConfig
+    from consensus_entropy_tpu.serve import (
+        AdmissionJournal,
+        FleetServer,
+        PoisonList,
+        ServeConfig,
+    )
 
     experiment = {"seed": cfg.seed, "queries": cfg.queries,
                   "train_size": cfg.train_size}
     report = FleetReport(os.path.join(paths.users_dir,
                                       "fleet_metrics.jsonl"))
+    journal = None if args.no_serve_journal else AdmissionJournal(
+        os.path.join(paths.users_dir, "serve_journal.jsonl"))
+    poison = PoisonList(os.path.join(paths.users_dir,
+                                     "serve_poison.jsonl"))
     scheduler = FleetScheduler(
         cfg, tie_break=args.tie_break, retrain_epochs=args.retrain_epochs,
         host_workers=args.fleet_host_workers, report=report,
@@ -405,14 +466,31 @@ def _run_users_serve(args, cfg, paths, users, pool, anno, hc_table, store,
         scheduler,
         ServeConfig(target_live=args.serve,
                     admit_window_s=args.admit_window_ms / 1000.0,
-                    bucket_widths=args._bucket_widths),
-        preemption=guard)
+                    bucket_widths=args._bucket_widths,
+                    watchdog_s=args.watchdog_s,
+                    failure_budget=args.failure_budget,
+                    breaker_threshold=args.breaker_threshold,
+                    breaker_cooldown_s=args.breaker_cooldown_s),
+        preemption=guard, journal=journal, poison=poison)
+
+    todo = list(users[: args.max_users])
+    if journal is not None and journal.recovered:
+        st = journal.state
+        # the restart path: in-flight users first (their workspaces hold
+        # the most sunk work), then journal-queued users in enqueue
+        # order, then new users; finished/poisoned drop out here AND are
+        # skipped defensively at enqueue
+        todo = st.recovery_order(todo)
+        print(f"serve journal: recovering — {len(st.finished)} finished "
+              f"(skipped), {len(st.in_flight)} in-flight (re-admitted "
+              f"first), {len(st.queued)} queued (re-enqueued), "
+              f"{len(st.poisoned)} poisoned")
 
     def source():
         # pulled lazily as queue room frees: per-user workspace creation
         # and committee loads happen just-in-time at admission pressure,
         # and a drain leaves un-pulled users completely untouched
-        for u_id in users[: args.max_users]:
+        for u_id in todo:
             user_path, skip = workspace.create_user(
                 paths.users_dir, paths.pretrained_dir, u_id, cfg.mode,
                 experiment=experiment)
@@ -457,6 +535,16 @@ def _run_users_serve(args, cfg, paths, users, pool, anno, hc_table, store,
         summary = report.write_summary(cohort=args.serve)
         print("serve summary: "
               + json.dumps(bench_line(summary), sort_keys=True))
+        if summary.get("users_failed") or len(poison):
+            # terminal-failure visibility (the result record alone is
+            # easy to miss in a long-running service): counts up front,
+            # reasons in fleet_metrics.jsonl user_failed/poison events
+            print(f"serve failures: {summary.get('users_failed', 0)} "
+                  f"user(s) failed terminally, {len(poison)} on the "
+                  f"poison list ({poison.path})")
+        if journal is not None:
+            journal.close()
+        poison.close()
     if failed:
         # parity with the fleet path: users dropped after eviction/resume
         # must not let the sweep look successful to CI/scripts

@@ -115,9 +115,18 @@ class FleetReport:
                    final_mean_f1=result.get("final_mean_f1"),
                    epochs=len(result.get("trajectory", [])))
 
-    def user_failed(self, user, error: str) -> None:
+    def user_failed(self, user, error: str,
+                    attempts: int | None = None) -> None:
+        """A user failed TERMINALLY (every in-engine resume and — under
+        the serve layer — every backoff re-admission exhausted).  The
+        reason and the attempt count land in the metrics stream, not just
+        the result record, so an operator tailing ``fleet_metrics.jsonl``
+        sees WHY a user dropped."""
         self.users_failed += 1
-        self.event("user_failed", user=str(user), error=error)
+        rec = {"user": str(user), "error": error}
+        if attempts is not None:
+            rec["attempts"] = attempts
+        self.event("user_failed", **rec)
 
     def elapsed_s(self) -> float:
         return time.perf_counter() - self._t0
@@ -184,6 +193,17 @@ class FleetReport:
             "evictions": sum(e["event"] == "evict" for e in self.events),
             "resumes": sum(e["event"] == "resume" for e in self.events),
         }
+        # serve-layer fault-domain counters, present only when the run
+        # exercised them — pre-existing fleet/serve summaries (and the
+        # committed BENCH artifacts) stay byte-stable
+        for key, event in (("watchdog_evictions", "watchdog_evict"),
+                           ("breaker_trips", "breaker_open"),
+                           ("dispatch_failures", "dispatch_failed"),
+                           ("requeues", "requeue"),
+                           ("users_poisoned", "poison")):
+            n = sum(e["event"] == event for e in self.events)
+            if n:
+                out[key] = n
         per_bucket = self.per_bucket_occupancy
         if per_bucket is not None:
             out["per_bucket"] = per_bucket
@@ -219,6 +239,10 @@ def bench_line(summary: dict, *, baseline_users_per_sec: float | None = None,
     }
     if summary.get("per_bucket") is not None:
         line["per_bucket"] = summary["per_bucket"]
+    for key in ("watchdog_evictions", "breaker_trips", "dispatch_failures",
+                "requeues", "users_poisoned"):
+        if summary.get(key):
+            line[key] = summary[key]
     if extra:
         line.update(extra)
     return line

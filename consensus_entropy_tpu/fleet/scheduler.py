@@ -63,6 +63,7 @@ from consensus_entropy_tpu.fleet.session import (
     UserSession,
 )
 from consensus_entropy_tpu.ops import scoring as ops_scoring
+from consensus_entropy_tpu.resilience import faults
 from consensus_entropy_tpu.utils.profiling import StepTimer
 
 
@@ -121,7 +122,8 @@ class FleetScheduler:
                  report: FleetReport | None = None,
                  user_timings: bool = True,
                  batch_window_s: float = 0.0,
-                 scoring_by_width: bool = False):
+                 scoring_by_width: bool = False,
+                 watchdog=None, breaker=None, on_terminal=None):
         self.config = config
         self.tie_break = tie_break
         self.retrain_epochs = retrain_epochs
@@ -133,6 +135,22 @@ class FleetScheduler:
         self.report = report or FleetReport()
         self.user_timings = user_timings
         self.scoring_by_width = scoring_by_width
+        #: optional ``serve.watchdog.Watchdog``: wall-clock deadline on
+        #: every host step and device dispatch — an expired step is
+        #: abandoned and its session evicted through the normal
+        #: :meth:`_evict` path (slot refilled, cohort unaffected)
+        self.watchdog = watchdog
+        #: optional ``serve.breaker.DispatchBreaker``: a bucket width with
+        #: repeated stacked-dispatch failures degrades to per-user
+        #: dispatch until a half-open probe recovers it
+        self.breaker = breaker
+        #: optional driver hook called on a session's TERMINAL failure
+        #: (resumes exhausted, or the resume reload itself failed) with
+        #: ``(entry, error_str, resumes)``; returning True absorbs the
+        #: failure — no result is recorded and no user_failed emitted —
+        #: so the driver can re-admit the user later (serve-layer backoff
+        #: re-admission)
+        self.on_terminal = on_terminal
         #: before dispatching a partially-full score batch while host work
         #: is still in flight, wait up to this long for more sessions to
         #: reach their ScoreStep — trades latency for device-batch
@@ -169,6 +187,10 @@ class FleetScheduler:
         self._live: set = set()
         self._score_wait: list = []   # (state, ScoreStep)
         self._host_wait: dict = {}    # Future -> (state, HostStep)
+        #: futures of watchdog-abandoned host steps: their zombie threads
+        #: run to completion against discarded session objects; we keep
+        #: the handles so close() knows not to block on a truly-hung one
+        self._abandoned: list = []
         self._opened = True
 
     def admit(self, entry: FleetUser, *, pad: int | None = None
@@ -188,6 +210,7 @@ class FleetScheduler:
         engine is idle — no ready, waiting or in-flight session."""
         if not (self._ready or self._score_wait or self._host_wait):
             return False
+        self._reap_hung_hosts()
         while self._ready:
             state, value, exc = self._ready.popleft()
             self._live.add(state)
@@ -202,7 +225,10 @@ class FleetScheduler:
                 self._ready.append((state, res, None))
             return True
         if self._host_wait:
-            self._drain_host(None)
+            # under a watchdog the wait is bounded so a hung future cannot
+            # block the scheduler past the next armed deadline
+            self._drain_host(None if self.watchdog is None
+                             else self.watchdog.poll_s())
         return True
 
     @property
@@ -229,7 +255,7 @@ class FleetScheduler:
         ``KeyboardInterrupt``): drain workers first (they touch session
         state), then close every live generator so each session's
         checkpointer joins — all workspaces end durable and resumable."""
-        self._host_pool.shutdown(wait=True)
+        self._shutdown_host_pool()
         for state in list(self._live):
             try:
                 state.gen.close()
@@ -243,9 +269,28 @@ class FleetScheduler:
         checkpointer inside the generator, aborted ones in :meth:`abort` —
         so ``_ckpt_pool.shutdown(wait=True)`` only ever reaps an idle or
         draining pool, never strands a pending two-phase commit."""
-        self._host_pool.shutdown(wait=True)
+        self._shutdown_host_pool()
         self._ckpt_pool.shutdown(wait=True)
         self._opened = False
+
+    def _shutdown_host_pool(self) -> None:
+        """Join the host pool.  Without a watchdog this blocks until every
+        host step finishes (the pre-watchdog contract).  WITH a watchdog,
+        teardown is bounded by the same deadline the watchdog promises:
+        in-flight tracked futures get one deadline to finish — covering
+        the abort/Ctrl-C path where a hung step was never reaped because
+        pump() stopped running — and anything still alive after that
+        (tracked or already-abandoned zombie) is left to the interpreter
+        rather than wedging shutdown on the very hang the watchdog
+        exists to bound."""
+        if self.watchdog is None:
+            self._host_pool.shutdown(wait=True)
+            return
+        if self._host_wait:
+            wait(list(self._host_wait), timeout=self.watchdog.deadline_s)
+        hung = any(not f.done() for f in self._abandoned) \
+            or any(not f.done() for f in self._host_wait)
+        self._host_pool.shutdown(wait=not hung)
 
     # -- session plumbing --------------------------------------------------
 
@@ -293,6 +338,8 @@ class FleetScheduler:
         else:
             fut = self._host_pool.submit(step.fn)
             self._host_wait[fut] = (state, step)
+            if self.watchdog is not None:
+                self.watchdog.arm(state, step.label or "host")
 
     def _drain_host(self, timeout) -> int:
         """Move completed host futures back to the ready queue; returns
@@ -303,6 +350,8 @@ class FleetScheduler:
                        return_when=FIRST_COMPLETED)
         for fut in done:
             state, _step = self._host_wait.pop(fut)
+            if self.watchdog is not None:
+                self.watchdog.disarm(state)
             err = fut.exception()
             if err is None:
                 self._ready.append((state, fut.result(), None))
@@ -312,6 +361,33 @@ class FleetScheduler:
                 # block had raised inline
                 self._ready.append((state, None, err))
         return len(done)
+
+    def _reap_hung_hosts(self) -> None:
+        """Evict sessions whose in-flight host step blew the watchdog
+        deadline: the future is abandoned (threads cannot be killed — the
+        zombie finishes against the discarded session's objects) and the
+        timeout is thrown into the generator, so the session's own error
+        path runs and :meth:`_evict` resumes the user from its workspace.
+        The slot refills on the next admission; the cohort never waits."""
+        if self.watchdog is None or not self._host_wait:
+            return
+        expired = {key: (label, elapsed)
+                   for key, label, elapsed in self.watchdog.expired()}
+        if not expired:
+            return
+        for fut, (state, step) in list(self._host_wait.items()):
+            if state not in expired or fut.done():
+                continue  # done-but-unreaped futures drain normally
+            del self._host_wait[fut]
+            self._abandoned.append(fut)
+            label, elapsed = expired[state]
+            exc = self.watchdog.trip(state, label, elapsed)
+            self.report.event("watchdog_evict",
+                              user=str(state.entry.user_id),
+                              step=step.label or "host",
+                              elapsed_s=round(elapsed, 3),
+                              deadline_s=self.watchdog.deadline_s)
+            self._ready.append((state, None, exc))
 
     def _finish(self, state: _SessionState, result: dict) -> None:
         phases = {}
@@ -339,14 +415,9 @@ class FleetScheduler:
             try:
                 committee = entry.committee_factory()
             except Exception as load_err:
-                self.report.user_failed(
-                    entry.user_id,
-                    f"resume reload failed: {load_err!r} "
-                    f"(after {exc!r})")
-                self._results[id(entry)] = {
-                    "user": entry.user_id, "result": None,
-                    "committee": None, "resumes": state.resumes,
-                    "error": f"{exc!r}; resume reload failed: {load_err!r}"}
+                self._terminal(
+                    entry, f"{exc!r}; resume reload failed: {load_err!r}",
+                    state.resumes)
                 return
             # the pad is pinned per RUN, not per attempt: the resumed
             # session must land in the same dispatch bucket (UserSession
@@ -358,10 +429,21 @@ class FleetScheduler:
                               attempt=new.resumes)
             self._ready.append((new, None, None))
         else:
-            self.report.user_failed(entry.user_id, repr(exc))
-            self._results[id(entry)] = {
-                "user": entry.user_id, "result": None, "committee": None,
-                "resumes": state.resumes, "error": repr(exc)}
+            self._terminal(entry, repr(exc), state.resumes)
+
+    def _terminal(self, entry: FleetUser, error: str, resumes: int) -> None:
+        """A user ran out of in-engine recovery.  ``on_terminal`` gets the
+        first say: a driver that returns True has taken ownership (the
+        serve layer re-queues the user with backoff — no result record, no
+        user_failed, the failure never looks final).  Otherwise the
+        failure is recorded exactly as before."""
+        if self.on_terminal is not None \
+                and self.on_terminal(entry, error, resumes):
+            return
+        self.report.user_failed(entry.user_id, error, attempts=resumes + 1)
+        self._results[id(entry)] = {
+            "user": entry.user_id, "result": None, "committee": None,
+            "resumes": resumes, "error": error}
 
     # -- batched scoring ---------------------------------------------------
 
@@ -399,7 +481,15 @@ class FleetScheduler:
         """Service a round of ScoreSteps: group by (scorer, shapes), run
         each multi-session group as ONE vmapped dispatch, singletons
         through the session's own single-user fns.  Returns
-        ``[(session_state, ScoreResult), ...]``."""
+        ``[(session_state, ScoreResult), ...]``.
+
+        Failure isolation: a failed STACKED dispatch no longer takes its
+        whole batch down — the failure is recorded on the breaker (which
+        may open the bucket) and the group falls back to per-user
+        dispatch, where a session whose own dispatch fails is evicted
+        through its generator's error path while its peers keep their
+        results.  ``InjectedKill``/``Preempted`` stay ``BaseException``
+        and still stop the fleet."""
         groups = collections.defaultdict(list)
         for st, step in steps:
             key = (step.fn_key,) + tuple(self._sig(x) for x in step.inputs)
@@ -408,31 +498,102 @@ class FleetScheduler:
         out = []
         for group in groups.values():
             width = group[0][0].n_pad
-            t0 = time.perf_counter()
-            if len(group) == 1:
-                st, step = group[0]
-                res = step.session.acq.run_scoring(step.fn_key, step.inputs)
+            fn_key = group[0][1].fn_key
+            use_stacked = len(group) > 1
+            if use_stacked and self.breaker is not None:
+                use_stacked = self.breaker.allow_stacked(width)
+                if use_stacked and self.breaker.state_of(width) \
+                        == "half_open":
+                    self.report.event("breaker_probe", width=width)
+            if use_stacked:
+                t0 = time.perf_counter()
+                try:
+                    served = self._stacked_call(fn_key, width, group)
+                except Exception as exc:
+                    self._note_stacked_failure(fn_key, width, exc)
+                else:
+                    out.extend(served)
+                    if self.breaker is not None \
+                            and self.breaker.record_success(width) \
+                            == "close":
+                        self.report.event("breaker_close", width=width)
+                    # width tags only BUCKETED dispatches: a plain fleet
+                    # cohort is one width by construction and its
+                    # summaries/BENCH artifacts must not grow a
+                    # per-bucket section
+                    self.report.dispatch(
+                        fn_key, len(group),
+                        self._active_in_bucket(width)
+                        if self.scoring_by_width else n_live,
+                        time.perf_counter() - t0,
+                        width=width if self.scoring_by_width else None)
+                    continue
+            # per-user dispatch: singletons, open-breaker (degraded)
+            # buckets, and the stacked-failure fallback
+            for st, step in group:
+                t0 = time.perf_counter()
+                try:
+                    res = self._single_call(step)
+                except Exception as exc:
+                    # throw into the generator: the session's own error
+                    # path runs and _evict resumes or terminally fails
+                    # THIS user; the rest of the group is untouched
+                    self.report.event("dispatch_session_error",
+                                      user=str(st.entry.user_id),
+                                      fn=fn_key, error=repr(exc))
+                    self._ready.append((st, None, exc))
+                    continue
                 out.append((st, res))
-            else:
-                fn_key = group[0][1].fn_key
-                stacked = [self._stack([step.inputs[pos]
-                                        for _, step in group])
-                           for pos in range(len(group[0][1].inputs))]
-                batched = self._group_fns(width)[fn_key](*stacked)
-                for i, (st, _) in enumerate(group):
-                    out.append((st, ops_scoring.ScoreResult(
-                        batched.entropy[i], batched.values[i],
-                        batched.indices[i])))
-            # width tags only BUCKETED dispatches: a plain fleet cohort is
-            # one width by construction and its summaries/BENCH artifacts
-            # must not grow a per-bucket section
-            self.report.dispatch(
-                group[0][1].fn_key, len(group),
-                self._active_in_bucket(width) if self.scoring_by_width
-                else n_live,
-                time.perf_counter() - t0,
-                width=width if self.scoring_by_width else None)
+                self.report.dispatch(
+                    fn_key, 1,
+                    self._active_in_bucket(width)
+                    if self.scoring_by_width else n_live,
+                    time.perf_counter() - t0,
+                    width=width if self.scoring_by_width else None)
         return out
+
+    def _stacked_call(self, fn_key: str, width: int, group: list):
+        """One vmapped dispatch for a multi-session group, bounded by the
+        watchdog when one is installed.  The ``serve.dispatch`` fault
+        point fires inside the (possibly watchdog-threaded) call so
+        injected kills/delays land exactly where a real device fault
+        would."""
+        stacked = [self._stack([step.inputs[pos] for _, step in group])
+                   for pos in range(len(group[0][1].inputs))]
+
+        def dispatch():
+            faults.fire("serve.dispatch", fn=fn_key, width=width,
+                        batch=len(group))
+            return self._group_fns(width)[fn_key](*stacked)
+
+        batched = (self.watchdog.call(dispatch,
+                                      f"dispatch {fn_key}@{width}")
+                   if self.watchdog is not None else dispatch())
+        return [(st, ops_scoring.ScoreResult(
+            batched.entropy[i], batched.values[i], batched.indices[i]))
+            for i, (st, _) in enumerate(group)]
+
+    def _single_call(self, step):
+        """One session's own single-user dispatch (the sequential path),
+        watchdog-bounded like the stacked one."""
+        def dispatch():
+            faults.fire("serve.dispatch", fn=step.fn_key,
+                        width=step.session.acq.n_pad, batch=1)
+            return step.session.acq.run_scoring(step.fn_key, step.inputs)
+
+        if self.watchdog is not None:
+            return self.watchdog.call(dispatch, f"dispatch {step.fn_key}x1")
+        return dispatch()
+
+    def _note_stacked_failure(self, fn_key: str, width: int,
+                              exc: Exception) -> None:
+        self.report.event("dispatch_failed", fn=fn_key, width=width,
+                          error=repr(exc))
+        if self.breaker is not None \
+                and self.breaker.record_failure(width) == "open":
+            self.report.event("breaker_open", width=width,
+                              threshold=self.breaker.threshold,
+                              cooldown_s=self.breaker.cooldown_s)
 
     # -- the cohort driver -------------------------------------------------
 

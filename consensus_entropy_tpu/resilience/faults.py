@@ -52,6 +52,13 @@ FAULT_POINTS = frozenset({
     "pool.score",         # ALLoop score phase (whole-pool probs table)
     "state.save",         # al.state.ALState.save (the commit point)
     "multihost.sync",     # parallel.multihost.sync barriers
+    # serve-layer boundaries (the crash-safe-serving fault domain): a kill
+    # at any of these must lose no submitted user — the admission journal
+    # replays the queue/in-flight set on restart (serve.journal)
+    "serve.admit",           # FleetServer slot refill, pre-engine-admit
+    "serve.journal.append",  # admission-journal WAL append, pre-fsync
+    "serve.dispatch",        # stacked/per-user device scoring dispatch
+    "serve.collect",         # completion collection, pre-finish-journal
 })
 
 ACTIONS = ("kill", "raise", "transient", "corrupt", "delay")

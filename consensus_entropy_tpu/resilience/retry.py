@@ -43,6 +43,19 @@ def _transient_types() -> tuple:
 TRANSIENT_ERRORS: tuple = _transient_types()
 
 
+def backoff_delay(attempt: int, *, base_delay: float = 0.05,
+                  max_delay: float = 2.0, rng=None) -> float:
+    """The backoff schedule shared by :func:`retry_transient` and the serve
+    layer's re-admission queue: ``min(max_delay, base_delay * 2**attempt)``
+    jittered into ``[0.5, 1.5)x`` when ``rng`` is given (seeded by the
+    caller, so a faulted run's timing replays; jitter keeps a fleet of
+    failures from re-admitting in lockstep)."""
+    delay = min(max_delay, base_delay * (2 ** max(attempt, 0)))
+    if rng is not None:
+        delay *= 0.5 + rng.random()
+    return delay
+
+
 def retry_transient(fn: Callable[[], T], *, attempts: int = 3,
                     base_delay: float = 0.05, max_delay: float = 2.0,
                     seed: int = 0, what: str = "op",
@@ -67,7 +80,6 @@ def retry_transient(fn: Callable[[], T], *, attempts: int = 3,
         except on as e:
             if attempt == attempts - 1:
                 raise
-            delay = min(max_delay, base_delay * (2 ** attempt))
-            delay *= 0.5 + rng.random()  # jitter in [0.5, 1.5)x
-            sleep(delay)
+            sleep(backoff_delay(attempt, base_delay=base_delay,
+                                max_delay=max_delay, rng=rng))
     raise AssertionError("unreachable")  # pragma: no cover

@@ -21,20 +21,47 @@ and this package runs it like one:
   untouched and every finished user durable.  Terminally-failed users are
   recorded without stalling admission.
 
+The serve layer is also its own FAULT DOMAIN (PR 4, "crash-safe
+serving"):
+
+- :mod:`serve.journal` — an append-fsync admission WAL
+  (``users/serve_journal.jsonl``) plus the persisted poison list: a
+  SIGKILLed server restarted from the journal loses no user (finished
+  skipped, in-flight re-admitted and resumed, queued re-enqueued in
+  order), and users past their failure budget are skipped for good.
+- :mod:`serve.watchdog` — wall-clock deadlines on every host step and
+  device dispatch; a hung step's session is evicted via the normal
+  eviction path and its slot refilled.
+- :mod:`serve.breaker` — a per-bucket circuit breaker: repeated stacked-
+  dispatch failures degrade that width to per-user dispatch until a
+  half-open probe recovers it; a failed stacked dispatch falls back to
+  per-user dispatch instead of evicting the whole batch.
+
 Parity is inherited, not re-proven: the server drives the SAME engine
 (``FleetScheduler.open/admit/pump``) over the SAME session generators,
 and padding never changes selections, so per-user results under ``--serve``
 are bit-identical to the sequential loop (pinned for all four modes,
-including eviction+resume, by ``tests/test_serve.py``).
+including eviction+resume, restart recovery and degraded dispatch, by
+``tests/test_serve.py`` and ``tests/test_serve_faults.py``).
 """
 
+from consensus_entropy_tpu.serve.breaker import DispatchBreaker
 from consensus_entropy_tpu.serve.buckets import BucketRouter
+from consensus_entropy_tpu.serve.journal import (
+    AdmissionJournal,
+    JournalState,
+    PoisonList,
+)
 from consensus_entropy_tpu.serve.server import (
     AdmissionQueue,
     FleetServer,
+    QueueClosed,
     QueueFull,
     ServeConfig,
 )
+from consensus_entropy_tpu.serve.watchdog import Watchdog, WatchdogTimeout
 
-__all__ = ["AdmissionQueue", "BucketRouter", "FleetServer", "QueueFull",
-           "ServeConfig"]
+__all__ = ["AdmissionJournal", "AdmissionQueue", "BucketRouter",
+           "DispatchBreaker", "FleetServer", "JournalState", "PoisonList",
+           "QueueClosed", "QueueFull", "ServeConfig", "Watchdog",
+           "WatchdogTimeout"]

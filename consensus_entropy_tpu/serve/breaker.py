@@ -1,0 +1,117 @@
+"""Per-bucket circuit breaker for stacked scoring dispatches.
+
+A stacked (multi-session, vmapped) dispatch amortizes the device
+round-trip — but it also couples its sessions' fates: one bucket whose
+width-specific compiled program keeps failing (a poisoned executable, an
+OOM at that width, a degenerate member payload only that gang produces)
+would fail EVERY batch routed to it, evicting innocent cohabitants over
+and over.  The breaker isolates the blast radius per bucket width:
+
+- **closed** (normal): stacked dispatch allowed.  Each failure of the
+  stacked call increments a consecutive-failure count; reaching
+  ``threshold`` OPENS the breaker.
+- **open**: the width is degraded to per-user (width-1) dispatch — the
+  literal sequential path, which sidesteps whatever the stacked program
+  tripped on — for ``cooldown_s``.
+- **half-open**: after the cooldown, ONE stacked probe is allowed
+  through.  Success closes the breaker (full batching restored); failure
+  re-opens it for another cooldown.
+
+State is per width; a bucket tripping never degrades any other bucket.
+The failure/ success signals come from ``FleetScheduler._dispatch_scores``
+(the only stacked-dispatch site), which also provides the per-user
+fallback the open state routes to.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+#: breaker dispositions, as reported in telemetry events
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+
+@dataclasses.dataclass
+class _BucketState:
+    state: str = CLOSED
+    consecutive_failures: int = 0
+    opened_at: float = 0.0
+    probing: bool = False
+
+
+class DispatchBreaker:
+    """Per-width breaker state machine (see module docstring).
+
+    ``threshold``: consecutive stacked-dispatch failures that open a
+    width.  ``cooldown_s``: how long an open width stays degraded before
+    a half-open probe.  ``clock``: injectable monotonic source (tests).
+    ``trips`` counts closed→open transitions for telemetry."""
+
+    def __init__(self, threshold: int = 2, cooldown_s: float = 30.0, *,
+                 clock=time.monotonic):
+        if threshold < 1:
+            raise ValueError(f"threshold must be >= 1, got {threshold}")
+        if cooldown_s <= 0:
+            raise ValueError(f"cooldown_s must be > 0, got {cooldown_s}")
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._buckets: dict[int, _BucketState] = {}
+        self.trips = 0
+
+    def _bucket(self, width: int) -> _BucketState:
+        return self._buckets.setdefault(width, _BucketState())
+
+    def state_of(self, width: int) -> str:
+        return self._bucket(width).state
+
+    def allow_stacked(self, width: int) -> bool:
+        """May this width dispatch stacked right now?  An open bucket past
+        its cooldown transitions to half-open and admits ONE probe; while
+        the probe's verdict is pending, further batches stay degraded."""
+        b = self._bucket(width)
+        if b.state == CLOSED:
+            return True
+        if b.state == OPEN \
+                and self._clock() - b.opened_at >= self.cooldown_s:
+            b.state = HALF_OPEN
+            b.probing = False
+        if b.state == HALF_OPEN and not b.probing:
+            b.probing = True
+            return True
+        return False
+
+    def record_success(self, width: int) -> str | None:
+        """A stacked dispatch at ``width`` succeeded.  Returns ``"close"``
+        when this was the half-open probe re-closing the breaker (the
+        caller emits the recovery event), else ``None``."""
+        b = self._bucket(width)
+        was_probe = b.state == HALF_OPEN
+        b.state = CLOSED
+        b.consecutive_failures = 0
+        b.probing = False
+        return "close" if was_probe else None
+
+    def record_failure(self, width: int) -> str | None:
+        """A stacked dispatch at ``width`` failed.  Returns ``"open"`` on
+        a closed→open or half-open→open transition (the caller emits the
+        trip event), else ``None``."""
+        b = self._bucket(width)
+        b.consecutive_failures += 1
+        if b.state == HALF_OPEN or b.consecutive_failures >= self.threshold:
+            # failures only arrive when allow_stacked admitted the batch,
+            # so the prior state here is closed or a half-open probe —
+            # either way this is a fresh trip
+            b.state = OPEN
+            b.opened_at = self._clock()
+            b.probing = False
+            self.trips += 1
+            return "open"
+        return None
+
+    def summary(self) -> dict:
+        """``{width: state}`` for every width that ever tripped or is
+        currently degraded — quiet (always-closed) widths are omitted."""
+        return {w: b.state for w, b in sorted(self._buckets.items())
+                if b.state != CLOSED or b.consecutive_failures > 0}

@@ -1,0 +1,267 @@
+"""The admission journal: a durable WAL for the serve layer's user state.
+
+``FleetServer`` (PR 3) keeps its admission state — who is queued, who is
+in flight, who finished — purely in memory: a SIGKILL of the server
+process loses every queued user and forces the operator to re-submit the
+in-flight ones.  This module closes that gap with a write-ahead log,
+``users/serve_journal.jsonl``:
+
+- **append-fsync**: every admission transition (``enqueue`` / ``admit`` /
+  ``finish`` / ``fail`` / ``poison``) is one JSON line, flushed AND
+  fsynced before the server proceeds — by the time a user's transition is
+  acted on, it is durable.  ``finish`` is appended AFTER the driver's
+  ``on_result`` persistence ran, so "finished" in the journal implies the
+  user's workspace is final (a crash between the two re-finishes the user
+  idempotently rather than losing it).
+- **replay**: a restarted server builds a :class:`JournalState` from the
+  journal — each user's LAST event decides its disposition (a trailing
+  half-written line from the crash itself is skipped).  Finished users
+  are skipped on re-submit; in-flight users (last event ``admit`` or
+  ``fail``) are re-admitted FIRST and resume from their durable PR 1
+  workspaces; queued users re-enter the waiting queue in enqueue order;
+  per-user admission attempts survive, so the failure budget is
+  crash-proof.
+- **poison list**: a sibling append-fsync file (:class:`PoisonList`)
+  records users that exhausted their failure budget; future submits skip
+  them instead of burning slots on a user that has already proven
+  terminally broken.
+
+The journal records user IDs (stringified), never payloads: the per-user
+data/committee state lives in the PR 1 workspaces, which are already
+crash-durable via the two-phase checkpoint commit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from consensus_entropy_tpu.resilience import faults
+
+#: admission transitions a journal line may carry
+EVENTS = ("enqueue", "admit", "finish", "fail", "poison")
+
+
+class JournalState:
+    """The replayed disposition of every user a journal has seen.
+
+    ``last[user]`` is the user's final journaled event; :meth:`recovery_order`
+    turns that into the restart admission order — in-flight users first
+    (their workspaces hold the most sunk work), then still-queued users in
+    their enqueue order, then users the journal never saw."""
+
+    def __init__(self):
+        self.last: dict[str, str] = {}
+        self.admits: dict[str, int] = {}
+        self.fails: dict[str, int] = {}
+        self._enqueue_seq: dict[str, int] = {}
+        self._admit_seq: dict[str, int] = {}
+        self._seq = 0
+
+    def apply(self, rec: dict) -> None:
+        event, user = rec.get("event"), rec.get("user")
+        if event not in EVENTS or not isinstance(user, str):
+            return  # foreign/corrupt line: disposition unchanged
+        self._seq += 1
+        self.last[user] = event
+        if event == "enqueue":
+            self._enqueue_seq[user] = self._seq
+        elif event == "admit":
+            self.admits[user] = self.admits.get(user, 0) + 1
+            self._admit_seq.setdefault(user, self._seq)
+        elif event == "fail":
+            self.fails[user] = self.fails.get(user, 0) + 1
+
+    @property
+    def finished(self) -> set:
+        return {u for u, e in self.last.items() if e == "finish"}
+
+    @property
+    def poisoned(self) -> set:
+        return {u for u, e in self.last.items() if e == "poison"}
+
+    @property
+    def in_flight(self) -> list:
+        """Users whose last event is ``admit`` or ``fail`` (admitted, never
+        finished — the crash interrupted them), first-admit order."""
+        live = [u for u, e in self.last.items() if e in ("admit", "fail")]
+        return sorted(live, key=lambda u: self._admit_seq.get(u, 0))
+
+    @property
+    def queued(self) -> list:
+        """Users whose last event is ``enqueue`` (waiting when the server
+        died, or re-queued by backoff), enqueue order."""
+        q = [u for u, e in self.last.items() if e == "enqueue"]
+        return sorted(q, key=lambda u: self._enqueue_seq.get(u, 0))
+
+    @property
+    def pending(self) -> list:
+        return self.in_flight + self.queued
+
+    def recovery_order(self, user_ids) -> list:
+        """Reorder ``user_ids`` for a restarted submit pass: in-flight
+        first, then journal-queued in enqueue order, then unseen users in
+        their given order, then finished users last (they cost one skip
+        check each — keeping them lets the driver surface its normal
+        "skipping" message).  Poisoned users are dropped outright."""
+        by_key = {}
+        for u in user_ids:
+            by_key.setdefault(str(u), u)
+        out = []
+        for key in self.pending:
+            if key in by_key:
+                out.append(by_key.pop(key))
+        done, poisoned = self.finished, self.poisoned
+        out.extend(u for k, u in by_key.items()
+                   if k not in done and k not in poisoned)
+        out.extend(u for k, u in by_key.items() if k in done)
+        return out
+
+
+def _replay(path: str) -> JournalState:
+    state = JournalState()
+    if not os.path.exists(path):
+        return state
+    with open(path, "rb") as f:
+        for raw in f:
+            try:
+                rec = json.loads(raw.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                # a half-written tail line IS the expected crash artifact:
+                # its transition never happened as far as recovery cares
+                continue
+            if isinstance(rec, dict):
+                state.apply(rec)
+    return state
+
+
+class _AppendFsyncFile:
+    """One JSONL record per call, durable before return (flush + fsync).
+    The handle is opened lazily and kept open — the fsync per append is
+    the durability point, reopening per line would only add syscalls."""
+
+    def __init__(self, path: str | None):
+        self.path = path
+        self._f = None
+
+    def append(self, rec: dict) -> None:
+        if self.path is None:
+            return
+        if self._f is None:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            self._f = open(self.path, "ab")
+        self._f.write((json.dumps(rec) + "\n").encode("utf-8"))
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class AdmissionJournal:
+    """The serve layer's WAL (see module docstring).
+
+    Construction replays any existing journal into :attr:`state`; the
+    server consults it for skip/ordering/attempt decisions, then appends
+    new transitions through :meth:`append`.  ``path=None`` journals
+    nothing (unit tests, embedded drivers) while keeping the interface.
+    """
+
+    def __init__(self, path: str | None):
+        self.path = path
+        self.state = _replay(path) if path else JournalState()
+        self._file = _AppendFsyncFile(path)
+        #: appends happen on the serve-loop thread, but ``FleetServer.
+        #: submit`` (producer threads) both appends (enqueue) and reads
+        #: the replayed state (finished-skip) — one lock covers the file
+        #: handle and the state dicts
+        self._lock = threading.Lock()
+
+    @property
+    def recovered(self) -> bool:
+        """True when the journal held prior state to recover from."""
+        return bool(self.state.last)
+
+    def append(self, event: str, user, **fields) -> None:
+        """Durably record one transition; thread-safe.  The
+        ``serve.journal.append`` fault point fires BEFORE the write: an
+        injected kill there models dying with the transition un-journaled,
+        which recovery must treat as 'never happened' (the enclosing step
+        is re-done on restart)."""
+        if event not in EVENTS:
+            raise ValueError(f"unknown journal event {event!r}")
+        with self._lock:
+            faults.fire("serve.journal.append", event=event,
+                        user=str(user))
+            rec = {"event": event, "user": str(user),
+                   "t": round(time.time(), 3), **fields}
+            self._file.append(rec)
+            self.state.apply(rec)
+
+    def is_finished(self, user) -> bool:
+        """Thread-safe finished-check for producer-side skip decisions
+        (reading ``state`` directly is only safe on the serve-loop
+        thread)."""
+        with self._lock:
+            return self.state.last.get(str(user)) == "finish"
+
+    def close(self) -> None:
+        with self._lock:
+            self._file.close()
+
+    def __enter__(self) -> "AdmissionJournal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class PoisonList:
+    """Users that exhausted their failure budget, persisted append-fsync
+    (``users/serve_poison.jsonl``): a poisoned user is skipped on every
+    future submit instead of re-burning admission slots.  ``path=None``
+    keeps the list in memory only (single-run semantics)."""
+
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self._users: dict[str, dict] = {}
+        if path and os.path.exists(path):
+            with open(path, "rb") as f:
+                for raw in f:
+                    try:
+                        rec = json.loads(raw.decode("utf-8"))
+                    except (ValueError, UnicodeDecodeError):
+                        continue  # half-written tail from a crash
+                    if isinstance(rec, dict) and "user" in rec:
+                        self._users[str(rec["user"])] = rec
+        self._file = _AppendFsyncFile(path)
+        # adds run on the serve-loop thread; membership checks also run
+        # on producer threads (FleetServer.submit skip path)
+        self._lock = threading.Lock()
+
+    def add(self, user, *, error: str, attempts: int) -> None:
+        rec = {"user": str(user), "error": error, "attempts": attempts,
+               "t": round(time.time(), 3)}
+        with self._lock:
+            self._users[str(user)] = rec
+            self._file.append(rec)
+
+    def __contains__(self, user) -> bool:
+        with self._lock:
+            return str(user) in self._users
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._users)
+
+    def record(self, user) -> dict | None:
+        with self._lock:
+            return self._users.get(str(user))
+
+    def close(self) -> None:
+        with self._lock:
+            self._file.close()

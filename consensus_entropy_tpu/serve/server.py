@@ -24,6 +24,28 @@ continuously:
   and ``Preempted`` is raised so the CLI exits ``EXIT_PREEMPTED`` (75);
   a rerun picks the queued users up from their unstarted workspaces.
 
+The serve-layer **fault domain** (this PR's tentpole) hardens the server
+itself:
+
+- **Crash safety** — every admission transition is WAL-journaled
+  (:class:`~consensus_entropy_tpu.serve.journal.AdmissionJournal`,
+  append-fsync) so a SIGKILLed server restarted from
+  ``serve_journal.jsonl`` loses no user: finished users are skipped,
+  in-flight users re-admitted first (resuming from their durable PR 1
+  workspaces), queued users re-enqueued in order.
+- **Watchdog** — ``ServeConfig.watchdog_s`` bounds every host step and
+  device dispatch; a hung step's session is evicted through the normal
+  eviction path and its slot refilled (``serve.watchdog``).
+- **Backoff re-admission** — a terminally failed session (resumes
+  exhausted) re-enters the waiting queue with seeded-jitter exponential
+  backoff (``resilience.retry.backoff_delay``) up to
+  ``ServeConfig.failure_budget`` total admissions; past the budget the
+  user lands in the persisted poison list and is skipped on every future
+  submit instead of burning slots.
+- **Circuit breaker** — ``ServeConfig.breaker_threshold`` consecutive
+  stacked-dispatch failures degrade that bucket width to per-user
+  dispatch until a half-open probe recovers it (``serve.breaker``).
+
 Sessions run WITHOUT the guard (the server owns preemption), so a drain
 finishes in-flight work instead of tearing it down mid-iteration — the
 constructor rejects a scheduler that would hand the guard to sessions.
@@ -36,12 +58,24 @@ import dataclasses
 import threading
 import time
 
+import numpy as np
+
 from consensus_entropy_tpu.fleet.scheduler import FleetScheduler, FleetUser
+from consensus_entropy_tpu.resilience import faults
+from consensus_entropy_tpu.resilience.retry import backoff_delay
+from consensus_entropy_tpu.serve.breaker import DispatchBreaker
 from consensus_entropy_tpu.serve.buckets import BucketRouter
+from consensus_entropy_tpu.serve.journal import PoisonList
+from consensus_entropy_tpu.serve.watchdog import Watchdog
 
 
 class QueueFull(RuntimeError):
     """The bounded waiting queue rejected an enqueue (backpressure)."""
+
+
+class QueueClosed(RuntimeError):
+    """The waiting queue was closed (drain): producers must stop
+    retrying — the entry will never be accepted this run."""
 
 
 @dataclasses.dataclass
@@ -58,12 +92,30 @@ class ServeConfig:
     admission-side sibling of the engine's ``batch_window_s``).
     ``bucket_widths``: explicit bucket edges, or ``None`` for powers of
     two (see :class:`BucketRouter`).
+
+    Fault-domain knobs:
+    ``watchdog_s``: wall-clock deadline per engine step (host block or
+    device dispatch); 0 disables.  ``failure_budget``: total admissions
+    per user (first + backoff re-admissions) before the user is poisoned;
+    1 disables re-admission.  ``backoff_base_s``/``backoff_max_s``/
+    ``backoff_seed``: the seeded-jitter exponential re-admission schedule
+    (``resilience.retry.backoff_delay``).  ``breaker_threshold``:
+    consecutive stacked-dispatch failures that open a bucket's circuit
+    breaker (0 disables); ``breaker_cooldown_s``: how long an open bucket
+    stays degraded to per-user dispatch before a half-open probe.
     """
 
     target_live: int = 4
     max_queue: int = 64
     admit_window_s: float = 0.0
     bucket_widths: tuple | None = None
+    watchdog_s: float = 0.0
+    failure_budget: int = 3
+    backoff_base_s: float = 0.25
+    backoff_max_s: float = 8.0
+    backoff_seed: int = 0
+    breaker_threshold: int = 0
+    breaker_cooldown_s: float = 30.0
 
     def __post_init__(self):
         if self.target_live < 1:
@@ -71,6 +123,15 @@ class ServeConfig:
                              f"got {self.target_live}")
         if self.max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
+        if self.watchdog_s < 0:
+            raise ValueError(f"watchdog_s must be >= 0, "
+                             f"got {self.watchdog_s}")
+        if self.failure_budget < 1:
+            raise ValueError(f"failure_budget must be >= 1, "
+                             f"got {self.failure_budget}")
+        if self.breaker_threshold < 0:
+            raise ValueError(f"breaker_threshold must be >= 0, "
+                             f"got {self.breaker_threshold}")
 
 
 class AdmissionQueue:
@@ -82,11 +143,32 @@ class AdmissionQueue:
         self.maxsize = maxsize
         self._q: collections.deque = collections.deque()
         self._cond = threading.Condition()
+        self._closed = False
+
+    def close(self) -> None:
+        """Drain sentinel: no further ``put`` succeeds (``QueueClosed``),
+        and every thread blocked in :meth:`wait_nonempty` /
+        :meth:`wait_at_least` wakes PROMPTLY instead of spinning out its
+        timeout — a producer stuck in a put-retry loop sees the closed
+        queue on its next attempt and stops.  Entries already queued stay
+        readable (a drain leaves them for the rerun)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed
 
     def put(self, entry: FleetUser) -> int:
         """Enqueue; returns the depth AFTER.  Raises :class:`QueueFull`
-        at the bound — the caller (a producer) must back off."""
+        at the bound — the caller (a producer) must back off — and
+        :class:`QueueClosed` once the queue closed (stop retrying)."""
         with self._cond:
+            if self._closed:
+                raise QueueClosed("admission queue is closed (drain); "
+                                  "stop submitting")
             if len(self._q) >= self.maxsize:
                 raise QueueFull(
                     f"admission queue is at its bound ({self.maxsize}); "
@@ -111,18 +193,24 @@ class AdmissionQueue:
             return self._q.popleft() if self._q else None
 
     def wait_nonempty(self, timeout: float) -> bool:
+        """True when the queue is non-empty at return; a :meth:`close`
+        wakes the wait immediately (returning the actual emptiness) so
+        drains never sit out the full timeout."""
         with self._cond:
-            return self._cond.wait_for(lambda: bool(self._q),
-                                       timeout=timeout)
+            self._cond.wait_for(lambda: self._closed or bool(self._q),
+                                timeout=timeout)
+            return bool(self._q)
 
     def wait_at_least(self, n: int, timeout: float) -> bool:
         """Block until the queue holds ``n`` entries or ``timeout``
         elapses — the admission-window primitive: arrivals landing within
         the window gang into one admission (and thus phase-align into one
-        bucket dispatch) instead of trickling in one at a time."""
+        bucket dispatch) instead of trickling in one at a time.  A
+        :meth:`close` wakes the wait immediately."""
         with self._cond:
-            return self._cond.wait_for(lambda: len(self._q) >= n,
-                                       timeout=timeout)
+            self._cond.wait_for(lambda: self._closed or len(self._q) >= n,
+                                timeout=timeout)
+            return len(self._q) >= n
 
     def __len__(self) -> int:
         with self._cond:
@@ -144,7 +232,7 @@ class FleetServer:
     """
 
     def __init__(self, scheduler: FleetScheduler, config: ServeConfig, *,
-                 preemption=None):
+                 preemption=None, journal=None, poison=None):
         if scheduler.preemption is not None:
             raise ValueError(
                 "serve mode owns preemption: build the FleetScheduler with "
@@ -159,24 +247,77 @@ class FleetServer:
         self.report = scheduler.report
         self.results: list[dict] = []
         self._admitted: list[FleetUser] = []
+        self._admitted_ids: set[int] = set()
         self._pending: set[int] = set()
         #: one pulled-but-unqueued entry held when a concurrent submit()
         #: filled the queue's last slot between our pull and our put
         self._spill: FleetUser | None = None
         self._draining = False
         self._intake_open = True
+        #: optional serve.journal.AdmissionJournal — the crash-safety WAL;
+        #: its replayed state seeds skip/ordering/attempt decisions
+        self.journal = journal
+        #: serve.journal.PoisonList (in-memory when the caller passes
+        #: none): users past their failure budget, skipped on submit
+        self.poison = poison if poison is not None else PoisonList()
+        #: per-user admission attempts (the failure-budget counter),
+        #: seeded from the journal so the budget survives restarts
+        self._attempts: dict[str, int] = (
+            dict(journal.state.admits) if journal is not None else {})
+        #: ``(due_monotonic, entry)`` backoff re-admissions not yet due
+        self._requeue: list = []
+        self._backoff_rng = np.random.default_rng(config.backoff_seed)
+        # the fault-domain engine hooks: install from config unless the
+        # caller wired its own instances into the scheduler already
+        if config.watchdog_s > 0 and scheduler.watchdog is None:
+            scheduler.watchdog = Watchdog(config.watchdog_s)
+        if config.breaker_threshold > 0 and scheduler.breaker is None:
+            scheduler.breaker = DispatchBreaker(
+                config.breaker_threshold, config.breaker_cooldown_s)
+        if scheduler.on_terminal is not None:
+            raise ValueError(
+                "FleetServer owns the scheduler's on_terminal hook "
+                "(backoff re-admission); build the scheduler with "
+                "on_terminal=None")
+        scheduler.on_terminal = self._on_terminal
 
     # -- producer surface --------------------------------------------------
 
     def submit(self, entry: FleetUser) -> int:
         """Thread-safe enqueue for external producers; returns queue depth.
-        Raises :class:`QueueFull` at the bound and ``RuntimeError`` once
-        the server is draining or its intake closed."""
+        Raises :class:`QueueFull` at the bound and ``RuntimeError``
+        (:class:`QueueClosed` on a drained queue) once the server is
+        draining or its intake closed.  A user the journal shows finished,
+        or the poison list shows past its failure budget, is skipped (the
+        skip is reported, the depth returned unchanged)."""
         if self._draining or not self._intake_open:
             raise RuntimeError("server is draining; not accepting users")
+        if self._skip(entry):
+            return len(self.queue)
         depth = self.queue.put(entry)
+        self._journal("enqueue", entry.user_id)
         self.report.enqueued(entry.user_id, depth)
         return depth
+
+    def _skip(self, entry: FleetUser) -> bool:
+        """Journal-finished and poisoned users never re-enter the queue.
+        Runs on producer threads too (``submit``), so it only touches the
+        journal/poison list through their thread-safe surfaces."""
+        uid = str(entry.user_id)
+        if self.journal is not None and self.journal.is_finished(uid):
+            self.report.event("skip_done", user=uid)
+            return True
+        if uid in self.poison:
+            rec = self.poison.record(uid) or {}
+            self.report.event("skip_poisoned", user=uid,
+                              error=rec.get("error"),
+                              attempts=rec.get("attempts"))
+            return True
+        return False
+
+    def _journal(self, event: str, user, **fields) -> None:
+        if self.journal is not None:
+            self.journal.append(event, user, **fields)
 
     def close_intake(self) -> None:
         """No further ``submit``s: :meth:`serve` returns once the queue
@@ -212,6 +353,12 @@ class FleetServer:
         cfg = self.config
         src = iter(source)
         src_live = True
+        if self.journal is not None and self.journal.recovered:
+            st = self.journal.state
+            self.report.event(
+                "journal_recover", finished=len(st.finished),
+                in_flight=len(st.in_flight), queued=len(st.queued),
+                poisoned=len(st.poisoned))
         sched.open(cfg.target_live)
         try:
             while True:
@@ -219,12 +366,17 @@ class FleetServer:
                         and self.preemption.requested
                         and not self._draining):
                     self._draining = True
+                    # wake producers promptly: put-retry loops get
+                    # QueueClosed, wait_* calls return instead of
+                    # spinning out their timeouts
+                    self.queue.close()
                     self.report.event(
                         "drain", queued=len(self.queue),
                         live=sched.n_live,
                         reason="preemption requested; finishing in-flight "
                                "sessions, queue left for the rerun")
                 if not self._draining:
+                    self._admit_due_requeues()
                     src_live = self._refill(src, src_live)
                     if not src_live and not keep_open:
                         self._intake_open = False
@@ -245,27 +397,37 @@ class FleetServer:
                     self._collect(on_result)
                     continue
                 # engine idle: anything left to wait for?  (a held spill
-                # entry counts as queued — it must not be dropped)
+                # entry counts as queued, and so does a not-yet-due
+                # backoff re-admission — neither may be dropped)
                 if self._draining or (not len(self.queue)
                                       and self._spill is None
+                                      and not self._requeue
                                       and not self._intake_open):
                     break
                 if not len(self.queue):
-                    # free slots, empty queue, open intake: wait (bounded,
-                    # so a drain request is never missed) for an arrival,
-                    # which the next round's admission window may gang
-                    self.queue.wait_nonempty(max(cfg.admit_window_s, 0.05))
+                    # free slots, empty queue: wait (bounded, so a drain
+                    # request is never missed) for an arrival or for the
+                    # next backoff re-admission to come due
+                    timeout = max(cfg.admit_window_s, 0.05)
+                    if self._requeue:
+                        due = min(t for t, _ in self._requeue) \
+                            - time.monotonic()
+                        timeout = min(timeout, max(due, 0.01))
+                    self.queue.wait_nonempty(timeout)
         except BaseException:
             sched.abort()
             raise
         finally:
             sched.close()
+            self.queue.close()
             self._collect(on_result)
-            # admission-ordered, whatever order completions landed in
+            # admission-ordered, whatever order completions landed in (a
+            # backoff-re-admitted user keeps its FIRST admission slot)
             self.results = [sched.results[id(e)] for e in self._admitted
                             if id(e) in sched.results]
         if self._draining:
-            queued = len(self.queue) + (1 if self._spill is not None else 0)
+            queued = (len(self.queue) + len(self._requeue)
+                      + (1 if self._spill is not None else 0))
             raise Preempted(
                 f"drained: {len(self.results)} user(s) finished in-flight, "
                 f"{queued} left queued — rerun to serve them")
@@ -288,33 +450,106 @@ class FleetServer:
                 depth = self.queue.try_put(self._spill)
                 if depth is None:  # producers still hold the last slot
                     return src_live
+                self._journal("enqueue", self._spill.user_id)
                 self.report.enqueued(self._spill.user_id, depth)
                 self._spill = None
             if not src_live or len(self.queue) >= want:
                 return src_live
             try:
-                self._spill = next(src)
+                cand = next(src)
             except StopIteration:
                 return False
+            if not self._skip(cand):  # finished/poisoned never re-enter
+                self._spill = cand
 
     def _admit_up_to_target(self) -> None:
         """Refill freed engine slots from the queue — the continuous-
         batching core: admission happens the moment occupancy dips, not at
-        cohort boundaries."""
+        cohort boundaries.  Each admission is journaled (the ``admit``
+        transition makes the user in-flight for crash recovery) and
+        counted against the user's failure budget."""
         sched = self.scheduler
         while sched.n_live < self.config.target_live:
             item = self.queue.pop()
             if item is None:
                 return
             entry, t_enq = item
+            uid = str(entry.user_id)
             width = self.router.width_for(entry.data.pool.n_songs)
+            # a kill here models dying between the queue pop and the
+            # durable admit record: the journal still shows the user
+            # queued, so a restart re-enqueues it — no user is lost
+            faults.fire("serve.admit", user=uid, width=width)
+            self._journal("admit", uid)
+            self._attempts[uid] = self._attempts.get(uid, 0) + 1
             sched.admit(entry, pad=width)
-            self._admitted.append(entry)
+            if id(entry) not in self._admitted_ids:
+                self._admitted_ids.add(id(entry))
+                self._admitted.append(entry)
             self._pending.add(id(entry))
             self.report.admitted(
                 entry.user_id, width=width,
                 wait_s=time.perf_counter() - t_enq,
                 depth=len(self.queue), live=sched.n_live)
+
+    def _admit_due_requeues(self) -> None:
+        """Move backoff re-admissions whose delay elapsed back into the
+        waiting queue (a full queue just postpones them — the entry keeps
+        its due time and retries next round)."""
+        if not self._requeue:
+            return
+        now = time.monotonic()
+        still: list = []
+        for due, entry in self._requeue:
+            if due > now:
+                still.append((due, entry))
+                continue
+            depth = self.queue.try_put(entry)
+            if depth is None:
+                still.append((due, entry))
+                continue
+            self._journal("enqueue", entry.user_id)
+            self.report.enqueued(entry.user_id, depth)
+        self._requeue = still
+
+    def _on_terminal(self, entry: FleetUser, error: str,
+                     resumes: int) -> bool:
+        """The scheduler's terminal-failure hook: decide between backoff
+        re-admission (absorb — return True) and a FINAL failure (return
+        False so the scheduler records it).  Final failures past the
+        budget also land in the persisted poison list, so future submits
+        skip the user."""
+        uid = str(entry.user_id)
+        attempts = self._attempts.get(uid, 1)
+        if (self._draining or entry.committee_factory is None
+                or self.config.failure_budget <= 1):
+            return False  # not re-admittable: record the failure now
+        if attempts >= self.config.failure_budget:
+            self.poison.add(uid, error=error, attempts=attempts)
+            self._journal("poison", uid, error=error, attempts=attempts)
+            self.report.event("poison", user=uid, error=error,
+                              attempts=attempts)
+            return False  # budget exhausted: record it, poisoned for good
+        try:
+            # reload NOW, while the evicted session's workspace is
+            # quiescent: the re-admitted attempt must start from the
+            # durable two-phase-committed state, not the faulted
+            # in-memory committee
+            entry.committee = entry.committee_factory()
+        except Exception as load_err:
+            # nothing to re-admit with: record the failure terminally
+            self.report.event("requeue_reload_failed", user=uid,
+                              error=repr(load_err))
+            return False
+        delay = backoff_delay(attempts - 1,
+                              base_delay=self.config.backoff_base_s,
+                              max_delay=self.config.backoff_max_s,
+                              rng=self._backoff_rng)
+        self._requeue.append((time.monotonic() + delay, entry))
+        self._journal("fail", uid, error=error, attempt=attempts)
+        self.report.event("requeue", user=uid, attempt=attempts,
+                          delay_s=round(delay, 4), error=error)
+        return True
 
     def _collect(self, on_result) -> None:
         """Surface newly-finished users (done or terminally failed) to
@@ -328,7 +563,23 @@ class FleetServer:
             return
         finished = [eid for eid in self._pending
                     if eid in self.scheduler.results]
+        if not finished:
+            return
+        # a kill here models dying between engine completion and the
+        # durable finish record: the journal still shows the user
+        # in-flight, so a restart re-admits it and it re-finishes from its
+        # final workspace (idempotently) — no user is lost
+        faults.fire("serve.collect", n=len(finished))
         for eid in finished:
             self._pending.discard(eid)
+            rec = self.scheduler.results[eid]
             if on_result is not None:
-                on_result(self.scheduler.results[eid])
+                on_result(rec)
+            if rec["error"] is None:
+                # AFTER on_result: "finished" in the journal implies the
+                # driver's persistence ran, so recovery may skip the user
+                self._journal("finish", rec["user"])
+            elif str(rec["user"]) not in self.poison:
+                # a final (non-poisoned) failure stays re-admittable on
+                # restart: the journal keeps the user in-flight
+                self._journal("fail", rec["user"], error=rec["error"])

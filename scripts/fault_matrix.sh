@@ -3,18 +3,27 @@
 # scripts/race_check.sh for the resilience layer).
 #
 # Runs EVERY fault-injection test, including the slow full matrix that
-# tier-1 skips: for each named fault point (checkpoint.write,
-# member.retrain, member.predict, pool.score, state.save, multihost.sync)
-# x each acquisition mode (mc/hc/mix/rand), a run killed at that boundary
-# and resumed must reproduce the unfaulted F1 trajectory bit-for-bit, and
-# a corrupted live checkpoint must roll back one generation and converge
-# to the same trajectory.
+# tier-1 skips:
+#
+# - per-session (tests/test_resilience.py): for each named fault point
+#   (checkpoint.write, member.retrain, member.predict, pool.score,
+#   state.save, multihost.sync) x each acquisition mode (mc/hc/mix/rand),
+#   a run killed at that boundary and resumed must reproduce the
+#   unfaulted F1 trajectory bit-for-bit, and a corrupted live checkpoint
+#   must roll back one generation and converge to the same trajectory.
+# - serve-layer (tests/test_serve_faults.py): for each serve boundary
+#   (serve.admit, serve.journal.append, serve.dispatch, serve.collect)
+#   plus the 4-mode restart matrix, a SIGKILLed server restarted from
+#   serve_journal.jsonl must finish EVERY submitted user with results
+#   bit-identical to an uninterrupted run — journal recovery loses no
+#   user; the watchdog/backoff/poison/breaker drills ride along.
 #
 # Extra pytest args pass through, e.g.:
 #   scripts/fault_matrix.sh -k kill_at_every_boundary
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
-JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -v -m faults \
+JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
+  tests/test_serve_faults.py -v -m faults \
   -p no:cacheprovider "$@"
 echo "fault matrix passed"

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Crash-safe-serving throughput under faults (ISSUE 4 CI drill; the
+# resilience sibling of scripts/serve_bench.sh).
+#
+# Runs `bench.py --suite serve-faults`: the serve layer over a flaky user
+# mix — every 3rd user's victim member raises on its first two retrains
+# (burning the session AND its in-engine resume, so recovery goes through
+# serve-layer backoff re-admission), a straggler pool.score delay trips
+# the session watchdog, and a transient stacked-dispatch fault exercises
+# the per-bucket circuit breaker.  Sequential UNFAULTED runs are the
+# ground truth: parity is asserted per user on every rep (reps are
+# interleaved best-of per the 2-vCPU drift protocol), then the JSON line
+# reports recovered-users/sec plus eviction/resume/requeue/watchdog/
+# breaker trip counts.
+#
+# The JSON line goes to stdout (redirect to BENCH_serve_faults_r<N>.json
+# to commit an artifact); the per-rep log goes to stderr.  Extra bench
+# args pass through, e.g.:
+#   scripts/serve_fault_bench.sh --users 6 --reps 2
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+if [ "$#" -gt 0 ]; then
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py \
+        --suite serve-faults "$@"
+else
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py \
+        --suite serve-faults --users 8 --pool 120 --fleet 4
+fi

@@ -295,6 +295,15 @@ def test_serve_flag_validation(synth_roots, capsys):
     assert "requires --serve" in capsys.readouterr().out
     assert amg_test.main(base + ["--admit-window-ms", "10"]) == 1
     assert "requires --serve" in capsys.readouterr().out
+    # the fault-domain flags are serve-only too
+    for flags in (["--watchdog-s", "5"], ["--failure-budget", "2"],
+                  ["--breaker-threshold", "3"], ["--no-serve-journal"],
+                  ["--breaker-cooldown-s", "1"]):
+        assert amg_test.main(base + flags) == 1
+        assert "requires --serve" in capsys.readouterr().out
+    assert amg_test.main(base + ["--serve", "2",
+                                 "--failure-budget", "0"]) == 1
+    assert ">= 1" in capsys.readouterr().out
 
 
 @pytest.mark.slow
@@ -323,8 +332,15 @@ def test_serve_cli_matches_sequential(synth_roots, capsys):
     seq_users = os.path.join(seq_mr, "users")
     serve_users = os.path.join(serve_mr, "users")
     uids = sorted(os.listdir(seq_users))
+    serve_files = {"fleet_metrics.jsonl", "serve_journal.jsonl",
+                   "serve_poison.jsonl"}
     assert sorted(f for f in os.listdir(serve_users)
-                  if f != "fleet_metrics.jsonl") == uids
+                  if f not in serve_files) == uids
+    # the admission journal shows every user enqueued/admitted/finished
+    jrecs = [json.loads(l) for l in
+             open(os.path.join(serve_users, "serve_journal.jsonl"))]
+    assert {r["user"] for r in jrecs if r["event"] == "finish"} \
+        == {u for u in uids}
     for uid in uids:
         sd = os.path.join(seq_users, uid, "mc")
         fd = os.path.join(serve_users, uid, "mc")

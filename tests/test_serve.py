@@ -28,6 +28,7 @@ from consensus_entropy_tpu.serve import (
     AdmissionQueue,
     BucketRouter,
     FleetServer,
+    QueueClosed,
     QueueFull,
     ServeConfig,
 )
@@ -113,14 +114,72 @@ def test_admission_queue_try_put_and_wait_at_least():
         t.join()
 
 
+def test_admission_queue_close_wakes_waiters_and_producers():
+    """The drain sentinel: close() makes put raise QueueClosed (ending
+    producer retry loops promptly), wakes wait_* early, and leaves queued
+    entries readable for the rerun."""
+    import threading
+    import time as _time
+
+    q = AdmissionQueue(2)
+    q.put("a")
+    q.put("b")
+    woke = {}
+
+    def waiter():
+        t0 = _time.perf_counter()
+        woke["result"] = q.wait_at_least(5, timeout=30.0)
+        woke["s"] = _time.perf_counter() - t0
+
+    def producer():
+        # the put-retry loop every threaded producer runs: QueueFull →
+        # back off and retry; QueueClosed must END the loop, not retry
+        t0 = _time.perf_counter()
+        while True:
+            try:
+                q.put("c")
+                break
+            except QueueFull:
+                _time.sleep(0.005)
+            except QueueClosed:
+                woke["producer"] = "closed"
+                break
+        woke["producer_s"] = _time.perf_counter() - t0
+
+    tw = threading.Thread(target=waiter)
+    tp = threading.Thread(target=producer)
+    tw.start(), tp.start()
+    _time.sleep(0.05)
+    q.close()
+    tw.join(timeout=5.0), tp.join(timeout=5.0)
+    assert not tw.is_alive() and not tp.is_alive()
+    assert woke["result"] is False and woke["s"] < 5.0  # not the full 30s
+    assert woke["producer"] == "closed" and woke["producer_s"] < 5.0
+    assert q.closed
+    with pytest.raises(QueueClosed):
+        q.put("d")
+    assert q.pop()[0] == "a" and q.pop()[0] == "b"  # drain leaves entries
+    assert q.wait_nonempty(0.01) is False
+
+
 def test_serve_config_validation():
     with pytest.raises(ValueError):
         ServeConfig(target_live=0)
     with pytest.raises(ValueError):
         ServeConfig(max_queue=0)
+    with pytest.raises(ValueError):
+        ServeConfig(watchdog_s=-1.0)
+    with pytest.raises(ValueError):
+        ServeConfig(failure_budget=0)
+    with pytest.raises(ValueError):
+        ServeConfig(breaker_threshold=-1)
     with pytest.raises(ValueError, match="owns preemption"):
         FleetServer(FleetScheduler(ALConfig(queries=2, epochs=1, mode="mc"),
                                    preemption=object()),
+                    ServeConfig())
+    with pytest.raises(ValueError, match="on_terminal"):
+        FleetServer(FleetScheduler(ALConfig(queries=2, epochs=1, mode="mc"),
+                                   on_terminal=lambda *a: False),
                     ServeConfig())
 
 
@@ -326,6 +385,11 @@ def test_serve_drain_finishes_in_flight_and_leaves_queue(tmp_path):
                          preemption=TripAfter(1))
     with pytest.raises(Preempted, match="drained"):
         server.serve(iter(entries))
+    # the drain closed the queue: producers blocked in put-retry loops or
+    # wait_* see it promptly instead of spinning out their timeouts
+    assert server.queue.closed
+    with pytest.raises(QueueClosed):
+        server.queue.put(entries[-1])
     # the first admissions ran to completion with sequential results
     assert 1 <= len(server.results) < 4
     for rec in server.results:

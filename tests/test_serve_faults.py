@@ -1,0 +1,490 @@
+"""Crash-safe serving: journal restart recovery, watchdog, backoff
+re-admission + poison list, dispatch circuit breaker.
+
+The headline drill kills the server at serve-layer boundaries
+(``serve.admit`` / ``serve.journal.append`` / ``serve.dispatch`` /
+``serve.collect``), restarts it from ``serve_journal.jsonl`` and asserts
+that EVERY submitted user finishes with results bit-identical to an
+uninterrupted run — recovery is exercised, not trusted.  Tier-1 keeps the
+pure-host units and one mc 3-user restart case (the acceptance pin); the
+kill matrix, the 4-mode restart matrix and the watchdog/backoff/poison/
+breaker drills are ``slow`` and run via ``scripts/fault_matrix.sh``.
+
+Parity is exact (``==`` on float lists) throughout: recovery replays the
+same sessions from the same durable workspaces, and degraded (per-user)
+dispatch is the literal sequential scoring path.
+"""
+
+import dataclasses
+
+import pytest
+
+from consensus_entropy_tpu.al import workspace
+from consensus_entropy_tpu.al.loop import ALLoop
+from consensus_entropy_tpu.fleet import FleetReport, FleetScheduler, FleetUser
+from consensus_entropy_tpu.resilience import faults
+from consensus_entropy_tpu.resilience.faults import FaultRule, InjectedKill
+from consensus_entropy_tpu.resilience.retry import backoff_delay
+from consensus_entropy_tpu.serve import (
+    AdmissionJournal,
+    DispatchBreaker,
+    FleetServer,
+    PoisonList,
+    ServeConfig,
+    Watchdog,
+    WatchdogTimeout,
+)
+from tests.test_fleet import _cfg, _committee, _user_data
+
+pytestmark = [pytest.mark.serve, pytest.mark.faults]
+
+
+# -- pure-host units (no jax) ---------------------------------------------
+
+
+def test_journal_replay_and_recovery_order(tmp_path):
+    """The WAL replays into per-user dispositions; a half-written tail
+    line (the crash artifact an fsynced append can leave) is ignored."""
+    jp = str(tmp_path / "j.jsonl")
+    with AdmissionJournal(jp) as j:
+        for ev, u in [("enqueue", "a"), ("enqueue", "b"), ("admit", "a"),
+                      ("enqueue", "c"), ("admit", "b"), ("finish", "a"),
+                      ("fail", "b")]:
+            j.append(ev, u)
+    with open(jp, "ab") as f:
+        f.write(b'{"event": "fin')  # torn tail write
+    st = AdmissionJournal(jp).state
+    assert st.finished == {"a"}
+    assert st.in_flight == ["b"]  # last event fail: still re-admittable
+    assert st.queued == ["c"]
+    assert st.admits == {"a": 1, "b": 1} and st.fails == {"b": 1}
+    # in-flight first, queued next, unseen, then finished last (cheap
+    # skips that let the driver print its usual message)
+    assert st.recovery_order(["a", "b", "c", "d"]) == ["b", "c", "d", "a"]
+    with pytest.raises(ValueError, match="unknown journal event"):
+        AdmissionJournal(None).append("bogus", "u")
+
+
+def test_journal_append_is_a_fault_point(tmp_path):
+    """``serve.journal.append`` fires BEFORE the write: a kill there dies
+    with the transition un-journaled, which replay treats as 'never
+    happened'."""
+    jp = str(tmp_path / "j.jsonl")
+    j = AdmissionJournal(jp)
+    j.append("enqueue", "a")
+    with faults.inject(FaultRule("serve.journal.append", "kill")) as inj:
+        with pytest.raises(InjectedKill):
+            j.append("admit", "a")
+        assert inj.fired
+    j.close()
+    st = AdmissionJournal(jp).state
+    assert st.queued == ["a"] and not st.in_flight  # admit never landed
+
+
+def test_poison_list_persists_and_skips(tmp_path):
+    pp = str(tmp_path / "p.jsonl")
+    p = PoisonList(pp)
+    assert "x" not in p
+    p.add("x", error="boom", attempts=3)
+    assert "x" in p and len(p) == 1
+    p.close()
+    p2 = PoisonList(pp)  # reload across restarts
+    assert "x" in p2 and p2.record("x")["attempts"] == 3
+    mem = PoisonList()  # path=None: in-memory only
+    mem.add("y", error="e", attempts=1)
+    assert "y" in mem
+
+
+def test_watchdog_deadline_call_and_arm():
+    import time
+
+    w = Watchdog(0.15)
+    assert w.call(lambda: 42, "quick") == 42
+    with pytest.raises(WatchdogTimeout):
+        w.call(lambda: time.sleep(2.0), "hang")
+    assert w.trips == 1
+    w.arm("k", "step")
+    assert not w.expired()
+    time.sleep(0.2)
+    exp = w.expired()
+    assert exp and exp[0][0] == "k" and exp[0][1] == "step"
+    assert isinstance(w.trip("k", "step", exp[0][2]), WatchdogTimeout)
+    assert w.trips == 2 and not w.expired()
+    assert 0.01 <= w.poll_s() <= 0.15
+    with pytest.raises(ValueError):
+        Watchdog(0.0)
+
+
+def test_breaker_state_machine():
+    clock = [0.0]
+    b = DispatchBreaker(2, 10.0, clock=lambda: clock[0])
+    assert b.allow_stacked(32)
+    assert b.record_failure(32) is None  # 1 of 2
+    assert b.allow_stacked(32)
+    assert b.record_failure(32) == "open" and b.trips == 1
+    assert not b.allow_stacked(32)  # degraded to per-user dispatch
+    assert b.allow_stacked(64)  # other buckets unaffected
+    clock[0] = 11.0
+    assert b.allow_stacked(32) and b.state_of(32) == "half_open"  # probe
+    assert not b.allow_stacked(32)  # one probe at a time
+    assert b.record_failure(32) == "open"  # probe failed: re-open
+    clock[0] = 22.0
+    assert b.allow_stacked(32)
+    assert b.record_success(32) == "close"  # probe succeeded: recovered
+    assert b.allow_stacked(32) and b.state_of(32) == "closed"
+    # a success resets the consecutive-failure count
+    assert b.record_failure(32) is None
+    assert b.record_success(32) is None
+    assert b.record_failure(32) is None
+    with pytest.raises(ValueError):
+        DispatchBreaker(0)
+
+
+def test_backoff_delay_schedule_and_jitter():
+    import numpy as np
+
+    assert backoff_delay(0, base_delay=0.1, max_delay=2.0) == 0.1
+    assert backoff_delay(3, base_delay=0.1, max_delay=2.0) == 0.8
+    assert backoff_delay(9, base_delay=0.1, max_delay=2.0) == 2.0  # capped
+    rng = np.random.default_rng(0)
+    ds = [backoff_delay(1, base_delay=0.1, max_delay=2.0, rng=rng)
+          for _ in range(20)]
+    assert all(0.1 <= d < 0.3 for d in ds)  # jitter in [0.5, 1.5)x
+    assert len(set(ds)) > 1
+    # seeded: the schedule replays
+    rng2 = np.random.default_rng(0)
+    assert ds[0] == backoff_delay(1, base_delay=0.1, max_delay=2.0,
+                                  rng=rng2)
+
+
+# -- restart recovery ------------------------------------------------------
+
+
+def _min2(cfg):
+    """min_members=2 survives committee reloads (the config floor is
+    re-applied per session), so an injected member fault exhausts the
+    2-member committee on EVERY attempt — the terminal-failure trigger."""
+    return dataclasses.replace(cfg, min_members=2)
+
+
+def _seq_baselines(tmp_path, cfg, specs, committee_fn=_committee):
+    seq = []
+    for seed, uid, n in specs:
+        data = _user_data(seed, uid, n_songs=n)
+        p = tmp_path / f"seq_{uid}"
+        p.mkdir()
+        seq.append(ALLoop(cfg).run_user(committee_fn(data), data, str(p)))
+    return seq
+
+
+def _entries(tmp_path, cfg, specs, committee_fn=_committee):
+    """Serve entries over the persistent ``serve_*`` workspaces: a fresh
+    workspace gets a fresh committee, a restarted one (al_state.json from
+    the killed run) resumes from its own files — exactly what the CLI's
+    restart path does via ``workspace.create_user``/``load_committee``."""
+    out = []
+    for seed, uid, n in specs:
+        data = _user_data(seed, uid, n_songs=n)
+        fp = tmp_path / f"serve_{uid}"
+        fp.mkdir(exist_ok=True)
+        if (fp / "al_state.json").exists():
+            committee = workspace.load_committee(str(fp))
+        else:
+            committee = committee_fn(data)
+        out.append(FleetUser(
+            uid, committee, data, str(fp), seed=cfg.seed,
+            committee_factory=lambda fp=fp: workspace.load_committee(
+                str(fp))))
+    return out
+
+
+def _restart_drill(tmp_path, cfg, specs, rule, *, target_live=2):
+    """Kill a serving run at ``rule``'s boundary, restart from the
+    journal, return ``{user: last result}`` over both segments plus the
+    second segment's report."""
+    jpath = str(tmp_path / "serve_journal.jsonl")
+    done: dict = {}
+
+    def on_result(rec):
+        done[rec["user"]] = rec
+
+    with faults.inject(rule) as inj:
+        journal = AdmissionJournal(jpath)
+        sched = FleetScheduler(cfg, report=FleetReport(),
+                               scoring_by_width=True)
+        server = FleetServer(sched, ServeConfig(target_live=target_live),
+                             journal=journal)
+        with pytest.raises(InjectedKill):
+            server.serve(iter(_entries(tmp_path, cfg, specs)),
+                         on_result=on_result)
+        assert inj.fired, f"{rule.point} never fired"
+        journal.close()
+
+    journal = AdmissionJournal(jpath)
+    assert journal.recovered
+    order = journal.state.recovery_order([uid for _, uid, _ in specs])
+    emap = {e.user_id: e for e in _entries(tmp_path, cfg, specs)}
+    report = FleetReport()
+    sched = FleetScheduler(cfg, report=report, scoring_by_width=True)
+    server = FleetServer(sched, ServeConfig(target_live=target_live),
+                         journal=journal)
+    server.serve(iter(emap[u] for u in order), on_result=on_result)
+    journal.close()
+    return done, report
+
+
+def test_serve_restart_from_journal_loses_no_user(tmp_path):
+    """THE acceptance pin (tier-1 case): a server killed at the first
+    ``finish`` journal append — after 1 of 3 users finished — restarted
+    from ``serve_journal.jsonl`` finishes every submitted user with
+    results bit-identical to uninterrupted sequential runs.  The journal
+    ends with all three users finished."""
+    cfg = _cfg(mode="mc", epochs=2)
+    specs = [(100 + i, f"u{i}", 30) for i in range(3)]
+    seq = _seq_baselines(tmp_path, cfg, specs)
+    # appends 1-5: enqueue x3 + admit x2 (target 2, lazy pull); append 6
+    # is the first finish — the user was persisted by on_result but dies
+    # un-journaled, so the restart re-admits and re-finishes it
+    # idempotently from its final workspace
+    done, report = _restart_drill(
+        tmp_path, cfg, specs,
+        FaultRule("serve.journal.append", "kill", at=6))
+    assert sorted(done) == [uid for _, uid, _ in specs]
+    for s, (_, uid, _) in zip(seq, specs):
+        assert done[uid]["error"] is None
+        assert done[uid]["result"]["trajectory"] == s["trajectory"]
+    assert any(e["event"] == "journal_recover" for e in report.events)
+    st = AdmissionJournal(str(tmp_path / "serve_journal.jsonl")).state
+    assert st.finished == {uid for _, uid, _ in specs}
+    assert not st.pending
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("point,at", [
+    ("serve.admit", 2),           # between queue pop and durable admit
+    ("serve.journal.append", 4),  # mid-admission (the admit record)
+    ("serve.journal.append", 6),  # the first finish record
+    ("serve.collect", 1),         # engine done, finish not yet journaled
+    ("serve.dispatch", 2),        # mid device dispatch
+], ids=lambda v: str(v))
+def test_serve_kill_matrix_restart_loses_no_user(tmp_path, point, at):
+    """Kill-at-every-serve-boundary: wherever the server dies, a restart
+    from the journal serves every submitted user to sequential-identical
+    results."""
+    cfg = _cfg(mode="mc", epochs=2)
+    specs = [(100 + i, f"u{i}", 30) for i in range(3)]
+    seq = _seq_baselines(tmp_path, cfg, specs)
+    done, _ = _restart_drill(tmp_path, cfg, specs,
+                             FaultRule(point, "kill", at=at))
+    assert sorted(done) == [uid for _, uid, _ in specs]
+    for s, (_, uid, _) in zip(seq, specs):
+        assert done[uid]["error"] is None
+        assert done[uid]["result"]["trajectory"] == s["trajectory"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["mc", "hc", "mix", "rand"])
+def test_serve_restart_matrix_all_modes(tmp_path, mode):
+    """Acceptance: restart recovery is bit-identical in all four
+    acquisition modes (k=1 of N=3 users finished at the kill)."""
+    cfg = _cfg(mode=mode, epochs=2)
+    specs = [(100 + i, f"u{i}", 30) for i in range(3)]
+    seq = _seq_baselines(tmp_path, cfg, specs)
+    done, _ = _restart_drill(
+        tmp_path, cfg, specs,
+        FaultRule("serve.journal.append", "kill", at=6))
+    assert sorted(done) == [uid for _, uid, _ in specs]
+    for s, (_, uid, _) in zip(seq, specs):
+        assert done[uid]["error"] is None
+        assert done[uid]["result"]["trajectory"] == s["trajectory"]
+        assert done[uid]["result"]["final_mean_f1"] == s["final_mean_f1"]
+
+
+# -- watchdog / backoff / poison / breaker drills --------------------------
+
+
+@pytest.mark.slow
+def test_serve_watchdog_evicts_hung_host_step(tmp_path):
+    """An injected straggler (pool.score delay far past the deadline)
+    trips the watchdog: the hung step is abandoned, the session evicted
+    and resumed from its workspace, and the user still finishes with the
+    sequential trajectory."""
+    cfg = _cfg(mode="mc", epochs=2)
+    specs = [(103, "h", 30)]
+    seq = _seq_baselines(tmp_path, cfg, specs)
+    with faults.inject(FaultRule("pool.score", "delay", at=2,
+                                 delay_s=1.5)):
+        report = FleetReport()
+        # 2 host workers so the zombie (the abandoned sleeping step)
+        # cannot starve the resumed session's own host steps
+        sched = FleetScheduler(cfg, report=report, scoring_by_width=True,
+                               host_workers=2)
+        server = FleetServer(sched, ServeConfig(target_live=1,
+                                                watchdog_s=0.3))
+        recs = server.serve(iter(_entries(tmp_path, cfg, specs)))
+    evs = [e["event"] for e in report.events]
+    assert "watchdog_evict" in evs and "resume" in evs
+    assert sched.watchdog.trips >= 1
+    assert recs[0]["error"] is None
+    assert recs[0]["result"]["trajectory"] == seq[0]["trajectory"]
+    assert report.summary(cohort=1)["watchdog_evictions"] >= 1
+
+
+@pytest.mark.slow
+def test_serve_backoff_readmission_recovers(tmp_path):
+    """A user whose session fails terminally (initial run AND in-engine
+    resume both exhaust the committee) re-enters the queue with backoff
+    and succeeds on its second admission — sequential-identical."""
+    cfg = _min2(_cfg(mode="mc", epochs=2))
+    specs = [(100, "v", 30)]
+    seq = _seq_baselines(
+        tmp_path, cfg, specs,
+        committee_fn=lambda d: _committee(d, sgd_name="sgd.victim"))
+    entries = _entries(
+        tmp_path, cfg, specs,
+        committee_fn=lambda d: _committee(d, sgd_name="sgd.victim"))
+    with faults.inject(FaultRule("member.retrain", "raise", at=1, times=2,
+                                 member="sgd.victim")) as inj:
+        report = FleetReport()
+        sched = FleetScheduler(cfg, report=report, scoring_by_width=True)
+        server = FleetServer(sched, ServeConfig(
+            target_live=1, failure_budget=3,
+            backoff_base_s=0.01, backoff_max_s=0.05))
+        recs = server.serve(iter(entries))
+    assert inj.fired
+    evs = [e["event"] for e in report.events]
+    # evict -> in-engine resume -> evict -> terminal -> requeue -> admit
+    assert evs.count("requeue") == 1 and evs.count("admit") == 2
+    assert recs[0]["error"] is None
+    assert recs[0]["result"]["trajectory"] == seq[0]["trajectory"]
+    assert report.summary(cohort=1)["requeues"] == 1
+    assert report.users_failed == 0
+
+
+@pytest.mark.slow
+def test_serve_poison_after_budget_then_skipped(tmp_path):
+    """A user that fails on EVERY admission exhausts its failure budget,
+    lands in the persisted poison list (terminal reason + attempts in the
+    metrics stream), and never stalls admission — a healthy user behind
+    it finishes normally.  A later server with the same poison list skips
+    the user outright."""
+    cfg = _min2(_cfg(mode="mc", epochs=2))
+    good_specs = [(101, "w", 30)]
+    seq = _seq_baselines(tmp_path, cfg, good_specs)
+    bad_specs = [(102, "pz", 30)]
+    bad = _entries(tmp_path, cfg, bad_specs,
+                   committee_fn=lambda d: _committee(
+                       d, sgd_name="sgd.victim"))
+    good = _entries(tmp_path, cfg, good_specs)
+    ppath = str(tmp_path / "serve_poison.jsonl")
+    with faults.inject(FaultRule("member.retrain", "raise", at=1, times=-1,
+                                 member="sgd.victim")):
+        report = FleetReport()
+        sched = FleetScheduler(cfg, report=report, scoring_by_width=True)
+        server = FleetServer(
+            sched,
+            ServeConfig(target_live=1, failure_budget=2,
+                        backoff_base_s=0.01, backoff_max_s=0.02),
+            poison=PoisonList(ppath))
+        recs = server.serve(iter(bad + good))
+    by = {r["user"]: r for r in recs}
+    assert by["pz"]["error"] is not None
+    assert by["w"]["error"] is None
+    assert by["w"]["result"]["trajectory"] == seq[0]["trajectory"]
+    s = report.summary(cohort=1)
+    assert s["users_poisoned"] == 1 and s["requeues"] == 1
+    assert s["users_failed"] == 1
+    pev = [e for e in report.events if e["event"] == "poison"]
+    assert pev and pev[0]["attempts"] == 2 and pev[0]["error"]
+    fev = [e for e in report.events if e["event"] == "user_failed"]
+    assert fev and "attempts" in fev[0] and fev[0]["error"]
+    # a fresh server (restart) skips the poisoned user via the persisted
+    # list: no admission, no result, an explicit skip event
+    report2 = FleetReport()
+    sched2 = FleetScheduler(cfg, report=report2, scoring_by_width=True)
+    server2 = FleetServer(sched2, ServeConfig(target_live=1),
+                          poison=PoisonList(ppath))
+    recs2 = server2.serve(iter(_entries(tmp_path, cfg, bad_specs)))
+    assert recs2 == []
+    assert any(e["event"] == "skip_poisoned" for e in report2.events)
+
+
+@pytest.mark.slow
+def test_serve_breaker_opens_degrades_and_recovers(tmp_path):
+    """Stacked-dispatch failures open the bucket's breaker: the batch
+    falls back to per-user dispatch (nobody evicted), the width stays
+    degraded through the cooldown, then a half-open probe restores
+    stacked dispatch — and every trajectory matches sequential."""
+    cfg = _cfg(mode="mc", epochs=2)
+    specs = [(104, "b0", 30), (105, "b1", 30)]
+    seq = _seq_baselines(tmp_path, cfg, specs)
+    with faults.inject(FaultRule("serve.dispatch", "transient", at=1,
+                                 times=1)) as inj:
+        report = FleetReport()
+        breaker = DispatchBreaker(1, 0.0001)  # trip fast, recover fast
+        sched = FleetScheduler(cfg, report=report, scoring_by_width=True,
+                               breaker=breaker, batch_window_s=5.0)
+        server = FleetServer(sched, ServeConfig(target_live=2))
+        recs = server.serve(iter(_entries(tmp_path, cfg, specs)))
+    assert inj.fired
+    evs = [e["event"] for e in report.events]
+    assert "dispatch_failed" in evs and "breaker_open" in evs
+    assert "breaker_probe" in evs and "breaker_close" in evs
+    assert "evict" not in evs  # the fallback isolated the failure
+    for s, r in zip(seq, recs):
+        assert r["error"] is None
+        assert r["result"]["trajectory"] == s["trajectory"]
+    assert breaker.trips == 1 and breaker.summary() == {}
+    s = report.summary(cohort=2)
+    assert s["breaker_trips"] == 1 and s["dispatch_failures"] == 1
+
+
+@pytest.mark.slow
+def test_serve_dispatch_error_isolates_single_session(tmp_path):
+    """A per-user dispatch failure evicts ONLY that session (generator
+    error path → resume → backoff re-admission when resumes exhaust);
+    with the rule spent, the user recovers to the sequential result."""
+    cfg = _cfg(mode="mc", epochs=2)
+    specs = [(106, "s", 30)]
+    seq = _seq_baselines(tmp_path, cfg, specs)
+    with faults.inject(FaultRule("serve.dispatch", "raise", at=1,
+                                 times=2)) as inj:
+        report = FleetReport()
+        sched = FleetScheduler(cfg, report=report, scoring_by_width=True)
+        server = FleetServer(sched, ServeConfig(
+            target_live=1, failure_budget=3,
+            backoff_base_s=0.01, backoff_max_s=0.05))
+        recs = server.serve(iter(_entries(tmp_path, cfg, specs)))
+    assert inj.fired
+    evs = [e["event"] for e in report.events]
+    assert "dispatch_session_error" in evs
+    assert recs[0]["error"] is None
+    assert recs[0]["result"]["trajectory"] == seq[0]["trajectory"]
+
+
+def test_serve_flaky_mix_smoke(tmp_path):
+    """The serve_fault_bench fast subset: a 2-user mix with one flaky
+    user (member fault absorbed by evict+resume) finishes everyone with
+    sequential-identical results and records the recovery telemetry."""
+    cfg = _min2(_cfg(mode="mc", epochs=2))
+    flaky = lambda d: _committee(d, sgd_name="sgd.flaky")  # noqa: E731
+    specs = [(107, "f", 30), (108, "ok", 30)]
+    seq = [_seq_baselines(tmp_path, cfg, specs[:1], committee_fn=flaky)[0],
+           _seq_baselines(tmp_path, cfg, specs[1:])[0]]
+    entries = (_entries(tmp_path, cfg, specs[:1], committee_fn=flaky)
+               + _entries(tmp_path, cfg, specs[1:]))
+    with faults.inject(FaultRule("member.retrain", "raise", at=1,
+                                 member="sgd.flaky")) as inj:
+        report = FleetReport()
+        sched = FleetScheduler(cfg, report=report, scoring_by_width=True)
+        server = FleetServer(sched, ServeConfig(
+            target_live=2, failure_budget=2,
+            backoff_base_s=0.01, backoff_max_s=0.05, watchdog_s=30.0))
+        recs = server.serve(iter(entries))
+    assert inj.fired
+    by = {r["user"]: r for r in recs}
+    for s, (_, uid, _) in zip(seq, specs):
+        assert by[uid]["error"] is None
+        assert by[uid]["result"]["trajectory"] == s["trajectory"]
+    s = report.summary(cohort=2)
+    assert s["evictions"] >= 1 and s["users_failed"] == 0
