@@ -1243,6 +1243,151 @@ def run_serve_faults_suite(args_ns) -> int:
     return 0
 
 
+def run_fabric_suite(args_ns) -> int:
+    """Multi-host fabric resilience: recovered-users/sec with one worker
+    host SIGKILLed mid-run.
+
+    A ``--hosts`` fabric (coordinator in-process, worker subprocesses
+    over the shared ``tests/fabric_workload`` synthetic users) serves
+    ``--users`` users; the moment the journal shows host h0 admitted a
+    user, h0 is SIGKILLed — its in-flight users must resume on the
+    survivors from their durable workspaces and its queued users
+    re-enqueue in journal order.  Sequential UNFAULTED runs are the
+    ground truth: every user must finish with a bit-identical trajectory
+    (recovery is exercised, not trusted), and the metric is the faulted
+    fabric's users/sec — the price of losing a host mid-run.  Journal
+    compaction runs live (small ``compact_bytes``) so the WAL bound is
+    exercised under load.  Reps are interleaved best-of (2-vCPU drift
+    protocol)."""
+    import os
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.fabric_workload import (
+        make_cfg,
+        read_results,
+        sequential_baselines,
+        user_specs,
+    )
+
+    from consensus_entropy_tpu.fleet import FleetReport
+    from consensus_entropy_tpu.serve import (
+        AdmissionJournal,
+        FabricConfig,
+        FabricCoordinator,
+    )
+    from consensus_entropy_tpu.serve.hosts import fabric_paths
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "tests", "fabric_worker.py")
+    n_users, hosts = args_ns.users, args_ns.hosts
+    epochs = args_ns.al_epochs
+    cfg = make_cfg("mc", epochs=epochs)
+    specs = user_specs(n_users)
+    compact_bytes = 1024  # small enough that the run compacts live
+
+    _log(f"fabric workload: {n_users} users x {epochs} AL iterations, "
+         f"{hosts} worker hosts, h0 SIGKILLed at its first admission, "
+         f"journal compaction at {compact_bytes}B")
+
+    root = tempfile.mkdtemp(prefix="fabric_bench_")
+    best = None
+    seq_s = float("inf")
+    try:
+        for rep in range(args_ns.reps):
+            ws = _mkdir(root, f"rep{rep}")
+            t0 = time.perf_counter()
+            seq = sequential_baselines(ws, cfg, specs)
+            seq_s = min(seq_s, time.perf_counter() - t0)
+
+            fabric_dir = _mkdir(ws, "fabric")
+            journal = AdmissionJournal(
+                os.path.join(fabric_dir, "serve_journal.jsonl"),
+                compact_bytes=compact_bytes)
+            report = FleetReport()
+
+            def spawn(host_id, fabric_dir=fabric_dir, ws=ws):
+                log = open(fabric_paths(fabric_dir, host_id)["log"], "ab")
+                try:
+                    return subprocess.Popen(
+                        [sys.executable, worker, fabric_dir, host_id, ws,
+                         cfg.mode, str(cfg.epochs), str(n_users), "5.0",
+                         str(max(2, n_users // hosts))],
+                        stdout=log, stderr=subprocess.STDOUT,
+                        env={**os.environ, "PYTHONPATH": repo})
+                finally:
+                    log.close()
+
+            chaos_state = {"killed": False}
+
+            def chaos(coord, chaos_state=chaos_state):
+                if chaos_state["killed"]:
+                    return
+                st = coord.journal.state
+                if any(h == "h0" and st.last.get(u) == "admit"
+                       for u, h in st.assigned.items()):
+                    coord.hosts["h0"].proc.kill()
+                    chaos_state["killed"] = True
+
+            coord = FabricCoordinator(
+                journal, fabric_dir, FabricConfig(hosts=hosts),
+                report=report, on_poll=chaos)
+            t0 = time.perf_counter()
+            summary = coord.run([u for _, u, _ in specs], spawn)
+            wall = time.perf_counter() - t0
+            journal.close()
+
+            results = read_results(fabric_dir)
+            parity = (sorted(summary["finished"])
+                      == [u for _, u, _ in specs]
+                      and all(results[u]["error"] is None
+                              and results[u]["result"]["trajectory"]
+                              == seq[u]["trajectory"]
+                              for _, u, _ in specs))
+            ups = len(summary["finished"]) / wall
+            _log(f"[rep {rep}] fabric {len(summary['finished'])}/"
+                 f"{n_users} users in {wall:.1f}s ({ups:.3f} u/s, "
+                 f"parity={parity}, killed={chaos_state['killed']}, "
+                 f"revocations={summary['revocations']}, "
+                 f"reassigned={summary['reassignments']}, "
+                 f"compactions={summary['compactions']})")
+            if not (parity and chaos_state["killed"]
+                    and summary["revocations"] >= 1):
+                raise AssertionError(
+                    f"fabric rep {rep} lost parity or never exercised "
+                    f"the kill: {summary}")
+            rec = {"users_per_sec": ups, "wall_s": round(wall, 3),
+                   **{k: summary[k] for k in
+                      ("revocations", "reassignments", "compactions")},
+                   "finished": len(summary["finished"])}
+            if best is None or ups > best["users_per_sec"]:
+                best = rec
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    seq_ups = n_users / seq_s
+    print(json.dumps({
+        "metric": f"fabric_recovered_users_per_sec_{n_users}u_{hosts}h",
+        "value": round(best["users_per_sec"], 4),
+        "unit": "users/s",
+        # recovered-throughput ratio: a fabric that loses a host mid-run
+        # vs the UNFAULTED sequential loop over the same users
+        "vs_baseline": round(best["users_per_sec"] / seq_ups, 2),
+        "hosts": hosts,
+        "sequential_unfaulted_users_per_sec": round(seq_ups, 4),
+        "users_done": best["finished"],
+        "revocations": best["revocations"],
+        "reassignments": best["reassignments"],
+        "compactions": best["compactions"],
+        "parity_with_sequential": True,
+        **_provenance(),
+    }))
+    return 0
+
+
 def _mkdir(root, name):
     import os
 
@@ -1254,7 +1399,7 @@ def _mkdir(root, name):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--suite", choices=("linear", "cnn", "retrain", "fleet",
-                                        "serve", "serve-faults"),
+                                        "serve", "serve-faults", "fabric"),
                     default="linear",
                     help="linear: the north-star fused pool scoring; cnn: "
                          "Flax ShortChunkCNN committee inference "
@@ -1265,7 +1410,10 @@ def main(argv=None) -> int:
                          "padding vs fleet cohorts on a skewed workload; "
                          "serve-faults: recovered-users/sec under a "
                          "fault-injected flaky user mix (watchdog, "
-                         "backoff re-admission, circuit breaker)")
+                         "backoff re-admission, circuit breaker); "
+                         "fabric: recovered-users/sec of a multi-host "
+                         "fabric with one worker SIGKILLed mid-run "
+                         "(journal failover + compaction)")
     ap.add_argument("--members", type=int, default=None,
                     help="committee size (default: 16 linear / 5 cnn)")
     ap.add_argument("--pool", type=int, default=None,
@@ -1315,6 +1463,8 @@ def main(argv=None) -> int:
     ap.add_argument("--reps", type=int, default=3,
                     help="fleet suite: timing repetitions; best (min "
                          "wall) is reported for both sides")
+    ap.add_argument("--hosts", type=int, default=2,
+                    help="fabric suite: worker host processes")
     args_ns = ap.parse_args(argv)
 
     import jax
@@ -1328,6 +1478,9 @@ def main(argv=None) -> int:
     if args_ns.suite == "serve-faults":
         # same skewed sizing as serve; every 3rd user is flaky
         return run_serve_faults_suite(args_ns)
+    if args_ns.suite == "fabric":
+        # multi-host: --users over --hosts workers, h0 killed mid-run
+        return run_fabric_suite(args_ns)
     if args_ns.suite == "cnn":
         # cnn-suite defaults: 5 members (paper committee), 48 crops per
         # pass — the first conv block's activations are ~75 MB per

@@ -97,6 +97,38 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="S",
                    help="serve mode: how long an open bucket stays "
                         "degraded before the half-open probe (default 30)")
+    p.add_argument("--breaker-probes", type=int, default=0, metavar="N",
+                   help="serve mode: failed half-open probes before a "
+                        "bucket width is given up (stays per-user "
+                        "dispatch) for the rest of the run, instead of "
+                        "probing forever (0 = unlimited probes; default 0)")
+    p.add_argument("--journal-compact-kb", type=int, default=0, metavar="KB",
+                   help="serve mode: compact the admission journal "
+                        "(checkpoint the replayed state, truncate the "
+                        "WAL — crash-safe) whenever it grows past this "
+                        "size, so a long-lived server's journal stays "
+                        "bounded (0 = never compact; default 0)")
+    p.add_argument("--hosts", type=int, default=None, metavar="N",
+                   help="multi-host fabric: shard admitted users across N "
+                        "worker host processes (each running its own "
+                        "--serve engine), coordinated through the "
+                        "admission journal; a worker that dies or stops "
+                        "heartbeating (--lease-s) is SIGKILLed and its "
+                        "users fail over to the survivors — in-flight "
+                        "users resume from their workspaces, queued users "
+                        "re-enqueue in journal order (requires --serve)")
+    p.add_argument("--lease-s", type=float, default=5.0, metavar="S",
+                   help="fabric: worker heartbeat lease — a host whose "
+                        "last heartbeat is older than this is declared "
+                        "dead and failed over (default 5)")
+    p.add_argument("--unpoison", default=None, metavar="USER[,USER...]",
+                   help="operator command: remove users from the "
+                        "persisted poison list (users/serve_poison.jsonl) "
+                        "via journaled records — never hand-edit the "
+                        "jsonl — then exit (the users become submittable "
+                        "again with a fresh failure budget)")
+    p.add_argument("--fabric-worker", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--fabric-dir", default=None, help=argparse.SUPPRESS)
     p.add_argument("--seed", type=int, default=1987)
     p.add_argument("--tie-break", choices=("fast", "numpy"), default="fast")
     p.add_argument("--trace-dir", default=None,
@@ -141,7 +173,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    raw_argv = list(argv) if argv is not None else sys.argv[1:]
     args = build_parser().parse_args(argv)
+    args._raw_argv = raw_argv
+    if args.unpoison is not None:
+        # pure operator action on the journal/poison files: no dataset,
+        # no backend
+        return _run_unpoison(args)
     configure_device(args.device)
 
     if args.fleet is not None and args.serve is not None:
@@ -174,15 +212,36 @@ def main(argv=None) -> int:
                          ("--breaker-threshold",
                           args.breaker_threshold != 2),
                          ("--breaker-cooldown-s",
-                          args.breaker_cooldown_s != 30.0)):
+                          args.breaker_cooldown_s != 30.0),
+                         ("--breaker-probes", args.breaker_probes != 0),
+                         ("--journal-compact-kb",
+                          args.journal_compact_kb != 0),
+                         ("--hosts", args.hosts is not None),
+                         ("--lease-s", args.lease_s != 5.0)):
         if is_set and args.serve is None:
             print(f"{flag} requires --serve")
             return 1
     if args.serve is not None and (args.watchdog_s < 0
                                    or args.failure_budget < 1
-                                   or args.breaker_threshold < 0):
+                                   or args.breaker_threshold < 0
+                                   or args.breaker_probes < 0
+                                   or args.journal_compact_kb < 0):
         print("--watchdog-s must be >= 0, --failure-budget >= 1, "
-              "--breaker-threshold >= 0")
+              "--breaker-threshold >= 0, --breaker-probes >= 0, "
+              "--journal-compact-kb >= 0")
+        return 1
+    if args.hosts is not None:
+        if args.hosts < 1 or args.lease_s <= 0:
+            print("--hosts must be >= 1 and --lease-s > 0")
+            return 1
+        if args.no_serve_journal:
+            print("--hosts requires the admission journal (it is the "
+                  "fabric's source of truth); drop --no-serve-journal")
+            return 1
+    if args.fabric_worker is not None and (args.fabric_dir is None
+                                           or args.serve is None):
+        print("--fabric-worker is internal (spawned by --hosts) and "
+              "needs --fabric-dir and --serve")
         return 1
     bucket_widths = None
     if args.bucket_widths is not None:
@@ -455,7 +514,8 @@ def _run_users_serve(args, cfg, paths, users, pool, anno, hc_table, store,
     report = FleetReport(os.path.join(paths.users_dir,
                                       "fleet_metrics.jsonl"))
     journal = None if args.no_serve_journal else AdmissionJournal(
-        os.path.join(paths.users_dir, "serve_journal.jsonl"))
+        os.path.join(paths.users_dir, "serve_journal.jsonl"),
+        compact_bytes=args.journal_compact_kb * 1024 or None)
     poison = PoisonList(os.path.join(paths.users_dir,
                                      "serve_poison.jsonl"))
     scheduler = FleetScheduler(
@@ -470,7 +530,8 @@ def _run_users_serve(args, cfg, paths, users, pool, anno, hc_table, store,
                     watchdog_s=args.watchdog_s,
                     failure_budget=args.failure_budget,
                     breaker_threshold=args.breaker_threshold,
-                    breaker_cooldown_s=args.breaker_cooldown_s),
+                    breaker_cooldown_s=args.breaker_cooldown_s,
+                    breaker_probes=args.breaker_probes),
         preemption=guard, journal=journal, poison=poison)
 
     todo = list(users[: args.max_users])
@@ -553,6 +614,198 @@ def _run_users_serve(args, cfg, paths, users, pool, anno, hc_table, store,
             f"eviction/resume: {failed}")
 
 
+def _run_unpoison(args) -> int:
+    """The ``--unpoison`` operator command: journaled removal from the
+    poison list (plus an ``unpoison`` record in the admission journal so
+    restart replay forgets the user's spent failure budget)."""
+    from consensus_entropy_tpu.config import PathsConfig
+    from consensus_entropy_tpu.serve import (
+        AdmissionJournal,
+        PoisonList,
+        SingleWriterViolation,
+    )
+
+    paths = PathsConfig(models_root=args.models_root,
+                        deam_root=args.deam_root, amg_root=args.amg_root)
+    ppath = os.path.join(paths.users_dir, "serve_poison.jsonl")
+    jpath = os.path.join(paths.users_dir, "serve_journal.jsonl")
+    poison = PoisonList(ppath)
+    journal = AdmissionJournal(jpath) if os.path.exists(jpath) else None
+    rc = 0
+    try:
+        for uid in filter(None, (u.strip()
+                                 for u in args.unpoison.split(","))):
+            if poison.remove(uid):
+                if journal is not None:
+                    journal.append("unpoison", uid)
+                print(f"unpoisoned user {uid} (failure budget reset)")
+            else:
+                print(f"user {uid} is not on the poison list ({ppath})")
+                rc = 1
+    except SingleWriterViolation as e:
+        # a live server owns the WAL: refuse rather than interleave seq
+        # numbers with it (records would silently dedupe away on replay)
+        print(f"cannot unpoison while a server is running: {e}")
+        rc = 1
+    finally:
+        poison.close()
+        if journal is not None:
+            journal.close()
+    return rc
+
+
+def _run_users_fabric(args, cfg, paths, users, guard) -> None:
+    """Fabric coordinator: shard the user axis across ``--hosts`` worker
+    processes (each re-execing this CLI with ``--fabric-worker``),
+    coordinated through the admission journal — see ``serve.fabric``.
+    The coordinator owns the journal, the routing and the failover;
+    workers own the engines and the per-user persistence."""
+    import json
+    import subprocess
+
+    from consensus_entropy_tpu.fleet import FleetReport
+    from consensus_entropy_tpu.serve import (
+        AdmissionJournal,
+        FabricConfig,
+        FabricCoordinator,
+        PoisonList,
+    )
+    from consensus_entropy_tpu.serve.hosts import fabric_paths
+
+    fabric_dir = os.path.join(paths.users_dir, "fabric")
+    os.makedirs(fabric_dir, exist_ok=True)
+    journal = AdmissionJournal(
+        os.path.join(paths.users_dir, "serve_journal.jsonl"),
+        compact_bytes=args.journal_compact_kb * 1024 or None)
+    poison = PoisonList(os.path.join(paths.users_dir,
+                                     "serve_poison.jsonl"))
+    report = FleetReport(os.path.join(paths.users_dir,
+                                      "fleet_metrics.jsonl"))
+
+    # the worker argv is this run's argv minus the coordinator-only flag
+    worker_argv = list(args._raw_argv)
+    if "--hosts" in worker_argv:
+        i = worker_argv.index("--hosts")
+        del worker_argv[i:i + 2]
+
+    # workers must import this package regardless of their cwd
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = pkg_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+
+    def spawn(host_id):
+        log = open(fabric_paths(fabric_dir, host_id)["log"], "ab")
+        try:
+            return subprocess.Popen(
+                [sys.executable, "-m", "consensus_entropy_tpu.cli.amg_test",
+                 *worker_argv, "--fabric-worker", host_id,
+                 "--fabric-dir", fabric_dir],
+                stdout=log, stderr=subprocess.STDOUT, env=env)
+        finally:
+            log.close()  # the child holds its own fd
+
+    coord = FabricCoordinator(
+        journal, fabric_dir,
+        FabricConfig(hosts=args.hosts, lease_s=args.lease_s),
+        poison=poison, report=report, preemption=guard)
+    try:
+        summary = coord.run([str(u) for u in users[: args.max_users]],
+                            spawn)
+    finally:
+        journal.close()
+        poison.close()
+    print("fabric summary: " + json.dumps(
+        {"users": summary["users"], "finished": len(summary["finished"]),
+         "failed": len(summary["failed"]),
+         "poisoned": len(summary["poisoned"]),
+         "revocations": summary["revocations"],
+         "reassignments": summary["reassignments"],
+         "compactions": summary["compactions"]}, sort_keys=True))
+    bad = summary["failed"] + summary["poisoned"]
+    if bad:
+        raise RuntimeError(
+            f"{len(bad)} fabric user(s) failed terminally: {bad}")
+
+
+def _run_users_fabric_worker(args, cfg, paths, users, pool, anno,
+                             hc_table, store, cnn_cfg, guard) -> None:
+    """Fabric worker: one serve engine fed from the coordinator's
+    assignment file instead of a local user list (``serve.hosts``); every
+    finished user is persisted the moment it completes, exactly like the
+    single-host serve path."""
+    import numpy as np
+
+    from consensus_entropy_tpu.al import workspace
+    from consensus_entropy_tpu.al.loop import UserData
+    from consensus_entropy_tpu.data import amg
+    from consensus_entropy_tpu.fleet import (
+        FleetReport,
+        FleetScheduler,
+        FleetUser,
+    )
+    from consensus_entropy_tpu.serve import ServeConfig
+    from consensus_entropy_tpu.serve.hosts import run_worker
+
+    experiment = {"seed": cfg.seed, "queries": cfg.queries,
+                  "train_size": cfg.train_size}
+    by_id = {str(u): u for u in users}
+    report = FleetReport(os.path.join(
+        paths.users_dir, f"fleet_metrics_{args.fabric_worker}.jsonl"))
+    scheduler = FleetScheduler(
+        cfg, tie_break=args.tie_break, retrain_epochs=args.retrain_epochs,
+        host_workers=args.fleet_host_workers, report=report,
+        scoring_by_width=True)
+
+    def build_entry(uid):
+        u_id = by_id.get(uid, uid)
+        user_path, skip = workspace.create_user(
+            paths.users_dir, paths.pretrained_dir, u_id, cfg.mode,
+            experiment=experiment)
+        if skip:
+            print(f"Skipping user {u_id}, already exists!")
+            return None
+
+        def factory(user_path=user_path):
+            return workspace.load_committee(
+                user_path, cnn_cfg, device_members=args.device_members,
+                full_song_hop=args.full_song_hop)
+
+        committee = factory()
+        sub_pool, labels = amg.user_pool(pool, anno, u_id)
+        hc_rows = hc_table.reindex(sub_pool.song_ids).to_numpy(np.float32)
+        data = UserData(u_id, sub_pool, labels, hc_rows=hc_rows,
+                        store=store)
+        return FleetUser(u_id, committee, data, user_path, seed=cfg.seed,
+                         committee_factory=factory)
+
+    def on_result(rec):
+        if rec["error"] is not None:
+            print(f"user {rec['user']} FAILED: {rec['error']}")
+            return
+        user_path = workspace.user_dir(paths.users_dir, rec["user"],
+                                       cfg.mode)
+        rec["committee"].save(user_path)
+        workspace.mark_done(user_path)
+        print(f"user {rec['user']}: final mean F1 = "
+              f"{rec['result']['final_mean_f1']:.4f}")
+
+    run_worker(
+        args.fabric_dir, args.fabric_worker, build_entry=build_entry,
+        scheduler=scheduler,
+        config=ServeConfig(
+            target_live=args.serve,
+            admit_window_s=args.admit_window_ms / 1000.0,
+            bucket_widths=args._bucket_widths,
+            watchdog_s=args.watchdog_s,
+            failure_budget=args.failure_budget,
+            breaker_threshold=args.breaker_threshold,
+            breaker_cooldown_s=args.breaker_cooldown_s,
+            breaker_probes=args.breaker_probes),
+        on_result=on_result, lease_s=args.lease_s, preemption=guard)
+
+
 def _run_users(args, cfg, paths, users, pool, anno, hc_table, store,
                cnn_cfg, mesh, train_mesh, loop, multihost, guard,
                results) -> None:
@@ -567,6 +820,13 @@ def _run_users(args, cfg, paths, users, pool, anno, hc_table, store,
     if args.fleet is not None:
         _run_users_fleet(args, cfg, paths, users, pool, anno, hc_table,
                          store, cnn_cfg, guard, results)
+        return
+    if args.fabric_worker is not None:
+        _run_users_fabric_worker(args, cfg, paths, users, pool, anno,
+                                 hc_table, store, cnn_cfg, guard)
+        return
+    if args.hosts is not None:
+        _run_users_fabric(args, cfg, paths, users, guard)
         return
     if args.serve is not None:
         _run_users_serve(args, cfg, paths, users, pool, anno, hc_table,

@@ -198,6 +198,7 @@ class FleetReport:
         # committed BENCH artifacts) stay byte-stable
         for key, event in (("watchdog_evictions", "watchdog_evict"),
                            ("breaker_trips", "breaker_open"),
+                           ("breaker_giveups", "breaker_giveup"),
                            ("dispatch_failures", "dispatch_failed"),
                            ("requeues", "requeue"),
                            ("users_poisoned", "poison")):

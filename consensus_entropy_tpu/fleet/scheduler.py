@@ -589,11 +589,17 @@ class FleetScheduler:
                               exc: Exception) -> None:
         self.report.event("dispatch_failed", fn=fn_key, width=width,
                           error=repr(exc))
-        if self.breaker is not None \
-                and self.breaker.record_failure(width) == "open":
+        if self.breaker is None:
+            return
+        verdict = self.breaker.record_failure(width)
+        if verdict == "open":
             self.report.event("breaker_open", width=width,
                               threshold=self.breaker.threshold,
                               cooldown_s=self.breaker.cooldown_s)
+        elif verdict == "giveup":
+            # probe budget spent: the width stays per-user for the run
+            self.report.event("breaker_giveup", width=width,
+                              probes=self.breaker.probe_budget)
 
     # -- the cohort driver -------------------------------------------------
 

@@ -59,6 +59,14 @@ FAULT_POINTS = frozenset({
     "serve.journal.append",  # admission-journal WAL append, pre-fsync
     "serve.dispatch",        # stacked/per-user device scoring dispatch
     "serve.collect",         # completion collection, pre-finish-journal
+    # multi-host fabric boundaries: a kill at any of these must lose no
+    # user — the coordinator's journal replay + lease failover re-route
+    # every in-flight/queued user to a surviving host (serve.fabric)
+    "fabric.assign",         # coordinator routing, pre-assign-journal
+    "fabric.lease",          # worker heartbeat, pre-lease-file-write
+    "fabric.compact",        # journal compaction (checkpoint + truncate
+                             # stages — a kill between the two renames
+                             # must replay idempotently)
 })
 
 ACTIONS = ("kill", "raise", "transient", "corrupt", "delay")

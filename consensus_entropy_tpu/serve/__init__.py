@@ -35,7 +35,21 @@ serving"):
 - :mod:`serve.breaker` — a per-bucket circuit breaker: repeated stacked-
   dispatch failures degrade that width to per-user dispatch until a
   half-open probe recovers it; a failed stacked dispatch falls back to
-  per-user dispatch instead of evicting the whole batch.
+  per-user dispatch instead of evicting the whole batch; a probe budget
+  gives a width up for the run once half-open probes keep failing.
+
+And a MULTI-HOST fabric (PR 5) scales the user axis across processes:
+
+- :mod:`serve.fabric` — the coordinator: shards users across N worker
+  hosts through the SAME admission journal (``assign``/``lease``/
+  ``revoke`` records + transcribed worker events), SIGKILLs and fails
+  over hosts whose lease expires or whose process dies, and bounds the
+  journal with crash-safe checkpoint-then-truncate compaction.
+- :mod:`serve.hosts` — the worker side: one ``FleetServer`` per host fed
+  from a per-host assignment feed, heartbeating through a lease file
+  (file-based coordination — no CPU multiprocess collectives on this
+  image; ``parallel.multihost`` stays for real multi-controller
+  runtimes).
 
 Parity is inherited, not re-proven: the server drives the SAME engine
 (``FleetScheduler.open/admit/pump``) over the SAME session generators,
@@ -47,10 +61,18 @@ including eviction+resume, restart recovery and degraded dispatch, by
 
 from consensus_entropy_tpu.serve.breaker import DispatchBreaker
 from consensus_entropy_tpu.serve.buckets import BucketRouter
+from consensus_entropy_tpu.serve.fabric import (
+    FabricConfig,
+    FabricCoordinator,
+    FabricError,
+)
+from consensus_entropy_tpu.serve.hosts import HostLease, run_worker
 from consensus_entropy_tpu.serve.journal import (
     AdmissionJournal,
     JournalState,
+    JsonlTail,
     PoisonList,
+    SingleWriterViolation,
 )
 from consensus_entropy_tpu.serve.server import (
     AdmissionQueue,
@@ -62,6 +84,8 @@ from consensus_entropy_tpu.serve.server import (
 from consensus_entropy_tpu.serve.watchdog import Watchdog, WatchdogTimeout
 
 __all__ = ["AdmissionJournal", "AdmissionQueue", "BucketRouter",
-           "DispatchBreaker", "FleetServer", "JournalState", "PoisonList",
-           "QueueClosed", "QueueFull", "ServeConfig", "Watchdog",
-           "WatchdogTimeout"]
+           "DispatchBreaker", "FabricConfig", "FabricCoordinator",
+           "FabricError", "FleetServer", "HostLease", "JournalState",
+           "JsonlTail", "PoisonList", "QueueClosed", "QueueFull",
+           "ServeConfig", "SingleWriterViolation", "Watchdog",
+           "WatchdogTimeout", "run_worker"]

@@ -16,6 +16,12 @@ and over.  The breaker isolates the blast radius per bucket width:
 - **half-open**: after the cooldown, ONE stacked probe is allowed
   through.  Success closes the breaker (full batching restored); failure
   re-opens it for another cooldown.
+- **gave up**: with a ``probe_budget``, a width whose half-open probes
+  keep failing stops probing after the budget-th failed probe — it stays
+  on per-user dispatch for the REST OF THE RUN instead of burning one
+  stacked batch (and its recovery round-trip) every cooldown forever.
+  A restart gets a fresh budget (breaker state is in-memory by design:
+  the degradation is an availability tactic, not durable truth).
 
 State is per width; a bucket tripping never degrades any other bucket.
 The failure/ success signals come from ``FleetScheduler._dispatch_scores``
@@ -29,7 +35,7 @@ import dataclasses
 import time
 
 #: breaker dispositions, as reported in telemetry events
-CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+CLOSED, OPEN, HALF_OPEN, GAVE_UP = "closed", "open", "half_open", "gave_up"
 
 
 @dataclasses.dataclass
@@ -38,6 +44,7 @@ class _BucketState:
     consecutive_failures: int = 0
     opened_at: float = 0.0
     probing: bool = False
+    failed_probes: int = 0
 
 
 class DispatchBreaker:
@@ -45,17 +52,23 @@ class DispatchBreaker:
 
     ``threshold``: consecutive stacked-dispatch failures that open a
     width.  ``cooldown_s``: how long an open width stays degraded before
-    a half-open probe.  ``clock``: injectable monotonic source (tests).
-    ``trips`` counts closed→open transitions for telemetry."""
+    a half-open probe.  ``probe_budget``: failed half-open probes allowed
+    before the width is given up for the run (0 = probe forever).
+    ``clock``: injectable monotonic source (tests).  ``trips`` counts
+    closed→open transitions for telemetry."""
 
     def __init__(self, threshold: int = 2, cooldown_s: float = 30.0, *,
-                 clock=time.monotonic):
+                 probe_budget: int = 0, clock=time.monotonic):
         if threshold < 1:
             raise ValueError(f"threshold must be >= 1, got {threshold}")
         if cooldown_s <= 0:
             raise ValueError(f"cooldown_s must be > 0, got {cooldown_s}")
+        if probe_budget < 0:
+            raise ValueError(f"probe_budget must be >= 0, "
+                             f"got {probe_budget}")
         self.threshold = threshold
         self.cooldown_s = cooldown_s
+        self.probe_budget = probe_budget
         self._clock = clock
         self._buckets: dict[int, _BucketState] = {}
         self.trips = 0
@@ -69,10 +82,13 @@ class DispatchBreaker:
     def allow_stacked(self, width: int) -> bool:
         """May this width dispatch stacked right now?  An open bucket past
         its cooldown transitions to half-open and admits ONE probe; while
-        the probe's verdict is pending, further batches stay degraded."""
+        the probe's verdict is pending, further batches stay degraded.  A
+        given-up width never dispatches stacked again this run."""
         b = self._bucket(width)
         if b.state == CLOSED:
             return True
+        if b.state == GAVE_UP:
+            return False
         if b.state == OPEN \
                 and self._clock() - b.opened_at >= self.cooldown_s:
             b.state = HALF_OPEN
@@ -91,18 +107,31 @@ class DispatchBreaker:
         b.state = CLOSED
         b.consecutive_failures = 0
         b.probing = False
+        b.failed_probes = 0
         return "close" if was_probe else None
 
     def record_failure(self, width: int) -> str | None:
         """A stacked dispatch at ``width`` failed.  Returns ``"open"`` on
-        a closed→open or half-open→open transition (the caller emits the
-        trip event), else ``None``."""
+        a closed→open or half-open→open transition, ``"giveup"`` when the
+        failed probe spent the width's probe budget (the caller emits the
+        matching telemetry event), else ``None``."""
         b = self._bucket(width)
         b.consecutive_failures += 1
-        if b.state == HALF_OPEN or b.consecutive_failures >= self.threshold:
+        if b.state == HALF_OPEN:
+            b.failed_probes += 1
+            b.probing = False
+            if self.probe_budget and b.failed_probes >= self.probe_budget:
+                # the width has proven it cannot recover: stop paying one
+                # failed stacked batch per cooldown and stay per-user
+                b.state = GAVE_UP
+                return "giveup"
+            b.state = OPEN
+            b.opened_at = self._clock()
+            self.trips += 1
+            return "open"
+        if b.consecutive_failures >= self.threshold:
             # failures only arrive when allow_stacked admitted the batch,
-            # so the prior state here is closed or a half-open probe —
-            # either way this is a fresh trip
+            # so the prior state here is closed — a fresh trip
             b.state = OPEN
             b.opened_at = self._clock()
             b.probing = False

@@ -1,0 +1,475 @@
+"""The multi-host serve fabric: journal-coordinated user sharding with
+lease-based host failover.
+
+One coordinator process shards admitted users across N worker host
+processes, each running its own :class:`~consensus_entropy_tpu.serve.
+server.FleetServer` engine over its local devices (committee-based AL is
+embarrassingly parallel across users — scaling the USER axis is pure
+robustness engineering).  The single admission journal stays the source
+of truth:
+
+- the coordinator is its SOLE writer — it appends ``enqueue`` records as
+  users are accepted, ``assign(user, host)`` routing records, host
+  ``lease``/``revoke`` membership records, and TRANSCRIBES each worker's
+  own event journal (``admit``/``finish``/``fail``/``poison``, tailed
+  partial-line-safe) into it with ``host`` + ``src_off`` fields, so the
+  main journal replays into the complete fabric state and the
+  transcription cursor survives coordinator crashes;
+- workers heartbeat through per-host lease files (:mod:`serve.hosts` —
+  file-based on purpose: this image has no CPU multiprocess collectives,
+  so coordination is process-level and ``parallel.multihost`` stays for
+  real multi-controller runtimes);
+- on lease expiry or worker death (SIGKILL, watchdog-style hang, nonzero
+  exit) the coordinator SIGKILLs the host (no split-brain: a hung process
+  is confirmed dead before its users move), drains its durable events,
+  appends ``revoke``, and re-routes the host's unresolved users to the
+  surviving hosts — in-flight users FIRST (they resume from their durable
+  PR 1 workspaces, mid-run), then queued users in journal enqueue order.
+  Per-user trajectories stay bit-identical to an uninterrupted run: a
+  user only ever runs on one live host at a time, and resume replays the
+  two-phase-committed workspace exactly as the single-process restart
+  path does.
+
+Coordinator crash recovery mirrors the PR 4 restart semantics one level
+up: a restarted coordinator replays the journal (checkpoint + tail),
+reaps any still-running orphan workers via their lease-file pids, spawns
+fresh hosts, and re-routes every unresolved user — finished users are
+skipped, in-flight users re-admitted first, queued users re-enqueued in
+order.  Journal growth is bounded by compaction
+(:meth:`~consensus_entropy_tpu.serve.journal.AdmissionJournal.compact`),
+which the single-writer discipline makes safe to run mid-fabric.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import time
+
+from consensus_entropy_tpu.fleet.report import FleetReport
+from consensus_entropy_tpu.resilience import faults
+from consensus_entropy_tpu.serve.hosts import (
+    fabric_paths,
+    lease_age_s,
+    read_lease,
+)
+from consensus_entropy_tpu.serve.journal import (
+    JsonlTail,
+    PoisonList,
+    _AppendFsyncFile,
+)
+
+
+class FabricError(RuntimeError):
+    """The fabric cannot make progress (every worker host is down with
+    users still unresolved).  All state is durable: rerunning the
+    coordinator resumes from the journal."""
+
+
+@dataclasses.dataclass
+class FabricConfig:
+    """Coordinator policy knobs.
+
+    ``hosts``: worker host processes to spawn.  ``lease_s``: heartbeat
+    lease — a worker whose last beat is older than this is declared dead
+    (killed + failed over); workers beat at a third of it.  ``poll_s``:
+    coordinator loop period (transcription + liveness checks).
+    ``spawn_grace_s``: how long a fresh worker may take to publish its
+    FIRST heartbeat (process start + jax import) before it is presumed
+    stillborn.  ``drain_timeout_s``: how long the graceful close waits
+    for idle workers to exit before SIGKILLing them (their work is done
+    and durable by then — the kill is cosmetic)."""
+
+    hosts: int = 2
+    lease_s: float = 5.0
+    poll_s: float = 0.05
+    spawn_grace_s: float = 120.0
+    drain_timeout_s: float = 60.0
+
+    def __post_init__(self):
+        if self.hosts < 1:
+            raise ValueError(f"hosts must be >= 1, got {self.hosts}")
+        if self.lease_s <= 0:
+            raise ValueError(f"lease_s must be > 0, got {self.lease_s}")
+        if self.poll_s <= 0:
+            raise ValueError(f"poll_s must be > 0, got {self.poll_s}")
+
+
+@dataclasses.dataclass(eq=False)
+class HostHandle:
+    """Coordinator-side view of one worker host process."""
+
+    host_id: str
+    proc: object  # Popen-like: pid / poll() / kill() / wait(timeout)
+    assign: _AppendFsyncFile
+    tail: JsonlTail
+    lease_path: str
+    spawned_t: float
+    alive: bool = True
+    closed: bool = False  # close sentinel sent (clean rc=0 expected)
+
+
+class FabricCoordinator:
+    """Shard users across worker hosts through the admission journal.
+
+    ``journal``: the main :class:`~consensus_entropy_tpu.serve.journal.
+    AdmissionJournal` (must be file-backed — it IS the fabric's source of
+    truth; give it ``compact_bytes`` to bound it for long-lived fabrics).
+    ``fabric_dir``: directory for the per-host assign/events/lease
+    channels.  ``poison``: the fabric-wide persisted poison list
+    (transcribed worker poisons land here; poisoned users are never
+    routed again).  ``on_poll``: test/bench hook called once per
+    coordinator loop with the coordinator itself (chaos drills kill
+    workers from here at journal-state-defined instants).
+    """
+
+    def __init__(self, journal, fabric_dir: str, config: FabricConfig, *,
+                 poison: PoisonList | None = None,
+                 report: FleetReport | None = None, on_poll=None,
+                 preemption=None):
+        if journal.path is None:
+            raise ValueError("the fabric journal must be file-backed — it "
+                             "is the coordinator's source of truth")
+        self.journal = journal
+        self.fabric_dir = fabric_dir
+        self.config = config
+        self.poison = poison if poison is not None else PoisonList()
+        self.report = report or FleetReport()
+        self.on_poll = on_poll
+        #: optional guard with a boolean ``requested`` (``resilience.
+        #: preemption.PreemptionGuard``): SIGTERM drains the fabric —
+        #: workers are SIGTERMed (their own guards finish in-flight
+        #: sessions and exit 75), the finishes are transcribed, and
+        #: ``Preempted`` surfaces so the CLI exits 75 with every queued
+        #: user durable in the journal for the rerun
+        self.preemption = preemption
+        self.hosts: dict[str, HostHandle] = {}
+        self.reassignments = 0
+        self.revocations = 0
+        self._unresolved: set[str] = set()
+        self._failed: set[str] = set()
+        self._submitted: list[str] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def run(self, user_ids, spawn) -> dict:
+        """Serve ``user_ids`` across ``config.hosts`` workers; returns a
+        summary dict.  ``spawn(host_id) -> Popen``-like launches one
+        worker process (the CLI re-execs itself with ``--fabric-worker``;
+        tests launch a synthetic-workload script).
+
+        Any escaping ``BaseException`` (injected coordinator kill,
+        Ctrl-C) SIGKILLs every worker first — mirroring the orphan-exit
+        the workers would perform themselves on a real coordinator death
+        — and leaves all recovery state durable in the journal."""
+        os.makedirs(self.fabric_dir, exist_ok=True)
+        st = self.journal.state
+        if st.last:
+            self.report.event(
+                "journal_recover", finished=len(st.finished),
+                in_flight=len(st.in_flight), queued=len(st.queued),
+                poisoned=len(st.poisoned))
+        pending: list[str] = []
+        for u in st.recovery_order([str(u) for u in user_ids]):
+            if u in st.finished:
+                self.report.event("skip_done", user=u)
+                continue
+            if u in self.poison or u in st.poisoned:
+                self.report.event("skip_poisoned", user=u)
+                continue
+            if st.last.get(u) in (None, "unpoison"):
+                self.journal.append("enqueue", u)
+            pending.append(u)
+        self._submitted = list(pending)
+        self._unresolved = set(pending)
+        try:
+            if pending:  # nothing unresolved → no workers to spawn
+                for i in range(self.config.hosts):
+                    self._spawn_host(f"h{i}", spawn)
+                # (re)route every unresolved user: prior-run assignments
+                # are void (their processes were reaped above), and
+                # recovery_order already put in-flight users ahead of the
+                # queue
+                for u in pending:
+                    self._assign(u)
+            while self._unresolved:
+                if self.preemption is not None \
+                        and self.preemption.requested:
+                    self._preempt_drain()
+                for h in list(self.hosts.values()):
+                    if h.alive:
+                        self._transcribe(h)
+                self._check_hosts()
+                if not self._unresolved:
+                    break
+                if not any(h.alive for h in self.hosts.values()):
+                    raise FabricError(
+                        f"every worker host is down with "
+                        f"{len(self._unresolved)} user(s) unresolved — "
+                        "rerun the coordinator to recover from the "
+                        "journal")
+                if self.on_poll is not None:
+                    self.on_poll(self)
+                time.sleep(self.config.poll_s)
+            self._close_hosts()
+        except BaseException:
+            self._kill_all()
+            raise
+        return self._summary()
+
+    # -- host management ---------------------------------------------------
+
+    def _spawn_host(self, host_id: str, spawn) -> HostHandle:
+        paths = fabric_paths(self.fabric_dir, host_id)
+        self._reap_stale(host_id, paths)
+        proc = spawn(host_id)
+        tail = JsonlTail(paths["events"])
+        tail.seek(self.journal.state.host_cursor.get(host_id, 0))
+        self.journal.append("lease", host=host_id,
+                            pid=getattr(proc, "pid", None))
+        h = HostHandle(host_id, proc, _AppendFsyncFile(paths["assign"]),
+                       tail, paths["lease"], time.time())
+        self.hosts[host_id] = h
+        self.report.event("host_up", host=host_id,
+                          pid=getattr(proc, "pid", None))
+        return h
+
+    def _pid_is_fabric_worker(self, pid: int) -> bool:
+        """The lease file's pid may have been RECYCLED to an unrelated
+        process since the worker died — only kill a process whose
+        command line actually names this fabric's directory (every
+        worker carries it in argv).  No ``/proc`` entry (process gone,
+        or a platform without procfs) → nothing safe to reap."""
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().decode("utf-8", "replace")
+        except OSError:
+            return False
+        return self.fabric_dir in cmd
+
+    def _reap_stale(self, host_id: str, paths: dict) -> None:
+        """Kill any orphan worker a crashed coordinator left behind (its
+        lease file names the pid) and clear the stale channels, so the
+        fresh worker never races an orphan for the same workspaces.  The
+        events file is KEPT — its transcription cursor lives in the
+        journal and must stay valid."""
+        lease = read_lease(paths["lease"])
+        pid = lease.get("pid") if lease else None
+        if isinstance(pid, int) and pid != os.getpid() \
+                and self._pid_is_fabric_worker(pid):
+            try:
+                os.kill(pid, signal.SIGKILL)
+                self.report.event("orphan_reaped", host=host_id, pid=pid)
+            except (ProcessLookupError, PermissionError):
+                pass
+            else:
+                deadline = time.time() + 5.0
+                while time.time() < deadline:
+                    try:
+                        os.kill(pid, 0)
+                    except (ProcessLookupError, PermissionError):
+                        break
+                    time.sleep(0.02)
+        for key in ("lease", "assign"):
+            try:
+                os.remove(paths[key])
+            except FileNotFoundError:
+                pass
+
+    def _check_hosts(self) -> None:
+        now = time.time()
+        for h in list(self.hosts.values()):
+            if not h.alive:
+                continue
+            rc = h.proc.poll()
+            if rc is not None:
+                self._fail_over(h, f"worker exited rc={rc}")
+                continue
+            age = lease_age_s(h.lease_path, now)
+            if age is None:
+                if now - h.spawned_t > self.config.spawn_grace_s:
+                    self._fail_over(h, "no first heartbeat within "
+                                       "spawn grace")
+            elif age > self.config.lease_s:
+                self._fail_over(h, f"lease expired ({age:.1f}s since "
+                                   "last heartbeat)")
+
+    def _fail_over(self, h: HostHandle, reason: str) -> None:
+        """Revoke one host and re-route its unresolved users.  The kill
+        comes FIRST (a hung-but-alive worker must be dead before its
+        users run elsewhere — no user may ever run on two hosts at once),
+        the final event drain second (finishes it durably journaled
+        before dying must resolve, not re-run), the re-routing last."""
+        h.alive = False
+        try:
+            h.proc.kill()
+            h.proc.wait(timeout=10)
+        except Exception:
+            pass
+        self._transcribe(h)
+        self.journal.append("revoke", host=h.host_id, reason=reason)
+        self.revocations += 1
+        victims = [u for u in self.journal.state.assigned_to(h.host_id)
+                   if u in self._unresolved]
+        self.report.event("host_down", host=h.host_id, reason=reason,
+                          reassigned=len(victims))
+        for u in victims:
+            self._assign(u)
+            self.reassignments += 1
+
+    def _close_hosts(self) -> None:
+        """Graceful shutdown: every user is resolved, so workers are idle
+        — send the close sentinel, give them ``drain_timeout_s`` to exit
+        0, then SIGKILL stragglers (nothing left to lose)."""
+        for h in self.hosts.values():
+            if h.alive:
+                h.closed = True
+                h.assign.append({"close": True})
+        deadline = time.time() + self.config.drain_timeout_s
+        for h in self.hosts.values():
+            if h.alive:
+                while h.proc.poll() is None and time.time() < deadline:
+                    time.sleep(self.config.poll_s)
+                if h.proc.poll() is None:
+                    self.report.event("drain_kill", host=h.host_id)
+                    try:
+                        h.proc.kill()
+                        h.proc.wait(timeout=10)
+                    except Exception:
+                        pass
+                self._transcribe(h)
+            h.assign.close()
+            h.tail.close()
+
+    def _preempt_drain(self) -> None:
+        """SIGTERM each worker (its own guard drains: in-flight sessions
+        finish, queued users stay journaled), transcribe the finishes,
+        then surface ``Preempted``."""
+        from consensus_entropy_tpu.resilience.preemption import Preempted
+
+        self.report.event(
+            "drain", unresolved=len(self._unresolved),
+            reason="preemption requested; workers finish in-flight "
+                   "sessions, queued users left for the rerun")
+        for h in self.hosts.values():
+            if h.alive:
+                try:
+                    h.proc.terminate()
+                except Exception:
+                    pass
+        deadline = time.time() + self.config.drain_timeout_s
+        for h in self.hosts.values():
+            if not h.alive:
+                continue
+            while h.proc.poll() is None and time.time() < deadline:
+                self._transcribe(h)
+                time.sleep(self.config.poll_s)
+            if h.proc.poll() is None:
+                try:
+                    h.proc.kill()
+                    h.proc.wait(timeout=10)
+                except Exception:
+                    pass
+            self._transcribe(h)
+        raise Preempted(
+            f"fabric drained: {len(self._unresolved)} user(s) left "
+            "journaled for the rerun")
+
+    def _kill_all(self) -> None:
+        for h in self.hosts.values():
+            try:
+                h.proc.kill()
+            except Exception:
+                pass
+
+    # -- routing + transcription -------------------------------------------
+
+    def _load_of(self, host_id: str) -> int:
+        assigned = self.journal.state.assigned
+        return sum(1 for u in self._unresolved
+                   if assigned.get(u) == host_id)
+
+    def _assign(self, user: str) -> None:
+        live = [h for h in self.hosts.values() if h.alive]
+        if not live:
+            return  # the run loop raises FabricError on its next pass
+        h = min(live, key=lambda h: (self._load_of(h.host_id), h.host_id))
+        # a kill here models the coordinator dying between choosing a
+        # route and journaling it: the user's last record stays
+        # enqueue/fail, so the restarted coordinator re-routes it
+        faults.fire("fabric.assign", user=user, host=h.host_id)
+        self.journal.append("assign", user, host=h.host_id)
+        h.assign.append({"user": user})
+        self.report.event("assign", user=user, host=h.host_id)
+
+    def _transcribe(self, h: HostHandle) -> None:
+        """Fold the host's durable events into the main journal.  Each
+        transcription carries ``src_off`` — the byte cursor after the
+        consumed line — so a restarted coordinator's replay resumes the
+        tail exactly where the journal proves it left off (an event is
+        transcribed at-least-zero, never twice)."""
+        for rec, off in h.tail.poll():
+            ev, u = rec.get("event"), rec.get("user")
+            if ev == "admit":
+                self.journal.append("admit", u, host=h.host_id,
+                                    src_off=off)
+            elif ev == "finish":
+                self.journal.append("finish", u, host=h.host_id,
+                                    src_off=off)
+                self._unresolved.discard(u)
+                self.report.event("user_finished", user=u, host=h.host_id)
+            elif ev == "poison":
+                self.journal.append("poison", u, host=h.host_id,
+                                    src_off=off, error=rec.get("error"))
+                if u not in self.poison:
+                    self.poison.add(u, error=str(rec.get("error")),
+                                    attempts=int(rec.get("attempts") or 0))
+                self._unresolved.discard(u)
+                self.report.event("user_poisoned", user=u,
+                                  host=h.host_id)
+            elif ev == "fail":
+                fields = {"host": h.host_id, "src_off": off,
+                          "error": rec.get("error")}
+                if rec.get("final"):
+                    fields["final"] = True
+                self.journal.append("fail", u, **fields)
+                if rec.get("final"):
+                    # the worker's whole recovery ladder (evict → resume
+                    # → backoff re-admission) is spent: resolved with an
+                    # error THIS run; a coordinator restart re-admits it,
+                    # same as the single-host journal semantics
+                    self._failed.add(u)
+                    self._unresolved.discard(u)
+                    self.report.event("user_failed_final", user=u,
+                                      host=h.host_id,
+                                      error=rec.get("error"))
+            # worker-local enqueue/requeue records are flow bookkeeping,
+            # not dispositions the fabric needs — skipped (their bytes
+            # are covered by the next transcribed record's cursor)
+
+    # -- summary -----------------------------------------------------------
+
+    def _summary(self) -> dict:
+        st = self.journal.state
+        sub = set(self._submitted)
+        summary = {
+            "users": len(self._submitted),
+            "finished": sorted(u for u in sub if u in st.finished),
+            "failed": sorted(self._failed),
+            "poisoned": sorted(u for u in sub if u in st.poisoned),
+            "revocations": self.revocations,
+            "reassignments": self.reassignments,
+            "compactions": self.journal.compactions,
+            "hosts": {hid: ("revoked" if not h.alive else "closed")
+                      for hid, h in self.hosts.items()},
+        }
+        self.report.event(
+            "fabric_summary", users=summary["users"],
+            finished=len(summary["finished"]),
+            failed=len(summary["failed"]),
+            poisoned=len(summary["poisoned"]),
+            revocations=self.revocations,
+            reassignments=self.reassignments,
+            compactions=summary["compactions"])
+        return summary

@@ -1,0 +1,226 @@
+"""Worker-host side of the multi-host serve fabric.
+
+A fabric worker is ONE process running ONE :class:`~consensus_entropy_tpu.
+serve.server.FleetServer` over its local devices.  It talks to the
+coordinator (:mod:`serve.fabric`) exclusively through files — this image's
+jax build cannot run multiprocess collectives on CPU, so fabric
+coordination is process-level by construction and ``parallel.multihost``
+stays reserved for real multi-controller runtimes:
+
+- ``fabric/assign_<host>.jsonl`` (coordinator → worker): one line per
+  routed user (``{"user": ...}``), plus a final ``{"close": true}``
+  sentinel.  The worker tails it with the partial-line-safe
+  :class:`~consensus_entropy_tpu.serve.journal.JsonlTail` and submits each
+  user into its server's admission queue (backpressure: a full queue just
+  delays the submit — the tail position IS the flow-control state).
+- ``fabric/events_<host>.jsonl`` (worker → coordinator): the worker's own
+  :class:`~consensus_entropy_tpu.serve.journal.AdmissionJournal` — every
+  admit/finish/fail/poison the server journals is durable here first; the
+  coordinator tails and transcribes it into the main journal.  Worker and
+  coordinator each write only their OWN file (single-writer WALs), which
+  is what keeps compaction and torn-tail recovery simple.
+- ``fabric/lease_<host>.json`` (worker → coordinator): the heartbeat.
+  :class:`HostLease` rewrites it atomically (tmp + rename) every
+  ``interval_s``; the coordinator treats a beat older than the lease as a
+  dead or hung worker and fails its users over.  The heartbeat thread
+  also performs ORPHAN detection: when the coordinator process dies, the
+  worker is re-parented and exits hard (``EXIT_ORPHANED``) rather than
+  keep mutating workspaces a restarted coordinator is about to hand to
+  fresh workers.
+
+Durability contract: the worker never needs a clean shutdown.  SIGKILL at
+any instant leaves (a) per-user workspaces resumable (PR 1 two-phase
+commit), (b) the event journal torn-tail-recoverable, and (c) the lease
+file stale — exactly the three signals the coordinator's failover path
+consumes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from consensus_entropy_tpu.resilience import faults
+from consensus_entropy_tpu.serve.journal import AdmissionJournal, JsonlTail
+from consensus_entropy_tpu.serve.server import (
+    FleetServer,
+    QueueClosed,
+    QueueFull,
+)
+
+#: worker process exit codes (beyond the CLI's EXIT_PREEMPTED=75)
+EXIT_ORPHANED = 76
+
+FABRIC_SUBDIR = "fabric"
+
+
+def fabric_paths(fabric_dir: str, host_id: str) -> dict:
+    """The three per-host channel paths plus the worker's stdout log."""
+    return {
+        "assign": os.path.join(fabric_dir, f"assign_{host_id}.jsonl"),
+        "events": os.path.join(fabric_dir, f"events_{host_id}.jsonl"),
+        "lease": os.path.join(fabric_dir, f"lease_{host_id}.json"),
+        "log": os.path.join(fabric_dir, f"log_{host_id}.txt"),
+    }
+
+
+def read_lease(path: str) -> dict | None:
+    """The last heartbeat a worker managed to publish, or ``None`` (never
+    beat, or a torn write — the atomic rename makes the latter a
+    never-happened)."""
+    import json
+
+    try:
+        with open(path, "rb") as f:
+            rec = json.loads(f.read().decode("utf-8"))
+    except (OSError, ValueError, UnicodeDecodeError):
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+def lease_age_s(path: str, now: float | None = None) -> float | None:
+    """Seconds since the worker's last heartbeat (wall clock — the lease
+    file crosses processes, so monotonic clocks don't compare)."""
+    rec = read_lease(path)
+    if rec is None or not isinstance(rec.get("t"), (int, float)):
+        return None
+    return (time.time() if now is None else now) - rec["t"]
+
+
+class HostLease:
+    """The worker's heartbeat writer (daemon thread).
+
+    Every ``interval_s`` it fires the ``fabric.lease`` fault point (an
+    injected kill/delay there models a dead or wedged heartbeat while the
+    engine may still be running — the coordinator must SIGKILL + fail
+    over on lease age alone) and atomically replaces the lease file.
+
+    ``orphan_check``: when the spawning coordinator dies, this process is
+    re-parented (``getppid`` changes); the heartbeat thread then exits the
+    WHOLE process hard via ``os._exit(EXIT_ORPHANED)`` — crash semantics,
+    which the recovery machinery is already pinned against — so orphans
+    never race a restarted coordinator's fresh workers for the same
+    workspaces."""
+
+    def __init__(self, path: str, host_id: str, interval_s: float, *,
+                 orphan_check: bool = True):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self.path = path
+        self.host_id = host_id
+        self.interval_s = interval_s
+        self.beats = 0
+        self._orphan_check = orphan_check
+        self._ppid = os.getppid()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def beat_once(self) -> None:
+        """One heartbeat: fault point, then tmp-write + atomic rename (a
+        reader sees the previous beat or this one, never a torn file)."""
+        import json
+
+        self.beats += 1
+        faults.fire("fabric.lease", host=self.host_id, beat=self.beats)
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(json.dumps(
+                {"host": self.host_id, "pid": os.getpid(),
+                 "beat": self.beats,
+                 "t": round(time.time(), 3)}).encode("utf-8"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if self._orphan_check and os.getppid() != self._ppid:
+                os._exit(EXIT_ORPHANED)
+            self.beat_once()
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "HostLease":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"fabric-lease-{self.host_id}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+def run_worker(fabric_dir: str, host_id: str, *, build_entry, scheduler,
+               config, on_result=None, lease_s: float = 5.0,
+               preemption=None, poll_s: float = 0.05) -> list:
+    """Run one fabric worker to completion; returns the server's results.
+
+    ``build_entry(user_id) -> FleetUser | None``: constructs the user's
+    entry from its (possibly mid-run) workspace — a failed-over user
+    resumes from whatever its dead host durably committed.  ``None``
+    means the workspace is already complete; the worker journals the
+    ``finish`` directly (with ``skipped=True``) so the coordinator
+    resolves the user without burning a slot.  A raising ``build_entry``
+    journals a FINAL ``fail`` for the same reason — the coordinator must
+    never wait forever on a user no worker can construct.
+
+    ``scheduler``: a fresh :class:`~consensus_entropy_tpu.fleet.scheduler.
+    FleetScheduler` built for serving (``scoring_by_width=True``).
+    ``config``: the worker's :class:`~consensus_entropy_tpu.serve.server.
+    ServeConfig`.  ``lease_s``: the coordinator's lease — heartbeats run
+    at a third of it so one missed beat never looks like death.
+    """
+    paths = fabric_paths(fabric_dir, host_id)
+    journal = AdmissionJournal(paths["events"])
+    server = FleetServer(scheduler, config, preemption=preemption,
+                         journal=journal)
+    feed = JsonlTail(paths["assign"])
+    stop = threading.Event()
+
+    def intake():
+        """Tail the assignment feed into the server's admission queue;
+        runs as the 'threaded producer' the server's keep_open mode is
+        built for."""
+        while not stop.is_set():
+            for rec, _off in feed.poll():
+                if rec.get("close"):
+                    server.close_intake()
+                    return
+                uid = rec.get("user")
+                if uid is None:
+                    continue
+                try:
+                    entry = build_entry(uid)
+                except Exception as e:
+                    journal.append("fail", uid, error=repr(e), final=True)
+                    continue
+                if entry is None:
+                    # workspace already complete: resolve without a slot
+                    journal.append("finish", uid, skipped=True)
+                    continue
+                while not stop.is_set():
+                    try:
+                        server.submit(entry)
+                        break
+                    except QueueFull:
+                        stop.wait(poll_s)  # backpressure: retry
+                    except (QueueClosed, RuntimeError):
+                        return  # draining: the rerun picks the user up
+            stop.wait(poll_s)
+
+    lease = HostLease(paths["lease"], host_id,
+                      max(lease_s / 3.0, 0.05)).start()
+    thread = threading.Thread(target=intake, daemon=True,
+                              name=f"fabric-intake-{host_id}")
+    thread.start()
+    try:
+        return server.serve((), keep_open=True, on_result=on_result)
+    finally:
+        stop.set()
+        thread.join(timeout=2.0)
+        lease.stop()
+        feed.close()
+        journal.close()

@@ -7,12 +7,12 @@ in-flight ones.  This module closes that gap with a write-ahead log,
 ``users/serve_journal.jsonl``:
 
 - **append-fsync**: every admission transition (``enqueue`` / ``admit`` /
-  ``finish`` / ``fail`` / ``poison``) is one JSON line, flushed AND
-  fsynced before the server proceeds — by the time a user's transition is
-  acted on, it is durable.  ``finish`` is appended AFTER the driver's
-  ``on_result`` persistence ran, so "finished" in the journal implies the
-  user's workspace is final (a crash between the two re-finishes the user
-  idempotently rather than losing it).
+  ``finish`` / ``fail`` / ``poison`` / ``unpoison``) is one JSON line,
+  flushed AND fsynced before the server proceeds — by the time a user's
+  transition is acted on, it is durable.  ``finish`` is appended AFTER the
+  driver's ``on_result`` persistence ran, so "finished" in the journal
+  implies the user's workspace is final (a crash between the two
+  re-finishes the user idempotently rather than losing it).
 - **replay**: a restarted server builds a :class:`JournalState` from the
   journal — each user's LAST event decides its disposition (a trailing
   half-written line from the crash itself is skipped).  Finished users
@@ -24,11 +24,38 @@ in-flight ones.  This module closes that gap with a write-ahead log,
 - **poison list**: a sibling append-fsync file (:class:`PoisonList`)
   records users that exhausted their failure budget; future submits skip
   them instead of burning slots on a user that has already proven
-  terminally broken.
+  terminally broken.  ``--unpoison`` removals are journaled records in the
+  same file (never a hand-edit), replayed on load.
+- **fabric records** (the multi-host serve fabric): the coordinator
+  process shards users across worker hosts through the SAME journal —
+  ``assign(user, host)`` maps a user onto a host without changing its
+  admission disposition, ``lease``/``revoke`` record host membership, and
+  transcribed worker events carry ``host`` + ``src_off`` (the byte cursor
+  into that host's own event file) so a restarted coordinator resumes
+  transcription exactly where it stopped.  See :mod:`serve.fabric`.
+- **compaction**: a long-lived server's WAL grows without bound.
+  :meth:`AdmissionJournal.compact` checkpoints the replayed
+  :class:`JournalState` to ``<journal>.ckpt`` (write-new-then-rename,
+  fsynced) and then truncates the journal the same way; every record
+  carries a monotonic ``seq`` and the checkpoint stores the last applied
+  one, so a crash BETWEEN the two renames replays the stale journal tail
+  idempotently (records at or below the checkpoint seq are skipped).
+  ``compact_bytes`` triggers compaction automatically from ``append``,
+  bounding the journal below a fixed size for the life of the server.
 
 The journal records user IDs (stringified), never payloads: the per-user
 data/committee state lives in the PR 1 workspaces, which are already
 crash-durable via the two-phase checkpoint commit.
+
+Single-writer discipline: one process owns one journal file.  The fabric
+keeps this invariant — the coordinator is the sole writer of the main
+journal, each worker the sole writer of its own per-host event journal —
+which is what makes compaction's rename-over safe (no other process holds
+an open append handle to the replaced inode).  The discipline is
+ENFORCED: the first append flocks a sibling ``<path>.lock`` for the
+writer's lifetime, so a second writer (say, ``--unpoison`` racing a live
+server) fails loudly with :class:`SingleWriterViolation` instead of
+interleaving seq numbers that replay would silently dedupe away.
 """
 
 from __future__ import annotations
@@ -40,8 +67,11 @@ import time
 
 from consensus_entropy_tpu.resilience import faults
 
-#: admission transitions a journal line may carry
-EVENTS = ("enqueue", "admit", "finish", "fail", "poison")
+#: admission transitions a journal line may carry (user-scoped)
+EVENTS = ("enqueue", "admit", "finish", "fail", "poison", "unpoison",
+          "assign")
+#: host-membership records (fabric): no user field
+HOST_EVENTS = ("lease", "revoke")
 
 
 class JournalState:
@@ -50,21 +80,58 @@ class JournalState:
     ``last[user]`` is the user's final journaled event; :meth:`recovery_order`
     turns that into the restart admission order — in-flight users first
     (their workspaces hold the most sunk work), then still-queued users in
-    their enqueue order, then users the journal never saw."""
+    their enqueue order, then users the journal never saw.
+
+    Fabric bookkeeping rides along without touching dispositions:
+    ``assigned[user]`` is the host a coordinator last routed the user to,
+    ``hosts[host]`` the host's lease state (``lease``/``revoke``), and
+    ``host_cursor[host]`` the durable transcription offset into that
+    host's event file."""
 
     def __init__(self):
         self.last: dict[str, str] = {}
         self.admits: dict[str, int] = {}
         self.fails: dict[str, int] = {}
+        self.assigned: dict[str, str] = {}
+        self.hosts: dict[str, str] = {}
+        self.host_cursor: dict[str, int] = {}
         self._enqueue_seq: dict[str, int] = {}
         self._admit_seq: dict[str, int] = {}
         self._seq = 0
 
+    @property
+    def seq(self) -> int:
+        """The last applied record seq (the compaction watermark)."""
+        return self._seq
+
     def apply(self, rec: dict) -> None:
-        event, user = rec.get("event"), rec.get("user")
-        if event not in EVENTS or not isinstance(user, str):
+        event = rec.get("event")
+        if event not in EVENTS and event not in HOST_EVENTS:
             return  # foreign/corrupt line: disposition unchanged
-        self._seq += 1
+        seq = rec.get("seq")
+        if isinstance(seq, int):
+            if seq <= self._seq:
+                return  # pre-checkpoint duplicate (crash mid-compaction)
+            self._seq = seq
+        else:  # pre-seq journal line (older writers)
+            self._seq += 1
+        host = rec.get("host")
+        if isinstance(host, str) and isinstance(rec.get("src_off"), int):
+            self.host_cursor[host] = max(self.host_cursor.get(host, 0),
+                                         rec["src_off"])
+        if event in HOST_EVENTS:
+            if isinstance(host, str):
+                self.hosts[host] = event
+            return
+        user = rec.get("user")
+        if not isinstance(user, str):
+            return
+        if event == "assign":
+            # routing only: a (re)assignment never changes whether the
+            # user is queued/in-flight — the worker's transcribed events do
+            if isinstance(host, str):
+                self.assigned[user] = host
+            return
         self.last[user] = event
         if event == "enqueue":
             self._enqueue_seq[user] = self._seq
@@ -73,6 +140,11 @@ class JournalState:
             self._admit_seq.setdefault(user, self._seq)
         elif event == "fail":
             self.fails[user] = self.fails.get(user, 0) + 1
+        elif event == "unpoison":
+            # the operator asked for a fresh start: the budget counters
+            # must not instantly re-poison the user on its next failure
+            self.admits.pop(user, None)
+            self.fails.pop(user, None)
 
     @property
     def finished(self) -> set:
@@ -100,6 +172,17 @@ class JournalState:
     def pending(self) -> list:
         return self.in_flight + self.queued
 
+    def live_hosts(self) -> list:
+        return sorted(h for h, e in self.hosts.items() if e == "lease")
+
+    def assigned_to(self, host: str) -> list:
+        """This host's unresolved users, in-flight first (first-admit
+        order) then queued (enqueue order) — the failover re-admission
+        order for a revoked host."""
+        mine = {u for u, h in self.assigned.items() if h == host}
+        return ([u for u in self.in_flight if u in mine]
+                + [u for u in self.queued if u in mine])
+
     def recovery_order(self, user_ids) -> list:
         """Reorder ``user_ids`` for a restarted submit pass: in-flight
         first, then journal-queued in enqueue order, then unseen users in
@@ -119,9 +202,50 @@ class JournalState:
         out.extend(u for k, u in by_key.items() if k in done)
         return out
 
+    # -- checkpoint serialization (compaction) -----------------------------
+
+    def to_dict(self) -> dict:
+        return {"seq": self._seq, "last": dict(self.last),
+                "admits": dict(self.admits), "fails": dict(self.fails),
+                "assigned": dict(self.assigned), "hosts": dict(self.hosts),
+                "host_cursor": dict(self.host_cursor),
+                "enqueue_seq": dict(self._enqueue_seq),
+                "admit_seq": dict(self._admit_seq)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "JournalState":
+        st = cls()
+        st._seq = int(d.get("seq", 0))
+        st.last = dict(d.get("last", {}))
+        st.admits = {k: int(v) for k, v in d.get("admits", {}).items()}
+        st.fails = {k: int(v) for k, v in d.get("fails", {}).items()}
+        st.assigned = dict(d.get("assigned", {}))
+        st.hosts = dict(d.get("hosts", {}))
+        st.host_cursor = {k: int(v)
+                          for k, v in d.get("host_cursor", {}).items()}
+        st._enqueue_seq = {k: int(v)
+                           for k, v in d.get("enqueue_seq", {}).items()}
+        st._admit_seq = {k: int(v)
+                         for k, v in d.get("admit_seq", {}).items()}
+        return st
+
+
+def _ckpt_path(path: str) -> str:
+    return path + ".ckpt"
+
 
 def _replay(path: str) -> JournalState:
     state = JournalState()
+    has_ckpt = False
+    ckpt = _ckpt_path(path)
+    if os.path.exists(ckpt):
+        try:
+            with open(ckpt, "rb") as f:
+                state = JournalState.from_dict(json.loads(f.read()
+                                                          .decode("utf-8")))
+            has_ckpt = True
+        except (ValueError, UnicodeDecodeError, TypeError):
+            state = JournalState()  # unreadable ckpt: journal alone decides
     if not os.path.exists(path):
         return state
     with open(path, "rb") as f:
@@ -132,29 +256,156 @@ def _replay(path: str) -> JournalState:
                 # a half-written tail line IS the expected crash artifact:
                 # its transition never happened as far as recovery cares
                 continue
-            if isinstance(rec, dict):
-                state.apply(rec)
+            if not isinstance(rec, dict):
+                continue
+            if has_ckpt and not isinstance(rec.get("seq"), int):
+                # legacy pre-seq line surviving a crash between the two
+                # compaction renames: only pre-upgrade writers omit seq
+                # and only post-upgrade writers produce checkpoints, so
+                # the checkpoint already covers it — re-applying would
+                # overwrite newer seq'd dispositions and double-count
+                # the failure budget
+                continue
+            state.apply(rec)
     return state
+
+
+try:
+    import fcntl
+except ImportError:  # non-POSIX: single-writer stays a documented contract
+    fcntl = None
+
+
+class SingleWriterViolation(RuntimeError):
+    """Another process already holds this WAL's write lock.  The
+    append-fsync files are single-writer BY DESIGN (see module
+    docstring); a second writer would interleave seq numbers (records
+    silently deduped away on replay) and lose appends across a
+    compaction rename.  Typical trigger: ``--unpoison`` while a server
+    is still running against the same users dir."""
 
 
 class _AppendFsyncFile:
     """One JSONL record per call, durable before return (flush + fsync).
     The handle is opened lazily and kept open — the fsync per append is
-    the durability point, reopening per line would only add syscalls."""
+    the durability point, reopening per line would only add syscalls.
+
+    Opening REPAIRS a torn tail first: a file whose last line lacks its
+    newline (the process died mid-append) gets one appended, so the torn
+    record stays an ignorable line of its own instead of swallowing the
+    NEXT append into one unparseable blob (which would silently lose a
+    healthy post-restart record along with the torn one).
+
+    The single-writer discipline is ENFORCED, not assumed: the first
+    append takes an exclusive ``flock`` on a sibling ``<path>.lock``
+    file (held for the writer's lifetime — a separate file so
+    compaction's rename-over of the data file never drops it, and the
+    kernel releases it on any process death, SIGKILL included).  A
+    second writer gets :class:`SingleWriterViolation` instead of
+    silently corrupting the seq stream."""
 
     def __init__(self, path: str | None):
         self.path = path
         self._f = None
+        self._lockf = None
+
+    def _open(self):
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        if self._lockf is None and fcntl is not None:
+            lockf = open(self.path + ".lock", "ab")
+            try:
+                fcntl.flock(lockf.fileno(),
+                            fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                lockf.close()
+                raise SingleWriterViolation(
+                    f"{self.path}: another process holds this journal's "
+                    "write lock (append-fsync WALs are single-writer); "
+                    "is a server still running against this users dir?")
+            self._lockf = lockf
+        self._f = open(self.path, "ab")
+        if self._f.tell() > 0:
+            with open(self.path, "rb") as r:
+                r.seek(-1, os.SEEK_END)
+                torn = r.read(1) != b"\n"
+            if torn:
+                self._f.write(b"\n")
+                self._f.flush()
+                os.fsync(self._f.fileno())
 
     def append(self, rec: dict) -> None:
         if self.path is None:
             return
         if self._f is None:
-            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-            self._f = open(self.path, "ab")
+            self._open()
         self._f.write((json.dumps(rec) + "\n").encode("utf-8"))
         self._f.flush()
         os.fsync(self._f.fileno())
+
+    def size(self) -> int:
+        """Bytes written so far (0 before the first append this run)."""
+        return self._f.tell() if self._f is not None else 0
+
+    def rotate(self) -> None:
+        """Close the DATA handle only (the caller is about to rename a
+        fresh file over the path — compaction); the write lock stays
+        held so no second writer can slip in mid-rotation."""
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def close(self) -> None:
+        self.rotate()
+        if self._lockf is not None:
+            self._lockf.close()  # releases the flock
+            self._lockf = None
+
+
+class JsonlTail:
+    """Partial-line-safe follower of an append-only JSONL file written by
+    ANOTHER process (the fabric coordinator tailing a worker's event
+    journal, a worker tailing its assignment feed).
+
+    :meth:`poll` yields ``(record, offset_after)`` for every COMPLETE line
+    appended since the last poll — a line still missing its newline (the
+    writer is mid-append, or died there) is left unconsumed, so a record
+    is either seen whole or not yet.  Unparseable complete lines are
+    skipped with their offset advanced (the torn-tail artifact after a
+    writer crash).  ``seek`` resumes from a durable cursor (the fabric
+    coordinator journals each transcription's ``offset_after``)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+        self.offset = 0
+
+    def seek(self, offset: int) -> None:
+        self.offset = max(int(offset), 0)
+        if self._f is not None:
+            self._f.seek(self.offset)
+
+    def poll(self) -> list:
+        if self._f is None:
+            if not os.path.exists(self.path):
+                return []
+            self._f = open(self.path, "rb")
+            self._f.seek(self.offset)
+        out = []
+        while True:
+            line = self._f.readline()
+            if not line.endswith(b"\n"):
+                # incomplete tail: rewind so the next poll re-reads it
+                # once the writer finishes (or never, if the writer died)
+                self._f.seek(self.offset)
+                break
+            self.offset += len(line)
+            try:
+                rec = json.loads(line.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                continue
+            if isinstance(rec, dict):
+                out.append((rec, self.offset))
+        return out
 
     def close(self) -> None:
         if self._f is not None:
@@ -165,16 +416,21 @@ class _AppendFsyncFile:
 class AdmissionJournal:
     """The serve layer's WAL (see module docstring).
 
-    Construction replays any existing journal into :attr:`state`; the
-    server consults it for skip/ordering/attempt decisions, then appends
-    new transitions through :meth:`append`.  ``path=None`` journals
-    nothing (unit tests, embedded drivers) while keeping the interface.
+    Construction replays any existing checkpoint + journal into
+    :attr:`state`; the server consults it for skip/ordering/attempt
+    decisions, then appends new transitions through :meth:`append`.
+    ``path=None`` journals nothing (unit tests, embedded drivers) while
+    keeping the interface.  ``compact_bytes`` bounds the journal file:
+    once an append pushes it past the bound, the state is checkpointed
+    and the journal truncated in place (crash-safe, see :meth:`compact`).
     """
 
-    def __init__(self, path: str | None):
+    def __init__(self, path: str | None, *, compact_bytes: int | None = None):
         self.path = path
+        self.compact_bytes = compact_bytes
         self.state = _replay(path) if path else JournalState()
         self._file = _AppendFsyncFile(path)
+        self.compactions = 0
         #: appends happen on the serve-loop thread, but ``FleetServer.
         #: submit`` (producer threads) both appends (enqueue) and reads
         #: the replayed state (finished-skip) — one lock covers the file
@@ -186,21 +442,36 @@ class AdmissionJournal:
         """True when the journal held prior state to recover from."""
         return bool(self.state.last)
 
-    def append(self, event: str, user, **fields) -> None:
+    @property
+    def ckpt_path(self) -> str | None:
+        return _ckpt_path(self.path) if self.path else None
+
+    def append(self, event: str, user=None, **fields) -> None:
         """Durably record one transition; thread-safe.  The
         ``serve.journal.append`` fault point fires BEFORE the write: an
         injected kill there models dying with the transition un-journaled,
         which recovery must treat as 'never happened' (the enclosing step
-        is re-done on restart)."""
-        if event not in EVENTS:
+        is re-done on restart).  Host-membership records (``lease`` /
+        ``revoke``) carry a ``host=`` field instead of a user."""
+        if event in HOST_EVENTS:
+            if not isinstance(fields.get("host"), str):
+                raise ValueError(f"journal event {event!r} needs host=")
+        elif event not in EVENTS:
             raise ValueError(f"unknown journal event {event!r}")
+        elif user is None:
+            raise ValueError(f"journal event {event!r} needs a user")
         with self._lock:
             faults.fire("serve.journal.append", event=event,
-                        user=str(user))
-            rec = {"event": event, "user": str(user),
+                        user=None if user is None else str(user))
+            rec = {"event": event, "seq": self.state.seq + 1,
                    "t": round(time.time(), 3), **fields}
+            if user is not None:
+                rec["user"] = str(user)
             self._file.append(rec)
             self.state.apply(rec)
+            if (self.compact_bytes
+                    and self._file.size() > self.compact_bytes):
+                self._compact_locked()
 
     def is_finished(self, user) -> bool:
         """Thread-safe finished-check for producer-side skip decisions
@@ -208,6 +479,47 @@ class AdmissionJournal:
         thread)."""
         with self._lock:
             return self.state.last.get(str(user)) == "finish"
+
+    def compact(self) -> None:
+        """Checkpoint the replayed state and truncate the journal.
+
+        Two atomic renames, each preceded by a ``fabric.compact`` fault
+        point so drills can die in every window:
+
+        1. ``<journal>.ckpt.tmp`` ← ``state.to_dict()`` (fsync), renamed
+           over ``<journal>.ckpt``.
+        2. An empty ``<journal>.tmp`` (fsync), renamed over the journal.
+
+        A crash before (1) leaves the old ckpt + full journal (nothing
+        lost); between (1) and (2), replay loads the new ckpt and skips
+        every stale journal record by seq (idempotent); after (2) the
+        journal is empty and the ckpt is the state.  Requires the
+        single-writer discipline in the module docstring — no other
+        process may hold an append handle to the journal being renamed
+        over."""
+        with self._lock:
+            self._compact_locked()
+
+    def _compact_locked(self) -> None:
+        if self.path is None:
+            return
+        faults.fire("fabric.compact", stage="checkpoint",
+                    seq=self.state.seq)
+        ckpt = _ckpt_path(self.path)
+        tmp = ckpt + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(json.dumps(self.state.to_dict()).encode("utf-8"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, ckpt)
+        faults.fire("fabric.compact", stage="truncate", seq=self.state.seq)
+        self._file.rotate()  # keep the write lock across the rename
+        jtmp = self.path + ".tmp"
+        with open(jtmp, "wb") as f:
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(jtmp, self.path)
+        self.compactions += 1
 
     def close(self) -> None:
         with self._lock:
@@ -224,7 +536,13 @@ class PoisonList:
     """Users that exhausted their failure budget, persisted append-fsync
     (``users/serve_poison.jsonl``): a poisoned user is skipped on every
     future submit instead of re-burning admission slots.  ``path=None``
-    keeps the list in memory only (single-run semantics)."""
+    keeps the list in memory only (single-run semantics).
+
+    The file is itself a tiny journal: :meth:`remove` (the ``--unpoison``
+    operator command) appends an ``unpoison`` record instead of rewriting
+    the file, so removals are as crash-durable and audit-traceable as the
+    additions, and replay (including across a torn tail line) simply
+    applies both record kinds in order."""
 
     def __init__(self, path: str | None = None):
         self.path = path
@@ -236,7 +554,11 @@ class PoisonList:
                         rec = json.loads(raw.decode("utf-8"))
                     except (ValueError, UnicodeDecodeError):
                         continue  # half-written tail from a crash
-                    if isinstance(rec, dict) and "user" in rec:
+                    if not isinstance(rec, dict) or "user" not in rec:
+                        continue
+                    if rec.get("event") == "unpoison":
+                        self._users.pop(str(rec["user"]), None)
+                    else:
                         self._users[str(rec["user"])] = rec
         self._file = _AppendFsyncFile(path)
         # adds run on the serve-loop thread; membership checks also run
@@ -249,6 +571,18 @@ class PoisonList:
         with self._lock:
             self._users[str(user)] = rec
             self._file.append(rec)
+
+    def remove(self, user) -> bool:
+        """Journal an ``unpoison`` record for ``user`` (the operator
+        surface — never hand-edit the jsonl).  Returns False when the
+        user was not on the list (nothing appended)."""
+        with self._lock:
+            if str(user) not in self._users:
+                return False
+            self._file.append({"event": "unpoison", "user": str(user),
+                               "t": round(time.time(), 3)})
+            del self._users[str(user)]
+            return True
 
     def __contains__(self, user) -> bool:
         with self._lock:

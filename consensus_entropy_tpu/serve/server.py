@@ -102,7 +102,9 @@ class ServeConfig:
     (``resilience.retry.backoff_delay``).  ``breaker_threshold``:
     consecutive stacked-dispatch failures that open a bucket's circuit
     breaker (0 disables); ``breaker_cooldown_s``: how long an open bucket
-    stays degraded to per-user dispatch before a half-open probe.
+    stays degraded to per-user dispatch before a half-open probe;
+    ``breaker_probes``: failed half-open probes before the width is given
+    up (stays per-user) for the rest of the run (0 probes forever).
     """
 
     target_live: int = 4
@@ -116,6 +118,7 @@ class ServeConfig:
     backoff_seed: int = 0
     breaker_threshold: int = 0
     breaker_cooldown_s: float = 30.0
+    breaker_probes: int = 0
 
     def __post_init__(self):
         if self.target_live < 1:
@@ -132,6 +135,9 @@ class ServeConfig:
         if self.breaker_threshold < 0:
             raise ValueError(f"breaker_threshold must be >= 0, "
                              f"got {self.breaker_threshold}")
+        if self.breaker_probes < 0:
+            raise ValueError(f"breaker_probes must be >= 0, "
+                             f"got {self.breaker_probes}")
 
 
 class AdmissionQueue:
@@ -273,7 +279,8 @@ class FleetServer:
             scheduler.watchdog = Watchdog(config.watchdog_s)
         if config.breaker_threshold > 0 and scheduler.breaker is None:
             scheduler.breaker = DispatchBreaker(
-                config.breaker_threshold, config.breaker_cooldown_s)
+                config.breaker_threshold, config.breaker_cooldown_s,
+                probe_budget=config.breaker_probes)
         if scheduler.on_terminal is not None:
             raise ValueError(
                 "FleetServer owns the scheduler's on_terminal hook "
@@ -581,5 +588,10 @@ class FleetServer:
                 self._journal("finish", rec["user"])
             elif str(rec["user"]) not in self.poison:
                 # a final (non-poisoned) failure stays re-admittable on
-                # restart: the journal keeps the user in-flight
-                self._journal("fail", rec["user"], error=rec["error"])
+                # restart: the journal keeps the user in-flight.  The
+                # ``final`` marker distinguishes it from a backoff-requeue
+                # fail so a fabric coordinator tailing this journal knows
+                # THIS server is done with the user (restart replay
+                # deliberately ignores the marker)
+                self._journal("fail", rec["user"], error=rec["error"],
+                              final=True)

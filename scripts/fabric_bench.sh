@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Multi-host fabric resilience throughput (ISSUE 5 CI drill; the
+# multi-host sibling of scripts/serve_fault_bench.sh).
+#
+# Runs `bench.py --suite fabric`: a 2-host fabric (coordinator
+# in-process, worker subprocesses over the shared synthetic workload)
+# serves --users users; the moment the journal shows host h0 admitted a
+# user, h0 is SIGKILLed — its in-flight users must resume on the
+# survivor from their durable workspaces and its queued users re-enqueue
+# in journal order, while journal compaction runs live at a small bound.
+# Sequential UNFAULTED runs are the ground truth: per-user trajectory
+# parity is asserted on every rep (reps are interleaved best-of per the
+# 2-vCPU drift protocol), then the JSON line reports recovered-users/sec
+# plus revocation/reassignment/compaction counts.
+#
+# The JSON line goes to stdout (redirect to BENCH_fabric_r<N>.json to
+# commit an artifact); the per-rep log goes to stderr.  Extra bench args
+# pass through, e.g.:
+#   scripts/fabric_bench.sh --users 6 --al-epochs 2 --reps 2
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+if [ "$#" -gt 0 ]; then
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py \
+        --suite fabric "$@"
+else
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py \
+        --suite fabric --users 8 --al-epochs 3 --hosts 2 --reps 2
+fi

@@ -16,7 +16,17 @@
 #   plus the 4-mode restart matrix, a SIGKILLed server restarted from
 #   serve_journal.jsonl must finish EVERY submitted user with results
 #   bit-identical to an uninterrupted run — journal recovery loses no
-#   user; the watchdog/backoff/poison/breaker drills ride along.
+#   user; the watchdog/backoff/poison/breaker drills (including the
+#   watchdog-expiry-counts-toward-breaker interaction) ride along.
+# - fabric kill matrix (tests/test_serve_fabric.py): a REAL 2-host
+#   fabric, drilled at every process boundary — SIGKILL the coordinator
+#   (restart replays the journal, orphan workers self-exit and are
+#   reaped), SIGKILL each worker in every acquisition mode (in-flight
+#   users resume on the survivor, queued users re-enqueue in journal
+#   order), a heartbeat-dead (hung) worker failed over on lease expiry,
+#   and journal compaction killed in BOTH rename windows — all asserting
+#   journal-driven recovery with per-user trajectories bit-identical to
+#   uninterrupted single-host runs.
 #
 # Extra pytest args pass through, e.g.:
 #   scripts/fault_matrix.sh -k kill_at_every_boundary
@@ -24,6 +34,6 @@ set -euo pipefail
 
 cd "$(dirname "$0")/.."
 JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
-  tests/test_serve_faults.py -v -m faults \
+  tests/test_serve_faults.py tests/test_serve_fabric.py -v -m faults \
   -p no:cacheprovider "$@"
 echo "fault matrix passed"

@@ -1,0 +1,78 @@
+"""Fabric worker subprocess entrypoint over the synthetic workload.
+
+Spawned by ``tests/test_serve_fabric.py`` and ``bench.py --suite fabric``
+(the production equivalent is the CLI's ``--fabric-worker`` re-exec):
+
+    python tests/fabric_worker.py FABRIC_DIR HOST_ID WS_ROOT MODE \
+        EPOCHS N_USERS LEASE_S TARGET_LIVE
+
+Runs one ``FleetServer`` fed from the coordinator's assignment file
+(``serve.hosts.run_worker``), persisting each finished user's result to
+``FABRIC_DIR/results_<HOST_ID>.jsonl`` (append + fsync — the parity
+assertions read these).  Fault rules arrive via the ``CETPU_FAULTS``
+environment variable (installed at package import), so chaos drills can
+wedge THIS worker's heartbeat or kill its steps without touching its
+peers.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main(argv) -> int:
+    (fabric_dir, host_id, ws_root, mode, epochs, n_users, lease_s,
+     target) = argv[:8]
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tests.fabric_workload import (
+        build_entry_factory,
+        configure_jax,
+        make_cfg,
+        user_specs,
+    )
+
+    configure_jax()
+    from consensus_entropy_tpu.fleet import FleetReport, FleetScheduler
+    from consensus_entropy_tpu.resilience.preemption import (
+        EXIT_PREEMPTED,
+        Preempted,
+        PreemptionGuard,
+    )
+    from consensus_entropy_tpu.serve import ServeConfig
+    from consensus_entropy_tpu.serve.hosts import run_worker
+
+    cfg = make_cfg(mode=mode, epochs=int(epochs))
+    specs = user_specs(int(n_users))
+    results_path = os.path.join(fabric_dir, f"results_{host_id}.jsonl")
+
+    def on_result(rec):
+        line = {"user": str(rec["user"]), "error": rec["error"],
+                "host": host_id, "t": round(time.time(), 3)}
+        if rec["result"] is not None:
+            line["result"] = {
+                "trajectory": rec["result"]["trajectory"],
+                "final_mean_f1": rec["result"]["final_mean_f1"]}
+        with open(results_path, "ab") as f:
+            f.write((json.dumps(line) + "\n").encode("utf-8"))
+            f.flush()
+            os.fsync(f.fileno())
+
+    scheduler = FleetScheduler(cfg, report=FleetReport(),
+                               scoring_by_width=True)
+    try:
+        with PreemptionGuard() as guard:
+            run_worker(fabric_dir, host_id,
+                       build_entry=build_entry_factory(ws_root, cfg, specs),
+                       scheduler=scheduler,
+                       config=ServeConfig(target_live=int(target)),
+                       on_result=on_result, lease_s=float(lease_s),
+                       preemption=guard)
+    except Preempted:
+        return EXIT_PREEMPTED
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,148 @@
+"""Synthetic multi-host fabric workload.
+
+Shared by the fabric tests (``tests/test_serve_fabric.py``), the worker
+subprocess entrypoint (``tests/fabric_worker.py``) and
+``bench.py --suite fabric``.  Deliberately self-contained (no pytest
+import, deterministic from seeds): worker subprocesses must rebuild the
+EXACT users the in-process sequential baselines were computed from, or
+the bit-identical parity assertions would be comparing different
+problems.  The generators mirror ``tests/test_fleet._user_data`` /
+``_committee`` (3 songs' pools, GNB+SGD host committees, float32
+checkpoints so resume replays bit-exactly).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+
+def configure_jax() -> None:
+    """Mirror ``tests/conftest.py``'s backend setup so worker subprocesses
+    compute bit-identically to the in-process baselines (8 virtual CPU
+    devices, partitionable threefry)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:  # this image's 0.4.37: XLA_FLAGS above applies
+        pass
+    jax.config.update("jax_threefry_partitionable", True)
+
+
+def make_cfg(mode: str = "mc", epochs: int = 2, queries: int = 4):
+    from consensus_entropy_tpu.config import ALConfig
+
+    # float32 checkpoints: resume (failover included) replays bit-exactly
+    return ALConfig(queries=queries, epochs=epochs, mode=mode, seed=7,
+                    ckpt_dtype="float32")
+
+
+def user_specs(n_users: int, n_songs: int = 30) -> list:
+    """``[(seed, user_id, n_songs), ...]`` — the canonical workload."""
+    return [(100 + i, f"u{i}", n_songs) for i in range(int(n_users))]
+
+
+def make_data(seed: int, uid: str, n_songs: int = 30, f: int = 10):
+    from consensus_entropy_tpu.al.loop import UserData
+    from consensus_entropy_tpu.models.committee import FramePool
+
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((4, f)).astype(np.float32) * 2.5
+    rows, sids, labels = [], [], {}
+    for i in range(n_songs):
+        sid = f"song{i:03d}"
+        c = int(rng.integers(0, 4))
+        labels[sid] = c
+        k = int(rng.integers(3, 7))
+        rows.append(centers[c]
+                    + rng.standard_normal((k, f)).astype(np.float32))
+        sids += [sid] * k
+    pool = FramePool(np.vstack(rows), sids)
+    counts = rng.integers(1, 30, size=(n_songs, 4))
+    hc = np.round(counts / counts.sum(1, keepdims=True),
+                  3).astype(np.float32)
+    return UserData(uid, pool, labels, hc_rows=hc)
+
+
+def make_committee(data, sgd_name: str = "sgd.it_0"):
+    from consensus_entropy_tpu.models.committee import Committee
+    from consensus_entropy_tpu.models.sklearn_members import (
+        GNBMember,
+        SGDMember,
+    )
+
+    X = data.pool.X
+    y = np.array([data.labels[s] for s in np.repeat(
+        data.pool.song_ids, data.pool.counts)], np.int32)
+    return Committee([GNBMember("gnb.it_0").fit(X, y),
+                      SGDMember(sgd_name, seed=0).fit(X, y)], [])
+
+
+def build_entry_factory(ws_root: str, cfg, specs):
+    """``build_entry(uid) -> FleetUser`` over persistent per-user
+    workspaces under ``ws_root``: a fresh workspace gets a fresh
+    committee, one holding mid-run state (the previous host's durable
+    checkpoints) resumes from its own files — the fabric failover path."""
+    from consensus_entropy_tpu.al import workspace
+    from consensus_entropy_tpu.fleet import FleetUser
+
+    by = {uid: (seed, uid, n) for seed, uid, n in specs}
+
+    def build_entry(uid):
+        seed, _, n = by[str(uid)]
+        data = make_data(seed, str(uid), n_songs=n)
+        fp = os.path.join(ws_root, f"fab_{uid}")
+        os.makedirs(fp, exist_ok=True)
+        if os.path.exists(os.path.join(fp, "al_state.json")):
+            committee = workspace.load_committee(fp)
+        else:
+            committee = make_committee(data)
+        return FleetUser(
+            str(uid), committee, data, fp, seed=cfg.seed,
+            committee_factory=lambda fp=fp: workspace.load_committee(fp))
+
+    return build_entry
+
+
+def sequential_baselines(ws_root: str, cfg, specs) -> dict:
+    """Uninterrupted single-host ground truth: ``{uid: result}`` from
+    ``ALLoop.run_user`` over the identical users and seeds."""
+    from consensus_entropy_tpu.al.loop import ALLoop
+
+    out = {}
+    for seed, uid, n in specs:
+        data = make_data(seed, uid, n_songs=n)
+        p = os.path.join(ws_root, f"seq_{uid}")
+        os.makedirs(p)
+        out[uid] = ALLoop(cfg).run_user(make_committee(data), data, p)
+    return out
+
+
+def read_results(fabric_dir: str) -> dict:
+    """``{uid: last result record}`` across every ``results_<host>.jsonl``
+    the workers wrote (an idempotent re-finish appends a second record —
+    the LAST one is the user's standing result)."""
+    recs = []
+    for fname in sorted(os.listdir(fabric_dir)):
+        if not (fname.startswith("results_")
+                and fname.endswith(".jsonl")):
+            continue
+        with open(os.path.join(fabric_dir, fname), "rb") as f:
+            for raw in f:
+                try:
+                    rec = json.loads(raw.decode("utf-8"))
+                except ValueError:
+                    continue  # torn tail from a killed worker
+                if isinstance(rec, dict) and "user" in rec:
+                    recs.append(rec)
+    recs.sort(key=lambda r: r.get("t", 0.0))
+    return {r["user"]: r for r in recs}

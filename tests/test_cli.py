@@ -304,6 +304,19 @@ def test_serve_flag_validation(synth_roots, capsys):
     assert amg_test.main(base + ["--serve", "2",
                                  "--failure-budget", "0"]) == 1
     assert ">= 1" in capsys.readouterr().out
+    # fabric + compaction + probe-budget flags are serve-only too
+    for flags in (["--hosts", "2"], ["--lease-s", "2"],
+                  ["--breaker-probes", "1"], ["--journal-compact-kb", "64"]):
+        assert amg_test.main(base + flags) == 1
+        assert "requires --serve" in capsys.readouterr().out
+    assert amg_test.main(base + ["--serve", "2", "--hosts", "0"]) == 1
+    assert ">= 1" in capsys.readouterr().out
+    assert amg_test.main(base + ["--serve", "2", "--hosts", "2",
+                                 "--no-serve-journal"]) == 1
+    assert "source of truth" in capsys.readouterr().out
+    assert amg_test.main(base + ["--serve", "2",
+                                 "--fabric-worker", "h0"]) == 1
+    assert "internal" in capsys.readouterr().out
 
 
 @pytest.mark.slow
@@ -335,7 +348,8 @@ def test_serve_cli_matches_sequential(synth_roots, capsys):
     serve_files = {"fleet_metrics.jsonl", "serve_journal.jsonl",
                    "serve_poison.jsonl"}
     assert sorted(f for f in os.listdir(serve_users)
-                  if f not in serve_files) == uids
+                  if f not in serve_files
+                  and not f.endswith((".lock", ".ckpt"))) == uids
     # the admission journal shows every user enqueued/admitted/finished
     jrecs = [json.loads(l) for l in
              open(os.path.join(serve_users, "serve_journal.jsonl"))]
@@ -359,6 +373,58 @@ def test_serve_cli_matches_sequential(synth_roots, capsys):
     assert amg_test.main(al + ["--serve", "2", "--bucket-widths", "32,64",
                                "--models-root", serve_mr] + flags) == 0
     assert "Skipping user" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+@pytest.mark.serve
+def test_fabric_cli_matches_sequential(synth_roots, capsys):
+    """``--serve 2 --hosts 2`` end to end: the coordinator re-execs this
+    CLI as two worker processes over the shared synthetic tree; per-user
+    workspaces/metrics are identical to the sequential CLI, the journal
+    records leases + per-host admits, and a rerun resolves instantly
+    (everyone finished, no workers spawned)."""
+    import shutil
+
+    flags = ["--deam-root", synth_roots["deam"],
+             "--amg-root", synth_roots["amg"], "--device", "cpu"]
+    seq_mr = os.path.join(synth_roots["models"], "seqf")
+    fab_mr = os.path.join(synth_roots["models"], "fabric")
+    for model in ("gnb", "sgd"):
+        assert deam_classifier.main(
+            ["-cv", "2", "-m", model, "--models-root", seq_mr] + flags) == 0
+    shutil.copytree(os.path.join(seq_mr, "pretrained"),
+                    os.path.join(fab_mr, "pretrained"))
+    al = ["-q", "4", "-e", "2", "-m", "mc", "-n", "10", "--max-users", "3"]
+    assert amg_test.main(al + ["--models-root", seq_mr] + flags) == 0
+    fab = al + ["--serve", "2", "--hosts", "2", "--lease-s", "5",
+                "--journal-compact-kb", "64", "--models-root", fab_mr]
+    assert amg_test.main(fab + flags) == 0
+    out = capsys.readouterr().out
+    assert "fabric summary:" in out
+    seq_users = os.path.join(seq_mr, "users")
+    fab_users = os.path.join(fab_mr, "users")
+    uids = sorted(os.listdir(seq_users))
+    for uid in uids:
+        fd = os.path.join(fab_users, uid, "mc")
+        assert os.path.exists(os.path.join(fd, "DONE"))
+        seq_recs = [json.loads(l) for l in open(
+            os.path.join(seq_users, uid, "mc", "metrics.jsonl"))]
+        fab_recs = [json.loads(l)
+                    for l in open(os.path.join(fd, "metrics.jsonl"))]
+        assert fab_recs == seq_recs
+    from consensus_entropy_tpu.serve import AdmissionJournal
+
+    st = AdmissionJournal(
+        os.path.join(fab_users, "serve_journal.jsonl")).state
+    assert st.finished == set(uids) and not st.pending
+    assert set(st.hosts) == {"h0", "h1"}
+    assert set(st.assigned.values()) <= {"h0", "h1"}
+    # per-worker engine telemetry landed beside the shared journal
+    assert os.path.exists(os.path.join(fab_users,
+                                       "fleet_metrics_h0.jsonl"))
+    # rerun: the journal resolves everyone up front — no workers spawned
+    assert amg_test.main(fab + flags) == 0
+    assert '"users": 0' in capsys.readouterr().out
 
 
 def test_pretrain_classic_parallel_folds_match_sequential(tmp_path, rng):

@@ -1,0 +1,594 @@
+"""Multi-host serve fabric: journal-coordinated sharding + lease failover.
+
+The headline drill runs a REAL 2-host fabric (worker subprocesses over
+the synthetic ``tests/fabric_workload`` users), SIGKILLs one worker
+mid-iteration, and asserts the coordinator recovers EVERY user — finished
+skipped, in-flight resumed on the survivor from their durable
+workspaces, queued re-enqueued in journal order — with per-user
+trajectories bit-identical to uninterrupted single-host runs.  Tier-1
+keeps the pure-host units (fabric journal records, compaction incl. the
+kill-between-renames window, torn-tail repair, unpoison, breaker probe
+budget, lease heartbeat) plus ONE 2-host mc kill case (the acceptance
+pin); the 4-mode matrix, the coordinator-SIGKILL restart and the
+lease-expiry hang drill are ``slow`` and run via
+``scripts/fault_matrix.sh``.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from consensus_entropy_tpu.fleet import FleetReport, FleetScheduler
+from consensus_entropy_tpu.resilience import faults
+from consensus_entropy_tpu.resilience.faults import FaultRule, InjectedKill
+from consensus_entropy_tpu.serve import (
+    AdmissionJournal,
+    DispatchBreaker,
+    FabricConfig,
+    FabricCoordinator,
+    HostLease,
+    JournalState,
+    JsonlTail,
+    PoisonList,
+)
+from consensus_entropy_tpu.serve.hosts import (
+    fabric_paths,
+    lease_age_s,
+    read_lease,
+)
+from tests.fabric_workload import (
+    make_cfg,
+    read_results,
+    sequential_baselines,
+    user_specs,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.faults]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "fabric_worker.py")
+
+
+# -- pure-host units (no subprocesses) -------------------------------------
+
+
+def test_journal_fabric_records_and_roundtrip(tmp_path):
+    """assign/lease/revoke ride the journal without touching admission
+    dispositions; the state checkpoint round-trips losslessly."""
+    jp = str(tmp_path / "j.jsonl")
+    with AdmissionJournal(jp) as j:
+        for u in ("a", "b", "c"):
+            j.append("enqueue", u)
+        j.append("lease", host="h0", pid=1)
+        j.append("lease", host="h1", pid=2)
+        j.append("assign", "a", host="h0")
+        j.append("assign", "b", host="h1")
+        j.append("assign", "c", host="h0")
+        j.append("admit", "a", host="h0", src_off=64)
+        j.append("revoke", host="h0", reason="drill")
+        j.append("assign", "a", host="h1")
+        j.append("assign", "c", host="h1")
+    st = AdmissionJournal(jp).state
+    assert st.hosts == {"h0": "revoke", "h1": "lease"}
+    assert st.live_hosts() == ["h1"]
+    assert st.assigned == {"a": "h1", "b": "h1", "c": "h1"}
+    assert st.host_cursor == {"h0": 64}
+    # assign never changed dispositions: a in-flight, b/c still queued
+    assert st.in_flight == ["a"] and st.queued == ["b", "c"]
+    # failover order: in-flight first, then queued in enqueue order
+    assert st.assigned_to("h1") == ["a", "b", "c"]
+    rt = JournalState.from_dict(st.to_dict())
+    assert rt.to_dict() == st.to_dict()
+    with pytest.raises(ValueError, match="needs host"):
+        AdmissionJournal(None).append("lease")
+    with pytest.raises(ValueError, match="needs a user"):
+        AdmissionJournal(None).append("enqueue")
+
+
+def test_journal_compaction_bounds_size_across_cycles(tmp_path):
+    """≥3 checkpoint-truncate cycles keep the WAL below its bound while
+    the replayed state stays complete — order included."""
+    jp = str(tmp_path / "j.jsonl")
+    j = AdmissionJournal(jp, compact_bytes=600)
+    for i in range(200):
+        j.append("enqueue", f"user_{i:04d}")
+    j.append("admit", "user_0000")
+    assert j.compactions >= 3
+    assert os.path.getsize(jp) <= 600 + 200  # bound + one-record overshoot
+    assert os.path.exists(j.ckpt_path)
+    j.close()
+    st = AdmissionJournal(jp).state
+    assert st.in_flight == ["user_0000"]
+    assert len(st.queued) == 199
+    assert st.queued[:2] == ["user_0001", "user_0002"]  # order preserved
+
+
+def test_journal_compaction_kill_windows_recover_losslessly(tmp_path):
+    """A kill in EITHER compaction window — before the checkpoint rename,
+    or between it and the journal truncation — replays to the identical
+    state (seq-deduped), and the next compaction completes normally."""
+    jp = str(tmp_path / "j.jsonl")
+    j = AdmissionJournal(jp)
+    for i in range(6):
+        j.append("enqueue", f"u{i}")
+    j.append("admit", "u0")
+    j.append("finish", "u0")
+    expect = j.state.to_dict()
+    # window 2: ckpt renamed, journal NOT truncated (stale tail on disk)
+    with faults.inject(FaultRule("fabric.compact", "kill", at=2)) as inj:
+        with pytest.raises(InjectedKill):
+            j.compact()
+        assert inj.fired
+    j.close()
+    assert os.path.exists(jp + ".ckpt") and os.path.getsize(jp) > 0
+    j2 = AdmissionJournal(jp)
+    assert j2.state.to_dict() == expect  # stale records deduped by seq
+    # window 1: before the checkpoint write — nothing changed
+    with faults.inject(FaultRule("fabric.compact", "kill", at=1)):
+        with pytest.raises(InjectedKill):
+            j2.compact()
+    j2.close()
+    j3 = AdmissionJournal(jp)
+    assert j3.state.to_dict() == expect
+    j3.compact()  # a clean compaction still works after both crashes
+    assert os.path.getsize(jp) == 0
+    j3.append("enqueue", "zz")
+    j3.close()
+    st = AdmissionJournal(jp).state
+    assert st.to_dict()["last"]["zz"] == "enqueue"
+    assert st.finished == {"u0"} and len(st.queued) == 6
+
+
+def test_journal_ckpt_skips_legacy_seqless_lines(tmp_path):
+    """A crash between compaction's two renames over a journal that
+    still holds PRE-SEQ (legacy-writer) lines must not re-apply those
+    lines on top of the checkpoint: they predate it by construction, and
+    replaying them would regress dispositions (a finished user back to
+    admitted) and double-count the failure budget."""
+    import json as _json
+
+    jp = str(tmp_path / "j.jsonl")
+    # a legacy journal: no seq fields (the committed pre-compaction code)
+    with open(jp, "wb") as f:
+        for ev in ({"event": "enqueue", "user": "a"},
+                   {"event": "admit", "user": "a"}):
+            f.write((_json.dumps(ev) + "\n").encode())
+    j = AdmissionJournal(jp)
+    assert j.state.in_flight == ["a"] and j.state.admits == {"a": 1}
+    j.append("finish", "a")  # new writer: seq'd record
+    # crash between the checkpoint rename and the journal truncation:
+    # the new ckpt coexists with the FULL stale journal (legacy lines
+    # included)
+    with faults.inject(FaultRule("fabric.compact", "kill", at=2)):
+        with pytest.raises(InjectedKill):
+            j.compact()
+    j.close()
+    st = AdmissionJournal(jp).state
+    assert st.finished == {"a"} and not st.pending  # finish NOT regressed
+    assert st.admits == {"a": 1}  # budget not double-counted
+
+
+def test_journal_single_writer_lock(tmp_path):
+    """The append-fsync WAL is single-writer by ENFORCEMENT: a second
+    live writer (the --unpoison-vs-running-server hazard) raises instead
+    of interleaving seq numbers; read-only replays never take the lock,
+    and close releases it."""
+    from consensus_entropy_tpu.serve import SingleWriterViolation
+
+    jp = str(tmp_path / "j.jsonl")
+    j = AdmissionJournal(jp)
+    j.append("enqueue", "a")
+    second = AdmissionJournal(jp)  # replay-only: allowed
+    assert second.state.queued == ["a"]
+    with pytest.raises(SingleWriterViolation):
+        second.append("enqueue", "b")
+    # compaction rotates the data handle but KEEPS the lock
+    j.compact()
+    with pytest.raises(SingleWriterViolation):
+        second.append("enqueue", "b")
+    j.close()
+    second.append("enqueue", "b")  # lock released: new writer may own it
+    second.close()
+    st = AdmissionJournal(jp).state
+    assert st.queued == ["a", "b"]
+
+
+def test_journal_torn_tail_repair_preserves_next_append(tmp_path):
+    """A journal whose last line is torn (died mid-append) must not
+    swallow the first post-restart append into the torn line."""
+    jp = str(tmp_path / "j.jsonl")
+    with AdmissionJournal(jp) as j:
+        j.append("enqueue", "a")
+    with open(jp, "ab") as f:
+        f.write(b'{"event": "enq')  # the crash artifact
+    with AdmissionJournal(jp) as j2:
+        j2.append("enqueue", "b")
+    st = AdmissionJournal(jp).state
+    assert st.queued == ["a", "b"]  # b survived the torn neighbour
+
+
+def test_poison_list_torn_tail_repair(tmp_path):
+    """The poison list replays across a torn tail line exactly like the
+    main journal does, and a post-restart add is NOT merged into (and
+    lost with) the torn line."""
+    pp = str(tmp_path / "p.jsonl")
+    p = PoisonList(pp)
+    p.add("a", error="e1", attempts=2)
+    p.add("b", error="e2", attempts=3)
+    p.close()
+    with open(pp, "ab") as f:
+        f.write(b'{"user": "c", "err')  # torn mid-append
+    p2 = PoisonList(pp)
+    assert "a" in p2 and "b" in p2 and "c" not in p2
+    p2.add("d", error="e3", attempts=1)
+    p2.close()
+    p3 = PoisonList(pp)
+    assert "d" in p3 and "a" in p3 and "b" in p3 and len(p3) == 3
+
+
+def test_unpoison_resets_user_and_budget(tmp_path):
+    """An ``unpoison`` record clears the poisoned disposition AND the
+    replayed failure-budget counters, making the user submittable again
+    in its given order."""
+    jp = str(tmp_path / "j.jsonl")
+    with AdmissionJournal(jp) as j:
+        j.append("enqueue", "x")
+        j.append("admit", "x")
+        j.append("fail", "x", error="e")
+        j.append("poison", "x", error="e", attempts=3)
+    st = AdmissionJournal(jp).state
+    assert st.poisoned == {"x"}
+    assert st.recovery_order(["x", "y"]) == ["y"]  # poisoned dropped
+    with AdmissionJournal(jp) as j:
+        j.append("unpoison", "x")
+    st = AdmissionJournal(jp).state
+    assert st.poisoned == set()
+    assert st.admits == {} and st.fails == {}  # fresh budget
+    assert st.recovery_order(["x", "y"]) == ["x", "y"]
+
+
+def test_unpoison_cli_roundtrip(tmp_path, capsys):
+    """``--unpoison`` removes via journaled records (poison file AND the
+    admission journal) and exits nonzero for unknown users."""
+    from consensus_entropy_tpu.cli.amg_test import main
+
+    users_dir = tmp_path / "users"
+    users_dir.mkdir()
+    p = PoisonList(str(users_dir / "serve_poison.jsonl"))
+    p.add("u7", error="boom", attempts=3)
+    p.close()
+    with AdmissionJournal(str(users_dir / "serve_journal.jsonl")) as j:
+        j.append("poison", "u7", error="boom", attempts=3)
+    base = ["-q", "1", "-e", "1", "-n", "1", "-m", "mc",
+            "--models-root", str(tmp_path)]
+    assert main(base + ["--unpoison", "u7"]) == 0
+    assert "unpoisoned user u7" in capsys.readouterr().out
+    assert "u7" not in PoisonList(str(users_dir / "serve_poison.jsonl"))
+    st = AdmissionJournal(str(users_dir / "serve_journal.jsonl")).state
+    assert st.poisoned == set() and st.last["u7"] == "unpoison"
+    assert main(base + ["--unpoison", "u7"]) == 1  # no longer on the list
+
+
+def test_breaker_probe_budget_gives_width_up():
+    """After ``probe_budget`` failed half-open probes the width stays
+    per-user for the run (no more probes) and the giveup lands in the
+    telemetry events + summary."""
+    clock = [0.0]
+    breaker = DispatchBreaker(1, 1.0, probe_budget=1,
+                              clock=lambda: clock[0])
+    report = FleetReport()
+    sched = FleetScheduler(make_cfg("mc"), report=report, breaker=breaker)
+    sched._note_stacked_failure("mc", 32, RuntimeError("boom"))
+    assert breaker.state_of(32) == "open"
+    clock[0] = 2.0
+    assert breaker.allow_stacked(32)  # the half-open probe
+    sched._note_stacked_failure("mc", 32, RuntimeError("boom"))
+    assert breaker.state_of(32) == "gave_up"
+    clock[0] = 100.0
+    assert not breaker.allow_stacked(32)  # no probes ever again
+    assert breaker.allow_stacked(64)  # other widths unaffected
+    assert breaker.summary() == {32: "gave_up"}
+    evs = [e["event"] for e in report.events]
+    assert "breaker_open" in evs and "breaker_giveup" in evs
+    s = report.summary(cohort=2)
+    assert s["breaker_giveups"] == 1
+    with pytest.raises(ValueError):
+        DispatchBreaker(1, 1.0, probe_budget=-1)
+
+
+def test_host_lease_beat_read_age_and_fault_point(tmp_path):
+    lp = str(tmp_path / "lease.json")
+    lease = HostLease(lp, "h0", 0.1)
+    lease.beat_once()
+    rec = read_lease(lp)
+    assert rec["host"] == "h0" and rec["pid"] == os.getpid()
+    assert rec["beat"] == 1
+    assert 0 <= lease_age_s(lp) < 5.0
+    # the fault point fires BEFORE the write: a killed beat leaves the
+    # previous lease on disk, which then goes stale (the failover
+    # signal).  at=1: hit counters are injector-local, so the first beat
+    # under this injector is hit 1 regardless of earlier beats.
+    with faults.inject(FaultRule("fabric.lease", "kill", at=1)) as inj:
+        with pytest.raises(InjectedKill):
+            lease.beat_once()
+        assert inj.fired
+    assert read_lease(lp)["beat"] == 1
+    assert read_lease(str(tmp_path / "missing.json")) is None
+    assert lease_age_s(str(tmp_path / "missing.json")) is None
+    with pytest.raises(ValueError):
+        HostLease(lp, "h0", 0)
+
+
+def test_jsonl_tail_partial_lines_and_seek(tmp_path):
+    tp = str(tmp_path / "t.jsonl")
+    t = JsonlTail(tp)
+    assert t.poll() == []  # not yet created
+    with open(tp, "wb") as f:
+        f.write(b'{"a": 1}\n{"b": 2}\nnot json\n{"c":')
+    assert [r for r, _ in t.poll()] == [{"a": 1}, {"b": 2}]
+    assert t.poll() == []  # the half line stays unconsumed
+    with open(tp, "ab") as f:
+        f.write(b' 3}\n')
+    polled = t.poll()
+    assert [r for r, _ in polled] == [{"c": 3}]
+    off = polled[-1][1]
+    t2 = JsonlTail(tp)
+    t2.seek(off)
+    assert t2.poll() == []  # cursor resume: nothing new past off
+    t.close()
+    t2.close()
+
+
+# -- the 2-host kill drill -------------------------------------------------
+
+
+def _spawn_factory(fabric_dir, ws_root, cfg, n_users, *, lease_s=5.0,
+                   target=2, env_extra=None):
+    def spawn(host_id):
+        log = open(fabric_paths(fabric_dir, host_id)["log"], "ab")
+        env = {**os.environ, "PYTHONPATH": REPO}
+        env.pop("CETPU_FAULTS", None)  # in-process rules stay in-process
+        env.update((env_extra or {}).get(host_id, {}))
+        try:
+            return subprocess.Popen(
+                [sys.executable, WORKER, fabric_dir, host_id, ws_root,
+                 cfg.mode, str(cfg.epochs), str(n_users), str(lease_s),
+                 str(target)],
+                stdout=log, stderr=subprocess.STDOUT, env=env)
+        finally:
+            log.close()
+    return spawn
+
+
+def _with_deadline(inner=None, deadline_s=300.0):
+    """on_poll hook: optional chaos + a hard drill deadline so a wedged
+    fabric fails the test (killing its workers) instead of eating the
+    whole tier-1 budget."""
+    t0 = time.monotonic()
+
+    def hook(coord):
+        if time.monotonic() - t0 > deadline_s:
+            raise AssertionError(
+                f"fabric drill exceeded {deadline_s}s; journal state: "
+                f"unresolved={sorted(coord._unresolved)}")
+        if inner is not None:
+            inner(coord)
+    return hook
+
+
+def _kill_on_first_admit(host_id="h0"):
+    """SIGKILL ``host_id`` the moment the journal shows it admitted a
+    user — i.e. mid-iteration, with in-flight AND queued users on the
+    host — driven by journal state, not wall clock."""
+    state = {"done": False}
+
+    def chaos(coord):
+        if state["done"]:
+            return
+        st = coord.journal.state
+        if any(h == host_id and st.last.get(u) == "admit"
+               for u, h in st.assigned.items()):
+            coord.hosts[host_id].proc.kill()
+            state["done"] = True
+    return chaos
+
+
+def _fabric_kill_drill(tmp_path, mode, *, n_users=3, epochs=2,
+                       compact_bytes=800, victim="h0"):
+    """Run the 2-host fabric over ``n_users``, SIGKILL ``victim`` after
+    its first admission, assert total recovery + bit-identical parity."""
+    cfg = make_cfg(mode, epochs=epochs)
+    specs = user_specs(n_users)
+    seq = sequential_baselines(str(tmp_path), cfg, specs)
+    fabric_dir = str(tmp_path / "fabric")
+    os.makedirs(fabric_dir)
+    jp = os.path.join(fabric_dir, "serve_journal.jsonl")
+    journal = AdmissionJournal(jp, compact_bytes=compact_bytes)
+    report = FleetReport()
+    coord = FabricCoordinator(
+        journal, fabric_dir, FabricConfig(hosts=2, lease_s=5.0),
+        report=report,
+        on_poll=_with_deadline(_kill_on_first_admit(victim)))
+    try:
+        summary = coord.run([u for _, u, _ in specs],
+                            _spawn_factory(fabric_dir, str(tmp_path), cfg,
+                                           n_users))
+    finally:
+        journal.close()
+    assert sorted(summary["finished"]) == [u for _, u, _ in specs]
+    assert summary["failed"] == [] and summary["poisoned"] == []
+    assert summary["revocations"] == 1
+    assert summary["reassignments"] >= 1  # the victim's users moved over
+    assert summary["hosts"][victim] == "revoked"
+    results = read_results(fabric_dir)
+    for _, uid, _ in specs:
+        assert results[uid]["error"] is None
+        assert results[uid]["result"]["trajectory"] \
+            == seq[uid]["trajectory"]
+        assert results[uid]["result"]["final_mean_f1"] \
+            == seq[uid]["final_mean_f1"]
+    # the journal is the record: replay shows everyone finished, the dead
+    # host revoked, and compaction kept the WAL bounded
+    st = AdmissionJournal(jp).state
+    assert st.finished == {u for _, u, _ in specs}
+    assert not st.pending
+    survivor = "h1" if victim == "h0" else "h0"
+    assert st.hosts[victim] == "revoke" and st.hosts[survivor] == "lease"
+    assert os.path.getsize(jp) <= compact_bytes + 300
+    return summary, report
+
+
+def test_fabric_two_hosts_worker_sigkill_recovers_all_users(tmp_path):
+    """THE acceptance pin (tier-1 case): a 2-host mc fabric with one
+    worker SIGKILLed mid-iteration recovers every user — in-flight
+    resumed on the survivor, queued re-enqueued in journal order — with
+    per-user trajectories bit-identical to uninterrupted single-host
+    runs, while journal compaction keeps the WAL bounded."""
+    summary, report = _fabric_kill_drill(tmp_path, "mc")
+    evs = [e["event"] for e in report.events]
+    assert "host_down" in evs and "assign" in evs
+    down = next(e for e in report.events if e["event"] == "host_down")
+    assert down["host"] == "h0" and down["reassigned"] >= 1
+    assert summary["compactions"] >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["hc", "mix", "rand"])
+def test_fabric_kill_matrix_all_modes(tmp_path, mode):
+    """Acceptance: the same worker-SIGKILL recovery is bit-identical in
+    every acquisition mode (mc is the tier-1 case above)."""
+    _fabric_kill_drill(tmp_path, mode)
+
+
+@pytest.mark.slow
+def test_fabric_kill_matrix_other_worker(tmp_path):
+    """The kill matrix covers EACH worker: losing h1 (the other shard)
+    recovers identically — failover is symmetric, not h0-special."""
+    _fabric_kill_drill(tmp_path, "mc", victim="h1")
+
+
+@pytest.mark.slow
+def test_fabric_lease_expiry_hang_fails_over(tmp_path):
+    """A worker whose heartbeat thread dies (injected kill at its 2nd
+    beat via CETPU_FAULTS — the engine itself keeps running, the classic
+    wedged-host shape) is SIGKILLed on lease expiry and its users fail
+    over; every user still finishes with sequential-identical results."""
+    cfg = make_cfg("mc", epochs=3)
+    specs = user_specs(4)
+    seq = sequential_baselines(str(tmp_path), cfg, specs)
+    fabric_dir = str(tmp_path / "fabric")
+    os.makedirs(fabric_dir)
+    jp = os.path.join(fabric_dir, "serve_journal.jsonl")
+    journal = AdmissionJournal(jp)
+    report = FleetReport()
+    coord = FabricCoordinator(
+        journal, fabric_dir, FabricConfig(hosts=2, lease_s=1.5),
+        report=report, on_poll=_with_deadline())
+    spawn = _spawn_factory(
+        fabric_dir, str(tmp_path), cfg, 4, lease_s=1.5,
+        env_extra={"h0": {"CETPU_FAULTS": "fabric.lease:kill@2"}})
+    try:
+        summary = coord.run([u for _, u, _ in specs], spawn)
+    finally:
+        journal.close()
+    assert summary["revocations"] == 1
+    down = next(e for e in report.events if e["event"] == "host_down")
+    assert down["host"] == "h0" and "lease expired" in down["reason"]
+    assert sorted(summary["finished"]) == [u for _, u, _ in specs]
+    results = read_results(fabric_dir)
+    for _, uid, _ in specs:
+        assert results[uid]["error"] is None
+        assert results[uid]["result"]["trajectory"] \
+            == seq[uid]["trajectory"]
+
+
+COORD_SCRIPT = '''\
+import os, subprocess, sys
+repo = {repo!r}
+sys.path.insert(0, repo)
+fabric_dir, ws_root, mode, epochs, n_users, lease_s = sys.argv[1:7]
+from tests.fabric_workload import configure_jax, user_specs
+configure_jax()
+from consensus_entropy_tpu.serve import (
+    AdmissionJournal, FabricConfig, FabricCoordinator)
+from consensus_entropy_tpu.serve.hosts import fabric_paths
+worker = os.path.join(repo, "tests", "fabric_worker.py")
+
+def spawn(host_id):
+    log = open(fabric_paths(fabric_dir, host_id)["log"], "ab")
+    try:
+        return subprocess.Popen(
+            [sys.executable, worker, fabric_dir, host_id, ws_root, mode,
+             epochs, n_users, lease_s, "2"],
+            stdout=log, stderr=subprocess.STDOUT,
+            env={{**os.environ, "PYTHONPATH": repo}})
+    finally:
+        log.close()
+
+journal = AdmissionJournal(
+    os.path.join(fabric_dir, "serve_journal.jsonl"), compact_bytes=8192)
+coord = FabricCoordinator(journal, fabric_dir,
+                          FabricConfig(hosts=2, lease_s=float(lease_s)))
+summary = coord.run([u for _, u, _ in user_specs(int(n_users))], spawn)
+journal.close()
+print("COORD_DONE", len(summary["finished"]), flush=True)
+'''
+
+
+@pytest.mark.slow
+def test_fabric_coordinator_sigkill_restart_recovers(tmp_path):
+    """SIGKILL the COORDINATOR mid-run: its workers orphan-exit (ppid
+    watch in the lease thread), and a rerun replays the journal — reaping
+    any straggler via the lease pid, skipping finished users, re-routing
+    the rest — to a complete, bit-identical fabric."""
+    cfg = make_cfg("mc", epochs=2)
+    specs = user_specs(3)
+    seq = sequential_baselines(str(tmp_path), cfg, specs)
+    fabric_dir = str(tmp_path / "fabric")
+    os.makedirs(fabric_dir)
+    script = tmp_path / "coord.py"
+    script.write_text(COORD_SCRIPT.format(repo=REPO))
+    argv = [sys.executable, str(script), fabric_dir, str(tmp_path), "mc",
+            "2", "3", "2.0"]
+    env = {**os.environ, "PYTHONPATH": REPO}
+    env.pop("CETPU_FAULTS", None)
+    jp = os.path.join(fabric_dir, "serve_journal.jsonl")
+    clog = open(str(tmp_path / "coord1.log"), "ab")
+    p1 = subprocess.Popen(argv, stdout=clog, stderr=subprocess.STDOUT,
+                          env=env)
+    clog.close()
+    try:
+        deadline = time.monotonic() + 300
+        killed = False
+        while time.monotonic() < deadline:
+            if p1.poll() is not None:
+                break  # finished before we could kill (degenerate; rare)
+            if os.path.exists(jp) \
+                    and b'"event": "admit"' in open(jp, "rb").read():
+                p1.kill()  # SIGKILL mid-run, with users in flight
+                killed = True
+                break
+            time.sleep(0.1)
+        p1.wait(timeout=30)
+        assert killed or p1.returncode == 0
+    finally:
+        if p1.poll() is None:
+            p1.kill()
+            p1.wait()
+    # give the orphaned workers one heartbeat interval to self-exit; the
+    # rerun's lease-pid reaper covers any straggler
+    time.sleep(2.5)
+    out = subprocess.run(argv, capture_output=True, text=True, env=env,
+                         timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "COORD_DONE 3" in out.stdout
+    results = read_results(fabric_dir)
+    for _, uid, _ in specs:
+        assert results[uid]["error"] is None
+        assert results[uid]["result"]["trajectory"] \
+            == seq[uid]["trajectory"]
+    st = AdmissionJournal(jp).state
+    assert st.finished == {u for _, u, _ in specs} and not st.pending

@@ -440,6 +440,48 @@ def test_serve_breaker_opens_degrades_and_recovers(tmp_path):
 
 
 @pytest.mark.slow
+def test_serve_watchdog_expiry_counts_toward_breaker(tmp_path):
+    """Watchdog × breaker interaction: a STACKED dispatch that blows the
+    watchdog deadline (injected delay far past it) must count toward the
+    bucket's breaker exactly like an exception-failed dispatch — the
+    breaker opens at threshold 1 — and the batch must still fall back to
+    per-user dispatch with nobody evicted and sequential-identical
+    results."""
+    cfg = _cfg(mode="mc", epochs=2)
+    specs = [(110, "wb0", 30), (111, "wb1", 30)]
+    seq = _seq_baselines(tmp_path, cfg, specs)
+    with faults.inject(FaultRule("serve.dispatch", "delay", at=1,
+                                 delay_s=3.0)) as inj:
+        report = FleetReport()
+        breaker = DispatchBreaker(1, 60.0)  # one failure opens; no probe
+        # batch_window_s phase-aligns both sessions so the delayed (and
+        # watchdog-expired) dispatch is the STACKED one; the 1s deadline
+        # clears legit host steps and the warm single-user fns by a wide
+        # margin on the throttled box
+        sched = FleetScheduler(cfg, report=report, scoring_by_width=True,
+                               breaker=breaker, batch_window_s=5.0,
+                               watchdog=Watchdog(1.0))
+        server = FleetServer(sched, ServeConfig(target_live=2))
+        recs = server.serve(iter(_entries(tmp_path, cfg, specs)))
+    assert inj.fired
+    evs = [e["event"] for e in report.events]
+    # the expiry was recorded as a dispatch failure AND tripped the
+    # breaker: the width is degraded, not probed (cooldown far away)
+    assert "dispatch_failed" in evs and "breaker_open" in evs
+    assert "evict" not in evs  # per-user fallback isolated the expiry
+    assert sched.watchdog.trips >= 1
+    assert breaker.trips == 1 and breaker.state_of(32) == "open"
+    failed = next(e for e in report.events
+                  if e["event"] == "dispatch_failed")
+    assert "WatchdogTimeout" in failed["error"]
+    for s, r in zip(seq, recs):
+        assert r["error"] is None
+        assert r["result"]["trajectory"] == s["trajectory"]
+    s = report.summary(cohort=2)
+    assert s["breaker_trips"] == 1 and s["dispatch_failures"] == 1
+
+
+@pytest.mark.slow
 def test_serve_dispatch_error_isolates_single_session(tmp_path):
     """A per-user dispatch failure evicts ONLY that session (generator
     error path → resume → backoff re-admission when resumes exhaust);
