@@ -1243,6 +1243,217 @@ def run_serve_faults_suite(args_ns) -> int:
     return 0
 
 
+def run_qbdc_suite(args_ns) -> int:
+    """QBDC (query-by-dropout-committee) vs the stored-committee mc path.
+
+    The paper's committee is ``--members`` (default 20) STORED CNN models
+    per user; qbdc is ONE CNN forwarded under K seeded dropout masks
+    (``Committee.qbdc_pool_probs``), so committee width is a vmap width
+    and per-user device memory is one weight set regardless of K.  This
+    suite measures, on an identical synthetic waveform workload:
+
+    - **K-sweep scoring throughput** (K in ``--qbdc-sweep``, default
+      8/20/64): AL scoring passes/sec of the qbdc chain (crop forward +
+      dropout heads + fused consensus->entropy->top-k) vs the 20-model
+      stored-committee mc chain — interleaved best-of ``--reps`` windows
+      (the throttled-image discipline the fleet suite uses).
+    - **per-user device memory**: parameter bytes a user's committee
+      pins in device memory — stored = M x member; qbdc = 1 x member at
+      EVERY K (the acceptance bound: K=64 below the 20-model footprint).
+    - **top-k overlap**: |top-k(qbdc) ∩ top-k(mc)| / k per K on the same
+      iteration key — how far the mask committee's ranking agrees with
+      the stored ensemble it replaces (different acquisition functions;
+      overlap quantifies, parity is not expected).
+    - **users/sec**: 2-user end-to-end AL runs (score -> select ->
+      reveal -> retrain -> eval), qbdc@20 vs stored-mc@20, interleaved
+      best-of reps.
+    """
+    import os
+    import shutil
+    import tempfile
+
+    import jax
+
+    # the CNN crop path requires prefix-stable threefry (this image's
+    # 0.4.37 defaults the flag off; tests/CLI set it the same way)
+    jax.config.update("jax_threefry_partitionable", True)
+
+    from consensus_entropy_tpu.al.loop import ALLoop, UserData
+    from consensus_entropy_tpu.config import ALConfig, CNNConfig, TrainConfig
+    from consensus_entropy_tpu.data.audio import DeviceWaveformStore
+    from consensus_entropy_tpu.models import short_cnn
+    from consensus_entropy_tpu.models.committee import (
+        CNNMember,
+        Committee,
+        FramePool,
+    )
+    from consensus_entropy_tpu.ops import scoring as ops_scoring
+
+    cnn_cfg = CNNConfig(n_channels=8, n_mels=32, n_layers=5,
+                        input_length=8192)
+    tc = TrainConfig(batch_size=2)
+    stored_m = args_ns.members or 20
+    n_songs = args_ns.pool or 48
+    k = args_ns.k
+    sweep_ks = sorted(set(args_ns.qbdc_sweep))
+    reps = args_ns.reps
+    seed = 1987
+
+    def make_user(uid, u_seed):
+        rng = np.random.default_rng(u_seed)
+        centers = rng.standard_normal((4, 16)).astype(np.float32) * 2.5
+        rows, sids, labels = [], [], {}
+        for i in range(n_songs):
+            sid = f"song{i:03d}"
+            c = int(rng.integers(0, 4))
+            labels[sid] = c
+            kk = int(rng.integers(3, 7))
+            rows.append(centers[c]
+                        + rng.standard_normal((kk, 16)).astype(np.float32))
+            sids += [sid] * kk
+        pool = FramePool(np.vstack(rows), sids)
+        data = UserData(uid, pool, labels, hc_rows=None)
+        wrng = np.random.default_rng(u_seed + 7)
+        waves = {s: wrng.standard_normal(9000).astype(np.float32)
+                 for s in pool.song_ids}
+        data.store = DeviceWaveformStore(waves, cnn_cfg.input_length)
+        return data
+
+    def cnn_members(n):
+        return [CNNMember(f"cnn{i}", short_cnn.init_variables(
+            jax.random.key(seed + i), cnn_cfg), cnn_cfg, tc)
+            for i in range(n)]
+
+    def stored_committee():
+        return Committee([], cnn_members(stored_m), cnn_cfg, tc)
+
+    def qbdc_committee():
+        return Committee([], cnn_members(1), cnn_cfg, tc)
+
+    def param_bytes(committee):
+        return int(sum(
+            np.asarray(leaf).size * np.asarray(leaf).dtype.itemsize
+            for m in committee.cnn_members
+            for leaf in jax.tree.leaves(m.variables)))
+
+    data = make_user("u_score", seed)
+    songs = data.pool.song_ids
+    mask = np.ones(n_songs, bool)
+    fns = ops_scoring.make_scoring_fns(k=k)
+    stored = stored_committee()
+    single = qbdc_committee()
+    stored_bytes = param_bytes(stored)
+    qbdc_bytes = param_bytes(single)
+    _log(f"qbdc workload: {n_songs} songs, stored committee M={stored_m} "
+         f"({stored_bytes/1e6:.2f} MB/user), qbdc member "
+         f"({qbdc_bytes/1e6:.2f} MB/user), K sweep {sweep_ks}, k={k}")
+
+    def mc_pass(it):
+        key = jax.random.fold_in(jax.random.key(seed), it)
+        probs = stored.predict_songs_cnn(data.store, songs, key)
+        res = fns["mc"](probs, mask)
+        jax.block_until_ready(res.entropy)
+        return res
+
+    def qbdc_pass(it, kk):
+        key = jax.random.fold_in(jax.random.key(seed), it)
+        probs = single.qbdc_pool_probs(data.store, songs, key, k=kk)
+        res = fns["qbdc"](probs, mask)
+        jax.block_until_ready(res.entropy)
+        return res
+
+    passes = 3  # per timed window
+
+    def window(fn):
+        t0 = time.perf_counter()
+        for it in range(passes):
+            fn(1 + it)
+        return (time.perf_counter() - t0) / passes
+
+    # warm-up compiles (untimed), then interleaved best-of-reps windows
+    mc_res0 = mc_pass(0)
+    q_res0 = {kk: qbdc_pass(0, kk) for kk in sweep_ks}
+    best_mc = float("inf")
+    best_q = {kk: float("inf") for kk in sweep_ks}
+    for _ in range(reps):
+        best_mc = min(best_mc, window(mc_pass))
+        for kk in sweep_ks:
+            best_q[kk] = min(best_q[kk],
+                             window(lambda it, kk=kk: qbdc_pass(it, kk)))
+    _log(f"[stored mc M={stored_m}] {best_mc*1e3:.1f} ms/pass "
+         f"({1.0/best_mc:.2f} passes/s)")
+
+    def topk_set(res):
+        return set(np.asarray(res.indices).tolist())
+
+    sweep = {}
+    for kk in sweep_ks:
+        overlap = len(topk_set(q_res0[kk]) & topk_set(mc_res0)) / k
+        sweep[kk] = {
+            "passes_per_sec": round(1.0 / best_q[kk], 3),
+            "ms_per_pass": round(best_q[kk] * 1e3, 2),
+            "speedup_vs_stored_mc": round(best_mc / best_q[kk], 2),
+            "topk_overlap_vs_stored_mc": round(overlap, 3),
+            "device_param_bytes_per_user": qbdc_bytes,
+        }
+        _log(f"[qbdc K={kk}] {best_q[kk]*1e3:.1f} ms/pass "
+             f"({sweep[kk]['passes_per_sec']} passes/s, "
+             f"{sweep[kk]['speedup_vs_stored_mc']}x stored, overlap "
+             f"{sweep[kk]['topk_overlap_vs_stored_mc']})")
+
+    # -- end-to-end users/sec: 2-user AL runs, interleaved best-of-reps --
+    n_users = 2
+    al_users = [make_user(f"u{i}", seed + 10 + i) for i in range(n_users)]
+    cfg_mc = ALConfig(queries=k, epochs=args_ns.al_epochs, mode="mc",
+                      seed=seed, ckpt_dtype="float32")
+    cfg_q = ALConfig(queries=k, epochs=args_ns.al_epochs, mode="qbdc",
+                     seed=seed, ckpt_dtype="float32", qbdc_k=stored_m)
+    root = tempfile.mkdtemp(prefix="qbdc_bench_")
+    best_al = {"stored_mc": float("inf"), "qbdc": float("inf")}
+    try:
+        for rep in range(reps):
+            for tag, cfg, com_fn in (
+                    ("stored_mc", cfg_mc, stored_committee),
+                    ("qbdc", cfg_q, qbdc_committee)):
+                loop = ALLoop(cfg, retrain_epochs=1)
+                t0 = time.perf_counter()
+                for i, u in enumerate(al_users):
+                    p = os.path.join(root, f"{tag}_{rep}_{i}")
+                    os.makedirs(p)
+                    loop.run_user(com_fn(), u, p, seed=cfg.seed)
+                best_al[tag] = min(best_al[tag],
+                                   time.perf_counter() - t0)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    ups = {tag: n_users / s for tag, s in best_al.items()}
+    _log(f"[AL users/sec] stored mc {ups['stored_mc']:.3f}, "
+         f"qbdc@{stored_m} {ups['qbdc']:.3f} "
+         f"({ups['qbdc']/ups['stored_mc']:.2f}x)")
+
+    k64 = max(sweep_ks)
+    print(json.dumps({
+        "metric": f"qbdc_users_per_sec_{n_users}u_K{stored_m}",
+        "value": round(ups["qbdc"], 4),
+        "unit": "users/s",
+        "vs_baseline": round(ups["qbdc"] / ups["stored_mc"], 2),
+        "stored_mc_users_per_sec": round(ups["stored_mc"], 4),
+        "al_epochs": args_ns.al_epochs,
+        "queries": k,
+        "n_songs": n_songs,
+        "stored_members": stored_m,
+        "stored_committee_param_bytes_per_user": stored_bytes,
+        "qbdc_param_bytes_per_user": qbdc_bytes,
+        # the acceptance bound: per-user device memory at the LARGEST K
+        # stays below the 20-model stored-committee footprint (qbdc
+        # weights don't scale with K; masks are transient activations)
+        "memory_at_max_K_below_stored": bool(qbdc_bytes < stored_bytes),
+        "max_K": k64,
+        "sweep": {str(kk): sweep[kk] for kk in sweep_ks},
+        **_provenance(),
+    }))
+    return 0
+
+
 def run_fabric_suite(args_ns) -> int:
     """Multi-host fabric resilience: recovered-users/sec with one worker
     host SIGKILLed mid-run.
@@ -1399,7 +1610,8 @@ def _mkdir(root, name):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--suite", choices=("linear", "cnn", "retrain", "fleet",
-                                        "serve", "serve-faults", "fabric"),
+                                        "serve", "serve-faults", "fabric",
+                                        "qbdc"),
                     default="linear",
                     help="linear: the north-star fused pool scoring; cnn: "
                          "Flax ShortChunkCNN committee inference "
@@ -1413,7 +1625,10 @@ def main(argv=None) -> int:
                          "backoff re-admission, circuit breaker); "
                          "fabric: recovered-users/sec of a multi-host "
                          "fabric with one worker SIGKILLed mid-run "
-                         "(journal failover + compaction)")
+                         "(journal failover + compaction); qbdc: "
+                         "dropout-committee scoring (K-sweep) + users/sec "
+                         "+ per-user memory vs the stored-committee mc "
+                         "path")
     ap.add_argument("--members", type=int, default=None,
                     help="committee size (default: 16 linear / 5 cnn)")
     ap.add_argument("--pool", type=int, default=None,
@@ -1465,6 +1680,10 @@ def main(argv=None) -> int:
                          "wall) is reported for both sides")
     ap.add_argument("--hosts", type=int, default=2,
                     help="fabric suite: worker host processes")
+    ap.add_argument("--qbdc-sweep", type=int, nargs="+",
+                    default=[8, 20, 64],
+                    help="qbdc suite: dropout-committee widths K to sweep "
+                         "against the stored-committee mc baseline")
     args_ns = ap.parse_args(argv)
 
     import jax
@@ -1481,6 +1700,10 @@ def main(argv=None) -> int:
     if args_ns.suite == "fabric":
         # multi-host: --users over --hosts workers, h0 killed mid-run
         return run_fabric_suite(args_ns)
+    if args_ns.suite == "qbdc":
+        # dropout committee vs stored committee; --pool is songs per user,
+        # --members the stored-committee size (default 20, the paper's)
+        return run_qbdc_suite(args_ns)
     if args_ns.suite == "cnn":
         # cnn-suite defaults: 5 members (paper committee), 48 crops per
         # pass — the first conv block's activations are ~75 MB per

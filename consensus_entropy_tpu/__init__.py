@@ -17,8 +17,14 @@ Architecture (TPU-first, not a port):
                   batched on TPU; classic sklearn members (GNB/SGD/XGB with
                   warm-start class preservation) stay host-side and feed logits
                   into the same on-device reduction.
-- ``al``        — the active-learning driver: acquisition modes mc/hc/mix/rand,
-                  per-user loop, incremental retraining, reporting, resume.
+- ``acquire``   — the acquisition registry: the paper's mc/hc/mix/rand plus
+                  qbdc (one CNN × K dropout masks) and wmc (reliability-
+                  weighted consensus) behind one strategy interface; new
+                  modes register once and ride the fleet/serve/resilience
+                  machinery unchanged.
+- ``al``        — the active-learning driver: per-user loop over the
+                  registered acquisition strategies, incremental
+                  retraining, reporting, resume.
 - ``data``      — host data layer: AMG1608 annotations + human-consensus table,
                   DEAM frame/annotation join, grouped splits, audio crop store.
 - ``train``     — DEAM pre-training (committee construction).

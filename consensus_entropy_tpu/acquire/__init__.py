@@ -1,0 +1,56 @@
+"""Pluggable acquisition subsystem: a registry of scoring strategies.
+
+Public surface:
+
+- :func:`get`, :func:`register`, :func:`available_modes` — the registry
+  (``acquire.base``).  ``al.acquisition.Acquirer`` resolves its mode here;
+  the CLI's ``--al-mode`` choices are :func:`available_modes`.
+- Built-in entries: the paper's ``mc`` / ``hc`` / ``mix`` / ``rand``
+  (``acquire.builtin``), plus ``qbdc`` — query-by-dropout-committee, one
+  CNN × K seeded dropout masks on device (``acquire.qbdc``) — and ``wmc``
+  — weighted machine consensus with per-member reliability weights
+  (``acquire.wmc``).
+
+Every registered mode rides the SAME engine seam (``scoring_inputs`` /
+``run_scoring`` / ``finish_select``), so it works sequentially, under
+``--fleet`` (vmapped cross-user dispatch), under ``--serve``/``--hosts``
+(per-bucket jit families, journal restart, kill matrix) and in the
+resilience harness without mode-specific plumbing.
+"""
+
+from consensus_entropy_tpu.acquire.base import (
+    AcquisitionStrategy,
+    available_modes,
+    get,
+    register,
+)
+from consensus_entropy_tpu.acquire.builtin import (
+    HumanConsensus,
+    MachineConsensus,
+    MixedConsensus,
+    RandomBaseline,
+)
+from consensus_entropy_tpu.acquire.qbdc import DropoutCommittee
+from consensus_entropy_tpu.acquire.wmc import WeightedMachineConsensus
+
+# registration order defines the CLI listing: the paper's four, then the
+# registry extensions
+register(MachineConsensus())
+register(HumanConsensus())
+register(MixedConsensus())
+register(RandomBaseline())
+register(DropoutCommittee())
+register(WeightedMachineConsensus())
+
+__all__ = [
+    "AcquisitionStrategy",
+    "available_modes",
+    "get",
+    "register",
+    "DropoutCommittee",
+    "HumanConsensus",
+    "MachineConsensus",
+    "MixedConsensus",
+    "RandomBaseline",
+    "WeightedMachineConsensus",
+]

@@ -1,0 +1,135 @@
+"""The acquisition-strategy interface and registry.
+
+Acquisition modes were an if-chain inside ``al.acquisition.Acquirer``
+(``scoring_inputs`` / ``finish_select`` branching on a mode string —
+mirroring the reference's ``amg_test.py:425-489`` dispatch).  This module
+turns them into REGISTERED STRATEGIES behind one seam, so a new mode (a
+dropout committee, a weighted consensus, a transfer-learning prior) drops
+into the whole stack — sequential loop, fleet vmapped dispatch, serve
+bucket families, kill-matrix/journal-restart harness — by implementing
+three methods and calling :func:`register`.
+
+A strategy is a STATELESS singleton: per-user state (masks, staged
+buffers, reliability weights) lives on the ``Acquirer`` the strategy
+receives; per-experiment parameters live in ``ALConfig``.  The split
+matches the engine seam PR 2 cut: ``scoring_inputs`` stages a device call
+(name + positional inputs) that schedulers may stack across users, and
+``extract_queries`` maps the scoring result back to song ids.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class AcquisitionStrategy:
+    """One acquisition mode's behavior behind the ``Acquirer`` seam.
+
+    Class attributes declare what the surrounding machinery must provide:
+
+    - ``needs_probs``: the AL loop computes a committee probs table
+      ``(M, n_live, C)`` before scoring (mc/mix/wmc/qbdc).
+    - ``probs_source``: which producer fills that table — ``"committee"``
+      (``Committee.pool_probs``: the stored-member stack) or ``"qbdc"``
+      (``Committee.qbdc_pool_probs``: one CNN × K dropout masks).
+    - ``uses_weights``: scoring consumes the acquirer's per-member
+      reliability weights (``Acquirer.member_weights``; the session
+      updates them from post-reveal agreement and persists them in
+      ``ALState``).
+    - ``uses_hc_table`` / ``uses_hc_entropy``: the acquirer commits the
+      human-consensus table (and its hoisted row entropies) to device at
+      construction, and ``replay``/``finish_select`` maintain the hc mask.
+    """
+
+    name: str = ""
+    needs_probs: bool = False
+    probs_source: str = "committee"
+    uses_weights: bool = False
+    uses_hc_table: bool = False
+    uses_hc_entropy: bool = False
+
+    def scoring_inputs(self, acq, member_probs=None, *, rand_key=None):
+        """Stage one device-scoring call: ``(fn_key, inputs)``.
+
+        ``fn_key`` names the jitted scorer (a key of
+        ``ops.scoring.make_scoring_fns`` and of every fleet/bucket
+        family); ``inputs`` is its positional argument tuple.  Mask
+        mutations are deferred to ``finish_select``."""
+        raise NotImplementedError
+
+    def extract_queries(self, acq, res) -> list:
+        """Map a ``ScoreResult`` back to song ids and apply any
+        mode-specific mask mutation (hc row removal, mix dedup).  The
+        common pool shrink happens in ``Acquirer.finish_select``."""
+        raise NotImplementedError
+
+
+# -- registry --------------------------------------------------------------
+
+_REGISTRY: dict[str, AcquisitionStrategy] = {}
+
+
+def register(strategy: AcquisitionStrategy) -> AcquisitionStrategy:
+    """Register a strategy under ``strategy.name``.  Re-registering a name
+    with a DIFFERENT object fails loud — two strategies silently shadowing
+    each other would make ``--al-mode`` runs irreproducible."""
+    name = strategy.name
+    if not name:
+        raise ValueError(f"{type(strategy).__name__} has no name")
+    prev = _REGISTRY.get(name)
+    if prev is not None and type(prev) is not type(strategy):
+        raise ValueError(
+            f"acquisition mode {name!r} is already registered to "
+            f"{type(prev).__name__}")
+    _REGISTRY[name] = strategy
+    return strategy
+
+
+def get(mode: str) -> AcquisitionStrategy:
+    try:
+        return _REGISTRY[mode]
+    except KeyError:
+        raise ValueError(
+            f"unknown mode {mode!r} (registered: "
+            f"{', '.join(available_modes())})") from None
+
+
+def available_modes() -> tuple[str, ...]:
+    """Registered mode names, registration-ordered (the paper's four
+    first, then extensions) — the CLI's ``--al-mode`` choices."""
+    return tuple(_REGISTRY)
+
+
+# -- shared device helpers -------------------------------------------------
+
+
+def _sanitize_member_rows_impl(p):
+    """Neutralize degenerate member rows before the entropy reduction.
+
+    A row (one member's class distribution for one song) is invalid when
+    it carries a non-finite value or sums to zero — one NaN row would
+    otherwise poison the consensus mean for that song and propagate
+    through ``ops.entropy`` into the ranking (zero rows NaN there too).
+    Invalid rows are replaced by the mean of the song's VALID rows, so the
+    downstream mean-over-members equals the mean renormalized over
+    surviving members — the same masking semantics member quarantine uses,
+    applied row-wise.  A song with no valid row at all becomes uniform
+    (maximally uncertain; behind ``pool_mask`` for padding rows, so only a
+    fully-degenerate live song is affected).  With every row valid the
+    output is bit-identical to the input, so unfaulted rankings are
+    unchanged.
+    """
+    p = jnp.asarray(p)
+    valid = (jnp.all(jnp.isfinite(p), axis=-1)
+             & (jnp.sum(p, axis=-1) > 0))[..., None]
+    safe = jnp.where(valid, p, 0.0)
+    cnt = jnp.sum(valid, axis=0)
+    fallback = jnp.where(cnt > 0, jnp.sum(safe, axis=0)
+                         / jnp.maximum(cnt, 1), 1.0 / p.shape[-1])
+    return jnp.where(valid, p, fallback[None])
+
+
+#: module-level jit: the cache is shared across every Acquirer instance /
+#: user (same rationale as the scoring-fn factories)
+sanitize_member_rows = jax.jit(_sanitize_member_rows_impl)

@@ -1,0 +1,95 @@
+"""The paper's four acquisition modes as registered strategies.
+
+Semantics are the reference's, unchanged (``amg_test.py:425-489``; the
+``Acquirer`` docstrings cite each line) — this module only relocates the
+mode dispatch from an if-chain into registry entries.  The staged inputs
+reference the acquirer's live mask arrays, so callers must score before
+finishing (the jit call copies on transfer).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from consensus_entropy_tpu.acquire.base import (
+    AcquisitionStrategy,
+    sanitize_member_rows,
+)
+
+
+class MachineConsensus(AcquisitionStrategy):
+    """mc: committee probs → mean → entropy → top-q (``amg_test.py:
+    425-447``)."""
+
+    name = "mc"
+    needs_probs = True
+
+    def scoring_inputs(self, acq, member_probs=None, *, rand_key=None):
+        return "mc", (sanitize_member_rows(acq._staged_probs(member_probs)),
+                      acq._feed(acq.pool_mask, 0))
+
+    def extract_queries(self, acq, res) -> list:
+        return acq._ids(res)
+
+
+class HumanConsensus(AcquisitionStrategy):
+    """hc: entropy of annotator-frequency rows, queried rows removed
+    (``amg_test.py:449-455``).  The production path scores hoisted
+    loop-invariant row entropies (``score_hc_precomputed``)."""
+
+    name = "hc"
+    uses_hc_table = True
+    uses_hc_entropy = True
+
+    def scoring_inputs(self, acq, member_probs=None, *, rand_key=None):
+        return "hc_pre", (acq._hc_ent_dev, acq._feed(acq.hc_mask, 0))
+
+    def extract_queries(self, acq, res) -> list:
+        q_songs = acq._ids(res)
+        acq._remove_hc(q_songs)  # amg_test.py:455
+        return q_songs
+
+
+class MixedConsensus(AcquisitionStrategy):
+    """mix: entropy over stacked [mc consensus; hc rows], ranked jointly
+    (``amg_test.py:457-484``)."""
+
+    name = "mix"
+    needs_probs = True
+    uses_hc_table = True
+
+    def scoring_inputs(self, acq, member_probs=None, *, rand_key=None):
+        return "mix", (sanitize_member_rows(acq._staged_probs(member_probs)),
+                       acq._feed(acq.pool_mask, 0),
+                       acq._hc_dev,
+                       acq._feed(acq.hc_mask, 0))
+
+    def extract_queries(self, acq, res) -> list:
+        from consensus_entropy_tpu.ops import scoring
+
+        is_hc, slots = scoring.split_mix_index(res.indices, acq.n_pad)
+        valid = np.asarray(res.values) > -np.inf
+        raw = [acq.songs[int(s)]
+               for s, ok in zip(np.asarray(slots), valid) if ok]
+        # the same song can surface from both blocks; the reference's
+        # isin-based batch build dedups implicitly (amg_test.py:491)
+        q_songs = list(dict.fromkeys(raw))
+        acq._remove_hc(q_songs)  # amg_test.py:484
+        return q_songs
+
+
+class RandomBaseline(AcquisitionStrategy):
+    """rand: uniform shuffle via top-k over uniform scores
+    (``amg_test.py:486-489``)."""
+
+    name = "rand"
+
+    def scoring_inputs(self, acq, member_probs=None, *, rand_key=None):
+        if rand_key is None:
+            acq._rand_key, rand_key = jax.random.split(acq._rand_key)
+        return "rand", (acq._feed_key(rand_key),
+                        acq._feed(acq.pool_mask, 0))
+
+    def extract_queries(self, acq, res) -> list:
+        return acq._ids(res)

@@ -1,0 +1,39 @@
+"""qbdc — query-by-dropout-committee (arxiv 1511.06412).
+
+The paper's committee is 20 STORED models per user — the storage/compute
+shape that makes million-user personalization implausible.  QBDC replaces
+the stored ensemble with ONE personalized CNN forwarded under K seeded
+dropout masks: committee size becomes a vmap width (``short_cnn.
+qbdc_infer`` — one trunk pass + K dropout heads), and per-user storage is
+one set of weights regardless of K.
+
+Scoring is mc's graph verbatim (the committee axis holds the K mask
+forwards; ``ops.scoring.score_qbdc``), so qbdc inherits the whole
+consensus-entropy machinery — sanitizer, staging scatter, fleet vmapped
+dispatch, per-bucket jit families — by registration alone.  The probs
+producer is ``Committee.qbdc_pool_probs``: mask keys are folded from the
+AL iteration's PRNG key (the ``acquire.qbdc.masks`` fault point fires at
+the sampler), so the dropout committee is deterministic and bit-identical
+across checkpoint resume, fleet eviction, and serve-journal restart.
+"""
+
+from __future__ import annotations
+
+from consensus_entropy_tpu.acquire.base import (
+    AcquisitionStrategy,
+    sanitize_member_rows,
+)
+
+
+class DropoutCommittee(AcquisitionStrategy):
+    name = "qbdc"
+    needs_probs = True
+    probs_source = "qbdc"
+
+    def scoring_inputs(self, acq, member_probs=None, *, rand_key=None):
+        return "qbdc", (
+            sanitize_member_rows(acq._staged_probs(member_probs)),
+            acq._feed(acq.pool_mask, 0))
+
+    def extract_queries(self, acq, res) -> list:
+        return acq._ids(res)

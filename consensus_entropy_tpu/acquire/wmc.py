@@ -1,0 +1,52 @@
+"""wmc — weighted machine consensus (arxiv 2011.06086, 2012.01988).
+
+Generalizes the PR 1 member-quarantine masks into per-member RELIABILITY
+WEIGHTS: the consensus mean becomes ``Σ w_m p_m / Σ w_m``
+(``ops.scoring.weighted_consensus_mean``), with the quarantine mask
+zeroing a member's weight BEFORE the renormalization so a quarantined
+member cannot re-enter through a stale weight.
+
+Weights start uniform (1.0 — exactly mc, pinned bit-identical) and are
+updated by the AL loop from POST-REVEAL AGREEMENT: after each query
+batch's labels are revealed, member m's weight moves by an EMA toward the
+fraction of queried songs it predicted correctly
+(``UserSession._update_member_weights``;
+``ALConfig.consensus_weighting`` / ``consensus_weight_alpha``).  Weights
+are keyed by member name, carried in ``ALState``, and restored on resume,
+so faulted runs replay bit-identically.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from consensus_entropy_tpu.acquire.base import (
+    AcquisitionStrategy,
+    sanitize_member_rows,
+)
+
+
+class WeightedMachineConsensus(AcquisitionStrategy):
+    name = "wmc"
+    needs_probs = True
+    uses_weights = True
+
+    def scoring_inputs(self, acq, member_probs=None, *, rand_key=None):
+        staged = sanitize_member_rows(acq._staged_probs(member_probs))
+        m = staged.shape[0]
+        w = acq.member_weights
+        if w is None:
+            w = np.ones(m, np.float32)  # uniform start: exactly mc
+        w = np.asarray(w, np.float32)
+        if w.shape != (m,):
+            raise ValueError(
+                f"member_weights shape {w.shape} does not match the "
+                f"{m}-member probs axis")
+        # the weights vector is committee-axis, not pool-axis: replicated
+        # feed under a mesh (the sharded wmc jit expects it replicated)
+        return "wmc", (staged, acq._feed(acq.pool_mask, 0),
+                       acq._feed_repl(jnp.asarray(w)))
+
+    def extract_queries(self, acq, res) -> list:
+        return acq._ids(res)

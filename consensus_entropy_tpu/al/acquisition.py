@@ -6,6 +6,12 @@ driver (``amg_test.py:425-489``): index↔song-id mapping, the hc table's
 block-concatenation, and the shrinking-pool mask — all while keeping every
 device shape fixed across the 10 AL iterations (one compile per mode per
 user-pool size class).
+
+Mode behavior itself lives in the ``consensus_entropy_tpu.acquire``
+registry: the ``Acquirer`` resolves its mode to a registered
+:class:`~consensus_entropy_tpu.acquire.AcquisitionStrategy` and provides
+the per-user machinery (padded masks, staged probs buffer, song-id
+mapping, reliability weights) the strategies operate on.
 """
 
 from __future__ import annotations
@@ -16,6 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from consensus_entropy_tpu import acquire
+from consensus_entropy_tpu.acquire.base import sanitize_member_rows
 from consensus_entropy_tpu.config import NUM_CLASSES
 from consensus_entropy_tpu.ops import scoring
 from consensus_entropy_tpu.ops.entropy import shannon_entropy
@@ -41,34 +49,10 @@ _scatter_rows = jax.jit(_scatter_rows_impl, donate_argnums=0)
 #: across Acquirer instances / users)
 _row_entropy = jax.jit(shannon_entropy)
 
-
-def _sanitize_member_rows_impl(p):
-    """Neutralize degenerate member rows before the entropy reduction.
-
-    A row (one member's class distribution for one song) is invalid when
-    it carries a non-finite value or sums to zero — one NaN row would
-    otherwise poison the consensus mean for that song and propagate
-    through ``ops.entropy`` into the mc/mix ranking (zero rows NaN there
-    too).  Invalid rows are replaced by the mean of the song's VALID rows,
-    so the downstream mean-over-members equals the mean renormalized over
-    surviving members — the same masking semantics member quarantine uses,
-    applied row-wise.  A song with no valid row at all becomes uniform
-    (maximally uncertain; behind ``pool_mask`` for padding rows, so only a
-    fully-degenerate live song is affected).  With every row valid the
-    output is bit-identical to the input, so unfaulted rankings are
-    unchanged.
-    """
-    p = jnp.asarray(p)
-    valid = (jnp.all(jnp.isfinite(p), axis=-1)
-             & (jnp.sum(p, axis=-1) > 0))[..., None]
-    safe = jnp.where(valid, p, 0.0)
-    cnt = jnp.sum(valid, axis=0)
-    fallback = jnp.where(cnt > 0, jnp.sum(safe, axis=0)
-                         / jnp.maximum(cnt, 1), 1.0 / p.shape[-1])
-    return jnp.where(valid, p, fallback[None])
-
-
-_sanitize_member_rows = jax.jit(_sanitize_member_rows_impl)
+#: degenerate-member-row sanitizer, relocated to ``acquire.base`` with the
+#: strategy registry (the strategies call it before staging); re-exported
+#: here for its original callers
+_sanitize_member_rows = sanitize_member_rows
 
 
 class Acquirer:
@@ -90,6 +74,13 @@ class Acquirer:
                  mode: str, tie_break: str = "fast", pad_multiple: int = 8,
                  seed: int = 0, mesh=None, pad_to: int | None = None):
         self.mode = mode
+        #: the registered strategy this acquirer delegates mode behavior to
+        self.strategy = acquire.get(mode)
+        #: per-member reliability weights ((M,) float32, committee order of
+        #: the probs axis) for weight-consuming strategies (wmc); None =
+        #: uniform.  The session sets this before each scoring pass and
+        #: persists the underlying name-keyed dict in ``ALState``.
+        self.member_weights: np.ndarray | None = None
         self.queries = queries
         self.songs = list(train_songs)
         self.n_valid = len(self.songs)
@@ -128,7 +119,7 @@ class Acquirer:
         # shrinks): commit it to the device ONCE; per-iteration uploads are
         # then just the tiny bool masks.  (Round-1..2 re-uploaded the
         # (N, C) table every select — the last static input in the loop.)
-        if mode in ("hc", "mix"):
+        if self.strategy.uses_hc_table:
             self._hc_dev = self._feed(self.hc, 0) if mesh is not None \
                 else jax.device_put(self.hc)
         else:
@@ -140,7 +131,7 @@ class Acquirer:
         # (amg_test.py:449-455); selections are identical.  Padding rows
         # (all-zero) come out -0.0 and sit behind the mask.
         self._hc_ent_dev = _row_entropy(self._hc_dev) \
-            if mode == "hc" else None
+            if self.strategy.uses_hc_entropy else None
         #: persistent (M, n_pad, C) device buffer for member probs —
         #: live rows are scattered in-place each iteration (see
         #: :meth:`_staged_probs`); stale rows stay behind the pool mask
@@ -168,12 +159,21 @@ class Acquirer:
         the identical seed-derived key, so the replication is consistent."""
         if self._mesh is None:
             return key
+        return jax.random.wrap_key_data(
+            self._feed_repl(np.asarray(jax.random.key_data(key))))
+
+    def _feed_repl(self, arr):
+        """Replicated global feed for small committee-axis inputs (the wmc
+        reliability-weights vector): every process holds the identical
+        values, so replication is consistent; single-process this is a
+        plain upload."""
+        if self._mesh is None:
+            return jnp.asarray(arr)
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        data = np.asarray(jax.random.key_data(key))
-        arr = jax.make_array_from_process_local_data(
+        data = np.asarray(arr)
+        return jax.make_array_from_process_local_data(
             NamedSharding(self._mesh, P()), data, data.shape)
-        return jax.random.wrap_key_data(arr)
 
     # -- helpers -----------------------------------------------------------
 
@@ -254,7 +254,7 @@ class Acquirer:
             member_probs.astype(jnp.float32))
         return self._probs_buf
 
-    # -- the four modes ----------------------------------------------------
+    # -- the registered modes ----------------------------------------------
 
     def scoring_inputs(self, member_probs=None, *, rand_key=None):
         """Stage this iteration's device-scoring call: ``(fn_key, inputs)``.
@@ -268,29 +268,14 @@ class Acquirer:
         :meth:`finish_select`.  :meth:`select` composes the three steps,
         so the single-user path is unchanged.
 
-        Mask updates are deferred to :meth:`finish_select`; the staged
-        inputs reference the acquirer's live mask arrays, so callers must
-        score before finishing (the jit call copies on transfer).
+        Mode behavior is the registered strategy's
+        (``consensus_entropy_tpu.acquire``).  Mask updates are deferred to
+        :meth:`finish_select`; the staged inputs reference the acquirer's
+        live mask arrays, so callers must score before finishing (the jit
+        call copies on transfer).
         """
-        if self.mode == "mc":
-            return "mc", (
-                _sanitize_member_rows(self._staged_probs(member_probs)),
-                self._feed(self.pool_mask, 0))
-        if self.mode == "hc":
-            return "hc_pre", (self._hc_ent_dev,
-                              self._feed(self.hc_mask, 0))
-        if self.mode == "mix":
-            return "mix", (
-                _sanitize_member_rows(self._staged_probs(member_probs)),
-                self._feed(self.pool_mask, 0),
-                self._hc_dev,
-                self._feed(self.hc_mask, 0))
-        if self.mode == "rand":
-            if rand_key is None:
-                self._rand_key, rand_key = jax.random.split(self._rand_key)
-            return "rand", (self._feed_key(rand_key),
-                            self._feed(self.pool_mask, 0))
-        raise ValueError(f"unknown mode {self.mode!r}")
+        return self.strategy.scoring_inputs(self, member_probs,
+                                            rand_key=rand_key)
 
     def run_scoring(self, fn_key: str, inputs) -> scoring.ScoreResult:
         """Run one staged scoring call through this acquirer's compiled
@@ -299,26 +284,10 @@ class Acquirer:
         return self._fns[fn_key](*inputs)
 
     def finish_select(self, res: scoring.ScoreResult) -> list:
-        """Map a scoring result back to song ids and apply the reference's
-        mask mutations (pool shrink + hc row removal)."""
-        if self.mode in ("mc", "rand"):
-            q_songs = self._ids(res)
-        elif self.mode == "hc":
-            q_songs = self._ids(res)
-            self._remove_hc(q_songs)  # amg_test.py:455
-        elif self.mode == "mix":
-            is_hc, slots = scoring.split_mix_index(res.indices, self.n_pad)
-            valid = np.asarray(res.values) > -np.inf
-            raw = [self.songs[int(s)]
-                   for s, ok in zip(np.asarray(slots), valid) if ok]
-            # the same song can surface from both blocks; the reference's
-            # isin-based batch build dedups implicitly (amg_test.py:491)
-            q_songs = list(dict.fromkeys(raw))
-            self._remove_hc(q_songs)  # amg_test.py:484
-        else:
-            raise ValueError(f"unknown mode {self.mode!r}")
-
-        # remove the batch from the unlabeled pool (amg_test.py:520-523)
+        """Map a scoring result back to song ids (strategy-specific, incl.
+        hc row removal / mix dedup) and apply the reference's common pool
+        shrink (amg_test.py:520-523)."""
+        q_songs = self.strategy.extract_queries(self, res)
         for s in q_songs:
             self.pool_mask[self._song_row[s]] = False
         return q_songs
@@ -343,7 +312,7 @@ class Acquirer:
         for batch in queried_batches:
             for s in batch:
                 self.pool_mask[self._song_row[s]] = False
-                if self.mode in ("hc", "mix"):
+                if self.strategy.uses_hc_table:
                     self.hc_mask[self._song_row[s]] = False
 
     def _ids(self, res: scoring.ScoreResult) -> list:

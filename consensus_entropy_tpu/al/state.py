@@ -84,6 +84,12 @@ class ALState:
     seed: int
     queries: int = -1         # -1: legacy state, parameter unknown
     train_size: float = -1.0
+    #: wmc mode: per-member reliability weights, keyed by member NAME (the
+    #: probs-axis order is reconstructed from the live committee at each
+    #: scoring pass, so quarantine-shrunk member lists stay aligned).
+    #: None for modes without weights and for legacy states; floats
+    #: round-trip JSON exactly, so resume replays bit-identically.
+    member_weights: dict | None = None
 
     def matches(self, *, mode: str, seed: int, queries: int,
                 train_size: float) -> bool:

@@ -19,7 +19,12 @@ from consensus_entropy_tpu.cli.common import (
     resolve_cnn_config,
 )
 
-MODES = ("mc", "hc", "mix", "rand")
+def _modes() -> tuple[str, ...]:
+    """The registered acquisition modes (``consensus_entropy_tpu.acquire``)
+    — the paper's four plus registry extensions (qbdc, wmc)."""
+    from consensus_entropy_tpu import acquire
+
+    return acquire.available_modes()
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -31,9 +36,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="AL iterations")
     p.add_argument("-n", "--num_anno", required=True, type=int,
                    help="minimum annotations per user")
-    p.add_argument("-m", "--mode", required=True, choices=MODES,
+    p.add_argument("-m", "--mode", "--al-mode", required=True,
+                   choices=_modes(),
                    help="acquisition: machine-consensus [mc], human "
-                        "consensus [hc], both [mix], random [rand]")
+                        "consensus [hc], both [mix], random [rand], "
+                        "query-by-dropout-committee [qbdc: one CNN x "
+                        "--qbdc-k seeded dropout masks on device], "
+                        "weighted machine consensus [wmc: per-member "
+                        "reliability weights from post-reveal agreement]")
+    p.add_argument("--qbdc-k", type=int, default=20, metavar="K",
+                   help="qbdc: dropout-committee width — K seeded masks of "
+                        "the single personalized CNN (a vmap width, not "
+                        "stored models; default 20, the paper's stored-"
+                        "committee size)")
+    p.add_argument("--consensus-weighting",
+                   choices=("agreement", "uniform"), default="agreement",
+                   help="wmc: reliability-weight update rule — "
+                        "'agreement' moves each member's weight by an EMA "
+                        "toward its post-reveal agreement with the user's "
+                        "revealed labels; 'uniform' freezes weights at "
+                        "1.0 (wmc is then exactly mc)")
     p.add_argument("--max-users", type=int, default=None,
                    help="cap the user count (debug)")
     p.add_argument("--fleet", type=int, default=None, metavar="N",
@@ -221,6 +243,9 @@ def main(argv=None) -> int:
         if is_set and args.serve is None:
             print(f"{flag} requires --serve")
             return 1
+    if args.qbdc_k < 1:
+        print(f"--qbdc-k must be >= 1, got {args.qbdc_k}")
+        return 1
     if args.serve is not None and (args.watchdog_s < 0
                                    or args.failure_budget < 1
                                    or args.breaker_threshold < 0
@@ -289,7 +314,9 @@ def main(argv=None) -> int:
     paths = PathsConfig(models_root=args.models_root,
                         deam_root=args.deam_root, amg_root=args.amg_root)
     cfg = ALConfig(queries=args.queries, epochs=args.epochs, mode=args.mode,
-                   num_anno=args.num_anno, seed=args.seed)
+                   num_anno=args.num_anno, seed=args.seed,
+                   qbdc_k=args.qbdc_k,
+                   consensus_weighting=args.consensus_weighting)
 
     anno = amg.load_annotations(paths.amg_annotations_mat,
                                 paths.amg_mapping_mat)
@@ -314,6 +341,21 @@ def main(argv=None) -> int:
         # the device-resident waveform buffer; AMG1608 fits one chip's HBM)
         store = device_store_from_npy(paths.amg_npy_dir, pool.song_ids,
                                       cnn_cfg.input_length)
+    if args.mode == "qbdc" and store is None:
+        # the dropout committee IS K masked forwards of a CNN member; a
+        # host-only registry has no network to mask
+        print("--al-mode qbdc needs pre-trained CNN members (no .msgpack "
+              f"in {paths.pretrained_dir}); run deam-classifier with a "
+              "CNN registry first")
+        return 1
+
+    if args.mode == "qbdc" and args.mesh:
+        # statically known incompatibility: fail here, not minutes later at
+        # the first scoring pass (Committee.qbdc_pool_probs is single-mesh
+        # only — stack users via --fleet/--serve instead of sharding a pool)
+        print("--al-mode qbdc does not support --mesh (qbdc scoring is "
+              "single-mesh only; use --fleet/--serve to batch users)")
+        return 1
 
     mesh = None
     train_mesh = None

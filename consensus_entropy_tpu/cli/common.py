@@ -14,9 +14,16 @@ def add_device_arg(parser: argparse.ArgumentParser) -> None:
 
 def configure_device(device: str) -> None:
     """Must run before the first JAX backend touch."""
-    if device == "cpu":
-        import jax
+    import jax
 
+    # The CNN crop compile-buckets (committee.predict_songs_cnn /
+    # qbdc_pool_probs) and the fleet rand batcher rely on prefix-stable
+    # threefry draws — the modern JAX default, but THIS image's 0.4.37
+    # defaults the flag off.  The test harness sets it in conftest; the
+    # production CLI must set it itself or any re-exec'd worker process
+    # (--hosts) fails the point-of-reliance check on its first CNN pass.
+    jax.config.update("jax_threefry_partitionable", True)
+    if device == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
 

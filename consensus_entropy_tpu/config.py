@@ -11,7 +11,12 @@ import dataclasses
 import os
 from typing import Literal
 
-AcquisitionMode = Literal["mc", "hc", "mix", "rand"]
+#: The paper's four acquisition modes plus the framework's registry
+#: extensions (``consensus_entropy_tpu.acquire``): ``qbdc`` = query-by-
+#: dropout-committee (one CNN + K seeded dropout masks, arxiv 1511.06412),
+#: ``wmc`` = weighted machine consensus (per-member reliability weights in
+#: the renormalized entropy reduction, arxiv 2011.06086).
+AcquisitionMode = Literal["mc", "hc", "mix", "rand", "qbdc", "wmc"]
 
 #: Quadrant label codec — ``amg_test.py:54`` (``{'Q1': 0, ... 'Q4': 3}``).
 QUADRANT_TO_CLASS = {"Q1": 0, "Q2": 1, "Q3": 2, "Q4": 3}
@@ -281,6 +286,22 @@ class ALConfig:
     #: exponential backoff is jittered and seeded (resilience.retry).
     retry_attempts: int = 3
     retry_base_delay: float = 0.05
+    #: ``qbdc`` mode: committee width K — the number of seeded dropout
+    #: masks the single personalized CNN is forwarded under (the committee
+    #: axis of the consensus entropy; the paper's stored committee is 20
+    #: models, so 20 is the like-for-like default).  Storage/compute shape:
+    #: one set of CNN weights regardless of K — K only widens a vmap over
+    #: dropout heads (``short_cnn.qbdc_infer``).
+    qbdc_k: int = 20
+    #: ``wmc`` mode: how per-member reliability weights evolve.
+    #: ``agreement`` — after each reveal, member m's weight moves toward
+    #: its fraction of correctly-predicted queried songs by an EMA with
+    #: ``consensus_weight_alpha`` (weights start at 1.0 = plain mc);
+    #: ``uniform`` — weights stay 1.0 forever, so wmc is exactly mc
+    #: (the equal-weights reduction is pinned bit-identical by tests).
+    consensus_weighting: Literal["agreement", "uniform"] = "agreement"
+    #: EMA step for the ``agreement`` weight update (0 freezes weights).
+    consensus_weight_alpha: float = 0.5
     #: Validation-gate the host members' incremental updates (keep an
     #: update only if the member's weighted F1 on the user's test split
     #: does not drop) — the host analogue of the reference's CNN
@@ -290,6 +311,23 @@ class ALConfig:
     #: the round-5 evidence measures what that costs under
     #: uncertainty-dense batches (EVIDENCE_r05 mechanism_study).
     gate_host_updates: bool = False
+
+    def __post_init__(self):
+        if self.consensus_weighting not in ("agreement", "uniform"):
+            # a typo here would silently freeze wmc weights at uniform
+            # (the update hook no-ops on anything but "agreement")
+            raise ValueError(
+                f"consensus_weighting must be 'agreement' or 'uniform'; "
+                f"got {self.consensus_weighting!r}")
+        if self.qbdc_k < 1:
+            raise ValueError(
+                f"qbdc_k (dropout committee width) must be >= 1; "
+                f"got {self.qbdc_k}")
+        if not 0.0 <= self.consensus_weight_alpha <= 1.0:
+            # >1 can drive weights negative (negative/zero normalizer)
+            raise ValueError(
+                f"consensus_weight_alpha must be in [0, 1]; "
+                f"got {self.consensus_weight_alpha}")
 
 
 @dataclasses.dataclass(frozen=True)

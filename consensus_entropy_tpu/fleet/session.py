@@ -130,6 +130,16 @@ class UserSession:
         # the config's survivor floor never weakens a stricter committee
         committee.min_members = max(committee.min_members, cfg.min_members)
 
+        #: wmc: per-member reliability weights, keyed by member name —
+        #: updated from post-reveal agreement, persisted in ALState,
+        #: restored on resume so faulted runs replay bit-identically
+        self.member_weights: dict = {}
+        #: the member-name order of the LAST scoring pass's probs axis
+        #: (captured when the weights vector is built, so the post-reveal
+        #: agreement update pairs rows with the right members even after
+        #: a quarantine shrinks the active list)
+        self._scoring_member_names: list | None = None
+
         st = al_state.ALState.load(user_path) if resume else None
         if st is not None and not st.matches(
                 mode=cfg.mode, seed=self.seed, queries=cfg.queries,
@@ -147,6 +157,8 @@ class UserSession:
         if st is not None:
             self.split = self._rebuild_split(data, st)
             self.key = st.unpack_key()
+            if st.member_weights:
+                self.member_weights = dict(st.member_weights)
             self.trajectory = list(st.trajectory)
             self.queried_hist = [al_state.remap_songs(b, data.pool.song_ids)
                                  for b in st.queried]
@@ -203,6 +215,49 @@ class UserSession:
             data.pool, data.labels,
             al_state.remap_songs(st.train_songs, data.pool.song_ids),
             al_state.remap_songs(st.test_songs, data.pool.song_ids))
+
+    def _weights_vector(self) -> np.ndarray:
+        """The (M,) reliability-weights vector aligned with the NEXT
+        scoring pass's probs axis (active CNN members first, then active
+        host members — ``Committee.pool_probs`` order).  Unseen members
+        start at 1.0 (uniform = plain mc).  Captures the name order so
+        :meth:`_update_member_weights` pairs agreement rows correctly."""
+        c = self.committee
+        names = ([m.name for m in c.active_cnn_members]
+                 + [c._member_name(m) for m in c.active_host_members])
+        self._scoring_member_names = names
+        return np.array([self.member_weights.get(nm, 1.0)
+                         for nm in names], np.float32)
+
+    def _update_member_weights(self, member_probs, live_songs,
+                               q_songs) -> None:
+        """wmc post-reveal agreement update: member m's weight moves by an
+        EMA toward its fraction of correctly-predicted queried songs
+        (predictions read from the SAME probs table the selection scored,
+        labels from the just-revealed batch).  Pure host math on values
+        already in hand — deterministic, replayed exactly from the
+        weights ``ALState`` carries."""
+        cfg = self.config
+        if (cfg.consensus_weighting != "agreement" or not q_songs
+                or member_probs is None
+                or cfg.consensus_weight_alpha <= 0):
+            return
+        alpha = cfg.consensus_weight_alpha
+        probs = np.asarray(member_probs)
+        row = {s: i for i, s in enumerate(live_songs)}
+        idx = [row[s] for s in q_songs]
+        pred = probs[:, idx, :].argmax(axis=-1)
+        truth = np.asarray([self.data.labels[s] for s in q_songs])
+        agree = (pred == truth).mean(axis=1)
+        quarantined = self.committee.quarantined
+        for nm, a in zip(self._scoring_member_names or [], agree):
+            if nm in quarantined:
+                # its probs row was sanitized (not its own prediction):
+                # freeze the weight; the member is out of the consensus
+                # via the active-list/member-mask path anyway
+                continue
+            w = self.member_weights.get(nm, 1.0)
+            self.member_weights[nm] = (1.0 - alpha) * w + alpha * float(a)
 
     def _evaluate(self, report: UserReport, key) -> list[float]:
         """Evaluate every ACTIVE member on the user's test set; returns F1
@@ -268,6 +323,8 @@ class UserSession:
                      for b in self.queried_hist],
             key_data=kd, key_dtype=kdt, mode=cfg.mode, seed=self.seed,
             queries=cfg.queries, train_size=cfg.train_size,
+            member_weights=(dict(self.member_weights)
+                            if self.acq.strategy.uses_weights else None),
         )
         bg_times = self.bg_times
 
@@ -403,8 +460,14 @@ class UserSession:
                 if len(live) == 0:
                     break
                 member_probs = None
-                if cfg.mode in ("mc", "mix"):
+                strat = acq.strategy
+                if strat.needs_probs:
                     self.key, sub = jax.random.split(self.key)
+                    if strat.uses_weights:
+                        # align the reliability weights with the probs
+                        # axis the upcoming pass will produce (captures
+                        # the name order for the post-reveal update)
+                        acq.member_weights = self._weights_vector()
 
                     def score(sub=sub, live=live):
                         # stays a device array end-to-end: the acquirer
@@ -413,16 +476,25 @@ class UserSession:
                         # at the fixed bucket width so the chain compiles
                         # once per bucket, not once per live-width.
                         # Scoring is pure (committee state is read-only
-                        # and the crop key is fixed), so a transient
-                        # device/RPC error retries the identical pass.
+                        # and the crop/mask keys are fixed), so a
+                        # transient device/RPC error retries the
+                        # identical pass.  The probs producer is the
+                        # strategy's: the stored-member stack, or the
+                        # qbdc dropout committee (one CNN x K masks).
+                        if strat.probs_source == "qbdc":
+                            def produce():
+                                return committee.qbdc_pool_probs(
+                                    data.store, live, sub, k=cfg.qbdc_k,
+                                    pad_to=acq.staging_width(len(live)))
+                        else:
+                            def produce():
+                                return committee.pool_probs(
+                                    data.pool, data.store, live, sub,
+                                    pad_to=acq.staging_width(len(live)))
                         with timer.phase("score"):
                             return retry_transient(
-                                lambda: faults.fire(
-                                    "pool.score",
-                                    payload=committee.pool_probs(
-                                        data.pool, data.store, live, sub,
-                                        pad_to=acq.staging_width(
-                                            len(live)))),
+                                lambda: faults.fire("pool.score",
+                                                    payload=produce()),
                                 attempts=cfg.retry_attempts,
                                 base_delay=cfg.retry_base_delay,
                                 seed=seed + epoch, what="pool.score")
@@ -431,6 +503,18 @@ class UserSession:
                         member_probs = yield HostStep(self, score, "score")
                     else:
                         member_probs = score()
+                if strat.uses_weights and committee.quarantined:
+                    # a member quarantined DURING this pass keeps its probs
+                    # row (NaN'd, then sanitized to the survivor mean) and
+                    # its axis slot — zero its weight so it can't re-enter
+                    # the weighted consensus through a stale reliability
+                    # weight (the mask-before-renormalize contract; members
+                    # quarantined on earlier passes already left the axis)
+                    w = np.asarray(acq.member_weights, np.float32).copy()
+                    for i, nm in enumerate(self._scoring_member_names or []):
+                        if nm in committee.quarantined:
+                            w[i] = 0.0
+                    acq.member_weights = w
                 self.key, sub = jax.random.split(self.key)
                 with timer.phase("select"):
                     fn_key, inputs = acq.scoring_inputs(member_probs,
@@ -438,14 +522,24 @@ class UserSession:
                     res = yield ScoreStep(self, fn_key, inputs)
                     q_songs = acq.finish_select(res)
 
+                # only wmc reads the probs table post-select; binding None
+                # otherwise lets the device buffer drop before the (possibly
+                # host-offloaded) update/retrain phase instead of pinning it
                 def update_and_eval(epoch=epoch, q_songs=q_songs,
-                                    before=last_host_f1s):
+                                    before=last_host_f1s,
+                                    probs=(member_probs
+                                           if strat.uses_weights else None),
+                                    live=live):
                     from consensus_entropy_tpu.al.loop import query_batch
 
                     # reveal labels; build the frame batch (amg_test.py:
                     # 491-493)
                     X_batch, y_batch = query_batch(data.pool, data.labels,
                                                    q_songs)
+                    if strat.uses_weights:
+                        # post-reveal agreement -> reliability weights for
+                        # the NEXT iteration's weighted consensus
+                        self._update_member_weights(probs, live, q_songs)
                     with timer.phase("update_host"):
                         if cfg.gate_host_updates and len(split.X_test):
                             committee.update_host_gated(

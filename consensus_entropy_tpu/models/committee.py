@@ -270,6 +270,20 @@ def _infer_fns(config: CNNConfig, mesh):
                     out_shardings=out_sh))
 
 
+@functools.lru_cache(maxsize=None)
+def _qbdc_infer_fn(config: CNNConfig):
+    """Process-wide jitted QBDC forward for ``config`` (same sharing
+    rationale as :func:`_infer_fns`: committees are rebuilt per user, the
+    program is pure in its operands).  One executable serves every user
+    and every K — the mask-key operand's leading axis is the committee
+    width, so jit specializes per K, cached like any shape."""
+
+    def infer(variables, x, mask_keys):
+        return short_cnn.qbdc_infer(variables, x, mask_keys, config)
+
+    return jax.jit(infer)
+
+
 class Committee:
     """The user's private committee: M_host sklearn + M_cnn Flax members.
 
@@ -590,6 +604,89 @@ class Committee:
             # re-shard it on upload anyway.
             return np.concatenate([np.asarray(b) for b in blocks], axis=0)
         return jnp.concatenate(blocks, axis=0)
+
+    def qbdc_pool_probs(self, store: DeviceWaveformStore | None, song_ids,
+                        key, *, k: int, pad_to: int | None = None):
+        """Query-by-dropout-committee probabilities ``(K, N, C)`` over
+        ``song_ids`` — or ``(K, pad_to, C)`` with the same staging-tail
+        contract as :meth:`pool_probs`.
+
+        ONE personalized CNN (the committee's first active CNN member — the
+        single network QBDC personalizes per user) forwarded under ``k``
+        seeded dropout masks (``short_cnn.qbdc_infer``): the committee axis
+        of the consensus entropy becomes a vmap width instead of stored
+        models.  Crop sampling reuses :meth:`predict_songs_cnn`'s
+        compile-bucket discipline (prefix-stable threefry, 256-wide
+        slices), so the crop stream and compile behavior match the stored-
+        committee path.
+
+        Determinism contract: ``key`` is the AL iteration's PRNG key; it
+        splits into a crop key and a mask key, and the K member keys fold
+        deterministically from the latter — so the dropout committee is
+        bit-identical across checkpoint resume, fleet eviction/resume and
+        serve-journal restart (the ``acquire.qbdc.masks`` fault point fires
+        at the sampler so kill drills land exactly there).  Masks are
+        unit-level per member (see ``qbdc_infer``), hence independent of
+        pool width and staging padding.
+        """
+        active = self.active_cnn_members
+        if not active:
+            raise ValueError(
+                "qbdc acquisition needs a committee with at least one "
+                "(active) CNN member — the dropout committee is K masked "
+                "forwards of that network")
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "qbdc scoring is single-mesh only (stack users via "
+                "--fleet/--serve instead of sharding one pool)")
+        if k < 1:
+            raise ValueError(f"qbdc committee width must be >= 1, got {k}")
+        if store is None:
+            # fail loud like pool_probs: a zeros return would sanitize to
+            # uniform rows and silently degrade selection to a tie-break
+            raise ValueError(
+                "qbdc scoring needs the device waveform store (the masked "
+                "forwards run on raw crops); build UserData with a "
+                "DeviceWaveformStore")
+        rows = store.row_of(song_ids)
+        if pad_to is not None and pad_to < len(rows):
+            raise ValueError(f"pad_to={pad_to} < n={len(rows)}")
+        if len(rows) == 0:
+            return jnp.zeros((k, pad_to or 0, self.config.n_class),
+                             jnp.float32)
+        crop_key, mask_key = jax.random.split(jnp.asarray(key))
+        faults.fire("acquire.qbdc.masks", k=int(k))
+        mask_keys = jax.random.split(mask_key, k)
+        if not jax.config.jax_threefry_partitionable:
+            # same point-of-reliance check as predict_songs_cnn: the crop
+            # compile-buckets below need prefix-stable threefry draws
+            raise RuntimeError(
+                "jax_threefry_partitionable is off; crop compile-buckets "
+                "require prefix-stable threefry — enable the flag (the "
+                "modern JAX default) to use the qbdc scoring path")
+        bucket = 256
+        pad = -len(rows) % bucket
+        rows_in = np.concatenate([rows, np.repeat(rows[-1:], pad)]) \
+            if pad else rows
+        crops = store.sample_crops(crop_key, rows_in)
+        infer = _qbdc_infer_fn(self.config)
+        variables = active[0].variables
+        # bucket-wide sub-dispatches bound the trunk's activation
+        # transient for any pool size (see predict_songs_cnn); the mask
+        # keys are unit-level so every slice sees the same K subnetworks
+        sub = [infer(variables,
+                     jax.lax.dynamic_slice_in_dim(crops, lo, bucket),
+                     mask_keys)
+               for lo in range(0, crops.shape[0], bucket)]
+        out = _concat_member_blocks(sub)
+        keep = len(rows) if pad_to is None else pad_to
+        if keep > out.shape[1]:
+            # out-of-contract pad_to beyond the compile bucket: honor the
+            # shape contract anyway (same fallback as predict_songs_cnn)
+            out = jnp.concatenate(
+                [out, jnp.repeat(out[:, -1:], keep - out.shape[1],
+                                 axis=1)], axis=1)
+        return out[:, :keep] if keep != out.shape[1] else out
 
     # -- device-side GNB/SGD inference (ops.device_members) ----------------
 

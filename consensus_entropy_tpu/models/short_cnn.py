@@ -183,8 +183,14 @@ class ShortChunkCNN(nn.Module):
     config: CNNConfig = CNNConfig()
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
-        """x: waveform ``(B, L)`` float — returns sigmoid scores ``(B, C)``."""
+    def __call__(self, x, train: bool = False,
+                 return_features: bool = False):
+        """x: waveform ``(B, L)`` float — returns sigmoid scores ``(B, C)``.
+
+        ``return_features``: stop after the penultimate ReLU (the dropout
+        layer's input) and return the ``(B, D)`` feature map instead — the
+        split point the QBDC head (:func:`qbdc_infer`) resamples K dropout
+        masks over without re-running the trunk."""
         cfg = self.config
         dtype = jnp.dtype(cfg.compute_dtype)
 
@@ -246,6 +252,8 @@ class ShortChunkCNN(nn.Module):
         s = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          epsilon=1e-5, dtype=dtype, name="head_bn")(s)
         s = nn.relu(s)
+        if return_features:
+            return s
         s = nn.Dropout(cfg.dropout_rate, deterministic=not train)(s)
         s = nn.Dense(cfg.n_class, dtype=dtype, name="dense2")(s)
         return nn.sigmoid(s.astype(jnp.float32))
@@ -269,6 +277,47 @@ def apply_train(variables, x, dropout_key, config: CNNConfig = CNNConfig()):
         variables, x, train=True, rngs={"dropout": dropout_key},
         mutable=["batch_stats"])
     return out, mutated["batch_stats"]
+
+
+def apply_features(variables, x, config: CNNConfig = CNNConfig()):
+    """Penultimate features ``(B, D)``: the inference forward (running-
+    stats BN, no dropout) stopped at the dropout layer's input."""
+    return ShortChunkCNN(config).apply(variables, x, train=False,
+                                       return_features=True)
+
+
+def qbdc_infer(variables, x, mask_keys, config: CNNConfig = CNNConfig()):
+    """Query-by-dropout-committee forward: ``(K, B, C)`` sigmoid scores of
+    ONE member under K seeded dropout masks (arxiv 1511.06412).
+
+    The committee members share every parameter — member ``j`` is the
+    FIXED thinned subnetwork drawn by ``mask_keys[j]``: a unit-level
+    Bernoulli mask over the ``D`` penultimate features, broadcast over the
+    batch, so each member scores the whole pool through one consistent
+    subnetwork (and the mask is independent of batch width, compile
+    bucketing and staging padding — a member's identity never drifts as
+    the pool shrinks).  The expensive trunk runs ONCE and only the
+    dropout→dense2→sigmoid head is vmapped over ``mask_keys``: committee
+    width K costs K tiny ``(B, D)×(D, C)`` matmuls and NO extra weights —
+    the storage/compute shape that replaces the paper's 20 stored models
+    per user.  Masks use inverted-dropout scaling (keep-probability
+    ``1 - dropout_rate``; ``dropout_rate == 0`` degenerates to K identical
+    members).  BN runs in inference mode (running stats), matching
+    :func:`apply_infer`.
+    """
+    feats = apply_features(variables, x, config)
+    dense2 = variables["params"]["dense2"]
+    dtype = jnp.dtype(config.compute_dtype)
+    kernel = dense2["kernel"].astype(dtype)
+    bias = dense2["bias"].astype(dtype)
+    keep = 1.0 - config.dropout_rate
+
+    def head(key):
+        m = jax.random.bernoulli(key, keep, (feats.shape[-1],))
+        h = jnp.where(m[None, :], feats / keep, 0.0).astype(dtype)
+        return nn.sigmoid((h @ kernel + bias).astype(jnp.float32))
+
+    return jax.vmap(head)(mask_keys)
 
 
 def stack_params(member_variables: list):

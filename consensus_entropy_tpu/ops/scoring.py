@@ -77,6 +77,62 @@ def score_mc(member_probs, pool_mask, *, k: int, member_mask=None,
     return ScoreResult(ent, values, indices)
 
 
+def weighted_consensus_mean(member_probs, member_weights, member_mask=None):
+    """Reliability-weighted consensus over the committee axis.
+
+    Generalizes :func:`consensus_mean`'s binary quarantine mask into
+    per-member reliability weights (Bayesian/weighted committee consensus,
+    arxiv 2011.06086): ``Σ_m w_m · p_m / Σ_m w_m``.
+
+    Ordering contract (weights × mask interaction): the quarantine mask
+    zeroes a member's weight BEFORE the reliability renormalization, so a
+    quarantined member can never re-enter the consensus through a stale
+    (possibly large) weight in the normalizer — the reduction renormalizes
+    over surviving members' weights only.
+
+    Spelled as ``mean(p · w·M/Σw)`` rather than ``Σ(p·w)/Σw`` — same
+    value, but with uniform unit weights the per-member scale is exactly
+    1.0 (a bitwise identity multiply) feeding the SAME mean reduction
+    :func:`consensus_mean` lowers to, so ``wmc`` with equal weights is
+    bit-identical to ``mc`` (pinned by tests), not merely close.
+    """
+    p = jnp.asarray(member_probs)
+    w = jnp.asarray(member_weights).astype(p.dtype)
+    if member_mask is not None:
+        # mask first, THEN renormalize: see the ordering contract above
+        w = w * jnp.asarray(member_mask).astype(p.dtype)
+    # an all-zero weight vector (alpha=1.0 EMA after universal
+    # disagreement, or a fully-masked committee) would make the
+    # normalizer 0/0-NaN the whole consensus; fall back to uniform
+    # (= mc) instead — any positive sum takes the true branch, where
+    # jnp.where returns w bitwise-unchanged, so normal runs are unaffected
+    w = jnp.where(jnp.sum(w) > 0, w, jnp.ones_like(w))
+    scale = w * (p.shape[0] / jnp.sum(w))
+    return jnp.mean(p * scale[:, None, None], axis=0)
+
+
+def score_wmc(member_probs, pool_mask, member_weights, *, k: int,
+              member_mask=None, tie_break: str = "fast") -> ScoreResult:
+    """Weighted-machine-consensus acquisition: reliability-weighted mean →
+    entropy → top-k.  ``member_weights``: ``(M,)`` non-negative reliability
+    weights (the AL loop updates them from post-reveal agreement and
+    carries them in ``ALState``)."""
+    consensus = weighted_consensus_mean(member_probs, member_weights,
+                                        member_mask)
+    ent = masked_entropy(consensus, pool_mask)
+    values, indices = masked_top_k(ent, pool_mask, k, tie_break)
+    return ScoreResult(ent, values, indices)
+
+
+#: qbdc shares mc's scoring graph: the committee axis holds K dropout-mask
+#: forwards of ONE network instead of M stored models — the reduction is
+#: identical, only the probs producer differs (``committee.
+#: qbdc_pool_probs``).  A DISTINCT fn key still exists end-to-end so fleet
+#: dispatch groups, per-bucket jit families, breaker state and telemetry
+#: distinguish the modes.
+score_qbdc = score_mc
+
+
 def score_hc(hc_freq, hc_mask, *, k: int, tie_break: str = "fast") -> ScoreResult:
     """Human-consensus acquisition: entropy of annotator-frequency rows."""
     ent = masked_entropy(hc_freq, hc_mask)
@@ -170,7 +226,10 @@ def _make_scoring_fns_cached(k: int, tie_break: str) -> dict[str, Callable]:
                                        tie_break=tie_break))
     mix = jax.jit(functools.partial(score_mix, k=k, tie_break=tie_break))
     rand = jax.jit(functools.partial(score_rand, k=k))
-    return {"mc": mc, "hc": hc, "hc_pre": hc_pre, "mix": mix, "rand": rand}
+    qbdc = jax.jit(functools.partial(score_qbdc, k=k, tie_break=tie_break))
+    wmc = jax.jit(functools.partial(score_wmc, k=k, tie_break=tie_break))
+    return {"mc": mc, "hc": hc, "hc_pre": hc_pre, "mix": mix, "rand": rand,
+            "qbdc": qbdc, "wmc": wmc}
 
 
 def make_fleet_scoring_fns(*, k: int,
@@ -229,9 +288,21 @@ def _fleet_base_fns(k: int, tie_break: str) -> dict[str, Callable]:
     def _rand(key, pool_mask):
         return score_rand(key, pool_mask, k=k)
 
+    def _qbdc(probs, pool_mask):
+        return score_qbdc(probs, pool_mask, k=k, tie_break=tie_break)
+
+    def _wmc(probs, pool_mask, weights):
+        return score_wmc(probs, pool_mask, weights, k=k,
+                         tie_break=tie_break)
+
+    def _wmc_masked(probs, pool_mask, weights, member_mask):
+        return score_wmc(probs, pool_mask, weights, k=k,
+                         member_mask=member_mask, tie_break=tie_break)
+
     return {"mc": _mc, "mc_masked": _mc_masked, "hc": _hc,
             "hc_pre": _hc_pre, "mix": _mix, "mix_masked": _mix_masked,
-            "rand": _rand}
+            "rand": _rand, "qbdc": _qbdc, "wmc": _wmc,
+            "wmc_masked": _wmc_masked}
 
 
 @functools.lru_cache(maxsize=None)
@@ -244,7 +315,8 @@ def _make_fleet_scoring_fns_cached(k: int, tie_break: str) -> dict[str, Callable
 #: mask — the operand whose trailing dim IS the padded pool width (the
 #: member mask of the ``*_masked`` variants is (U, M) and must not be used)
 _POOL_MASK_POS = {"mc": 1, "mc_masked": 1, "hc": 1, "hc_pre": 1,
-                  "mix": 1, "mix_masked": 1, "rand": 1}
+                  "mix": 1, "mix_masked": 1, "rand": 1, "qbdc": 1,
+                  "wmc": 1, "wmc_masked": 1}
 
 
 def fleet_scoring_fns_for_width(*, k: int, tie_break: str = "fast",

@@ -32,7 +32,9 @@ from consensus_entropy_tpu.ops.scoring import (
     score_hc_precomputed,
     score_mc,
     score_mix,
+    score_qbdc,
     score_rand,
+    score_wmc,
 )
 from consensus_entropy_tpu.parallel.mesh import POOL_AXIS
 
@@ -80,8 +82,16 @@ def _make_sharded_scoring_fns_cached(mesh: Mesh, k: int, tie_break: str):
         out_shardings=mix_out_s)
     rand = jax.jit(functools.partial(score_rand, k=k),
                    in_shardings=(repl, vec_s), out_shardings=out_s)
+    # registry extensions: qbdc shards exactly like mc (the committee axis
+    # holds K dropout forwards); wmc adds a tiny replicated weights vector
+    qbdc = jax.jit(
+        functools.partial(score_qbdc, k=k, tie_break=tie_break),
+        in_shardings=(probs_s, vec_s), out_shardings=out_s)
+    wmc = jax.jit(
+        functools.partial(score_wmc, k=k, tie_break=tie_break),
+        in_shardings=(probs_s, vec_s, repl), out_shardings=out_s)
     return {"mc": mc, "hc": hc, "hc_pre": hc_pre, "mix": mix,
-            "rand": rand}
+            "rand": rand, "qbdc": qbdc, "wmc": wmc}
 
 
 def _merge_local_topk(v, i, local_n: int, k: int):

@@ -67,6 +67,11 @@ FAULT_POINTS = frozenset({
     "fabric.compact",        # journal compaction (checkpoint + truncate
                              # stages — a kill between the two renames
                              # must replay idempotently)
+    # acquisition-subsystem boundaries (the acquire registry's fault
+    # domain): the qbdc dropout-mask sampler — mask keys fold from the AL
+    # iteration seed, so a kill here must resume bit-identically (same
+    # masks, same consensus) from checkpoint/journal state
+    "acquire.qbdc.masks",    # Committee.qbdc_pool_probs, pre-mask-sampling
 })
 
 ACTIONS = ("kill", "raise", "transient", "corrupt", "delay")

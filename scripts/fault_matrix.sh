@@ -7,10 +7,15 @@
 #
 # - per-session (tests/test_resilience.py): for each named fault point
 #   (checkpoint.write, member.retrain, member.predict, pool.score,
-#   state.save, multihost.sync) x each acquisition mode (mc/hc/mix/rand),
-#   a run killed at that boundary and resumed must reproduce the
-#   unfaulted F1 trajectory bit-for-bit, and a corrupted live checkpoint
-#   must roll back one generation and converge to the same trajectory.
+#   state.save, multihost.sync) x each acquisition mode (mc/hc/mix/rand,
+#   plus the registry's wmc rows), a run killed at that boundary and
+#   resumed must reproduce the unfaulted F1 trajectory bit-for-bit, and a
+#   corrupted live checkpoint must roll back one generation and converge
+#   to the same trajectory.  The qbdc rows add the dropout committee's
+#   own boundary — the acquire.qbdc.masks mask sampler — alongside
+#   pool.score/state.save/checkpoint.write, with mask keys folding from
+#   the checkpointed PRNG stream so the resumed committee is bit-identical
+#   (test_qbdc_kill_at_every_boundary).
 # - serve-layer (tests/test_serve_faults.py): for each serve boundary
 #   (serve.admit, serve.journal.append, serve.dispatch, serve.collect)
 #   plus the 4-mode restart matrix, a SIGKILLed server restarted from
@@ -21,12 +26,15 @@
 # - fabric kill matrix (tests/test_serve_fabric.py): a REAL 2-host
 #   fabric, drilled at every process boundary — SIGKILL the coordinator
 #   (restart replays the journal, orphan workers self-exit and are
-#   reaped), SIGKILL each worker in every acquisition mode (in-flight
-#   users resume on the survivor, queued users re-enqueue in journal
-#   order), a heartbeat-dead (hung) worker failed over on lease expiry,
-#   and journal compaction killed in BOTH rename windows — all asserting
-#   journal-driven recovery with per-user trajectories bit-identical to
-#   uninterrupted single-host runs.
+#   reaped), SIGKILL each worker in every acquisition mode — including
+#   the registry's qbdc (dropout committee) and wmc (reliability
+#   weights) rows — (in-flight users resume on the survivor, queued
+#   users re-enqueue in journal order), a heartbeat-dead (hung) worker
+#   failed over on lease expiry, and journal compaction killed in BOTH
+#   rename windows — all asserting journal-driven recovery with per-user
+#   trajectories bit-identical to uninterrupted single-host runs.
+# - acquisition registry (tests/test_acquire.py): the acquire.qbdc.masks
+#   fault point unit and the qbdc resume drill.
 #
 # Extra pytest args pass through, e.g.:
 #   scripts/fault_matrix.sh -k kill_at_every_boundary
@@ -34,6 +42,7 @@ set -euo pipefail
 
 cd "$(dirname "$0")/.."
 JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
-  tests/test_serve_faults.py tests/test_serve_fabric.py -v -m faults \
+  tests/test_serve_faults.py tests/test_serve_fabric.py \
+  tests/test_acquire.py -v -m faults \
   -p no:cacheprovider "$@"
 echo "fault matrix passed"

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# QBDC (query-by-dropout-committee) vs stored-committee mc (ISSUE 6
+# acceptance: the K-sweep artifact with per-user device memory at K=64
+# below the 20-model stored-committee footprint).
+#
+# Runs `bench.py --suite qbdc`: ONE personalized CNN forwarded under K
+# seeded dropout masks (Committee.qbdc_pool_probs -> the fused
+# consensus->entropy->top-k graph) against the paper's 20-stored-model mc
+# committee on an identical synthetic waveform workload.  Reports
+# per-pass scoring throughput across K in {8, 20, 64}, top-k overlap vs
+# the stored ensemble, per-user device parameter bytes, and end-to-end
+# AL users/sec — interleaved best-of-reps windows (throttled-image
+# discipline).
+#
+# The JSON line goes to stdout (redirect to BENCH_qbdc_r<N>.json to
+# commit an artifact); the per-window log goes to stderr.  Extra bench
+# args pass through, e.g.:
+#   scripts/qbdc_bench.sh --qbdc-sweep 8 20 64 128 --pool 96
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --suite qbdc \
+    --al-epochs 2 --k 5 "$@"

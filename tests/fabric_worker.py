@@ -30,6 +30,7 @@ def main(argv) -> int:
         build_entry_factory,
         configure_jax,
         make_cfg,
+        retrain_epochs_for,
         user_specs,
     )
 
@@ -60,6 +61,7 @@ def main(argv) -> int:
             os.fsync(f.fileno())
 
     scheduler = FleetScheduler(cfg, report=FleetReport(),
+                               retrain_epochs=retrain_epochs_for(mode),
                                scoring_by_width=True)
     try:
         with PreemptionGuard() as guard:

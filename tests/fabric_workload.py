@@ -43,7 +43,24 @@ def make_cfg(mode: str = "mc", epochs: int = 2, queries: int = 4):
 
     # float32 checkpoints: resume (failover included) replays bit-exactly
     return ALConfig(queries=queries, epochs=epochs, mode=mode, seed=7,
-                    ckpt_dtype="float32")
+                    ckpt_dtype="float32", qbdc_k=6)
+
+
+def tiny_cnn_configs():
+    """The tiny CNN geometry the qbdc fabric rows run on (matches the CNN
+    fleet/acquire tests; workers rebuild it from these constants, so the
+    in-process baselines and the subprocess engines agree)."""
+    from consensus_entropy_tpu.config import CNNConfig, TrainConfig
+
+    return (CNNConfig(n_channels=4, n_mels=32, n_layers=5,
+                      input_length=8192),
+            TrainConfig(batch_size=2))
+
+
+def retrain_epochs_for(mode: str):
+    """CNN retrain epochs per AL iteration for the synthetic workload
+    (qbdc only; host-committee modes have no CNN retrain)."""
+    return 1 if mode == "qbdc" else None
 
 
 def user_specs(n_users: int, n_songs: int = 30) -> list:
@@ -51,7 +68,8 @@ def user_specs(n_users: int, n_songs: int = 30) -> list:
     return [(100 + i, f"u{i}", n_songs) for i in range(int(n_users))]
 
 
-def make_data(seed: int, uid: str, n_songs: int = 30, f: int = 10):
+def make_data(seed: int, uid: str, n_songs: int = 30, f: int = 10,
+              mode: str = "mc"):
     from consensus_entropy_tpu.al.loop import UserData
     from consensus_entropy_tpu.models.committee import FramePool
 
@@ -70,16 +88,40 @@ def make_data(seed: int, uid: str, n_songs: int = 30, f: int = 10):
     counts = rng.integers(1, 30, size=(n_songs, 4))
     hc = np.round(counts / counts.sum(1, keepdims=True),
                   3).astype(np.float32)
-    return UserData(uid, pool, labels, hc_rows=hc)
+    data = UserData(uid, pool, labels, hc_rows=hc)
+    if mode == "qbdc":
+        # seeded waveform store for the dropout committee's CNN (both
+        # processes rebuild identical waves from the spec seed)
+        from consensus_entropy_tpu.data.audio import DeviceWaveformStore
+
+        cnn_cfg, _ = tiny_cnn_configs()
+        wrng = np.random.default_rng(seed + 7)
+        waves = {s: wrng.standard_normal(9000).astype(np.float32)
+                 for s in pool.song_ids}
+        data.store = DeviceWaveformStore(waves, cnn_cfg.input_length)
+    return data
 
 
-def make_committee(data, sgd_name: str = "sgd.it_0"):
+def make_committee(data, sgd_name: str = "sgd.it_0", mode: str = "mc",
+                   cnn_seed: int = 5):
     from consensus_entropy_tpu.models.committee import Committee
     from consensus_entropy_tpu.models.sklearn_members import (
         GNBMember,
         SGDMember,
     )
 
+    if mode == "qbdc":
+        import jax
+
+        from consensus_entropy_tpu.models import short_cnn
+        from consensus_entropy_tpu.models.committee import CNNMember
+
+        cnn_cfg, tc = tiny_cnn_configs()
+        member = CNNMember(
+            "cnn0",
+            short_cnn.init_variables(jax.random.key(cnn_seed), cnn_cfg),
+            cnn_cfg, tc)
+        return Committee([], [member], cnn_cfg, tc)
     X = data.pool.X
     y = np.array([data.labels[s] for s in np.repeat(
         data.pool.song_ids, data.pool.counts)], np.int32)
@@ -87,28 +129,39 @@ def make_committee(data, sgd_name: str = "sgd.it_0"):
                       SGDMember(sgd_name, seed=0).fit(X, y)], [])
 
 
+def load_workspace_committee(path: str, mode: str):
+    """Reload a workspace committee with the mode's geometry (qbdc
+    checkpoints are the tiny CNN and need its config at load)."""
+    from consensus_entropy_tpu.al import workspace
+
+    if mode == "qbdc":
+        cnn_cfg, tc = tiny_cnn_configs()
+        return workspace.load_committee(path, cnn_cfg, tc)
+    return workspace.load_committee(path)
+
+
 def build_entry_factory(ws_root: str, cfg, specs):
     """``build_entry(uid) -> FleetUser`` over persistent per-user
     workspaces under ``ws_root``: a fresh workspace gets a fresh
     committee, one holding mid-run state (the previous host's durable
     checkpoints) resumes from its own files — the fabric failover path."""
-    from consensus_entropy_tpu.al import workspace
     from consensus_entropy_tpu.fleet import FleetUser
 
     by = {uid: (seed, uid, n) for seed, uid, n in specs}
 
     def build_entry(uid):
         seed, _, n = by[str(uid)]
-        data = make_data(seed, str(uid), n_songs=n)
+        data = make_data(seed, str(uid), n_songs=n, mode=cfg.mode)
         fp = os.path.join(ws_root, f"fab_{uid}")
         os.makedirs(fp, exist_ok=True)
         if os.path.exists(os.path.join(fp, "al_state.json")):
-            committee = workspace.load_committee(fp)
+            committee = load_workspace_committee(fp, cfg.mode)
         else:
-            committee = make_committee(data)
+            committee = make_committee(data, mode=cfg.mode)
         return FleetUser(
             str(uid), committee, data, fp, seed=cfg.seed,
-            committee_factory=lambda fp=fp: workspace.load_committee(fp))
+            committee_factory=lambda fp=fp: load_workspace_committee(
+                fp, cfg.mode))
 
     return build_entry
 
@@ -119,11 +172,13 @@ def sequential_baselines(ws_root: str, cfg, specs) -> dict:
     from consensus_entropy_tpu.al.loop import ALLoop
 
     out = {}
+    loop = ALLoop(cfg, retrain_epochs=retrain_epochs_for(cfg.mode))
     for seed, uid, n in specs:
-        data = make_data(seed, uid, n_songs=n)
+        data = make_data(seed, uid, n_songs=n, mode=cfg.mode)
         p = os.path.join(ws_root, f"seq_{uid}")
         os.makedirs(p)
-        out[uid] = ALLoop(cfg).run_user(make_committee(data), data, p)
+        out[uid] = loop.run_user(make_committee(data, mode=cfg.mode),
+                                 data, p)
     return out
 
 

@@ -1,0 +1,412 @@
+"""Acquisition registry: strategy round-trip, qbdc, wmc.
+
+Tier-1 keeps the registry units, the wmc==mc exact-equality pins, the
+weights-before-mask ordering pin, the fleet-scoring parity rows and the
+host-mode registry round-trip (a 2-user fleet smoke per registered mode);
+the qbdc fleet round and the qbdc resume drill are ``slow`` (the serve
+journal-restart qbdc acceptance case in ``tests/test_serve_faults.py`` is
+the tier-1 qbdc pin).
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from consensus_entropy_tpu import acquire
+from consensus_entropy_tpu.acquire.base import AcquisitionStrategy
+from consensus_entropy_tpu.al import state as al_state
+from consensus_entropy_tpu.al import workspace
+from consensus_entropy_tpu.al.loop import ALLoop
+from consensus_entropy_tpu.config import ALConfig, CNNConfig, TrainConfig
+from consensus_entropy_tpu.fleet import FleetScheduler, FleetUser
+from consensus_entropy_tpu.ops import scoring
+from tests.test_fleet import _cfg, _committee, _user_data
+
+pytestmark = pytest.mark.acquire
+
+TINY_CNN = CNNConfig(n_channels=4, n_mels=32, n_layers=5, input_length=8192)
+TINY_TC = TrainConfig(batch_size=2)
+
+
+# -- registry units --------------------------------------------------------
+
+
+def test_registry_lists_all_modes_and_rejects_unknown():
+    modes = acquire.available_modes()
+    assert ("mc", "hc", "mix", "rand") == modes[:4]  # the paper's four
+    assert {"qbdc", "wmc"} <= set(modes)
+    with pytest.raises(ValueError, match="unknown mode"):
+        acquire.get("zzz")
+    for m in modes:
+        assert acquire.get(m).name == m
+
+
+def test_registry_rejects_conflicting_reregistration():
+    class Imposter(AcquisitionStrategy):
+        name = "mc"
+
+    with pytest.raises(ValueError, match="already registered"):
+        acquire.register(Imposter())
+    # same-class re-registration is an idempotent no-op
+    acquire.register(acquire.MachineConsensus())
+    assert type(acquire.get("mc")) is acquire.MachineConsensus
+
+    class Nameless(AcquisitionStrategy):
+        pass
+
+    with pytest.raises(ValueError, match="no name"):
+        acquire.register(Nameless())
+
+
+def test_strategy_flags_drive_the_machinery():
+    """The attributes the loop/acquirer branch on, per mode."""
+    flags = {m: acquire.get(m) for m in acquire.available_modes()}
+    assert [flags[m].needs_probs for m in ("mc", "mix", "qbdc", "wmc")] \
+        == [True] * 4
+    assert not flags["hc"].needs_probs and not flags["rand"].needs_probs
+    assert flags["qbdc"].probs_source == "qbdc"
+    assert flags["wmc"].uses_weights
+    assert flags["hc"].uses_hc_table and flags["hc"].uses_hc_entropy
+    assert flags["mix"].uses_hc_table and not flags["mix"].uses_hc_entropy
+
+
+# -- wmc scoring pins ------------------------------------------------------
+
+
+def _probs(rng, m, n, c=4):
+    p = rng.uniform(0.01, 1.0, size=(m, n, c)).astype(np.float32)
+    return p / p.sum(axis=-1, keepdims=True)
+
+
+def test_wmc_equal_weights_is_exactly_mc(rng):
+    """THE degradation pin: uniform reliability weights reduce wmc to mc
+    BIT-IDENTICALLY (entropies, values, indices), through the jitted
+    production fns — wmc runs can be compared against mc baselines with
+    ``==``, no tolerance."""
+    p = _probs(rng, 5, 96)
+    mask = np.zeros(96, bool)
+    mask[:80] = True
+    fns = scoring.make_scoring_fns(k=7)
+    mc = fns["mc"](p, mask)
+    wmc = fns["wmc"](p, mask, np.ones(5, np.float32))
+    np.testing.assert_array_equal(np.asarray(mc.entropy),
+                                  np.asarray(wmc.entropy))
+    np.testing.assert_array_equal(np.asarray(mc.values),
+                                  np.asarray(wmc.values))
+    np.testing.assert_array_equal(np.asarray(mc.indices),
+                                  np.asarray(wmc.indices))
+    # qbdc shares mc's graph outright (distinct key, same scorer)
+    qb = fns["qbdc"](p, mask)
+    np.testing.assert_array_equal(np.asarray(mc.entropy),
+                                  np.asarray(qb.entropy))
+
+
+def test_wmc_weights_reorder_the_ranking(rng):
+    """Non-uniform weights actually change the consensus: an all-certain
+    committee outvoted by one up-weighted uncertain member flips the
+    ranking toward the member the weights trust."""
+    n = 16
+    p = np.zeros((2, n, 4), np.float32)
+    p[:, :, 0] = 1.0            # member 0+1 baseline: everything certain
+    p[1, 3, :] = 0.25           # member 1 is uncertain about song 3
+    mask = np.ones(n, bool)
+    fns = scoring.make_scoring_fns(k=1)
+    lo = fns["wmc"](p, mask, np.array([1.0, 0.01], np.float32))
+    hi = fns["wmc"](p, mask, np.array([0.01, 1.0], np.float32))
+    assert int(np.asarray(hi.indices)[0]) == 3
+    assert float(np.asarray(hi.values)[0]) \
+        > float(np.asarray(lo.values)[0])
+
+
+def test_wmc_quarantine_mask_zeroes_weight_before_renormalization(rng):
+    """The ordering fix: a quarantined member with a stale (huge) weight
+    contributes NOTHING — masked wmc equals wmc with that weight set to
+    zero, bit-for-bit, and equals scoring the surviving members alone."""
+    p = _probs(rng, 4, 48)
+    mask = np.zeros(48, bool)
+    mask[:40] = True
+    stale = np.array([1.0, 1e6, 1.0, 1.0], np.float32)  # member 1 stale
+    mmask = np.array([True, False, True, True])
+    a = scoring.score_wmc(p, mask, stale, k=5, member_mask=mmask)
+    zeroed = stale.copy()
+    zeroed[1] = 0.0
+    b = scoring.score_wmc(p, mask, zeroed, k=5, member_mask=mmask)
+    np.testing.assert_array_equal(np.asarray(a.entropy),
+                                  np.asarray(b.entropy))
+    np.testing.assert_array_equal(np.asarray(a.indices),
+                                  np.asarray(b.indices))
+    # and the ranking is the survivors': the stale weight never re-enters
+    survivors = scoring.score_wmc(p[[0, 2, 3]], mask,
+                                  np.ones(3, np.float32), k=5)
+    np.testing.assert_allclose(np.asarray(a.entropy)[mask],
+                               np.asarray(survivors.entropy)[mask],
+                               rtol=1e-6)
+
+
+# -- fleet batched parity for the new fn keys ------------------------------
+
+
+def test_fleet_wmc_and_qbdc_match_single(rng):
+    """Every row of the vmapped wmc/qbdc fleet scorers is bit-identical
+    to the single-user jitted fn — the same contract the four paper modes
+    are pinned to in tests/test_fleet_scoring.py."""
+    u, m, n, k = 3, 6, 64, 5
+    p = np.stack([_probs(rng, m, n) for _ in range(u)])
+    mask = np.zeros((u, n), bool)
+    mask[:, :56] = True
+    w = rng.uniform(0.1, 2.0, size=(u, m)).astype(np.float32)
+    fleet = scoring.make_fleet_scoring_fns(k=k)
+    single = scoring.make_scoring_fns(k=k)
+    res_w = fleet["wmc"](p, mask, w)
+    res_q = fleet["qbdc"](p, mask)
+    for i in range(u):
+        sw = single["wmc"](p[i], mask[i], w[i])
+        sq = single["qbdc"](p[i], mask[i])
+        for batched, s in ((res_w, sw), (res_q, sq)):
+            np.testing.assert_array_equal(np.asarray(batched.values[i]),
+                                          np.asarray(s.values))
+            np.testing.assert_array_equal(np.asarray(batched.indices[i]),
+                                          np.asarray(s.indices))
+            np.testing.assert_array_equal(np.asarray(batched.entropy[i]),
+                                          np.asarray(s.entropy))
+
+    mm = np.ones((u, m), bool)
+    mm[0, 2] = mm[2, 5] = False
+
+    def one(pp, pm, ww, mmm):
+        return scoring.score_wmc(pp, pm, ww, k=k, member_mask=mmm,
+                                 tie_break="fast")
+
+    jone = jax.jit(one)
+    res_m = fleet["wmc_masked"](p, mask, w, mm)
+    for i in range(u):
+        s = jone(p[i], mask[i], w[i], mm[i])
+        np.testing.assert_array_equal(np.asarray(res_m.entropy[i]),
+                                      np.asarray(s.entropy))
+        np.testing.assert_array_equal(np.asarray(res_m.indices[i]),
+                                      np.asarray(s.indices))
+
+
+def test_bucket_families_carry_registry_modes():
+    """Per-width serve families expose every registered probs mode and
+    keep the width guard on the new keys."""
+    fns = scoring.fleet_scoring_fns_for_width(k=4, width=32)
+    assert {"qbdc", "wmc", "wmc_masked"} <= set(fns)
+    bad = np.ones((2, 5, 48, 4), np.float32)
+    with pytest.raises(ValueError, match="bucket routing"):
+        fns["wmc"](bad, np.ones((2, 48), bool), np.ones((2, 5), np.float32))
+
+
+# -- qbdc probs producer ---------------------------------------------------
+
+
+def _cnn_data(seed, uid, n_songs=8):
+    from consensus_entropy_tpu.data.audio import DeviceWaveformStore
+
+    data = _user_data(seed, uid, n_songs=n_songs)
+    wrng = np.random.default_rng(seed + 7)
+    waves = {s: wrng.standard_normal(9000).astype(np.float32)
+             for s in data.pool.song_ids}
+    data.store = DeviceWaveformStore(waves, TINY_CNN.input_length)
+    return data
+
+
+def _cnn_committee(data, *, seed=5):
+    from consensus_entropy_tpu.models import short_cnn
+    from consensus_entropy_tpu.models.committee import CNNMember, Committee
+
+    member = CNNMember(
+        "cnn0", short_cnn.init_variables(jax.random.key(seed), TINY_CNN),
+        TINY_CNN, TINY_TC)
+    return Committee([], [member], TINY_CNN, TINY_TC)
+
+
+def test_qbdc_pool_probs_shape_determinism_and_mask_diversity():
+    data = _cnn_data(300, "u0")
+    committee = _cnn_committee(data)
+    key = jax.random.key(42)
+    songs = data.pool.song_ids
+    p1 = np.asarray(committee.qbdc_pool_probs(data.store, songs, key, k=5))
+    p2 = np.asarray(committee.qbdc_pool_probs(data.store, songs, key, k=5))
+    assert p1.shape == (5, len(songs), 4)
+    np.testing.assert_array_equal(p1, p2)  # same key -> bit-identical
+    # distinct masks actually disagree (a committee, not 5 copies)
+    assert np.abs(p1[0] - p1[1]).max() > 0
+    # rows are probabilities of a sigmoid head: in (0, 1), finite
+    assert np.all(np.isfinite(p1)) and p1.min() > 0 and p1.max() < 1
+    # the staging-pad contract mirrors pool_probs: live columns identical
+    padded = np.asarray(committee.qbdc_pool_probs(data.store, songs, key,
+                                                  k=5, pad_to=300))
+    assert padded.shape == (5, 300, 4)
+    np.testing.assert_array_equal(padded[:, :len(songs)], p1)
+    with pytest.raises(ValueError, match="pad_to"):
+        committee.qbdc_pool_probs(data.store, songs, key, k=5, pad_to=2)
+    with pytest.raises(ValueError, match=">= 1"):
+        committee.qbdc_pool_probs(data.store, songs, key, k=0)
+
+
+def test_qbdc_requires_a_cnn_member():
+    data = _user_data(301, "u0", n_songs=6)
+    committee = _committee(data)  # host-only
+    with pytest.raises(ValueError, match="CNN member"):
+        committee.qbdc_pool_probs(None, data.pool.song_ids,
+                                  jax.random.key(0), k=4)
+
+
+@pytest.mark.faults
+def test_qbdc_mask_sampler_is_a_fault_point():
+    from consensus_entropy_tpu.resilience import faults
+    from consensus_entropy_tpu.resilience.faults import (
+        FaultRule,
+        InjectedKill,
+    )
+
+    data = _cnn_data(302, "u0", n_songs=4)
+    committee = _cnn_committee(data)
+    with faults.inject(FaultRule("acquire.qbdc.masks", "kill")) as inj:
+        with pytest.raises(InjectedKill):
+            committee.qbdc_pool_probs(data.store, data.pool.song_ids,
+                                      jax.random.key(1), k=3)
+    assert inj.fired and inj.fired[0]["point"] == "acquire.qbdc.masks"
+
+
+# -- registry round-trip: every mode through the 2-user fleet --------------
+
+
+HOST_MODES = ("mc", "hc", "mix", "rand", "wmc")
+
+
+@pytest.mark.fleet
+@pytest.mark.parametrize("mode", HOST_MODES)
+def test_registry_roundtrip_fleet_smoke(tmp_path, mode):
+    """Every registered host-committee mode runs a 2-user fleet cohort
+    with per-user trajectories identical to sequential runs — new modes
+    inherit the engine by registration, not by plumbing."""
+    cfg = _cfg(mode=mode, epochs=2)
+    seq, entries = [], []
+    for i in range(2):
+        data = _user_data(100 + i, f"u{i}")
+        sp = tmp_path / f"seq_u{i}"
+        sp.mkdir()
+        seq.append(ALLoop(cfg).run_user(_committee(data), data, str(sp)))
+        fp = tmp_path / f"fleet_u{i}"
+        fp.mkdir()
+        entries.append(FleetUser(f"u{i}", _committee(data), data, str(fp),
+                                 seed=cfg.seed))
+    recs = FleetScheduler(cfg).run(entries)
+    for s, r in zip(seq, recs):
+        assert r["error"] is None
+        assert r["result"]["trajectory"] == s["trajectory"]
+
+
+@pytest.mark.fleet
+@pytest.mark.slow
+def test_registry_roundtrip_fleet_smoke_qbdc(tmp_path):
+    """The qbdc round of the registry round-trip: a 2-user dropout-
+    committee cohort matches sequential bit-for-bit (the tier-1 qbdc pin
+    is the serve journal-restart case in tests/test_serve_faults.py)."""
+    cfg = ALConfig(queries=3, epochs=2, mode="qbdc", seed=7,
+                   ckpt_dtype="float32", qbdc_k=6)
+    seq, entries = [], []
+    for i in range(2):
+        data = _cnn_data(100 + i, f"u{i}")
+        sp = tmp_path / f"seq_u{i}"
+        sp.mkdir()
+        seq.append(ALLoop(cfg, retrain_epochs=1).run_user(
+            _cnn_committee(data), data, str(sp)))
+        fp = tmp_path / f"fleet_u{i}"
+        fp.mkdir()
+        entries.append(FleetUser(f"u{i}", _cnn_committee(data), data,
+                                 str(fp), seed=cfg.seed))
+    recs = FleetScheduler(cfg, retrain_epochs=1).run(entries)
+    for s, r in zip(seq, recs):
+        assert r["error"] is None
+        assert r["result"]["trajectory"] == s["trajectory"]
+
+
+# -- wmc end-to-end --------------------------------------------------------
+
+
+def test_wmc_uniform_weighting_matches_mc_run(tmp_path, rng):
+    """End-to-end degradation pin: a wmc run under 'uniform' weighting
+    queries the same songs and lands the same trajectory as mc."""
+    data = _user_data(400, "u0")
+    mc = ALConfig(queries=4, epochs=3, mode="mc", seed=7,
+                  ckpt_dtype="float32")
+    wu = ALConfig(queries=4, epochs=3, mode="wmc", seed=7,
+                  ckpt_dtype="float32", consensus_weighting="uniform")
+    res = {}
+    for name, cfg in (("mc", mc), ("wmc", wu)):
+        p = tmp_path / name
+        p.mkdir()
+        res[name] = (ALLoop(cfg).run_user(_committee(data), data, str(p)),
+                     al_state.ALState.load(str(p)))
+    assert res["mc"][0]["trajectory"] == res["wmc"][0]["trajectory"]
+    assert res["mc"][1].queried == res["wmc"][1].queried
+    # uniform weighting persists no drifting weights: all exactly 1.0
+    assert set((res["wmc"][1].member_weights or {}).values()) <= {1.0}
+
+
+def test_wmc_agreement_updates_and_resumes_bit_identically(tmp_path, rng):
+    """The agreement EMA moves weights after each reveal, the weights
+    ride ALState, and a mid-run resume replays the straight run exactly
+    (weights restored, not re-derived)."""
+    data = _user_data(401, "u0")
+    full_cfg = ALConfig(queries=4, epochs=4, mode="wmc", seed=11,
+                        ckpt_dtype="float32")
+    d_full = tmp_path / "full"
+    d_full.mkdir()
+    res_full = ALLoop(full_cfg).run_user(_committee(data), data,
+                                         str(d_full), seed=11)
+    st_full = al_state.ALState.load(str(d_full))
+    assert st_full.member_weights  # populated, name-keyed
+    assert set(st_full.member_weights) == {"gnb.it_0", "sgd.it_0"}
+    for w in st_full.member_weights.values():
+        assert 0.0 <= w <= 1.0  # EMA of agreements from a 1.0 start
+
+    d_part = tmp_path / "part"
+    d_part.mkdir()
+    part_cfg = ALConfig(queries=4, epochs=2, mode="wmc", seed=11,
+                        ckpt_dtype="float32")
+    ALLoop(part_cfg).run_user(_committee(data), data, str(d_part), seed=11)
+    committee2 = workspace.load_committee(str(d_part))
+    res_resumed = ALLoop(full_cfg).run_user(committee2, data, str(d_part),
+                                            seed=11)
+    assert res_resumed["trajectory"] == res_full["trajectory"]
+    st_part = al_state.ALState.load(str(d_part))
+    assert st_part.queried == st_full.queried
+    assert st_part.member_weights == st_full.member_weights
+
+
+# -- qbdc resume determinism (slow; serve-restart is the tier-1 pin) -------
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_qbdc_resume_matches_straight_run(tmp_path):
+    """A qbdc run killed at the iteration boundary resumes with identical
+    queries and trajectory: mask keys fold from the checkpointed PRNG
+    stream, so the dropout committee is bit-identical across the cut."""
+    data = _cnn_data(500, "u0", n_songs=10)
+    full_cfg = ALConfig(queries=3, epochs=3, mode="qbdc", seed=11,
+                        ckpt_dtype="float32", qbdc_k=6)
+    d_full = tmp_path / "full"
+    d_full.mkdir()
+    res_full = ALLoop(full_cfg, retrain_epochs=1).run_user(
+        _cnn_committee(data), data, str(d_full), seed=11)
+
+    d_part = tmp_path / "part"
+    d_part.mkdir()
+    part_cfg = ALConfig(queries=3, epochs=1, mode="qbdc", seed=11,
+                        ckpt_dtype="float32", qbdc_k=6)
+    ALLoop(part_cfg, retrain_epochs=1).run_user(
+        _cnn_committee(data), data, str(d_part), seed=11)
+    committee2 = workspace.load_committee(str(d_part), TINY_CNN, TINY_TC)
+    res_resumed = ALLoop(full_cfg, retrain_epochs=1).run_user(
+        committee2, data, str(d_part), seed=11)
+    assert res_resumed["trajectory"] == res_full["trajectory"]
+    assert al_state.ALState.load(str(d_part)).queried \
+        == al_state.ALState.load(str(d_full)).queried
+    assert os.path.exists(d_part / "classifier_cnn.cnn0.msgpack")

@@ -58,7 +58,13 @@ def test_grouped_split_fractions(rng):
     assert set(np.unique(split.y_test_frames)) <= {0, 1, 2, 3}
 
 
-@pytest.mark.parametrize("mode", ["mc", "hc", "mix", "rand"])
+#: hc/mix rows slow-marked: see tests/test_resume.py's matrix note
+@pytest.mark.parametrize("mode", [
+    "mc",
+    pytest.param("hc", marks=pytest.mark.slow),
+    pytest.param("mix", marks=pytest.mark.slow),
+    "rand",
+])
 def test_al_loop_all_modes_run(rng, tmp_path, mode):
     data = _user_data(rng)
     com = _weak_committee(rng, data)

@@ -427,6 +427,77 @@ def test_fabric_cli_matches_sequential(synth_roots, capsys):
     assert '"users": 0' in capsys.readouterr().out
 
 
+@pytest.mark.slow
+@pytest.mark.serve
+@pytest.mark.acquire
+def test_qbdc_cli_serve_hosts_matches_sequential(synth_roots, tmp_path,
+                                                 rng, capsys):
+    """ISSUE 6 acceptance: ``--al-mode qbdc`` runs under ``--serve N
+    --hosts H`` — a CNN registry pretrained via the CLI, then a 2-host
+    dropout-committee fabric whose per-user metrics are bit-identical to
+    the sequential qbdc CLI over the same tree."""
+    import shutil
+
+    tiny = ('{"n_channels": 4, "n_fft": 64, "hop_length": 32, "n_mels": 16,'
+            ' "n_layers": 2, "input_length": 1024}')
+    flags = ["--deam-root", synth_roots["deam"],
+             "--amg-root", synth_roots["amg"], "--device", "cpu"]
+    for root, ids in ((synth_roots["deam"], range(1, 25)),
+                      (synth_roots["amg"], range(201, 241))):
+        npy = os.path.join(root, "npy")
+        os.makedirs(npy, exist_ok=True)
+        for sid in ids:
+            np.save(os.path.join(npy, f"{sid}.npy"),
+                    (rng.standard_normal(1600) * 0.05).astype(np.float32))
+    seq_mr = os.path.join(synth_roots["models"], "seqq")
+    fab_mr = os.path.join(synth_roots["models"], "fabq")
+    assert deam_classifier.main(
+        ["-cv", "1", "-m", "cnn_jax", "--epochs", "1",
+         "--cnn-config-json", tiny, "--models-root", seq_mr] + flags) == 0
+    shutil.copytree(os.path.join(seq_mr, "pretrained"),
+                    os.path.join(fab_mr, "pretrained"))
+    al = ["-q", "3", "-e", "2", "--al-mode", "qbdc", "-n", "10",
+          "--qbdc-k", "6", "--retrain-epochs", "1",
+          "--cnn-config-json", tiny, "--max-users", "2"]
+    assert amg_test.main(al + ["--models-root", seq_mr] + flags) == 0
+    fab = al + ["--serve", "2", "--hosts", "2", "--lease-s", "10",
+                "--models-root", fab_mr]
+    assert amg_test.main(fab + flags) == 0
+    out = capsys.readouterr().out
+    assert "fabric summary:" in out
+    seq_users = os.path.join(seq_mr, "users")
+    fab_users = os.path.join(fab_mr, "users")
+    uids = sorted(os.listdir(seq_users))
+    assert len(uids) == 2
+    for uid in uids:
+        fd = os.path.join(fab_users, uid, "qbdc")
+        assert os.path.exists(os.path.join(fd, "DONE"))
+        seq_recs = [json.loads(l) for l in open(
+            os.path.join(seq_users, uid, "qbdc", "metrics.jsonl"))]
+        fab_recs = [json.loads(l)
+                    for l in open(os.path.join(fd, "metrics.jsonl"))]
+        assert fab_recs == seq_recs
+    from consensus_entropy_tpu.serve import AdmissionJournal
+
+    st = AdmissionJournal(
+        os.path.join(fab_users, "serve_journal.jsonl")).state
+    assert st.finished == set(uids) and not st.pending
+
+
+def test_qbdc_cli_requires_cnn_registry(synth_roots, capsys):
+    """``--al-mode qbdc`` against a host-only registry is a clean error,
+    and ``--qbdc-k`` is validated."""
+    flags = ["--models-root", synth_roots["models"],
+             "--deam-root", synth_roots["deam"],
+             "--amg-root", synth_roots["amg"], "--device", "cpu"]
+    assert deam_classifier.main(["-cv", "2", "-m", "gnb"] + flags) == 0
+    base = ["-q", "3", "-e", "1", "-m", "qbdc", "-n", "10"]
+    assert amg_test.main(base + ["--qbdc-k", "0"] + flags) == 1
+    assert "--qbdc-k" in capsys.readouterr().out
+    assert amg_test.main(base + flags) == 1
+    assert "needs pre-trained CNN members" in capsys.readouterr().out
+
+
 def test_pretrain_classic_parallel_folds_match_sequential(tmp_path, rng):
     """n_jobs>1 (the reference's cross_validate(n_jobs=10) fold pool,
     deam_classifier.py:326) must produce identical metrics and artifacts

@@ -94,12 +94,12 @@ def _run(data, path, mode="mc", epochs=4, seed=11, committee=None, **kw):
 #: commit, multihost.sync once at run end), and the modes where the point
 #: fires at all (member.predict / pool.score only exist on mc/mix paths).
 BOUNDARIES = {
-    "checkpoint.write": (3, ("mc", "hc", "mix", "rand")),
-    "member.retrain": (3, ("mc", "hc", "mix", "rand")),
-    "member.predict": (3, ("mc", "mix")),
-    "pool.score": (2, ("mc", "mix")),
-    "state.save": (2, ("mc", "hc", "mix", "rand")),
-    "multihost.sync": (1, ("mc", "hc", "mix", "rand")),
+    "checkpoint.write": (3, ("mc", "hc", "mix", "rand", "wmc")),
+    "member.retrain": (3, ("mc", "hc", "mix", "rand", "wmc")),
+    "member.predict": (3, ("mc", "mix", "wmc")),
+    "pool.score": (2, ("mc", "mix", "wmc")),
+    "state.save": (2, ("mc", "hc", "mix", "rand", "wmc")),
+    "multihost.sync": (1, ("mc", "hc", "mix", "rand", "wmc")),
 }
 
 _MATRIX = [
@@ -130,6 +130,54 @@ def test_kill_at_every_boundary(tmp_path, rng, mode, point, at):
 
     committee2 = workspace.load_committee(str(d))
     res2 = _run(data, d, mode=mode, committee=committee2)
+    assert res2["trajectory"] == res_base["trajectory"]
+    assert (al_state.ALState.load(str(d)).queried
+            == al_state.ALState.load(str(base)).queried)
+
+
+#: qbdc kill rows: the dropout committee's own boundary (the mask
+#: sampler) plus the shared ones its iterations cross.  Hit indices land
+#: mid-run for the 1-CNN-member committee (masks/pool.score fire once per
+#: scored iteration, state.save once per commit, checkpoint.write once
+#: per member msgpack per generation).
+QBDC_BOUNDARIES = [("acquire.qbdc.masks", 2), ("pool.score", 2),
+                   ("state.save", 2), ("checkpoint.write", 2)]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("point,at", QBDC_BOUNDARIES,
+                         ids=[p for p, _ in QBDC_BOUNDARIES])
+def test_qbdc_kill_at_every_boundary(tmp_path, point, at):
+    """The qbdc rows of the kill matrix: a dropout-committee run killed
+    at the named boundary — including the mode's OWN fault point, the
+    mask sampler — resumes to the unfaulted trajectory bit-for-bit (mask
+    keys fold from the checkpointed PRNG stream)."""
+    from tests.test_acquire import (
+        TINY_CNN,
+        TINY_TC,
+        _cnn_committee,
+        _cnn_data,
+    )
+
+    cfg = ALConfig(queries=3, epochs=3, mode="qbdc", seed=11,
+                   ckpt_dtype="float32", qbdc_k=6)
+    data = _cnn_data(600, "u0", n_songs=10)
+    base = tmp_path / "base"
+    base.mkdir()
+    res_base = ALLoop(cfg, retrain_epochs=1).run_user(
+        _cnn_committee(data), data, str(base), seed=11)
+
+    d = tmp_path / "faulted"
+    d.mkdir()
+    with faults.inject(FaultRule(point=point, action="kill", at=at)) as inj:
+        with pytest.raises(InjectedKill):
+            ALLoop(cfg, retrain_epochs=1).run_user(
+                _cnn_committee(data), data, str(d), seed=11)
+        assert inj.fired, f"{point} never fired — boundary not exercised"
+
+    committee2 = workspace.load_committee(str(d), TINY_CNN, TINY_TC)
+    res2 = ALLoop(cfg, retrain_epochs=1).run_user(committee2, data, str(d),
+                                                  seed=11)
     assert res2["trajectory"] == res_base["trajectory"]
     assert (al_state.ALState.load(str(d)).queried
             == al_state.ALState.load(str(base)).queried)

@@ -456,10 +456,14 @@ def test_fabric_two_hosts_worker_sigkill_recovers_all_users(tmp_path):
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("mode", ["hc", "mix", "rand"])
+@pytest.mark.parametrize("mode", ["hc", "mix", "rand", "wmc", "qbdc"])
 def test_fabric_kill_matrix_all_modes(tmp_path, mode):
     """Acceptance: the same worker-SIGKILL recovery is bit-identical in
-    every acquisition mode (mc is the tier-1 case above)."""
+    every acquisition mode (mc is the tier-1 case above) — including the
+    registry extensions: wmc's reliability weights ride ALState through
+    the failover resume, and qbdc's dropout-mask keys fold from the
+    checkpointed PRNG stream, so the re-routed users' committees are the
+    SAME committees on the surviving host."""
     _fabric_kill_drill(tmp_path, mode)
 
 

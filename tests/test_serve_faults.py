@@ -198,11 +198,15 @@ def _entries(tmp_path, cfg, specs, committee_fn=_committee):
     return out
 
 
-def _restart_drill(tmp_path, cfg, specs, rule, *, target_live=2):
+def _restart_drill(tmp_path, cfg, specs, rule, *, target_live=2,
+                   entries_fn=None, scheduler_kw=None):
     """Kill a serving run at ``rule``'s boundary, restart from the
     journal, return ``{user: last result}`` over both segments plus the
-    second segment's report."""
+    second segment's report.  ``entries_fn``/``scheduler_kw`` let modes
+    with non-default committees (qbdc's CNN) ride the same drill."""
     jpath = str(tmp_path / "serve_journal.jsonl")
+    entries_fn = entries_fn or _entries
+    scheduler_kw = scheduler_kw or {}
     done: dict = {}
 
     def on_result(rec):
@@ -211,11 +215,11 @@ def _restart_drill(tmp_path, cfg, specs, rule, *, target_live=2):
     with faults.inject(rule) as inj:
         journal = AdmissionJournal(jpath)
         sched = FleetScheduler(cfg, report=FleetReport(),
-                               scoring_by_width=True)
+                               scoring_by_width=True, **scheduler_kw)
         server = FleetServer(sched, ServeConfig(target_live=target_live),
                              journal=journal)
         with pytest.raises(InjectedKill):
-            server.serve(iter(_entries(tmp_path, cfg, specs)),
+            server.serve(iter(entries_fn(tmp_path, cfg, specs)),
                          on_result=on_result)
         assert inj.fired, f"{rule.point} never fired"
         journal.close()
@@ -223,9 +227,10 @@ def _restart_drill(tmp_path, cfg, specs, rule, *, target_live=2):
     journal = AdmissionJournal(jpath)
     assert journal.recovered
     order = journal.state.recovery_order([uid for _, uid, _ in specs])
-    emap = {e.user_id: e for e in _entries(tmp_path, cfg, specs)}
+    emap = {e.user_id: e for e in entries_fn(tmp_path, cfg, specs)}
     report = FleetReport()
-    sched = FleetScheduler(cfg, report=report, scoring_by_width=True)
+    sched = FleetScheduler(cfg, report=report, scoring_by_width=True,
+                           **scheduler_kw)
     server = FleetServer(sched, ServeConfig(target_live=target_live),
                          journal=journal)
     server.serve(iter(emap[u] for u in order), on_result=on_result)
@@ -257,6 +262,58 @@ def test_serve_restart_from_journal_loses_no_user(tmp_path):
     st = AdmissionJournal(str(tmp_path / "serve_journal.jsonl")).state
     assert st.finished == {uid for _, uid, _ in specs}
     assert not st.pending
+
+
+def test_serve_restart_qbdc_loses_no_user(tmp_path):
+    """The tier-1 qbdc pin (acceptance): a dropout-committee serve run
+    killed at the first completion collection, restarted from the
+    journal, finishes every user BIT-IDENTICALLY to uninterrupted
+    sequential runs — the K mask keys fold from the checkpointed PRNG
+    stream, so neither the workspace resume nor the journal re-admission
+    perturbs the committee."""
+    from tests.test_acquire import (
+        TINY_CNN,
+        TINY_TC,
+        _cnn_committee,
+        _cnn_data,
+    )
+
+    cfg = dataclasses.replace(_cfg(mode="qbdc", epochs=2, queries=3),
+                              qbdc_k=6)
+    specs = [(100 + i, f"u{i}", 8) for i in range(2)]
+    seq = []
+    for seed, uid, n in specs:
+        data = _cnn_data(seed, uid, n_songs=n)
+        p = tmp_path / f"seq_{uid}"
+        p.mkdir()
+        seq.append(ALLoop(cfg, retrain_epochs=1).run_user(
+            _cnn_committee(data), data, str(p)))
+
+    def entries(tmp_path, cfg, specs):
+        out = []
+        for seed, uid, n in specs:
+            data = _cnn_data(seed, uid, n_songs=n)
+            fp = tmp_path / f"serve_{uid}"
+            fp.mkdir(exist_ok=True)
+            if (fp / "al_state.json").exists():
+                committee = workspace.load_committee(str(fp), TINY_CNN,
+                                                     TINY_TC)
+            else:
+                committee = _cnn_committee(data)
+            out.append(FleetUser(
+                uid, committee, data, str(fp), seed=cfg.seed,
+                committee_factory=lambda fp=fp: workspace.load_committee(
+                    str(fp), TINY_CNN, TINY_TC)))
+        return out
+
+    done, report = _restart_drill(
+        tmp_path, cfg, specs, FaultRule("serve.collect", "kill", at=1),
+        entries_fn=entries, scheduler_kw={"retrain_epochs": 1})
+    assert sorted(done) == [uid for _, uid, _ in specs]
+    for s, (_, uid, _) in zip(seq, specs):
+        assert done[uid]["error"] is None
+        assert done[uid]["result"]["trajectory"] == s["trajectory"]
+    assert any(e["event"] == "journal_recover" for e in report.events)
 
 
 @pytest.mark.slow
