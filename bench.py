@@ -1454,6 +1454,264 @@ def run_qbdc_suite(args_ns) -> int:
     return 0
 
 
+def run_cnn_fleet_suite(args_ns) -> int:
+    """Cross-user stacked CNN device path: users/sec + mean_device_batch
+    of a same-bucket CNN cohort vs the per-user CNN dispatch path.
+
+    Both arms run the SAME fleet engine over the identical synthetic
+    waveform workload and seeds — the only difference is
+    ``FleetScheduler(stack_cnn=...)``: stacked groups the cohort's CNN
+    probs production / qbdc dropout committees / retrain epochs into ONE
+    device dispatch per round (``models.committee.run_device_plans``);
+    per-user is the pre-stacking shape (CNN work inline, one dispatch per
+    user per step).  Parity with the sequential ``ALLoop.run_user``
+    trajectories is asserted on EVERY rep for BOTH arms and both modes
+    (mc stored committee, qbdc dropout committee), so the reported
+    speedup is for bit-identical per-user results.  Timing reps are
+    interleaved (each arm once per rep, best-of-reps per arm) — the
+    throttled-image discipline of the fleet suite.
+
+    Because per-user rows are bit-identical, the two arms run EQUAL
+    device FLOPs (``lax.map`` over users; vmapped convs would lower to
+    different, non-bitwise kernels) — the stacked arm's users/sec win is
+    host/device OVERLAP plus dispatch amortization, so it is bounded by
+    the box's real parallel capacity, measured and recorded as
+    ``host_parallel_speedup`` (this throttled 2-vCPU image has been
+    observed as low as ~1.1x: two perfectly parallel workers gain 10%).
+    ``mean_device_batch`` and the per-fn dispatch counts are the
+    capacity-independent structural metrics: one dispatch PER COHORT
+    instead of per user, which is what closes the arithmetic-intensity
+    gap on a real accelerator (ISSUE 7 / BENCH_cnn_r05 MFU analysis).
+    """
+    import os
+    import shutil
+    import tempfile
+
+    import jax
+
+    # the CNN crop path requires prefix-stable threefry (this image's
+    # 0.4.37 defaults the flag off; tests/CLI set it the same way)
+    jax.config.update("jax_threefry_partitionable", True)
+
+    from consensus_entropy_tpu.al.loop import ALLoop, UserData
+    from consensus_entropy_tpu.config import ALConfig, CNNConfig, TrainConfig
+    from consensus_entropy_tpu.data.audio import DeviceWaveformStore
+    from consensus_entropy_tpu.fleet import FleetReport, FleetScheduler, \
+        FleetUser
+    from consensus_entropy_tpu.models import short_cnn
+    from consensus_entropy_tpu.models.committee import (
+        CNNMember,
+        Committee,
+        FramePool,
+    )
+
+    cnn_cfg = CNNConfig(n_channels=4, n_mels=32, n_layers=5,
+                        input_length=8192)
+    tc = TrainConfig(batch_size=2)
+    n_users = args_ns.users
+    n_songs = args_ns.pool or 120
+    reps = args_ns.reps
+    seed = 1987
+    qbdc_k = 8
+    retrain_epochs = 1
+    # hold a dispatch briefly while host futures are outstanding so the
+    # cohort phase-aligns into FULL stacked plan groups (stable cohort
+    # geometry = one compiled program per plan kind; see the README fleet
+    # section on batch_window_s).  Inert for the per-user arm: its CNN
+    # sessions run everything inline, so there are never host futures to
+    # wait on — the two arms stay comparable.
+    batch_window_s = 0.25
+
+    def make_user(uid, u_seed):
+        rng = np.random.default_rng(u_seed)
+        n_feat = 96
+        centers = rng.standard_normal((4, n_feat)).astype(np.float32) * 2.5
+        rows, sids, labels = [], [], {}
+        for i in range(n_songs):
+            sid = f"song{i:03d}"
+            c = int(rng.integers(0, 4))
+            labels[sid] = c
+            # 40-90 frames/song: an AMG-like pool carries tens of frames
+            # per song, and the host members' sklearn blocks (pool
+            # predict_proba, gated test predicts) scale with it — the
+            # host share the stacked arm overlaps under its device
+            # dispatches.  A 4-9-frame pool makes host work a rounding
+            # error and the A/B measures pure dispatch overhead instead.
+            kk = int(rng.integers(40, 90))
+            rows.append(centers[c] + rng.standard_normal(
+                (kk, n_feat)).astype(np.float32))
+            sids += [sid] * kk
+        pool = FramePool(np.vstack(rows), sids)
+        data = UserData(uid, pool, labels, hc_rows=None)
+        wrng = np.random.default_rng(u_seed + 7)
+        waves = {s: wrng.standard_normal(9000).astype(np.float32)
+                 for s in pool.song_ids}
+        data.store = DeviceWaveformStore(waves, cnn_cfg.input_length)
+        return data
+
+    def committee_fn(data, u_seed, n_members, hosts):
+        # personalized committees: each user's member inits draw from its
+        # own seed, so stacked rows can't accidentally pass parity by
+        # weight sharing.  mc is the paper's MIXED shape (sklearn hosts +
+        # CNN members): the per-step offload split is part of what this
+        # suite measures — the baseline arm (stack_cnn=False) runs a CNN
+        # session's sklearn blocks inline (the old whole-session gate),
+        # the stacked arm rides them on the worker pool overlapping
+        # peers' device dispatches.
+        cnns = [CNNMember(f"cnn{i}", short_cnn.init_variables(
+                    jax.random.key(u_seed + i), cnn_cfg), cnn_cfg, tc)
+                for i in range(n_members)]
+        host = []
+        if hosts:
+            from consensus_entropy_tpu.models.sklearn_members import (
+                GNBMember,
+                SGDMember,
+            )
+
+            X = data.pool.X
+            y = np.array([data.labels[s] for s in np.repeat(
+                data.pool.song_ids, data.pool.counts)], np.int32)
+            host = [GNBMember("gnb.it_0").fit(X, y),
+                    SGDMember("sgd.it_0", seed=0).fit(X, y),
+                    SGDMember("sgd.it_1", seed=1).fit(X, y)]
+        return Committee(host, cnns, cnn_cfg, tc)
+
+    def host_parallel_speedup() -> float:
+        """Measured parallel capacity of THIS box at bench time: the
+        speedup of two GIL-releasing single-threaded numpy workers run on
+        two threads vs back-to-back.  The stacked arm's users/sec win is
+        overlap (host blocks under the device stream) on equal-FLOP
+        bit-identical work, so it is bounded above by this number — on a
+        throttled-shares image it has been measured anywhere from ~1.1
+        (both vCPUs contending for ~one core of real capacity) to ~2.0.
+        Recorded in the artifact so the A/B ratio is read against what
+        the hardware offered during the run, the same reason reps are
+        interleaved."""
+        import threading
+
+        a = np.random.default_rng(0).standard_normal(1 << 22)
+
+        def work():
+            for _ in range(6):
+                np.exp(a)
+
+        work()  # warm/page-in
+        t0 = time.perf_counter()
+        work()
+        work()
+        seq = time.perf_counter() - t0
+        ts = [threading.Thread(target=work) for _ in range(2)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        par = time.perf_counter() - t0
+        return round(seq / par, 2)
+
+    modes = {"mc": dict(n_members=2, hosts=True, cfg_kw={}),
+             "qbdc": dict(n_members=1, hosts=False,
+                          cfg_kw=dict(qbdc_k=qbdc_k))}
+    al_users = [make_user(f"u{i}", seed + 10 * i) for i in range(n_users)]
+    capacity = host_parallel_speedup()
+    _log(f"cnn-fleet workload: {n_users} users x {n_songs} songs, "
+         f"mc M=2 / qbdc K={qbdc_k}, q={args_ns.k}, "
+         f"{args_ns.al_epochs} AL iterations, {reps} interleaved reps, "
+         f"host parallel capacity {capacity}x")
+
+    root = tempfile.mkdtemp(prefix="cnn_fleet_bench_")
+    out_modes = {}
+    try:
+        for mode, spec in modes.items():
+            cfg = ALConfig(queries=args_ns.k, epochs=args_ns.al_epochs,
+                           mode=mode, seed=seed, ckpt_dtype="float32",
+                           gate_host_updates=True, **spec["cfg_kw"])
+            # sequential reference (untimed): the parity ground truth
+            loop = ALLoop(cfg, retrain_epochs=retrain_epochs)
+            seq = []
+            for i, data in enumerate(al_users):
+                p = os.path.join(root, f"{mode}_seq_{i}")
+                os.makedirs(p)
+                seq.append(loop.run_user(
+                    committee_fn(data, seed + 10 * i, spec["n_members"],
+                                 spec["hosts"]), data, p, seed=cfg.seed))
+            best = {}
+            for rep in range(reps):
+                for arm, stack in (("stacked", True), ("per_user", False)):
+                    report = FleetReport()
+                    sched = FleetScheduler(cfg, report=report,
+                                           retrain_epochs=retrain_epochs,
+                                           user_timings=False,
+                                           batch_window_s=batch_window_s,
+                                           stack_cnn=stack)
+                    entries = []
+                    for i, data in enumerate(al_users):
+                        p = os.path.join(root,
+                                         f"{mode}_{arm}_{rep}_{i}")
+                        os.makedirs(p)
+                        entries.append(FleetUser(
+                            data.user_id,
+                            committee_fn(data, seed + 10 * i,
+                                         spec["n_members"], spec["hosts"]),
+                            data, p, seed=cfg.seed))
+                    t0 = time.perf_counter()
+                    recs = sched.run(entries)
+                    wall = time.perf_counter() - t0
+                    for r, s in zip(recs, seq):
+                        assert r["error"] is None, (mode, arm, r["error"])
+                        if r["result"]["trajectory"] != s["trajectory"]:
+                            raise AssertionError(
+                                f"{mode}/{arm} diverged from the "
+                                f"sequential trajectory for "
+                                f"{r['user']} (rep {rep})")
+                    s = report.summary(cohort=n_users, wall_s=wall)
+                    prev = best.get(arm)
+                    if prev is None or s["users_per_sec"] > \
+                            prev["users_per_sec"]:
+                        best[arm] = s
+            st, pu = best["stacked"], best["per_user"]
+            cnn = st["cnn"]
+            speedup = round(st["users_per_sec"] / pu["users_per_sec"], 2)
+            out_modes[mode] = {
+                "users_per_sec": st["users_per_sec"],
+                "per_user_users_per_sec": pu["users_per_sec"],
+                "speedup_vs_per_user": speedup,
+                "mean_device_batch": cnn["mean_device_batch"],
+                "occupancy": cnn.get("occupancy"),
+                "cnn_dispatches": cnn["dispatches"],
+                "per_fn": {fn: cnn[fn] for fn in cnn
+                           if isinstance(cnn[fn], dict)},
+                "parity_with_sequential": True,  # asserted every rep
+            }
+            _log(f"[{mode}] stacked {st['users_per_sec']:.3f} users/s vs "
+                 f"per-user {pu['users_per_sec']:.3f} ({speedup}x), "
+                 f"mean_device_batch {cnn['mean_device_batch']}, "
+                 f"parity=True")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    mc = out_modes["mc"]
+    print(json.dumps({
+        "metric": f"cnn_fleet_users_per_sec_{n_users}u",
+        "value": mc["users_per_sec"],
+        "unit": "users/s",
+        "vs_baseline": mc["speedup_vs_per_user"],
+        "mean_device_batch": mc["mean_device_batch"],
+        "cohort": n_users,
+        "n_songs": n_songs,
+        "queries": args_ns.k,
+        "al_epochs": args_ns.al_epochs,
+        "retrain_epochs": retrain_epochs,
+        "qbdc_k": qbdc_k,
+        "host_parallel_speedup": capacity,
+        "parity_with_sequential": all(
+            m["parity_with_sequential"] for m in out_modes.values()),
+        "modes": out_modes,
+        **_provenance(),
+    }))
+    return 0
+
+
 def run_fabric_suite(args_ns) -> int:
     """Multi-host fabric resilience: recovered-users/sec with one worker
     host SIGKILLed mid-run.
@@ -1611,7 +1869,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--suite", choices=("linear", "cnn", "retrain", "fleet",
                                         "serve", "serve-faults", "fabric",
-                                        "qbdc"),
+                                        "qbdc", "cnn-fleet"),
                     default="linear",
                     help="linear: the north-star fused pool scoring; cnn: "
                          "Flax ShortChunkCNN committee inference "
@@ -1628,7 +1886,10 @@ def main(argv=None) -> int:
                          "(journal failover + compaction); qbdc: "
                          "dropout-committee scoring (K-sweep) + users/sec "
                          "+ per-user memory vs the stored-committee mc "
-                         "path")
+                         "path; cnn-fleet: users/sec + mean_device_batch "
+                         "of a same-bucket CNN cohort under the stacked "
+                         "cross-user device path vs per-user CNN "
+                         "dispatch (mc + qbdc, parity asserted)")
     ap.add_argument("--members", type=int, default=None,
                     help="committee size (default: 16 linear / 5 cnn)")
     ap.add_argument("--pool", type=int, default=None,
@@ -1704,6 +1965,10 @@ def main(argv=None) -> int:
         # dropout committee vs stored committee; --pool is songs per user,
         # --members the stored-committee size (default 20, the paper's)
         return run_qbdc_suite(args_ns)
+    if args_ns.suite == "cnn-fleet":
+        # CNN cohort stacking vs per-user dispatch; --pool is songs per
+        # user (default 120), --users the same-bucket cohort size
+        return run_cnn_fleet_suite(args_ns)
     if args_ns.suite == "cnn":
         # cnn-suite defaults: 5 members (paper committee), 48 crops per
         # pass — the first conv block's activations are ~75 MB per

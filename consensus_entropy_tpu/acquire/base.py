@@ -58,6 +58,25 @@ class AcquisitionStrategy:
         mutations are deferred to ``finish_select``."""
         raise NotImplementedError
 
+    def probs_plan(self, committee, store, song_ids, key, *, pad_to,
+                   config):
+        """Stage this mode's CNN probs PRODUCTION as a batchable device
+        plan (``models.committee`` — ``CNNScorePlan``/``QBDCScorePlan``),
+        or ``None`` to keep the inline per-user path.
+
+        This is the producer-side sibling of ``scoring_inputs``: the fleet
+        scheduler stacks same-signature plans from a whole cohort into ONE
+        device dispatch (``committee.run_device_plans``), exactly as it
+        vmaps the reduction scorers — so a registered mode gets cohort
+        batching of its forward for free.  The default routes by
+        ``probs_source``; override for modes with a custom producer."""
+        if not self.needs_probs:
+            return None
+        if self.probs_source == "qbdc":
+            return committee.qbdc_score_plan(store, song_ids, key,
+                                             k=config.qbdc_k, pad_to=pad_to)
+        return committee.cnn_score_plan(store, song_ids, key, pad_to=pad_to)
+
     def extract_queries(self, acq, res) -> list:
         """Map a ``ScoreResult`` back to song ids and apply any
         mode-specific mask mutation (hc row removal, mix dedup).  The

@@ -15,6 +15,12 @@ producer is ``Committee.qbdc_pool_probs``: mask keys are folded from the
 AL iteration's PRNG key (the ``acquire.qbdc.masks`` fault point fires at
 the sampler), so the dropout committee is deterministic and bit-identical
 across checkpoint resume, fleet eviction, and serve-journal restart.
+
+The producer itself cohort-batches through the base ``probs_plan`` seam
+(``probs_source == "qbdc"`` routes to ``Committee.qbdc_score_plan``): a
+same-bucket fleet/serve cohort runs ONE stacked ``(U, K)`` dispatch —
+one trunk pass per user, K dropout heads each — with per-user rows
+bit-identical to ``qbdc_pool_probs`` (``short_cnn.qbdc_infer_users``).
 """
 
 from __future__ import annotations

@@ -69,6 +69,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bounded worker pool for fleet host-side "
                         "sklearn retraining/evaluation (default: "
                         "min(N, cpus, 8))")
+    p.add_argument("--plan-chunk", type=int, default=None, metavar="U",
+                   help="fleet/serve mode: service stacked CNN plan "
+                        "groups in dispatch quanta of at most U users "
+                        "(sub-chunk remainders wait for stragglers while "
+                        "host futures are outstanding) instead of whole-"
+                        "group dispatches — bounds the compiled-program "
+                        "set per plan kind and pipelines chunk dispatches "
+                        "against the cohort's remaining host steps "
+                        "(default: whole-group)")
+    p.add_argument("--no-stack-cnn", action="store_true",
+                   help="fleet/serve mode: disable cross-user stacking of "
+                        "the CNN device path (stacked probs forward, "
+                        "qbdc dropout committee, cohort lockstep "
+                        "retraining) — CNN work then runs inline per "
+                        "user, the pre-stacking shape; per-user results "
+                        "are identical either way (debug/baseline)")
     p.add_argument("--serve", type=int, default=None, metavar="N",
                    help="serving mode: continuous-batching admission on "
                         "top of the fleet engine — keep N AL sessions "
@@ -224,6 +240,16 @@ def main(argv=None) -> int:
     if args.serve is not None and args.pad_pool_to is not None:
         print("--serve pads per bucket; use --bucket-widths instead of "
               "--pad-pool-to")
+        return 1
+    if args.no_stack_cnn and args.fleet is None and args.serve is None:
+        print("--no-stack-cnn requires --fleet or --serve (the sequential "
+              "path never stacks)")
+        return 1
+    if args.plan_chunk is not None and (
+            args.plan_chunk < 1 or (args.fleet is None
+                                    and args.serve is None)):
+        print("--plan-chunk takes a positive chunk size and requires "
+              "--fleet or --serve")
         return 1
     if args.admit_window_ms and args.serve is None:
         print("--admit-window-ms requires --serve")
@@ -457,7 +483,8 @@ def _run_users_fleet(args, cfg, paths, users, pool, anno, hc_table, store,
     scheduler = FleetScheduler(
         cfg, tie_break=args.tie_break, retrain_epochs=args.retrain_epochs,
         host_workers=args.fleet_host_workers, preemption=guard,
-        pad_pool_to=args.pad_pool_to, report=report)
+        pad_pool_to=args.pad_pool_to, report=report,
+        stack_cnn=not args.no_stack_cnn, plan_chunk=args.plan_chunk)
     todo = list(users[: args.max_users])
     n_cohorts = 0
     failed = []
@@ -563,7 +590,8 @@ def _run_users_serve(args, cfg, paths, users, pool, anno, hc_table, store,
     scheduler = FleetScheduler(
         cfg, tie_break=args.tie_break, retrain_epochs=args.retrain_epochs,
         host_workers=args.fleet_host_workers, report=report,
-        scoring_by_width=True)
+        scoring_by_width=True, stack_cnn=not args.no_stack_cnn,
+        plan_chunk=args.plan_chunk)
     server = FleetServer(
         scheduler,
         ServeConfig(target_live=args.serve,
@@ -798,7 +826,8 @@ def _run_users_fabric_worker(args, cfg, paths, users, pool, anno,
     scheduler = FleetScheduler(
         cfg, tie_break=args.tie_break, retrain_epochs=args.retrain_epochs,
         host_workers=args.fleet_host_workers, report=report,
-        scoring_by_width=True)
+        scoring_by_width=True, stack_cnn=not args.no_stack_cnn,
+        plan_chunk=args.plan_chunk)
 
     def build_entry(uid):
         u_id = by_id.get(uid, uid)

@@ -35,6 +35,12 @@ import time
 
 from consensus_entropy_tpu.utils.profiling import RollingStat
 
+#: fn keys of the CNN device-plan dispatches (stored-committee / qbdc
+#: probs producers and the cohort retrain) — rolled up separately in the
+#: summary so the CNN cohort's ``mean_device_batch`` / occupancy are
+#: regression-pinned exactly like the sklearn stacked path's
+CNN_DISPATCH_FNS = ("cnn_probs", "qbdc_probs", "cnn_retrain", "cnn_eval")
+
 
 class FleetReport:
     """Collects fleet-run telemetry; optionally streams events to JSONL.
@@ -165,6 +171,35 @@ class FleetReport:
             }
         return out
 
+    @property
+    def cnn_dispatch_summary(self) -> dict | None:
+        """Roll-up of the CNN device-plan dispatches (:data:`CNN_DISPATCH_FNS`)
+        — per fn: dispatch count, mean users per dispatch, occupancy
+        against the active slots — plus the combined ``mean_device_batch``.
+        ``None`` when the run had no CNN dispatches, so host-only fleet
+        summaries (and committed BENCH artifacts) stay byte-stable."""
+        cnn = [d for d in self.dispatches if d["fn"] in CNN_DISPATCH_FNS]
+        if not cnn:
+            return None
+        out = {"dispatches": len(cnn),
+               "mean_device_batch": round(
+                   sum(d["batch"] for d in cnn) / len(cnn), 2)}
+        per_all = [d["batch"] / d["active"] for d in cnn if d["active"]]
+        if per_all:
+            out["occupancy"] = round(sum(per_all) / len(per_all), 3)
+        for fn in CNN_DISPATCH_FNS:
+            ds = [d for d in cnn if d["fn"] == fn]
+            if not ds:
+                continue
+            per = [d["batch"] / d["active"] for d in ds if d["active"]]
+            out[fn] = {
+                "dispatches": len(ds),
+                "mean_batch": round(sum(d["batch"] for d in ds) / len(ds),
+                                    2),
+                "occupancy": round(sum(per) / len(per), 3) if per else None,
+            }
+        return out
+
     def summary(self, *, cohort: int, wall_s: float | None = None) -> dict:
         """Cohort roll-up.  ``phase_wall_s`` sums the sessions' OWN timers
         — session-observed latency, so in fleet mode a phase that spans a
@@ -208,6 +243,9 @@ class FleetReport:
         per_bucket = self.per_bucket_occupancy
         if per_bucket is not None:
             out["per_bucket"] = per_bucket
+        cnn = self.cnn_dispatch_summary
+        if cnn is not None:
+            out["cnn"] = cnn
         if self.admission_wait.n:
             out["admissions"] = self.admission_wait.n
             out["admission_wait_s"] = self.admission_wait.snapshot()
@@ -240,6 +278,8 @@ def bench_line(summary: dict, *, baseline_users_per_sec: float | None = None,
     }
     if summary.get("per_bucket") is not None:
         line["per_bucket"] = summary["per_bucket"]
+    if summary.get("cnn") is not None:
+        line["cnn"] = summary["cnn"]
     for key in ("watchdog_evictions", "breaker_trips", "dispatch_failures",
                 "requeues", "users_poisoned"):
         if summary.get(key):

@@ -9,6 +9,14 @@ through their SCORE → QUERY → RETRAIN → EVAL state machines:
   its row, bit-identical to its own single-user jitted call (pinned by
   ``tests/test_fleet_scoring.py``).  Groups of one fall back to the
   session's own fns — literally the sequential path.
+- **Batched CNN device path** — sessions blocked on a ``DeviceStep``
+  (stored-committee / qbdc probs production, committee retraining) are
+  grouped by their plan signature and each group runs as ONE stacked
+  dispatch (``models.committee.run_device_plans`` — a ``lax.map`` over
+  the users axis whose body is the single-user program, so per-user rows
+  and retrain trajectories are bit-identical to the sequential path;
+  pinned by ``tests/test_cnn_fleet.py``).  ``stack_cnn=False`` restores
+  the pre-stacking inline shape (the bench baseline).
 - **Host/device overlap** — ``HostStep`` blocks (sklearn ``predict_proba``
   / ``partial_fit`` / evaluation for jax-free committees) run on a bounded
   worker pool; while user A retrains on host threads, users B..Z score on
@@ -58,6 +66,7 @@ import jax.numpy as jnp
 from consensus_entropy_tpu.config import ALConfig
 from consensus_entropy_tpu.fleet.report import FleetReport
 from consensus_entropy_tpu.fleet.session import (
+    DeviceStep,
     HostStep,
     ScoreStep,
     UserSession,
@@ -123,7 +132,8 @@ class FleetScheduler:
                  user_timings: bool = True,
                  batch_window_s: float = 0.0,
                  scoring_by_width: bool = False,
-                 watchdog=None, breaker=None, on_terminal=None):
+                 watchdog=None, breaker=None, on_terminal=None,
+                 stack_cnn: bool = True, plan_chunk: int | None = None):
         self.config = config
         self.tie_break = tie_break
         self.retrain_epochs = retrain_epochs
@@ -144,6 +154,30 @@ class FleetScheduler:
         #: repeated stacked-dispatch failures degrades to per-user
         #: dispatch until a half-open probe recovers it
         self.breaker = breaker
+        #: CNN cohorts batch their device path (probs production and
+        #: retraining ride ``DeviceStep`` plans, stacked per group into
+        #: one ``lax.map``-over-users dispatch — bit-identical per-user
+        #: rows) and their jax-free sklearn blocks offload per step.
+        #: ``False`` restores the pre-stacking shape — CNN work inline,
+        #: whole-session offload gating — the baseline arm
+        #: ``bench.py --suite cnn-fleet`` races against.
+        self.stack_cnn = stack_cnn
+        #: device-plan dispatch quantum.  ``None`` (accelerator default)
+        #: services each plan group whole — biggest stacked dispatch, but
+        #: the cohort then LOCKSTEPS: by the time the group is full no
+        #: host work is left in flight, so the pool idles through every
+        #: dispatch.  A small ``plan_chunk`` turns the drain loop into a
+        #: pipeline (``_hold_partial_plans``): full chunk quanta dispatch
+        #: the moment they form — overlapping the still-outstanding host
+        #: steps of the sessions that will fill the next chunk — while
+        #: sub-chunk remainders are held back (never dispatched
+        #: fragmented) until the pool is quiet.  It also caps the
+        #: compiled-program set at U ≤ chunk per plan kind instead of one
+        #: ``lax.map`` program per transient cohort size.  On a host-bound
+        #: box this overlap, not dispatch amortization, is the throughput
+        #: lever.  Reduction ScoreSteps are untouched: cheap and
+        #: latency-sensitive, they always dispatch with their round.
+        self.plan_chunk = plan_chunk
         #: optional driver hook called on a session's TERMINAL failure
         #: (resumes exhausted, or the resume reload itself failed) with
         #: ``(entry, error_str, resumes)``; returning True absorbs the
@@ -221,6 +255,21 @@ class FleetScheduler:
                 # own ScoreStep — let them join this batch
                 return True
             batch, self._score_wait = self._score_wait, []
+            if self.plan_chunk and self._host_wait:
+                # batch-forming for DeviceSteps: while host futures are
+                # outstanding, more same-key plans may still arrive — hold
+                # partial plan groups back (they rejoin _score_wait) and
+                # dispatch only full chunk quanta now, so the dispatch
+                # overlaps the stragglers' host work instead of
+                # fragmenting their group.  With the pool quiet, nothing
+                # more can arrive and everything flushes below.
+                batch = self._hold_partial_plans(batch)
+                if not batch:
+                    # everything held: block until host progress instead
+                    # of spinning (bounded under a watchdog, as below)
+                    self._drain_host(None if self.watchdog is None
+                                     else self.watchdog.poll_s())
+                    return True
             for state, res in self._dispatch_scores(batch):
                 self._ready.append((state, res, None))
             return True
@@ -306,7 +355,7 @@ class FleetScheduler:
             retrain_epochs=self.retrain_epochs,
             pad_pool_to=pad, timer=timer,
             preemption=self.preemption, ckpt_executor=self._ckpt_pool,
-            pin_pad=pin_pad)
+            pin_pad=pin_pad, cnn_steps=self.stack_cnn)
         st = _SessionState(entry, session, session.steps(), pad=pad,
                            n_pad=session.acq.n_pad)
         return st
@@ -333,7 +382,10 @@ class FleetScheduler:
     def _track(self, state: _SessionState, step) -> None:
         if step is None:
             self._live.discard(state)
-        elif isinstance(step, ScoreStep):
+        elif isinstance(step, (ScoreStep, DeviceStep)):
+            # DeviceSteps share the score-wait list: both are device
+            # dispatches whose batches fill as peers reach their own
+            # yield, under the same batch-window/host-drain policy
             self._score_wait.append((state, step))
         else:
             fut = self._host_pool.submit(step.fn)
@@ -477,11 +529,41 @@ class FleetScheduler:
         faulted user never dilutes later dispatches' occupancy."""
         return sum(1 for s in self._live if s.n_pad == width)
 
+    def _hold_partial_plans(self, steps: list) -> list:
+        """Batch-forming: split ``steps`` into the part to dispatch NOW and
+        the part to hold back in ``_score_wait`` for the next round.  Plan
+        (DeviceStep) groups release whole ``plan_chunk`` quanta — those
+        dispatch while the cohort's remaining host futures run — and their
+        sub-chunk remainders are held, to be joined by the same-key plans
+        the outstanding host steps are about to produce.  Reduction
+        ScoreSteps always pass through (cheap, latency-sensitive).  Callers
+        only hold while ``_host_wait`` is non-empty, so held steps can
+        never starve: with the pool quiet the whole batch dispatches."""
+        groups = collections.defaultdict(list)
+        for st, step in steps:
+            if isinstance(step, DeviceStep):
+                groups[("__plan__",) + step.plan.group_key()].append(
+                    (st, step))
+            else:
+                groups[None].append((st, step))
+        out = []
+        for key, group in groups.items():
+            if key is None:
+                out.extend(group)
+                continue
+            keep = (len(group) // self.plan_chunk) * self.plan_chunk
+            out.extend(group[:keep])
+            self._score_wait.extend(group[keep:])
+        return out
+
     def _dispatch_scores(self, steps: list):
-        """Service a round of ScoreSteps: group by (scorer, shapes), run
-        each multi-session group as ONE vmapped dispatch, singletons
-        through the session's own single-user fns.  Returns
-        ``[(session_state, ScoreResult), ...]``.
+        """Service a round of ScoreSteps and DeviceSteps: group by
+        (scorer, shapes) — device plans by their ``group_key()`` — run
+        each multi-session group as ONE stacked dispatch, singletons
+        through the session's own single-user path.  Plan groups larger
+        than ``plan_chunk`` are serviced in chunk-sized dispatches (see
+        the attribute note: bounded compile set + pipeline grain).
+        Returns ``[(session_state, result), ...]``.
 
         Failure isolation: a failed STACKED dispatch no longer takes its
         whole batch down — the failure is recorded on the breaker (which
@@ -489,16 +571,33 @@ class FleetScheduler:
         dispatch, where a session whose own dispatch fails is evicted
         through its generator's error path while its peers keep their
         results.  ``InjectedKill``/``Preempted`` stay ``BaseException``
-        and still stop the fleet."""
+        and still stop the fleet.  CNN plan dispatches share the
+        per-width breaker with the reduction scorers: a degraded bucket
+        is degraded for its whole device path."""
         groups = collections.defaultdict(list)
         for st, step in steps:
-            key = (step.fn_key,) + tuple(self._sig(x) for x in step.inputs)
+            if isinstance(step, DeviceStep):
+                key = ("__plan__",) + step.plan.group_key()
+            else:
+                key = (step.fn_key,) + tuple(self._sig(x)
+                                             for x in step.inputs)
             groups[key].append((st, step))
         n_live = len(self._live)
+        rounds = []
+        for key, group in groups.items():
+            if (self.plan_chunk and key[0] == "__plan__"
+                    and len(group) > self.plan_chunk):
+                rounds.extend(
+                    group[i:i + self.plan_chunk]
+                    for i in range(0, len(group), self.plan_chunk))
+            else:
+                rounds.append(group)
         out = []
-        for group in groups.values():
+        for group in rounds:
             width = group[0][0].n_pad
-            fn_key = group[0][1].fn_key
+            step0 = group[0][1]
+            fn_key = (step0.plan.fn_key if isinstance(step0, DeviceStep)
+                      else step0.fn_key)
             use_stacked = len(group) > 1
             if use_stacked and self.breaker is not None:
                 use_stacked = self.breaker.allow_stacked(width)
@@ -508,7 +607,9 @@ class FleetScheduler:
             if use_stacked:
                 t0 = time.perf_counter()
                 try:
-                    served = self._stacked_call(fn_key, width, group)
+                    served = (self._plan_call(fn_key, width, group)
+                              if isinstance(step0, DeviceStep)
+                              else self._stacked_call(fn_key, width, group))
                 except Exception as exc:
                     self._note_stacked_failure(fn_key, width, exc)
                 else:
@@ -573,16 +674,49 @@ class FleetScheduler:
             batched.entropy[i], batched.values[i], batched.indices[i]))
             for i, (st, _) in enumerate(group)]
 
+    def _plan_call(self, fn_key: str, width: int, group: list):
+        """One stacked CNN device dispatch (probs production or cohort
+        retrain) for a multi-session plan group — the producer-side
+        sibling of :meth:`_stacked_call`, same fault point, same watchdog
+        bound.  Only the PURE compute half runs under the watchdog: a
+        retrain's member rebinding commits on this thread after the
+        dispatch returned, so an abandoned (zombie) dispatch that
+        eventually finishes can never overwrite committees that already
+        took the per-user fallback."""
+        from consensus_entropy_tpu.models import committee as committee_mod
+
+        plans = [step.plan for _, step in group]
+
+        def dispatch():
+            faults.fire("serve.dispatch", fn=fn_key, width=width,
+                        batch=len(group))
+            return committee_mod.stage_device_plans(plans)
+
+        computed = (self.watchdog.call(dispatch,
+                                       f"dispatch {fn_key}@{width}")
+                    if self.watchdog is not None else dispatch())
+        results = committee_mod.commit_device_plans(plans, computed)
+        return [(st, res) for (st, _), res in zip(group, results)]
+
     def _single_call(self, step):
         """One session's own single-user dispatch (the sequential path),
         watchdog-bounded like the stacked one."""
+        if isinstance(step, DeviceStep):
+            fn_key, run = step.plan.fn_key, step.single
+        else:
+            fn_key = step.fn_key
+
+            def run():
+                return step.session.acq.run_scoring(step.fn_key,
+                                                    step.inputs)
+
         def dispatch():
-            faults.fire("serve.dispatch", fn=step.fn_key,
+            faults.fire("serve.dispatch", fn=fn_key,
                         width=step.session.acq.n_pad, batch=1)
-            return step.session.acq.run_scoring(step.fn_key, step.inputs)
+            return run()
 
         if self.watchdog is not None:
-            return self.watchdog.call(dispatch, f"dispatch {step.fn_key}x1")
+            return self.watchdog.call(dispatch, f"dispatch {fn_key}x1")
         return dispatch()
 
     def _note_stacked_failure(self, fn_key: str, width: int,

@@ -12,6 +12,23 @@ two points where a multi-user scheduler can interleave work:
   ``partial_fit`` / evaluation) for committees with no device members.
   The sequential driver runs it inline; the fleet scheduler runs it on a
   bounded worker pool so host retraining overlaps device scoring.
+- :class:`DeviceStep` — a batchable CNN device call (stored-committee /
+  qbdc probs production, committee retraining) staged as a
+  ``models.committee`` device PLAN.  The sequential driver (and any
+  batch of one) runs the step's ``single`` closure — the unchanged
+  per-user jitted path; the fleet scheduler groups same-signature plans
+  from the cohort and services each group with ONE stacked dispatch
+  (``committee.run_device_plans`` — ``lax.map`` over a users axis,
+  per-user rows bit-identical to the single path).  A CNN committee no
+  longer opts the whole session out of the worker pool: its jax-free
+  sklearn/checkpoint blocks still offload (gated per-step via
+  ``sklearn_offloadable``), only batchable device work routes through
+  DeviceSteps, and its remaining per-user host blocks (baseline/epoch
+  evaluation, the post-dispatch probs merge + scoring staging) ride the
+  pool too — they are host-dominated with only thread-safe jitted
+  forwards inside, and pooling them pipelines one user's staging with
+  peers' stacked dispatches instead of serializing the cohort on the
+  scheduler thread.
 
 Single-writer-per-driver contract: between a yield and the corresponding
 resume, only the step's servicer touches the session (the generator is
@@ -65,6 +82,25 @@ class HostStep:
     label: str = ""
 
 
+@dataclasses.dataclass
+class DeviceStep:
+    """Request: run a batchable CNN device call.
+
+    ``plan`` is a ``models.committee`` device plan (``CNNScorePlan`` /
+    ``QBDCScorePlan`` / ``CNNRetrainPlan``): it carries the group
+    signature (``plan.group_key()``) and the staged inputs of the stacked
+    path.  ``single`` is THIS session's sequential closure — the per-user
+    jitted path with its own retry/fault wrapping — used by the inline
+    driver, by batch-of-one dispatches, and as the fallback when a stacked
+    dispatch fails.  The servicer answers with the plan's result (the CNN
+    probs block, or the retrain histories)."""
+
+    session: "UserSession"
+    plan: object
+    single: Callable
+    label: str = ""
+
+
 def drive_inline(session: "UserSession") -> dict:
     """Service a session synchronously — the sequential execution of
     ``run_user``: every ``HostStep`` runs inline, every ``ScoreStep`` goes
@@ -86,6 +122,8 @@ def drive_inline(session: "UserSession") -> dict:
                 if isinstance(step, ScoreStep):
                     value = step.session.acq.run_scoring(step.fn_key,
                                                          step.inputs)
+                elif isinstance(step, DeviceStep):
+                    value = step.single()
                 else:
                     value = step.fn()
             except BaseException as e:
@@ -113,7 +151,8 @@ class UserSession:
                  retrain_epochs: int | None = None, mesh=None,
                  pad_pool_to: int | None = None, resume: bool = True,
                  timer: StepTimer | None = None, preemption=None,
-                 ckpt_executor=None, pin_pad: int | None = None):
+                 ckpt_executor=None, pin_pad: int | None = None,
+                 cnn_steps: bool = True):
         from consensus_entropy_tpu.al.loop import AsyncCheckpointer
 
         cfg = config
@@ -199,12 +238,25 @@ class UserSession:
         self.ckpt = AsyncCheckpointer(executor=ckpt_executor)
         #: last finished background job's self-timed durations (fetch/write)
         self.bg_times: dict = {}
-        #: host steps may run on fleet worker threads only when the whole
-        #: block is guaranteed jax-free: no CNN members, no device-resident
-        #: GNB/SGD inference, no mesh feeds
+        #: WHOLE iteration blocks may run on fleet worker threads only when
+        #: every one of them is guaranteed jax-free: no CNN members, no
+        #: device-resident GNB/SGD inference, no mesh feeds
         self.host_offloadable = (not committee.cnn_members
                                  and not committee.device_members
                                  and mesh is None)
+        #: CNN device work (probs production, retraining) is yielded as
+        #: batchable :class:`DeviceStep`\ s — the fleet scheduler stacks
+        #: same-bucket cohorts into one dispatch; mesh committees keep the
+        #: inline path (their placements can't stack across users)
+        self.cnn_steps = (cnn_steps and bool(committee.cnn_members)
+                          and mesh is None)
+        #: per-STEP offload gate (the fix for the old per-session flag): a
+        #: CNN committee no longer disqualifies the session's jax-free
+        #: sklearn update and checkpoint-boundary blocks from the worker
+        #: pool — only genuinely-device steps stay off it.  (The deferred
+        #: checkpoint commit already ran device_get on a worker thread for
+        #: every committee, so thread-side jax fetches are precedented.)
+        self.sklearn_offloadable = self.host_offloadable or self.cnn_steps
 
     @staticmethod
     def _rebuild_split(data, st: al_state.ALState):
@@ -259,18 +311,26 @@ class UserSession:
             w = self.member_weights.get(nm, 1.0)
             self.member_weights[nm] = (1.0 - alpha) * w + alpha * float(a)
 
-    def _evaluate(self, report: UserReport, key) -> list[float]:
+    def _evaluate(self, report: UserReport, key,
+                  cnn_probs=None) -> list[float]:
         """Evaluate every ACTIVE member on the user's test set; returns F1
         list in committee order (CNN members first, as ``member_names``).
         A member that fails here — predict raises, or its probabilities go
         non-finite — is quarantined and dropped from the mean, so one
-        degenerate member can't sink the trajectory or kill the user."""
+        degenerate member can't sink the trajectory or kill the user.
+
+        ``cnn_probs``: the test-split CNN forward, already produced by a
+        staged :class:`~..models.committee.CNNEvalPlan` dispatch (the
+        fleet path — one stacked device call for the whole cohort);
+        ``None`` runs the single-user forward inline, the sequential
+        path."""
         committee, split = self.committee, self.split
         f1s = []
         cnns = committee.active_cnn_members
         if cnns:
             probs = np.asarray(committee.predict_songs_cnn(
-                self.data.store, split.test_songs, key))
+                self.data.store, split.test_songs, key)
+                if cnn_probs is None else cnn_probs)
             for m, p in zip(cnns, probs):
                 if not np.all(np.isfinite(p)):
                     committee.quarantine(
@@ -421,9 +481,27 @@ class UserSession:
                 report.epoch_header(-1)
                 self.key, sub = jax.random.split(self.key)
 
-                def baseline(sub=sub):
+                # the eval's CNN forward is device work the same shape as
+                # the scoring pass: staged as a CNNEvalPlan it rides ONE
+                # stacked dispatch with the cohort's other evals instead
+                # of hiding a full 256-crop forward inside each user's
+                # host block (crop stream identical either way —
+                # _bucketed_crops under the same key)
+                eval_block = None
+                eplan = (committee.eval_plan(data.store, split.test_songs,
+                                             sub)
+                         if self.cnn_steps else None)
+                if eplan is not None:
                     with timer.phase("evaluate"):
-                        f1s = self._evaluate(report, sub)
+                        eval_block = yield DeviceStep(
+                            self, eplan,
+                            lambda sub=sub: committee.predict_songs_cnn(
+                                data.store, split.test_songs, sub),
+                            eplan.fn_key)
+
+                def baseline(sub=sub, block=eval_block):
+                    with timer.phase("evaluate"):
+                        f1s = self._evaluate(report, sub, cnn_probs=block)
                     if drain_events(-1):
                         f1_prev = None  # member set shifted mid-eval
                     else:
@@ -432,7 +510,12 @@ class UserSession:
                     trajectory.append(float(np.mean(f1s)))
                     return f1_prev
 
-                if self.host_offloadable:
+                # cnn_steps sessions pipeline the baseline too: the eval
+                # remainder is host work (sklearn predicts, report math),
+                # and running it on the pool lets peers' stacked
+                # dispatches proceed instead of serializing the whole
+                # cohort behind one user's eval
+                if self.sklearn_offloadable:
                     last_host_f1s = yield HostStep(self, baseline,
                                                    "baseline")
                 else:
@@ -447,8 +530,11 @@ class UserSession:
                 # the iteration boundary (previous-commit join + checkpoint
                 # staging + pickle writes) is pure host work: offloading it
                 # keeps a slow join/commit from stalling the scheduler's
-                # main thread — and with it every other session
-                if self.host_offloadable:
+                # main thread — and with it every other session.  Gated on
+                # the per-STEP flag: CNN sessions' boundaries are just as
+                # jax-free as host-only ones (the deferred device_get
+                # already runs on the checkpointer thread)
+                if self.sklearn_offloadable:
                     yield HostStep(self, boundary0, "checkpoint")
                 else:
                     boundary0()
@@ -460,6 +546,7 @@ class UserSession:
                 if len(live) == 0:
                     break
                 member_probs = None
+                merge_probs = None  # plan path: deferred probs producer
                 strat = acq.strategy
                 if strat.needs_probs:
                     self.key, sub = jax.random.split(self.key)
@@ -499,78 +586,136 @@ class UserSession:
                                 base_delay=cfg.retry_base_delay,
                                 seed=seed + epoch, what="pool.score")
 
-                    if self.host_offloadable:
+                    plan = None
+                    if self.cnn_steps:
+                        plan = strat.probs_plan(
+                            committee, data.store, live, sub,
+                            pad_to=acq.staging_width(len(live)), config=cfg)
+
+                    if plan is not None:
+                        def score_single(plan=plan, sub=sub, live=live,
+                                         epoch=epoch):
+                            """The staged plan's per-user path — the
+                            identical producer/retry/fault wrapping the
+                            inline ``score`` uses, minus the host-member
+                            merge (which runs after the yield either
+                            way)."""
+                            if strat.probs_source == "qbdc":
+                                def produce():
+                                    return committee.qbdc_pool_probs(
+                                        data.store, live, sub,
+                                        k=cfg.qbdc_k, pad_to=plan.pad_to)
+                            else:
+                                def produce():
+                                    return committee.predict_songs_cnn(
+                                        data.store, live, sub,
+                                        pad_to=plan.pad_to)
+                            return retry_transient(
+                                lambda: faults.fire("pool.score",
+                                                    payload=produce()),
+                                attempts=cfg.retry_attempts,
+                                base_delay=cfg.retry_base_delay,
+                                seed=seed + epoch, what="pool.score")
+
+                        # the timer wraps the yield (like `select` wraps
+                        # its ScoreStep), so `score` covers staging →
+                        # stacked dispatch → resume
+                        with timer.phase("score"):
+                            block = yield DeviceStep(self, plan,
+                                                     score_single,
+                                                     plan.fn_key)
+                        if strat.probs_source == "qbdc":
+                            def merge_probs(block=block):
+                                return block
+                        else:
+                            # merge the cohort-produced CNN rows with this
+                            # user's host members.  Deferred into the
+                            # select HostStep below: the merge's sklearn
+                            # predicts and the blocking asarray of the
+                            # async device block then ride a pool thread,
+                            # off the scheduler's critical path
+                            def merge_probs(block=block, sub=sub,
+                                            live=live, plan=plan):
+                                return committee.pool_probs(
+                                    data.pool, data.store, live, sub,
+                                    pad_to=plan.pad_to, cnn_block=block)
+                    elif self.host_offloadable:
                         member_probs = yield HostStep(self, score, "score")
                     else:
                         member_probs = score()
-                if strat.uses_weights and committee.quarantined:
+                def weight_fixup():
                     # a member quarantined DURING this pass keeps its probs
                     # row (NaN'd, then sanitized to the survivor mean) and
                     # its axis slot — zero its weight so it can't re-enter
                     # the weighted consensus through a stale reliability
                     # weight (the mask-before-renormalize contract; members
                     # quarantined on earlier passes already left the axis)
+                    if not (strat.uses_weights and committee.quarantined):
+                        return
                     w = np.asarray(acq.member_weights, np.float32).copy()
                     for i, nm in enumerate(self._scoring_member_names or []):
                         if nm in committee.quarantined:
                             w[i] = 0.0
                     acq.member_weights = w
+
+                if merge_probs is None:
+                    weight_fixup()
                 self.key, sub = jax.random.split(self.key)
                 with timer.phase("select"):
-                    fn_key, inputs = acq.scoring_inputs(member_probs,
-                                                        rand_key=sub)
+                    if merge_probs is not None:
+                        # probs merge + scoring staging for the plan path:
+                        # per-user host work (deterministic regardless of
+                        # thread), pooled so peers' stacked dispatches and
+                        # this staging pipeline instead of lockstepping.
+                        # The wmc weight fixup runs INSIDE the step, after
+                        # the host-member merge: a host member quarantined
+                        # during the merge must zero its weight before
+                        # scoring_inputs reads the weights — the inline
+                        # path's order (merge → fixup → scoring_inputs)
+                        def stage_select(sub=sub):
+                            mp = merge_probs()
+                            weight_fixup()
+                            return acq.scoring_inputs(mp,
+                                                      rand_key=sub), mp
+                        (fn_key, inputs), member_probs = yield HostStep(
+                            self, stage_select, "select")
+                    else:
+                        fn_key, inputs = acq.scoring_inputs(member_probs,
+                                                            rand_key=sub)
                     res = yield ScoreStep(self, fn_key, inputs)
                     q_songs = acq.finish_select(res)
 
                 # only wmc reads the probs table post-select; binding None
                 # otherwise lets the device buffer drop before the (possibly
                 # host-offloaded) update/retrain phase instead of pinning it
-                def update_and_eval(epoch=epoch, q_songs=q_songs,
-                                    before=last_host_f1s,
-                                    probs=(member_probs
-                                           if strat.uses_weights else None),
-                                    live=live):
+                def reveal_update(q_songs=q_songs, before=last_host_f1s,
+                                  probs=(member_probs
+                                         if strat.uses_weights
+                                         else None),
+                                  live=live):
                     from consensus_entropy_tpu.al.loop import query_batch
 
-                    # reveal labels; build the frame batch (amg_test.py:
-                    # 491-493)
-                    X_batch, y_batch = query_batch(data.pool, data.labels,
-                                                   q_songs)
+                    # reveal labels; build the frame batch
+                    # (amg_test.py:491-493)
+                    X_batch, y_batch = query_batch(
+                        data.pool, data.labels, q_songs)
                     if strat.uses_weights:
-                        # post-reveal agreement -> reliability weights for
-                        # the NEXT iteration's weighted consensus
-                        self._update_member_weights(probs, live, q_songs)
+                        # post-reveal agreement -> reliability weights
+                        # for the NEXT weighted consensus
+                        self._update_member_weights(probs, live,
+                                                    q_songs)
                     with timer.phase("update_host"):
                         if cfg.gate_host_updates and len(split.X_test):
                             committee.update_host_gated(
                                 X_batch, y_batch, split.X_test,
-                                split.y_test_frames, before_scores=before)
+                                split.y_test_frames,
+                                before_scores=before)
                         else:
                             committee.update_host(X_batch, y_batch)
-                    if committee.active_cnn_members:
-                        y_q = one_hot_np([data.labels[s] for s in q_songs])
-                        y_t = one_hot_np(split.y_test_songs)
-                        self.key, sub = jax.random.split(self.key)
-                        with timer.phase("retrain_cnn"):
-                            # fit_many rebinds member variables only on
-                            # return, so a transient failure mid-fit left no
-                            # partial mutation and the retry replays the
-                            # identical fit
-                            retry_transient(
-                                lambda sub=sub, y_q=y_q, y_t=y_t:
-                                committee.retrain_cnns(
-                                    data.store, q_songs, y_q,
-                                    split.test_songs, y_t, sub,
-                                    n_epochs=self.retrain_epochs),
-                                attempts=cfg.retry_attempts,
-                                base_delay=cfg.retry_base_delay,
-                                seed=seed + 7919 * (epoch + 1),
-                                what="member.retrain")
-                    self.key, sub = jax.random.split(self.key)
-                    with timer.phase("evaluate"):
-                        f1s = self._evaluate(report, sub)
+
+                def finish_epoch(f1s, epoch=epoch, q_songs=q_songs):
                     if drain_events(epoch):
-                        f1_prev = None  # member set shifted mid-iteration
+                        f1_prev = None  # member set shifted mid-iter
                     else:
                         f1_prev = f1s[len(committee.active_cnn_members):]
                     report.epoch_summary(epoch, f1s, queried=q_songs,
@@ -578,11 +723,116 @@ class UserSession:
                     trajectory.append(float(np.mean(f1s)))
                     return f1_prev
 
-                if self.host_offloadable:
-                    last_host_f1s = yield HostStep(self, update_and_eval,
-                                                   "update_eval")
+                if self.cnn_steps:
+                    # the old monolithic update_and_eval, split per step so
+                    # a CNN session's jax-free sklearn block still rides
+                    # the worker pool (overlapping peers' device work) and
+                    # its retrain rides the stacked cohort dispatch; the
+                    # statements — and the per-user key stream — run in
+                    # the identical order, so trajectories are unchanged
+                    if (self.sklearn_offloadable
+                            and committee.active_host_members):
+                        yield HostStep(self, reveal_update, "update_host")
+                    else:
+                        reveal_update()
+                    if committee.active_cnn_members:
+                        y_q = one_hot_np([data.labels[s] for s in q_songs])
+                        y_t = one_hot_np(split.y_test_songs)
+                        self.key, sub = jax.random.split(self.key)
+                        plan = committee.retrain_plan(
+                            data.store, q_songs, y_q, split.test_songs,
+                            y_t, sub, n_epochs=self.retrain_epochs)
+
+                        def retrain_single(sub=sub, y_q=y_q, y_t=y_t,
+                                           q_songs=q_songs, epoch=epoch):
+                            # fit_many rebinds member variables only on
+                            # return, so a transient failure mid-fit left
+                            # no partial mutation and the retry replays
+                            # the identical fit
+                            return retry_transient(
+                                lambda: committee.retrain_cnns(
+                                    data.store, q_songs, y_q,
+                                    split.test_songs, y_t, sub,
+                                    n_epochs=self.retrain_epochs),
+                                attempts=cfg.retry_attempts,
+                                base_delay=cfg.retry_base_delay,
+                                seed=seed + 7919 * (epoch + 1),
+                                what="member.retrain")
+
+                        with timer.phase("retrain_cnn"):
+                            if plan is not None:
+                                yield DeviceStep(self, plan,
+                                                 retrain_single,
+                                                 plan.fn_key)
+                            else:
+                                retrain_single()
+                    self.key, sub = jax.random.split(self.key)
+                    # stage the eval forward exactly as the baseline does:
+                    # the cohort's per-epoch evaluations become one
+                    # stacked dispatch, and the HostStep below keeps only
+                    # the genuinely-host remainder
+                    eval_block = None
+                    eplan = committee.eval_plan(data.store,
+                                                split.test_songs, sub)
+                    if eplan is not None:
+                        with timer.phase("evaluate"):
+                            eval_block = yield DeviceStep(
+                                self, eplan,
+                                lambda sub=sub:
+                                committee.predict_songs_cnn(
+                                    data.store, split.test_songs, sub),
+                                eplan.fn_key)
+
+                    def eval_epoch(sub=sub, block=eval_block):
+                        # the heaviest host block of a CNN session
+                        # (sklearn predicts + report math): pooled, like
+                        # the baseline above, so one user's eval overlaps
+                        # peers' stacked dispatches instead of stalling
+                        # the scheduler thread
+                        with timer.phase("evaluate"):
+                            f1s = self._evaluate(report, sub,
+                                                 cnn_probs=block)
+                        return finish_epoch(f1s)
+
+                    last_host_f1s = yield HostStep(self, eval_epoch,
+                                                   "evaluate")
                 else:
-                    last_host_f1s = update_and_eval()
+                    def update_and_eval(epoch=epoch, q_songs=q_songs):
+                        # the pre-split monolith: same statements as the
+                        # cnn_steps branch above (shared reveal_update /
+                        # finish_epoch closures), in one block so a pure-
+                        # host session still rides the pool as ONE step
+                        reveal_update()
+                        if committee.active_cnn_members:
+                            y_q = one_hot_np([data.labels[s]
+                                              for s in q_songs])
+                            y_t = one_hot_np(split.y_test_songs)
+                            self.key, sub = jax.random.split(self.key)
+                            with timer.phase("retrain_cnn"):
+                                # fit_many rebinds member variables only on
+                                # return, so a transient failure mid-fit
+                                # left no partial mutation and the retry
+                                # replays the identical fit
+                                retry_transient(
+                                    lambda sub=sub, y_q=y_q, y_t=y_t:
+                                    committee.retrain_cnns(
+                                        data.store, q_songs, y_q,
+                                        split.test_songs, y_t, sub,
+                                        n_epochs=self.retrain_epochs),
+                                    attempts=cfg.retry_attempts,
+                                    base_delay=cfg.retry_base_delay,
+                                    seed=seed + 7919 * (epoch + 1),
+                                    what="member.retrain")
+                        self.key, sub = jax.random.split(self.key)
+                        with timer.phase("evaluate"):
+                            f1s = self._evaluate(report, sub)
+                        return finish_epoch(f1s)
+
+                    if self.host_offloadable:
+                        last_host_f1s = yield HostStep(self, update_and_eval,
+                                                       "update_eval")
+                    else:
+                        last_host_f1s = update_and_eval()
 
                 # per-iteration persistence (amg_test.py:511) + resume state
                 queried_hist.append(q_songs)
@@ -594,7 +844,7 @@ class UserSession:
                     timer.flush(user=str(data.user_id), epoch=epoch,
                                 queried=len(q_songs), **labels)
 
-                if self.host_offloadable:  # see boundary0 above
+                if self.sklearn_offloadable:  # see boundary0 above
                     yield HostStep(self, boundary, "checkpoint")
                 else:
                     boundary()

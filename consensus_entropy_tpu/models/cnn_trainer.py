@@ -144,6 +144,15 @@ def _split_member_keys(ks):
     return splits[:, 0], splits[:, 1]
 
 
+def _split_user_member_keys(ks):
+    """``(U, M)`` key-carry advance: each user's member keys split exactly
+    as :func:`_split_member_keys` splits them in a single-user ``fit_many``
+    (vmap only batches the identical per-key threefry derivation), so the
+    user-lockstep schedule reproduces every user's own random stream."""
+    splits = jax.vmap(jax.vmap(jax.random.split))(ks)
+    return splits[:, :, 0], splits[:, :, 1]
+
+
 def _epoch_fns_cached(key_: tuple, build: Callable[[], Callable]) -> Callable:
     fn = _EPOCH_FNS.get(key_)
     if fn is None:
@@ -331,6 +340,49 @@ class CNNTrainer:
                 mapped,
                 in_shardings=(member,) * 6 + (repl,) * 6 + (member,),
                 out_shardings=(member,) * 6 + (repl,) * 5,
+                donate_argnums=(0, 1, 2, 3, 4))
+
+        return _epoch_fns_cached(key_, build)
+
+    def _build_epoch_users(self, phase: str, n_train: int, n_test: int,
+                           batch_size: int) -> Callable:
+        """Cross-USER lockstep epoch: ``lax.map`` over the users axis of
+        the member-lockstep epoch body, every argument (including the
+        waveform store and id tables, which ``_build_epoch_many``
+        broadcasts within one user) carried per user.  ``lax.map`` rather
+        than ``vmap`` for the same two reasons as the member axis: batched
+        conv kernels lower to slower feature-group convs, and the mapped
+        body runs the IDENTICAL per-user program — so each user's
+        trajectory is bit-identical to its own ``fit_many``
+        (pinned by ``tests/test_cnn_fleet.py``)."""
+        epoch_m = self._build_epoch_many(phase, n_train, n_test, batch_size)
+
+        def mapped(params, stats, opt, best_p, best_s, best_score,
+                   data, lengths, train_rows, train_y, test_rows, test_y,
+                   keys):
+            return jax.lax.map(
+                lambda a: epoch_m(*a),
+                (params, stats, opt, best_p, best_s, best_score,
+                 data, lengths, train_rows, train_y, test_rows, test_y,
+                 keys))
+
+        return mapped
+
+    def _phase_fn_users(self, phase: str, n_ep: int, n_train: int,
+                        n_test: int, batch_size: int) -> Callable:
+        """A whole schedule phase of the user-lockstep epoch as ONE
+        scanned jit (the ``_phase_fn_many`` shape, one users axis up):
+        ≤4 dispatches retrain a whole cohort.  Cached like every epoch
+        program; jit specializes per (U, M) cohort shape."""
+        batch_size = max(1, min(batch_size, n_train))
+        key_ = (self.config, self.train_config, "phase_users", phase, n_ep,
+                n_train, n_test, batch_size)
+
+        def build():
+            mapped = self._build_epoch_users(phase, n_train, n_test,
+                                             batch_size)
+            return jax.jit(
+                self._make_phase_run(mapped, n_ep, _split_user_member_keys),
                 donate_argnums=(0, 1, 2, 3, 4))
 
         return _epoch_fns_cached(key_, build)
@@ -824,3 +876,120 @@ class CNNTrainer:
                                              state["best_stats"])}
                 for m in range(n_members)]
         return best, histories
+
+    def fit_many_users(self, users: list[dict], *,
+                       n_epochs: int | None = None,
+                       batch_size: int | None = None,
+                       adam_patience: int | None = None) -> list[tuple]:
+        """Train U users' committees in USER-AND-MEMBER lockstep: one
+        scanned jit per schedule phase for the whole cohort — the
+        cross-user extension of :meth:`fit_many`, and the device half of
+        the fleet scheduler's ``cnn_retrain`` stacked dispatch
+        (``committee.CNNRetrainPlan``).
+
+        ``users``: one dict per user with ``variables_list`` (member
+        variable trees), ``store`` (:class:`DeviceWaveformStore`),
+        ``train_ids`` / ``train_y`` / ``test_ids`` / ``test_y`` and the
+        user's retrain ``key``.  The cohort must be homogeneous in member
+        count, split sizes and store geometry (the scheduler's plan
+        group key guarantees it; checked loudly here).
+
+        Exactness: lockstep across users is exact for the same reason it
+        is across members — the optimizer schedule is epoch-indexed, so
+        every user switches phase at the same epoch, and the user axis is
+        a ``lax.map`` whose body is the member-lockstep epoch itself
+        (``_build_epoch_users``), fed each user's own data/keys.  Member
+        ``i`` of user ``u`` trains under ``fold_in(users[u].key, i)`` —
+        the exact stream its own ``fit_many`` call would use — so
+        per-user results are bit-identical to U sequential ``fit_many``
+        calls (pinned by ``tests/test_cnn_fleet.py``).
+
+        Returns ``[(best_variables_list, histories), ...]`` per user, each
+        element exactly :meth:`fit_many`'s return shape.  Mesh sharding
+        and per-epoch callbacks are the per-user path's business — cohort
+        retraining is the callback-free production path.
+        """
+        from consensus_entropy_tpu.models.short_cnn import (
+            stack_params,
+            stack_user_params,
+        )
+
+        cfg = self.train_config
+        n_epochs = cfg.n_epochs if n_epochs is None else n_epochs
+        batch_size = batch_size or cfg.batch_size
+        adam_patience = adam_patience or cfg.adam_patience
+        u0 = users[0]
+        n_users = len(users)
+        n_members = len(u0["variables_list"])
+        n_train, n_test = len(u0["train_ids"]), len(u0["test_ids"])
+        for u in users:
+            if (len(u["variables_list"]) != n_members
+                    or len(u["train_ids"]) != n_train
+                    or len(u["test_ids"]) != n_test
+                    or u["store"].data.shape != u0["store"].data.shape):
+                raise ValueError(
+                    "fit_many_users cohort is not homogeneous (member "
+                    "count / split sizes / store geometry must match; "
+                    "group plans by their group_key)")
+
+        stacked = stack_user_params(
+            [stack_params(u["variables_list"]) for u in users])
+        params = stacked["params"]
+        batch_stats = stacked["batch_stats"]
+        best_params = jax.tree.map(jnp.copy, params)
+        best_stats = jax.tree.map(jnp.copy, batch_stats)
+        best_score = jnp.zeros((n_users, n_members))
+        # member i of user u: fold_in(key_u, i) — fit_many's exact stream;
+        # typed keys ride as raw key data across the user stack
+        keys = jax.random.wrap_key_data(jnp.stack([
+            jax.random.key_data(jax.vmap(
+                lambda i, k=u["key"]: jax.random.fold_in(k, i))(
+                    jnp.arange(n_members)))
+            for u in users]))
+        opt_state = jax.vmap(jax.vmap(make_tx(PHASES[0], cfg).init))(params)
+
+        data = jnp.stack([u["store"].data for u in users])
+        lengths = jnp.stack([u["store"].lengths for u in users])
+        train_rows = jnp.stack([jnp.asarray(u["store"].row_of(u["train_ids"]))
+                                for u in users])
+        train_y = jnp.stack([jnp.asarray(u["train_y"]) for u in users])
+        test_rows = jnp.stack([jnp.asarray(u["store"].row_of(u["test_ids"]))
+                               for u in users])
+        test_y = jnp.stack([jnp.asarray(u["test_y"]) for u in users])
+
+        state = {"params": params, "batch_stats": batch_stats,
+                 "opt_state": opt_state, "best_params": best_params,
+                 "best_stats": best_stats, "best_score": best_score,
+                 "keys": keys}
+
+        def reload_best(phase):
+            state["params"] = jax.tree.map(jnp.copy, state["best_params"])
+            state["batch_stats"] = jax.tree.map(jnp.copy,
+                                                state["best_stats"])
+            state["opt_state"] = jax.vmap(jax.vmap(
+                make_tx(phase, cfg).init))(state["params"])
+
+        rows = self._run_scanned_schedule(
+            n_epochs, adam_patience,
+            lambda phase, n_ep: self._phase_fn_users(
+                phase, n_ep, n_train, n_test, batch_size),
+            reload_best, state, "keys",
+            (data, lengths, train_rows, train_y, test_rows, test_y))
+
+        out = []
+        for ui in range(n_users):
+            histories = [
+                [{"epoch": epoch, "phase": phase,
+                  "train_loss": float(tl[ui, m]), "val_loss": float(vl[ui, m]),
+                  "val_f1": float(f1[ui, m]), "improved": bool(imp[ui, m])}
+                 for epoch, phase, tl, vl, f1, imp in rows]
+                for m in range(n_members)]
+            best = [{"params": jax.tree.map(
+                         lambda a, ui=ui, m=m: a[ui, m],
+                         state["best_params"]),
+                     "batch_stats": jax.tree.map(
+                         lambda a, ui=ui, m=m: a[ui, m],
+                         state["best_stats"])}
+                    for m in range(n_members)]
+            out.append((best, histories))
+        return out

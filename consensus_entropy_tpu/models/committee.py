@@ -20,6 +20,7 @@ TPU-native shape of the same computation:
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import os
 from typing import Sequence
@@ -284,6 +285,35 @@ def _qbdc_infer_fn(config: CNNConfig):
     return jax.jit(infer)
 
 
+@functools.lru_cache(maxsize=None)
+def _user_infer_fn(config: CNNConfig):
+    """Process-wide jitted CROSS-USER committee forward for ``config``:
+    ``short_cnn.committee_infer_users`` over ``(U, M, …)`` stacked user
+    params and ``(U, bucket, L)`` crop batches.  One cache entry per
+    config; jit specializes per (U, M, bucket) shape, so each serve
+    bucket's cohort geometry owns its compiled program — the per-width
+    executable-lifetime property ``fleet_scoring_fns_for_width`` gives the
+    reduction scorers, inherited here through shape keying."""
+
+    def infer(user_stacked, x):
+        return short_cnn.committee_infer_users(user_stacked, x, config)
+
+    return jax.jit(infer)
+
+
+@functools.lru_cache(maxsize=None)
+def _user_qbdc_infer_fn(config: CNNConfig):
+    """Cross-user QBDC forward (``short_cnn.qbdc_infer_users``), cached
+    like :func:`_user_infer_fn`.  Takes raw mask-key DATA ``(U, K, …)``
+    (typed keys re-wrapped inside the jit)."""
+
+    def infer(user_variables, x, mask_key_data):
+        return short_cnn.qbdc_infer_users(user_variables, x, mask_key_data,
+                                          config)
+
+    return jax.jit(infer)
+
+
 class Committee:
     """The user's private committee: M_host sklearn + M_cnn Flax members.
 
@@ -486,7 +516,8 @@ class Committee:
     def pool_probs(self, pool: FramePool | None,
                    store: DeviceWaveformStore | None,
                    song_ids: Sequence, key,
-                   pad_to: int | None = None) -> jnp.ndarray:
+                   pad_to: int | None = None, *,
+                   cnn_block=None) -> jnp.ndarray:
         """Stacked member probabilities ``(M, N, C)`` over ``song_ids``.
 
         CNN rows first (committee order = member_names).  Without
@@ -512,6 +543,12 @@ class Committee:
         scatters it into its persistent padded buffer).  Mesh committees
         return ``np.ndarray`` (blocks carry different placements; the
         sharded scoring fns re-shard on upload).
+
+        ``cnn_block``: a precomputed ``(M_cnn, width, C)`` CNN member block
+        (the fleet scheduler's cross-user stacked dispatch hands each
+        session its own rows) — used in place of
+        :meth:`predict_songs_cnn`, which the single-user path still calls;
+        the host-member block and the merge are identical either way.
         """
         n_live = len(song_ids)
         if pad_to is not None and pad_to < n_live:
@@ -524,11 +561,18 @@ class Committee:
             raise ValueError("pad_to requires at least one live song")
         blocks = []
         if active_cnn:
-            assert store is not None
-            # async dispatch either way; full_song_hop swaps the reference's
-            # stochastic single crop for the deterministic window grid
-            blocks.append(self.predict_songs_cnn(store, song_ids, key,
-                                                 pad_to=pad_to))
+            if cnn_block is not None:
+                # the cohort-stacked dispatch already produced this user's
+                # rows (still an async device array; the host members below
+                # compute while it resolves)
+                blocks.append(cnn_block)
+            else:
+                assert store is not None
+                # async dispatch either way; full_song_hop swaps the
+                # reference's stochastic single crop for the deterministic
+                # window grid
+                blocks.append(self.predict_songs_cnn(store, song_ids, key,
+                                                     pad_to=pad_to))
         width = n_live if pad_to is None else pad_to
         if active_host:
             assert pool is not None
@@ -654,35 +698,64 @@ class Committee:
         if len(rows) == 0:
             return jnp.zeros((k, pad_to or 0, self.config.n_class),
                              jnp.float32)
-        crop_key, mask_key = jax.random.split(jnp.asarray(key))
-        faults.fire("acquire.qbdc.masks", k=int(k))
-        mask_keys = jax.random.split(mask_key, k)
-        if not jax.config.jax_threefry_partitionable:
-            # same point-of-reliance check as predict_songs_cnn: the crop
-            # compile-buckets below need prefix-stable threefry draws
-            raise RuntimeError(
-                "jax_threefry_partitionable is off; crop compile-buckets "
-                "require prefix-stable threefry — enable the flag (the "
-                "modern JAX default) to use the qbdc scoring path")
-        bucket = 256
-        pad = -len(rows) % bucket
-        rows_in = np.concatenate([rows, np.repeat(rows[-1:], pad)]) \
-            if pad else rows
-        crops = store.sample_crops(crop_key, rows_in)
+        crops, mask_keys = self._qbdc_stage(store, rows, key, k)
         infer = _qbdc_infer_fn(self.config)
-        variables = active[0].variables
+        variables = self.active_cnn_members[0].variables
         # bucket-wide sub-dispatches bound the trunk's activation
         # transient for any pool size (see predict_songs_cnn); the mask
         # keys are unit-level so every slice sees the same K subnetworks
+        bucket = self.CROP_BUCKET
         sub = [infer(variables,
                      jax.lax.dynamic_slice_in_dim(crops, lo, bucket),
                      mask_keys)
                for lo in range(0, crops.shape[0], bucket)]
         out = _concat_member_blocks(sub)
-        keep = len(rows) if pad_to is None else pad_to
+        return self._keep_columns(
+            out, len(rows) if pad_to is None else pad_to)
+
+    #: crop compile-bucket width — matches ``Acquirer.STAGING_BUCKET`` so
+    #: the whole scoring chain quantizes to the same shapes
+    CROP_BUCKET = 256
+
+    def _qbdc_stage(self, store: DeviceWaveformStore, rows, key, k: int):
+        """Stage one qbdc scoring pass: split the iteration key into crop
+        and mask streams, fire the ``acquire.qbdc.masks`` fault point, and
+        sample the bucket-padded crop batch.  Shared VERBATIM by the
+        single-user forward above and the cross-user stacked dispatch
+        (:func:`run_device_plans`), so the crop/mask streams — and the
+        fault-point hit counts kill drills key on — are identical on both
+        paths.  Returns ``(crops, mask_keys)``."""
+        crop_key, mask_key = jax.random.split(jnp.asarray(key))
+        faults.fire("acquire.qbdc.masks", k=int(k))
+        mask_keys = jax.random.split(mask_key, k)
+        return self._bucketed_crops(store, rows, crop_key), mask_keys
+
+    def _bucketed_crops(self, store: DeviceWaveformStore, rows, key):
+        """Bucket-padded crop batch for ``rows`` (the 256-crop compile
+        discipline of :meth:`predict_songs_cnn`, factored so the stacked
+        cross-user path samples the identical stream).  Requires
+        prefix-stable threefry — checked at the point of reliance, not at
+        import (see the inline rationale at :meth:`predict_songs_cnn`)."""
+        import math
+
+        if not jax.config.jax_threefry_partitionable:
+            raise RuntimeError(
+                "jax_threefry_partitionable is off; crop compile-buckets "
+                "require prefix-stable threefry — enable the flag (the "
+                "modern JAX default) to use the CNN scoring path")
+        bucket = math.lcm(self.CROP_BUCKET, self._n_pool_shards)
+        pad = -len(rows) % bucket
+        rows_in = np.concatenate([rows, np.repeat(rows[-1:], pad)]) \
+            if pad else rows
+        return store.sample_crops(key, rows_in)
+
+    @staticmethod
+    def _keep_columns(out, keep: int):
+        """Slice a bucket-wide member/mask block to the staging width,
+        extending with repeats of the last column for an out-of-contract
+        ``pad_to`` beyond the compile bucket (``Acquirer.staging_width``
+        never requests this; the shape contract is honored anyway)."""
         if keep > out.shape[1]:
-            # out-of-contract pad_to beyond the compile bucket: honor the
-            # shape contract anyway (same fallback as predict_songs_cnn)
             out = jnp.concatenate(
                 [out, jnp.repeat(out[:, -1:], keep - out.shape[1],
                                  axis=1)], axis=1)
@@ -912,24 +985,16 @@ class Committee:
             # to one avoided compile.
             import math
 
-            # The bucket padding below is only sound when threefry draws
-            # are prefix-stable across batch widths (the modern JAX
-            # default).  Check at the point of reliance — NOT a package
-            # import-time config mutation, which would silently change an
-            # embedding application's unrelated jax.random streams on a
-            # JAX defaulting the flag off — so a config flip fails loudly
-            # instead of silently diverging the crop stream.
-            if not jax.config.jax_threefry_partitionable:
-                raise RuntimeError(
-                    "jax_threefry_partitionable is off; crop "
-                    "compile-buckets require prefix-stable threefry — "
-                    "enable the flag (the modern JAX default) to use the "
-                    "CNN scoring path")
-            bucket = math.lcm(256, self._n_pool_shards)
-            pad = -len(rows) % bucket
-            rows_in = np.concatenate([rows, np.repeat(rows[-1:], pad)]) \
-                if pad else rows
-            crops = store.sample_crops(key, rows_in)
+            # The bucket padding (_bucketed_crops) is only sound when
+            # threefry draws are prefix-stable across batch widths (the
+            # modern JAX default).  Checked there, at the point of
+            # reliance — NOT a package import-time config mutation, which
+            # would silently change an embedding application's unrelated
+            # jax.random streams on a JAX defaulting the flag off — so a
+            # config flip fails loudly instead of silently diverging the
+            # crop stream.
+            bucket = math.lcm(self.CROP_BUCKET, self._n_pool_shards)
+            crops = self._bucketed_crops(store, rows, key)
             stacked = self._feed_repl(self._stacked())
             # Forward in BUCKET-wide sub-dispatches, not one batch: at full
             # geometry the first conv block materializes ~15 MB/member-crop,
@@ -948,15 +1013,8 @@ class Committee:
             out = _concat_member_blocks(sub)
             # slice to the STAGING width, not the live width: the bucket
             # quantizes the slice program to ~n_pad/256 shapes per run
-            keep = len(rows) if pad_to is None else pad_to
-            if keep > out.shape[1]:
-                # out-of-contract pad_to (beyond the internal compile
-                # bucket — Acquirer.staging_width never requests this):
-                # honor the shape contract anyway, at a per-width compile
-                out = jnp.concatenate(
-                    [out, jnp.repeat(out[:, -1:], keep - out.shape[1],
-                                     axis=1)], axis=1)
-            return out[:, :keep] if keep != out.shape[1] else out
+            return self._keep_columns(
+                out, len(rows) if pad_to is None else pad_to)
         n = len(rows)
         # each window chunk is one sharded dispatch; keep it shard-divisible
         chunk = _round_up(chunk, self._n_pool_shards)
@@ -1029,6 +1087,74 @@ class Committee:
                 seq_mesh, plan, self.config)
         return scorer(self._stacked(), jnp.asarray(pad_song(wave, plan)),
                       plan.n_windows)
+
+    # -- cross-user device plans (fleet stacked dispatch) ------------------
+
+    def cnn_score_plan(self, store: DeviceWaveformStore | None, song_ids,
+                       key, *, pad_to: int) -> "CNNScorePlan | None":
+        """Stage this committee's CNN scoring pass as a batchable plan.
+
+        The fleet scheduler groups same-signature plans from a cohort and
+        runs them as ONE stacked device dispatch
+        (:func:`run_device_plans`); the sequential driver and any
+        batch-of-one falls back to :meth:`predict_songs_cnn` unchanged.
+        Returns ``None`` when this committee can't ride the stacked path
+        (no active CNN members, pool-sharded mesh, window-grid scoring, no
+        device store) — the caller then uses the inline path."""
+        if (not self.active_cnn_members or self.mesh is not None
+                or self.full_song_hop is not None or store is None
+                or not len(song_ids)):
+            return None
+        return CNNScorePlan(self, store, tuple(song_ids), key, pad_to,
+                            len(self.active_cnn_members))
+
+    def eval_plan(self, store: DeviceWaveformStore | None, song_ids,
+                  key) -> "CNNEvalPlan | None":
+        """Stage the per-epoch EVAL forward (``predict_songs_cnn`` over the
+        test split, no staging pad — the eval consumes exactly ``n`` rows)
+        as a batchable plan, so a cohort's evaluations ride ONE stacked
+        dispatch instead of hiding a full 256-crop forward inside each
+        user's host eval block.  Same eligibility rules as
+        :meth:`cnn_score_plan`."""
+        if (not self.active_cnn_members or self.mesh is not None
+                or self.full_song_hop is not None or store is None
+                or not len(song_ids)):
+            return None
+        return CNNEvalPlan(self, store, tuple(song_ids), key, len(song_ids),
+                           len(self.active_cnn_members))
+
+    def qbdc_score_plan(self, store: DeviceWaveformStore | None, song_ids,
+                        key, *, k: int, pad_to: int) -> "QBDCScorePlan | None":
+        """qbdc sibling of :meth:`cnn_score_plan`: one personalized CNN ×
+        ``k`` dropout masks, stacked ``(U, K)`` across the cohort.
+        ``None`` routes the caller to :meth:`qbdc_pool_probs`, whose
+        upfront validation raises the proper errors."""
+        if (not self.active_cnn_members or self.mesh is not None
+                or store is None or k < 1 or not len(song_ids)):
+            return None
+        return QBDCScorePlan(self, store, tuple(song_ids), key, int(k),
+                             pad_to)
+
+    def retrain_plan(self, store: DeviceWaveformStore, train_ids, train_y,
+                     test_ids, test_y, key, *,
+                     n_epochs: int | None = None) -> "CNNRetrainPlan | None":
+        """Stage :meth:`retrain_cnns` as a batchable plan: same-signature
+        cohorts train in user-lockstep through
+        ``CNNTrainer.fit_many_users`` — one jit dispatch per schedule
+        phase for the WHOLE cohort instead of per user.  ``None`` (mesh
+        retraining, host store, no active members, empty splits) falls
+        back to the per-user path."""
+        if (not self.active_cnn_members or self.train_mesh is not None
+                or self.mesh is not None or store is None
+                or not hasattr(store, "data")
+                or not len(train_ids) or not len(test_ids)):
+            return None
+        members = tuple(self.active_cnn_members)
+        return CNNRetrainPlan(
+            self, members, store, tuple(train_ids), np.asarray(train_y),
+            tuple(test_ids), np.asarray(test_y), key,
+            (self.trainer.train_config.n_epochs_retrain
+             if n_epochs is None else int(n_epochs)))
 
     # -- persistence -------------------------------------------------------
 
@@ -1122,3 +1248,232 @@ class Committee:
                     "n_members_fetched": len(snapshot)}
 
         return finish
+
+
+# -- cross-user device plans ------------------------------------------------
+#
+# The fleet scheduler's batching seam for the CNN device path: a session
+# whose committee can stack yields a plan instead of running its forward /
+# retrain inline; the scheduler groups plans by ``group_key()`` (one entry
+# per architecture × member-count × crop-bucket × staging-width cohort) and
+# services each multi-session group with ONE stacked dispatch
+# (:func:`run_device_plans` → ``lax.map`` over the users axis — bit-identical
+# per-user rows, see ``short_cnn.committee_infer_users``).  Groups of one —
+# and the sequential driver — use the session's own single-user closure, so
+# the per-user jitted path stays the ground truth.
+
+
+@dataclasses.dataclass
+class CNNScorePlan:
+    """One user's staged stored-committee CNN scoring pass (mc/mix/wmc
+    probs producer).  ``pad_to`` is the acquirer's staging width; crops are
+    sampled lazily at dispatch with the SAME helper the single-user path
+    uses (``Committee._bucketed_crops``), so the crop stream is identical
+    regardless of which path runs."""
+
+    committee: Committee
+    store: DeviceWaveformStore
+    song_ids: tuple
+    key: object
+    pad_to: int
+    n_members: int
+
+    fn_key = "cnn_probs"
+    #: fault point fired per plan on the stacked path — mirrors the
+    #: single-user closure's wrapping (the scoring pass fires
+    #: ``pool.score``; the eval forward fires none), so fault-injection
+    #: hit counts are identical on both paths
+    fault_point = "pool.score"
+
+    def group_key(self):
+        bucket = Committee.CROP_BUCKET
+        n_pad = -(-len(self.song_ids) // bucket) * bucket
+        return (self.fn_key, self.committee.config, self.n_members, n_pad,
+                self.pad_to)
+
+    @staticmethod
+    def run_many(plans: list["CNNScorePlan"]):
+        config = plans[0].committee.config
+        bucket = Committee.CROP_BUCKET
+        crops = jnp.stack([
+            p.committee._bucketed_crops(p.store, p.store.row_of(p.song_ids),
+                                        p.key)
+            for p in plans])
+        user_stacked = short_cnn.stack_user_params(
+            [p.committee._stacked() for p in plans])
+        infer = _user_infer_fn(config)
+        # same bucket-wide sub-dispatch discipline as predict_songs_cnn:
+        # the mapped body bounds the activation transient per user, and the
+        # (U, M, bucket) program compiles once per cohort geometry
+        sub = [infer(user_stacked,
+                     jax.lax.dynamic_slice_in_dim(crops, lo, bucket, axis=1))
+               for lo in range(0, crops.shape[1], bucket)]
+        out = jnp.concatenate(sub, axis=2) if len(sub) > 1 else sub[0]
+        res = [Committee._keep_columns(out[i], p.pad_to)
+               for i, p in enumerate(plans)]
+        if plans[0].fault_point:
+            res = [faults.fire(plans[0].fault_point, payload=r)
+                   for r in res]
+        return res
+
+
+class CNNEvalPlan(CNNScorePlan):
+    """One user's staged EVAL forward: ``predict_songs_cnn`` over the test
+    split, batchable exactly like the scoring pass (same crop helper, same
+    stacked infer body) so a cohort's per-epoch evaluations ride ONE
+    device dispatch and the eval's remainder (sklearn predicts + metrics)
+    stays a pure-host block on the worker pool.  No ``pool.score`` fault
+    point: the single-user eval path fires none."""
+
+    fn_key = "cnn_eval"
+    fault_point = None
+
+
+@dataclasses.dataclass
+class QBDCScorePlan:
+    """One user's staged qbdc scoring pass: ONE personalized CNN × ``k``
+    seeded dropout masks.  Key split / mask derivation / the
+    ``acquire.qbdc.masks`` fault point run per user through the same
+    ``Committee._qbdc_stage`` the single-user forward uses, so the dropout
+    committee is bit-identical on both paths."""
+
+    committee: Committee
+    store: DeviceWaveformStore
+    song_ids: tuple
+    key: object
+    k: int
+    pad_to: int
+
+    fn_key = "qbdc_probs"
+
+    def group_key(self):
+        bucket = Committee.CROP_BUCKET
+        n_pad = -(-len(self.song_ids) // bucket) * bucket
+        return (self.fn_key, self.committee.config, self.k, n_pad,
+                self.pad_to)
+
+    @staticmethod
+    def run_many(plans: list["QBDCScorePlan"]):
+        config = plans[0].committee.config
+        bucket = Committee.CROP_BUCKET
+        staged = [p.committee._qbdc_stage(
+                      p.store, p.store.row_of(p.song_ids), p.key, p.k)
+                  for p in plans]
+        crops = jnp.stack([c for c, _ in staged])
+        # typed keys don't jnp.stack portably: ship raw key data, re-wrap
+        # inside the mapped body (short_cnn.qbdc_infer_users)
+        mask_data = jnp.stack([jax.random.key_data(mk) for _, mk in staged])
+        variables = short_cnn.stack_user_params(
+            [p.committee.active_cnn_members[0].variables for p in plans])
+        infer = _user_qbdc_infer_fn(config)
+        sub = [infer(variables,
+                     jax.lax.dynamic_slice_in_dim(crops, lo, bucket, axis=1),
+                     mask_data)
+               for lo in range(0, crops.shape[1], bucket)]
+        out = jnp.concatenate(sub, axis=2) if len(sub) > 1 else sub[0]
+        return [faults.fire(
+                    "pool.score",
+                    payload=Committee._keep_columns(out[i], p.pad_to))
+                for i, p in enumerate(plans)]
+
+
+@dataclasses.dataclass
+class CNNRetrainPlan:
+    """One user's staged committee retrain (``Committee.retrain_cnns``
+    semantics).  Same-signature cohorts train in USER lockstep — the
+    epoch-indexed schedule makes this exact, just as member lockstep is
+    (``CNNTrainer.fit_many``) — and each member's best-checkpoint gate /
+    rebinding applies per user exactly as the single path does."""
+
+    committee: Committee
+    members: tuple
+    store: DeviceWaveformStore
+    train_ids: tuple
+    train_y: np.ndarray
+    test_ids: tuple
+    test_y: np.ndarray
+    key: object
+    n_epochs: int
+
+    fn_key = "cnn_retrain"
+
+    def group_key(self):
+        return (self.fn_key, self.committee.config,
+                self.committee.trainer.train_config, len(self.members),
+                len(self.train_ids), len(self.test_ids), self.n_epochs,
+                tuple(self.store.data.shape))
+
+    @staticmethod
+    def run_many(plans: list["CNNRetrainPlan"]):
+        # PURE compute: fit the cohort and return the raw ``fit_many_users``
+        # result — member rebinding lives in :meth:`apply_many` so a
+        # watchdog-abandoned stacked dispatch (a zombie thread the
+        # scheduler has already fallen back from) can never mutate live
+        # committees when it eventually finishes.  The per-user fault
+        # point fires for every cohort member, exactly once per retrain,
+        # as retrain_cnns does on the single path.
+        for _ in plans:
+            faults.fire("member.retrain", member="__cnn_stack__")
+        trainer = plans[0].committee.trainer
+        return trainer.fit_many_users(
+            [dict(variables_list=[m.variables for m in p.members],
+                  store=p.store, train_ids=list(p.train_ids),
+                  train_y=p.train_y, test_ids=list(p.test_ids),
+                  test_y=p.test_y, key=p.key)
+             for p in plans],
+            n_epochs=plans[0].n_epochs)
+
+    @staticmethod
+    def apply_many(plans: list["CNNRetrainPlan"], fitted):
+        """COMMIT the pure :meth:`run_many` result: the best-checkpoint
+        gate + member rebinding of ``retrain_cnns``, run by the caller
+        AFTER the (possibly watchdog-bounded) dispatch returned — never
+        inside it."""
+        out = []
+        for p, (best, histories) in zip(plans, fitted):
+            for m, b, h in zip(p.members, best, histories):
+                # the best-checkpoint gate of retrain_cnns: a member with
+                # no improved epoch keeps its incoming tree (and stays
+                # checkpoint-clean)
+                if any(e["improved"] for e in h):
+                    m.variables = b
+            out.append(histories)
+        return out
+
+
+def _check_plan_group(plans: list) -> type:
+    kind = type(plans[0])
+    keys = {p.group_key() for p in plans}
+    if any(type(p) is not kind for p in plans) or len(keys) != 1:
+        raise ValueError(
+            f"device-plan group is not homogeneous: {sorted(map(str, keys))}")
+    return kind
+
+
+def stage_device_plans(plans: list):
+    """PURE half of a stacked plan dispatch: run the group's compute and
+    return the raw result, mutating nothing.  This is the piece a
+    scheduler may run under a watchdog — if the deadline expires and the
+    thread is abandoned, the zombie's eventual completion is inert.  The
+    scheduler guarantees homogeneous groups (it groups by
+    ``group_key()``); the check here turns a grouping bug into a loud
+    error instead of a shape explosion inside jit."""
+    return _check_plan_group(plans).run_many(plans)
+
+
+def commit_device_plans(plans: list, computed):
+    """COMMIT half: apply any member-state side effects of the computed
+    result (today only ``CNNRetrainPlan`` has them) and return per-plan
+    results in order.  Callers run this on their own thread AFTER
+    :func:`stage_device_plans` returned in time."""
+    apply = getattr(_check_plan_group(plans), "apply_many", None)
+    return apply(plans, computed) if apply is not None else computed
+
+
+def run_device_plans(plans: list):
+    """Service one GROUP of same-signature device plans as a single
+    stacked dispatch; returns per-plan results in order.  One-shot
+    compute+commit — the watchdog-aware scheduler calls the
+    :func:`stage_device_plans` / :func:`commit_device_plans` halves
+    separately so an abandoned dispatch can never rebind live members."""
+    return commit_device_plans(plans, stage_device_plans(plans))

@@ -330,6 +330,18 @@ def stack_params(member_variables: list):
     return jax.tree.map(lambda *leaves: jnp.stack(leaves), *member_variables)
 
 
+def stack_user_params(user_stacked: list):
+    """Stack per-USER member-stacked pytrees along a leading users axis.
+
+    Input: one ``stack_params`` result per user (each ``(M, …)``); output
+    ``(U, M, …)`` — the operand of :func:`committee_infer_users`, the
+    cross-user device batch the fleet scheduler dispatches for a cohort of
+    same-bucket CNN sessions.  All users must share one architecture /
+    member count (the scheduler's group key guarantees it).
+    """
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *user_stacked)
+
+
 def unstack_params(stacked, index: int):
     """Extract member ``index`` from a stacked pytree."""
     return jax.tree.map(lambda leaf: leaf[index], stacked)
@@ -353,3 +365,46 @@ def committee_infer(stacked_variables, x, config: CNNConfig = CNNConfig()):
     """
     return jax.lax.map(lambda v: apply_infer(v, x, config),
                        stacked_variables)
+
+
+def committee_infer_users(user_stacked, x, config: CNNConfig = CNNConfig()):
+    """Cross-user committee forward: ``(U, M, B, C)`` sigmoid outputs.
+
+    ``user_stacked``: ``(U, M, …)`` per-user member-stacked variables
+    (:func:`stack_user_params`); ``x``: ``(U, B, L)`` per-user crop
+    batches.  A whole same-bucket cohort of CNN sessions scores as ONE
+    device dispatch — the users axis of the fleet scheduler's stacked
+    scoring calls, extended to the probs *producer*.
+
+    ``lax.map`` over the user axis, NOT ``vmap``, for the same reason
+    :func:`committee_infer` maps the member axis: vmapping convolutions
+    over batched kernels lowers to feature-group convs (slower on TPU,
+    and NOT bit-identical — measured 1e-7-level drift on this backend),
+    while the mapped body runs the exact single-user program, so each
+    user's rows are bit-identical to its own jitted
+    ``committee_infer`` call (pinned by ``tests/test_cnn_fleet.py``).
+    The win is dispatch-granularity: one compile, one dispatch, one
+    host round-trip for the cohort.
+    """
+    return jax.lax.map(
+        lambda uv: committee_infer(uv[0], uv[1], config),
+        (user_stacked, x))
+
+
+def qbdc_infer_users(user_variables, x, mask_key_data,
+                     config: CNNConfig = CNNConfig()):
+    """Cross-user QBDC forward: ``(U, K, B, C)`` — one trunk pass per user
+    plus K vmapped dropout heads, all users in ONE device dispatch.
+
+    ``user_variables``: ``(U, …)`` stacked single-member variables (the
+    network QBDC personalizes per user); ``x``: ``(U, B, L)`` crops;
+    ``mask_key_data``: ``(U, K, …)`` RAW key data of each user's mask keys
+    (``jax.random.key_data`` — typed key arrays don't ``jnp.stack``
+    portably; the keys are re-wrapped inside the mapped body).  Same
+    ``lax.map``-over-users bit-identity contract as
+    :func:`committee_infer_users`, against :func:`qbdc_infer`.
+    """
+    return jax.lax.map(
+        lambda a: qbdc_infer(a[0], a[1], jax.random.wrap_key_data(a[2]),
+                             config),
+        (user_variables, x, mask_key_data))

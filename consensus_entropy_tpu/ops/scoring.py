@@ -253,6 +253,16 @@ def make_fleet_scoring_fns(*, k: int,
     relies on ``jax_threefry_partitionable`` (checked at the committee's
     crop buckets too) for per-key draws that are independent of batching.
 
+    CNN cohorts batch end to end through these same keys: the ``mc`` /
+    ``mix`` / ``wmc`` / ``qbdc`` reductions consume probs tables whose
+    PRODUCER the scheduler also stacks across users
+    (``models.committee.run_device_plans`` — the ``lax.map``-over-users
+    CNN forward / dropout committee), so a same-bucket CNN cohort is one
+    device dispatch for the forward AND one for the reduction.  The
+    producer dispatch is keyed per cohort geometry the way these fns are
+    keyed per (k, tie_break) — and per width under bucketed admission,
+    mirroring :func:`fleet_scoring_fns_for_width`.
+
     Same ``lru_cache`` rationale as :func:`make_scoring_fns`: one compiled
     graph per (k, tie_break) process-wide; callers must not mutate the
     returned dict.

@@ -12,7 +12,13 @@ continuously:
   :class:`~consensus_entropy_tpu.serve.buckets.BucketRouter` edge; the
   engine's shape-grouping then dispatches one stacked call per bucket per
   mode through the per-width jit families
-  (``FleetScheduler(scoring_by_width=True)``).
+  (``FleetScheduler(scoring_by_width=True)``).  CNN cohorts batch the
+  same way: same-bucket sessions' CNN forwards / qbdc dropout committees
+  / retrain epochs group by plan signature into one stacked device
+  dispatch each (``models.committee.run_device_plans``), graded in the
+  dispatch records under the ``cnn`` summary section; their jax-free
+  sklearn blocks ride the worker pool per step.  ``--no-stack-cnn``
+  (``FleetScheduler(stack_cnn=False)``) restores per-user CNN dispatch.
 - **Backpressure** — the waiting queue is bounded
   (:class:`AdmissionQueue`); a full queue rejects ``submit`` with
   :class:`QueueFull` instead of buffering unboundedly, and the pull-path

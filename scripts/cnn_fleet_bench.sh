@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Cross-user stacked CNN device path (ISSUE 7 acceptance: CNN-cohort
+# mean_device_batch > 1.5 and >= 1.3x users/sec over the per-user CNN
+# dispatch path on a >= 4-user same-bucket cohort, parity bit-identical
+# to the sequential loop in mc and qbdc modes).  The users/sec ratio is
+# capacity-bound: both arms run equal FLOPs (bit-identity pins the
+# kernels), so the stacked win is host/device overlap, bounded by the
+# box's measured parallel capacity — recorded per run as
+# host_parallel_speedup in the JSON (observed ~1.1x on this throttled
+# image, i.e. the 1.3x arm ratio needs a box where two workers actually
+# run in parallel; mean_device_batch and dispatch counts are the
+# capacity-independent metrics).
+#
+# Runs `bench.py --suite cnn-fleet`: a same-bucket cohort of CNN AL
+# sessions through fleet.FleetScheduler with the cross-user stacked
+# device path (one lax.map-over-users dispatch per round for the CNN
+# probs forward, the qbdc dropout committee, and the lockstep retrain)
+# against the identical engine with `stack_cnn=False` — per-user CNN
+# dispatch, the pre-PR shape.  Reps are interleaved (best-of per arm;
+# this image's cpu shares are throttled) and per-user parity with the
+# sequential ALLoop trajectories is asserted on every rep of both arms,
+# so the reported speedup is for bit-identical results.
+#
+# The JSON line goes to stdout (redirect to BENCH_cnn_fleet_r<N>.json to
+# commit an artifact); the per-arm log goes to stderr.  Extra bench args
+# pass through, e.g.:
+#   scripts/cnn_fleet_bench.sh --users 8 --pool 32 --reps 5
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+if [ "$#" -gt 0 ]; then
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --suite cnn-fleet "$@"
+else
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --suite cnn-fleet \
+        --users 6 --pool 120 --k 10 --al-epochs 2 --reps 5
+fi
