@@ -1075,6 +1075,159 @@ def run_serve_suite(args_ns) -> int:
     return 0
 
 
+def run_serve_fused_suite(args_ns) -> int:
+    """Fused vs unfused serve step on one bucketed workload (ISSUE 8).
+
+    Races two serve arms over IDENTICAL users and seeds — the fused step
+    (device-resident ``DevicePoolState``, donated stacks, in-graph
+    select→reveal→mask; the default) against ``--no-fuse-step`` (score,
+    pull, host bookkeeping, re-upload; the breaker/fallback arm) — with
+    per-user trajectory parity against an unfused SEQUENTIAL baseline
+    asserted on every rep of both arms.  Timing follows the 2-vCPU drift
+    protocol (interleaved reps, best-of per arm), but the headline
+    numbers are the capacity-INDEPENDENT transfer metrics this box can
+    pin: host→device bytes per select, transfer ops per select, and
+    device calls per select — users/sec rides along for context.
+
+    The pool size defaults to 280 songs so the default power-of-two
+    bucket pads users to 512: the regime where the unfused arm re-ships
+    a 512-wide probs table + masks every iteration while the fused arm
+    uploads only the ≤512-wide live block (256 once the pool shrinks
+    under the staging bucket) plus a one-time mask upload at admission
+    (charged to the counters too — the accounting is symmetric).
+    """
+    import shutil
+    import tempfile
+
+    from consensus_entropy_tpu.al.loop import ALLoop
+    from consensus_entropy_tpu.config import ALConfig
+    from consensus_entropy_tpu.fleet import FleetReport, FleetScheduler, \
+        FleetUser
+    from consensus_entropy_tpu.serve import FleetServer, ServeConfig
+
+    cfg = ALConfig(queries=args_ns.k, epochs=args_ns.al_epochs, mode="mc",
+                   seed=1987, ckpt_dtype="float32")
+    n_users = args_ns.users
+    n_songs = args_ns.pool or 280
+    target = max(args_ns.fleet)
+    users = _fleet_workload(n_users, n_songs, 96, cfg.seed)
+    _log(f"serve-fused workload: {n_users} users x {n_songs} songs "
+         f"(power-of-two buckets), 3 host members, q={cfg.queries}, "
+         f"{cfg.epochs} AL iterations, target_live={target}")
+
+    root = tempfile.mkdtemp(prefix="serve_fused_bench_")
+    reps = args_ns.reps
+    try:
+        loop = ALLoop(cfg, fuse_step=False)
+        seq_results = None
+        seq_s = float("inf")
+        arms: dict = {}
+        for rep in range(reps):
+            t0 = time.perf_counter()
+            results = []
+            for i, (data, factory) in enumerate(users):
+                p = _mkdir(root, f"seq{rep}_{i}")
+                results.append(loop.run_user(factory(), data, p,
+                                             seed=cfg.seed))
+            seq_s = min(seq_s, time.perf_counter() - t0)
+            if seq_results is None:
+                seq_results = results
+            elif [r["trajectory"] for r in results] \
+                    != [r["trajectory"] for r in seq_results]:
+                raise AssertionError("sequential reps diverged")
+            traj_of = {r["user"]: r["trajectory"] for r in seq_results}
+
+            for arm, fuse in (("fused", True), ("unfused", False)):
+                report = FleetReport()
+                sched = FleetScheduler(cfg, report=report,
+                                       host_workers=args_ns.host_workers,
+                                       user_timings=False,
+                                       scoring_by_width=True,
+                                       fuse_step=fuse)
+                server = FleetServer(sched, ServeConfig(
+                    target_live=target, max_queue=max(n_users, 1)))
+                entries = [
+                    FleetUser(data.user_id, factory(), data,
+                              _mkdir(root, f"{arm}{rep}_{i}"),
+                              seed=cfg.seed)
+                    for i, (data, factory) in enumerate(users)]
+                t0 = time.perf_counter()
+                recs = server.serve(iter(entries))
+                wall = time.perf_counter() - t0
+                parity = len(recs) == n_users and all(
+                    r["error"] is None
+                    and r["result"]["trajectory"] == traj_of[r["user"]]
+                    for r in recs)
+                if not parity:
+                    raise AssertionError(
+                        f"{arm} arm lost parity on rep {rep}")
+                s = report.summary(cohort=target, wall_s=wall)
+                # uploaded bytes/ops are deterministic per arm (dispatch
+                # GROUPING varies with scheduling timing, so the
+                # calls-per-select figure may wiggle) — assert the
+                # deterministic part instead of averaging; keep the
+                # best-wall rep's summary
+                prev = arms.get(arm)
+                if prev is not None and any(
+                        prev["transfer"][k] != s["transfer"][k]
+                        for k in ("h2d_bytes", "h2d_ops", "selects")):
+                    raise AssertionError(
+                        f"{arm} transfer bytes drifted across reps: "
+                        f"{prev['transfer']} vs {s['transfer']}")
+                if prev is None or s["users_per_sec"] > \
+                        prev["users_per_sec"]:
+                    arms[arm] = s
+
+        seq_ups = n_users / seq_s
+        f, u = arms["fused"], arms["unfused"]
+        tf, tu = f["transfer"], u["transfer"]
+        assert tf["h2d_bytes"] < tu["h2d_bytes"], \
+            "fused arm did not reduce host->device bytes"
+        assert tf["device_calls_per_select"] \
+            < u["transfer"]["device_calls_per_select"], \
+            "fused arm did not reduce device calls per iteration"
+        for arm, s in arms.items():
+            s["speedup_vs_sequential"] = round(
+                s["users_per_sec"] / seq_ups, 2)
+            _log(f"[serve {arm}] best of {reps}: {s['wall_s']:.1f}s "
+                 f"({s['users_per_sec']:.3f} users/s, occupancy "
+                 f"{s['occupancy']}) transfer={s['transfer']}")
+        _log(f"[reduction] h2d bytes/select {tu['h2d_bytes_per_select']}"
+             f" -> {tf['h2d_bytes_per_select']} "
+             f"({tu['h2d_bytes_per_select'] / max(tf['h2d_bytes_per_select'], 1):.2f}x), "
+             f"device calls/select {tu['device_calls_per_select']} -> "
+             f"{tf['device_calls_per_select']}")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    print(json.dumps({
+        "metric": f"serve_fused_step_{n_users}u",
+        "value": f["users_per_sec"],
+        "unit": "users/s",
+        # users/sec ratio rides along for context; the acceptance
+        # metrics are the transfer reductions below (capacity-independent
+        # on the throttled box, where users/sec drifts ~2x)
+        "vs_baseline": round(f["users_per_sec"] / u["users_per_sec"], 2),
+        "target_live": target,
+        "sequential_users_per_sec": round(seq_ups, 4),
+        "unfused_users_per_sec": u["users_per_sec"],
+        "parity_with_sequential": True,  # asserted on every rep
+        "pool_songs": n_songs,
+        "transfer_fused": tf,
+        "transfer_unfused": tu,
+        "h2d_bytes_per_select_reduction": round(
+            tu["h2d_bytes_per_select"]
+            / max(tf["h2d_bytes_per_select"], 1), 2),
+        "device_calls_per_select_reduction": round(
+            tu["device_calls_per_select"]
+            / tf["device_calls_per_select"], 2),
+        "occupancy_fused": f.get("occupancy"),
+        "occupancy_unfused": u.get("occupancy"),
+        **_provenance(),
+    }))
+    return 0
+
+
 def run_serve_faults_suite(args_ns) -> int:
     """Crash-safe serving under a FLAKY user mix: recovered-users/sec.
 
@@ -1868,7 +2021,8 @@ def _mkdir(root, name):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--suite", choices=("linear", "cnn", "retrain", "fleet",
-                                        "serve", "serve-faults", "fabric",
+                                        "serve", "serve-fused",
+                                        "serve-faults", "fabric",
                                         "qbdc", "cnn-fleet"),
                     default="linear",
                     help="linear: the north-star fused pool scoring; cnn: "
@@ -1878,6 +2032,11 @@ def main(argv=None) -> int:
                          "multi-user AL users/sec vs the sequential loop; "
                          "serve: continuous-batching admission + bucketed "
                          "padding vs fleet cohorts on a skewed workload; "
+                         "serve-fused: the fused serve step (device-"
+                         "resident pool state, in-graph select/reveal/"
+                         "mask) vs --no-fuse-step on one bucketed "
+                         "workload — h2d bytes + device calls per "
+                         "iteration, parity asserted every rep; "
                          "serve-faults: recovered-users/sec under a "
                          "fault-injected flaky user mix (watchdog, "
                          "backoff re-admission, circuit breaker); "
@@ -1952,6 +2111,8 @@ def main(argv=None) -> int:
     if args_ns.suite == "fleet":
         # fleet reuses --pool as songs-per-user (default 150 inside)
         return run_fleet_suite(args_ns)
+    if args_ns.suite == "serve-fused":
+        return run_serve_fused_suite(args_ns)
     if args_ns.suite == "serve":
         # serve reuses --pool as the SMALL pool size (every 4th user 4x)
         return run_serve_suite(args_ns)

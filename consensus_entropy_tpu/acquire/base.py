@@ -58,6 +58,17 @@ class AcquisitionStrategy:
         mutations are deferred to ``finish_select``."""
         raise NotImplementedError
 
+    def fused_inputs(self, acq, member_probs=None, *, rand_key=None):
+        """Stage the FUSED variant of this mode's scoring call —
+        score → masked_top_k → reveal-mask-update as one jitted dispatch
+        over the acquirer's device-resident masks
+        (``acq.device_masks()``), the ``*_fused`` keys of
+        ``ops.scoring``.  Return ``None`` (the default) for modes without
+        a fused path: the acquirer then falls back to the two-call
+        ``scoring_inputs`` shape even under ``fuse_step``, so a new
+        registered mode works before it learns to fuse."""
+        return None
+
     def probs_plan(self, committee, store, song_ids, key, *, pad_to,
                    config):
         """Stage this mode's CNN probs PRODUCTION as a batchable device

@@ -29,6 +29,11 @@ class MachineConsensus(AcquisitionStrategy):
         return "mc", (sanitize_member_rows(acq._staged_probs(member_probs)),
                       acq._feed(acq.pool_mask, 0))
 
+    def fused_inputs(self, acq, member_probs=None, *, rand_key=None):
+        return "mc_fused", (
+            sanitize_member_rows(acq._staged_probs(member_probs)),
+            acq.device_masks().pool_mask)
+
     def extract_queries(self, acq, res) -> list:
         return acq._ids(res)
 
@@ -44,6 +49,10 @@ class HumanConsensus(AcquisitionStrategy):
 
     def scoring_inputs(self, acq, member_probs=None, *, rand_key=None):
         return "hc_pre", (acq._hc_ent_dev, acq._feed(acq.hc_mask, 0))
+
+    def fused_inputs(self, acq, member_probs=None, *, rand_key=None):
+        d = acq.device_masks()
+        return "hc_pre_fused", (d.hc_ent, d.hc_mask, d.pool_mask)
 
     def extract_queries(self, acq, res) -> list:
         q_songs = acq._ids(res)
@@ -64,6 +73,12 @@ class MixedConsensus(AcquisitionStrategy):
                        acq._feed(acq.pool_mask, 0),
                        acq._hc_dev,
                        acq._feed(acq.hc_mask, 0))
+
+    def fused_inputs(self, acq, member_probs=None, *, rand_key=None):
+        d = acq.device_masks()
+        return "mix_fused", (
+            sanitize_member_rows(acq._staged_probs(member_probs)),
+            d.pool_mask, d.hc, d.hc_mask)
 
     def extract_queries(self, acq, res) -> list:
         from consensus_entropy_tpu.ops import scoring
@@ -90,6 +105,11 @@ class RandomBaseline(AcquisitionStrategy):
             acq._rand_key, rand_key = jax.random.split(acq._rand_key)
         return "rand", (acq._feed_key(rand_key),
                         acq._feed(acq.pool_mask, 0))
+
+    def fused_inputs(self, acq, member_probs=None, *, rand_key=None):
+        if rand_key is None:
+            acq._rand_key, rand_key = jax.random.split(acq._rand_key)
+        return "rand_fused", (rand_key, acq.device_masks().pool_mask)
 
     def extract_queries(self, acq, res) -> list:
         return acq._ids(res)

@@ -41,5 +41,10 @@ class DropoutCommittee(AcquisitionStrategy):
             sanitize_member_rows(acq._staged_probs(member_probs)),
             acq._feed(acq.pool_mask, 0))
 
+    def fused_inputs(self, acq, member_probs=None, *, rand_key=None):
+        return "qbdc_fused", (
+            sanitize_member_rows(acq._staged_probs(member_probs)),
+            acq.device_masks().pool_mask)
+
     def extract_queries(self, acq, res) -> list:
         return acq._ids(res)

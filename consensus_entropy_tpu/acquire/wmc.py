@@ -33,6 +33,19 @@ class WeightedMachineConsensus(AcquisitionStrategy):
     uses_weights = True
 
     def scoring_inputs(self, acq, member_probs=None, *, rand_key=None):
+        staged, w = self._staged(acq, member_probs)
+        # the weights vector is committee-axis, not pool-axis: replicated
+        # feed under a mesh (the sharded wmc jit expects it replicated)
+        return "wmc", (staged, acq._feed(acq.pool_mask, 0),
+                       acq._feed_repl(jnp.asarray(w)))
+
+    def fused_inputs(self, acq, member_probs=None, *, rand_key=None):
+        staged, w = self._staged(acq, member_probs)
+        return "wmc_fused", (staged, acq.device_masks().pool_mask,
+                             jnp.asarray(w))
+
+    @staticmethod
+    def _staged(acq, member_probs):
         staged = sanitize_member_rows(acq._staged_probs(member_probs))
         m = staged.shape[0]
         w = acq.member_weights
@@ -43,10 +56,7 @@ class WeightedMachineConsensus(AcquisitionStrategy):
             raise ValueError(
                 f"member_weights shape {w.shape} does not match the "
                 f"{m}-member probs axis")
-        # the weights vector is committee-axis, not pool-axis: replicated
-        # feed under a mesh (the sharded wmc jit expects it replicated)
-        return "wmc", (staged, acq._feed(acq.pool_mask, 0),
-                       acq._feed_repl(jnp.asarray(w)))
+        return staged, w
 
     def extract_queries(self, acq, res) -> list:
         return acq._ids(res)

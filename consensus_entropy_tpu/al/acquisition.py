@@ -16,6 +16,7 @@ mapping, reliability weights) the strategies operate on.
 
 from __future__ import annotations
 
+import dataclasses
 import math
 
 import jax
@@ -55,6 +56,53 @@ _row_entropy = jax.jit(shannon_entropy)
 _sanitize_member_rows = sanitize_member_rows
 
 
+@dataclasses.dataclass
+class DevicePoolState:
+    """Per-user DEVICE-RESIDENT pool state — the fused serve step's
+    tentpole.  Everything an AL iteration's scoring dispatch reads lives
+    here across iterations, so per-iteration host↔device traffic shrinks
+    to the probs delta in and 2·k selection scalars out:
+
+    - ``hc`` / ``hc_ent``: the human-consensus frequency table and its
+      hoisted row entropies — loop-invariant, committed once at acquirer
+      construction (hc/mix modes only).
+    - ``probs``: the persistent ``(M, n_pad, C)`` member-probs buffer the
+      per-iteration scatter updates in place (donated
+      ``_scatter_rows``); rows of revealed songs keep stale values behind
+      the pool mask.
+    - ``pool_mask`` / ``hc_mask``: device twins of the acquirer's host
+      mirrors.  Uploaded ONCE — at admission, or at the pinned pad after
+      an eviction/resume or serve-journal restart rebuilt the host
+      mirrors from ``ALState`` (``Acquirer.device_masks`` builds them
+      lazily from the post-``replay`` mirrors) — then updated strictly
+      in-graph: each fused dispatch returns the post-select masks
+      (``ops.scoring.FusedStepResult``) and ``finish_select`` adopts the
+      buffers without pulling them.
+    - ``n_revealed``: size of the revealed-index set the in-graph updates
+      have accumulated (host-side bookkeeping/telemetry mirror).
+
+    The host-side numpy masks stay authoritative for crash-safety: they
+    feed ``ALState`` checkpoints and every rebuild path, so a lost device
+    (or an abandoned zombie dispatch that consumed a donated buffer) is
+    recovered by re-uploading the mirrors — never by trusting device
+    state that may have died with the dispatch.
+    """
+
+    hc: object | None = None
+    hc_ent: object | None = None
+    probs: object | None = None
+    pool_mask: object | None = None
+    hc_mask: object | None = None
+    n_revealed: int = 0
+    #: host→device traffic staged since the last scheduler read
+    #: (``Acquirer.take_h2d``): the probs uploads happen here at staging
+    #: time, not in the dispatch's own operands, so the dispatch grader
+    #: collects them through these counters.  ``h2d_ops`` counts discrete
+    #: uploads — each is its own transfer dispatch on a real accelerator.
+    h2d_bytes: int = 0
+    h2d_ops: int = 0
+
+
 class Acquirer:
     """Per-user acquisition state over a fixed padded pool.
 
@@ -72,8 +120,19 @@ class Acquirer:
 
     def __init__(self, train_songs, hc_rows: np.ndarray | None, *, queries: int,
                  mode: str, tie_break: str = "fast", pad_multiple: int = 8,
-                 seed: int = 0, mesh=None, pad_to: int | None = None):
+                 seed: int = 0, mesh=None, pad_to: int | None = None,
+                 fuse_step: bool = True):
         self.mode = mode
+        #: fused serve step: stage the ``*_fused`` scorers — ONE jitted
+        #: call running score → masked_top_k → reveal-mask-update over the
+        #: device-resident :class:`DevicePoolState`, returning only the
+        #: selection to host.  Selections and trajectories are
+        #: bit-identical to the two-call arm (pinned by
+        #: ``tests/test_fused_step.py``); ``False`` (``--no-fuse-step``)
+        #: keeps the host-round-trip path — the breaker/fallback arm.
+        #: Mesh committees keep the unfused path: the sharded fns carry
+        #: per-operand placements the donated twins don't model.
+        self.fuse_step = fuse_step and mesh is None
         #: the registered strategy this acquirer delegates mode behavior to
         self.strategy = acquire.get(mode)
         #: per-member reliability weights ((M,) float32, committee order of
@@ -115,27 +174,37 @@ class Acquirer:
             self._fns = make_sharded_scoring_fns(mesh, k=queries,
                                                  tie_break=tie_break)
         self._rand_key = jax.random.key(seed)
+        #: the device-resident pool state (masks adopted from each fused
+        #: step's in-graph update; probs scatter buffer; hc tables)
+        self.device = DevicePoolState()
         # The hc table never changes across iterations (only its mask
         # shrinks): commit it to the device ONCE; per-iteration uploads are
         # then just the tiny bool masks.  (Round-1..2 re-uploaded the
         # (N, C) table every select — the last static input in the loop.)
         if self.strategy.uses_hc_table:
-            self._hc_dev = self._feed(self.hc, 0) if mesh is not None \
+            self.device.hc = self._feed(self.hc, 0) if mesh is not None \
                 else jax.device_put(self.hc)
-        else:
-            self._hc_dev = None
         # hc mode: the table rows never change, so their entropies are
         # loop-invariant — compute them ONCE here and make every select a
         # pure masked top-k (score_hc_precomputed).  The reference
         # recomputes scipy entropy over the same rows every iteration
         # (amg_test.py:449-455); selections are identical.  Padding rows
         # (all-zero) come out -0.0 and sit behind the mask.
-        self._hc_ent_dev = _row_entropy(self._hc_dev) \
-            if self.strategy.uses_hc_entropy else None
-        #: persistent (M, n_pad, C) device buffer for member probs —
-        #: live rows are scattered in-place each iteration (see
-        #: :meth:`_staged_probs`); stale rows stay behind the pool mask
-        self._probs_buf = None
+        if self.strategy.uses_hc_entropy:
+            self.device.hc_ent = _row_entropy(self.device.hc)
+
+    # legacy spellings of the device-resident members (pre-DevicePoolState)
+    @property
+    def _hc_dev(self):
+        return self.device.hc
+
+    @property
+    def _hc_ent_dev(self):
+        return self.device.hc_ent
+
+    @property
+    def _probs_buf(self):
+        return self.device.probs
 
     def _feed(self, arr, axis: int):
         """Upload one scoring input with its pool sharding.
@@ -231,16 +300,35 @@ class Acquirer:
 
         Multi-host mesh path: the committee already merges its blocks on
         host (per-process feeding); keep the host pad + per-host feed.
+
+        Fused arm: HOST probs ride the scatter path too — upload only the
+        ``(M, W_live, C)`` live block (host-padded to the fixed
+        :meth:`staging_width`, so the scatter still compiles per 256-bucket)
+        instead of the full ``(M, n_pad, C)`` padded table.  With the masks
+        device-resident, that live block is the iteration's ONLY
+        bulk host→device transfer.
         """
         if self._mesh is not None:
             return self._feed(self.pad_probs(member_probs), 1)
         if isinstance(member_probs, np.ndarray):
-            return jnp.asarray(self.pad_probs(member_probs))
+            if not self.fuse_step:
+                padded = self.pad_probs(member_probs)
+                self.device.h2d_bytes += padded.nbytes
+                self.device.h2d_ops += 1
+                return jnp.asarray(padded)
+            w = self.staging_width(member_probs.shape[1])
+            member_probs = np.asarray(member_probs, np.float32)
+            if member_probs.shape[1] < w:  # host pad: fixed upload shape
+                member_probs = np.pad(
+                    member_probs,
+                    ((0, 0), (0, w - member_probs.shape[1]), (0, 0)))
+            self.device.h2d_bytes += member_probs.nbytes
+            self.device.h2d_ops += 1
         member_probs = jnp.asarray(member_probs)
         m = member_probs.shape[0]
-        if self._probs_buf is None or self._probs_buf.shape[0] != m:
-            self._probs_buf = jnp.zeros((m, self.n_pad, NUM_CLASSES),
-                                        jnp.float32)
+        if self.device.probs is None or self.device.probs.shape[0] != m:
+            self.device.probs = jnp.zeros((m, self.n_pad, NUM_CLASSES),
+                                          jnp.float32)
         live = np.flatnonzero(self.pool_mask)
         w = member_probs.shape[1]
         if w != len(live):
@@ -249,10 +337,43 @@ class Acquirer:
                     f"member_probs width {w} < {len(live)} live songs")
             live = np.concatenate(  # OOB slots → scatter mode='drop'
                 [live, np.full(w - len(live), self.n_pad, live.dtype)])
-        self._probs_buf = _scatter_rows(
-            self._probs_buf, jnp.asarray(live),
+        self.device.probs = _scatter_rows(
+            self.device.probs, jnp.asarray(live),
             member_probs.astype(jnp.float32))
-        return self._probs_buf
+        return self.device.probs
+
+    def take_h2d(self) -> tuple:
+        """Drain the ``(bytes, ops)`` staged onto the device since the
+        last read (the probs-table uploads of :meth:`_staged_probs`) —
+        the scheduler folds them into its per-dispatch transfer grading,
+        so ``fleet_metrics.jsonl`` pins the traffic the fused step
+        removes wherever the upload physically happened."""
+        out = (self.device.h2d_bytes, self.device.h2d_ops)
+        self.device.h2d_bytes = self.device.h2d_ops = 0
+        return out
+
+    def device_masks(self) -> DevicePoolState:
+        """The device twins of the pool/hc masks for the fused arm —
+        built LAZILY from the host mirrors on first use, which is what
+        makes every rebuild path correct for free: admission uploads the
+        fresh masks, and an eviction/resume or serve-journal restart
+        constructs its Acquirer, replays ``ALState.queried`` into the
+        host mirrors, and only THEN stages its first fused call — so the
+        twins materialize post-replay at the pinned pad, bit-identical to
+        the masks an uninterrupted run would hold."""
+        d = self.device
+        if d.pool_mask is None:
+            # the one-time mask upload is charged to the transfer
+            # counters like any other host→device feed — the fused arm's
+            # h2d accounting must not hide its own (re)admission cost
+            d.pool_mask = jnp.asarray(self.pool_mask)
+            d.h2d_bytes += self.pool_mask.nbytes
+            d.h2d_ops += 1
+            if self.strategy.uses_hc_table:
+                d.hc_mask = jnp.asarray(self.hc_mask)
+                d.h2d_bytes += self.hc_mask.nbytes
+                d.h2d_ops += 1
+        return d
 
     # -- the registered modes ----------------------------------------------
 
@@ -272,8 +393,20 @@ class Acquirer:
         (``consensus_entropy_tpu.acquire``).  Mask updates are deferred to
         :meth:`finish_select`; the staged inputs reference the acquirer's
         live mask arrays, so callers must score before finishing (the jit
-        call copies on transfer).
+        call copies on transfer — and the fused arm's dispatch CONSUMES
+        the donated device twins, which :meth:`finish_select` replaces
+        with the returned post-select buffers).
+
+        Fused arm (``fuse_step``): the strategy stages its ``*_fused``
+        scorer over the device-resident masks instead — one jitted call
+        per iteration running score → top-k → reveal-mask-update, with
+        only the k-row selection returning to host.
         """
+        if self.fuse_step:
+            staged = self.strategy.fused_inputs(self, member_probs,
+                                                rand_key=rand_key)
+            if staged is not None:
+                return staged
         return self.strategy.scoring_inputs(self, member_probs,
                                             rand_key=rand_key)
 
@@ -286,10 +419,24 @@ class Acquirer:
     def finish_select(self, res: scoring.ScoreResult) -> list:
         """Map a scoring result back to song ids (strategy-specific, incl.
         hc row removal / mix dedup) and apply the reference's common pool
-        shrink (amg_test.py:520-523)."""
+        shrink (amg_test.py:520-523).
+
+        Fused arm: ``res`` is a :class:`~consensus_entropy_tpu.ops.scoring.
+        FusedStepResult` whose mask buffers already carry the in-graph
+        reveal update — ADOPT them (the donated pre-select twins are
+        spent), then mirror the same flips into the host numpy masks from
+        the returned indices.  The mirrors stay authoritative for
+        ``remaining_songs``, ``ALState`` checkpoints and every rebuild
+        path; the device twins never round-trip to keep them so."""
+        if isinstance(res, scoring.FusedStepResult):
+            d = self.device
+            d.pool_mask = res.pool_mask
+            if res.hc_mask is not None:
+                d.hc_mask = res.hc_mask
         q_songs = self.strategy.extract_queries(self, res)
         for s in q_songs:
             self.pool_mask[self._song_row[s]] = False
+        self.device.n_revealed += len(q_songs)
         return q_songs
 
     def select(self, member_probs=None, *, rand_key=None) -> list:

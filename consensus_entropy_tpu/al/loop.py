@@ -177,12 +177,16 @@ class ALLoop:
 
     def __init__(self, config: ALConfig, *, tie_break: str = "fast",
                  retrain_epochs: int | None = None, mesh=None,
-                 pad_pool_to: int | None = None):
+                 pad_pool_to: int | None = None, fuse_step: bool = True):
         self.config = config
         self.tie_break = tie_break
         self.retrain_epochs = retrain_epochs
         self.mesh = mesh
         self.pad_pool_to = pad_pool_to
+        #: fused serve step (see ``Acquirer.fuse_step``): the sequential
+        #: driver fuses too — same selections, one dispatch per select;
+        #: ``False`` is the host-round-trip fallback arm
+        self.fuse_step = fuse_step
 
     def run_user(self, committee: Committee, data: UserData, user_path: str,
                  *, seed: int | None = None, resume: bool = True,
@@ -206,5 +210,5 @@ class ALLoop:
             self.config, committee, data, user_path, seed=seed,
             tie_break=self.tie_break, retrain_epochs=self.retrain_epochs,
             mesh=self.mesh, pad_pool_to=self.pad_pool_to, resume=resume,
-            timer=timer, preemption=preemption)
+            timer=timer, preemption=preemption, fuse_step=self.fuse_step)
         return drive_inline(session)

@@ -78,6 +78,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "set per plan kind and pipelines chunk dispatches "
                         "against the cohort's remaining host steps "
                         "(default: whole-group)")
+    p.add_argument("--no-fuse-step", action="store_true",
+                   help="disable the fused serve step: score the pool, "
+                        "pull the result and do select/reveal/mask "
+                        "bookkeeping on host each iteration (the "
+                        "pre-fusion shape) instead of keeping per-user "
+                        "pool state device-resident and running "
+                        "score->top-k->reveal-mask-update as one jitted "
+                        "dispatch per bucket; per-user results are "
+                        "identical either way (debug/baseline arm)")
     p.add_argument("--no-stack-cnn", action="store_true",
                    help="fleet/serve mode: disable cross-user stacking of "
                         "the CNN device path (stacked probs forward, "
@@ -427,7 +436,8 @@ def main(argv=None) -> int:
 
     loop = ALLoop(cfg, tie_break=args.tie_break,
                   retrain_epochs=args.retrain_epochs, mesh=mesh,
-                  pad_pool_to=args.pad_pool_to)
+                  pad_pool_to=args.pad_pool_to,
+                  fuse_step=not args.no_fuse_step)
     # Multi-host discipline (no-ops single-process): the coordinator owns
     # every workspace write; skip decisions are broadcast so control flow
     # stays in lockstep (divergence would deadlock the next collective).
@@ -484,7 +494,8 @@ def _run_users_fleet(args, cfg, paths, users, pool, anno, hc_table, store,
         cfg, tie_break=args.tie_break, retrain_epochs=args.retrain_epochs,
         host_workers=args.fleet_host_workers, preemption=guard,
         pad_pool_to=args.pad_pool_to, report=report,
-        stack_cnn=not args.no_stack_cnn, plan_chunk=args.plan_chunk)
+        stack_cnn=not args.no_stack_cnn, plan_chunk=args.plan_chunk,
+        fuse_step=not args.no_fuse_step)
     todo = list(users[: args.max_users])
     n_cohorts = 0
     failed = []
@@ -591,7 +602,7 @@ def _run_users_serve(args, cfg, paths, users, pool, anno, hc_table, store,
         cfg, tie_break=args.tie_break, retrain_epochs=args.retrain_epochs,
         host_workers=args.fleet_host_workers, report=report,
         scoring_by_width=True, stack_cnn=not args.no_stack_cnn,
-        plan_chunk=args.plan_chunk)
+        plan_chunk=args.plan_chunk, fuse_step=not args.no_fuse_step)
     server = FleetServer(
         scheduler,
         ServeConfig(target_live=args.serve,
@@ -827,7 +838,7 @@ def _run_users_fabric_worker(args, cfg, paths, users, pool, anno,
         cfg, tie_break=args.tie_break, retrain_epochs=args.retrain_epochs,
         host_workers=args.fleet_host_workers, report=report,
         scoring_by_width=True, stack_cnn=not args.no_stack_cnn,
-        plan_chunk=args.plan_chunk)
+        plan_chunk=args.plan_chunk, fuse_step=not args.no_fuse_step)
 
     def build_entry(uid):
         u_id = by_id.get(uid, uid)

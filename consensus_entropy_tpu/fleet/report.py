@@ -77,14 +77,24 @@ class FleetReport:
                     f.write(json.dumps(rec) + "\n")
 
     def dispatch(self, fn_key: str, batch: int, active: int,
-                 wall_s: float, width: int | None = None) -> None:
+                 wall_s: float, width: int | None = None,
+                 h2d_bytes: int | None = None,
+                 h2d_ops: int | None = None) -> None:
         """One device scoring dispatch: ``batch`` sessions scored together
         out of ``active`` live slots (cohort-wide, or this bucket's when
-        ``width`` identifies a bucketed dispatch)."""
+        ``width`` identifies a bucketed dispatch).  ``h2d_bytes`` /
+        ``h2d_ops``: bytes and discrete uploads this dispatch staged from
+        host memory (the fused serve step's target metrics —
+        device-resident inputs upload nothing; every host operand is its
+        own transfer dispatch on a real accelerator)."""
         rec = {"fn": fn_key, "batch": batch, "active": active,
                "wall_s": wall_s}
         if width is not None:
             rec["width"] = width
+        if h2d_bytes is not None:
+            rec["h2d_bytes"] = h2d_bytes
+        if h2d_ops is not None:
+            rec["h2d_ops"] = h2d_ops
         self.dispatches.append(rec)
 
     def event(self, kind: str, **fields) -> None:
@@ -172,6 +182,47 @@ class FleetReport:
         return out
 
     @property
+    def transfer_summary(self) -> dict | None:
+        """Host↔device traffic roll-up of the run's dispatches — the
+        overhead the fused serve step removes, pinned here (and in every
+        BENCH artifact via :func:`bench_line`) the way parity is, because
+        bytes-per-iteration and dispatches-per-iteration are
+        capacity-INDEPENDENT on a throttled CI box whose users/sec drifts
+        ~2x run to run.
+
+        - ``h2d_bytes`` / ``h2d_bytes_per_select``: host-memory bytes
+          uploaded by device dispatches, total and per session-iteration
+          (fused runs upload only each iteration's probs delta; unfused
+          runs re-ship probs tables and masks every select).
+        - ``h2d_ops`` / ``h2d_ops_per_select``: discrete host→device
+          uploads — each is its own transfer dispatch on a real
+          accelerator.
+        - ``selects``: session-iterations serviced (sum of reduction
+          dispatch batches); ``device_calls_per_select``: device
+          dispatches per session-iteration — jit executions (the
+          reduction dispatch, amortized by stacking) PLUS the transfer
+          ops, the figure the fused step shrinks.
+
+        ``None`` when no dispatch carried transfer accounting (records
+        replayed from pre-metric artifacts), so old summaries stay
+        byte-stable."""
+        graded = [d for d in self.dispatches if "h2d_bytes" in d]
+        if not graded:
+            return None
+        red = [d for d in self.dispatches
+               if d["fn"] not in CNN_DISPATCH_FNS]
+        selects = sum(d["batch"] for d in red)
+        h2d = sum(d.get("h2d_bytes") or 0 for d in self.dispatches)
+        ops = sum(d.get("h2d_ops") or 0 for d in self.dispatches)
+        out = {"h2d_bytes": h2d, "h2d_ops": ops, "selects": selects}
+        if selects:
+            out["h2d_bytes_per_select"] = round(h2d / selects)
+            out["h2d_ops_per_select"] = round(ops / selects, 3)
+            out["device_calls_per_select"] = round(
+                (len(red) + ops) / selects, 3)
+        return out
+
+    @property
     def cnn_dispatch_summary(self) -> dict | None:
         """Roll-up of the CNN device-plan dispatches (:data:`CNN_DISPATCH_FNS`)
         — per fn: dispatch count, mean users per dispatch, occupancy
@@ -246,6 +297,9 @@ class FleetReport:
         cnn = self.cnn_dispatch_summary
         if cnn is not None:
             out["cnn"] = cnn
+        transfer = self.transfer_summary
+        if transfer is not None:
+            out["transfer"] = transfer
         if self.admission_wait.n:
             out["admissions"] = self.admission_wait.n
             out["admission_wait_s"] = self.admission_wait.snapshot()
@@ -280,6 +334,8 @@ def bench_line(summary: dict, *, baseline_users_per_sec: float | None = None,
         line["per_bucket"] = summary["per_bucket"]
     if summary.get("cnn") is not None:
         line["cnn"] = summary["cnn"]
+    if summary.get("transfer") is not None:
+        line["transfer"] = summary["transfer"]
     for key in ("watchdog_evictions", "breaker_trips", "dispatch_failures",
                 "requeues", "users_poisoned"):
         if summary.get(key):

@@ -61,6 +61,7 @@ import time
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Callable
 
+import jax
 import jax.numpy as jnp
 
 from consensus_entropy_tpu.config import ALConfig
@@ -133,7 +134,8 @@ class FleetScheduler:
                  batch_window_s: float = 0.0,
                  scoring_by_width: bool = False,
                  watchdog=None, breaker=None, on_terminal=None,
-                 stack_cnn: bool = True, plan_chunk: int | None = None):
+                 stack_cnn: bool = True, plan_chunk: int | None = None,
+                 fuse_step: bool = True):
         self.config = config
         self.tie_break = tie_break
         self.retrain_epochs = retrain_epochs
@@ -162,6 +164,18 @@ class FleetScheduler:
         #: whole-session offload gating — the baseline arm
         #: ``bench.py --suite cnn-fleet`` races against.
         self.stack_cnn = stack_cnn
+        #: fused serve step (the hot-path tentpole): sessions stage the
+        #: ``*_fused`` reduction scorers — per-user pool masks stay
+        #: device-resident across AL iterations, the select→reveal→mask
+        #: tail runs inside the scoring dispatch (stacked per bucket, the
+        #: stacked mask buffers donated), and only each user's k-row
+        #: selection returns to host.  ``False`` (``--no-fuse-step``)
+        #: keeps the host-round-trip arm — per-user rows, reveal
+        #: trajectories and reports are bit-identical either way (pinned
+        #: by ``tests/test_fused_step.py``), so it doubles as the
+        #: baseline arm ``bench.py --suite serve-fused`` measures
+        #: against.
+        self.fuse_step = fuse_step
         #: device-plan dispatch quantum.  ``None`` (accelerator default)
         #: services each plan group whole — biggest stacked dispatch, but
         #: the cohort then LOCKSTEPS: by the time the group is full no
@@ -355,7 +369,8 @@ class FleetScheduler:
             retrain_epochs=self.retrain_epochs,
             pad_pool_to=pad, timer=timer,
             preemption=self.preemption, ckpt_executor=self._ckpt_pool,
-            pin_pad=pin_pad, cnn_steps=self.stack_cnn)
+            pin_pad=pin_pad, cnn_steps=self.stack_cnn,
+            fuse_step=self.fuse_step)
         st = _SessionState(entry, session, session.steps(), pad=pad,
                            n_pad=session.acq.n_pad)
         return st
@@ -512,6 +527,19 @@ class FleetScheduler:
             return ops_scoring.stack_user_keys(vals)
         return jnp.stack([jnp.asarray(v) for v in vals])
 
+    @staticmethod
+    def _h2d(vals) -> tuple:
+        """``(bytes, ops)`` of host→device transfer a dispatch over
+        ``vals`` performs: inputs still living in host memory (numpy)
+        upload — each its own transfer dispatch on a real accelerator —
+        while committed jax arrays (the fused arm's device-resident
+        masks/probs) cost nothing.  The per-dispatch numbers the fused
+        serve step exists to shrink — recorded on every dispatch so the
+        reduction is pinned like parity is, independent of this box's
+        wall-clock drift."""
+        host = [v for v in vals if not isinstance(v, jax.Array)]
+        return (sum(getattr(v, "nbytes", 0) for v in host), len(host))
+
     def _group_fns(self, width: int) -> dict:
         """The vmapped scorer family for one dispatch group: the shared
         fleet fns, or the per-bucket width-guarded family when the driver
@@ -573,7 +601,15 @@ class FleetScheduler:
         results.  ``InjectedKill``/``Preempted`` stay ``BaseException``
         and still stop the fleet.  CNN plan dispatches share the
         per-width breaker with the reduction scorers: a degraded bucket
-        is degraded for its whole device path."""
+        is degraded for its whole device path.
+
+        Pipelining: stacked REDUCTION dispatches are staged and LAUNCHED
+        for every bucket first and their rows distributed only after the
+        last launch — device dispatch is asynchronous, so bucket i+1's
+        stacking (the remaining host→device uploads) overlaps bucket i's
+        device execution instead of serializing behind its result.  Plan
+        (DeviceStep) groups keep their inline order: their commit half
+        must run on this thread between dispatch and distribution."""
         groups = collections.defaultdict(list)
         for st, step in steps:
             if isinstance(step, DeviceStep):
@@ -593,6 +629,22 @@ class FleetScheduler:
             else:
                 rounds.append(group)
         out = []
+        single = []   # (group, width, fn_key): per-user dispatch rounds
+        pending = []  # launched stacked reduction dispatches, in flight
+
+        def grade(fn_key, batch, width, wall, h2d=None):
+            # width tags only BUCKETED dispatches: a plain fleet cohort
+            # is one width by construction and its summaries/BENCH
+            # artifacts must not grow a per-bucket section
+            h2d_bytes, h2d_ops = h2d if h2d is not None else (None, None)
+            self.report.dispatch(
+                fn_key, batch,
+                self._active_in_bucket(width)
+                if self.scoring_by_width else n_live,
+                wall,
+                width=width if self.scoring_by_width else None,
+                h2d_bytes=h2d_bytes, h2d_ops=h2d_ops)
+
         for group in rounds:
             width = group[0][0].n_pad
             step0 = group[0][1]
@@ -604,33 +656,45 @@ class FleetScheduler:
                 if use_stacked and self.breaker.state_of(width) \
                         == "half_open":
                     self.report.event("breaker_probe", width=width)
-            if use_stacked:
-                t0 = time.perf_counter()
+            if not use_stacked:
+                single.append((group, width, fn_key))
+                continue
+            t0 = time.perf_counter()
+            if isinstance(step0, DeviceStep):
                 try:
-                    served = (self._plan_call(fn_key, width, group)
-                              if isinstance(step0, DeviceStep)
-                              else self._stacked_call(fn_key, width, group))
+                    served = self._plan_call(fn_key, width, group)
                 except Exception as exc:
                     self._note_stacked_failure(fn_key, width, exc)
+                    single.append((group, width, fn_key))
                 else:
                     out.extend(served)
                     if self.breaker is not None \
                             and self.breaker.record_success(width) \
                             == "close":
                         self.report.event("breaker_close", width=width)
-                    # width tags only BUCKETED dispatches: a plain fleet
-                    # cohort is one width by construction and its
-                    # summaries/BENCH artifacts must not grow a
-                    # per-bucket section
-                    self.report.dispatch(
-                        fn_key, len(group),
-                        self._active_in_bucket(width)
-                        if self.scoring_by_width else n_live,
-                        time.perf_counter() - t0,
-                        width=width if self.scoring_by_width else None)
-                    continue
-            # per-user dispatch: singletons, open-breaker (degraded)
-            # buckets, and the stacked-failure fallback
+                    grade(fn_key, len(group), width,
+                          time.perf_counter() - t0)
+                continue
+            try:
+                batched, h2d = self._stacked_call(fn_key, width, group)
+            except Exception as exc:
+                self._note_stacked_failure(fn_key, width, exc)
+                single.append((group, width, fn_key))
+            else:
+                # wall measured NOW, at launch: grading happens after the
+                # remaining buckets stack/launch, which must not be
+                # charged to this dispatch
+                pending.append((group, width, fn_key,
+                                time.perf_counter() - t0, batched, h2d))
+        for group, width, fn_key, wall, batched, h2d in pending:
+            if self.breaker is not None \
+                    and self.breaker.record_success(width) == "close":
+                self.report.event("breaker_close", width=width)
+            grade(fn_key, len(group), width, wall, h2d)
+            out.extend(self._result_rows(batched, group))
+        # per-user dispatch: singletons, open-breaker (degraded)
+        # buckets, and the stacked-failure fallback
+        for group, width, fn_key in single:
             for st, step in group:
                 t0 = time.perf_counter()
                 try:
@@ -645,20 +709,39 @@ class FleetScheduler:
                     self._ready.append((st, None, exc))
                     continue
                 out.append((st, res))
-                self.report.dispatch(
-                    fn_key, 1,
-                    self._active_in_bucket(width)
-                    if self.scoring_by_width else n_live,
-                    time.perf_counter() - t0,
-                    width=width if self.scoring_by_width else None)
+                wall = time.perf_counter() - t0
+                if isinstance(step, DeviceStep):
+                    grade(fn_key, 1, width, wall)
+                else:
+                    b1, o1 = self._h2d(step.inputs)
+                    b2, o2 = step.session.acq.take_h2d()
+                    grade(fn_key, 1, width, wall, (b1 + b2, o1 + o2))
         return out
 
     def _stacked_call(self, fn_key: str, width: int, group: list):
-        """One vmapped dispatch for a multi-session group, bounded by the
+        """Stage and LAUNCH one vmapped dispatch for a multi-session
+        group; returns ``(batched_result, h2d_bytes)`` without consuming
+        any result row (device dispatch is async — the caller distributes
+        rows only after every bucket's dispatch is in flight, so the next
+        bucket's stacking overlaps this one's execution).  Bounded by the
         watchdog when one is installed.  The ``serve.dispatch`` fault
         point fires inside the (possibly watchdog-threaded) call so
         injected kills/delays land exactly where a real device fault
-        would."""
+        would.
+
+        Fused arm: the per-user inputs are device-resident (masks, probs
+        buffer), so the stack is a device-side gather — ``h2d_bytes``
+        counts only the values still uploading from host memory — and the
+        jitted fused fns DONATE the stacked mask operands
+        (``ops.scoring.FUSED_DONATE``), updating the bucket's pool state
+        in place."""
+        h2d = (0, 0)
+        drained = []
+        for _, step in group:
+            b1, o1 = self._h2d(step.inputs)
+            b2, o2 = step.session.acq.take_h2d()
+            drained.append((step.session.acq, b2, o2))
+            h2d = (h2d[0] + b1 + b2, h2d[1] + o1 + o2)
         stacked = [self._stack([step.inputs[pos] for _, step in group])
                    for pos in range(len(group[0][1].inputs))]
 
@@ -667,12 +750,28 @@ class FleetScheduler:
                         batch=len(group))
             return self._group_fns(width)[fn_key](*stacked)
 
-        batched = (self.watchdog.call(dispatch,
-                                      f"dispatch {fn_key}@{width}")
-                   if self.watchdog is not None else dispatch())
-        return [(st, ops_scoring.ScoreResult(
-            batched.entropy[i], batched.values[i], batched.indices[i]))
-            for i, (st, _) in enumerate(group)]
+        try:
+            batched = (self.watchdog.call(dispatch,
+                                          f"dispatch {fn_key}@{width}")
+                       if self.watchdog is not None else dispatch())
+        except BaseException:
+            # the uploads happened regardless — put the drained counters
+            # back so the per-user fallback's grading still reports them
+            for acq, b2, o2 in drained:
+                acq.device.h2d_bytes += b2
+                acq.device.h2d_ops += o2
+            raise
+        return batched, h2d
+
+    @staticmethod
+    def _result_rows(batched, group):
+        """Slice a batched dispatch result into per-session rows of the
+        same result type — lazy device slices, nothing is pulled here.
+        Works for ``ScoreResult`` and the fused ``FusedStepResult``
+        (whose ``hc_mask`` field may be None for non-hc modes)."""
+        cls = type(batched)
+        return [(st, cls(*(None if x is None else x[i] for x in batched)))
+                for i, (st, _) in enumerate(group)]
 
     def _plan_call(self, fn_key: str, width: int, group: list):
         """One stacked CNN device dispatch (probs production or cohort

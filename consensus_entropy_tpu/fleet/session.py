@@ -152,7 +152,7 @@ class UserSession:
                  pad_pool_to: int | None = None, resume: bool = True,
                  timer: StepTimer | None = None, preemption=None,
                  ckpt_executor=None, pin_pad: int | None = None,
-                 cnn_steps: bool = True):
+                 cnn_steps: bool = True, fuse_step: bool = True):
         from consensus_entropy_tpu.al.loop import AsyncCheckpointer
 
         cfg = config
@@ -221,7 +221,7 @@ class UserSession:
         self.acq = Acquirer(self.split.train_songs, hc_rows,
                             queries=cfg.queries, mode=cfg.mode,
                             tie_break=tie_break, seed=self.seed, mesh=mesh,
-                            pad_to=pad_pool_to)
+                            pad_to=pad_pool_to, fuse_step=fuse_step)
         if pin_pad is not None and self.acq.n_pad != pin_pad:
             # A user's padded pool width is part of its run identity: the
             # scheduler pins it at first admission, and a resumed session
@@ -256,7 +256,23 @@ class UserSession:
         #: pool — only genuinely-device steps stay off it.  (The deferred
         #: checkpoint commit already ran device_get on a worker thread for
         #: every committee, so thread-side jax fetches are precedented.)
-        self.sklearn_offloadable = self.host_offloadable or self.cnn_steps
+        #: Gated on the committee ACTUALLY having host members: a
+        #: pure-CNN committee (qbdc cohorts, CNN-only mc) has nothing for
+        #: the pool to overlap — its "host" blocks are small eval
+        #: remainders and select staging — so offloading them only paid
+        #: ~100 thread handoffs per 6-user run (a measured ~5-10% on
+        #: pure-CNN qbdc cohorts; ROADMAP follow-on (d)).  DeviceStep
+        #: staging/stacking is unaffected by this gate.
+        self.sklearn_offloadable = (self.host_offloadable
+                                    or (self.cnn_steps
+                                        and bool(committee.host_members)))
+        #: checkpoint BOUNDARIES keep the wider gate: they are a
+        #: different cost class from the compute remainders above — a
+        #: blocking join on the previous async commit plus staging I/O —
+        #: and inlining them would let one session's slow disk stall the
+        #: scheduler thread (and with it every other session), host
+        #: members or not
+        self.boundary_offloadable = self.host_offloadable or self.cnn_steps
 
     @staticmethod
     def _rebuild_split(data, st: al_state.ALState):
@@ -531,10 +547,12 @@ class UserSession:
                 # staging + pickle writes) is pure host work: offloading it
                 # keeps a slow join/commit from stalling the scheduler's
                 # main thread — and with it every other session.  Gated on
-                # the per-STEP flag: CNN sessions' boundaries are just as
-                # jax-free as host-only ones (the deferred device_get
-                # already runs on the checkpointer thread)
-                if self.sklearn_offloadable:
+                # the boundary flag (NOT the host-member-gated sklearn
+                # one): CNN sessions' boundaries are just as jax-free as
+                # host-only ones (the deferred device_get already runs on
+                # the checkpointer thread), and checkpoint I/O benefits
+                # from the pool even when no sklearn member does
+                if self.boundary_offloadable:
                     yield HostStep(self, boundary0, "checkpoint")
                 else:
                     boundary0()
@@ -677,8 +695,15 @@ class UserSession:
                             weight_fixup()
                             return acq.scoring_inputs(mp,
                                                       rand_key=sub), mp
-                        (fn_key, inputs), member_probs = yield HostStep(
-                            self, stage_select, "select")
+                        # pure-CNN committees run the staging inline: with
+                        # no sklearn predicts in the merge there is
+                        # nothing for the pool to overlap, only a thread
+                        # handoff to pay (ROADMAP follow-on (d))
+                        if self.sklearn_offloadable:
+                            (fn_key, inputs), member_probs = yield HostStep(
+                                self, stage_select, "select")
+                        else:
+                            (fn_key, inputs), member_probs = stage_select()
                     else:
                         fn_key, inputs = acq.scoring_inputs(member_probs,
                                                             rand_key=sub)
@@ -788,14 +813,20 @@ class UserSession:
                         # (sklearn predicts + report math): pooled, like
                         # the baseline above, so one user's eval overlaps
                         # peers' stacked dispatches instead of stalling
-                        # the scheduler thread
+                        # the scheduler thread.  Pure-CNN committees run
+                        # it inline — the CNN forward already rode the
+                        # stacked CNNEvalPlan dispatch, leaving only
+                        # report math too small to buy its thread handoff
                         with timer.phase("evaluate"):
                             f1s = self._evaluate(report, sub,
                                                  cnn_probs=block)
                         return finish_epoch(f1s)
 
-                    last_host_f1s = yield HostStep(self, eval_epoch,
-                                                   "evaluate")
+                    if self.sklearn_offloadable:
+                        last_host_f1s = yield HostStep(self, eval_epoch,
+                                                       "evaluate")
+                    else:
+                        last_host_f1s = eval_epoch()
                 else:
                     def update_and_eval(epoch=epoch, q_songs=q_songs):
                         # the pre-split monolith: same statements as the
@@ -844,7 +875,7 @@ class UserSession:
                     timer.flush(user=str(data.user_id), epoch=epoch,
                                 queried=len(q_songs), **labels)
 
-                if self.sklearn_offloadable:  # see boundary0 above
+                if self.boundary_offloadable:  # see boundary0 above
                     yield HostStep(self, boundary, "checkpoint")
                 else:
                     boundary()

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from consensus_entropy_tpu.ops.entropy import masked_entropy
-from consensus_entropy_tpu.ops.topk import masked_top_k
+from consensus_entropy_tpu.ops.topk import masked_top_k, reveal_mask_update
 
 
 class ScoreResult(NamedTuple):
@@ -191,6 +191,106 @@ def score_rand(key, pool_mask, *, k: int) -> ScoreResult:
     return ScoreResult(scores, values, indices)
 
 
+class FusedStepResult(NamedTuple):
+    """Result of one FUSED acquisition step (score → top-k → reveal-mask
+    update as one jitted call — the serve hot path's tentpole).
+
+    ``entropy``/``values``/``indices`` are exactly the :class:`ScoreResult`
+    fields (bit-identical to the unfused scorer — pinned by
+    ``tests/test_fused_step.py``); ``pool_mask`` (and ``hc_mask`` for the
+    hc-table modes, else ``None``) are the POST-SELECT masks, updated
+    in-graph by :func:`~consensus_entropy_tpu.ops.topk.reveal_mask_update`
+    so they stay device-resident across AL iterations.  Only
+    ``values``/``indices`` (2·k scalars) need to reach the host per
+    iteration — the acquirer adopts the mask buffers without ever pulling
+    them (``Acquirer.finish_select``).
+    """
+
+    entropy: jax.Array
+    values: jax.Array
+    indices: jax.Array
+    pool_mask: jax.Array
+    hc_mask: jax.Array | None = None
+
+
+def fused_mc(member_probs, pool_mask, *, k: int, member_mask=None,
+             tie_break: str = "fast") -> FusedStepResult:
+    """mc with the iteration tail fused: mean → entropy → top-k → pool-mask
+    shrink, one graph.  ``pool_mask`` should be donated by the jit wrapper
+    (the returned mask reuses its buffer — a true in-place update)."""
+    r = score_mc(member_probs, pool_mask, k=k, member_mask=member_mask,
+                 tie_break=tie_break)
+    return FusedStepResult(
+        r.entropy, r.values, r.indices,
+        reveal_mask_update(pool_mask, r.values, r.indices))
+
+
+def fused_wmc(member_probs, pool_mask, member_weights, *, k: int,
+              member_mask=None, tie_break: str = "fast") -> FusedStepResult:
+    r = score_wmc(member_probs, pool_mask, member_weights, k=k,
+                  member_mask=member_mask, tie_break=tie_break)
+    return FusedStepResult(
+        r.entropy, r.values, r.indices,
+        reveal_mask_update(pool_mask, r.values, r.indices))
+
+
+#: qbdc shares mc's fused graph exactly as it shares the unfused one (the
+#: committee axis holds K dropout forwards); the distinct fn key keeps
+#: dispatch groups / breaker state / telemetry mode-separable end to end
+fused_qbdc = fused_mc
+
+
+def fused_hc_pre(hc_ent, hc_mask, pool_mask, *, k: int,
+                 tie_break: str = "fast") -> FusedStepResult:
+    """hc (precomputed-entropy production path) fused: top-k over the
+    hoisted row entropies, then BOTH masks shrink in-graph — the queried
+    rows leave the hc table (``amg_test.py:455``) and the pool
+    (``finish_select``'s common shrink) without a host round-trip.
+    ``pool_mask`` is not read by the hc ranking; it rides along so its
+    device twin stays in lockstep with the host mirror."""
+    r = score_hc_precomputed(hc_ent, hc_mask, k=k, tie_break=tie_break)
+    return FusedStepResult(
+        r.entropy, r.values, r.indices,
+        reveal_mask_update(pool_mask, r.values, r.indices),
+        reveal_mask_update(hc_mask, r.values, r.indices))
+
+
+def fused_mix(member_probs, pool_mask, hc_freq, hc_mask, *, k: int,
+              member_mask=None, tie_break: str = "fast") -> FusedStepResult:
+    """mix fused: the stacked [mc; hc] ranking's indices live in ``[0, 2N)``
+    — fold each winner back to its song slot (``split_mix_index``) and
+    shrink both masks there (the reference removes a queried song from the
+    pool AND its hc row whichever block surfaced it; a song surfacing from
+    both blocks double-updates idempotently, matching the host dedup)."""
+    r = score_mix(member_probs, pool_mask, hc_freq, hc_mask, k=k,
+                  member_mask=member_mask, tie_break=tie_break)
+    n = jnp.asarray(pool_mask).shape[-1]
+    _, slots = split_mix_index(r.indices, n)
+    return FusedStepResult(
+        r.entropy, r.values, r.indices,
+        reveal_mask_update(pool_mask, r.values, slots),
+        reveal_mask_update(hc_mask, r.values, slots))
+
+
+def fused_rand(key, pool_mask, *, k: int) -> FusedStepResult:
+    r = score_rand(key, pool_mask, k=k)
+    return FusedStepResult(
+        r.entropy, r.values, r.indices,
+        reveal_mask_update(pool_mask, r.values, r.indices))
+
+
+#: fn key → the positional operands a fused scorer's jit wrapper DONATES:
+#: the device-resident mask buffers, whose post-select update is returned
+#: at the same shape/dtype — XLA reuses the input buffer, so the per-user
+#: (and, vmapped, per-bucket stacked) pool state mutates in place instead
+#: of allocating a fresh mask every iteration.  (The probs table is NOT
+#: donated: its producer buffer is reused across iterations by the
+#: acquirer's scatter — ``al.acquisition._scatter_rows`` — not consumed.)
+FUSED_DONATE = {"mc_fused": (1,), "qbdc_fused": (1,), "wmc_fused": (1,),
+                "rand_fused": (1,), "hc_pre_fused": (1, 2),
+                "mix_fused": (1, 3)}
+
+
 def make_scoring_fns(*, k: int,
                      tie_break: str = "fast") -> dict[str, Callable]:
     """Jit-compile the acquisition scorers with ``k`` baked in.
@@ -218,6 +318,23 @@ def make_scoring_fns(*, k: int,
     return _make_scoring_fns_cached(k, tie_break)
 
 
+#: fn key → the un-jitted fused step (the single-user jit family and the
+#: fleet/bucket vmapped families all wrap exactly these, so the arms can
+#: never diverge)
+_FUSED_IMPLS = {"mc_fused": fused_mc, "qbdc_fused": fused_qbdc,
+                "wmc_fused": fused_wmc, "hc_pre_fused": fused_hc_pre,
+                "mix_fused": fused_mix, "rand_fused": fused_rand}
+
+
+def _fused_partial(key: str, k: int, tie_break: str) -> Callable:
+    """Bind one fused impl's static kwargs — the ONE place that knows
+    rand takes no tie policy, shared by the single-user jit family and
+    the fleet/bucket vmapped families so their arms cannot diverge."""
+    if key == "rand_fused":
+        return functools.partial(_FUSED_IMPLS[key], k=k)
+    return functools.partial(_FUSED_IMPLS[key], k=k, tie_break=tie_break)
+
+
 @functools.lru_cache(maxsize=None)
 def _make_scoring_fns_cached(k: int, tie_break: str) -> dict[str, Callable]:
     mc = jax.jit(functools.partial(score_mc, k=k, tie_break=tie_break))
@@ -228,8 +345,12 @@ def _make_scoring_fns_cached(k: int, tie_break: str) -> dict[str, Callable]:
     rand = jax.jit(functools.partial(score_rand, k=k))
     qbdc = jax.jit(functools.partial(score_qbdc, k=k, tie_break=tie_break))
     wmc = jax.jit(functools.partial(score_wmc, k=k, tie_break=tie_break))
-    return {"mc": mc, "hc": hc, "hc_pre": hc_pre, "mix": mix, "rand": rand,
-            "qbdc": qbdc, "wmc": wmc}
+    fns = {"mc": mc, "hc": hc, "hc_pre": hc_pre, "mix": mix, "rand": rand,
+           "qbdc": qbdc, "wmc": wmc}
+    for key in _FUSED_IMPLS:
+        fns[key] = jax.jit(_fused_partial(key, k, tie_break),
+                           donate_argnums=FUSED_DONATE[key])
+    return fns
 
 
 def make_fleet_scoring_fns(*, k: int,
@@ -309,15 +430,21 @@ def _fleet_base_fns(k: int, tie_break: str) -> dict[str, Callable]:
         return score_wmc(probs, pool_mask, weights, k=k,
                          member_mask=member_mask, tie_break=tie_break)
 
-    return {"mc": _mc, "mc_masked": _mc_masked, "hc": _hc,
-            "hc_pre": _hc_pre, "mix": _mix, "mix_masked": _mix_masked,
-            "rand": _rand, "qbdc": _qbdc, "wmc": _wmc,
-            "wmc_masked": _wmc_masked}
+    fns = {"mc": _mc, "mc_masked": _mc_masked, "hc": _hc,
+           "hc_pre": _hc_pre, "mix": _mix, "mix_masked": _mix_masked,
+           "rand": _rand, "qbdc": _qbdc, "wmc": _wmc,
+           "wmc_masked": _wmc_masked}
+    for key in _FUSED_IMPLS:
+        fns[key] = _fused_partial(key, k, tie_break)
+    return fns
 
 
 @functools.lru_cache(maxsize=None)
 def _make_fleet_scoring_fns_cached(k: int, tie_break: str) -> dict[str, Callable]:
-    return {key: jax.jit(jax.vmap(fn))
+    # the fused keys donate their STACKED mask operands: the whole
+    # bucket's device-resident pool state updates in place per dispatch
+    return {key: jax.jit(jax.vmap(fn),
+                         donate_argnums=FUSED_DONATE.get(key, ()))
             for key, fn in _fleet_base_fns(k, tie_break).items()}
 
 
@@ -326,7 +453,9 @@ def _make_fleet_scoring_fns_cached(k: int, tie_break: str) -> dict[str, Callable
 #: member mask of the ``*_masked`` variants is (U, M) and must not be used)
 _POOL_MASK_POS = {"mc": 1, "mc_masked": 1, "hc": 1, "hc_pre": 1,
                   "mix": 1, "mix_masked": 1, "rand": 1, "qbdc": 1,
-                  "wmc": 1, "wmc_masked": 1}
+                  "wmc": 1, "wmc_masked": 1, "mc_fused": 1,
+                  "qbdc_fused": 1, "wmc_fused": 1, "rand_fused": 1,
+                  "hc_pre_fused": 1, "mix_fused": 1}
 
 
 def fleet_scoring_fns_for_width(*, k: int, tie_break: str = "fast",
@@ -358,7 +487,8 @@ def fleet_scoring_fns_for_width(*, k: int, tie_break: str = "fast",
 @functools.lru_cache(maxsize=None)
 def _fleet_fns_for_width_cached(k: int, tie_break: str,
                                 width: int) -> dict[str, Callable]:
-    base = {key: jax.jit(jax.vmap(fn))
+    base = {key: jax.jit(jax.vmap(fn),
+                         donate_argnums=FUSED_DONATE.get(key, ()))
             for key, fn in _fleet_base_fns(k, tie_break).items()}
 
     def guarded(fn_key, fn):

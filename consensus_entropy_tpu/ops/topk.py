@@ -85,3 +85,23 @@ def two_stage_top_k(scores, k: int, *, row: int = 1024):
 def valid_count(values) -> jnp.ndarray:
     """How many of the returned top-k slots hold real (unmasked) entries."""
     return jnp.sum(values > -jnp.inf)
+
+
+def reveal_mask_update(mask, values, indices):
+    """Flip the just-selected top-k rows of ``mask`` to False — in-graph.
+
+    The select→reveal→mask bookkeeping of one AL iteration, fused into the
+    scoring dispatch (the ``ops.scoring`` ``*_fused`` family): the q
+    selected pool rows leave the mask ON DEVICE, so the shrunken mask
+    never round-trips through the host between iterations.  Slots whose
+    ``values`` entry is ``-inf`` (fewer than k valid rows remained) carry
+    meaningless indices — they are routed out of bounds and DROPPED by the
+    scatter, exactly mirroring the host path's ``values > -inf`` gate
+    (``Acquirer._ids``).  Re-selecting an already-False row is idempotent,
+    so duplicate indices (the mix mode's two blocks naming one song) are
+    harmless.
+    """
+    mask = jnp.asarray(mask)
+    n = mask.shape[0]
+    idx = jnp.where(jnp.asarray(values) > -jnp.inf, jnp.asarray(indices), n)
+    return mask.at[idx].set(False, mode="drop")

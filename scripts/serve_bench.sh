@@ -16,10 +16,26 @@
 # commit an artifact); the per-rep log goes to stderr.  Extra bench args
 # pass through, e.g.:
 #   scripts/serve_bench.sh --users 8 --pool 150 --fleet 2 4
+#
+# `scripts/serve_bench.sh fused [...]` runs the FUSED-STEP race instead
+# (`bench.py --suite serve-fused`, ISSUE 8): the fused serve step
+# (device-resident pool state, donated stacks, in-graph
+# select→reveal→mask) vs `--no-fuse-step` over identical users, parity
+# asserted on every rep, reporting host↔device bytes + device calls per
+# iteration alongside users/sec (redirect to BENCH_serve_fused_r<N>.json).
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
-if [ "$#" -gt 0 ]; then
+if [ "${1:-}" = "fused" ]; then
+    shift
+    if [ "$#" -gt 0 ]; then
+        JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py \
+            --suite serve-fused "$@"
+    else
+        JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py \
+            --suite serve-fused --users 6 --pool 280 --fleet 3 --reps 3
+    fi
+elif [ "$#" -gt 0 ]; then
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --suite serve "$@"
 else
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --suite serve \

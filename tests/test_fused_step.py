@@ -1,0 +1,412 @@
+"""The fused serve step: device-resident pool state, in-graph
+select→reveal→mask, donated buffers — pinned bit-identical to the
+host-round-trip arm.
+
+Layers covered:
+
+- **ops**: every ``*_fused`` scorer equals its unfused sibling's
+  entropies/values/indices EXACTLY, and its returned masks equal the host
+  bookkeeping the unfused arm performs (``Acquirer.finish_select``'s
+  shrink + hc removal), for all six registered modes; the fleet vmapped
+  fused fns are row-identical to the single-user fused fns (the stacked
+  bucket dispatch), with the donated stacked mask buffers actually
+  consumed.
+- **acquirer**: the device mask twins are adopted from each fused step
+  and stay in bitwise lockstep with the host mirrors across shrinking
+  iterations; ``--no-fuse-step`` (``fuse_step=False``) selects
+  identically.
+- **loop/fleet/serve**: full AL runs — sequential, stacked fleet cohorts,
+  and a serve-journal restart — produce bit-identical trajectories,
+  reveal histories and reports across the two arms (tier-1 keeps the mc
+  cases; the full mode matrix, the qbdc CNN case and the
+  eviction+resume drill are ``slow``).
+
+Eviction/resume and journal-restart correctness rest on one invariant the
+unit here pins directly: ``DevicePoolState`` masks are built LAZILY from
+the host mirrors (``device_masks``), so every rebuild path — which
+constructs a fresh ``Acquirer`` at the pinned pad and replays
+``ALState.queried`` — re-uploads post-replay mirrors bit-identical to
+what an uninterrupted run holds on device.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensus_entropy_tpu.al.acquisition import Acquirer
+from consensus_entropy_tpu.al.loop import ALLoop
+from consensus_entropy_tpu.fleet import FleetReport, FleetScheduler, FleetUser
+from consensus_entropy_tpu.ops import scoring
+from consensus_entropy_tpu.ops.entropy import shannon_entropy
+from consensus_entropy_tpu.ops.topk import reveal_mask_update
+from tests.test_fleet import _cfg, _committee, _run_pair, _user_data
+
+pytestmark = pytest.mark.fleet
+
+
+def _probs(rng, m, n, c=4):
+    p = rng.uniform(0.01, 1.0, size=(m, n, c)).astype(np.float32)
+    return p / p.sum(axis=-1, keepdims=True)
+
+
+def _host_shrink(mask, values, indices, n=None):
+    """The unfused arm's host bookkeeping, verbatim: flip selected rows
+    whose top-k value is real; mix-space indices fold mod n."""
+    out = np.asarray(mask).copy()
+    idx = np.asarray(indices)
+    if n is not None:
+        idx = idx % n
+    out[idx[np.asarray(values) > -np.inf]] = False
+    return out
+
+
+def test_reveal_mask_update_drops_invalid_slots():
+    mask = np.ones(10, bool)
+    vals = jnp.asarray([1.0, 0.5, -jnp.inf])
+    idx = jnp.asarray([3, 7, 2])  # slot 2 is a -inf filler: must survive
+    out = np.asarray(reveal_mask_update(mask, vals, idx))
+    expect = mask.copy()
+    expect[[3, 7]] = False
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_fused_ops_match_unfused_all_modes(rng):
+    """Every fused scorer == its unfused sibling + the host mask update,
+    bit for bit — the in-graph tail changes WHERE the bookkeeping runs,
+    never what is selected."""
+    m, n, k = 4, 96, 6
+    p = _probs(rng, m, n)
+    pool = np.zeros(n, bool)
+    pool[:80] = True
+    counts = rng.integers(1, 25, size=(n, 4))
+    hc = np.round(counts / counts.sum(-1, keepdims=True),
+                  3).astype(np.float32)
+    hc_mask = pool.copy()
+    hc_mask[50:] = False
+    hc_ent = np.asarray(jax.jit(shannon_entropy)(hc))
+    w = rng.uniform(0.2, 1.5, m).astype(np.float32)
+    key = jax.random.key(11)
+    fns = scoring.make_scoring_fns(k=k)
+
+    cases = {
+        "mc": ((p, pool), (p, jnp.asarray(pool))),
+        "qbdc": ((p, pool), (p, jnp.asarray(pool))),
+        "wmc": ((p, pool, w), (p, jnp.asarray(pool), w)),
+        "rand": ((key, pool), (key, jnp.asarray(pool))),
+        "hc_pre": ((hc_ent, hc_mask),
+                   (hc_ent, jnp.asarray(hc_mask), jnp.asarray(pool))),
+        "mix": ((p, pool, hc, hc_mask),
+                (p, jnp.asarray(pool), hc, jnp.asarray(hc_mask))),
+    }
+    for mode, (plain_in, fused_in) in cases.items():
+        plain = fns[mode](*plain_in)
+        fused = fns[f"{mode}_fused"](*fused_in)
+        for field in ("entropy", "values", "indices"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(fused, field)),
+                np.asarray(getattr(plain, field)), err_msg=mode)
+        v, i = np.asarray(plain.values), np.asarray(plain.indices)
+        fold = n if mode == "mix" else None
+        if mode == "hc_pre":
+            # hc scores over the hc mask; both masks shrink at the slots
+            np.testing.assert_array_equal(
+                np.asarray(fused.hc_mask), _host_shrink(hc_mask, v, i))
+            np.testing.assert_array_equal(
+                np.asarray(fused.pool_mask), _host_shrink(pool, v, i))
+        elif mode == "mix":
+            np.testing.assert_array_equal(
+                np.asarray(fused.pool_mask),
+                _host_shrink(pool, v, i, n=fold))
+            np.testing.assert_array_equal(
+                np.asarray(fused.hc_mask),
+                _host_shrink(hc_mask, v, i, n=fold))
+        else:
+            np.testing.assert_array_equal(
+                np.asarray(fused.pool_mask), _host_shrink(pool, v, i))
+            assert fused.hc_mask is None
+
+
+def test_fleet_fused_rows_match_single_and_donate(rng):
+    """The stacked bucket dispatch: every row of the vmapped fused fns is
+    bit-identical to the single-user fused fn, and the STACKED mask
+    operand is donated (consumed) — the in-place pool-state update the
+    tentpole claims."""
+    u, m, n, k = 3, 4, 64, 5
+    p = _probs(rng, u * m, n).reshape(u, m, n, 4)
+    mask = np.zeros((u, n), bool)
+    mask[:, :50] = True
+    fleet = scoring.make_fleet_scoring_fns(k=k)
+    single = scoring.make_scoring_fns(k=k)
+    stacked = jnp.asarray(mask)
+    res = fleet["mc_fused"](jnp.asarray(p), stacked)
+    for i in range(u):
+        s = single["mc_fused"](p[i], jnp.asarray(mask[i]))
+        for field in ("entropy", "values", "indices", "pool_mask"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(res, field)[i]),
+                np.asarray(getattr(s, field)))
+    with pytest.raises(RuntimeError):
+        stacked.block_until_ready()  # donated: the buffer was consumed
+
+    # bucketed (width-guarded) family: same graph, same rows, and the
+    # guard still reads the fused mask operand's width
+    bucket = scoring.fleet_scoring_fns_for_width(k=k, width=n)
+    res2 = bucket["mc_fused"](jnp.asarray(p), jnp.asarray(mask))
+    np.testing.assert_array_equal(np.asarray(res2.indices),
+                                  np.asarray(res.indices))
+    with pytest.raises(ValueError, match="bucket routing"):
+        bucket["mc_fused"](jnp.asarray(p[:, :, :32]),
+                           jnp.asarray(mask[:, :32]))
+
+
+def test_acquirer_fused_masks_lockstep(rng):
+    """Across shrinking iterations the device twins adopted from each
+    fused step stay bitwise equal to the host mirrors — and a fused
+    acquirer selects exactly what a ``fuse_step=False`` one does.  Also
+    pins the lazy-upload rebuild contract: a THIRD acquirer replays the
+    first acquirer's query history (the eviction/resume + journal-restart
+    path) and its first ``device_masks()`` equals the live twins."""
+    songs = [f"s{i:03d}" for i in range(37)]
+    counts = rng.integers(1, 20, size=(37, 4))
+    hc = np.round(counts / counts.sum(1, keepdims=True),
+                  3).astype(np.float32)
+    for mode in ("mc", "hc", "mix"):
+        fused = Acquirer(songs, hc, queries=4, mode=mode, seed=1)
+        plain = Acquirer(songs, hc, queries=4, mode=mode, seed=1,
+                         fuse_step=False)
+        assert fused.fuse_step and not plain.fuse_step
+        hist = []
+        for _ in range(3):
+            live = fused.remaining_songs
+            p = _probs(rng, 3, len(live))
+            qf = fused.select(p)
+            qp = plain.select(p)
+            assert qf == qp
+            hist.append(qf)
+            np.testing.assert_array_equal(
+                np.asarray(fused.device.pool_mask), fused.pool_mask)
+            np.testing.assert_array_equal(fused.pool_mask, plain.pool_mask)
+            if fused.strategy.uses_hc_table:
+                np.testing.assert_array_equal(
+                    np.asarray(fused.device.hc_mask), fused.hc_mask)
+        assert fused.device.n_revealed == sum(len(b) for b in hist)
+        rebuilt = Acquirer(songs, hc, queries=4, mode=mode, seed=1)
+        rebuilt.replay(hist)
+        d = rebuilt.device_masks()
+        np.testing.assert_array_equal(np.asarray(d.pool_mask),
+                                      np.asarray(fused.device.pool_mask))
+        if rebuilt.strategy.uses_hc_table:
+            np.testing.assert_array_equal(
+                np.asarray(d.hc_mask), np.asarray(fused.device.hc_mask))
+
+
+def _ab_run(tmp_path, cfg, tag, *, fuse, n_users=2):
+    out = []
+    loop = ALLoop(cfg, fuse_step=fuse)
+    for i in range(n_users):
+        data = _user_data(100 + i, f"u{i}")
+        p = tmp_path / f"{tag}_u{i}"
+        p.mkdir()
+        out.append(loop.run_user(_committee(data), data, str(p)))
+    return out
+
+
+def test_sequential_loop_fused_parity_mc(tmp_path):
+    """The tier-1 A/B pin: a sequential mc run under the fused step is
+    bit-identical — trajectory AND reveal history — to the
+    ``--no-fuse-step`` arm."""
+    cfg = _cfg(mode="mc", epochs=3)
+    a = _ab_run(tmp_path, cfg, "fused", fuse=True)
+    b = _ab_run(tmp_path, cfg, "plain", fuse=False)
+    assert [r["trajectory"] for r in a] == [r["trajectory"] for r in b]
+    import json
+    for i in range(2):
+        fa = json.loads(
+            (tmp_path / f"fused_u{i}" / "al_state.json").read_text())
+        fb = json.loads(
+            (tmp_path / f"plain_u{i}" / "al_state.json").read_text())
+        assert fa["queried"] == fb["queried"]  # reveal trajectories
+
+
+@pytest.mark.slow
+def test_sequential_loop_fused_parity_matrix(tmp_path):
+    """Full registered-mode matrix of the A/B pin (host modes; qbdc has
+    its own CNN case below)."""
+    import json
+
+    for mode in ("hc", "mix", "rand", "wmc"):
+        cfg = _cfg(mode=mode, epochs=3)
+        a = _ab_run(tmp_path, cfg, f"{mode}_fused", fuse=True)
+        b = _ab_run(tmp_path, cfg, f"{mode}_plain", fuse=False)
+        assert [r["trajectory"] for r in a] == \
+            [r["trajectory"] for r in b], mode
+        for i in range(2):
+            fa = json.loads((tmp_path / f"{mode}_fused_u{i}"
+                             / "al_state.json").read_text())
+            fb = json.loads((tmp_path / f"{mode}_plain_u{i}"
+                             / "al_state.json").read_text())
+            assert fa["queried"] == fb["queried"], mode
+
+
+def test_fleet_fused_stacked_matches_sequential(tmp_path):
+    """Cross-driver: a fused fleet cohort (stacked fused dispatches,
+    donated stacks) reproduces sequential runs bit-for-bit (the
+    sequential arm is fused too; the mc A/B pin above makes that
+    transitively equal to the unfused arm), and the dispatch records
+    carry the transfer grading the fused step is pinned by."""
+    cfg = _cfg(mode="mc", epochs=3)
+    report = FleetReport()
+    seq, recs, sched = _run_pair(
+        tmp_path, cfg, 2,
+        scheduler_kw={"report": report, "fuse_step": True})
+    assert all(r["error"] is None for r in recs)
+    assert [r["result"]["trajectory"] for r in recs] == \
+        [s["trajectory"] for s in seq]
+    fused_fns = {d["fn"] for d in report.dispatches}
+    assert "mc_fused" in fused_fns and "mc" not in fused_fns
+    t = report.transfer_summary
+    assert t is not None and t["selects"] == 2 * cfg.epochs
+    # fused mc over a host committee: the probs block is each select's
+    # ONLY steady-state host→device upload (masks live on device after
+    # the one charged per-user admission upload)
+    assert t["h2d_ops"] == t["selects"] + 2
+    # strictly below the unfused arm's floor of 3 (probs + mask uploads
+    # + the reduction dispatch per select); the exact value wiggles with
+    # dispatch grouping, which is scheduling-timing dependent
+    assert t["device_calls_per_select"] < 3.0
+
+
+@pytest.mark.slow
+def test_fleet_fused_eviction_resume_parity(tmp_path):
+    """Eviction+resume under the fused step: the resumed session rebuilds
+    its ``DevicePoolState`` from ``ALState`` at the pinned pad (lazy
+    ``device_masks`` upload post-replay) and the user's trajectory stays
+    bit-identical to an unfaulted UNFUSED sequential run."""
+    from consensus_entropy_tpu.resilience import faults
+    from consensus_entropy_tpu.resilience.faults import FaultRule
+
+    from consensus_entropy_tpu.al import workspace
+
+    cfg = _cfg(mode="mc", epochs=3)
+
+    def committee_fn(data):
+        if data.user_id == "u1":  # the victim: uniquely-named member
+            return _committee(data, sgd_name="sgd.victim", min_members=2)
+        return _committee(data)
+
+    seq, entries = [], []
+    for i in range(2):
+        data = _user_data(100 + i, f"u{i}")
+        sp = tmp_path / f"seqplain_u{i}"
+        sp.mkdir()
+        seq.append(ALLoop(cfg, fuse_step=False).run_user(
+            committee_fn(data), data, str(sp)))
+        fp = tmp_path / f"fleet_u{i}"
+        fp.mkdir()
+        entries.append(FleetUser(
+            f"u{i}", committee_fn(data), data, str(fp), seed=cfg.seed,
+            committee_factory=lambda fp=fp: workspace.load_committee(
+                str(fp))))
+    rule = FaultRule("member.retrain", "raise", at=1, member="sgd.victim")
+    with faults.inject(rule) as inj:
+        sched = FleetScheduler(cfg, report=FleetReport(), fuse_step=True)
+        recs = sched.run(entries)
+        assert inj.fired
+    assert all(r["error"] is None for r in recs)
+    assert sum(r["resumes"] for r in recs) >= 1  # somebody was evicted
+    assert [r["result"]["trajectory"] for r in recs] == \
+        [s["trajectory"] for s in seq]
+
+
+def test_serve_restart_fused_matches_unfused_sequential(tmp_path):
+    """THE serve acceptance pin: a fused serve run SIGKILLed mid-run (at
+    the first finish-journal append) and restarted from the journal
+    finishes every user — the restarted sessions rebuild their
+    ``DevicePoolState`` at the pinned pad from ``ALState`` — with results
+    bit-identical to uninterrupted UNFUSED sequential runs."""
+    from consensus_entropy_tpu.resilience.faults import FaultRule
+    from tests.test_serve_faults import _restart_drill
+
+    cfg = _cfg(mode="mc", epochs=2)
+    specs = [(100 + i, f"u{i}", 30) for i in range(3)]
+    seq = []
+    loop = ALLoop(cfg, fuse_step=False)
+    for seed, uid, n in specs:
+        data = _user_data(seed, uid, n_songs=n)
+        p = tmp_path / f"seq_{uid}"
+        p.mkdir()
+        seq.append(loop.run_user(_committee(data), data, str(p)))
+    done, report = _restart_drill(
+        tmp_path, cfg, specs,
+        FaultRule("serve.journal.append", "kill", at=6),
+        scheduler_kw={"fuse_step": True})
+    for s, (_, uid, _) in zip(seq, specs):
+        assert done[uid]["error"] is None
+        assert done[uid]["result"]["trajectory"] == s["trajectory"]
+    assert {d["fn"] for d in report.dispatches} <= {"mc_fused"}
+    assert report.transfer_summary is not None
+
+
+@pytest.mark.slow
+def test_qbdc_fused_parity_and_serve_restart(tmp_path):
+    """qbdc (the device-resident probs producer): fused vs unfused
+    sequential parity, then a fused serve restart against the unfused
+    baselines — the dropout committee's mask keys, the scatter buffer and
+    the device pool masks all rebuild bit-identically."""
+    from consensus_entropy_tpu.al import workspace
+    from consensus_entropy_tpu.resilience.faults import FaultRule
+    from tests.test_acquire import TINY_CNN, TINY_TC, _cnn_committee, \
+        _cnn_data
+    from tests.test_serve_faults import _restart_drill
+
+    cfg = dataclasses.replace(_cfg(mode="qbdc", epochs=2, queries=3),
+                              qbdc_k=6)
+    specs = [(100 + i, f"u{i}", 8) for i in range(2)]
+    seq = []
+    for seed, uid, n in specs:
+        data = _cnn_data(seed, uid, n_songs=n)
+        p = tmp_path / f"seq_{uid}"
+        p.mkdir()
+        seq.append(ALLoop(cfg, retrain_epochs=1, fuse_step=False).run_user(
+            _cnn_committee(data), data, str(p)))
+    # fused sequential parity first
+    for seed, uid, n in specs:
+        data = _cnn_data(seed, uid, n_songs=n)
+        p = tmp_path / f"fseq_{uid}"
+        p.mkdir()
+        r = ALLoop(cfg, retrain_epochs=1, fuse_step=True).run_user(
+            _cnn_committee(data), data, str(p))
+        assert r["trajectory"] == seq[
+            [u for _, u, _ in specs].index(uid)]["trajectory"]
+
+    def entries(tmp_path, cfg, specs):
+        out = []
+        for seed, uid, n in specs:
+            data = _cnn_data(seed, uid, n_songs=n)
+            fp = tmp_path / f"serve_{uid}"
+            fp.mkdir(exist_ok=True)
+            if (fp / "al_state.json").exists():
+                committee = workspace.load_committee(str(fp), TINY_CNN,
+                                                     TINY_TC)
+            else:
+                committee = _cnn_committee(data)
+            out.append(FleetUser(
+                uid, committee, data, str(fp), seed=cfg.seed,
+                committee_factory=lambda fp=fp: workspace.load_committee(
+                    str(fp), TINY_CNN, TINY_TC)))
+        return out
+
+    done, report = _restart_drill(
+        tmp_path, cfg, specs, FaultRule("serve.collect", "kill", at=1),
+        entries_fn=entries,
+        scheduler_kw={"retrain_epochs": 1, "fuse_step": True})
+    for s, (_, uid, _) in zip(seq, specs):
+        assert done[uid]["error"] is None
+        assert done[uid]["result"]["trajectory"] == s["trajectory"]
+    # the restart may find every user already past its last epoch (the
+    # kill landed after the work finished), so only pin that no UNFUSED
+    # reduction ran — the fused-parity halves above carry the equality
+    assert "qbdc" not in {d["fn"] for d in report.dispatches}

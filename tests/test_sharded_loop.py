@@ -108,11 +108,20 @@ def test_sharded_cnn_loop_matches_single_device(tmp_path):
     assert traj_a == traj_b
 
 
+@pytest.mark.slow
 def test_member_sharded_retrain_loop_matches_single_device(tmp_path):
     """Production retrain through a (dp=1, member=8) training mesh: the
     2-member committee is padded to 8 member slots inside fit_many, each
     chip trains one slot, and the full AL trajectory matches the
-    single-device run (reference hot loop #2, amg_test.py:496-502)."""
+    single-device run (reference hot loop #2, amg_test.py:496-502).
+
+    Slow since ISSUE 8 (budget rebalance — tier-1 was brushing the 870 s
+    ceiling under wall-clock drift): at ~60 s this is the largest tier-1
+    case, and the member-sharded fit_many MECHANISM stays tier-1 via
+    ``test_cnn_trainer.py::test_fit_many_member_sharded_mesh`` while the
+    mesh-driven AL loop stays tier-1 via the CLI mesh case
+    (``test_cli.py::test_mesh_auto_cnn_committee_cli``); this end-to-end
+    twin rides the slow lane."""
     from consensus_entropy_tpu.parallel.mesh import make_training_mesh
 
     traj_a, q_a = _run(tmp_path / "a", "mc", cnn=True, n_songs=10, epochs=2,
