@@ -1228,6 +1228,181 @@ def run_serve_fused_suite(args_ns) -> int:
     return 0
 
 
+def run_obs_suite(args_ns) -> int:
+    """Tracing overhead: traced vs ``--no-trace`` serve runs (ISSUE 9).
+
+    Two serve runs over IDENTICAL users and seeds — one with the obs
+    span tracer writing a real ``spans.jsonl``, one with the tracer off
+    (the ``--no-trace`` arm) — interleaved with alternating order per
+    rep (throttled-box discipline), per-user trajectory parity asserted
+    against a sequential baseline on EVERY rep of BOTH arms.
+
+    The acceptance number (overhead <= 3%) is the MEDIAN of per-rep
+    paired traced/bare wall ratios (adjacent runs, warmed, order
+    alternating) — pairing cancels this box's slow load drift, and the
+    identical-arm noise floor is measured IN-SUITE the same way and
+    included in the artifact so the headline reads in context.  A
+    deterministic companion pin rides along: the per-span emit cost
+    (tight-loop microbench against the same filesystem) times the run's
+    span count, as a share of traced wall — the capacity-independent
+    "work added" figure in the PR 7/8 byte/call tradition.  Each traced
+    rep's artifacts are validated too: metrics lines against the
+    schema-v2 event table, spans merged orphan-free, Chrome export
+    loadable.
+    """
+    import os
+    import shutil
+    import tempfile
+
+    from consensus_entropy_tpu.al.loop import ALLoop
+    from consensus_entropy_tpu.config import ALConfig
+    from consensus_entropy_tpu.fleet import FleetReport, FleetScheduler, \
+        FleetUser
+    from consensus_entropy_tpu.obs import export
+    from consensus_entropy_tpu.obs.trace import Tracer
+    from consensus_entropy_tpu.serve import FleetServer, ServeConfig
+
+    cfg = ALConfig(queries=args_ns.k, epochs=args_ns.al_epochs, mode="mc",
+                   seed=1987, ckpt_dtype="float32")
+    n_users = args_ns.users
+    users = _fleet_workload(n_users, args_ns.pool or 120, 96, cfg.seed)
+    target = min(max(args_ns.fleet), n_users)
+    _log(f"obs workload: {n_users} users x {args_ns.pool or 120} songs, "
+         f"3 host members, q={cfg.queries}, {cfg.epochs} AL iterations, "
+         f"target_live={target}")
+
+    root = tempfile.mkdtemp(prefix="obs_bench_")
+    reps = args_ns.reps
+    try:
+        loop = ALLoop(cfg)
+        # one sequential pass pins the ground-truth trajectories (the
+        # runs are deterministic; the timed race is traced vs untraced)
+        seq_results = []
+        for i, (data, factory) in enumerate(users):
+            p = _mkdir(root, f"seq_{i}")
+            seq_results.append(loop.run_user(factory(), data, p,
+                                             seed=cfg.seed))
+        traj_of = {r["user"]: r["trajectory"] for r in seq_results}
+
+        def serve_once(tag, rep, tracer, metrics_path=None):
+            report = FleetReport(metrics_path)
+            sched = FleetScheduler(cfg, report=report,
+                                   host_workers=args_ns.host_workers,
+                                   user_timings=False,
+                                   scoring_by_width=True, tracer=tracer)
+            server = FleetServer(sched, ServeConfig(
+                target_live=target, max_queue=max(n_users, 1)))
+            entries = [
+                FleetUser(data.user_id, factory(), data,
+                          _mkdir(root, f"{tag}_{rep}_{i}"), seed=cfg.seed)
+                for i, (data, factory) in enumerate(users)]
+            t0 = time.perf_counter()
+            recs = server.serve(iter(entries))
+            wall = time.perf_counter() - t0
+            assert len(recs) == n_users and all(
+                r["error"] is None
+                and r["result"]["trajectory"] == traj_of[r["user"]]
+                for r in recs), f"{tag} rep {rep}: parity failure"
+            return wall, report
+
+        # untimed warm-up: the first serve run pays the per-width jit
+        # compiles, which must not land inside either arm's rep 0
+        serve_once("warm", 0, None)
+        best = {"traced": float("inf"), "bare": float("inf")}
+        ratios = []  # per-rep traced/bare wall (adjacent runs)
+        span_stats = None
+        for rep in range(reps):
+            # interleave, alternating which arm goes first so the box's
+            # load drift can't systematically favor one side
+            walls = {}
+            order = ["traced", "bare"] if rep % 2 == 0 else ["bare",
+                                                             "traced"]
+            for arm in order:
+                if arm != "traced":
+                    walls["bare"], _ = serve_once("bare", rep, None)
+                    best["bare"] = min(best["bare"], walls["bare"])
+                    continue
+                spans_path = os.path.join(root, f"spans_{rep}.jsonl")
+                metrics_path = os.path.join(
+                    root, f"metrics_{rep}", "fleet_metrics.jsonl")
+                tracer = Tracer(spans_path,
+                                run_id=f"{cfg.mode}-{cfg.seed}")
+                walls["traced"], report = serve_once("traced", rep,
+                                                     tracer, metrics_path)
+                tracer.close()
+                report.write_summary(cohort=target)
+                report.close()
+                # artifact gates, every traced rep: schema-valid metrics,
+                # orphan-free merged spans, loadable Chrome export
+                errs = export.validate_metrics_file(metrics_path)
+                assert errs == [], f"schema violations: {errs[:3]}"
+                spans = export.load_spans([spans_path])
+                assert spans and export.orphan_spans(spans) == []
+                json.dumps(export.chrome_trace(spans))
+                span_stats = {"n_spans": len(spans),
+                              "bytes": os.path.getsize(spans_path)}
+                best["traced"] = min(best["traced"], walls["traced"])
+            ratios.append(walls["traced"] / walls["bare"])
+            _log(f"[rep {rep}] traced {walls['traced']:.2f}s / bare "
+                 f"{walls['bare']:.2f}s = {ratios[-1]:.3f}")
+        # the box's own noise floor, measured the same way the overhead
+        # is: identical bare arms, paired, |ratio - 1|
+        noise = []
+        for rep in range(2):
+            w1, _ = serve_once("na", rep, None)
+            w2, _ = serve_once("nb", rep, None)
+            noise.append(abs(w1 / w2 - 1.0))
+        # deterministic per-span emit cost against the same filesystem
+        # (tight loop, single thread): the "work added" companion pin
+        mb = Tracer(os.path.join(root, "mb.jsonl"), run_id="mb")
+        t0 = time.perf_counter()
+        for i in range(1000):
+            mb.end(mb.begin("al_iter", parent=mb.user_ctx("u"),
+                            key=("u", i), user="u", epoch=i))
+        per_span_s = (time.perf_counter() - t0) / 1000.0
+        mb.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    import statistics
+
+    traced_ups = n_users / best["traced"]
+    bare_ups = n_users / best["bare"]
+    wall_median_pct = round((statistics.median(ratios) - 1.0) * 100.0, 2)
+    noise_pct = round(100.0 * max(noise), 2)
+    emit_cost_pct = round(100.0 * span_stats["n_spans"] * per_span_s
+                          / best["traced"], 3)
+    _log(f"wall A/B median {wall_median_pct:+.2f}% (the <=3% pin) at a "
+         f"measured identical-arm noise floor of ±{noise_pct}%; "
+         f"deterministic span-emit cost {emit_cost_pct}% "
+         f"({span_stats['n_spans']} spans x {per_span_s * 1e6:.0f}us / "
+         f"{best['traced']:.2f}s); traced {traced_ups:.3f} vs bare "
+         f"{bare_ups:.3f} users/s best-of-{reps}")
+    print(json.dumps({
+        "metric": f"obs_tracing_overhead_{n_users}u",
+        # the acceptance number (<= 3): median of per-rep paired
+        # traced/bare wall ratios — pairing cancels the box's slow
+        # drift; the identical-arm noise floor below gives the error bar
+        "value": wall_median_pct,
+        "unit": "%",
+        "vs_baseline": round(traced_ups / bare_ups, 4),
+        "wall_noise_floor_pct": noise_pct,
+        # capacity-independent companion: spans/run x measured us/span
+        # over traced wall (the work the tracer actually adds)
+        "span_emit_cost_pct": emit_cost_pct,
+        "span_emit_us": round(per_span_s * 1e6, 1),
+        "traced_users_per_sec": round(traced_ups, 4),
+        "untraced_users_per_sec": round(bare_ups, 4),
+        "parity_every_rep": True,  # asserted above, every rep, both arms
+        "spans_per_run": span_stats["n_spans"],
+        "spans_bytes_per_run": span_stats["bytes"],
+        "schema_valid_every_rep": True,
+        "reps": reps,
+        **_provenance(),
+    }))
+    return 0
+
+
 def run_serve_faults_suite(args_ns) -> int:
     """Crash-safe serving under a FLAKY user mix: recovered-users/sec.
 
@@ -2023,7 +2198,7 @@ def main(argv=None) -> int:
     ap.add_argument("--suite", choices=("linear", "cnn", "retrain", "fleet",
                                         "serve", "serve-fused",
                                         "serve-faults", "fabric",
-                                        "qbdc", "cnn-fleet"),
+                                        "qbdc", "cnn-fleet", "obs"),
                     default="linear",
                     help="linear: the north-star fused pool scoring; cnn: "
                          "Flax ShortChunkCNN committee inference "
@@ -2048,7 +2223,12 @@ def main(argv=None) -> int:
                          "path; cnn-fleet: users/sec + mean_device_batch "
                          "of a same-bucket CNN cohort under the stacked "
                          "cross-user device path vs per-user CNN "
-                         "dispatch (mc + qbdc, parity asserted)")
+                         "dispatch (mc + qbdc, parity asserted); obs: "
+                         "span-tracing overhead — traced vs --no-trace "
+                         "serve runs, interleaved best-of-reps, parity "
+                         "asserted every rep, spans/metrics schema-"
+                         "validated every traced rep (acceptance: "
+                         "overhead <= 3%)")
     ap.add_argument("--members", type=int, default=None,
                     help="committee size (default: 16 linear / 5 cnn)")
     ap.add_argument("--pool", type=int, default=None,
@@ -2113,6 +2293,9 @@ def main(argv=None) -> int:
         return run_fleet_suite(args_ns)
     if args_ns.suite == "serve-fused":
         return run_serve_fused_suite(args_ns)
+    if args_ns.suite == "obs":
+        # traced vs untraced serve over --users; --pool is songs per user
+        return run_obs_suite(args_ns)
     if args_ns.suite == "serve":
         # serve reuses --pool as the SMALL pool size (every 4th user 4x)
         return run_serve_suite(args_ns)

@@ -181,6 +181,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-dir", default=None,
                    help="write a jax.profiler device trace here "
                         "(TensorBoard-loadable)")
+    p.add_argument("--no-trace", action="store_true",
+                   help="fleet/serve/fabric: disable the obs span tracer "
+                        "(spans.jsonl / fabric spans_<h>.jsonl; ON by "
+                        "default — run→user→al_iter→dispatch spans with "
+                        "deterministic ids that survive eviction+resume "
+                        "and host failover; export with `python -m "
+                        "consensus_entropy_tpu.cli.report`).  The bare "
+                        "arm `bench.py --suite obs` measures against")
+    p.add_argument("--jax-profile", default=None, metavar="DIR",
+                   help="fleet/serve: capture a jax.profiler device "
+                        "trace of the first --jax-profile-n STACKED "
+                        "dispatches into DIR (steady-state hot path, "
+                        "not imports/compiles; TensorBoard/Perfetto-"
+                        "loadable)")
+    p.add_argument("--jax-profile-n", type=int, default=10, metavar="N",
+                   help="stacked dispatches to keep the jax profiler "
+                        "open for (default 10)")
     p.add_argument("--mesh", default=None, metavar="auto|N",
                    help="shard the scoring path (CNN forward + fused "
                         "mean->entropy->top-k) over a pool-axis device mesh: "
@@ -262,6 +279,19 @@ def main(argv=None) -> int:
         return 1
     if args.admit_window_ms and args.serve is None:
         print("--admit-window-ms requires --serve")
+        return 1
+    if args.jax_profile is not None and args.fleet is None \
+            and args.serve is None:
+        print("--jax-profile captures STACKED dispatches; it requires "
+              "--fleet or --serve (use --trace-dir for sequential runs)")
+        return 1
+    if args.jax_profile is not None and args.hosts is not None:
+        # fabric workers would race each other's hostname-keyed profile
+        # files in one DIR; profile a single-host --serve run instead
+        print("--jax-profile is single-process (drop --hosts)")
+        return 1
+    if args.jax_profile_n < 1:
+        print(f"--jax-profile-n must be >= 1, got {args.jax_profile_n}")
         return 1
     for flag, is_set in (("--no-serve-journal", args.no_serve_journal),
                          ("--watchdog-s", args.watchdog_s),
@@ -469,36 +499,72 @@ def main(argv=None) -> int:
     return 0
 
 
+def _build_tracer(args, cfg, path, host=None):
+    """The obs span tracer for fleet/serve/fabric drivers.  ``run_id``
+    derives from (mode, seed) — deterministic, so a restarted run and
+    every fabric worker of one CONTINUE the same traces instead of
+    forking new ids."""
+    from consensus_entropy_tpu.obs.trace import Tracer
+
+    return Tracer(path, run_id=f"{cfg.mode}-{cfg.seed}", host=host,
+                  enabled=not args.no_trace)
+
+
 def _run_users_fleet(args, cfg, paths, users, pool, anno, hc_table, store,
                      cnn_cfg, guard, results) -> None:
     """Fleet path: cohorts of ``--fleet N`` users run concurrently through
     ``fleet.FleetScheduler``; per-user workspaces/results are identical to
     the sequential path (same session generator, same seeds)."""
-    import numpy as np
-
-    from consensus_entropy_tpu.al import workspace
-    from consensus_entropy_tpu.al.loop import UserData
-    from consensus_entropy_tpu.data import amg
-    from consensus_entropy_tpu.fleet import (
-        FleetReport,
-        FleetScheduler,
-        FleetUser,
-    )
+    from consensus_entropy_tpu.fleet import FleetReport, FleetScheduler
     from consensus_entropy_tpu.fleet.report import bench_line
 
-    experiment = {"seed": cfg.seed, "queries": cfg.queries,
-                  "train_size": cfg.train_size}
     report = FleetReport(os.path.join(paths.users_dir,
                                       "fleet_metrics.jsonl"))
+    tracer = _build_tracer(args, cfg,
+                           os.path.join(paths.users_dir, "spans.jsonl"))
     scheduler = FleetScheduler(
         cfg, tie_break=args.tie_break, retrain_epochs=args.retrain_epochs,
         host_workers=args.fleet_host_workers, preemption=guard,
         pad_pool_to=args.pad_pool_to, report=report,
         stack_cnn=not args.no_stack_cnn, plan_chunk=args.plan_chunk,
-        fuse_step=not args.no_fuse_step)
+        fuse_step=not args.no_fuse_step, tracer=tracer,
+        jax_profile_dir=args.jax_profile,
+        jax_profile_n=args.jax_profile_n)
     todo = list(users[: args.max_users])
-    n_cohorts = 0
     failed = []
+    try:
+        _run_fleet_cohorts(args, cfg, paths, store, pool, anno, hc_table,
+                           cnn_cfg, scheduler, todo, results, failed)
+    finally:
+        # the run span closes even on preemption (a rerun reuses the
+        # deterministic ids, so the restarted run's span supersedes)
+        tracer.close()
+    import json
+
+    summary = report.write_summary(cohort=min(args.fleet, len(todo) or 1))
+    report.close()
+    print("fleet summary: "
+          + json.dumps(bench_line(summary), sort_keys=True))
+    if failed:
+        # parity with the sequential path, where a user's terminal error
+        # crashes the sweep with a nonzero exit — a fleet run that quietly
+        # dropped users must not look successful to CI/scripts
+        raise RuntimeError(
+            f"{len(failed)} fleet user(s) failed terminally after "
+            f"eviction/resume: {failed}")
+
+
+def _run_fleet_cohorts(args, cfg, paths, store, pool, anno, hc_table,
+                       cnn_cfg, scheduler, todo, results, failed) -> None:
+    import numpy as np
+
+    from consensus_entropy_tpu.al import workspace
+    from consensus_entropy_tpu.al.loop import UserData
+    from consensus_entropy_tpu.data import amg
+    from consensus_entropy_tpu.fleet import FleetUser
+
+    experiment = {"seed": cfg.seed, "queries": cfg.queries,
+                  "train_size": cfg.train_size}
     for lo in range(0, len(todo), args.fleet):
         cohort = todo[lo: lo + args.fleet]
         entries = []
@@ -526,7 +592,6 @@ def _run_users_fleet(args, cfg, paths, users, pool, anno, hc_table, store,
                                      committee_factory=factory))
         if not entries:
             continue
-        n_cohorts += 1
         print(f"Fleet cohort of {len(entries)} users "
               f"({lo}..{lo + len(cohort) - 1} of {len(todo)})")
         for rec in scheduler.run(entries):
@@ -541,18 +606,6 @@ def _run_users_fleet(args, cfg, paths, users, pool, anno, hc_table, store,
             results.append(rec["result"])
             print(f"user {rec['user']}: final mean F1 = "
                   f"{rec['result']['final_mean_f1']:.4f}")
-    import json
-
-    summary = report.write_summary(cohort=min(args.fleet, len(todo) or 1))
-    print("fleet summary: "
-          + json.dumps(bench_line(summary), sort_keys=True))
-    if failed:
-        # parity with the sequential path, where a user's terminal error
-        # crashes the sweep with a nonzero exit — a fleet run that quietly
-        # dropped users must not look successful to CI/scripts
-        raise RuntimeError(
-            f"{len(failed)} fleet user(s) failed terminally after "
-            f"eviction/resume: {failed}")
 
 
 def _run_users_serve(args, cfg, paths, users, pool, anno, hc_table, store,
@@ -598,11 +651,15 @@ def _run_users_serve(args, cfg, paths, users, pool, anno, hc_table, store,
         compact_bytes=args.journal_compact_kb * 1024 or None)
     poison = PoisonList(os.path.join(paths.users_dir,
                                      "serve_poison.jsonl"))
+    tracer = _build_tracer(args, cfg,
+                           os.path.join(paths.users_dir, "spans.jsonl"))
     scheduler = FleetScheduler(
         cfg, tie_break=args.tie_break, retrain_epochs=args.retrain_epochs,
         host_workers=args.fleet_host_workers, report=report,
         scoring_by_width=True, stack_cnn=not args.no_stack_cnn,
-        plan_chunk=args.plan_chunk, fuse_step=not args.no_fuse_step)
+        plan_chunk=args.plan_chunk, fuse_step=not args.no_fuse_step,
+        tracer=tracer, jax_profile_dir=args.jax_profile,
+        jax_profile_n=args.jax_profile_n)
     server = FleetServer(
         scheduler,
         ServeConfig(target_live=args.serve,
@@ -674,7 +731,9 @@ def _run_users_serve(args, cfg, paths, users, pool, anno, hc_table, store,
     try:
         server.serve(source(), on_result=on_result)
     finally:
+        tracer.close()
         summary = report.write_summary(cohort=args.serve)
+        report.close()
         print("serve summary: "
               + json.dumps(bench_line(summary), sort_keys=True))
         if summary.get("users_failed") or len(poison):
@@ -787,16 +846,24 @@ def _run_users_fabric(args, cfg, paths, users, guard) -> None:
         finally:
             log.close()  # the child holds its own fd
 
+    # the coordinator's tracer owns users/spans.jsonl; worker span WALs
+    # (fabric/spans_<h>.jsonl) are transcribed into it, so the merged
+    # fleet timeline lives next to the merged metrics
+    tracer = _build_tracer(args, cfg,
+                           os.path.join(paths.users_dir, "spans.jsonl"),
+                           host="coordinator")
     coord = FabricCoordinator(
         journal, fabric_dir,
         FabricConfig(hosts=args.hosts, lease_s=args.lease_s),
-        poison=poison, report=report, preemption=guard)
+        poison=poison, report=report, preemption=guard, tracer=tracer)
     try:
         summary = coord.run([str(u) for u in users[: args.max_users]],
                             spawn)
     finally:
+        tracer.close()
         journal.close()
         poison.close()
+        report.close()
     print("fabric summary: " + json.dumps(
         {"users": summary["users"], "finished": len(summary["finished"]),
          "failed": len(summary["failed"]),
@@ -827,18 +894,26 @@ def _run_users_fabric_worker(args, cfg, paths, users, pool, anno,
         FleetUser,
     )
     from consensus_entropy_tpu.serve import ServeConfig
-    from consensus_entropy_tpu.serve.hosts import run_worker
+    from consensus_entropy_tpu.serve.hosts import fabric_paths, run_worker
 
     experiment = {"seed": cfg.seed, "queries": cfg.queries,
                   "train_size": cfg.train_size}
     by_id = {str(u): u for u in users}
     report = FleetReport(os.path.join(
         paths.users_dir, f"fleet_metrics_{args.fabric_worker}.jsonl"))
+    # per-host span WAL, tailed + transcribed by the coordinator; the
+    # shared deterministic run_id keeps failed-over users' trace ids
+    # continuous across hosts
+    tracer = _build_tracer(
+        args, cfg,
+        fabric_paths(args.fabric_dir, args.fabric_worker)["spans"],
+        host=args.fabric_worker)
     scheduler = FleetScheduler(
         cfg, tie_break=args.tie_break, retrain_epochs=args.retrain_epochs,
         host_workers=args.fleet_host_workers, report=report,
         scoring_by_width=True, stack_cnn=not args.no_stack_cnn,
-        plan_chunk=args.plan_chunk, fuse_step=not args.no_fuse_step)
+        plan_chunk=args.plan_chunk, fuse_step=not args.no_fuse_step,
+        tracer=tracer)
 
     def build_entry(uid):
         u_id = by_id.get(uid, uid)
@@ -873,19 +948,27 @@ def _run_users_fabric_worker(args, cfg, paths, users, pool, anno,
         print(f"user {rec['user']}: final mean F1 = "
               f"{rec['result']['final_mean_f1']:.4f}")
 
-    run_worker(
-        args.fabric_dir, args.fabric_worker, build_entry=build_entry,
-        scheduler=scheduler,
-        config=ServeConfig(
-            target_live=args.serve,
-            admit_window_s=args.admit_window_ms / 1000.0,
-            bucket_widths=args._bucket_widths,
-            watchdog_s=args.watchdog_s,
-            failure_budget=args.failure_budget,
-            breaker_threshold=args.breaker_threshold,
-            breaker_cooldown_s=args.breaker_cooldown_s,
-            breaker_probes=args.breaker_probes),
-        on_result=on_result, lease_s=args.lease_s, preemption=guard)
+    try:
+        run_worker(
+            args.fabric_dir, args.fabric_worker, build_entry=build_entry,
+            scheduler=scheduler,
+            config=ServeConfig(
+                target_live=args.serve,
+                admit_window_s=args.admit_window_ms / 1000.0,
+                bucket_widths=args._bucket_widths,
+                watchdog_s=args.watchdog_s,
+                failure_budget=args.failure_budget,
+                breaker_threshold=args.breaker_threshold,
+                breaker_cooldown_s=args.breaker_cooldown_s,
+                breaker_probes=args.breaker_probes),
+            on_result=on_result, lease_s=args.lease_s, preemption=guard)
+    finally:
+        tracer.close()
+        # the per-host fleet_summary carries THIS host's admission→finish
+        # latency histogram — the fabric shape of the SLO telemetry the
+        # report CLI merges per host
+        report.write_summary(cohort=args.serve)
+        report.close()
 
 
 def _run_users(args, cfg, paths, users, pool, anno, hc_table, store,

@@ -28,18 +28,29 @@ sessions of that dispatch's OWN bucket.
 
 from __future__ import annotations
 
-import json
-import os
 import threading
 import time
 
-from consensus_entropy_tpu.utils.profiling import RollingStat
+from consensus_entropy_tpu.obs.metrics import EventWriter, MetricsRegistry
 
 #: fn keys of the CNN device-plan dispatches (stored-committee / qbdc
 #: probs producers and the cohort retrain) — rolled up separately in the
 #: summary so the CNN cohort's ``mean_device_batch`` / occupancy are
 #: regression-pinned exactly like the sklearn stacked path's
 CNN_DISPATCH_FNS = ("cnn_probs", "qbdc_probs", "cnn_retrain", "cnn_eval")
+
+
+def _dispatch_rollup(ds: list[dict]) -> dict:
+    """The shared per-group dispatch aggregation (used for per-bucket,
+    per-CNN-fn and combined roll-ups alike): dispatch count, mean batch,
+    and occupancy against the slots active at each dispatch."""
+    per = [d["batch"] / d["active"] for d in ds if d["active"]]
+    return {
+        "dispatches": len(ds),
+        "mean_batch": round(sum(d["batch"] for d in ds) / len(ds), 2)
+        if ds else None,
+        "occupancy": round(sum(per) / len(per), 3) if per else None,
+    }
 
 
 class FleetReport:
@@ -59,22 +70,30 @@ class FleetReport:
         self.phase_totals: dict[str, float] = {}
         self.users_done = 0
         self.users_failed = 0
+        #: the obs metrics registry this report's stats live in — every
+        #: fleet_metrics.jsonl line now flows through ONE schema-tagged
+        #: writer (obs.metrics.EventWriter, schema: 2) instead of
+        #: per-append file opens
+        self.metrics = MetricsRegistry()
+        self.writer = EventWriter(jsonl_path)
         #: serve-layer admission telemetry (empty outside serve mode)
-        self.queue_depth = RollingStat()
-        self.admission_wait = RollingStat()
+        self.queue_depth = self.metrics.rolling("queue_depth")
+        self.admission_wait = self.metrics.rolling("admission_wait_s")
+        #: per-user admission→finish latency (first admit → user_done /
+        #: terminal failure) — log-bucketed histogram with exact
+        #: p50/p95/p99, the SLO-admission prerequisite
+        self.admission_latency = self.metrics.histogram(
+            "admission_to_finish_s")
+        self._admit_t: dict[str, float] = {}
         self._t0 = time.perf_counter()
         self._lock = threading.Lock()
-        if jsonl_path:
-            os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
 
     # -- recording ---------------------------------------------------------
 
     def _emit(self, rec: dict) -> None:
         with self._lock:
             self.events.append(rec)
-            if self.jsonl_path:
-                with open(self.jsonl_path, "a") as f:
-                    f.write(json.dumps(rec) + "\n")
+            self.writer.emit(rec)
 
     def dispatch(self, fn_key: str, batch: int, active: int,
                  wall_s: float, width: int | None = None,
@@ -118,13 +137,24 @@ class FleetReport:
         with self._lock:
             self.admission_wait.add(wait_s)
             self.queue_depth.add(depth)
+            # first admit starts the user's admission→finish latency
+            # clock; backoff re-admissions continue the original one (the
+            # user-observed latency includes its failures)
+            self._admit_t.setdefault(str(user), time.perf_counter())
         self.event("admit", user=str(user), width=width,
                    wait_s=round(wait_s, 4), depth=depth, live=live)
+
+    def _finish_latency(self, user) -> None:
+        with self._lock:
+            t = self._admit_t.pop(str(user), None)
+            if t is not None:
+                self.admission_latency.add(time.perf_counter() - t)
 
     def user_done(self, user, result: dict, phases: dict) -> None:
         """A session finished; ``phases`` are its summed ``{phase}_s``
         durations (from the session's ``StepTimer`` records)."""
         self.users_done += 1
+        self._finish_latency(user)
         for k, v in phases.items():
             self.phase_totals[k] = self.phase_totals.get(k, 0.0) + v
         self.event("user_done", user=str(user),
@@ -139,6 +169,7 @@ class FleetReport:
         the result record, so an operator tailing ``fleet_metrics.jsonl``
         sees WHY a user dropped."""
         self.users_failed += 1
+        self._finish_latency(user)
         rec = {"user": str(user), "error": error}
         if attempts is not None:
             rec["attempts"] = attempts
@@ -156,9 +187,7 @@ class FleetReport:
         (perfect phase alignment); 1/active = fully serialized (the
         sequential shape).  Finished/evicted sessions stopped counting
         when their generator returned (see module docstring)."""
-        per = [d["batch"] / d["active"] for d in self.dispatches
-               if d["active"]]
-        return sum(per) / len(per) if per else None
+        return _dispatch_rollup(self.dispatches)["occupancy"]
 
     @property
     def per_bucket_occupancy(self) -> dict | None:
@@ -170,16 +199,7 @@ class FleetReport:
                 buckets.setdefault(d["width"], []).append(d)
         if not buckets:
             return None
-        out = {}
-        for w, ds in sorted(buckets.items()):
-            per = [d["batch"] / d["active"] for d in ds if d["active"]]
-            out[w] = {
-                "dispatches": len(ds),
-                "mean_batch": round(sum(d["batch"] for d in ds) / len(ds),
-                                    2),
-                "occupancy": round(sum(per) / len(per), 3) if per else None,
-            }
-        return out
+        return {w: _dispatch_rollup(ds) for w, ds in sorted(buckets.items())}
 
     @property
     def transfer_summary(self) -> dict | None:
@@ -232,23 +252,15 @@ class FleetReport:
         cnn = [d for d in self.dispatches if d["fn"] in CNN_DISPATCH_FNS]
         if not cnn:
             return None
-        out = {"dispatches": len(cnn),
-               "mean_device_batch": round(
-                   sum(d["batch"] for d in cnn) / len(cnn), 2)}
-        per_all = [d["batch"] / d["active"] for d in cnn if d["active"]]
-        if per_all:
-            out["occupancy"] = round(sum(per_all) / len(per_all), 3)
+        combined = _dispatch_rollup(cnn)
+        out = {"dispatches": combined["dispatches"],
+               "mean_device_batch": combined["mean_batch"]}
+        if combined["occupancy"] is not None:
+            out["occupancy"] = combined["occupancy"]
         for fn in CNN_DISPATCH_FNS:
             ds = [d for d in cnn if d["fn"] == fn]
-            if not ds:
-                continue
-            per = [d["batch"] / d["active"] for d in ds if d["active"]]
-            out[fn] = {
-                "dispatches": len(ds),
-                "mean_batch": round(sum(d["batch"] for d in ds) / len(ds),
-                                    2),
-                "occupancy": round(sum(per) / len(per), 3) if per else None,
-            }
+            if ds:
+                out[fn] = _dispatch_rollup(ds)
         return out
 
     def summary(self, *, cohort: int, wall_s: float | None = None) -> dict:
@@ -304,6 +316,11 @@ class FleetReport:
             out["admissions"] = self.admission_wait.n
             out["admission_wait_s"] = self.admission_wait.snapshot()
             out["queue_depth"] = self.queue_depth.snapshot()
+        if self.admission_latency.n:
+            # per-user admission→finish latency (exact p50/p95/p99 while
+            # the reservoir holds) — the SLO planner's input; absent
+            # outside serve mode so fleet summaries stay byte-stable
+            out["admission_to_finish_s"] = self.admission_latency.snapshot()
         return out
 
     def write_summary(self, *, cohort: int, wall_s: float | None = None) -> dict:
@@ -311,6 +328,11 @@ class FleetReport:
         s = self.summary(cohort=cohort, wall_s=wall_s)
         self._emit({"event": "fleet_summary", **s})
         return s
+
+    def close(self) -> None:
+        """Release the event writer's file handle (flushed per record
+        throughout, so closing is hygiene, not durability)."""
+        self.writer.close()
 
 
 def bench_line(summary: dict, *, baseline_users_per_sec: float | None = None,
@@ -336,6 +358,8 @@ def bench_line(summary: dict, *, baseline_users_per_sec: float | None = None,
         line["cnn"] = summary["cnn"]
     if summary.get("transfer") is not None:
         line["transfer"] = summary["transfer"]
+    if summary.get("admission_to_finish_s") is not None:
+        line["admission_to_finish_s"] = summary["admission_to_finish_s"]
     for key in ("watchdog_evictions", "breaker_trips", "dispatch_failures",
                 "requeues", "users_poisoned"):
         if summary.get(key):

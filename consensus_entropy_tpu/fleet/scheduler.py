@@ -72,9 +72,10 @@ from consensus_entropy_tpu.fleet.session import (
     ScoreStep,
     UserSession,
 )
+from consensus_entropy_tpu.obs.metrics import StepTimer
+from consensus_entropy_tpu.obs.trace import NULL_TRACER
 from consensus_entropy_tpu.ops import scoring as ops_scoring
 from consensus_entropy_tpu.resilience import faults
-from consensus_entropy_tpu.utils.profiling import StepTimer
 
 
 @dataclasses.dataclass
@@ -135,7 +136,9 @@ class FleetScheduler:
                  scoring_by_width: bool = False,
                  watchdog=None, breaker=None, on_terminal=None,
                  stack_cnn: bool = True, plan_chunk: int | None = None,
-                 fuse_step: bool = True):
+                 fuse_step: bool = True, tracer=None,
+                 jax_profile_dir: str | None = None,
+                 jax_profile_n: int = 10):
         self.config = config
         self.tie_break = tie_break
         self.retrain_epochs = retrain_epochs
@@ -208,6 +211,20 @@ class FleetScheduler:
         #: of window buys near-full cohort batches — measured occupancy
         #: 0.17→1.0 at cohort 6 with a 10 ms window.
         self.batch_window_s = batch_window_s
+        #: obs span tracer (``obs.trace.Tracer``): sessions open their
+        #: user/al_iter spans through it, the scheduler adds the
+        #: dispatch-side spans (stacked score/retrain dispatches under
+        #: the run context, pooled host steps under the owning session's
+        #: current iteration).  NULL (zero-cost) unless a driver installs
+        #: one — ``--no-trace`` keeps it NULL.
+        self.tracer = NULL_TRACER if tracer is None else tracer
+        #: optional ``jax.profiler.trace`` hook: start the device profiler
+        #: at the FIRST stacked dispatch and stop it after
+        #: ``jax_profile_n`` of them, so the captured window is the
+        #: steady-state stacked hot path, not imports and compiles
+        self._jax_profile_dir = jax_profile_dir
+        self._jax_profile_left = jax_profile_n if jax_profile_dir else 0
+        self._jax_profiling = False
         self._opened = False
 
     # -- engine lifecycle --------------------------------------------------
@@ -334,6 +351,9 @@ class FleetScheduler:
         draining pool, never strands a pending two-phase commit."""
         self._shutdown_host_pool()
         self._ckpt_pool.shutdown(wait=True)
+        if self._jax_profiling:  # fewer than N stacked dispatches ran
+            jax.profiler.stop_trace()
+            self._jax_profiling = False
         self._opened = False
 
     def _shutdown_host_pool(self) -> None:
@@ -370,7 +390,7 @@ class FleetScheduler:
             pad_pool_to=pad, timer=timer,
             preemption=self.preemption, ckpt_executor=self._ckpt_pool,
             pin_pad=pin_pad, cnn_steps=self.stack_cnn,
-            fuse_step=self.fuse_step)
+            fuse_step=self.fuse_step, tracer=self.tracer)
         st = _SessionState(entry, session, session.steps(), pad=pad,
                            n_pad=session.acq.n_pad)
         return st
@@ -403,7 +423,27 @@ class FleetScheduler:
             # yield, under the same batch-window/host-drain policy
             self._score_wait.append((state, step))
         else:
-            fut = self._host_pool.submit(step.fn)
+            fn = step.fn
+            if self.tracer.enabled:
+                # span the pooled host block under the session's CURRENT
+                # iteration context (read here, while the generator is
+                # suspended — the single-writer contract makes it stable
+                # for the worker thread's lifetime).  Checkpoint
+                # boundaries get their own span name; deterministic keys
+                # ((user, epoch, label)) make a re-run after eviction
+                # re-emit the same id.
+                uid = str(state.entry.user_id)
+                name = ("checkpoint" if step.label == "checkpoint"
+                        else "host_step")
+                ctx = state.session.trace_ctx
+                key = (uid, state.session.trace_epoch, step.label)
+
+                def fn(fn=step.fn, name=name, ctx=ctx, key=key, uid=uid,
+                       label=step.label or "host"):
+                    with self.tracer.span(name, parent=ctx, key=key,
+                                          user=uid, label=label):
+                        return fn()
+            fut = self._host_pool.submit(fn)
             self._host_wait[fut] = (state, step)
             if self.watchdog is not None:
                 self.watchdog.arm(state, step.label or "host")
@@ -463,6 +503,8 @@ class FleetScheduler:
                 if k.endswith("_s"):
                     phases[k] = phases.get(k, 0.0) + v
         self.report.user_done(state.entry.user_id, result, phases)
+        self.tracer.close_user(str(state.entry.user_id),
+                               resumes=state.resumes)
         self._results[id(state.entry)] = {
             "user": state.entry.user_id, "result": result,
             "committee": state.session.committee,
@@ -506,8 +548,9 @@ class FleetScheduler:
         failure is recorded exactly as before."""
         if self.on_terminal is not None \
                 and self.on_terminal(entry, error, resumes):
-            return
+            return  # re-admitted later: the user span stays open
         self.report.user_failed(entry.user_id, error, attempts=resumes + 1)
+        self.tracer.close_user(str(entry.user_id), error=error)
         self._results[id(entry)] = {
             "user": entry.user_id, "result": None, "committee": None,
             "resumes": resumes, "error": error}
@@ -632,7 +675,7 @@ class FleetScheduler:
         single = []   # (group, width, fn_key): per-user dispatch rounds
         pending = []  # launched stacked reduction dispatches, in flight
 
-        def grade(fn_key, batch, width, wall, h2d=None):
+        def grade(fn_key, batch, width, wall, h2d=None, w0=None):
             # width tags only BUCKETED dispatches: a plain fleet cohort
             # is one width by construction and its summaries/BENCH
             # artifacts must not grow a per-bucket section
@@ -644,6 +687,16 @@ class FleetScheduler:
                 wall,
                 width=width if self.scoring_by_width else None,
                 h2d_bytes=h2d_bytes, h2d_ops=h2d_ops)
+            if w0 is not None and self.tracer.enabled:
+                # dispatch spans parent the RUN context (one span serves
+                # N users) on a per-bucket lane; retrain dispatches keep
+                # their own span name per the obs hierarchy
+                self.tracer.span_at(
+                    "retrain" if fn_key == "cnn_retrain"
+                    else "score_dispatch",
+                    w0, w0 + wall, parent=self.tracer.run_ctx, fn=fn_key,
+                    width=width if self.scoring_by_width else None,
+                    batch=batch)
 
         for group in rounds:
             width = group[0][0].n_pad
@@ -659,6 +712,7 @@ class FleetScheduler:
             if not use_stacked:
                 single.append((group, width, fn_key))
                 continue
+            w0 = time.time()
             t0 = time.perf_counter()
             if isinstance(step0, DeviceStep):
                 try:
@@ -673,7 +727,7 @@ class FleetScheduler:
                             == "close":
                         self.report.event("breaker_close", width=width)
                     grade(fn_key, len(group), width,
-                          time.perf_counter() - t0)
+                          time.perf_counter() - t0, w0=w0)
                 continue
             try:
                 batched, h2d = self._stacked_call(fn_key, width, group)
@@ -685,17 +739,19 @@ class FleetScheduler:
                 # remaining buckets stack/launch, which must not be
                 # charged to this dispatch
                 pending.append((group, width, fn_key,
-                                time.perf_counter() - t0, batched, h2d))
-        for group, width, fn_key, wall, batched, h2d in pending:
+                                time.perf_counter() - t0, batched, h2d,
+                                w0))
+        for group, width, fn_key, wall, batched, h2d, w0 in pending:
             if self.breaker is not None \
                     and self.breaker.record_success(width) == "close":
                 self.report.event("breaker_close", width=width)
-            grade(fn_key, len(group), width, wall, h2d)
+            grade(fn_key, len(group), width, wall, h2d, w0=w0)
             out.extend(self._result_rows(batched, group))
         # per-user dispatch: singletons, open-breaker (degraded)
         # buckets, and the stacked-failure fallback
         for group, width, fn_key in single:
             for st, step in group:
+                w0 = time.time()
                 t0 = time.perf_counter()
                 try:
                     res = self._single_call(step)
@@ -711,11 +767,12 @@ class FleetScheduler:
                 out.append((st, res))
                 wall = time.perf_counter() - t0
                 if isinstance(step, DeviceStep):
-                    grade(fn_key, 1, width, wall)
+                    grade(fn_key, 1, width, wall, w0=w0)
                 else:
                     b1, o1 = self._h2d(step.inputs)
                     b2, o2 = step.session.acq.take_h2d()
-                    grade(fn_key, 1, width, wall, (b1 + b2, o1 + o2))
+                    grade(fn_key, 1, width, wall, (b1 + b2, o1 + o2),
+                          w0=w0)
         return out
 
     def _stacked_call(self, fn_key: str, width: int, group: list):
@@ -750,10 +807,12 @@ class FleetScheduler:
                         batch=len(group))
             return self._group_fns(width)[fn_key](*stacked)
 
+        self._profile_start()
         try:
             batched = (self.watchdog.call(dispatch,
                                           f"dispatch {fn_key}@{width}")
                        if self.watchdog is not None else dispatch())
+            self._profile_tick()
         except BaseException:
             # the uploads happened regardless — put the drained counters
             # back so the per-user fallback's grading still reports them
@@ -791,9 +850,11 @@ class FleetScheduler:
                         batch=len(group))
             return committee_mod.stage_device_plans(plans)
 
+        self._profile_start()
         computed = (self.watchdog.call(dispatch,
                                        f"dispatch {fn_key}@{width}")
                     if self.watchdog is not None else dispatch())
+        self._profile_tick()
         results = committee_mod.commit_device_plans(plans, computed)
         return [(st, res) for (st, _), res in zip(group, results)]
 
@@ -817,6 +878,23 @@ class FleetScheduler:
         if self.watchdog is not None:
             return self.watchdog.call(dispatch, f"dispatch {fn_key}x1")
         return dispatch()
+
+    def _profile_start(self) -> None:
+        """Start ``jax.profiler`` at the first stacked dispatch (see the
+        ``_jax_profile_dir`` attribute note)."""
+        if self._jax_profile_left and not self._jax_profiling:
+            jax.profiler.start_trace(self._jax_profile_dir)
+            self._jax_profiling = True
+
+    def _profile_tick(self) -> None:
+        """One stacked dispatch completed under the profiler; stop after
+        the configured count so the capture stays bounded."""
+        if not self._jax_profiling:
+            return
+        self._jax_profile_left -= 1
+        if self._jax_profile_left <= 0:
+            jax.profiler.stop_trace()
+            self._jax_profiling = False
 
     def _note_stacked_failure(self, fn_key: str, width: int,
                               exc: Exception) -> None:

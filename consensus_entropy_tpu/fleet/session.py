@@ -55,8 +55,9 @@ from consensus_entropy_tpu.al.acquisition import Acquirer
 from consensus_entropy_tpu.al.reporting import UserReport
 from consensus_entropy_tpu.config import ALConfig
 from consensus_entropy_tpu.labels import one_hot_np
+from consensus_entropy_tpu.obs.metrics import StepTimer
+from consensus_entropy_tpu.obs.trace import NULL_TRACER
 from consensus_entropy_tpu.parallel import multihost
-from consensus_entropy_tpu.utils.profiling import StepTimer
 
 
 @dataclasses.dataclass
@@ -152,7 +153,8 @@ class UserSession:
                  pad_pool_to: int | None = None, resume: bool = True,
                  timer: StepTimer | None = None, preemption=None,
                  ckpt_executor=None, pin_pad: int | None = None,
-                 cnn_steps: bool = True, fuse_step: bool = True):
+                 cnn_steps: bool = True, fuse_step: bool = True,
+                 tracer=None):
         from consensus_entropy_tpu.al.loop import AsyncCheckpointer
 
         cfg = config
@@ -162,6 +164,19 @@ class UserSession:
         self.user_path = user_path
         self.seed = cfg.seed if seed is None else seed
         self.timer = timer or StepTimer(None)
+        #: obs tracer (NULL outside traced fleet/serve runs).  The user
+        #: root span opens idempotently — in serve mode the server already
+        #: opened it at first enqueue, and a session rebuilt after
+        #: eviction/restart re-derives the SAME deterministic ids, so the
+        #: user's trace continues instead of forking.
+        self.tracer = NULL_TRACER if tracer is None else tracer
+        #: the CURRENT iteration's span context/epoch: written only by the
+        #: generator between yields (single-writer contract), read by the
+        #: scheduler when it services this session's steps on other
+        #: threads
+        self.trace_ctx = None
+        self.trace_epoch = None
+        self.tracer.open_user(str(data.user_id))
         self.preemption = preemption
         self.retrain_epochs = retrain_epochs
         self.mesh = mesh
@@ -492,8 +507,19 @@ class UserSession:
                     report.quarantine_event(epoch, ev)
                 return events
 
+            uid = str(data.user_id)
+            uctx = self.tracer.user_ctx(uid)
             if self._fresh:
-                # epoch 0: baseline evaluation (amg_test.py:398-418)
+                # epoch 0: baseline evaluation (amg_test.py:398-418).
+                # Iteration spans use begin/end (not a with-block): a
+                # session killed or evicted mid-iteration leaves the span
+                # UNWRITTEN, and the resumed attempt — which re-runs the
+                # iteration — re-derives the same deterministic id and
+                # writes it, so children journaled before the fault are
+                # never orphaned (tests/test_obs.py pins this).
+                ictx = self.tracer.begin("al_iter", parent=uctx,
+                                         key=(uid, -1), user=uid, epoch=-1)
+                self.trace_ctx, self.trace_epoch = ictx, -1
                 report.epoch_header(-1)
                 self.key, sub = jax.random.split(self.key)
 
@@ -556,6 +582,11 @@ class UserSession:
                     yield HostStep(self, boundary0, "checkpoint")
                 else:
                     boundary0()
+                # the span closes BEFORE the preemption boundary: a clean
+                # preempt-after-checkpoint resumes at the NEXT iteration,
+                # which would otherwise never re-write this one's span
+                self.tracer.end(ictx)
+                self.trace_ctx = self.trace_epoch = None
                 self._preempt_check("baseline evaluation")
 
             for epoch in range(self.start_epoch, cfg.epochs):
@@ -563,6 +594,10 @@ class UserSession:
                 live = acq.remaining_songs
                 if len(live) == 0:
                     break
+                ictx = self.tracer.begin("al_iter", parent=uctx,
+                                         key=(uid, epoch), user=uid,
+                                         epoch=epoch)
+                self.trace_ctx, self.trace_epoch = ictx, epoch
                 member_probs = None
                 merge_probs = None  # plan path: deferred probs producer
                 strat = acq.strategy
@@ -879,6 +914,8 @@ class UserSession:
                     yield HostStep(self, boundary, "checkpoint")
                 else:
                     boundary()
+                self.tracer.end(ictx, queried=len(q_songs))
+                self.trace_ctx = self.trace_epoch = None
                 self._preempt_check(f"iteration {epoch}")
 
             result = {"user": data.user_id, "mode": cfg.mode,

@@ -1,0 +1,37 @@
+"""Unified observability: span tracing, metrics registry, export.
+
+One subsystem for the telemetry the serving stack grew piecemeal
+(``RollingStat``/``StepTimer`` in ``utils.profiling``, hand-rolled
+dispatch records in ``fleet.scheduler``, per-host ``fleet_metrics.jsonl``
+files nobody merged):
+
+- :mod:`obs.metrics` — counters/gauges/log-bucketed histograms behind a
+  named registry, the ``StepTimer``/``RollingStat`` primitives (moved
+  here; ``utils.profiling`` keeps thin aliases), and the single
+  schema-tagged JSONL event writer every metrics stream goes through.
+- :mod:`obs.trace` — a :class:`~obs.trace.Tracer` with explicit span
+  contexts (``run → user → al_iter → {score_dispatch, host_step,
+  retrain, checkpoint, admission_wait}``); trace/span ids derive
+  deterministically from ``(run_id, user, iteration)`` so a resumed or
+  failed-over user CONTINUES its trace instead of starting a new one.
+- :mod:`obs.export` — torn-tail-tolerant readers, schema-v2 validation,
+  the multi-host spans+metrics merge, Chrome trace-event export
+  (Perfetto-loadable, one lane per host/worker/bucket) and the text
+  report behind ``python -m consensus_entropy_tpu.cli.report``.
+"""
+
+from consensus_entropy_tpu.obs.metrics import (  # noqa: F401
+    SCHEMA_VERSION,
+    Counter,
+    EventWriter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    RollingStat,
+    StepTimer,
+)
+from consensus_entropy_tpu.obs.trace import (  # noqa: F401
+    NULL_TRACER,
+    SpanContext,
+    Tracer,
+)

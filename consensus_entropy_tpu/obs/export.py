@@ -1,0 +1,290 @@
+"""Export + report: torn-tail-safe readers, schema validation, the
+multi-host merge, Chrome trace-event export and the text report.
+
+All pure host code (json + os only): the ``report`` CLI subcommand and
+``scripts/obs_check.sh`` run it without touching a jax backend.
+
+Chrome trace output loads in Perfetto (or ``chrome://tracing``): one
+process lane per HOST, one thread lane per user / bucket / run within it.
+Span records come from ``spans.jsonl`` (single-host / coordinator-
+transcribed) and ``fabric/spans_<h>.jsonl`` (per-worker WALs); the merge
+dedupes by deterministic span id — a resumed user's re-run iteration
+keeps its completed attempt, a transcribed duplicate collapses — keeping
+the LONGEST duration per id (a partially-written eviction span loses to
+the completed re-run).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+#: schema-v2 event table: event kind -> fields every record of that kind
+#: must carry (beyond ``schema``/``event``; ``t_s`` is required for all
+#: but the summary records, which close a stream rather than timestamp a
+#: transition).  README "Observability" renders this as the docs table.
+EVENT_FIELDS = {
+    # admission flow
+    "enqueue": ("user", "depth"),
+    "admit": ("user", "width", "wait_s", "depth", "live"),
+    "user_done": ("user",),
+    "user_failed": ("user", "error"),
+    "skip_done": ("user",),
+    "skip_poisoned": ("user",),
+    # engine lifecycle
+    "evict": ("user", "error"),
+    "resume": ("user", "attempt"),
+    "watchdog_evict": ("user",),
+    "dispatch_failed": ("fn", "width"),
+    "dispatch_session_error": ("user", "fn"),
+    # fault domain
+    "breaker_open": ("width",),
+    "breaker_close": ("width",),
+    "breaker_probe": ("width",),
+    "breaker_giveup": ("width",),
+    "requeue": ("user", "attempt"),
+    "requeue_reload_failed": ("user",),
+    "poison": ("user",),
+    "drain": (),
+    "journal_recover": (),
+    # fabric
+    "assign": ("user", "host"),
+    "host_up": ("host",),
+    "host_down": ("host",),
+    "orphan_reaped": ("host",),
+    "drain_kill": ("host",),
+    "user_finished": ("user",),
+    "user_poisoned": ("user",),
+    "user_failed_final": ("user",),
+    # stream-closing summaries (no t_s)
+    "fleet_summary": (),
+    "fabric_summary": (),
+}
+
+#: events that close a stream instead of timestamping a transition
+_SUMMARY_EVENTS = ("fleet_summary", "fabric_summary")
+
+
+def read_jsonl_tolerant(path: str) -> list[dict]:
+    """Read a JSONL telemetry file, SKIPPING a torn tail line (the
+    expected SIGKILL artifact — the same discipline ``serve.journal``
+    applies to its WALs) and any other unparseable line, instead of
+    raising.  Non-dict lines are dropped too."""
+    out: list[dict] = []
+    if not os.path.exists(path):
+        return out
+    with open(path, "rb") as f:
+        for raw in f:
+            try:
+                rec = json.loads(raw.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                continue  # torn/corrupt line: telemetry, not a ledger
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def find_metrics_files(users_dir: str) -> list[str]:
+    """``fleet_metrics.jsonl`` plus the per-host
+    ``fleet_metrics_<h>.jsonl`` files a fabric run leaves."""
+    return sorted(glob.glob(os.path.join(users_dir,
+                                         "fleet_metrics*.jsonl")))
+
+
+def find_span_files(users_dir: str) -> list[str]:
+    """``spans.jsonl`` (single-host, or the coordinator's transcription)
+    plus any per-worker ``fabric/spans_<h>.jsonl`` WALs."""
+    return sorted(
+        glob.glob(os.path.join(users_dir, "spans*.jsonl"))
+        + glob.glob(os.path.join(users_dir, "fabric", "spans_*.jsonl")))
+
+
+def validate_metrics(records: list[dict], *, path: str = "") -> list[str]:
+    """Schema-v2 validation; returns human-readable error strings (empty
+    = valid).  Every line must be a tagged dict with a known event and
+    that event's required fields; non-summary events must carry ``t_s``.
+    """
+    errors = []
+    where = f"{path}:" if path else "line "
+    for i, rec in enumerate(records, 1):
+        ev = rec.get("event")
+        if rec.get("schema") != 2:
+            errors.append(f"{where}{i}: missing/wrong schema tag "
+                          f"(want 2, got {rec.get('schema')!r})")
+            continue
+        if ev not in EVENT_FIELDS:
+            errors.append(f"{where}{i}: unknown event {ev!r}")
+            continue
+        if ev not in _SUMMARY_EVENTS \
+                and not isinstance(rec.get("t_s"), (int, float)):
+            errors.append(f"{where}{i}: event {ev!r} lacks numeric t_s")
+        for field in EVENT_FIELDS[ev]:
+            if field not in rec:
+                errors.append(f"{where}{i}: event {ev!r} lacks {field!r}")
+    return errors
+
+
+def validate_metrics_file(path: str) -> list[str]:
+    return validate_metrics(read_jsonl_tolerant(path), path=path)
+
+
+def load_spans(paths: list[str]) -> list[dict]:
+    """Merge span files into one deduped timeline, sorted by ``t0``.
+    Dedupe key is the deterministic ``(trace, span)`` id; the longest
+    duration wins (see module docstring)."""
+    best: dict[tuple, dict] = {}
+    for path in paths:
+        for rec in read_jsonl_tolerant(path):
+            if rec.get("ev") != "span":
+                continue
+            key = (rec.get("trace"), rec.get("span"))
+            prev = best.get(key)
+            if prev is None or (rec.get("dur_s") or 0) \
+                    > (prev.get("dur_s") or 0):
+                best[key] = rec
+    return sorted(best.values(), key=lambda r: (r.get("t0") or 0))
+
+
+def orphan_spans(spans: list[dict]) -> list[dict]:
+    """Spans whose ``parent`` id is absent from the merged set — the
+    determinism contract says a healthy (resumed-to-completion) run has
+    none."""
+    ids = {r.get("span") for r in spans}
+    return [r for r in spans
+            if r.get("parent") is not None and r["parent"] not in ids]
+
+
+def _lane_of(rec: dict) -> str:
+    """The Chrome-trace thread lane: users own their session spans,
+    stacked device work rides per-bucket lanes, the run span its own."""
+    name = rec.get("name")
+    if name == "run":
+        return "run"
+    if rec.get("user") is not None:
+        return f"user {rec['user']}"
+    if name in ("score_dispatch", "retrain"):
+        width = rec.get("width")
+        return f"bucket {width}" if width is not None else "dispatch"
+    return "dispatch"
+
+
+def chrome_trace(spans: list[dict]) -> dict:
+    """Render merged spans as Chrome trace-event JSON (Perfetto-loadable):
+    complete (``ph: "X"``) events on one process per host and one thread
+    per user/bucket/run lane, with metadata naming events."""
+    pids: dict[str, int] = {}
+    tids: dict[tuple, int] = {}
+    events = []
+    for rec in spans:
+        host = rec.get("host") or "local"
+        if host not in pids:
+            pids[host] = len(pids) + 1
+            events.append({"name": "process_name", "ph": "M",
+                           "pid": pids[host], "tid": 0,
+                           "args": {"name": f"host {host}"}})
+        lane = _lane_of(rec)
+        tkey = (host, lane)
+        if tkey not in tids:
+            tids[tkey] = len(tids) + 1
+            events.append({"name": "thread_name", "ph": "M",
+                           "pid": pids[host], "tid": tids[tkey],
+                           "args": {"name": lane}})
+        args = {k: v for k, v in rec.items()
+                if k not in ("ev", "name", "t0", "dur_s", "host")}
+        events.append({
+            "name": rec.get("name") or "span", "cat": "obs", "ph": "X",
+            "ts": int(round((rec.get("t0") or 0) * 1e6)),
+            "dur": max(int(round((rec.get("dur_s") or 0) * 1e6)), 1),
+            "pid": pids[host], "tid": tids[tkey], "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _host_of_metrics_path(path: str) -> str:
+    base = os.path.basename(path)
+    if base == "fleet_metrics.jsonl":
+        return "main"
+    return base[len("fleet_metrics_"):-len(".jsonl")] or "main"
+
+
+def merged_summary(users_dir: str) -> dict:
+    """One fleet view over every host's metrics stream: the last
+    ``fleet_summary`` per file, keyed by host, plus fleet-wide roll-ups
+    (users done/failed, admission→finish latency per host — the fabric
+    shape of the SLO telemetry)."""
+    per_host = {}
+    for path in find_metrics_files(users_dir):
+        recs = read_jsonl_tolerant(path)
+        summaries = [r for r in recs if r.get("event") == "fleet_summary"]
+        if not summaries:
+            continue
+        per_host[_host_of_metrics_path(path)] = summaries[-1]
+    out = {
+        "hosts": sorted(per_host),
+        "users_done": sum(s.get("users_done") or 0
+                          for s in per_host.values()),
+        "users_failed": sum(s.get("users_failed") or 0
+                            for s in per_host.values()),
+        "per_host": per_host,
+        "admission_to_finish_s": {
+            h: s["admission_to_finish_s"] for h, s in per_host.items()
+            if s.get("admission_to_finish_s") is not None},
+    }
+    return out
+
+
+def text_report(users_dir: str) -> str:
+    """The operator text report: per-phase wall-clock breakdown, dispatch
+    occupancy, h2d traffic and admission→finish latency percentiles, per
+    host, from the merged metrics + spans."""
+    lines = [f"observability report — {users_dir}"]
+    merged = merged_summary(users_dir)
+    if not merged["per_host"]:
+        lines.append("  (no fleet_summary found in any "
+                     "fleet_metrics*.jsonl)")
+    for host in merged["hosts"]:
+        s = merged["per_host"][host]
+        lines.append(f"[{host}] users_done={s.get('users_done')} "
+                     f"failed={s.get('users_failed')} "
+                     f"wall_s={s.get('wall_s')} "
+                     f"users/s={s.get('users_per_sec')}")
+        phases = s.get("phase_wall_s") or {}
+        total = sum(phases.values()) or 1.0
+        for k, v in sorted(phases.items(), key=lambda kv: -kv[1]):
+            lines.append(f"    {k:<16} {v:>9.3f}s "
+                         f"({100.0 * v / total:5.1f}%)")
+        lines.append(f"    dispatches={s.get('score_dispatches')} "
+                     f"occupancy={s.get('occupancy')} "
+                     f"mean_batch={s.get('mean_device_batch')}")
+        if s.get("transfer") is not None:
+            t = s["transfer"]
+            lines.append(f"    h2d_bytes={t.get('h2d_bytes')} "
+                         f"({t.get('h2d_bytes_per_select')}/select), "
+                         f"h2d_ops={t.get('h2d_ops')}, "
+                         f"device_calls/select="
+                         f"{t.get('device_calls_per_select')}")
+        lat = s.get("admission_to_finish_s")
+        if lat is not None:
+            lines.append(f"    admission→finish p50={lat.get('p50')}s "
+                         f"p95={lat.get('p95')}s p99={lat.get('p99')}s "
+                         f"(n={lat.get('n')})")
+    spans = load_spans(find_span_files(users_dir))
+    if spans:
+        by_name: dict[str, list[float]] = {}
+        hosts = set()
+        for r in spans:
+            by_name.setdefault(r.get("name") or "span", []).append(
+                r.get("dur_s") or 0.0)
+            hosts.add(r.get("host") or "local")
+        lines.append(f"spans: {len(spans)} across {len(hosts)} host(s)")
+        for name, durs in sorted(by_name.items(),
+                                 key=lambda kv: -sum(kv[1])):
+            lines.append(f"    {name:<16} n={len(durs):<5} "
+                         f"total={sum(durs):9.3f}s "
+                         f"mean={sum(durs) / len(durs):8.4f}s")
+        orphans = orphan_spans(spans)
+        if orphans:
+            lines.append(f"    WARNING: {len(orphans)} orphan span(s) "
+                         "(parent id never written)")
+    return "\n".join(lines)

@@ -1,0 +1,331 @@
+"""Metrics primitives + the named registry + the one JSONL event writer.
+
+Everything here is pure host code (no jax): importable from CLI tooling,
+report scripts and fabric workers alike.
+
+- :class:`StepTimer` / :class:`RollingStat` moved here verbatim from
+  ``utils.profiling`` (which keeps thin aliases so existing imports and
+  ``tests/test_profiling.py`` stay valid).
+- :class:`Counter` / :class:`Gauge` / :class:`Histogram` are the new
+  registry metrics.  The histogram is LOG-bucketed for bounded state on
+  unbounded streams, but keeps an exact sample reservoir up to
+  ``max_samples`` — while the reservoir holds, ``percentile`` is exact
+  (numpy ``linear`` interpolation, pinned against numpy in
+  ``tests/test_obs.py``); past it, percentiles fall back to bucket upper
+  edges (conservative for latency reporting, flagged by ``exact=False``
+  in the snapshot).
+- :class:`MetricsRegistry` name-keys metric instances so the serving
+  stack's telemetry is declared in one place and snapshots as one dict.
+- :class:`EventWriter` is the single writer every ``fleet_metrics.jsonl``
+  line now goes through: thread-safe, line-buffered (flush per record,
+  no fsync — telemetry, not a WAL; readers tolerate a torn tail, see
+  ``obs.export.read_jsonl_tolerant``), and tags each record with
+  ``schema: 2``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import os
+import threading
+import time
+
+#: the fleet_metrics.jsonl / spans.jsonl line-format version.  v1 was the
+#: untagged PR 2-8 stream; v2 adds the tag itself, the admission→finish
+#: latency histogram in summaries, and the span records (see README
+#: "Observability" for the event table).
+SCHEMA_VERSION = 2
+
+
+class StepTimer:
+    """Accumulates named phase durations; one JSONL record per flush.
+
+    Usage::
+
+        timer = StepTimer(path)           # or StepTimer(None): in-memory
+        with timer.phase("score"):
+            ...
+        timer.flush(epoch=3)              # writes {"epoch": 3, "score_s": ...}
+    """
+
+    def __init__(self, jsonl_path: str | None = None):
+        self.jsonl_path = jsonl_path
+        self._acc: dict[str, float] = {}
+        self.records: list[dict] = []
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._acc[name] = (self._acc.get(name, 0.0)
+                               + time.perf_counter() - t0)
+
+    def add(self, name: str, seconds: float) -> None:
+        """Accumulate an externally measured duration into the current
+        record (e.g. a background thread's self-timed work — such phases
+        OVERLAP the foreground ones and must not be summed into iteration
+        wall-clock)."""
+        self._acc[name] = self._acc.get(name, 0.0) + seconds
+
+    def flush(self, **labels) -> dict:
+        """Close the current record: labels + ``{phase}_s`` durations."""
+        rec = dict(labels)
+        rec.update({f"{k}_s": round(v, 6) for k, v in self._acc.items()})
+        self._acc = {}
+        self.records.append(rec)
+        if self.jsonl_path:
+            with open(self.jsonl_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        return rec
+
+
+class RollingStat:
+    """Streaming count/mean/min/max/last aggregator for unbounded event
+    streams (serve-layer queue depth, admission wait): a long-running
+    admission service cannot keep every sample the way :class:`StepTimer`
+    keeps per-iteration records, so this folds each observation into O(1)
+    state and snapshots to a compact dict for the metrics stream."""
+
+    __slots__ = ("n", "total", "min", "max", "last")
+
+    def __init__(self):
+        self.n = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self.last = None
+
+    def add(self, value: float) -> None:
+        v = float(value)
+        self.n += 1
+        self.total += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        self.last = v
+
+    @property
+    def mean(self) -> float | None:
+        return self.total / self.n if self.n else None
+
+    def snapshot(self, ndigits: int = 4) -> dict | None:
+        """``{"n", "mean", "min", "max", "last"}``, or ``None`` before the
+        first observation (absent beats a row of nulls in JSONL)."""
+        if not self.n:
+            return None
+        return {"n": self.n, "mean": round(self.mean, ndigits),
+                "min": round(self.min, ndigits),
+                "max": round(self.max, ndigits),
+                "last": round(self.last, ndigits)}
+
+
+class Counter:
+    """Monotonic event count."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+    def snapshot(self):
+        return self.value
+
+
+class Gauge:
+    """Last-observed value (queue depth, live sessions)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = None
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+    def snapshot(self):
+        return self.value
+
+
+class Histogram:
+    """Log-bucketed histogram with an exact reservoir (see module doc).
+
+    ``growth``: geometric bucket ratio (default ``2**0.25`` — 4 buckets
+    per doubling, <= 19% worst-case edge error past the reservoir).
+    ``max_samples``: exact-percentile reservoir bound; the log buckets
+    keep accumulating forever either way, so the fallback path loses
+    resolution, never observations."""
+
+    def __init__(self, *, growth: float = 2 ** 0.25,
+                 max_samples: int = 4096):
+        if growth <= 1.0:
+            raise ValueError(f"growth must be > 1, got {growth}")
+        self.growth = growth
+        self.max_samples = max_samples
+        self.n = 0
+        self.total = 0.0
+        self.min: float | None = None
+        self.max: float | None = None
+        self._log_g = math.log(growth)
+        self._buckets: dict[int, int] = {}
+        self._samples: list[float] | None = []
+
+    #: bucket index for values <= 0 (latencies shouldn't produce them,
+    #: but a clock hiccup must not crash the metrics path)
+    _NONPOS = -(10 ** 9)
+
+    def _index(self, v: float) -> int:
+        if v <= 0.0:
+            return self._NONPOS
+        return math.floor(math.log(v) / self._log_g + 1e-9)
+
+    def add(self, value: float) -> None:
+        v = float(value)
+        self.n += 1
+        self.total += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        i = self._index(v)
+        self._buckets[i] = self._buckets.get(i, 0) + 1
+        if self._samples is not None:
+            if len(self._samples) < self.max_samples:
+                self._samples.append(v)
+            else:
+                self._samples = None  # reservoir spent: buckets only
+
+    @property
+    def exact(self) -> bool:
+        """True while every observation is still in the reservoir."""
+        return self._samples is not None
+
+    @property
+    def mean(self) -> float | None:
+        return self.total / self.n if self.n else None
+
+    def percentile(self, q: float) -> float | None:
+        """The ``q``-th percentile (0..100).  Exact (numpy ``linear``
+        interpolation) while the reservoir holds; otherwise the upper
+        edge of the log bucket containing the rank — an upper bound on
+        the true quantile, the conservative direction for latency SLOs.
+        """
+        if not self.n:
+            return None
+        if self._samples is not None:
+            s = sorted(self._samples)
+            rank = (q / 100.0) * (len(s) - 1)
+            lo = math.floor(rank)
+            hi = math.ceil(rank)
+            frac = rank - lo
+            # numpy's "linear" lerp, branch included (t >= 0.5 computes
+            # from the upper point), so the result is BIT-identical to
+            # np.percentile — pinned in tests/test_obs.py
+            diff = s[hi] - s[lo]
+            if frac >= 0.5:
+                return s[hi] - diff * (1.0 - frac)
+            return s[lo] + diff * frac
+        rank = math.ceil((q / 100.0) * self.n)
+        cum = 0
+        for i in sorted(self._buckets):
+            cum += self._buckets[i]
+            if cum >= max(rank, 1):
+                if i == self._NONPOS:
+                    return float(self.min)
+                return min(self.growth ** (i + 1), float(self.max))
+        return float(self.max)
+
+    def snapshot(self, ndigits: int = 4) -> dict | None:
+        """Compact summary for the metrics stream: ``n``/``mean``/``min``/
+        ``max`` plus p50/p95/p99 (``None`` before the first observation).
+        ``exact`` is flagged only when False — the common in-reservoir
+        case stays byte-lean."""
+        if not self.n:
+            return None
+        out = {"n": self.n, "mean": round(self.mean, ndigits),
+               "min": round(self.min, ndigits),
+               "max": round(self.max, ndigits),
+               "p50": round(self.percentile(50), ndigits),
+               "p95": round(self.percentile(95), ndigits),
+               "p99": round(self.percentile(99), ndigits)}
+        if not self.exact:
+            out["exact"] = False
+        return out
+
+
+class MetricsRegistry:
+    """Name-keyed metric instances; get-or-create, type-checked.
+
+    One registry per report/driver — the names are the declaration
+    surface (``registry.snapshot()`` is the whole telemetry state), and
+    getting an existing name with a different kind fails loudly instead
+    of silently forking the stream."""
+
+    def __init__(self):
+        self._metrics: dict[str, object] = {}
+
+    def _get(self, name: str, cls, **kw):
+        m = self._metrics.get(name)
+        if m is None:
+            m = cls(**kw)
+            self._metrics[name] = m
+        elif type(m) is not cls:
+            raise TypeError(f"metric {name!r} is {type(m).__name__}, "
+                            f"not {cls.__name__}")
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str, **kw) -> Histogram:
+        return self._get(name, Histogram, **kw)
+
+    def rolling(self, name: str) -> RollingStat:
+        return self._get(name, RollingStat)
+
+    def snapshot(self) -> dict:
+        return {name: m.snapshot() for name, m in
+                sorted(self._metrics.items())}
+
+
+class EventWriter:
+    """The one JSONL event writer (thread-safe, schema-tagged).
+
+    ``path=None`` keeps the interface with no I/O.  The handle opens
+    lazily and stays open (flush per record, NO fsync: this is telemetry
+    — a torn tail after SIGKILL is an expected artifact the readers skip,
+    ``obs.export.read_jsonl_tolerant``)."""
+
+    def __init__(self, path: str | None, schema: int = SCHEMA_VERSION):
+        self.path = path
+        self.schema = schema
+        self._f = None
+        self._lock = threading.Lock()
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def emit(self, rec: dict) -> dict:
+        """Write one record (``schema`` prepended unless already present);
+        returns the record as written."""
+        if "schema" not in rec:
+            rec = {"schema": self.schema, **rec}
+        if self.path is not None:
+            line = (json.dumps(rec) + "\n").encode("utf-8")
+            with self._lock:
+                if self._f is None:
+                    self._f = open(self.path, "ab")
+                self._f.write(line)
+                self._f.flush()
+        return rec
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None

@@ -1,0 +1,321 @@
+"""Span tracing with explicit contexts and deterministic ids.
+
+The span hierarchy mirrors the serving stack::
+
+    run ── user ── al_iter ── {host_step, checkpoint}
+     │      └──── admission_wait            (serve mode: enqueue→admit)
+     └──── {score_dispatch, retrain}        (stacked: one span, N users)
+
+**Determinism is the recovery story.**  Trace ids derive from
+``(run_id, user)`` and the user/iteration span ids from
+``(run_id, user, iteration)``, so a session rebuilt after eviction,
+serve-journal restart or fabric worker-SIGKILL failover CONTINUES its
+trace: the resumed attempt re-emits the SAME span ids for the re-run
+iteration, and the merge (``obs.export.load_spans``) dedupes by id,
+keeping the completed attempt.  An iteration interrupted mid-flight
+leaves its span unwritten — never torn — and its already-written children
+reference a parent id the resumed attempt is guaranteed to write, so the
+merged trace has no orphans (pinned in ``tests/test_obs.py``).
+
+**Threading.**  Contexts are EXPLICIT (passed as ``parent=``), never
+ambient: the fleet scheduler services one session's steps on worker
+threads while the session generator is suspended, so thread-local context
+propagation would attribute spans to whichever session last ran on the
+thread.  The writer is the shared :class:`~obs.metrics.EventWriter`
+(thread-safe, flush per record, torn tails tolerated by readers).
+
+**Cost.**  A span is one dict + one buffered JSON line; the serving
+stack emits a handful per user-iteration.  ``enabled=False`` (the
+``--no-trace`` arm) short-circuits every call — the overhead bound is
+measured by ``bench.py --suite obs``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import threading
+import time
+
+from consensus_entropy_tpu.obs.metrics import EventWriter
+
+
+def _digest(*parts) -> str:
+    h = hashlib.sha1("\x1f".join(str(p) for p in parts).encode("utf-8"))
+    return h.hexdigest()[:16]
+
+
+def trace_id(run_id: str, user=None) -> str:
+    """The deterministic trace id: one per (run, user), or the run's own
+    when ``user`` is None."""
+    return _digest("trace", run_id) if user is None \
+        else _digest("trace", run_id, str(user))
+
+
+class SpanContext:
+    """An addressable span: ``(trace, span)`` id pair, passed explicitly
+    as ``parent=`` to child spans.  Hashable/immutable."""
+
+    __slots__ = ("trace", "span")
+
+    def __init__(self, trace: str, span: str):
+        self.trace = trace
+        self.span = span
+
+    def __repr__(self):
+        return f"SpanContext({self.trace}/{self.span})"
+
+
+class _OpenSpan:
+    """Handle returned by :meth:`Tracer.begin`; usable as ``parent=``
+    directly (it carries its context)."""
+
+    __slots__ = ("ctx", "name", "t0", "attrs")
+
+    def __init__(self, ctx: SpanContext, name: str, t0: float, attrs: dict):
+        self.ctx = ctx
+        self.name = name
+        self.t0 = t0
+        self.attrs = attrs
+
+
+def _ctx_of(parent) -> SpanContext | None:
+    if parent is None:
+        return None
+    return parent.ctx if isinstance(parent, _OpenSpan) else parent
+
+
+class Tracer:
+    """Emit spans to a JSONL sink (``spans.jsonl`` / ``spans_<h>.jsonl``).
+
+    ``run_id``: the deterministic run identity — the CLI derives it from
+    ``(mode, seed)`` so a restarted run (and every fabric worker of one)
+    continues the same traces.  ``host``: tag for multi-host lanes.
+    ``path=None`` keeps spans in memory only (``records``); ``enabled=
+    False`` is the zero-cost ``--no-trace`` arm.
+    """
+
+    def __init__(self, path: str | None = None, *, run_id: str = "run",
+                 host: str | None = None, enabled: bool = True):
+        self.enabled = enabled
+        self.run_id = run_id
+        self.host = host
+        #: in-memory span mirror, kept ONLY for path=None tracers (unit
+        #: tests, embedded drivers): a file-backed tracer on a long-lived
+        #: server must not grow an unbounded list beside its sink
+        self.records: list[dict] = []
+        self._keep_records = path is None
+        #: approximate seconds spent INSIDE the tracer (id derivation +
+        #: record build + buffered write), summed across threads — the
+        #: capacity-independent overhead pin ``bench.py --suite obs``
+        #: reports, since this box's wall-clock noise floor (±3-8%
+        #: run-to-run) swamps a sub-1% true cost.  Non-atomic
+        #: accumulation: concurrent updates may drop a few µs.
+        self.cost_s = 0.0
+        self._writer = EventWriter(path if enabled else None)
+        self._lock = threading.Lock()
+        self._auto = 0
+        #: open user root spans: span id -> (ctx, t0, attrs); idempotent
+        #: open keeps the EARLIEST t0 (serve mode opens at first enqueue)
+        self._open_users: dict[str, tuple] = {}
+        self.run_ctx = SpanContext(trace_id(run_id),
+                                   _digest("span", run_id, "run"))
+        self._run_t0 = time.time()
+
+    # -- id derivation (pure) ---------------------------------------------
+
+    def user_ctx(self, user) -> SpanContext | None:
+        """The deterministic user-root context — derivable WITHOUT the
+        session (the serve layer parents ``admission_wait`` spans under
+        it before any session exists)."""
+        if not self.enabled:
+            return None
+        return SpanContext(trace_id(self.run_id, user),
+                           _digest("span", self.run_id, "user", str(user)))
+
+    def _child_ctx(self, name: str, parent: SpanContext | None,
+                   key) -> SpanContext:
+        trace = parent.trace if parent is not None else self.run_ctx.trace
+        if key is None:
+            # run-scoped, non-replayable span (a stacked dispatch): unique
+            # within and across (possibly restarted) runs — host + the
+            # tracer's own start instant salt the counter
+            with self._lock:
+                self._auto += 1
+                key = f"auto:{self.host}:{self._run_t0:.6f}:{self._auto}"
+        return SpanContext(trace, _digest("span", self.run_id, name, key))
+
+    # -- emission ----------------------------------------------------------
+
+    def _emit(self, rec: dict) -> None:
+        if self._keep_records:
+            self.records.append(rec)
+        self._writer.emit(rec)
+
+    def _span_rec(self, ctx: SpanContext, parent: SpanContext | None,
+                  name: str, t0: float, t1: float, attrs: dict) -> dict:
+        rec = {"ev": "span", "trace": ctx.trace, "span": ctx.span,
+               "parent": parent.span if parent is not None else None,
+               "name": name, "t0": round(t0, 6),
+               "dur_s": round(max(t1 - t0, 0.0), 6)}
+        if self.host is not None:
+            rec["host"] = self.host
+        rec.update(attrs)
+        return rec
+
+    # -- span lifecycle ----------------------------------------------------
+
+    def begin(self, name: str, *, parent=None, key=None,
+              **attrs) -> _OpenSpan | None:
+        """Open a span WITHOUT a context manager (generator code that
+        suspends across the span's lifetime).  An opened-but-never-ended
+        span is simply not written — deterministic keys make the re-run
+        write it (see module docstring)."""
+        if not self.enabled:
+            return None
+        c0 = time.perf_counter()
+        parent = _ctx_of(parent)
+        ctx = self._child_ctx(name, parent, key)
+        sp = _OpenSpan(ctx, name, time.time(), attrs)
+        sp.attrs["_parent"] = parent
+        self.cost_s += time.perf_counter() - c0
+        return sp
+
+    def end(self, span: _OpenSpan | None, **attrs) -> None:
+        if span is None or not self.enabled:
+            return
+        c0 = time.perf_counter()
+        a = dict(span.attrs)
+        parent = a.pop("_parent", None)
+        a.update(attrs)
+        self._emit(self._span_rec(span.ctx, parent, span.name, span.t0,
+                                  time.time(), a))
+        self.cost_s += time.perf_counter() - c0
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, parent=None, key=None, **attrs):
+        """Context-manager span; yields the child's :class:`SpanContext`
+        for further nesting.  Written on exit (exceptions included — the
+        partial duration is still telemetry)."""
+        if not self.enabled:
+            yield None
+            return
+        sp = self.begin(name, parent=parent, key=key, **attrs)
+        try:
+            yield sp.ctx
+        finally:
+            self.end(sp)
+
+    def span_at(self, name: str, t0: float, t1: float, *, parent=None,
+                key=None, **attrs) -> None:
+        """Record a span retroactively from measured wall-clock endpoints
+        (admission waits, already-timed dispatches)."""
+        if not self.enabled:
+            return
+        c0 = time.perf_counter()
+        parent = _ctx_of(parent)
+        ctx = self._child_ctx(name, parent, key)
+        self._emit(self._span_rec(ctx, parent, name, t0, t1, attrs))
+        self.cost_s += time.perf_counter() - c0
+
+    # -- user root spans ---------------------------------------------------
+
+    def open_user(self, user, *, t0: float | None = None, **attrs) -> None:
+        """Idempotently open the user's root span (keyed by its
+        deterministic id): the serve layer opens it at first enqueue, the
+        session constructor opens it defensively — whichever ran first
+        owns ``t0``, so admission waits nest inside the user span."""
+        if not self.enabled:
+            return
+        c0 = time.perf_counter()
+        ctx = self.user_ctx(user)
+        with self._lock:
+            if ctx.span not in self._open_users:
+                self._open_users[ctx.span] = (
+                    ctx, time.time() if t0 is None else t0,
+                    {"user": str(user), **attrs})
+        self.cost_s += time.perf_counter() - c0
+
+    def user_open_t0(self, user) -> float | None:
+        """The open user root span's start time (None when not open) —
+        lets the serve layer clamp an ``admission_wait`` span measured
+        from the queue's own (earlier) timestamp inside its parent."""
+        if not self.enabled:
+            return None
+        ctx = self.user_ctx(user)
+        with self._lock:
+            rec = self._open_users.get(ctx.span)
+        return rec[1] if rec is not None else None
+
+    def close_user(self, user, **attrs) -> None:
+        """Write the user root span (no-op if never/no-longer open —
+        a re-admitted user's span stays open across attempts)."""
+        if not self.enabled:
+            return
+        c0 = time.perf_counter()
+        ctx = self.user_ctx(user)
+        with self._lock:
+            open_rec = self._open_users.pop(ctx.span, None)
+        if open_rec is not None:
+            _ctx, t0, a = open_rec
+            a.update(attrs)
+            self._emit(self._span_rec(ctx, self.run_ctx, "user", t0,
+                                      time.time(), a))
+        self.cost_s += time.perf_counter() - c0
+
+    # -- transcription (fabric coordinator) --------------------------------
+
+    def transcribe(self, rec: dict, *, host: str | None = None) -> None:
+        """Re-emit a span record tailed from another host's span WAL into
+        this tracer's sink (the coordinator merging worker spans the way
+        it transcribes event WALs).  At-least-once is fine: ids are
+        deterministic and the merge dedupes."""
+        if not self.enabled or rec.get("ev") != "span":
+            return
+        rec = dict(rec)
+        if host is not None and "host" not in rec:
+            rec["host"] = host
+        self._emit(rec)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self, **attrs) -> None:
+        """Write the run span (covering the tracer's lifetime) plus any
+        still-open user spans (flagged ``open``: failed users whose close
+        never came), then close the sink."""
+        if self.enabled:
+            with self._lock:
+                leftovers = list(self._open_users.items())
+                self._open_users.clear()
+            for _sid, (ctx, t0, a) in leftovers:
+                self._emit(self._span_rec(ctx, self.run_ctx, "user", t0,
+                                          time.time(),
+                                          {**a, "open": True}))
+            self._emit(self._span_rec(
+                self.run_ctx, None, "run", self._run_t0, time.time(),
+                {"run_id": self.run_id, **attrs}))
+        self._writer.close()
+
+    def __enter__(self) -> "Tracer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+#: the shared no-op tracer (``--no-trace``, sequential drivers, tests
+#: that don't care) — every call short-circuits on ``enabled``
+NULL_TRACER = Tracer(None, enabled=False)
+
+
+@contextlib.contextmanager
+def device_trace(trace_dir: str | None):
+    """``jax.profiler.trace`` when a directory is given; no-op otherwise
+    (moved from ``utils.profiling.trace``; that alias remains)."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield

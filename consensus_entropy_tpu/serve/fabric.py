@@ -108,6 +108,9 @@ class HostHandle:
     spawned_t: float
     alive: bool = True
     closed: bool = False  # close sentinel sent (clean rc=0 expected)
+    #: tail of the worker's ``spans_<h>.jsonl`` (None when the
+    #: coordinator runs untraced)
+    span_tail: JsonlTail | None = None
 
 
 class FabricCoordinator:
@@ -127,7 +130,7 @@ class FabricCoordinator:
     def __init__(self, journal, fabric_dir: str, config: FabricConfig, *,
                  poison: PoisonList | None = None,
                  report: FleetReport | None = None, on_poll=None,
-                 preemption=None):
+                 preemption=None, tracer=None):
         if journal.path is None:
             raise ValueError("the fabric journal must be file-backed — it "
                              "is the coordinator's source of truth")
@@ -144,6 +147,11 @@ class FabricCoordinator:
         #: ``Preempted`` surfaces so the CLI exits 75 with every queued
         #: user durable in the journal for the rerun
         self.preemption = preemption
+        #: optional ``obs.trace.Tracer``: worker span WALs
+        #: (``fabric/spans_<h>.jsonl``) are tailed and transcribed into
+        #: this tracer's own sink — the span-side sibling of the event
+        #: transcription, so one merged file holds the fleet timeline
+        self.tracer = tracer
         self.hosts: dict[str, HostHandle] = {}
         self.reassignments = 0
         self.revocations = 0
@@ -200,6 +208,7 @@ class FabricCoordinator:
                 for h in list(self.hosts.values()):
                     if h.alive:
                         self._transcribe(h)
+                        self._transcribe_spans(h)
                 self._check_hosts()
                 if not self._unresolved:
                     break
@@ -230,6 +239,8 @@ class FabricCoordinator:
                             pid=getattr(proc, "pid", None))
         h = HostHandle(host_id, proc, _AppendFsyncFile(paths["assign"]),
                        tail, paths["lease"], time.time())
+        if self.tracer is not None and self.tracer.enabled:
+            h.span_tail = JsonlTail(paths["spans"])
         self.hosts[host_id] = h
         self.report.event("host_up", host=host_id,
                           pid=getattr(proc, "pid", None))
@@ -308,6 +319,7 @@ class FabricCoordinator:
         except Exception:
             pass
         self._transcribe(h)
+        self._transcribe_spans(h)
         self.journal.append("revoke", host=h.host_id, reason=reason)
         self.revocations += 1
         victims = [u for u in self.journal.state.assigned_to(h.host_id)
@@ -339,8 +351,11 @@ class FabricCoordinator:
                     except Exception:
                         pass
                 self._transcribe(h)
+                self._transcribe_spans(h)
             h.assign.close()
             h.tail.close()
+            if h.span_tail is not None:
+                h.span_tail.close()
 
     def _preempt_drain(self) -> None:
         """SIGTERM each worker (its own guard drains: in-flight sessions
@@ -372,6 +387,7 @@ class FabricCoordinator:
                 except Exception:
                     pass
             self._transcribe(h)
+            self._transcribe_spans(h)
         raise Preempted(
             f"fabric drained: {len(self._unresolved)} user(s) left "
             "journaled for the rerun")
@@ -447,6 +463,16 @@ class FabricCoordinator:
             # worker-local enqueue/requeue records are flow bookkeeping,
             # not dispositions the fabric needs — skipped (their bytes
             # are covered by the next transcribed record's cursor)
+
+    def _transcribe_spans(self, h: HostHandle) -> None:
+        """Fold the host's span WAL into the coordinator's tracer sink.
+        The cursor is in-memory only (spans are telemetry, not a ledger):
+        a coordinator restart re-reads from 0 and the deterministic span
+        ids collapse the duplicates at merge time."""
+        if h.span_tail is None:
+            return
+        for rec, _off in h.span_tail.poll():
+            self.tracer.transcribe(rec, host=h.host_id)
 
     # -- summary -----------------------------------------------------------
 

@@ -62,6 +62,10 @@ def fabric_paths(fabric_dir: str, host_id: str) -> dict:
         "events": os.path.join(fabric_dir, f"events_{host_id}.jsonl"),
         "lease": os.path.join(fabric_dir, f"lease_{host_id}.json"),
         "log": os.path.join(fabric_dir, f"log_{host_id}.txt"),
+        # the worker's span WAL (obs.trace.Tracer sink) — the coordinator
+        # tails + transcribes it like the event WAL; span ids are
+        # deterministic, so at-least-once transcription merges clean
+        "spans": os.path.join(fabric_dir, f"spans_{host_id}.jsonl"),
     }
 
 

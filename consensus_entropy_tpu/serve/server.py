@@ -310,6 +310,10 @@ class FleetServer:
         depth = self.queue.put(entry)
         self._journal("enqueue", entry.user_id)
         self.report.enqueued(entry.user_id, depth)
+        # the user's root span opens at FIRST enqueue (idempotent), so
+        # admission waits nest inside it; the scheduler closes it when
+        # the user resolves
+        self.scheduler.tracer.open_user(str(entry.user_id))
         return depth
 
     def _skip(self, entry: FleetUser) -> bool:
@@ -465,6 +469,7 @@ class FleetServer:
                     return src_live
                 self._journal("enqueue", self._spill.user_id)
                 self.report.enqueued(self._spill.user_id, depth)
+                self.scheduler.tracer.open_user(str(self._spill.user_id))
                 self._spill = None
             if not src_live or len(self.queue) >= want:
                 return src_live
@@ -500,10 +505,26 @@ class FleetServer:
                 self._admitted_ids.add(id(entry))
                 self._admitted.append(entry)
             self._pending.add(id(entry))
+            wait_s = time.perf_counter() - t_enq
             self.report.admitted(
-                entry.user_id, width=width,
-                wait_s=time.perf_counter() - t_enq,
+                entry.user_id, width=width, wait_s=wait_s,
                 depth=len(self.queue), live=sched.n_live)
+            tracer = sched.tracer
+            if tracer.enabled:
+                # the queue wait as a span under the user's root — keyed
+                # by attempt so backoff re-admissions each show their
+                # wait.  The queue stamps entries BEFORE the root span
+                # opens, so clamp the span start inside its parent
+                # (strict nesting is an export invariant).
+                now = time.time()
+                t0 = now - wait_s
+                root_t0 = tracer.user_open_t0(uid)
+                if root_t0 is not None:
+                    t0 = max(t0, root_t0)
+                tracer.span_at(
+                    "admission_wait", t0, now,
+                    parent=tracer.user_ctx(uid),
+                    key=(uid, self._attempts[uid]), user=uid, width=width)
 
     def _admit_due_requeues(self) -> None:
         """Move backoff re-admissions whose delay elapsed back into the
@@ -523,6 +544,7 @@ class FleetServer:
                 continue
             self._journal("enqueue", entry.user_id)
             self.report.enqueued(entry.user_id, depth)
+            self.scheduler.tracer.open_user(str(entry.user_id))
         self._requeue = still
 
     def _on_terminal(self, entry: FleetUser, error: str,

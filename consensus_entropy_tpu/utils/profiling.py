@@ -1,119 +1,19 @@
-"""Tracing / profiling: per-phase step timers + jax.profiler integration.
+"""Thin aliases over :mod:`consensus_entropy_tpu.obs` (the unified
+observability subsystem).
 
-The reference's only observability is wall-clock prints inside the CNN
-training loop (``deam_classifier.py:294-297``); there is no tracing at all
-(SURVEY.md §5).  Here:
-
-- :class:`StepTimer` — named-phase wall timing with a structured JSONL sink;
-  the AL loop times score / update-host / retrain-cnn / evaluate per
-  iteration, which is exactly the north-star metric surface (pool-scoring
-  wall-clock per iteration).
-- :func:`trace` — context manager around ``jax.profiler`` producing a
-  TensorBoard-loadable device trace when a directory is given, a no-op
-  otherwise (so call sites need no conditionals).
-
-Timers measure *host-observed* wall time; device work launched inside a
-phase is included only up to dispatch unless the phase ends with a blocking
-consume, which the AL loop's phases do (numpy conversions / host metrics).
+The profiling primitives grew up here (PR 2-8: ``StepTimer`` behind every
+per-iteration timing record, ``RollingStat`` behind the serve admission
+telemetry, ``trace`` around whole sequential runs) and then moved into
+``obs.metrics`` / ``obs.trace`` when tracing+metrics became one
+subsystem.  This module keeps the import surface stable — existing call
+sites and ``tests/test_profiling.py`` are untouched — but new code
+should import from :mod:`consensus_entropy_tpu.obs` directly.
 """
 
 from __future__ import annotations
 
-import contextlib
-import json
-import time
-
-
-class StepTimer:
-    """Accumulates named phase durations; one JSONL record per flush.
-
-    Usage::
-
-        timer = StepTimer(path)           # or StepTimer(None): in-memory
-        with timer.phase("score"):
-            ...
-        timer.flush(epoch=3)              # writes {"epoch": 3, "score_s": ...}
-    """
-
-    def __init__(self, jsonl_path: str | None = None):
-        self.jsonl_path = jsonl_path
-        self._acc: dict[str, float] = {}
-        self.records: list[dict] = []
-
-    @contextlib.contextmanager
-    def phase(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self._acc[name] = (self._acc.get(name, 0.0)
-                               + time.perf_counter() - t0)
-
-    def add(self, name: str, seconds: float) -> None:
-        """Accumulate an externally measured duration into the current
-        record (e.g. a background thread's self-timed work — such phases
-        OVERLAP the foreground ones and must not be summed into iteration
-        wall-clock)."""
-        self._acc[name] = self._acc.get(name, 0.0) + seconds
-
-    def flush(self, **labels) -> dict:
-        """Close the current record: labels + ``{phase}_s`` durations."""
-        rec = dict(labels)
-        rec.update({f"{k}_s": round(v, 6) for k, v in self._acc.items()})
-        self._acc = {}
-        self.records.append(rec)
-        if self.jsonl_path:
-            with open(self.jsonl_path, "a") as f:
-                f.write(json.dumps(rec) + "\n")
-        return rec
-
-
-class RollingStat:
-    """Streaming count/mean/min/max/last aggregator for unbounded event
-    streams (serve-layer queue depth, admission wait): a long-running
-    admission service cannot keep every sample the way :class:`StepTimer`
-    keeps per-iteration records, so this folds each observation into O(1)
-    state and snapshots to a compact dict for the metrics stream."""
-
-    __slots__ = ("n", "total", "min", "max", "last")
-
-    def __init__(self):
-        self.n = 0
-        self.total = 0.0
-        self.min = None
-        self.max = None
-        self.last = None
-
-    def add(self, value: float) -> None:
-        v = float(value)
-        self.n += 1
-        self.total += v
-        self.min = v if self.min is None else min(self.min, v)
-        self.max = v if self.max is None else max(self.max, v)
-        self.last = v
-
-    @property
-    def mean(self) -> float | None:
-        return self.total / self.n if self.n else None
-
-    def snapshot(self, ndigits: int = 4) -> dict | None:
-        """``{"n", "mean", "min", "max", "last"}``, or ``None`` before the
-        first observation (absent beats a row of nulls in JSONL)."""
-        if not self.n:
-            return None
-        return {"n": self.n, "mean": round(self.mean, ndigits),
-                "min": round(self.min, ndigits),
-                "max": round(self.max, ndigits),
-                "last": round(self.last, ndigits)}
-
-
-@contextlib.contextmanager
-def trace(trace_dir: str | None):
-    """``jax.profiler.trace`` when a directory is given; no-op otherwise."""
-    if not trace_dir:
-        yield
-        return
-    import jax
-
-    with jax.profiler.trace(trace_dir):
-        yield
+from consensus_entropy_tpu.obs.metrics import (  # noqa: F401
+    RollingStat,
+    StepTimer,
+)
+from consensus_entropy_tpu.obs.trace import device_trace as trace  # noqa: F401

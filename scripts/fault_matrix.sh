@@ -35,6 +35,12 @@
 #   trajectories bit-identical to uninterrupted single-host runs.
 # - acquisition registry (tests/test_acquire.py): the acquire.qbdc.masks
 #   fault point unit and the qbdc resume drill.
+# - observability (tests/test_obs.py): the traced fleet eviction+resume
+#   trace-continuity pin, and the slow 2-host fabric worker-SIGKILL
+#   drill — failed-over users must CONTINUE their traces on the
+#   survivor (one deterministic trace id per user, spans from both
+#   hosts, orphan-free merge).  scripts/obs_check.sh is the companion
+#   schema/export gate.
 #
 # Extra pytest args pass through, e.g.:
 #   scripts/fault_matrix.sh -k kill_at_every_boundary
@@ -43,6 +49,6 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
   tests/test_serve_faults.py tests/test_serve_fabric.py \
-  tests/test_acquire.py -v -m faults \
+  tests/test_acquire.py tests/test_obs.py -v -m faults \
   -p no:cacheprovider "$@"
 echo "fault matrix passed"

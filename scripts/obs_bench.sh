@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Tracing-overhead race (ISSUE 9 acceptance: traced-vs-untraced serve
+# throughput overhead <= 3%, parity asserted every rep).
+#
+# Runs `bench.py --suite obs`: a serve run with the obs span tracer
+# writing a real spans.jsonl vs the --no-trace arm over IDENTICAL users
+# and seeds, interleaved with alternating order per rep.  The headline
+# is the MEDIAN of per-rep paired wall ratios (pairing cancels the
+# throttled box's slow drift); the identical-arm noise floor and the
+# deterministic per-span emit cost ride along in the artifact so the
+# number reads in context.  Every traced rep also schema-validates its
+# fleet_metrics.jsonl and asserts the merged span set is orphan-free
+# with a loadable Chrome export.
+#
+# The JSON line goes to stdout (redirect to BENCH_obs_r<N>.json to
+# commit an artifact); the per-rep log goes to stderr.  Extra bench
+# args pass through, e.g.:
+#   scripts/obs_bench.sh --users 8 --reps 7
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+if [ "$#" -gt 0 ]; then
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --suite obs "$@"
+else
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --suite obs \
+        --users 6 --pool 100 --fleet 3 --reps 5 --al-epochs 2
+fi

@@ -1,0 +1,83 @@
+#!/usr/bin/env bash
+# Observability CI gate (ISSUE 9 satellite; sits next to fault_matrix.sh).
+#
+# Runs a REAL traced 2-user serve cohort over the synthetic workload,
+# then:
+#   1. validates EVERY fleet_metrics.jsonl line against the schema-v2
+#      event table (obs.export.validate_metrics_file),
+#   2. asserts the span WAL merges orphan-free and the Chrome trace
+#      export loads as JSON with complete events,
+#   3. round-trips the `report` CLI subcommand (--validate --out) over
+#      the run's users dir.
+#
+# Extra args are NOT accepted: this is a pass/fail gate, not a bench.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'PY'
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, ".")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+import bench
+from consensus_entropy_tpu.config import ALConfig
+from consensus_entropy_tpu.fleet import FleetReport, FleetScheduler, FleetUser
+from consensus_entropy_tpu.obs import export
+from consensus_entropy_tpu.obs.trace import Tracer
+from consensus_entropy_tpu.serve import FleetServer, ServeConfig
+
+cfg = ALConfig(queries=8, epochs=2, mode="mc", seed=1987,
+               ckpt_dtype="float32")
+users = bench._fleet_workload(2, 80, 96, cfg.seed)
+root = tempfile.mkdtemp(prefix="obs_check_")
+users_dir = os.path.join(root, "users")
+metrics_path = os.path.join(users_dir, "fleet_metrics.jsonl")
+spans_path = os.path.join(users_dir, "spans.jsonl")
+
+tracer = Tracer(spans_path, run_id=f"{cfg.mode}-{cfg.seed}")
+report = FleetReport(metrics_path)
+sched = FleetScheduler(cfg, report=report, scoring_by_width=True,
+                       user_timings=False, tracer=tracer)
+server = FleetServer(sched, ServeConfig(target_live=2))
+entries = [FleetUser(d.user_id, f(), d, bench._mkdir(root, f"u{i}"),
+                     seed=cfg.seed)
+           for i, (d, f) in enumerate(users)]
+recs = server.serve(iter(entries))
+tracer.close()
+report.write_summary(cohort=2)
+report.close()
+assert len(recs) == 2 and all(r["error"] is None for r in recs), recs
+
+# 1. every metrics line validates against the v2 schema
+errors = export.validate_metrics_file(metrics_path)
+assert errors == [], "schema violations:\n" + "\n".join(errors[:10])
+n_lines = len(export.read_jsonl_tolerant(metrics_path))
+print(f"obs_check: {n_lines} metrics lines schema-valid")
+
+# 2. spans merge orphan-free; the Chrome export loads
+spans = export.load_spans([spans_path])
+assert spans, "no spans written by a traced run"
+assert export.orphan_spans(spans) == []
+trace = json.loads(json.dumps(export.chrome_trace(spans)))
+xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+assert len(xs) == len(spans)
+print(f"obs_check: {len(spans)} spans merged, export loads "
+      f"({len(trace['traceEvents'])} events)")
+
+# 3. the report CLI round-trips over the same dir
+from consensus_entropy_tpu.cli.report import main as report_main
+
+out = os.path.join(root, "trace.json")
+assert report_main([users_dir, "--validate", "--out", out,
+                    "--no-text"]) == 0
+assert json.load(open(out))["traceEvents"]
+print("obs_check: report CLI validate+export ok")
+PY
+echo "obs check passed"

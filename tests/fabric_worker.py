@@ -60,9 +60,19 @@ def main(argv) -> int:
             f.flush()
             os.fsync(f.fileno())
 
+    tracer = None
+    if os.environ.get("CETPU_OBS_TRACE"):
+        # the obs drill arm: span WAL exactly where the production CLI
+        # worker puts it, run_id shared with the coordinator so a
+        # failed-over user's trace id is continuous across hosts
+        from consensus_entropy_tpu.obs.trace import Tracer
+        from consensus_entropy_tpu.serve.hosts import fabric_paths
+
+        tracer = Tracer(fabric_paths(fabric_dir, host_id)["spans"],
+                        run_id=f"{cfg.mode}-{cfg.seed}", host=host_id)
     scheduler = FleetScheduler(cfg, report=FleetReport(),
                                retrain_epochs=retrain_epochs_for(mode),
-                               scoring_by_width=True)
+                               scoring_by_width=True, tracer=tracer)
     try:
         with PreemptionGuard() as guard:
             run_worker(fabric_dir, host_id,
@@ -73,6 +83,9 @@ def main(argv) -> int:
                        preemption=guard)
     except Preempted:
         return EXIT_PREEMPTED
+    finally:
+        if tracer is not None:
+            tracer.close()
     return 0
 
 

@@ -244,7 +244,8 @@ def test_fleet_cli_matches_sequential(synth_roots, capsys):
     fleet_users = os.path.join(fleet_mr, "users")
     uids = sorted(os.listdir(seq_users))
     assert sorted(f for f in os.listdir(fleet_users)
-                  if f != "fleet_metrics.jsonl") == uids
+                  if f not in ("fleet_metrics.jsonl", "spans.jsonl")) \
+        == uids
     for uid in uids:
         sd = os.path.join(seq_users, uid, "mc")
         fd = os.path.join(fleet_users, uid, "mc")
@@ -346,7 +347,7 @@ def test_serve_cli_matches_sequential(synth_roots, capsys):
     serve_users = os.path.join(serve_mr, "users")
     uids = sorted(os.listdir(seq_users))
     serve_files = {"fleet_metrics.jsonl", "serve_journal.jsonl",
-                   "serve_poison.jsonl"}
+                   "serve_poison.jsonl", "spans.jsonl"}
     assert sorted(f for f in os.listdir(serve_users)
                   if f not in serve_files
                   and not f.endswith((".lock", ".ckpt"))) == uids

@@ -1,0 +1,501 @@
+"""Observability subsystem: tracing, metrics registry, export (obs/).
+
+Tier-1 (un-marked) keeps the pure-host units — histogram percentile
+exactness vs numpy, schema-v2 validation, torn-tail-tolerant readers,
+deterministic trace/span ids, span dedupe/orphan detection, Chrome-trace
+export shape — plus ONE traced 2-user fleet eviction+resume drill (the
+trace-continuity acceptance pin) and ONE traced 3-user serve run (span
+nesting + the admission→finish latency histogram).  The fabric
+worker-SIGKILL trace-continuity drill runs a real 2-host fabric and is
+``slow``/``faults`` (``scripts/fault_matrix.sh`` runs it).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from consensus_entropy_tpu.obs import export
+from consensus_entropy_tpu.obs.metrics import (
+    EventWriter,
+    Histogram,
+    MetricsRegistry,
+)
+from consensus_entropy_tpu.obs.trace import NULL_TRACER, Tracer, trace_id
+
+pytestmark = pytest.mark.obs
+
+
+# -- metrics: histogram / registry / writer (no jax) -----------------------
+
+
+def test_histogram_percentiles_exact_vs_numpy():
+    """While the reservoir holds, percentiles are BIT-identical to
+    np.percentile (linear interpolation, branch included) on known and
+    random draws."""
+    rng = np.random.default_rng(7)
+    for draws in (np.arange(1.0, 101.0),
+                  rng.exponential(0.3, size=257),
+                  rng.uniform(0.001, 50.0, size=1000)):
+        h = Histogram()
+        for v in draws:
+            h.add(v)
+        assert h.exact
+        for q in (0, 10, 50, 90, 95, 99, 99.9, 100):
+            assert h.percentile(q) == float(np.percentile(draws, q)), \
+                f"q={q} mismatch on n={len(draws)}"
+        snap = h.snapshot()
+        assert snap["p50"] == round(float(np.percentile(draws, 50)), 4)
+        assert snap["n"] == len(draws) and "exact" not in snap
+
+
+def test_histogram_bucket_fallback_is_conservative_upper_bound():
+    """Past the reservoir the percentile comes from log-bucket upper
+    edges: an UPPER bound on the true quantile, never below it, and the
+    snapshot flags the loss of exactness."""
+    rng = np.random.default_rng(3)
+    draws = rng.exponential(1.0, size=500)
+    h = Histogram(max_samples=100)
+    for v in draws:
+        h.add(v)
+    assert not h.exact
+    for q in (50, 95, 99):
+        true = float(np.percentile(draws, q))
+        est = h.percentile(q)
+        assert est >= true * (1.0 - 1e-9)
+        assert est <= max(true * h.growth, h.max)  # one bucket of slack
+    assert h.snapshot()["exact"] is False
+    assert h.n == 500 and h.min == draws.min() and h.max == draws.max()
+
+
+def test_histogram_nonpositive_and_empty():
+    h = Histogram()
+    assert h.percentile(50) is None and h.snapshot() is None
+    h.add(0.0)
+    h.add(-1.0)
+    h.add(2.0)
+    assert h.n == 3
+    assert h.percentile(0) == -1.0  # exact reservoir covers them
+
+
+def test_metrics_registry_get_or_create_and_type_guard():
+    r = MetricsRegistry()
+    c = r.counter("dispatches")
+    c.inc()
+    c.inc(2)
+    assert r.counter("dispatches") is c and c.value == 3
+    r.gauge("depth").set(5)
+    r.rolling("wait").add(1.5)
+    r.histogram("lat").add(0.25)
+    with pytest.raises(TypeError, match="is Counter"):
+        r.gauge("dispatches")
+    snap = r.snapshot()
+    assert snap["dispatches"] == 3 and snap["depth"] == 5
+    assert snap["wait"]["n"] == 1 and snap["lat"]["p50"] == 0.25
+
+
+def test_event_writer_schema_tag_and_torn_tail_reader(tmp_path):
+    """Every line the writer emits carries schema: 2; the tolerant reader
+    skips a torn last line (the SIGKILL artifact) instead of raising —
+    the same discipline serve.journal applies to its WALs."""
+    path = str(tmp_path / "m.jsonl")
+    w = EventWriter(path)
+    w.emit({"event": "enqueue", "t_s": 0.1, "user": "u0", "depth": 1})
+    w.emit({"event": "admit", "t_s": 0.2, "user": "u0", "width": 32,
+            "wait_s": 0.1, "depth": 0, "live": 1})
+    w.close()
+    with open(path, "ab") as f:
+        f.write(b'{"event": "user_done", "t_s": 0.3, "use')  # torn tail
+    recs = export.read_jsonl_tolerant(path)
+    assert [r["event"] for r in recs] == ["enqueue", "admit"]
+    assert all(r["schema"] == 2 for r in recs)
+    assert export.validate_metrics(recs) == []
+    # missing file reads empty, never raises
+    assert export.read_jsonl_tolerant(str(tmp_path / "absent.jsonl")) == []
+
+
+def test_schema_validation_catches_violations():
+    ok = {"schema": 2, "event": "enqueue", "t_s": 1.0, "user": "u",
+          "depth": 0}
+    assert export.validate_metrics([ok]) == []
+    errs = export.validate_metrics([
+        {"event": "enqueue", "t_s": 1.0, "user": "u", "depth": 0},  # no tag
+        {"schema": 2, "event": "warp_core_breach", "t_s": 1.0},  # unknown
+        {"schema": 2, "event": "admit", "t_s": 1.0, "user": "u"},  # fields
+        {"schema": 2, "event": "enqueue", "user": "u", "depth": 0},  # t_s
+    ])
+    assert len(errs) >= 4
+    assert any("schema tag" in e for e in errs)
+    assert any("unknown event" in e for e in errs)
+    assert any("lacks 'width'" in e for e in errs)
+    assert any("lacks numeric t_s" in e for e in errs)
+    # summaries are exempt from t_s
+    assert export.validate_metrics(
+        [{"schema": 2, "event": "fleet_summary", "users_done": 1}]) == []
+
+
+def test_profiling_aliases_are_the_obs_classes():
+    """The utils.profiling import surface survives the migration as thin
+    aliases over obs.metrics/obs.trace."""
+    from consensus_entropy_tpu.obs import metrics as obs_metrics
+    from consensus_entropy_tpu.obs import trace as obs_trace
+    from consensus_entropy_tpu.utils import profiling
+
+    assert profiling.StepTimer is obs_metrics.StepTimer
+    assert profiling.RollingStat is obs_metrics.RollingStat
+    assert profiling.trace is obs_trace.device_trace
+
+
+# -- tracer: deterministic ids, dedupe, export (no jax) --------------------
+
+
+def test_trace_and_span_ids_deterministic():
+    """Ids are pure functions of (run_id, user, iteration): two tracer
+    instances (a run and its restart, or two fabric hosts) derive the
+    SAME ids — the mechanism that makes resumed users continue their
+    trace."""
+    a = Tracer(None, run_id="mc-7", host="h0")
+    b = Tracer(None, run_id="mc-7", host="h1")
+    assert trace_id("mc-7", "u0") == trace_id("mc-7", "u0")
+    assert trace_id("mc-7", "u0") != trace_id("mc-7", "u1")
+    assert trace_id("mc-7", "u0") != trace_id("mc-8", "u0")
+    assert a.user_ctx("u0").span == b.user_ctx("u0").span
+    assert a.run_ctx.span == b.run_ctx.span
+    s1 = a.begin("al_iter", parent=a.user_ctx("u0"), key=("u0", 3))
+    s2 = b.begin("al_iter", parent=b.user_ctx("u0"), key=("u0", 3))
+    assert s1.ctx.span == s2.ctx.span
+    assert s1.ctx.trace == trace_id("mc-7", "u0")
+    # auto-keyed (dispatch) spans never collide across tracers
+    d1 = a.begin("score_dispatch")
+    d2 = b.begin("score_dispatch")
+    assert d1.ctx.span != d2.ctx.span
+
+
+def test_null_tracer_is_inert():
+    assert NULL_TRACER.begin("x") is None
+    NULL_TRACER.end(None)
+    NULL_TRACER.open_user("u")
+    NULL_TRACER.close_user("u")
+    NULL_TRACER.span_at("x", 0.0, 1.0)
+    with NULL_TRACER.span("x") as ctx:
+        assert ctx is None
+    assert NULL_TRACER.records == []
+
+
+def test_span_dedupe_keeps_longest_and_orphan_detection(tmp_path):
+    """The merge collapses duplicate span ids (resume re-runs, fabric
+    transcription) keeping the longest duration; a parent id missing
+    from the merged set is reported as an orphan."""
+    p1, p2 = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    t = Tracer(p1, run_id="r", host="h0")
+    t.open_user("u0", t0=1.0)
+    sp = t.begin("al_iter", parent=t.user_ctx("u0"), key=("u0", 0))
+    t.end(sp)
+    t.close_user("u0")
+    t.close()
+    # a second attempt re-emits the same iteration, longer
+    t2 = Tracer(p2, run_id="r", host="h1")
+    sp2 = t2.begin("al_iter", parent=t2.user_ctx("u0"), key=("u0", 0))
+    time.sleep(0.02)
+    t2.end(sp2)
+    t2.close_user("u0")  # never opened on h1: no record, no crash
+    t2.close()
+    spans = export.load_spans([p1, p2])
+    iters = [s for s in spans if s["name"] == "al_iter"]
+    assert len(iters) == 1  # deduped by deterministic id
+    assert iters[0]["host"] == "h1"  # the longer (completed) attempt won
+    assert export.orphan_spans(spans) == []
+    # drop the user record: its children become orphans
+    broken = [s for s in spans if s["name"] != "user"]
+    assert [o["name"] for o in export.orphan_spans(broken)] == ["al_iter"]
+
+
+def test_chrome_trace_export_schema_and_lanes(tmp_path):
+    """The export is valid Chrome trace-event JSON: complete events with
+    int ts/dur, one process per host with metadata naming, one thread
+    lane per user/bucket/run."""
+    p = str(tmp_path / "s.jsonl")
+    t = Tracer(p, run_id="r", host="h0")
+    t.open_user("u0")
+    sp = t.begin("al_iter", parent=t.user_ctx("u0"), key=("u0", 0),
+                 user="u0", epoch=0)
+    t.end(sp)
+    t.span_at("score_dispatch", time.time() - 0.01, time.time(),
+              parent=t.run_ctx, fn="mc_masked", width=32, batch=2)
+    t.close_user("u0")
+    t.close()
+    trace = export.chrome_trace(export.load_spans([p]))
+    blob = json.loads(json.dumps(trace))  # round-trips as plain JSON
+    evs = blob["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    ms = [e for e in evs if e["ph"] == "M"]
+    assert {e["name"] for e in xs} == {"run", "user", "al_iter",
+                                       "score_dispatch"}
+    for e in xs:
+        assert isinstance(e["ts"], int) and isinstance(e["dur"], int)
+        assert e["dur"] >= 1 and isinstance(e["pid"], int)
+    lane_names = {e["args"]["name"] for e in ms}
+    assert "host h0" in lane_names
+    assert "user u0" in lane_names and "bucket 32" in lane_names
+    assert "run" in lane_names
+
+
+def _assert_strictly_nested(spans, eps=1e-6):
+    by_id = {s["span"]: s for s in spans}
+    for s in spans:
+        parent = by_id.get(s.get("parent"))
+        if parent is None:
+            continue
+        assert parent["t0"] <= s["t0"] + eps, (s["name"], parent["name"])
+        assert s["t0"] + s["dur_s"] \
+            <= parent["t0"] + parent["dur_s"] + eps, \
+            (s["name"], parent["name"])
+
+
+# -- traced fleet/serve runs (jax) -----------------------------------------
+
+
+def _traced_fleet_eviction(tmp_path):
+    from consensus_entropy_tpu.al import workspace
+    from consensus_entropy_tpu.fleet import FleetScheduler, FleetUser
+    from consensus_entropy_tpu.resilience import faults
+    from consensus_entropy_tpu.resilience.faults import FaultRule
+    from tests.test_fleet import _cfg, _committee, _user_data
+
+    cfg = _cfg(epochs=2)
+    entries = []
+    for i in range(2):
+        data = _user_data(100 + i, f"u{i}")
+        committee = (_committee(data, sgd_name="sgd.victim", min_members=2)
+                     if i == 0 else _committee(data))
+        fp = tmp_path / f"fleet_u{i}"
+        fp.mkdir()
+        entries.append(FleetUser(
+            f"u{i}", committee, data, str(fp), seed=cfg.seed,
+            committee_factory=lambda fp=fp: workspace.load_committee(
+                str(fp))))
+    spans_path = str(tmp_path / "spans.jsonl")
+    tracer = Tracer(spans_path, run_id=f"{cfg.mode}-{cfg.seed}")
+    sched = FleetScheduler(cfg, tracer=tracer, max_resumes=1)
+    with faults.inject(FaultRule("member.retrain", "raise", at=1,
+                                 member="sgd.victim")) as inj:
+        recs = sched.run(entries)
+    tracer.close()
+    return recs, inj, spans_path
+
+
+@pytest.mark.faults
+def test_fleet_tracing_eviction_resume_continues_trace(tmp_path):
+    """THE trace-continuity pin: a session evicted mid-iteration and
+    resumed from its workspace keeps ONE trace id, the re-run iteration's
+    span id collapses with its interrupted attempt at merge, and no span
+    in the merged set is orphaned."""
+    recs, inj, spans_path = _traced_fleet_eviction(tmp_path)
+    assert inj.fired
+    assert [r["error"] for r in recs] == [None, None]
+    assert recs[0]["resumes"] == 1  # the eviction+resume actually ran
+    raw = [r for r in export.read_jsonl_tolerant(spans_path)
+           if r.get("ev") == "span"]
+    spans = export.load_spans([spans_path])
+    assert len(raw) > len(spans)  # the re-run emitted duplicate ids...
+    by_user = {}
+    for s in spans:
+        if "user" in s:
+            by_user.setdefault(s["user"], set()).add(s["trace"])
+    # ...and each user still owns exactly ONE trace id
+    assert {u: len(t) for u, t in by_user.items()} == {"u0": 1, "u1": 1}
+    assert by_user["u0"] == {trace_id("mc-7", "u0")}
+    assert export.orphan_spans(spans) == []
+    # the merged trace holds one al_iter per (user, epoch) — no forked
+    # iteration spans from the two attempts
+    iters = [(s["user"], s["epoch"]) for s in spans
+             if s["name"] == "al_iter"]
+    assert len(iters) == len(set(iters))
+    assert sorted(e for u, e in iters if u == "u0") == [-1, 0, 1]
+
+
+def test_serve_tracing_spans_nest_and_latency_histogram(tmp_path):
+    """A traced 3-user serve run: spans strictly nest under
+    run→user→al_iter, admission waits ride the user span, the summary
+    (and bench line) carry the admission→finish latency histogram, and
+    the metrics stream validates against schema v2."""
+    from consensus_entropy_tpu.fleet import (
+        FleetReport,
+        FleetScheduler,
+        FleetUser,
+    )
+    from consensus_entropy_tpu.fleet.report import bench_line
+    from consensus_entropy_tpu.serve import FleetServer, ServeConfig
+    from tests.test_fleet import _cfg, _committee, _user_data
+
+    cfg = _cfg(epochs=2)
+    entries = []
+    for i in range(3):
+        data = _user_data(100 + i, f"u{i}")
+        fp = tmp_path / f"serve_u{i}"
+        fp.mkdir()
+        entries.append(FleetUser(f"u{i}", _committee(data), data, str(fp),
+                                 seed=cfg.seed))
+    spans_path = str(tmp_path / "spans.jsonl")
+    metrics_path = str(tmp_path / "fleet_metrics.jsonl")
+    tracer = Tracer(spans_path, run_id=f"{cfg.mode}-{cfg.seed}")
+    report = FleetReport(metrics_path)
+    sched = FleetScheduler(cfg, report=report, scoring_by_width=True,
+                           tracer=tracer)
+    server = FleetServer(sched, ServeConfig(target_live=2))
+    recs = server.serve(iter(entries))
+    tracer.close()
+    summary = report.write_summary(cohort=2)
+    report.close()
+    assert all(r["error"] is None for r in recs)
+    # the latency histogram is the SLO prerequisite: per-run p50/p99
+    lat = summary["admission_to_finish_s"]
+    assert lat["n"] == 3
+    assert 0 < lat["p50"] <= lat["p95"] <= lat["p99"] <= lat["max"]
+    assert bench_line(summary)["admission_to_finish_s"] == lat
+    assert export.validate_metrics_file(metrics_path) == []
+    spans = export.load_spans([spans_path])
+    names = {s["name"] for s in spans}
+    assert {"run", "user", "al_iter", "admission_wait", "host_step",
+            "checkpoint", "score_dispatch"} <= names
+    assert len([s for s in spans if s["name"] == "user"]) == 3
+    assert len([s for s in spans if s["name"] == "admission_wait"]) == 3
+    assert export.orphan_spans(spans) == []
+    _assert_strictly_nested(spans)
+    # every span of a user's trace hangs off that user's deterministic id
+    for s in spans:
+        if s["name"] in ("al_iter", "admission_wait"):
+            assert s["parent"] == tracer.user_ctx(s["user"]).span
+    # the Chrome export of the run loads and keeps one host lane
+    trace = json.loads(json.dumps(export.chrome_trace(spans)))
+    assert len([e for e in trace["traceEvents"] if e["ph"] == "X"]) \
+        == len(spans)
+    # the text report renders without a backend
+    text = export.text_report(str(tmp_path))
+    assert "admission→finish p50=" in text and "spans:" in text
+
+
+# -- the fabric worker-SIGKILL trace drill (slow) --------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.serve
+@pytest.mark.faults
+def test_fabric_worker_sigkill_trace_continuity(tmp_path):
+    """A real 2-host fabric with h0 SIGKILLed mid-iteration: the
+    failed-over users CONTINUE their traces on the survivor (one trace id
+    per user, spans from both hosts), the coordinator's transcription +
+    the per-worker WALs merge with no orphans, and the merged Chrome
+    trace carries one process lane per host."""
+    from consensus_entropy_tpu.fleet import FleetReport
+    from consensus_entropy_tpu.serve import (
+        AdmissionJournal,
+        FabricConfig,
+        FabricCoordinator,
+    )
+    from consensus_entropy_tpu.serve.hosts import fabric_paths
+    from tests.fabric_workload import make_cfg, user_specs
+    from tests.test_serve_fabric import _spawn_factory, _with_deadline
+
+    cfg = make_cfg("mc", epochs=2)
+    specs = user_specs(3)
+    fabric_dir = str(tmp_path / "fabric")
+    os.makedirs(fabric_dir)
+    journal = AdmissionJournal(os.path.join(fabric_dir,
+                                            "serve_journal.jsonl"))
+    spans_path = str(tmp_path / "spans.jsonl")
+    tracer = Tracer(spans_path, run_id=f"{cfg.mode}-{cfg.seed}",
+                    host="coordinator")
+    trace_env = {"CETPU_OBS_TRACE": "1"}
+    h0_spans = fabric_paths(fabric_dir, "h0")["spans"]
+    state = {"done": False}
+
+    def kill_h0_after_first_span(coord):
+        # kill only once h0 has admitted a user AND flushed at least one
+        # span — so the drill exercises a trace interrupted MID-flight,
+        # not a host that died before tracing anything
+        if state["done"]:
+            return
+        st = coord.journal.state
+        admitted = any(h == "h0" and st.last.get(u) == "admit"
+                       for u, h in st.assigned.items())
+        if admitted and os.path.exists(h0_spans) \
+                and os.path.getsize(h0_spans) > 0:
+            coord.hosts["h0"].proc.kill()
+            state["done"] = True
+
+    coord = FabricCoordinator(
+        journal, fabric_dir, FabricConfig(hosts=2, lease_s=5.0),
+        report=FleetReport(), tracer=tracer,
+        on_poll=_with_deadline(kill_h0_after_first_span))
+    try:
+        summary = coord.run(
+            [u for _, u, _ in specs],
+            _spawn_factory(fabric_dir, str(tmp_path), cfg, 3,
+                           env_extra={"h0": trace_env, "h1": trace_env}))
+    finally:
+        tracer.close()
+        journal.close()
+    assert sorted(summary["finished"]) == [u for _, u, _ in specs]
+    assert summary["revocations"] == 1
+    assert state["done"], "the drill never killed h0"
+    # merge = coordinator transcription + the per-worker WALs (either
+    # alone would do; together they exercise the dedupe)
+    span_files = [spans_path] + [
+        os.path.join(fabric_dir, f"spans_h{i}.jsonl") for i in (0, 1)]
+    assert all(os.path.exists(p) for p in span_files)
+    spans = export.load_spans(span_files)
+    assert export.orphan_spans(spans) == []
+    by_user = {}
+    hosts_of = {}
+    for s in spans:
+        if "user" in s:
+            by_user.setdefault(s["user"], set()).add(s["trace"])
+            hosts_of.setdefault(s["user"], set()).add(s.get("host"))
+    # every user: exactly one trace id, even across the failover
+    assert all(len(t) == 1 for t in by_user.values())
+    assert len(by_user) == 3
+    # at least one failed-over user has spans from BOTH hosts
+    assert any({"h0", "h1"} <= h for h in hosts_of.values()), hosts_of
+    # the al_iter set is complete and unforked per user
+    iters = [(s["user"], s["epoch"]) for s in spans
+             if s["name"] == "al_iter"]
+    assert len(iters) == len(set(iters))
+    for _, uid, _ in specs:
+        assert sorted(e for u, e in iters if u == uid) == [-1, 0, 1]
+    trace = export.chrome_trace(spans)
+    host_lanes = {e["args"]["name"] for e in trace["traceEvents"]
+                  if e["ph"] == "M" and e["name"] == "process_name"}
+    assert {"host h0", "host h1"} <= host_lanes
+
+
+# -- the report CLI --------------------------------------------------------
+
+
+def test_report_cli_validate_export_and_text(tmp_path):
+    """python -m consensus_entropy_tpu.cli.report over a synthetic users
+    dir: schema validation passes, the Chrome trace is written, the text
+    report prints; an invalid metrics line flips the exit code."""
+    users = tmp_path / "users"
+    users.mkdir()
+    w = EventWriter(str(users / "fleet_metrics.jsonl"))
+    w.emit({"event": "enqueue", "t_s": 0.1, "user": "u0", "depth": 1})
+    w.emit({"event": "fleet_summary", "users_done": 1, "wall_s": 1.0,
+            "users_per_sec": 1.0, "phase_wall_s": {"score_s": 0.5}})
+    w.close()
+    t = Tracer(str(users / "spans.jsonl"), run_id="r")
+    t.open_user("u0")
+    t.close_user("u0")
+    t.close()
+    from consensus_entropy_tpu.cli.report import main
+
+    out = str(tmp_path / "trace.json")
+    assert main([str(users), "--validate", "--out", out]) == 0
+    blob = json.load(open(out))
+    assert any(e["ph"] == "X" for e in blob["traceEvents"])
+    with open(users / "fleet_metrics.jsonl", "ab") as f:
+        f.write(json.dumps({"schema": 2, "event": "nonsense"}).encode()
+                + b"\n")
+    assert main([str(users), "--validate", "--no-text"]) == 1
