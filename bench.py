@@ -1075,6 +1075,180 @@ def run_serve_suite(args_ns) -> int:
     return 0
 
 
+def run_slo_suite(args_ns) -> int:
+    """SLO planner vs fixed-window admission on the tail-heavy serve
+    workload (ISSUE 11).
+
+    Both arms drive the SAME class-aware server (every 3rd user
+    ``interactive``, the rest ``batch``, all submitted up front so the
+    priority queue actually orders admissions) over IDENTICAL tail-heavy
+    users (every 4th pool 4x).  The FIXED arm (``slo_planner=False``)
+    is the PR 3 shape — operator-free pow2 buckets, no admission window,
+    eager dispatch.  The PLANNER arm derives bucket edges online from
+    the quantile sketch and holds partially-formed dispatches while host
+    work is in flight (``serve.planner.dispatch_hold``), inside
+    per-class SLO headroom.  Per-user trajectory parity against the
+    sequential loop is asserted on EVERY rep of both arms; the headline
+    is MEAN BUCKET OCCUPANCY (capacity-independent on this throttled
+    box, like the fused suite's h2d bytes) — the acceptance bound is
+    planner > fixed — with users/sec and per-class admission→finish p95
+    (interactive <= batch under load) reported alongside.
+    """
+    import shutil
+    import tempfile
+
+    from consensus_entropy_tpu.al.loop import ALLoop
+    from consensus_entropy_tpu.config import ALConfig
+    from consensus_entropy_tpu.fleet import FleetReport, FleetScheduler, \
+        FleetUser
+    from consensus_entropy_tpu.serve import FleetServer, ServeConfig
+
+    cfg = ALConfig(queries=args_ns.k, epochs=args_ns.al_epochs, mode="mc",
+                   seed=1987, ckpt_dtype="float32")
+    n_users = args_ns.users
+    small = args_ns.pool or 120
+    n = sorted(set(args_ns.fleet))[-1]
+    users, sizes = _skewed_fleet_workload(n_users, small, 96, cfg.seed)
+    cls_of = ["interactive" if i % 3 == 2 else "batch"
+              for i in range(n_users)]
+    _log(f"slo workload: {n_users} users, pool sizes {sizes}, classes "
+         f"{cls_of}, target_live {n}, 3 host members, q={cfg.queries}, "
+         f"{cfg.epochs} AL iterations")
+
+    root = tempfile.mkdtemp(prefix="slo_bench_")
+    reps = args_ns.reps
+    try:
+        loop = ALLoop(cfg)
+        seq_results = None
+        seq_s = float("inf")
+        arms: dict[str, list] = {"fixed": [], "planner": []}
+        for rep in range(reps):
+            # interleaved (sequential, fixed, planner per rep) — the
+            # 2-vCPU drift protocol every suite here uses
+            t0 = time.perf_counter()
+            results = []
+            for i, (data, factory) in enumerate(users):
+                p = _mkdir(root, f"seq{rep}_{i}")
+                results.append(loop.run_user(factory(), data, p,
+                                             seed=cfg.seed))
+            seq_s = min(seq_s, time.perf_counter() - t0)
+            if seq_results is None:
+                seq_results = results
+            elif [r["trajectory"] for r in results] \
+                    != [r["trajectory"] for r in seq_results]:
+                raise AssertionError("sequential reps diverged")
+            traj_of = {r["user"]: r["trajectory"] for r in seq_results}
+
+            for arm, planner_on in (("fixed", False), ("planner", True)):
+                report = FleetReport()
+                sched = FleetScheduler(cfg, report=report,
+                                       host_workers=args_ns.host_workers,
+                                       user_timings=False,
+                                       scoring_by_width=True)
+                server = FleetServer(sched, ServeConfig(
+                    target_live=n, max_queue=max(n_users, 1),
+                    slo_planner=planner_on, planner_epoch=4))
+                entries = [
+                    FleetUser(data.user_id, factory(), data,
+                              _mkdir(root, f"{arm}{rep}_{i}"),
+                              seed=cfg.seed, priority=cls_of[i])
+                    for i, (data, factory) in enumerate(users)]
+                t0 = time.perf_counter()
+                for e in entries:
+                    server.submit(e)
+                server.close_intake()
+                recs = server.serve(())
+                wall = time.perf_counter() - t0
+                s = report.summary(cohort=n, wall_s=wall)
+                s["parity_with_sequential"] = (
+                    len(recs) == n_users
+                    and all(r["error"] is None
+                            and r["result"]["trajectory"]
+                            == traj_of[r["user"]] for r in recs))
+                arms[arm].append(s)
+                _log(f"[rep {rep} {arm}] occupancy={s['occupancy']} "
+                     f"users/s={s['users_per_sec']} "
+                     f"parity={s['parity_with_sequential']}")
+
+        def mean_occ(arm):
+            occ = [s["occupancy"] for s in arms[arm]
+                   if s["occupancy"] is not None]
+            return round(sum(occ) / len(occ), 3) if occ else None
+
+        def best(arm):
+            return max(arms[arm], key=lambda s: s["users_per_sec"] or 0)
+
+        def class_p95(s):
+            per = s.get("per_class") or {}
+            return {cls: (c.get("admission_to_finish_s") or {}).get("p95")
+                    for cls, c in sorted(per.items())}
+
+        seq_ups = n_users / seq_s
+        occ_fixed, occ_planner = mean_occ("fixed"), mean_occ("planner")
+        bf, bp = best("fixed"), best("planner")
+        parity = all(s["parity_with_sequential"]
+                     for ss in arms.values() for s in ss)
+        if not parity:
+            # the acceptance PRECONDITION: a planner that changes
+            # per-user results must never produce a green-looking
+            # occupancy artifact
+            raise AssertionError(
+                "slo suite lost per-user parity with the sequential "
+                "loop: " + json.dumps({
+                    arm: [s["parity_with_sequential"] for s in ss]
+                    for arm, ss in arms.items()}))
+        _log(f"[sequential] {seq_ups:.3f} users/s best of {reps}")
+        _log(f"[fixed]   occupancy {occ_fixed} (mean of {reps}), "
+             f"{bf['users_per_sec']:.3f} users/s best, per-class p95 "
+             f"{class_p95(bf)}")
+        _log(f"[planner] occupancy {occ_planner} (mean of {reps}), "
+             f"{bp['users_per_sec']:.3f} users/s best, per-class p95 "
+             f"{class_p95(bp)}, edges {bp.get('planner', {}).get('edges')}")
+
+        def arm_line(s, occ):
+            p95 = class_p95(s)
+            out = {
+                "occupancy": occ,
+                "users_per_sec": s["users_per_sec"],
+                "vs_sequential": round(s["users_per_sec"] / seq_ups, 2),
+                "mean_device_batch": s.get("mean_device_batch"),
+                "per_bucket": s.get("per_bucket"),
+                "per_class_p95_s": p95,
+                "interactive_p95_le_batch_p95": (
+                    p95.get("interactive") is not None
+                    and p95.get("batch") is not None
+                    and p95["interactive"] <= p95["batch"]),
+                "admission_to_finish_s": s.get("admission_to_finish_s"),
+            }
+            if s.get("planner") is not None:
+                out["planner"] = s["planner"]
+            return out
+
+        print(json.dumps({
+            "metric": f"slo_planner_mean_occupancy_{n_users}u",
+            "value": occ_planner,
+            "unit": "occupancy",
+            # the acceptance ratio: planner-formed dispatches vs the
+            # fixed-window arm's, same users, parity exact on every rep
+            "vs_baseline": (round(occ_planner / occ_fixed, 2)
+                            if occ_planner and occ_fixed else None),
+            "target_live": n,
+            "pool_sizes": sizes,
+            "classes": cls_of,
+            "sequential_users_per_sec": round(seq_ups, 4),
+            "fixed": arm_line(bf, occ_fixed),
+            "planner": arm_line(bp, occ_planner),
+            "per_rep_occupancy": {
+                arm: [s["occupancy"] for s in ss]
+                for arm, ss in arms.items()},
+            "parity_with_sequential": parity,
+            **_provenance(),
+        }))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return 0
+
+
 def run_serve_fused_suite(args_ns) -> int:
     """Fused vs unfused serve step on one bucketed workload (ISSUE 8).
 
@@ -2196,7 +2370,7 @@ def _mkdir(root, name):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--suite", choices=("linear", "cnn", "retrain", "fleet",
-                                        "serve", "serve-fused",
+                                        "serve", "serve-fused", "slo",
                                         "serve-faults", "fabric",
                                         "qbdc", "cnn-fleet", "obs"),
                     default="linear",
@@ -2212,6 +2386,13 @@ def main(argv=None) -> int:
                          "mask) vs --no-fuse-step on one bucketed "
                          "workload — h2d bytes + device calls per "
                          "iteration, parity asserted every rep; "
+                         "slo: SLO-aware admission planner (adaptive "
+                         "quantile-sketch bucket edges, priority "
+                         "classes, predictive dispatch holds) vs the "
+                         "fixed-window arm on the tail-heavy serve "
+                         "workload — mean bucket occupancy, users/sec, "
+                         "per-class admission→finish p95, parity "
+                         "asserted every rep; "
                          "serve-faults: recovered-users/sec under a "
                          "fault-injected flaky user mix (watchdog, "
                          "backoff re-admission, circuit breaker); "
@@ -2299,6 +2480,10 @@ def main(argv=None) -> int:
     if args_ns.suite == "serve":
         # serve reuses --pool as the SMALL pool size (every 4th user 4x)
         return run_serve_suite(args_ns)
+    if args_ns.suite == "slo":
+        # same skewed sizing as serve; every 3rd user is interactive,
+        # target_live is the LAST --fleet value
+        return run_slo_suite(args_ns)
     if args_ns.suite == "serve-faults":
         # same skewed sizing as serve; every 3rd user is flaky
         return run_serve_faults_suite(args_ns)

@@ -115,6 +115,37 @@ def build_parser() -> argparse.ArgumentParser:
                         "the smallest edge that fits their pool, "
                         "oversized pools fall through to the next power "
                         "of two (default: power-of-two buckets)")
+    p.add_argument("--no-slo-planner", action="store_true",
+                   help="serve mode: disable the SLO admission planner "
+                        "(ON by default: bucket edges derive online from "
+                        "a quantile sketch of enqueue-time pool sizes — "
+                        "journaled, so restarts re-derive identical "
+                        "routing — and the admission/batch windows "
+                        "become adaptive holds bounded by per-class SLO "
+                        "headroom).  Disabled = the fixed-window arm; "
+                        "per-user results are identical either way "
+                        "(debug/baseline)")
+    p.add_argument("--slo-interactive-s", type=float, default=60.0,
+                   metavar="S",
+                   help="serve mode: admission->finish latency target "
+                        "for the 'interactive' priority class — the SLO "
+                        "headroom every adaptive hold is bounded by "
+                        "(default 60)")
+    p.add_argument("--slo-batch-s", type=float, default=600.0, metavar="S",
+                   help="serve mode: admission->finish latency target "
+                        "for the 'batch' priority class (default 600)")
+    p.add_argument("--priority-aging-s", type=float, default=30.0,
+                   metavar="S",
+                   help="serve mode: queue wait past which a 'batch' "
+                        "user jumps strict-priority pop ahead of fresh "
+                        "'interactive' arrivals — the starvation guard "
+                        "(0 = pure strict priority; default 30)")
+    p.add_argument("--interactive-users", default=None,
+                   metavar="USER[,USER...]",
+                   help="serve mode: submit these user ids in the "
+                        "'interactive' priority class (strict-priority "
+                        "admission ahead of 'batch', tighter SLO "
+                        "target); everyone else is 'batch'")
     p.add_argument("--no-serve-journal", action="store_true",
                    help="serve mode: disable the crash-safety admission "
                         "journal (users/serve_journal.jsonl, on by "
@@ -294,6 +325,14 @@ def main(argv=None) -> int:
         print(f"--jax-profile-n must be >= 1, got {args.jax_profile_n}")
         return 1
     for flag, is_set in (("--no-serve-journal", args.no_serve_journal),
+                         ("--no-slo-planner", args.no_slo_planner),
+                         ("--slo-interactive-s",
+                          args.slo_interactive_s != 60.0),
+                         ("--slo-batch-s", args.slo_batch_s != 600.0),
+                         ("--priority-aging-s",
+                          args.priority_aging_s != 30.0),
+                         ("--interactive-users",
+                          args.interactive_users is not None),
                          ("--watchdog-s", args.watchdog_s),
                          ("--failure-budget", args.failure_budget != 3),
                          ("--breaker-threshold",
@@ -320,6 +359,12 @@ def main(argv=None) -> int:
               "--breaker-threshold >= 0, --breaker-probes >= 0, "
               "--journal-compact-kb >= 0")
         return 1
+    if args.serve is not None and (args.slo_interactive_s <= 0
+                                   or args.slo_batch_s <= 0
+                                   or args.priority_aging_s < 0):
+        print("--slo-interactive-s and --slo-batch-s must be > 0, "
+              "--priority-aging-s >= 0")
+        return 1
     if args.hosts is not None:
         if args.hosts < 1 or args.lease_s <= 0:
             print("--hosts must be >= 1 and --lease-s > 0")
@@ -341,11 +386,25 @@ def main(argv=None) -> int:
         try:
             bucket_widths = tuple(int(w) for w in
                                   args.bucket_widths.split(",") if w)
-            if not bucket_widths or min(bucket_widths) < 1:
+            if not bucket_widths:
                 raise ValueError
         except ValueError:
             print(f"--bucket-widths must be comma-separated positive ints, "
                   f"got {args.bucket_widths!r}")
+            return 1
+        # full construction-time validation (sorted, unique, positive,
+        # no PAD_MULTIPLE collapse) — a typo'd geometry fails HERE with
+        # the reason, instead of silently misrouting users to the wrong
+        # jit family at admission time
+        from consensus_entropy_tpu.serve.buckets import (
+            validate_bucket_widths,
+        )
+
+        try:
+            validate_bucket_widths(bucket_widths)
+        except ValueError as e:
+            print(f"--bucket-widths {args.bucket_widths!r} is invalid: "
+                  f"{e}")
             return 1
     args._bucket_widths = bucket_widths
 
@@ -499,6 +558,35 @@ def main(argv=None) -> int:
     return 0
 
 
+def _serve_config(args):
+    """The ``ServeConfig`` shared by the single-host serve path and every
+    fabric worker (workers inherit the flags via argv passthrough)."""
+    from consensus_entropy_tpu.serve import ServeConfig
+
+    return ServeConfig(
+        target_live=args.serve,
+        admit_window_s=args.admit_window_ms / 1000.0,
+        bucket_widths=args._bucket_widths,
+        watchdog_s=args.watchdog_s,
+        failure_budget=args.failure_budget,
+        breaker_threshold=args.breaker_threshold,
+        breaker_cooldown_s=args.breaker_cooldown_s,
+        breaker_probes=args.breaker_probes,
+        slo_planner=not args.no_slo_planner,
+        slo_interactive_s=args.slo_interactive_s,
+        slo_batch_s=args.slo_batch_s,
+        aging_s=args.priority_aging_s)
+
+
+def _interactive_set(args) -> set:
+    """User ids the operator flagged ``--interactive-users`` (everyone
+    else submits as the ``batch`` class)."""
+    if not getattr(args, "interactive_users", None):
+        return set()
+    return {u.strip() for u in args.interactive_users.split(",")
+            if u.strip()}
+
+
 def _build_tracer(args, cfg, path, host=None):
     """The obs span tracer for fleet/serve/fabric drivers.  ``run_id``
     derives from (mode, seed) — deterministic, so a restarted run and
@@ -639,7 +727,6 @@ def _run_users_serve(args, cfg, paths, users, pool, anno, hc_table, store,
         AdmissionJournal,
         FleetServer,
         PoisonList,
-        ServeConfig,
     )
 
     experiment = {"seed": cfg.seed, "queries": cfg.queries,
@@ -660,17 +747,8 @@ def _run_users_serve(args, cfg, paths, users, pool, anno, hc_table, store,
         plan_chunk=args.plan_chunk, fuse_step=not args.no_fuse_step,
         tracer=tracer, jax_profile_dir=args.jax_profile,
         jax_profile_n=args.jax_profile_n)
-    server = FleetServer(
-        scheduler,
-        ServeConfig(target_live=args.serve,
-                    admit_window_s=args.admit_window_ms / 1000.0,
-                    bucket_widths=args._bucket_widths,
-                    watchdog_s=args.watchdog_s,
-                    failure_budget=args.failure_budget,
-                    breaker_threshold=args.breaker_threshold,
-                    breaker_cooldown_s=args.breaker_cooldown_s,
-                    breaker_probes=args.breaker_probes),
-        preemption=guard, journal=journal, poison=poison)
+    server = FleetServer(scheduler, _serve_config(args),
+                         preemption=guard, journal=journal, poison=poison)
 
     todo = list(users[: args.max_users])
     if journal is not None and journal.recovered:
@@ -684,6 +762,8 @@ def _run_users_serve(args, cfg, paths, users, pool, anno, hc_table, store,
               f"(skipped), {len(st.in_flight)} in-flight (re-admitted "
               f"first), {len(st.queued)} queued (re-enqueued), "
               f"{len(st.poisoned)} poisoned")
+
+    interactive = _interactive_set(args)
 
     def source():
         # pulled lazily as queue room frees: per-user workspace creation
@@ -709,7 +789,9 @@ def _run_users_serve(args, cfg, paths, users, pool, anno, hc_table, store,
             data = UserData(u_id, sub_pool, labels, hc_rows=hc_rows,
                             store=store)
             yield FleetUser(u_id, committee, data, user_path,
-                            seed=cfg.seed, committee_factory=factory)
+                            seed=cfg.seed, committee_factory=factory,
+                            priority="interactive"
+                            if str(u_id) in interactive else "batch")
 
     failed = []
 
@@ -856,9 +938,11 @@ def _run_users_fabric(args, cfg, paths, users, guard) -> None:
         journal, fabric_dir,
         FabricConfig(hosts=args.hosts, lease_s=args.lease_s),
         poison=poison, report=report, preemption=guard, tracer=tracer)
+    interactive = _interactive_set(args)
     try:
-        summary = coord.run([str(u) for u in users[: args.max_users]],
-                            spawn)
+        summary = coord.run(
+            [str(u) for u in users[: args.max_users]], spawn,
+            classes={u: "interactive" for u in interactive})
     finally:
         tracer.close()
         journal.close()
@@ -893,7 +977,6 @@ def _run_users_fabric_worker(args, cfg, paths, users, pool, anno,
         FleetScheduler,
         FleetUser,
     )
-    from consensus_entropy_tpu.serve import ServeConfig
     from consensus_entropy_tpu.serve.hosts import fabric_paths, run_worker
 
     experiment = {"seed": cfg.seed, "queries": cfg.queries,
@@ -951,16 +1034,7 @@ def _run_users_fabric_worker(args, cfg, paths, users, pool, anno,
     try:
         run_worker(
             args.fabric_dir, args.fabric_worker, build_entry=build_entry,
-            scheduler=scheduler,
-            config=ServeConfig(
-                target_live=args.serve,
-                admit_window_s=args.admit_window_ms / 1000.0,
-                bucket_widths=args._bucket_widths,
-                watchdog_s=args.watchdog_s,
-                failure_budget=args.failure_budget,
-                breaker_threshold=args.breaker_threshold,
-                breaker_cooldown_s=args.breaker_cooldown_s,
-                breaker_probes=args.breaker_probes),
+            scheduler=scheduler, config=_serve_config(args),
             on_result=on_result, lease_s=args.lease_s, preemption=guard)
     finally:
         tracer.close()

@@ -7,7 +7,9 @@ fleet timeline, and:
 
 - prints the text report (per-phase wall-clock breakdown, dispatch
   occupancy, h2d traffic, admission→finish latency percentiles per
-  host, span roll-up);
+  host — overall AND per priority class — plus the SLO planner section:
+  derived bucket edges over time, hold activity, per-bucket occupancy,
+  span roll-up);
 - with ``--out trace.json``, writes the merged Chrome trace-event JSON —
   load it at https://ui.perfetto.dev (or ``chrome://tracing``): one
   process lane per host, one thread lane per user / bucket / run;

@@ -79,11 +79,23 @@ class FleetReport:
         #: serve-layer admission telemetry (empty outside serve mode)
         self.queue_depth = self.metrics.rolling("queue_depth")
         self.admission_wait = self.metrics.rolling("admission_wait_s")
-        #: per-user admission→finish latency (first admit → user_done /
-        #: terminal failure) — log-bucketed histogram with exact
-        #: p50/p95/p99, the SLO-admission prerequisite
+        #: per-user admission-flow latency (FIRST ENQUEUE → user_done /
+        #: terminal failure — queue wait included, the user-observed
+        #: quantity a latency SLO targets and the quantity priority
+        #: classes differentiate; through PR 9 the clock started at
+        #: first admit) — log-bucketed histogram with exact p50/p95/p99
         self.admission_latency = self.metrics.histogram(
             "admission_to_finish_s")
+        #: per-PRIORITY-CLASS admission→finish histograms (the SLO
+        #: planner's acceptance surface: interactive p95 vs batch p95
+        #: under load) — created lazily per class seen at admission
+        self._class_latency: dict[str, object] = {}
+        self._class_of: dict[str, str] = {}
+        #: the serve layer's SLO planner (``serve.planner``), installed
+        #: by ``FleetServer`` so summaries carry its ``planner`` section
+        #: (derived edges, hold activity); None outside planner-enabled
+        #: serve runs — fleet summaries stay byte-stable
+        self.planner = None
         self._admit_t: dict[str, float] = {}
         self._t0 = time.perf_counter()
         self._lock = threading.Lock()
@@ -122,33 +134,48 @@ class FleetReport:
         self._emit({"event": kind, "t_s": round(self.elapsed_s(), 3),
                     **fields})
 
-    def enqueued(self, user, depth: int) -> None:
-        """A user entered the serve-layer waiting queue (depth AFTER).
-        May be called from producer threads (``FleetServer.submit``)."""
+    def enqueued(self, user, depth: int, cls: str = "batch") -> None:
+        """A user entered the serve-layer waiting queue (depth AFTER),
+        in priority class ``cls``.  May be called from producer threads
+        (``FleetServer.submit``).  The FIRST enqueue starts the user's
+        admission-flow latency clock (queue wait counts — it is what
+        priority buys); backoff re-enqueues continue the original one."""
         with self._lock:
             self.queue_depth.add(depth)
-        self.event("enqueue", user=str(user), depth=depth)
+            self._admit_t.setdefault(str(user), time.perf_counter())
+        self.event("enqueue", user=str(user), depth=depth, cls=cls)
 
     def admitted(self, user, *, width: int, wait_s: float, depth: int,
-                 live: int) -> None:
+                 live: int, cls: str = "batch") -> None:
         """A queued user was admitted into the engine: its bucket width,
-        how long it waited in the queue, the queue depth left behind and
-        the live-session count after admission."""
+        priority class, how long it waited in the queue, the queue depth
+        left behind and the live-session count after admission."""
         with self._lock:
             self.admission_wait.add(wait_s)
             self.queue_depth.add(depth)
-            # first admit starts the user's admission→finish latency
-            # clock; backoff re-admissions continue the original one (the
-            # user-observed latency includes its failures)
+            # normally the first ENQUEUE already started the latency
+            # clock; the setdefault covers drivers that admit without
+            # enqueueing (backoff re-admissions continue the original
+            # clock either way — the user-observed latency includes its
+            # failures)
             self._admit_t.setdefault(str(user), time.perf_counter())
+            self._class_of.setdefault(str(user), cls)
+            if cls not in self._class_latency:
+                self._class_latency[cls] = self.metrics.histogram(
+                    f"admission_to_finish_s.{cls}")
         self.event("admit", user=str(user), width=width,
-                   wait_s=round(wait_s, 4), depth=depth, live=live)
+                   wait_s=round(wait_s, 4), depth=depth, live=live,
+                   cls=cls)
 
     def _finish_latency(self, user) -> None:
         with self._lock:
             t = self._admit_t.pop(str(user), None)
             if t is not None:
-                self.admission_latency.add(time.perf_counter() - t)
+                latency = time.perf_counter() - t
+                self.admission_latency.add(latency)
+                cls = self._class_of.get(str(user))
+                if cls in self._class_latency:
+                    self._class_latency[cls].add(latency)
 
     def user_done(self, user, result: dict, phases: dict) -> None:
         """A session finished; ``phases`` are its summed ``{phase}_s``
@@ -321,6 +348,23 @@ class FleetReport:
             # the reservoir holds) — the SLO planner's input; absent
             # outside serve mode so fleet summaries stay byte-stable
             out["admission_to_finish_s"] = self.admission_latency.snapshot()
+        if self._class_latency:
+            # the per-PRIORITY-CLASS shape of the same histogram — the
+            # SLO acceptance surface (interactive p95 <= batch p95 under
+            # load); absent outside class-aware serve runs
+            out["per_class"] = {}
+            for cls, h in sorted(self._class_latency.items()):
+                snap = h.snapshot()
+                # "users" counts RESOLVED users (finished or terminally
+                # failed — the histogram's population), matching its n;
+                # successes alone are the top-level users_done
+                out["per_class"][cls] = {
+                    "users": snap["n"] if snap else 0,
+                    "admission_to_finish_s": snap}
+        if self.planner is not None:
+            # the SLO planner's own section: derived edges, epoch count,
+            # hold activity (serve.planner.AdmissionPlanner.summary)
+            out["planner"] = self.planner.summary()
         return out
 
     def write_summary(self, *, cohort: int, wall_s: float | None = None) -> dict:
@@ -360,6 +404,10 @@ def bench_line(summary: dict, *, baseline_users_per_sec: float | None = None,
         line["transfer"] = summary["transfer"]
     if summary.get("admission_to_finish_s") is not None:
         line["admission_to_finish_s"] = summary["admission_to_finish_s"]
+    if summary.get("per_class") is not None:
+        line["per_class"] = summary["per_class"]
+    if summary.get("planner") is not None:
+        line["planner"] = summary["planner"]
     for key in ("watchdog_evictions", "breaker_trips", "dispatch_failures",
                 "requeues", "users_poisoned"):
         if summary.get(key):

@@ -90,6 +90,11 @@ class FleetUser:
     user_path: str
     seed: int | None = None
     committee_factory: Callable | None = None
+    #: serve-layer admission priority class (``serve.planner.
+    #: PRIORITY_CLASSES``): ``"interactive"`` pops ahead of ``"batch"``
+    #: in the class-aware admission queue and carries a tighter SLO
+    #: target; ignored outside serve mode
+    priority: str = "batch"
 
 
 @dataclasses.dataclass(eq=False)  # identity hash: states live in sets
@@ -138,7 +143,7 @@ class FleetScheduler:
                  stack_cnn: bool = True, plan_chunk: int | None = None,
                  fuse_step: bool = True, tracer=None,
                  jax_profile_dir: str | None = None,
-                 jax_profile_n: int = 10):
+                 jax_profile_n: int = 10, hold=None):
         self.config = config
         self.tie_break = tie_break
         self.retrain_epochs = retrain_epochs
@@ -211,6 +216,16 @@ class FleetScheduler:
         #: of window buys near-full cohort batches — measured occupancy
         #: 0.17→1.0 at cohort 6 with a 10 ms window.
         self.batch_window_s = batch_window_s
+        #: optional ADAPTIVE dispatch-hold policy (``serve.planner.
+        #: AdmissionPlanner`` installs itself here): an object whose
+        #: ``window_s(waiting, host_in_flight)`` returns how long to
+        #: hold a partially-formed stacked dispatch — reduction
+        #: ScoreSteps AND mid-run CNN ``DeviceStep`` cohorts alike —
+        #: while outstanding host steps mean more sessions can still
+        #: join, bounded by per-class SLO headroom.  ``batch_window_s``
+        #: stays a FLOOR (the hold can only extend it); holds change
+        #: when work batches, never per-user results.
+        self.hold = hold
         #: obs span tracer (``obs.trace.Tracer``): sessions open their
         #: user/al_iter spans through it, the scheduler adds the
         #: dispatch-side spans (stacked score/retrain dispatches under
@@ -281,7 +296,11 @@ class FleetScheduler:
             self._live.add(state)
             self._track(state, self._advance(state, value, exc))
         if self._score_wait:
-            if self._host_wait and self._drain_host(self.batch_window_s):
+            window = self.batch_window_s
+            if self.hold is not None:
+                window = max(window, self.hold.window_s(
+                    len(self._score_wait), len(self._host_wait)))
+            if self._host_wait and self._drain_host(window):
                 # sessions finishing host work may be one step from their
                 # own ScoreStep — let them join this batch
                 return True

@@ -27,6 +27,7 @@ from consensus_entropy_tpu.obs.metrics import (  # noqa: F401
     Gauge,
     Histogram,
     MetricsRegistry,
+    QuantileSketch,
     RollingStat,
     StepTimer,
 )

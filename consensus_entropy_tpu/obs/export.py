@@ -25,7 +25,10 @@ import os
 #: but the summary records, which close a stream rather than timestamp a
 #: transition).  README "Observability" renders this as the docs table.
 EVENT_FIELDS = {
-    # admission flow
+    # admission flow (enqueue/admit also carry a ``cls`` priority-class
+    # field since the SLO planner — OPTIONAL here so pre-planner v2
+    # streams keep validating; scripts/slo_check.sh asserts it on
+    # planner runs)
     "enqueue": ("user", "depth"),
     "admit": ("user", "width", "wait_s", "depth", "live"),
     "user_done": ("user",),
@@ -48,6 +51,9 @@ EVENT_FIELDS = {
     "poison": ("user",),
     "drain": (),
     "journal_recover": (),
+    # SLO planner decisions (serve.planner)
+    "planner_edges": ("edges",),
+    "admission_hold": ("window_s",),
     # fabric
     "assign": ("user", "host"),
     "host_up": ("host",),
@@ -230,7 +236,32 @@ def merged_summary(users_dir: str) -> dict:
         "admission_to_finish_s": {
             h: s["admission_to_finish_s"] for h, s in per_host.items()
             if s.get("admission_to_finish_s") is not None},
+        "per_class": {
+            h: s["per_class"] for h, s in per_host.items()
+            if s.get("per_class") is not None},
     }
+    return out
+
+
+def planner_timeline(users_dir: str) -> dict:
+    """The SLO planner's decision history per host, from the schema-v2
+    event stream: every ``planner_edges`` event (derived edges over
+    time) and the ``admission_hold`` count — the ``cetpu-report``
+    planner section's data."""
+    out: dict[str, dict] = {}
+    for path in find_metrics_files(users_dir):
+        host = _host_of_metrics_path(path)
+        edges, holds = [], 0
+        for rec in read_jsonl_tolerant(path):
+            ev = rec.get("event")
+            if ev == "planner_edges":
+                edges.append({"t_s": rec.get("t_s"),
+                              "edges": rec.get("edges"),
+                              "observations": rec.get("observations")})
+            elif ev == "admission_hold":
+                holds += 1
+        if edges or holds:
+            out[host] = {"edges": edges, "admission_holds": holds}
     return out
 
 
@@ -269,6 +300,33 @@ def text_report(users_dir: str) -> str:
             lines.append(f"    admission→finish p50={lat.get('p50')}s "
                          f"p95={lat.get('p95')}s p99={lat.get('p99')}s "
                          f"(n={lat.get('n')})")
+        per_class = s.get("per_class") or {}
+        for cls, c in sorted(per_class.items()):
+            clat = c.get("admission_to_finish_s") or {}
+            lines.append(f"      [{cls}] users={c.get('users')} "
+                         f"p50={clat.get('p50')}s p95={clat.get('p95')}s "
+                         f"p99={clat.get('p99')}s")
+        planner = s.get("planner")
+        if planner is not None:
+            lines.append(f"    planner: edges={planner.get('edges')} "
+                         f"({planner.get('edge_updates')} update(s) over "
+                         f"{planner.get('observations')} obs), holds: "
+                         f"admission={planner.get('admission_hold_rounds')}"
+                         f" dispatch={planner.get('dispatch_hold_rounds')}")
+        per_bucket = s.get("per_bucket") or {}
+        for width, b in sorted(per_bucket.items(),
+                               key=lambda kv: int(kv[0])):
+            lines.append(f"      bucket {width}: occupancy="
+                         f"{b.get('occupancy')} mean_batch="
+                         f"{b.get('mean_batch')} "
+                         f"dispatches={b.get('dispatches')}")
+    timeline = planner_timeline(users_dir)
+    for host, t in sorted(timeline.items()):
+        if t["edges"]:
+            lines.append(f"planner edges over time [{host}]:")
+            for e in t["edges"]:
+                lines.append(f"    t={e.get('t_s')}s -> {e.get('edges')} "
+                             f"(after {e.get('observations')} obs)")
     spans = load_spans(find_span_files(users_dir))
     if spans:
         by_name: dict[str, list[float]] = {}

@@ -256,6 +256,71 @@ class Histogram:
         return out
 
 
+class QuantileSketch(Histogram):
+    """A MERGEABLE :class:`Histogram`: the SLO admission planner's view of
+    the enqueue-time pool-size distribution (``serve.planner``).
+
+    Same accounting as the parent — numpy-exact percentiles while the
+    reservoir holds (``n <= max_samples``), log-bucket upper edges after —
+    plus the two capabilities the planner needs:
+
+    - :meth:`merge` folds another sketch in (fabric hosts each sketch
+      their own admission stream; a merged view is one ``merge`` chain).
+      Merging is ASSOCIATIVE: bucket counts add, and the exact reservoir
+      survives iff the combined count still fits the bound — a decision
+      that depends only on the total, not the merge order (pinned in
+      ``tests/test_slo.py``).
+    - :meth:`to_dict` / :meth:`from_dict` round-trip the full state, so
+      the admission journal's planner records can carry the sketch and a
+      restarted server re-derives IDENTICAL bucket edges from replay.
+    """
+
+    def merge(self, other: "QuantileSketch") -> "QuantileSketch":
+        if (other.growth != self.growth
+                or other.max_samples != self.max_samples):
+            raise ValueError("cannot merge sketches with different "
+                             "growth/max_samples geometry")
+        if not other.n:
+            return self
+        self.n += other.n
+        self.total += other.total
+        self.min = other.min if self.min is None \
+            else min(self.min, other.min)
+        self.max = other.max if self.max is None \
+            else max(self.max, other.max)
+        for i, c in other._buckets.items():
+            self._buckets[i] = self._buckets.get(i, 0) + c
+        if (self._samples is not None and other._samples is not None
+                and self.n <= self.max_samples):
+            self._samples = self._samples + other._samples
+        else:
+            self._samples = None  # combined stream past the exact bound
+        return self
+
+    def to_dict(self) -> dict:
+        return {"growth": self.growth, "max_samples": self.max_samples,
+                "n": self.n, "total": self.total, "min": self.min,
+                "max": self.max,
+                "buckets": {str(i): c for i, c in self._buckets.items()},
+                "samples": (list(self._samples)
+                            if self._samples is not None else None)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "QuantileSketch":
+        sk = cls(growth=float(d.get("growth", 2 ** 0.25)),
+                 max_samples=int(d.get("max_samples", 4096)))
+        sk.n = int(d.get("n", 0))
+        sk.total = float(d.get("total", 0.0))
+        sk.min = d.get("min")
+        sk.max = d.get("max")
+        sk._buckets = {int(i): int(c)
+                       for i, c in (d.get("buckets") or {}).items()}
+        samples = d.get("samples")
+        sk._samples = [float(v) for v in samples] \
+            if samples is not None else None
+        return sk
+
+
 class MetricsRegistry:
     """Name-keyed metric instances; get-or-create, type-checked.
 

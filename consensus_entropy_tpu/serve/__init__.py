@@ -60,7 +60,18 @@ including eviction+resume, restart recovery and degraded dispatch, by
 """
 
 from consensus_entropy_tpu.serve.breaker import DispatchBreaker
-from consensus_entropy_tpu.serve.buckets import BucketRouter
+from consensus_entropy_tpu.serve.buckets import (
+    BucketRouter,
+    validate_bucket_widths,
+)
+from consensus_entropy_tpu.serve.planner import (
+    DEFAULT_CLASS,
+    PRIORITY_CLASSES,
+    AdmissionPlanner,
+    admission_hold,
+    derive_edges,
+    dispatch_hold,
+)
 from consensus_entropy_tpu.serve.fabric import (
     FabricConfig,
     FabricCoordinator,
@@ -83,9 +94,11 @@ from consensus_entropy_tpu.serve.server import (
 )
 from consensus_entropy_tpu.serve.watchdog import Watchdog, WatchdogTimeout
 
-__all__ = ["AdmissionJournal", "AdmissionQueue", "BucketRouter",
-           "DispatchBreaker", "FabricConfig", "FabricCoordinator",
-           "FabricError", "FleetServer", "HostLease", "JournalState",
-           "JsonlTail", "PoisonList", "QueueClosed", "QueueFull",
+__all__ = ["AdmissionJournal", "AdmissionPlanner", "AdmissionQueue",
+           "BucketRouter", "DEFAULT_CLASS", "DispatchBreaker",
+           "FabricConfig", "FabricCoordinator", "FabricError",
+           "FleetServer", "HostLease", "JournalState", "JsonlTail",
+           "PRIORITY_CLASSES", "PoisonList", "QueueClosed", "QueueFull",
            "ServeConfig", "SingleWriterViolation", "Watchdog",
-           "WatchdogTimeout", "run_worker"]
+           "WatchdogTimeout", "admission_hold", "derive_edges",
+           "dispatch_hold", "run_worker", "validate_bucket_widths"]

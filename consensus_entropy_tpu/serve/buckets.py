@@ -32,6 +32,41 @@ def next_pow2(n: int) -> int:
     return max(PAD_MULTIPLE, 1 << (max(int(n), 1) - 1).bit_length())
 
 
+def validate_bucket_widths(widths) -> tuple[int, ...]:
+    """Validate EXPLICIT operator bucket edges at configuration time
+    (``ServeConfig`` construction and the CLI's ``--bucket-widths``
+    parse) instead of silently repairing them at routing time.
+
+    Each edge must be a positive int; the sequence must be strictly
+    ascending (sorted AND unique) as given — an out-of-order or
+    duplicated list is a typo'd geometry, and silently sorting it hides
+    which jit family the operator actually provisioned.  Two edges that
+    collapse onto the same ``PAD_MULTIPLE`` multiple are rejected for
+    the same reason: both would silently route to one family.  Pools
+    wider than every edge remain HANDLED — they fall through to the
+    power-of-two overflow (:meth:`BucketRouter.width_for`), so no edge
+    list can misroute an oversized user.  Returns the validated tuple.
+    """
+    edges = tuple(widths)
+    if not edges:
+        raise ValueError("bucket widths must be a non-empty sequence of "
+                         "positive ints")
+    for w in edges:
+        if not isinstance(w, int) or isinstance(w, bool) or w <= 0:
+            raise ValueError(f"bucket widths must be positive ints, "
+                             f"got {w!r} in {list(edges)!r}")
+    if list(edges) != sorted(set(edges)):
+        raise ValueError(f"bucket widths must be strictly ascending "
+                         f"(sorted, unique), got {list(edges)!r}")
+    rounded = [_round_up(w, PAD_MULTIPLE) for w in edges]
+    if len(set(rounded)) != len(rounded):
+        raise ValueError(
+            f"bucket widths {list(edges)!r} collapse onto the same "
+            f"PAD_MULTIPLE={PAD_MULTIPLE} edge(s) {sorted(set(rounded))!r}"
+            " — each edge must provision a distinct jit family")
+    return edges
+
+
 class BucketRouter:
     """Maps a user's pool size to its admission bucket width.
 
@@ -52,6 +87,15 @@ class BucketRouter:
                 raise ValueError(f"bucket widths must be positive ints, "
                                  f"got {widths!r}")
             self.widths = tuple(edges)
+
+    def update(self, widths) -> None:
+        """Replace the edge set IN PLACE — the SLO planner's seam
+        (``serve.planner``): edges derived from the observed pool-size
+        distribution take effect for future admissions, while users
+        already admitted keep their pinned pad (the router is consulted
+        once, at admission).  ``widths`` are planner-derived (already
+        ``PAD_MULTIPLE``-rounded, ascending, unique)."""
+        self.widths = tuple(int(w) for w in widths)
 
     def width_for(self, n_songs: int) -> int:
         """The bucket edge this pool size pads to."""

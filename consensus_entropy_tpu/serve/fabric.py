@@ -161,11 +161,18 @@ class FabricCoordinator:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def run(self, user_ids, spawn) -> dict:
+    def run(self, user_ids, spawn, *, classes: dict | None = None) -> dict:
         """Serve ``user_ids`` across ``config.hosts`` workers; returns a
         summary dict.  ``spawn(host_id) -> Popen``-like launches one
         worker process (the CLI re-execs itself with ``--fabric-worker``;
         tests launch a synthetic-workload script).
+
+        ``classes``: optional ``{user_id: priority_class}`` — carried on
+        the journal's ``enqueue`` records and every assignment-feed line,
+        so each worker's class-aware admission queue and per-class SLO
+        histograms see the same classes the operator submitted; the
+        journal's record wins for users it has already seen (restart /
+        failover keeps first-submit classes).
 
         Any escaping ``BaseException`` (injected coordinator kill,
         Ctrl-C) SIGKILLs every worker first — mirroring the orphan-exit
@@ -179,6 +186,7 @@ class FabricCoordinator:
                 in_flight=len(st.in_flight), queued=len(st.queued),
                 poisoned=len(st.poisoned))
         pending: list[str] = []
+        classes = {str(u): c for u, c in (classes or {}).items()}
         for u in st.recovery_order([str(u) for u in user_ids]):
             if u in st.finished:
                 self.report.event("skip_done", user=u)
@@ -187,7 +195,9 @@ class FabricCoordinator:
                 self.report.event("skip_poisoned", user=u)
                 continue
             if st.last.get(u) in (None, "unpoison"):
-                self.journal.append("enqueue", u)
+                cls = st.classes.get(u) or classes.get(u)
+                self.journal.append(
+                    "enqueue", u, **({"cls": cls} if cls else {}))
             pending.append(u)
         self._submitted = list(pending)
         self._unresolved = set(pending)
@@ -416,7 +426,11 @@ class FabricCoordinator:
         # enqueue/fail, so the restarted coordinator re-routes it
         faults.fire("fabric.assign", user=user, host=h.host_id)
         self.journal.append("assign", user, host=h.host_id)
-        h.assign.append({"user": user})
+        # the assignment feed carries the user's priority class so the
+        # worker's class-aware queue pops it correctly (failover
+        # included — the journal remembers first-submit classes)
+        cls = self.journal.state.classes.get(user)
+        h.assign.append({"user": user, **({"cls": cls} if cls else {})})
         self.report.event("assign", user=user, host=h.host_id)
 
     def _transcribe(self, h: HostHandle) -> None:

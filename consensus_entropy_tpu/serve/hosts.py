@@ -205,6 +205,10 @@ def run_worker(fabric_dir: str, host_id: str, *, build_entry, scheduler,
                     # workspace already complete: resolve without a slot
                     journal.append("finish", uid, skipped=True)
                     continue
+                if isinstance(rec.get("cls"), str):
+                    # the coordinator routed the priority class along
+                    # with the user (serve.planner classes)
+                    entry.priority = rec["cls"]
                 while not stop.is_set():
                     try:
                         server.submit(entry)

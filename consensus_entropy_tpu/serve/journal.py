@@ -72,6 +72,10 @@ EVENTS = ("enqueue", "admit", "finish", "fail", "poison", "unpoison",
           "assign")
 #: host-membership records (fabric): no user field
 HOST_EVENTS = ("lease", "revoke")
+#: SLO-planner epoch records (no user field): ``edges`` (the derived
+#: bucket edges in force) + ``sketch`` (the quantile-sketch state), so a
+#: restarted server re-derives IDENTICAL routing from replay alone
+PLANNER_EVENTS = ("planner",)
 
 
 class JournalState:
@@ -95,6 +99,17 @@ class JournalState:
         self.assigned: dict[str, str] = {}
         self.hosts: dict[str, str] = {}
         self.host_cursor: dict[str, int] = {}
+        #: SLO admission state (serve.planner): each user's priority
+        #: class (from enqueue records) and admitted bucket width (from
+        #: admit records) — restarts re-pin both; plus the last planner
+        #: epoch's edges + sketch and the enqueue-time pool sizes
+        #: journaled SINCE it (the bounded replay tail the restarted
+        #: planner re-observes)
+        self.classes: dict[str, str] = {}
+        self.widths: dict[str, int] = {}
+        self.planner_edges: list | None = None
+        self.planner_sketch: dict | None = None
+        self.pool_obs: list[int] = []
         self._enqueue_seq: dict[str, int] = {}
         self._admit_seq: dict[str, int] = {}
         self._seq = 0
@@ -106,7 +121,8 @@ class JournalState:
 
     def apply(self, rec: dict) -> None:
         event = rec.get("event")
-        if event not in EVENTS and event not in HOST_EVENTS:
+        if event not in EVENTS and event not in HOST_EVENTS \
+                and event not in PLANNER_EVENTS:
             return  # foreign/corrupt line: disposition unchanged
         seq = rec.get("seq")
         if isinstance(seq, int):
@@ -123,6 +139,17 @@ class JournalState:
             if isinstance(host, str):
                 self.hosts[host] = event
             return
+        if event in PLANNER_EVENTS:
+            edges = rec.get("edges")
+            if isinstance(edges, list):
+                self.planner_edges = [int(e) for e in edges]
+            sketch = rec.get("sketch")
+            self.planner_sketch = sketch if isinstance(sketch, dict) \
+                else None
+            # the sketch covers everything observed so far: the replay
+            # tail restarts empty
+            self.pool_obs = []
+            return
         user = rec.get("user")
         if not isinstance(user, str):
             return
@@ -135,9 +162,15 @@ class JournalState:
         self.last[user] = event
         if event == "enqueue":
             self._enqueue_seq[user] = self._seq
+            if isinstance(rec.get("cls"), str):
+                self.classes[user] = rec["cls"]
+            if isinstance(rec.get("pool"), int):
+                self.pool_obs.append(rec["pool"])
         elif event == "admit":
             self.admits[user] = self.admits.get(user, 0) + 1
             self._admit_seq.setdefault(user, self._seq)
+            if isinstance(rec.get("width"), int):
+                self.widths[user] = rec["width"]
         elif event == "fail":
             self.fails[user] = self.fails.get(user, 0) + 1
         elif event == "unpoison":
@@ -209,6 +242,10 @@ class JournalState:
                 "admits": dict(self.admits), "fails": dict(self.fails),
                 "assigned": dict(self.assigned), "hosts": dict(self.hosts),
                 "host_cursor": dict(self.host_cursor),
+                "classes": dict(self.classes), "widths": dict(self.widths),
+                "planner_edges": self.planner_edges,
+                "planner_sketch": self.planner_sketch,
+                "pool_obs": list(self.pool_obs),
                 "enqueue_seq": dict(self._enqueue_seq),
                 "admit_seq": dict(self._admit_seq)}
 
@@ -223,6 +260,14 @@ class JournalState:
         st.hosts = dict(d.get("hosts", {}))
         st.host_cursor = {k: int(v)
                           for k, v in d.get("host_cursor", {}).items()}
+        st.classes = dict(d.get("classes", {}))
+        st.widths = {k: int(v) for k, v in d.get("widths", {}).items()}
+        edges = d.get("planner_edges")
+        st.planner_edges = [int(e) for e in edges] \
+            if isinstance(edges, list) else None
+        sketch = d.get("planner_sketch")
+        st.planner_sketch = sketch if isinstance(sketch, dict) else None
+        st.pool_obs = [int(p) for p in d.get("pool_obs", [])]
         st._enqueue_seq = {k: int(v)
                            for k, v in d.get("enqueue_seq", {}).items()}
         st._admit_seq = {k: int(v)
@@ -456,6 +501,9 @@ class AdmissionJournal:
         if event in HOST_EVENTS:
             if not isinstance(fields.get("host"), str):
                 raise ValueError(f"journal event {event!r} needs host=")
+        elif event in PLANNER_EVENTS:
+            if not isinstance(fields.get("edges"), list):
+                raise ValueError(f"journal event {event!r} needs edges=")
         elif event not in EVENTS:
             raise ValueError(f"unknown journal event {event!r}")
         elif user is None:
@@ -479,6 +527,29 @@ class AdmissionJournal:
         thread)."""
         with self._lock:
             return self.state.last.get(str(user)) == "finish"
+
+    def class_of(self, user) -> str | None:
+        """The user's journaled priority class (thread-safe — ``submit``
+        runs on producer threads): a re-submitted user keeps the class
+        its first enqueue recorded, across restarts."""
+        with self._lock:
+            return self.state.classes.get(str(user))
+
+    def width_of(self, user) -> int | None:
+        """The user's journaled admission bucket width: a restart
+        re-admits at exactly this pad even if the planner's edges have
+        since moved (per-RUN pad pinning survives the process)."""
+        with self._lock:
+            return self.state.widths.get(str(user))
+
+    def planner_state(self) -> tuple:
+        """``(edges, sketch_dict, pool_obs)`` — the planner-restore
+        snapshot: the last journaled epoch plus the enqueue pool sizes
+        journaled after it."""
+        with self._lock:
+            st = self.state
+            return (list(st.planner_edges) if st.planner_edges else None,
+                    st.planner_sketch, list(st.pool_obs))
 
     def compact(self) -> None:
         """Checkpoint the replayed state and truncate the journal.

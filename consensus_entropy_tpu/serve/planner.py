@@ -1,0 +1,332 @@
+"""SLO-aware admission: adaptive bucket edges, priority classes, holds.
+
+Before this module the serve layer's admission policy was CONFIGURED:
+operators guessed bucket edges (``--bucket-widths``), every user was one
+class, and the gang window was a fixed ``--admit-window-ms``.  The
+observed result (BENCH_serve_r07) was stacked dispatches that don't fill
+— the committee-scoring throughput the stacked device path buys sits idle
+behind mis-sized buckets and mis-phased admissions.  This module makes
+admission LEARN from the telemetry the stack already records:
+
+- **Adaptive bucket edges** — a mergeable :class:`~consensus_entropy_tpu.
+  obs.metrics.QuantileSketch` over enqueue-time pool sizes; every
+  ``planner_epoch`` observations, :func:`derive_edges` turns its
+  quantiles into bucket edges (rounded to ``PAD_MULTIPLE``, deduped) and
+  the live :class:`~consensus_entropy_tpu.serve.buckets.BucketRouter` is
+  updated in place.  Edges only apply to FUTURE admissions — an admitted
+  user's pad stays pinned for the run (and its journaled ``admit`` width
+  re-pins it across restarts).  Every epoch is journaled as a ``planner``
+  record carrying the edges AND the sketch state, so a restarted server
+  re-derives IDENTICAL routing from replay: restore the last journaled
+  sketch, re-observe the enqueue pool sizes journaled after it, done.
+- **Priority classes** — :data:`PRIORITY_CLASSES` (``interactive`` ahead
+  of ``batch``); the admission queue pops strict-priority WITH AGING (a
+  ``batch`` user waiting past ``aging_s`` jumps a fresh ``interactive``
+  one, so strict priority cannot starve), classes ride the journal's
+  ``enqueue`` records and the fabric assignment feeds, and per-class
+  admission→finish histograms extend the schema-v2 metrics stream.
+- **Predictive batch-forming** — the fixed windows become ADAPTIVE holds,
+  pure functions of observed telemetry: :func:`admission_hold` holds
+  intake-side admission only while the predicted marginal arrival wait
+  (inter-enqueue EMA) would raise the admission gang without breaching
+  the most-constrained waiter's SLO headroom; :func:`dispatch_hold`
+  holds a partially-formed stacked dispatch (reduction ScoreSteps AND
+  mid-run CNN ``DeviceStep`` cohorts — the scheduler consults the same
+  policy) only while outstanding host steps mean more sessions can still
+  join, again bounded by SLO headroom.  Holds change WHEN work batches,
+  never what it computes: per-user results stay bit-identical to the
+  sequential path (pinned across all six acquisition modes in
+  ``tests/test_slo.py``).
+
+``--no-slo-planner`` (``ServeConfig.slo_planner=False``) keeps the PR 3
+fixed-window arm — the baseline ``bench.py --suite slo`` races against.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+from consensus_entropy_tpu.obs.metrics import QuantileSketch
+from consensus_entropy_tpu.serve.buckets import PAD_MULTIPLE
+from consensus_entropy_tpu.utils import round_up as _round_up
+
+#: admission priority classes, HIGHEST priority first.  ``interactive``
+#: (latency-sensitive, tight SLO) pops ahead of ``batch`` (throughput
+#: work, loose SLO) unless aging promotes a starved ``batch`` entry.
+PRIORITY_CLASSES = ("interactive", "batch")
+
+#: the class an unclassified user lands in (the pre-class behavior:
+#: every user equal, FIFO)
+DEFAULT_CLASS = "batch"
+
+
+def derive_edges(sketch, *, n_buckets: int = 4,
+                 pad_multiple: int = PAD_MULTIPLE) -> tuple:
+    """Bucket edges from the observed pool-size distribution: the
+    ``i/n``-quantiles (``i = 1..n_buckets``, so the top edge is the
+    observed max), each rounded UP to ``pad_multiple``, deduped and
+    sorted.  Deterministic given the sketch state — numpy-exact while the
+    sketch's reservoir holds, bucket upper edges (conservative: wider,
+    never tighter) after.  Pools above every edge still fall through to
+    the router's power-of-two overflow, so routing stays total."""
+    if not sketch.n:
+        return ()
+    edges = set()
+    for i in range(1, n_buckets + 1):
+        q = sketch.percentile(100.0 * i / n_buckets)
+        if q is not None and q > 0:
+            edges.add(_round_up(int(math.ceil(q)), pad_multiple))
+    return tuple(sorted(e for e in edges if e > 0))
+
+
+def admission_hold(*, free: int, queued: int, gap_s: float | None,
+                   headroom_s: float, max_hold_s: float) -> float:
+    """Seconds to hold intake-side admission open for further arrivals.
+
+    Queueing-theory batch-forming, reduced to its decision kernel: hold
+    only while the predicted marginal wait buys occupancy —
+
+    - ``queued >= free``: the gang already fills every free slot; one
+      more arrival cannot raise this admission's occupancy → 0.
+    - ``gap_s`` (the observed inter-arrival EMA) is unknown or exceeds
+      the SLO ``headroom_s`` of the most-constrained waiter: the
+      predicted wait would breach (or is unpredictable) → 0.
+    - otherwise hold for the predicted time to fill the remaining slots
+      (``gap_s * (free - queued)``), clamped by the headroom and the
+      operator cap.
+
+    Pure — every input is observed telemetry, so decisions replay
+    deterministically and pin in unit tests."""
+    if free <= 0 or queued >= free:
+        return 0.0
+    if headroom_s <= 0 or gap_s is None or gap_s > headroom_s:
+        return 0.0
+    return min(gap_s * (free - queued), headroom_s, max_hold_s)
+
+
+def dispatch_hold(*, waiting: int, host_in_flight: int,
+                  headroom_s: float, max_hold_s: float) -> float:
+    """Seconds to hold a partially-formed stacked dispatch.
+
+    A session can only join the waiting batch by finishing an
+    outstanding host step, so the predictor is structural: with
+    ``host_in_flight == 0`` nothing more can join (hold buys nothing →
+    0); with host work outstanding, holding raises expected occupancy —
+    hold up to the SLO ``headroom_s`` of the most-constrained live user,
+    clamped by the operator cap.  Applies identically to reduction
+    ScoreSteps and mid-run CNN ``DeviceStep`` cohorts (both wait in the
+    scheduler's score-wait list).  Pure, like :func:`admission_hold`."""
+    if waiting <= 0 or host_in_flight <= 0:
+        return 0.0
+    if headroom_s <= 0:
+        return 0.0
+    return min(headroom_s, max_hold_s)
+
+
+class AdmissionPlanner:
+    """The serve layer's learning admission policy (see module doc).
+
+    One planner per :class:`~consensus_entropy_tpu.serve.server.
+    FleetServer`; the server feeds it enqueue/admit/finish transitions
+    and consults it for the admission hold, the router consults it
+    (indirectly — the planner updates the router in place) for edges,
+    and the scheduler consults :meth:`window_s` for the dispatch hold.
+
+    ``journal``: the admission journal (may be ``None``); construction
+    RESTORES from its replayed state — last journaled sketch + the
+    enqueue pool sizes journaled after it — so edges re-derive
+    identically across restarts.  ``clock`` is injectable for tests.
+    """
+
+    def __init__(self, config, *, router, journal=None, report=None,
+                 clock=time.monotonic):
+        self.slo = {"interactive": config.slo_interactive_s,
+                    "batch": config.slo_batch_s}
+        self.epoch = config.planner_epoch
+        self.n_buckets = config.planner_buckets
+        self.max_hold_s = config.max_hold_s
+        #: explicit operator edges win: the planner still sketches (and
+        #: journals) but never overrides a configured router
+        self.adapt_edges = config.bucket_widths is None
+        self.router = router
+        self.journal = journal
+        self.report = report
+        self._clock = clock
+        self.sketch = QuantileSketch()
+        self.edges: tuple = ()
+        self.edge_updates = 0
+        self.admission_hold_rounds = 0
+        self.dispatch_hold_rounds = 0
+        self._holding = False
+        self._gap_ema: float | None = None
+        self._last_enq_t: float | None = None
+        #: live (admitted, unfinished) users: uid -> (class, admit_t)
+        self._live: dict[str, tuple] = {}
+        #: enqueue observations arrive from producer threads
+        #: (``FleetServer.submit``) AND the serve loop — one lock covers
+        #: the sketch, the arrival EMA and the epoch derivation (which
+        #: appends to the journal; the journal has its own lock)
+        self._lock = threading.Lock()
+        #: True while :meth:`_restore` replays the journal tail —
+        #: derivations then update state but never journal (see
+        #: _restore's ordering note)
+        self._restoring = False
+        if journal is not None:
+            self._restore()
+
+    # -- restart restore ---------------------------------------------------
+
+    def _restore(self) -> None:
+        """Rebuild the planner from the replayed journal: the last
+        ``planner`` record's sketch + edges, then the enqueue pool sizes
+        journaled after it (re-observed through the normal path, so an
+        epoch boundary the crash interrupted re-derives now).
+
+        Journaling is SUPPRESSED while the tail replays — a planner
+        record appended mid-restore would land AFTER enqueue records it
+        does not cover (the tail's remainder), and the next replay's
+        ``pool_obs`` reset at that record would silently drop them.
+        Instead, ONE covering record is appended after the whole tail
+        re-observed, so every planner record in the file covers every
+        enqueue record before it; a crash mid-restore appends nothing
+        and the next restore repeats deterministically."""
+        edges, sketch, pool_obs = self.journal.planner_state()
+        if sketch:
+            self.sketch = QuantileSketch.from_dict(sketch)
+        if edges and self.adapt_edges:
+            # explicit operator edges win even over a journal written by
+            # an earlier adaptive run — never restore edges the router
+            # is not using
+            self.edges = tuple(int(e) for e in edges)
+            self.router.update(self.edges)
+        self._restoring = True
+        try:
+            for pool in pool_obs:
+                self.observe_enqueue(pool)
+        finally:
+            self._restoring = False
+        if pool_obs:
+            with self._lock:
+                self.journal.append("planner", edges=list(self.edges),
+                                    sketch=self.sketch.to_dict())
+
+    # -- telemetry intake --------------------------------------------------
+
+    def observe_enqueue(self, pool_size, t: float | None = None,
+                        journal_entry=None) -> None:
+        """One enqueue observation: fold the pool size into the sketch
+        (deriving + journaling edges at epoch boundaries) and, when a
+        wall-time ``t`` is given (live enqueues — replay passes none),
+        update the inter-arrival EMA the admission hold predicts with.
+
+        ``journal_entry``: nullary callable appending the enqueue's OWN
+        journal record — run inside this planner's lock, immediately
+        before the observation, so the two commit atomically: a planner
+        epoch record can then never omit an enqueue journaled before it
+        (concurrent producers would otherwise race the epoch boundary
+        and break the restart-identical-edges contract)."""
+        with self._lock:
+            if journal_entry is not None:
+                journal_entry()
+            if t is not None:
+                if self._last_enq_t is not None:
+                    gap = max(t - self._last_enq_t, 0.0)
+                    self._gap_ema = gap if self._gap_ema is None \
+                        else 0.3 * gap + 0.7 * self._gap_ema
+                self._last_enq_t = t
+            if pool_size is None:
+                return
+            self.sketch.add(int(pool_size))
+            if self.sketch.n % self.epoch == 0:
+                self._derive()
+
+    def _derive(self) -> None:
+        """One planner epoch: re-derive edges from the sketch, update the
+        live router on change, and journal the epoch (edges + sketch
+        state) so replay reconstructs this exact planner.  The journal
+        record is appended even when the edges did not change — it resets
+        the replay tail (``pool_obs``) and bounds what a restart must
+        re-observe; the metrics event fires only on change.  With
+        explicit operator edges (``adapt_edges=False``) no edges are
+        derived or reported at all — the sketch still journals, but the
+        planner never claims edges the router is not using."""
+        if self.adapt_edges:
+            edges = derive_edges(self.sketch, n_buckets=self.n_buckets)
+            if edges and edges != self.edges:
+                self.edges = edges
+                self.edge_updates += 1
+                self.router.update(edges)
+                if self.report is not None:
+                    self.report.event("planner_edges", edges=list(edges),
+                                      observations=self.sketch.n)
+        if self.journal is not None and not self._restoring:
+            self.journal.append("planner", edges=list(self.edges),
+                                sketch=self.sketch.to_dict())
+
+    def note_admit(self, user, cls: str, waited_s: float = 0.0) -> None:
+        """The user took a slot; ``waited_s`` is the queue wait it
+        already spent — the SLO latency clock starts at enqueue, so the
+        user's headroom is back-dated by the wait (a user that queued
+        55 s of a 60 s SLO has 5 s of hold headroom left, not 60)."""
+        self._live[str(user)] = (cls, self._clock() - max(waited_s, 0.0))
+
+    def note_resolved(self, user) -> None:
+        """The user finished or failed terminally: its SLO clock stops
+        constraining holds."""
+        self._live.pop(str(user), None)
+
+    # -- hold decisions ----------------------------------------------------
+
+    def headroom_s(self, head_waits: dict | None = None) -> float:
+        """SLO headroom of the most-constrained user a hold would delay:
+        min over live (admitted) users of ``slo[class] - age``, and over
+        the queue heads' ``(class, waited)`` pairs when given.  With
+        nobody to constrain, the loosest class target."""
+        now = self._clock()
+        default = min(self.slo.values())
+        vals = [self.slo.get(cls, default) - (now - t)
+                for cls, t in self._live.values()]
+        for cls, waited in (head_waits or {}).items():
+            vals.append(self.slo.get(cls, default) - waited)
+        return min(vals) if vals else max(self.slo.values())
+
+    def admission_hold_s(self, *, free: int, queued: int,
+                         head_waits: dict | None = None) -> float:
+        hold = admission_hold(free=free, queued=queued,
+                              gap_s=self._gap_ema,
+                              headroom_s=self.headroom_s(head_waits),
+                              max_hold_s=self.max_hold_s)
+        if hold > 0:
+            self.admission_hold_rounds += 1
+        return hold
+
+    def window_s(self, waiting: int, host_in_flight: int) -> float:
+        """The scheduler-side dispatch-hold policy (installed as
+        ``FleetScheduler.hold``): see :func:`dispatch_hold`.  The
+        counter counts hold PERIODS (a 0→held transition), not pump
+        consults — the scheduler re-asks every loop round while one
+        hold is in progress."""
+        hold = dispatch_hold(waiting=waiting,
+                             host_in_flight=host_in_flight,
+                             headroom_s=self.headroom_s(),
+                             max_hold_s=self.max_hold_s)
+        if hold > 0 and not self._holding:
+            self.dispatch_hold_rounds += 1
+        self._holding = hold > 0
+        return hold
+
+    # -- reporting ---------------------------------------------------------
+
+    def summary(self) -> dict:
+        """The ``planner`` section of the fleet summary (and bench
+        lines): current edges, derivation and hold activity."""
+        return {
+            "edges": list(self.edges) if self.edges else None,
+            "edge_updates": self.edge_updates,
+            "observations": self.sketch.n,
+            "admission_hold_rounds": self.admission_hold_rounds,
+            "dispatch_hold_rounds": self.dispatch_hold_rounds,
+            "slo_s": dict(sorted(self.slo.items())),
+        }

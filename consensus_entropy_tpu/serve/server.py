@@ -52,6 +52,15 @@ itself:
   stacked-dispatch failures degrade that bucket width to per-user
   dispatch until a half-open probe recovers it (``serve.breaker``).
 
+**SLO-aware admission** (the :mod:`serve.planner` tentpole) makes the
+policy LEARN instead of being configured: bucket edges derive online
+from a quantile sketch of enqueue-time pool sizes (journaled per epoch,
+so restarts re-derive identical routing), the queue is priority-class
+aware (``interactive`` ahead of ``batch``, with anti-starvation aging),
+and the fixed admission/batch windows become adaptive holds bounded by
+per-class SLO headroom.  ``--no-slo-planner`` keeps the fixed-window
+arm; per-user results are bit-identical either way.
+
 Sessions run WITHOUT the guard (the server owns preemption), so a drain
 finishes in-flight work instead of tearing it down mid-iteration — the
 constructor rejects a scheduler that would hand the guard to sessions.
@@ -70,8 +79,16 @@ from consensus_entropy_tpu.fleet.scheduler import FleetScheduler, FleetUser
 from consensus_entropy_tpu.resilience import faults
 from consensus_entropy_tpu.resilience.retry import backoff_delay
 from consensus_entropy_tpu.serve.breaker import DispatchBreaker
-from consensus_entropy_tpu.serve.buckets import BucketRouter
+from consensus_entropy_tpu.serve.buckets import (
+    BucketRouter,
+    validate_bucket_widths,
+)
 from consensus_entropy_tpu.serve.journal import PoisonList
+from consensus_entropy_tpu.serve.planner import (
+    DEFAULT_CLASS,
+    PRIORITY_CLASSES,
+    AdmissionPlanner,
+)
 from consensus_entropy_tpu.serve.watchdog import Watchdog
 
 
@@ -111,6 +128,21 @@ class ServeConfig:
     stays degraded to per-user dispatch before a half-open probe;
     ``breaker_probes``: failed half-open probes before the width is given
     up (stays per-user) for the rest of the run (0 probes forever).
+
+    SLO-planner knobs (``serve.planner``; ``slo_planner=False`` keeps
+    the fixed-window arm throughout):
+    ``planner_epoch``: enqueue observations between bucket-edge
+    re-derivations; ``planner_buckets``: quantile edges derived per
+    epoch (the top edge is the observed max).  With explicit
+    ``bucket_widths`` the planner never overrides them (operator edges
+    win; classes + holds stay active).  ``slo_interactive_s`` /
+    ``slo_batch_s``: per-class admission→finish latency targets — the
+    headroom every adaptive hold is bounded by.  ``aging_s``: queue-wait
+    past which a lower-priority user jumps strict-priority pop (the
+    starvation guard; 0 = pure strict priority).  ``max_hold_s``: cap on
+    any single adaptive hold (explicit ``admit_window_s`` /
+    ``batch_window_s`` remain honored as FLOORS — the planner can only
+    hold longer, and only inside SLO headroom).
     """
 
     target_live: int = 4
@@ -125,6 +157,13 @@ class ServeConfig:
     breaker_threshold: int = 0
     breaker_cooldown_s: float = 30.0
     breaker_probes: int = 0
+    slo_planner: bool = True
+    planner_epoch: int = 8
+    planner_buckets: int = 4
+    slo_interactive_s: float = 60.0
+    slo_batch_s: float = 600.0
+    aging_s: float = 30.0
+    max_hold_s: float = 1.0
 
     def __post_init__(self):
         if self.target_live < 1:
@@ -132,6 +171,12 @@ class ServeConfig:
                              f"got {self.target_live}")
         if self.max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
+        if self.bucket_widths is not None:
+            # the explicit-edge bugfix: a typo'd geometry (unsorted,
+            # duplicated, non-positive, or edges collapsing onto one
+            # PAD_MULTIPLE family) fails HERE, not as silent misrouting
+            # to the wrong jit family at admission time
+            self.bucket_widths = validate_bucket_widths(self.bucket_widths)
         if self.watchdog_s < 0:
             raise ValueError(f"watchdog_s must be >= 0, "
                              f"got {self.watchdog_s}")
@@ -144,18 +189,56 @@ class ServeConfig:
         if self.breaker_probes < 0:
             raise ValueError(f"breaker_probes must be >= 0, "
                              f"got {self.breaker_probes}")
+        if self.planner_epoch < 1:
+            raise ValueError(f"planner_epoch must be >= 1, "
+                             f"got {self.planner_epoch}")
+        if self.planner_buckets < 1:
+            raise ValueError(f"planner_buckets must be >= 1, "
+                             f"got {self.planner_buckets}")
+        if self.slo_interactive_s <= 0 or self.slo_batch_s <= 0:
+            raise ValueError("per-class SLO targets must be > 0, got "
+                             f"interactive={self.slo_interactive_s} "
+                             f"batch={self.slo_batch_s}")
+        if self.aging_s < 0:
+            raise ValueError(f"aging_s must be >= 0, got {self.aging_s}")
+        if self.max_hold_s < 0:
+            raise ValueError(f"max_hold_s must be >= 0, "
+                             f"got {self.max_hold_s}")
 
 
 class AdmissionQueue:
-    """Bounded FIFO waiting room; thread-safe (producers may ``put`` from
-    other threads while the serve loop pops).  Entries carry their
-    enqueue timestamp so admission latency is measurable."""
+    """Bounded, PRIORITY-CLASS-aware waiting room; thread-safe (producers
+    may ``put`` from other threads while the serve loop pops).  Entries
+    carry their enqueue timestamp so admission latency is measurable.
 
-    def __init__(self, maxsize: int):
+    ``classes`` (highest priority first, default
+    :data:`~consensus_entropy_tpu.serve.planner.PRIORITY_CLASSES`): each
+    entry lands in the deque of its ``priority`` attribute (unknown or
+    missing → the lowest class), FIFO within a class.  :meth:`pop` is
+    STRICT priority — ``interactive`` ahead of ``batch`` — with an AGING
+    guard: a lower-class head that has waited past ``aging_s`` jumps the
+    order (oldest aged head first), so strict priority cannot starve the
+    batch tier behind a steady interactive stream.  ``aging_s=0``
+    disables aging (pure strict priority)."""
+
+    def __init__(self, maxsize: int, *, classes=PRIORITY_CLASSES,
+                 aging_s: float = 0.0):
         self.maxsize = maxsize
-        self._q: collections.deque = collections.deque()
+        self.classes = tuple(classes)
+        if not self.classes:
+            raise ValueError("classes must be non-empty")
+        self.aging_s = aging_s
+        self._q: dict[str, collections.deque] = {
+            cls: collections.deque() for cls in self.classes}
         self._cond = threading.Condition()
         self._closed = False
+
+    def _class_of(self, entry) -> str:
+        cls = getattr(entry, "priority", None)
+        return cls if cls in self._q else self.classes[-1]
+
+    def _total(self) -> int:
+        return sum(len(dq) for dq in self._q.values())
 
     def close(self) -> None:
         """Drain sentinel: no further ``put`` succeeds (``QueueClosed``),
@@ -181,13 +264,14 @@ class AdmissionQueue:
             if self._closed:
                 raise QueueClosed("admission queue is closed (drain); "
                                   "stop submitting")
-            if len(self._q) >= self.maxsize:
+            if self._total() >= self.maxsize:
                 raise QueueFull(
                     f"admission queue is at its bound ({self.maxsize}); "
                     "retry after sessions drain")
-            self._q.append((entry, time.perf_counter()))
+            self._q[self._class_of(entry)].append(
+                (entry, time.perf_counter()))
             self._cond.notify_all()
-            return len(self._q)
+            return self._total()
 
     def try_put(self, entry: FleetUser) -> int | None:
         """:meth:`put` that returns ``None`` instead of raising at the
@@ -200,18 +284,42 @@ class AdmissionQueue:
             return None
 
     def pop(self):
-        """``(entry, enqueue_t)`` or ``None`` when empty."""
+        """``(entry, enqueue_t)`` or ``None`` when empty: the head of the
+        highest-priority non-empty class — unless a lower class's head
+        has AGED past ``aging_s``, in which case the oldest aged head
+        pops first (the starvation guard)."""
         with self._cond:
-            return self._q.popleft() if self._q else None
+            if self.aging_s > 0:
+                now = time.perf_counter()
+                aged = [(self._q[cls][0][1], cls)
+                        for cls in self.classes[1:]
+                        if self._q[cls]
+                        and now - self._q[cls][0][1] >= self.aging_s]
+                if aged:
+                    return self._q[min(aged)[1]].popleft()
+            for cls in self.classes:
+                if self._q[cls]:
+                    return self._q[cls].popleft()
+            return None
+
+    def head_waits(self) -> dict:
+        """``{class: seconds its head entry has waited}`` for non-empty
+        classes — the SLO-headroom input of the planner's admission
+        hold."""
+        with self._cond:
+            now = time.perf_counter()
+            return {cls: now - dq[0][1]
+                    for cls, dq in self._q.items() if dq}
 
     def wait_nonempty(self, timeout: float) -> bool:
         """True when the queue is non-empty at return; a :meth:`close`
         wakes the wait immediately (returning the actual emptiness) so
         drains never sit out the full timeout."""
         with self._cond:
-            self._cond.wait_for(lambda: self._closed or bool(self._q),
-                                timeout=timeout)
-            return bool(self._q)
+            self._cond.wait_for(
+                lambda: self._closed or self._total() > 0,
+                timeout=timeout)
+            return self._total() > 0
 
     def wait_at_least(self, n: int, timeout: float) -> bool:
         """Block until the queue holds ``n`` entries or ``timeout``
@@ -220,13 +328,14 @@ class AdmissionQueue:
         bucket dispatch) instead of trickling in one at a time.  A
         :meth:`close` wakes the wait immediately."""
         with self._cond:
-            self._cond.wait_for(lambda: self._closed or len(self._q) >= n,
-                                timeout=timeout)
-            return len(self._q) >= n
+            self._cond.wait_for(
+                lambda: self._closed or self._total() >= n,
+                timeout=timeout)
+            return self._total() >= n
 
     def __len__(self) -> int:
         with self._cond:
-            return len(self._q)
+            return self._total()
 
 
 class FleetServer:
@@ -255,7 +364,8 @@ class FleetServer:
         self.config = config
         self.preemption = preemption
         self.router = BucketRouter(config.bucket_widths)
-        self.queue = AdmissionQueue(config.max_queue)
+        self.queue = AdmissionQueue(config.max_queue,
+                                    aging_s=config.aging_s)
         self.report = scheduler.report
         self.results: list[dict] = []
         self._admitted: list[FleetUser] = []
@@ -293,6 +403,24 @@ class FleetServer:
                 "(backoff re-admission); build the scheduler with "
                 "on_terminal=None")
         scheduler.on_terminal = self._on_terminal
+        #: the SLO admission planner (serve.planner): adaptive bucket
+        #: edges (journal-replayable), per-class SLO headroom, and the
+        #: adaptive admission/dispatch holds.  None under
+        #: ``--no-slo-planner`` — the fixed-window arm.  Construction
+        #: RESTORES from the journal, so a restarted server routes with
+        #: the killed run's exact edges before its first enqueue.
+        self.planner = None
+        if config.slo_planner:
+            self.planner = AdmissionPlanner(
+                config, router=self.router, journal=journal,
+                report=self.report)
+            if scheduler.hold is None:
+                # the dispatch-hold policy: the engine holds partially
+                # formed stacked dispatches (reductions AND CNN plan
+                # cohorts) while host steps are in flight, inside SLO
+                # headroom; an explicit batch_window_s stays a floor
+                scheduler.hold = self.planner
+            self.report.planner = self.planner
 
     # -- producer surface --------------------------------------------------
 
@@ -307,14 +435,58 @@ class FleetServer:
             raise RuntimeError("server is draining; not accepting users")
         if self._skip(entry):
             return len(self.queue)
+        self._resolve_class(entry)
         depth = self.queue.put(entry)
-        self._journal("enqueue", entry.user_id)
-        self.report.enqueued(entry.user_id, depth)
+        self._note_enqueued(entry, depth)
+        return depth
+
+    def _resolve_class(self, entry: FleetUser) -> str:
+        """The entry's priority class: the journal's record wins (a
+        re-submitted or restart-recovered user keeps the class its first
+        enqueue recorded), then the entry's own ``priority``, then the
+        default.  The resolved class is written back onto the entry so
+        the queue's pop order and every downstream record agree."""
+        cls = None
+        if self.journal is not None:
+            cls = self.journal.class_of(entry.user_id)
+        if cls is None:
+            cls = getattr(entry, "priority", None) or DEFAULT_CLASS
+        if getattr(entry, "priority", None) != cls:
+            entry.priority = cls
+        return cls
+
+    def _note_enqueued(self, entry: FleetUser, depth: int) -> None:
+        """The shared post-put bookkeeping for every enqueue path
+        (submit / pull-refill / backoff requeue): journal the transition
+        (class + pool size — the planner's replayable observation
+        stream), grade the telemetry, open the user's root span, and
+        feed the planner's sketch + arrival-rate estimate."""
+        cls = getattr(entry, "priority", None) or DEFAULT_CLASS
+        pool = getattr(getattr(entry.data, "pool", None), "n_songs", None)
+        if pool is not None:
+            pool = int(pool)  # one coercion: the journal field and the
+            # sketch observation must see the SAME value or replay
+            # diverges from the live run
+        fields = {"cls": cls}
+        if pool is not None:
+            fields["pool"] = pool
+        if self.planner is not None:
+            # the journal append and the sketch observation commit as
+            # ONE critical section (the planner's lock), so a planner
+            # epoch record always covers every enqueue journaled before
+            # it — concurrent producers cannot race the epoch boundary
+            # into a sketch that replay would reconstruct differently
+            self.planner.observe_enqueue(
+                pool, t=time.monotonic(),
+                journal_entry=lambda: self._journal(
+                    "enqueue", entry.user_id, **fields))
+        else:
+            self._journal("enqueue", entry.user_id, **fields)
+        self.report.enqueued(entry.user_id, depth, cls=cls)
         # the user's root span opens at FIRST enqueue (idempotent), so
         # admission waits nest inside it; the scheduler closes it when
         # the user resolves
         self.scheduler.tracer.open_user(str(entry.user_id))
-        return depth
 
     def _skip(self, entry: FleetUser) -> bool:
         """Journal-finished and poisoned users never re-enter the queue.
@@ -397,17 +569,39 @@ class FleetServer:
                     src_live = self._refill(src, src_live)
                     if not src_live and not keep_open:
                         self._intake_open = False
-                    if (cfg.admit_window_s > 0 and not sched.has_work
-                            and self._intake_open
+                    if (not sched.has_work and self._intake_open
                             and len(self.queue) < cfg.target_live):
                         # idle engine, open intake, short queue: hold the
                         # admission window open so arrivals GANG into one
                         # phase-aligned admission (one stacked bucket
                         # dispatch) instead of trickling in one at a time.
-                        # Bounded, so a drain request is seen at worst one
-                        # window later; a busy engine never waits here.
-                        self.queue.wait_at_least(cfg.target_live,
-                                                 cfg.admit_window_s)
+                        # Under the planner the window is ADAPTIVE —
+                        # predicted marginal arrival wait vs per-class
+                        # SLO headroom (serve.planner.admission_hold),
+                        # with an explicit admit_window_s as the floor.
+                        # Bounded, so a drain request is seen at worst
+                        # one window later; a busy engine never waits
+                        # here.
+                        window = cfg.admit_window_s
+                        hold = 0.0
+                        if self.planner is not None:
+                            hold = self.planner.admission_hold_s(
+                                free=cfg.target_live - sched.n_live,
+                                queued=len(self.queue),
+                                head_waits=self.queue.head_waits())
+                            window = max(window, hold)
+                        if window > 0:
+                            ganged = self.queue.wait_at_least(
+                                cfg.target_live, window)
+                            # a planner DECISION event only when the
+                            # planner's hold GOVERNED the window (not
+                            # the fixed admit_window_s floor) and a
+                            # gang actually formed under it
+                            if ganged and hold > 0 and hold == window:
+                                self.report.event(
+                                    "admission_hold",
+                                    window_s=round(hold, 4),
+                                    depth=len(self.queue))
                     self._admit_up_to_target()
                 if sched.has_work:
                     sched.pump()
@@ -464,12 +658,11 @@ class FleetServer:
         want = min(self.queue.maxsize, self.config.target_live)
         while True:
             if self._spill is not None:
+                self._resolve_class(self._spill)
                 depth = self.queue.try_put(self._spill)
                 if depth is None:  # producers still hold the last slot
                     return src_live
-                self._journal("enqueue", self._spill.user_id)
-                self.report.enqueued(self._spill.user_id, depth)
-                self.scheduler.tracer.open_user(str(self._spill.user_id))
+                self._note_enqueued(self._spill, depth)
                 self._spill = None
             if not src_live or len(self.queue) >= want:
                 return src_live
@@ -493,12 +686,19 @@ class FleetServer:
                 return
             entry, t_enq = item
             uid = str(entry.user_id)
-            width = self.router.width_for(entry.data.pool.n_songs)
+            cls = getattr(entry, "priority", None) or DEFAULT_CLASS
+            # a restarted run re-admits at the KILLED run's journaled
+            # width — per-RUN pad pinning survives the process even when
+            # the planner's edges have since moved
+            width = self.journal.width_of(uid) \
+                if self.journal is not None else None
+            if width is None:
+                width = self.router.width_for(entry.data.pool.n_songs)
             # a kill here models dying between the queue pop and the
             # durable admit record: the journal still shows the user
             # queued, so a restart re-enqueues it — no user is lost
             faults.fire("serve.admit", user=uid, width=width)
-            self._journal("admit", uid)
+            self._journal("admit", uid, width=width)
             self._attempts[uid] = self._attempts.get(uid, 0) + 1
             sched.admit(entry, pad=width)
             if id(entry) not in self._admitted_ids:
@@ -506,9 +706,13 @@ class FleetServer:
                 self._admitted.append(entry)
             self._pending.add(id(entry))
             wait_s = time.perf_counter() - t_enq
+            if self.planner is not None:
+                # headroom back-dates by the queue wait: the SLO clock
+                # started at enqueue, not here
+                self.planner.note_admit(uid, cls, waited_s=wait_s)
             self.report.admitted(
                 entry.user_id, width=width, wait_s=wait_s,
-                depth=len(self.queue), live=sched.n_live)
+                depth=len(self.queue), live=sched.n_live, cls=cls)
             tracer = sched.tracer
             if tracer.enabled:
                 # the queue wait as a span under the user's root — keyed
@@ -542,9 +746,7 @@ class FleetServer:
             if depth is None:
                 still.append((due, entry))
                 continue
-            self._journal("enqueue", entry.user_id)
-            self.report.enqueued(entry.user_id, depth)
-            self.scheduler.tracer.open_user(str(entry.user_id))
+            self._note_enqueued(entry, depth)
         self._requeue = still
 
     def _on_terminal(self, entry: FleetUser, error: str,
@@ -556,6 +758,10 @@ class FleetServer:
         skip the user."""
         uid = str(entry.user_id)
         attempts = self._attempts.get(uid, 1)
+        if self.planner is not None:
+            # the user left the engine either way (requeue or final):
+            # its SLO clock stops constraining holds until re-admission
+            self.planner.note_resolved(uid)
         if (self._draining or entry.committee_factory is None
                 or self.config.failure_budget <= 1):
             return False  # not re-admittable: record the failure now
@@ -608,6 +814,8 @@ class FleetServer:
         for eid in finished:
             self._pending.discard(eid)
             rec = self.scheduler.results[eid]
+            if self.planner is not None:
+                self.planner.note_resolved(rec["user"])
             if on_result is not None:
                 on_result(rec)
             if rec["error"] is None:

@@ -33,6 +33,13 @@
 #   failed over on lease expiry, and journal compaction killed in BOTH
 #   rename windows — all asserting journal-driven recovery with per-user
 #   trajectories bit-identical to uninterrupted single-host runs.
+# - SLO-planner restart (tests/test_slo.py): a SIGKILLed
+#   planner-enabled serve run (adaptive bucket edges + priority classes)
+#   restarted from the journal must re-derive IDENTICAL bucket edges,
+#   preserve every user's class assignment and admitted width, and
+#   finish every user bit-identical to sequential — the planner rows of
+#   the serve kill matrix (scripts/slo_check.sh is the companion
+#   schema/replay gate).
 # - acquisition registry (tests/test_acquire.py): the acquire.qbdc.masks
 #   fault point unit and the qbdc resume drill.
 # - observability (tests/test_obs.py): the traced fleet eviction+resume
@@ -49,6 +56,6 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
   tests/test_serve_faults.py tests/test_serve_fabric.py \
-  tests/test_acquire.py tests/test_obs.py -v -m faults \
+  tests/test_slo.py tests/test_acquire.py tests/test_obs.py -v -m faults \
   -p no:cacheprovider "$@"
 echo "fault matrix passed"

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# SLO-aware admission race (ISSUE 11 acceptance: the planner arm raises
+# MEAN BUCKET OCCUPANCY vs the fixed-window arm on the tail-heavy serve
+# workload, with per-user parity exact on every rep and per-class
+# admission→finish p95 reported for both arms).
+#
+# Runs `bench.py --suite slo`: the SLO admission planner (bucket edges
+# derived online from a quantile sketch of enqueue-time pool sizes,
+# priority classes interactive/batch with strict-priority+aging
+# admission, predictive dispatch holds bounded by per-class SLO
+# headroom) against the PR 3 fixed-window arm (`slo_planner=False`) over
+# IDENTICAL tail-heavy users (every 4th pool 4x, every 3rd user
+# interactive).  Per the 2-vCPU drift protocol the reps are INTERLEAVED
+# (sequential, fixed, planner per rep); occupancy is reported as the
+# mean over reps (capacity-independent on this box — the same role h2d
+# bytes played for the fused-step suite), users/sec as each arm's best.
+#
+# The JSON line goes to stdout (redirect to BENCH_slo_r<N>.json to
+# commit an artifact); the per-rep log goes to stderr.  Extra bench args
+# pass through, e.g.:
+#   scripts/slo_bench.sh --users 8 --pool 120 --fleet 4 --reps 3
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+if [ "$#" -gt 0 ]; then
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --suite slo "$@"
+else
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --suite slo \
+        --users 8 --pool 120 --fleet 4 --reps 3
+fi

@@ -1,0 +1,104 @@
+#!/usr/bin/env bash
+# SLO-admission CI gate (ISSUE 11 satellite; sits next to obs_check.sh).
+#
+# Runs a REAL planner-enabled 2-class serve cohort over the synthetic
+# workload, then:
+#   1. schema-validates EVERY fleet_metrics.jsonl line (the v2 table now
+#      includes the cls fields and the planner_edges/admission_hold
+#      events),
+#   2. asserts the per-class admission→finish histograms and the
+#      planner-decision events are present,
+#   3. asserts the journal REPLAYS to identical bucket edges — a fresh
+#      AdmissionPlanner restored from the replayed journal derives the
+#      same routing the live run used.
+#
+# Extra args are NOT accepted: this is a pass/fail gate, not a bench.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'PY'
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, ".")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+import bench
+from consensus_entropy_tpu.config import ALConfig
+from consensus_entropy_tpu.fleet import FleetReport, FleetScheduler, FleetUser
+from consensus_entropy_tpu.obs import export
+from consensus_entropy_tpu.serve import (
+    AdmissionJournal,
+    AdmissionPlanner,
+    BucketRouter,
+    FleetServer,
+    ServeConfig,
+)
+
+cfg = ALConfig(queries=8, epochs=2, mode="mc", seed=1987,
+               ckpt_dtype="float32")
+users = bench._fleet_workload(4, 80, 96, cfg.seed)
+root = tempfile.mkdtemp(prefix="slo_check_")
+users_dir = os.path.join(root, "users")
+metrics_path = os.path.join(users_dir, "fleet_metrics.jsonl")
+journal_path = os.path.join(users_dir, "serve_journal.jsonl")
+
+report = FleetReport(metrics_path)
+journal = AdmissionJournal(journal_path)
+sched = FleetScheduler(cfg, report=report, scoring_by_width=True,
+                       user_timings=False)
+serve_cfg = ServeConfig(target_live=2, planner_epoch=2)
+server = FleetServer(sched, serve_cfg, journal=journal)
+entries = [FleetUser(d.user_id, f(), d, bench._mkdir(root, f"u{i}"),
+                     seed=cfg.seed,
+                     priority="interactive" if i % 2 else "batch")
+           for i, (d, f) in enumerate(users)]
+for e in entries:
+    server.submit(e)
+server.close_intake()
+recs = server.serve(())
+assert len(recs) == 4 and all(r["error"] is None for r in recs), recs
+summary = report.write_summary(cohort=2)
+report.close()
+live_edges = server.planner.edges
+journal.close()
+assert live_edges, "planner derived no edges"
+
+# 1. every metrics line validates against the v2 schema
+errors = export.validate_metrics_file(metrics_path)
+assert errors == [], "schema violations:\n" + "\n".join(errors[:10])
+n_lines = len(export.read_jsonl_tolerant(metrics_path))
+print(f"slo_check: {n_lines} metrics lines schema-valid")
+
+# 2. per-class histograms + planner-decision events are present
+per_class = summary.get("per_class") or {}
+assert set(per_class) == {"batch", "interactive"}, per_class
+for cls, c in per_class.items():
+    snap = c["admission_to_finish_s"]
+    assert snap and snap["n"] == 2, (cls, snap)
+assert summary.get("planner", {}).get("edges") == list(live_edges)
+events = export.read_jsonl_tolerant(metrics_path)
+assert any(e.get("event") == "planner_edges" for e in events), \
+    "no planner-decision events in the metrics stream"
+assert all(e.get("cls") for e in events
+           if e.get("event") in ("enqueue", "admit"))
+print(f"slo_check: per-class histograms + planner events present "
+      f"(edges {list(live_edges)})")
+
+# 3. the journal replays to identical edges
+with AdmissionJournal(journal_path) as replayed:
+    assert replayed.recovered
+    router = BucketRouter()
+    restored = AdmissionPlanner(serve_cfg, router=router,
+                                journal=replayed)
+    assert restored.edges == live_edges, (restored.edges, live_edges)
+    assert router.widths == live_edges
+    assert set(replayed.state.classes.values()) \
+        == {"batch", "interactive"}
+print(f"slo_check: journal replays to identical edges {list(live_edges)}")
+PY
+echo "slo check passed"

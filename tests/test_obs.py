@@ -102,9 +102,10 @@ def test_event_writer_schema_tag_and_torn_tail_reader(tmp_path):
     the same discipline serve.journal applies to its WALs."""
     path = str(tmp_path / "m.jsonl")
     w = EventWriter(path)
-    w.emit({"event": "enqueue", "t_s": 0.1, "user": "u0", "depth": 1})
+    w.emit({"event": "enqueue", "t_s": 0.1, "user": "u0", "depth": 1,
+            "cls": "batch"})
     w.emit({"event": "admit", "t_s": 0.2, "user": "u0", "width": 32,
-            "wait_s": 0.1, "depth": 0, "live": 1})
+            "wait_s": 0.1, "depth": 0, "live": 1, "cls": "batch"})
     w.close()
     with open(path, "ab") as f:
         f.write(b'{"event": "user_done", "t_s": 0.3, "use')  # torn tail
@@ -118,13 +119,15 @@ def test_event_writer_schema_tag_and_torn_tail_reader(tmp_path):
 
 def test_schema_validation_catches_violations():
     ok = {"schema": 2, "event": "enqueue", "t_s": 1.0, "user": "u",
-          "depth": 0}
+          "depth": 0, "cls": "batch"}
     assert export.validate_metrics([ok]) == []
     errs = export.validate_metrics([
-        {"event": "enqueue", "t_s": 1.0, "user": "u", "depth": 0},  # no tag
+        {"event": "enqueue", "t_s": 1.0, "user": "u", "depth": 0,
+         "cls": "batch"},  # no tag
         {"schema": 2, "event": "warp_core_breach", "t_s": 1.0},  # unknown
         {"schema": 2, "event": "admit", "t_s": 1.0, "user": "u"},  # fields
-        {"schema": 2, "event": "enqueue", "user": "u", "depth": 0},  # t_s
+        {"schema": 2, "event": "enqueue", "user": "u", "depth": 0,
+         "cls": "batch"},  # t_s
     ])
     assert len(errs) >= 4
     assert any("schema tag" in e for e in errs)
@@ -481,7 +484,8 @@ def test_report_cli_validate_export_and_text(tmp_path):
     users = tmp_path / "users"
     users.mkdir()
     w = EventWriter(str(users / "fleet_metrics.jsonl"))
-    w.emit({"event": "enqueue", "t_s": 0.1, "user": "u0", "depth": 1})
+    w.emit({"event": "enqueue", "t_s": 0.1, "user": "u0", "depth": 1,
+            "cls": "batch"})
     w.emit({"event": "fleet_summary", "users_done": 1, "wall_s": 1.0,
             "users_per_sec": 1.0, "phase_wall_s": {"score_s": 0.5}})
     w.close()

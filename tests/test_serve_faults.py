@@ -573,10 +573,15 @@ def test_serve_dispatch_error_isolates_single_session(tmp_path):
     assert recs[0]["result"]["trajectory"] == seq[0]["trajectory"]
 
 
+@pytest.mark.slow
 def test_serve_flaky_mix_smoke(tmp_path):
     """The serve_fault_bench fast subset: a 2-user mix with one flaky
     user (member fault absorbed by evict+resume) finishes everyone with
-    sequential-identical results and records the recovery telemetry."""
+    sequential-identical results and records the recovery telemetry.
+    (Demoted to slow in PR 11's tier-1 budget trade against the new SLO
+    planner tier-1 cases — the evict+resume+backoff mechanisms stay
+    tier-1-adjacent via the SLO smoke and pure-host units, and this case
+    still runs in ``scripts/fault_matrix.sh``.)"""
     cfg = _min2(_cfg(mode="mc", epochs=2))
     flaky = lambda d: _committee(d, sgd_name="sgd.flaky")  # noqa: E731
     specs = [(107, "f", 30), (108, "ok", 30)]

@@ -1,0 +1,615 @@
+"""SLO-aware admission: adaptive bucket planner, priority classes,
+predictive batch-forming (``serve.planner``).
+
+Tier-1 (un-marked) keeps the pure-host units — quantile-sketch exactness
+vs numpy / merge associativity / serialization, edge derivation, the
+pinned hold-decision tables, the class-aware queue with its starvation
+guard, the ``ServeConfig`` bucket-widths validation bugfix, and the
+journal-replay edge determinism — plus ONE small two-class serve smoke
+(paid for by demoting the flaky-mix smoke to slow, see
+``tests/test_serve_faults.py``).  The six-mode parity matrix and the
+planner restart drill are ``slow`` (``scripts/fault_matrix.sh`` /
+``scripts/slo_check.sh`` run them in CI's slow lane).
+
+Parity is exact (``==`` on float lists) throughout: holds and edges only
+change WHEN work batches and at what pad, never what it computes —
+padding does not change selections, and the stacked scorers are
+bit-identical to the single-user fns.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from consensus_entropy_tpu.al import workspace
+from consensus_entropy_tpu.al.loop import ALLoop
+from consensus_entropy_tpu.fleet import FleetReport, FleetScheduler, FleetUser
+from consensus_entropy_tpu.obs import export
+from consensus_entropy_tpu.obs.metrics import QuantileSketch
+from consensus_entropy_tpu.resilience import faults
+from consensus_entropy_tpu.resilience.faults import FaultRule, InjectedKill
+from consensus_entropy_tpu.serve import (
+    AdmissionJournal,
+    AdmissionPlanner,
+    AdmissionQueue,
+    BucketRouter,
+    FleetServer,
+    ServeConfig,
+    admission_hold,
+    derive_edges,
+    dispatch_hold,
+    validate_bucket_widths,
+)
+from tests.test_fleet import _cfg, _committee, _user_data
+
+pytestmark = pytest.mark.serve
+
+
+# -- quantile sketch (pure host) ------------------------------------------
+
+
+def test_sketch_exact_vs_numpy_below_reservoir():
+    """While the reservoir holds, every percentile is BIT-identical to
+    numpy's linear interpolation — the planner's edge derivation is
+    numpy-exact until the bound, like the obs Histogram it extends."""
+    rng = np.random.default_rng(7)
+    xs = rng.integers(8, 4000, size=600)
+    sk = QuantileSketch()
+    for x in xs:
+        sk.add(int(x))
+    assert sk.exact
+    for q in (1, 10, 25, 50, 66.6, 75, 90, 95, 99, 100):
+        assert sk.percentile(q) == np.percentile(xs, q)
+
+
+def test_sketch_past_reservoir_upper_bounds():
+    """Past ``max_samples`` the reservoir is spent: percentiles fall back
+    to log-bucket upper edges — an UPPER bound on the true quantile (the
+    conservative direction: derived bucket edges get wider, never too
+    tight to fit the pools that produced them)."""
+    rng = np.random.default_rng(8)
+    xs = rng.integers(8, 4000, size=500)
+    sk = QuantileSketch(max_samples=64)
+    for x in xs:
+        sk.add(int(x))
+    assert not sk.exact
+    for q in (50, 90, 99):
+        assert sk.percentile(q) >= np.percentile(xs, q)
+    assert sk.percentile(100) == float(np.max(xs))
+
+
+def test_sketch_merge_associative_and_exactness_rule():
+    """Merge associativity (the fabric-hosts contract): bucket counts
+    add, and the exact reservoir survives iff the COMBINED count fits the
+    bound — a decision independent of merge order."""
+    rng = np.random.default_rng(9)
+    xs = rng.integers(8, 2000, size=90)
+    parts = [xs[:30], xs[30:55], xs[55:]]
+
+    def sketch(vals, max_samples=4096):
+        sk = QuantileSketch(max_samples=max_samples)
+        for v in vals:
+            sk.add(int(v))
+        return sk
+
+    a, b, c = (sketch(p) for p in parts)
+    left = QuantileSketch.from_dict(a.to_dict()).merge(b).merge(c)
+    right = QuantileSketch.from_dict(a.to_dict()).merge(
+        QuantileSketch.from_dict(b.to_dict()).merge(c))
+    assert (left.n, left.total, left.min, left.max) \
+        == (right.n, right.total, right.min, right.max)
+    assert left._buckets == right._buckets
+    assert sorted(left._samples) == sorted(right._samples)
+    for q in (25, 50, 75, 95, 100):
+        assert left.percentile(q) == right.percentile(q) \
+            == np.percentile(xs, q)
+    # overflow collapse is order-independent too: 30+25+35 > bound=48
+    a, b, c = (sketch(p, max_samples=48) for p in parts)
+    left = QuantileSketch.from_dict(a.to_dict()).merge(b).merge(c)
+    right = QuantileSketch.from_dict(a.to_dict()).merge(
+        QuantileSketch.from_dict(b.to_dict()).merge(c))
+    assert left._samples is None and right._samples is None
+    assert left._buckets == right._buckets
+    for q in (50, 95):
+        assert left.percentile(q) == right.percentile(q)
+    # geometry mismatch fails loudly instead of merging garbage
+    with pytest.raises(ValueError, match="geometry"):
+        sketch(parts[0]).merge(sketch(parts[1], max_samples=48))
+
+
+def test_sketch_dict_roundtrip():
+    sk = QuantileSketch()
+    for v in (10, 20, 300, 4000):
+        sk.add(v)
+    rt = QuantileSketch.from_dict(json.loads(json.dumps(sk.to_dict())))
+    assert (rt.n, rt.total, rt.min, rt.max) \
+        == (sk.n, sk.total, sk.min, sk.max)
+    for q in (0, 50, 100):
+        assert rt.percentile(q) == sk.percentile(q)
+
+
+# -- edge derivation (pure host) ------------------------------------------
+
+
+def test_derive_edges_deterministic_padded_and_total():
+    sk = QuantileSketch()
+    for v in [120] * 32 + [480] * 8:
+        sk.add(v)
+    edges = derive_edges(sk, n_buckets=4)
+    # quantiles of a two-point distribution collapse onto the observed
+    # sizes: the operator-guess-free geometry is TIGHT (120, not 128)
+    assert edges == (120, 480)
+    assert edges == derive_edges(sk, n_buckets=4)  # deterministic
+    # every edge is a PAD_MULTIPLE multiple; the empty sketch derives
+    # nothing (the router keeps its pow2 fallback)
+    assert all(e % 8 == 0 for e in derive_edges(sk, n_buckets=7))
+    assert derive_edges(QuantileSketch()) == ()
+    # routing stays total: a pool above every edge falls through to pow2
+    r = BucketRouter()
+    r.update(edges)
+    assert r.width_for(100) == 120
+    assert r.width_for(481) == 512
+
+
+# -- hold decisions (pure host, pinned) -----------------------------------
+
+
+def test_admission_hold_decision_table():
+    """The intake-side batch-forming kernel, pinned on synthetic
+    telemetry: hold only while the predicted marginal wait raises the
+    gang without breaching SLO headroom."""
+    kw = dict(gap_s=0.2, headroom_s=10.0, max_hold_s=2.0)
+    # gang already fills the free slots -> no hold
+    assert admission_hold(free=2, queued=2, **kw) == 0.0
+    assert admission_hold(free=0, queued=0, **kw) == 0.0
+    # predicted fill time for the remaining slots, capped
+    assert admission_hold(free=4, queued=2, **kw) \
+        == pytest.approx(0.4)
+    assert admission_hold(free=4, queued=0, gap_s=1.0, headroom_s=10.0,
+                          max_hold_s=2.0) == 2.0  # operator cap
+    # SLO guard: predicted wait past headroom, or headroom spent -> 0
+    assert admission_hold(free=4, queued=0, gap_s=5.0, headroom_s=1.0,
+                          max_hold_s=9.0) == 0.0
+    assert admission_hold(free=4, queued=0, gap_s=0.1, headroom_s=0.0,
+                          max_hold_s=9.0) == 0.0
+    # no arrival telemetry yet -> unpredictable -> no hold
+    assert admission_hold(free=4, queued=0, gap_s=None, headroom_s=10.0,
+                          max_hold_s=2.0) == 0.0
+
+
+def test_dispatch_hold_decision_table():
+    """The dispatch-side kernel: hold a partial stacked batch only while
+    outstanding host steps mean more sessions can still join, inside SLO
+    headroom."""
+    # nothing waiting, or nothing in flight that could join -> release
+    assert dispatch_hold(waiting=0, host_in_flight=3, headroom_s=10.0,
+                         max_hold_s=1.0) == 0.0
+    assert dispatch_hold(waiting=2, host_in_flight=0, headroom_s=10.0,
+                         max_hold_s=1.0) == 0.0
+    # joinable work in flight -> hold to the cap, inside headroom
+    assert dispatch_hold(waiting=2, host_in_flight=1, headroom_s=10.0,
+                         max_hold_s=1.0) == 1.0
+    assert dispatch_hold(waiting=2, host_in_flight=1, headroom_s=0.4,
+                         max_hold_s=1.0) == pytest.approx(0.4)
+    # SLO headroom spent -> release immediately
+    assert dispatch_hold(waiting=2, host_in_flight=1, headroom_s=0.0,
+                         max_hold_s=1.0) == 0.0
+
+
+def test_planner_holds_from_synthetic_clock():
+    """Planner-level hold/release decisions under an injected clock:
+    admitted users' SLO ages shrink the headroom until holds release."""
+    clock = [0.0]
+    cfg = ServeConfig(slo_interactive_s=5.0, slo_batch_s=50.0,
+                      max_hold_s=1.0)
+    p = AdmissionPlanner(cfg, router=BucketRouter(),
+                         clock=lambda: clock[0])
+    # inter-arrival telemetry: two enqueues 0.2s apart -> gap EMA 0.2
+    p.observe_enqueue(100, t=0.0)
+    p.observe_enqueue(100, t=0.2)
+    assert p.admission_hold_s(free=4, queued=1) == pytest.approx(0.6)
+    # a live interactive user ages: headroom = 5 - age
+    p.note_admit("u0", "interactive")
+    assert p.window_s(2, 1) == 1.0  # fresh: capped hold
+    clock[0] = 4.8
+    assert p.window_s(2, 1) == pytest.approx(0.2)  # headroom shrinking
+    clock[0] = 5.1
+    assert p.window_s(2, 1) == 0.0  # SLO spent: release
+    p.note_resolved("u0")
+    assert p.window_s(2, 1) == 1.0  # clock stopped constraining
+    # hold PERIODS, not consults: the first two holds are one period
+    # (no release between), the SLO release ends it, the post-resolve
+    # hold starts the second
+    assert p.dispatch_hold_rounds == 2 and p.admission_hold_rounds == 1
+
+
+# -- class-aware queue (pure host) ----------------------------------------
+
+
+class _E:
+    def __init__(self, uid, priority="batch"):
+        self.user_id = uid
+        self.priority = priority
+
+
+def test_queue_strict_priority_fifo_within_class():
+    q = AdmissionQueue(8)
+    for e in (_E("b0"), _E("i0", "interactive"), _E("b1"),
+              _E("i1", "interactive")):
+        q.put(e)
+    assert len(q) == 4
+    assert [q.pop()[0].user_id for _ in range(4)] \
+        == ["i0", "i1", "b0", "b1"]
+    # unknown/missing classes land in the lowest class, never raise
+    q.put(_E("x", "warp"))
+    q.put("bare-string")
+    assert q.pop()[0].user_id == "x"
+
+
+def test_queue_aging_starvation_guard():
+    """The satellite pin: an AGED batch user admits ahead of a fresh
+    interactive one — strict priority cannot starve the batch tier."""
+    import time as _time
+
+    q = AdmissionQueue(8, aging_s=0.05)
+    q.put(_E("b0"))
+    q.put(_E("i0", "interactive"))
+    assert q.pop()[0].user_id == "i0"  # not aged yet: strict priority
+    _time.sleep(0.06)
+    q.put(_E("i1", "interactive"))  # fresh interactive arrival
+    assert q.pop()[0].user_id == "b0"  # aged batch jumps it
+    assert q.pop()[0].user_id == "i1"
+    waits = AdmissionQueue(8, aging_s=0.05)
+    waits.put(_E("b0"))
+    hw = waits.head_waits()
+    assert set(hw) == {"batch"} and hw["batch"] >= 0.0
+
+
+# -- ServeConfig bucket-widths validation (the bugfix satellite) ----------
+
+
+def test_serve_config_validates_explicit_bucket_widths():
+    """Typo'd explicit edges fail at CONSTRUCTION with the reason,
+    instead of silently misrouting users to the wrong jit family."""
+    assert ServeConfig(bucket_widths=(32, 64)).bucket_widths == (32, 64)
+    with pytest.raises(ValueError, match="ascending"):
+        ServeConfig(bucket_widths=(64, 32))  # unsorted
+    with pytest.raises(ValueError, match="ascending"):
+        ServeConfig(bucket_widths=(32, 32, 64))  # duplicate
+    with pytest.raises(ValueError, match="positive"):
+        ServeConfig(bucket_widths=(0, 32))
+    with pytest.raises(ValueError, match="positive"):
+        ServeConfig(bucket_widths=(32, -8))
+    with pytest.raises(ValueError, match="positive"):
+        ServeConfig(bucket_widths=(32.5, 64))  # non-int
+    with pytest.raises(ValueError, match="collapse"):
+        ServeConfig(bucket_widths=(30, 32))  # both round to 32
+    with pytest.raises(ValueError, match="non-empty"):
+        validate_bucket_widths(())
+    # oversized pools are HANDLED, not an error: pow2 fall-through
+    r = BucketRouter((32, 64))
+    assert r.width_for(100) == 128
+    # planner knob validation rides the same __post_init__
+    with pytest.raises(ValueError, match="planner_epoch"):
+        ServeConfig(planner_epoch=0)
+    with pytest.raises(ValueError, match="SLO"):
+        ServeConfig(slo_interactive_s=0.0)
+    with pytest.raises(ValueError, match="aging_s"):
+        ServeConfig(aging_s=-1.0)
+
+
+# -- journal-replayed edge determinism (pure host) ------------------------
+
+
+def test_planner_edges_replay_identically_from_journal(tmp_path):
+    """The restart contract, at journal level: a planner rebuilt from a
+    replayed journal (last planner record's sketch + the enqueue pool
+    sizes after it) derives IDENTICAL edges — including when the kill
+    landed between an epoch boundary and its planner append."""
+    jp = str(tmp_path / "j.jsonl")
+    cfg = ServeConfig(planner_epoch=2)
+    pools = [120, 480, 96, 120, 480]
+    with AdmissionJournal(jp) as j:
+        p = AdmissionPlanner(cfg, router=BucketRouter(), journal=j)
+        for i, pool in enumerate(pools):
+            j.append("enqueue", f"u{i}", cls="batch", pool=pool)
+            p.observe_enqueue(pool, t=float(i))
+        live_edges = p.edges
+        assert live_edges  # two epochs elapsed
+    with AdmissionJournal(jp) as j2:
+        r2 = BucketRouter()
+        p2 = AdmissionPlanner(cfg, router=r2, journal=j2)
+        assert p2.edges == live_edges
+        assert r2.widths == live_edges
+        assert p2.sketch.n == len(pools)
+    # torn planner append: drop the journal's LAST planner record — the
+    # replay tail (pool_obs) then re-derives it on restore
+    lines = [ln for ln in open(jp).read().splitlines() if ln]
+    kept, dropped = [], 0
+    for ln in reversed(lines):
+        if not dropped and '"planner"' in ln:
+            dropped = 1
+            continue
+        kept.append(ln)
+    with open(jp, "w") as f:
+        f.write("\n".join(reversed(kept)) + "\n")
+    with AdmissionJournal(jp) as j3:
+        p3 = AdmissionPlanner(cfg, router=BucketRouter(), journal=j3)
+        assert p3.edges == live_edges
+        assert p3.sketch.n == len(pools)
+    # explicit operator edges WIN: the planner never overrides them
+    cfg_explicit = ServeConfig(planner_epoch=2, bucket_widths=(32, 512))
+    r4 = BucketRouter((32, 512))
+    p4 = AdmissionPlanner(cfg_explicit, router=r4)
+    for i, pool in enumerate(pools):
+        p4.observe_enqueue(pool, t=float(i))
+    assert r4.widths == (32, 512)
+    # cross-arm restore: a journal written WITHOUT a planner (pool-
+    # carrying enqueues, no planner records) restored by a planner
+    # run must append ONE covering record AFTER the whole tail — a
+    # mid-restore record would orphan the tail's remainder for the
+    # next replay — so a further restart derives identical edges
+    jp2 = str(tmp_path / "j2.jsonl")
+    with AdmissionJournal(jp2) as j:
+        for i, pool in enumerate(pools):
+            j.append("enqueue", f"u{i}", cls="batch", pool=pool)
+    with AdmissionJournal(jp2) as j:
+        p5 = AdmissionPlanner(cfg, router=BucketRouter(), journal=j)
+        edges5, n5 = p5.edges, p5.sketch.n
+        assert n5 == len(pools) and edges5
+    with AdmissionJournal(jp2) as j:
+        p6 = AdmissionPlanner(cfg, router=BucketRouter(), journal=j)
+        assert (p6.edges, p6.sketch.n) == (edges5, n5)
+
+
+# -- per-class report surface (pure host) ---------------------------------
+
+
+def test_report_per_class_latency_histograms():
+    report = FleetReport()
+    report.admitted("i0", width=32, wait_s=0.0, depth=0, live=1,
+                    cls="interactive")
+    report.admitted("b0", width=32, wait_s=0.0, depth=0, live=2,
+                    cls="batch")
+    report.user_done("i0", {"trajectory": []}, {})
+    report.user_done("b0", {"trajectory": []}, {})
+    s = report.summary(cohort=2)
+    per = s["per_class"]
+    assert set(per) == {"batch", "interactive"}
+    for cls in per:
+        assert per[cls]["users"] == 1
+        snap = per[cls]["admission_to_finish_s"]
+        assert snap["n"] == 1 and snap["p95"] >= 0
+    # classes ride the event stream and validate against schema v2
+    evs = [e for e in report.events if e["event"] == "admit"]
+    assert [e["cls"] for e in evs] == ["interactive", "batch"]
+    # the schema tag is stamped at write time (EventWriter.emit)
+    assert export.validate_metrics([{"schema": 2, **e}
+                                    for e in report.events]) == []
+
+
+# -- two-class serve smoke (tier-1) ---------------------------------------
+
+
+def test_slo_serve_two_class_smoke(tmp_path):
+    """Planner-on end-to-end: interactive users admit ahead of
+    earlier-queued batch users, per-user results match sequential,
+    per-class histograms + the planner section land in the summary, the
+    planner's derived edges are journaled, and every metrics line
+    validates against schema v2."""
+    cfg = _cfg(mode="mc", epochs=1)
+    specs = [(100, "b0", 30), (101, "i0", 30), (102, "i1", 30)]
+    seq, entries = [], []
+    for seed, uid, n_songs in specs:
+        data = _user_data(seed, uid, n_songs=n_songs)
+        p = tmp_path / f"seq_{uid}"
+        p.mkdir()
+        seq.append(ALLoop(cfg).run_user(_committee(data), data, str(p)))
+        fp = tmp_path / f"serve_{uid}"
+        fp.mkdir()
+        entries.append(FleetUser(
+            uid, _committee(data), data, str(fp), seed=cfg.seed,
+            priority="interactive" if uid.startswith("i") else "batch"))
+    jsonl = tmp_path / "fleet_metrics.jsonl"
+    report = FleetReport(str(jsonl))
+    journal = AdmissionJournal(str(tmp_path / "serve_journal.jsonl"))
+    sched = FleetScheduler(cfg, report=report, scoring_by_width=True)
+    server = FleetServer(
+        sched, ServeConfig(target_live=1, planner_epoch=2),
+        journal=journal)
+    for e in entries:  # b0 queued FIRST, then the interactive pair
+        server.submit(e)
+    server.close_intake()
+    recs = server.serve(())
+    journal.close()
+    by = {r["user"]: r for r in recs}
+    for s, (_, uid, _) in zip(seq, specs):
+        assert by[uid]["error"] is None
+        assert by[uid]["result"]["trajectory"] == s["trajectory"]
+    # strict priority: both interactive users admitted before the batch
+    # user that was queued ahead of them
+    admits = [e for e in report.events if e["event"] == "admit"]
+    assert [a["user"] for a in admits] == ["i0", "i1", "b0"]
+    assert [a["cls"] for a in admits] \
+        == ["interactive", "interactive", "batch"]
+    summary = report.write_summary(cohort=1)
+    assert set(summary["per_class"]) == {"batch", "interactive"}
+    assert summary["per_class"]["interactive"]["users"] == 2
+    planner = summary["planner"]
+    assert planner["edges"] and planner["observations"] == 3
+    assert server.planner.edges == tuple(planner["edges"])
+    # the journal carries the planner epochs + classes + admit widths:
+    # a restarted server re-derives identical routing
+    st = AdmissionJournal(str(tmp_path / "serve_journal.jsonl")).state
+    assert st.planner_edges == planner["edges"]
+    assert st.classes == {"b0": "batch", "i0": "interactive",
+                          "i1": "interactive"}
+    assert set(st.widths) == {"b0", "i0", "i1"}
+    # schema v2, incl. the new cls fields and planner_edges events
+    report.close()
+    recs2 = export.read_jsonl_tolerant(str(jsonl))
+    assert export.validate_metrics(recs2) == []
+    assert any(e.get("event") == "planner_edges" for e in recs2)
+
+
+# -- slow drills ----------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["mc", "hc", "mix", "rand", "wmc"])
+def test_slo_planner_parity_host_modes(tmp_path, mode):
+    """Per-user parity vs sequential with the planner ON (adaptive
+    edges + holds + mixed classes), for every host-committee acquisition
+    mode.  Holds change batching, never results."""
+    cfg = _cfg(mode=mode, epochs=2)
+    specs = [(100, "u0", 30), (101, "u1", 55), (102, "u2", 30)]
+    seq, entries = [], []
+    for i, (seed, uid, n_songs) in enumerate(specs):
+        data = _user_data(seed, uid, n_songs=n_songs)
+        p = tmp_path / f"seq_{uid}"
+        p.mkdir()
+        seq.append(ALLoop(cfg).run_user(_committee(data), data, str(p)))
+        fp = tmp_path / f"serve_{uid}"
+        fp.mkdir()
+        entries.append(FleetUser(
+            uid, _committee(data), data, str(fp), seed=cfg.seed,
+            committee_factory=lambda fp=fp: workspace.load_committee(
+                str(fp)),
+            priority="interactive" if i == 0 else "batch"))
+    sched = FleetScheduler(cfg, report=FleetReport(),
+                           scoring_by_width=True)
+    server = FleetServer(sched,
+                         ServeConfig(target_live=2, planner_epoch=2))
+    recs = server.serve(iter(entries))
+    by = {r["user"]: r for r in recs}
+    for s, (_, uid, _) in zip(seq, specs):
+        assert by[uid]["error"] is None
+        assert by[uid]["result"]["trajectory"] == s["trajectory"]
+    assert server.planner.edges  # the planner actually derived edges
+
+
+@pytest.mark.slow
+def test_slo_planner_parity_qbdc(tmp_path):
+    """The sixth mode: qbdc (dropout committee on the CNN device path)
+    under the planner — bit-identical to its sequential run."""
+    from tests.test_acquire import (
+        TINY_CNN,
+        TINY_TC,
+        _cnn_committee,
+        _cnn_data,
+    )
+
+    cfg = dataclasses.replace(_cfg(mode="qbdc", epochs=2, queries=3),
+                              qbdc_k=6)
+    specs = [(100, "u0", 8), (101, "u1", 8)]
+    seq, entries = [], []
+    for i, (seed, uid, n) in enumerate(specs):
+        data = _cnn_data(seed, uid, n_songs=n)
+        p = tmp_path / f"seq_{uid}"
+        p.mkdir()
+        seq.append(ALLoop(cfg, retrain_epochs=1).run_user(
+            _cnn_committee(data), data, str(p)))
+        fp = tmp_path / f"serve_{uid}"
+        fp.mkdir()
+        entries.append(FleetUser(
+            uid, _cnn_committee(data), data, str(fp), seed=cfg.seed,
+            committee_factory=lambda fp=fp: workspace.load_committee(
+                str(fp), TINY_CNN, TINY_TC),
+            priority="interactive" if i == 0 else "batch"))
+    sched = FleetScheduler(cfg, report=FleetReport(),
+                           scoring_by_width=True, retrain_epochs=1)
+    server = FleetServer(sched,
+                         ServeConfig(target_live=2, planner_epoch=2))
+    recs = server.serve(iter(entries))
+    by = {r["user"]: r for r in recs}
+    for s, (_, uid, _) in zip(seq, specs):
+        assert by[uid]["error"] is None
+        assert by[uid]["result"]["trajectory"] == s["trajectory"]
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_slo_planner_restart_identical_edges_classes_results(tmp_path):
+    """THE acceptance pin (rides ``scripts/fault_matrix.sh``): a
+    SIGKILLed planner-enabled serve run restarts from the journal with
+    IDENTICAL bucket edges, class assignments and per-user results.
+    The kill lands at the first completion collection — after planner
+    epochs derived edges and all users were classed."""
+    cfg = _cfg(mode="mc", epochs=2)
+    specs = [(100, "b0", 30), (101, "i0", 30), (102, "b1", 55)]
+    seq = []
+    for seed, uid, n_songs in specs:
+        data = _user_data(seed, uid, n_songs=n_songs)
+        p = tmp_path / f"seq_{uid}"
+        p.mkdir()
+        seq.append(ALLoop(cfg).run_user(_committee(data), data, str(p)))
+
+    def entries():
+        out = []
+        for seed, uid, n_songs in specs:
+            data = _user_data(seed, uid, n_songs=n_songs)
+            fp = tmp_path / f"serve_{uid}"
+            fp.mkdir(exist_ok=True)
+            if (fp / "al_state.json").exists():
+                committee = workspace.load_committee(str(fp))
+            else:
+                committee = _committee(data)
+            out.append(FleetUser(
+                uid, committee, data, str(fp), seed=cfg.seed,
+                committee_factory=lambda fp=fp: workspace.load_committee(
+                    str(fp)),
+                priority="interactive" if uid.startswith("i")
+                else "batch"))
+        return out
+
+    jpath = str(tmp_path / "serve_journal.jsonl")
+    serve_cfg = ServeConfig(target_live=2, planner_epoch=2)
+    done: dict = {}
+    with faults.inject(FaultRule("serve.collect", "kill", at=1)) as inj:
+        journal = AdmissionJournal(jpath)
+        sched = FleetScheduler(cfg, report=FleetReport(),
+                               scoring_by_width=True)
+        server = FleetServer(sched, serve_cfg, journal=journal)
+        with pytest.raises(InjectedKill):
+            server.serve(iter(entries()),
+                         on_result=lambda r: done.update(
+                             {r["user"]: r}))
+        assert inj.fired
+        edges_at_kill = server.planner.edges
+        assert edges_at_kill  # epochs elapsed before the kill
+        journal.close()
+
+    st = AdmissionJournal(jpath).state
+    assert st.planner_edges == list(edges_at_kill)
+    classes_at_kill = dict(st.classes)
+    widths_at_kill = dict(st.widths)
+    assert classes_at_kill == {"b0": "batch", "i0": "interactive",
+                               "b1": "batch"}
+
+    journal = AdmissionJournal(jpath)
+    assert journal.recovered
+    order = journal.state.recovery_order([u for _, u, _ in specs])
+    emap = {e.user_id: e for e in entries()}
+    for e in emap.values():
+        e.priority = "batch"  # journal classes must override, not argv
+    report = FleetReport()
+    sched = FleetScheduler(cfg, report=report, scoring_by_width=True)
+    server = FleetServer(sched, serve_cfg, journal=journal)
+    # restored BEFORE the first enqueue: identical edges from replay
+    assert server.planner.edges == edges_at_kill
+    server.serve(iter(emap[u] for u in order),
+                 on_result=lambda r: done.update({r["user"]: r}))
+    journal.close()
+    for s, (_, uid, _) in zip(seq, specs):
+        assert done[uid]["error"] is None
+        assert done[uid]["result"]["trajectory"] == s["trajectory"]
+    st = AdmissionJournal(jpath).state
+    assert st.finished == {u for _, u, _ in specs}
+    # classes and admitted widths preserved across the restart
+    assert dict(st.classes) == classes_at_kill
+    for u, w in widths_at_kill.items():
+        assert st.widths[u] == w
+    admits = [e for e in report.events if e["event"] == "admit"]
+    assert all(e["cls"] == classes_at_kill[e["user"]] for e in admits)
