@@ -1,0 +1,50 @@
+"""cetpu-lint: repo-specific static analysis over Python ``ast``.
+
+Every load-bearing guarantee in this stack is a *convention*: fused fns
+donate their mask buffers (``ops.scoring.FUSED_DONATE``), qbdc dropout
+keys fold from the AL-iteration seed, replay-critical code must never
+consult a wall clock or an unseeded RNG, every ``faults.fire`` literal
+must name a registered fault point, and every schema-v2 emit site must
+match ``obs.export.EVENT_FIELDS``.  Tests enforce these only on the
+paths they happen to exercise; this package enforces them at the SOURCE
+level, before any run happens.
+
+Design constraints (see README "Static analysis"):
+
+- **pure host**: the pass imports nothing from jax.  The project model
+  (:mod:`analysis.model`) reads the ``FAULT_POINTS`` / ``EVENT_FIELDS``
+  / ``FUSED_DONATE`` tables straight out of the source files via
+  ``ast.literal_eval``, so ``cetpu-lint`` runs in seconds anywhere the
+  tree was copied to — no backend, no imports of the linted code.
+- **suppressions are visible**: a finding is silenced per line with
+  ``# cetpu: noqa[rule]`` (justify it in the same comment) or
+  grandfathered in the checked-in baseline file (``lint_baseline.json``
+  — kept EMPTY: fix it or noqa it with a reason).
+- **registry**: rules self-register (:func:`analysis.engine.register`);
+  ``cetpu-lint --list-rules`` prints the live table.
+"""
+
+from consensus_entropy_tpu.analysis.engine import (
+    Finding,
+    LintResult,
+    available_rules,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    register,
+)
+from consensus_entropy_tpu.analysis.model import ProjectModel
+
+# importing the rules module populates the registry
+from consensus_entropy_tpu.analysis import rules as _rules  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "ProjectModel",
+    "available_rules",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "register",
+]

@@ -1,0 +1,892 @@
+"""The lint rules — one per load-bearing convention in the stack.
+
+Each rule documents the CONTRACT it enforces, the scope it applies to,
+and what the accepted escape hatch is (``# cetpu: noqa[rule] <why>``).
+All checks are pure-AST heuristics: linear over branches where real
+dataflow would need a solver, conservative where types are unknowable.
+A false positive is one visible noqa with a justification — the price
+of machine-checking conventions that otherwise only fail at 3am in a
+replay drill.
+
+Scoping tables (kept here, next to the rules that read them):
+
+- :data:`REPLAY_PREFIXES` / :data:`REPLAY_FILES` — the replay-critical
+  surface: everything journaled, checkpointed or replayed must be a
+  pure function of journal/seed state, never of wall clock or unseeded
+  RNG (serve journal replay, fleet eviction+resume, resilience
+  recovery, ALState).
+- :data:`HOT_PATH_FUNCS` — the scheduler's dispatch hot path, where the
+  PR 8 h2d/d2h accounting assumes every transfer goes through
+  ``Acquirer.take_h2d`` and an implicit ``float()``/``.item()`` sync
+  would both stall the pipeline and escape the accounting.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from consensus_entropy_tpu.analysis.engine import register
+
+PKG = "consensus_entropy_tpu/"
+
+#: replay-critical modules (directory prefixes + exact files)
+REPLAY_PREFIXES = (
+    PKG + "serve/",
+    PKG + "fleet/",
+    PKG + "resilience/",
+)
+REPLAY_FILES = (
+    PKG + "al/state.py",
+    PKG + "al/workspace.py",
+)
+
+#: dispatch hot paths: file -> function names whose whole subtree
+#: (nested closures included) must not host-sync implicitly
+HOT_PATH_FUNCS = {
+    PKG + "fleet/scheduler.py": {
+        "pump", "_dispatch_scores", "_stacked_call", "_plan_call",
+        "_single_call", "_result_rows", "_hold_partial_plans",
+        "_h2d", "_stack", "_sig",
+    },
+}
+
+#: wall-clock reads replay can never reproduce.  ``time.perf_counter``
+#: is deliberately ABSENT: it is the stack's sanctioned duration-
+#: telemetry clock (StepTimer, wait_s, span durations) — process-local
+#: deltas that never feed a journaled decision; listing it would bury
+#: the real signal under telemetry noqas.
+_WALLCLOCKS = {
+    "time.time", "time.monotonic", "time.time_ns", "time.monotonic_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.now", "datetime.utcnow",  # `from datetime import datetime`
+}
+
+#: jax.random fns that CONSUME the key passed in first position (using
+#: the same key at a second sink yields correlated — or identical —
+#: streams; ``split`` consumes too: the parent key must not outlive it)
+_KEY_CONSUMERS = {
+    "split", "uniform", "normal", "bernoulli", "permutation", "randint",
+    "choice", "categorical", "gumbel", "exponential", "truncated_normal",
+    "shuffle", "bits", "dirichlet", "beta", "gamma", "poisson", "laplace",
+    "rademacher", "multivariate_normal",
+}
+
+#: order-independent consumers a set may feed directly
+_ORDER_FREE = {"sorted", "sum", "min", "max", "any", "all", "len",
+               "set", "frozenset"}
+
+#: order-CAPTURING conversions of an iterable
+_ORDER_CAPTURE = {"list", "tuple", "enumerate", "iter", "reversed"}
+
+
+def _in_pkg(path: str) -> bool:
+    return path.startswith(PKG)
+
+
+def _in_replay_scope(path: str) -> bool:
+    return path.startswith(REPLAY_PREFIXES) or path in REPLAY_FILES
+
+
+def _dotted(node) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _iter_scopes(tree):
+    """Yield ``(scope_node, body)`` for the module and every function —
+    each analyzed independently (nested defs get their own scope AND
+    appear, unanalyzed, in their parent's)."""
+    yield tree, tree.body
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, node.body
+
+
+def _calls_in_order(stmt):
+    """Call nodes within one statement, source order (nested defs and
+    lambdas excluded — separate control flow)."""
+    skip: set[int] = set()
+    for node in ast.walk(stmt):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not stmt:
+            for sub in ast.walk(node):
+                skip.add(id(sub))
+    calls = [n for n in ast.walk(stmt)
+             if isinstance(n, ast.Call) and id(n) not in skip]
+    return sorted(calls, key=lambda n: (n.lineno, n.col_offset))
+
+
+def _store_paths(stmt) -> list[str]:
+    """Dotted paths assigned by this statement (tuple targets unpacked)."""
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.For):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.withitem) and stmt.optional_vars:
+        targets = [stmt.optional_vars]
+    out = []
+    stack = list(targets)
+    while stack:
+        t = stack.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            stack.extend(t.elts)
+        else:
+            path = _dotted(t)
+            if path:
+                out.append(path)
+    return out
+
+
+# -- rule 1: donation-after-use ---------------------------------------------
+
+
+def _local_donated_fns(tree) -> dict[str, tuple]:
+    """Module-level ``X = jax.jit(fn, donate_argnums=<literal>)``
+    assignments: ``{X: positions}`` — the in-module siblings of the
+    ``FUSED_DONATE`` table (e.g. ``al.acquisition._scatter_rows``)."""
+    out: dict[str, tuple] = {}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            continue
+        callee = _dotted(node.value.func)
+        if callee is None or callee.split(".")[-1] != "jit":
+            continue
+        for kw in node.value.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            try:
+                pos = ast.literal_eval(kw.value)
+            except ValueError:
+                continue
+            out[node.targets[0].id] = (pos,) if isinstance(pos, int) \
+                else tuple(pos)
+    return out
+
+
+def _donated_positions(call, model, local) -> tuple | None:
+    """Which positional args of ``call`` are donated, or None."""
+    f = call.func
+    if isinstance(f, ast.Subscript):  # fns["mc_fused"](...)
+        sl = f.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+            return model.fused_donate.get(sl.value)
+        return None
+    name = _dotted(f)
+    if name is None:
+        return None
+    last = name.split(".")[-1]
+    return model.fused_donate.get(last) or local.get(last)
+
+
+@register(
+    "donation-after-use",
+    doc="no read of a buffer after it was passed in a donated argument "
+        "position of a *_fused / donate_argnums-jitted call",
+    applies=_in_pkg)
+def check_donation_after_use(tree, ctx):
+    """The fused serve step's contract (PR 8): the jitted ``*_fused``
+    families donate their mask operands (``ops.scoring.FUSED_DONATE``),
+    so the caller's reference is SPENT the moment the call is staged —
+    XLA reuses the buffer in place.  Reading it afterwards returns
+    whatever the dispatch scribbled there (or raises on a deleted
+    buffer), and the failure is timing-dependent: it survives unit runs
+    and dies under serve load.  The only valid continuation is the
+    RETURNED buffer (``finish_select`` adopts ``FusedStepResult``
+    masks).  Linear over branches — a donate in one branch and a read
+    in the other flags conservatively."""
+    findings = []
+    local = _local_donated_fns(tree)
+    for _scope, body in _iter_scopes(tree):
+        consumed: dict[str, int] = {}  # path -> donating line
+
+        def flat(node, store_paths=()):
+            """Process one straight-line node: register donations, flag
+            loads of already-donated paths, then clear stores."""
+            donated_args: set[int] = set()
+            for call in _calls_in_order(node):
+                pos = _donated_positions(call, ctx.model, local)
+                if not pos:
+                    continue
+                for p in pos:
+                    if p < len(call.args):
+                        path = _dotted(call.args[p])
+                        if path:
+                            donated_args.add(id(call.args[p]))
+                            consumed[path] = call.lineno
+            if consumed:
+                flagged: set[tuple] = set()  # one per (path, line)
+                for sub in ast.walk(node):
+                    if id(sub) in donated_args:
+                        continue
+                    if not isinstance(sub, (ast.Name, ast.Attribute)):
+                        continue
+                    if not isinstance(getattr(sub, "ctx", None),
+                                      ast.Load):
+                        continue
+                    path = _dotted(sub)
+                    if path is None:
+                        continue
+                    for cpath, at in consumed.items():
+                        if path != cpath \
+                                and not path.startswith(cpath + "."):
+                            continue
+                        if (cpath, sub.lineno) in flagged:
+                            continue  # mask and mask.sum are ONE read
+                        flagged.add((cpath, sub.lineno))
+                        findings.append(ctx.finding(
+                            "donation-after-use", sub,
+                            f"{path!r} was donated to a fused call "
+                            f"on line {at} and is read here; use "
+                            "the returned buffer instead (the "
+                            "donated operand is spent)"))
+            for spath in store_paths:
+                for cpath in list(consumed):
+                    if cpath == spath or cpath.startswith(spath + "."):
+                        del consumed[cpath]
+
+        def scan(stmts):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                if isinstance(stmt, ast.If):
+                    flat(stmt.test)
+                    scan(stmt.body)
+                    scan(stmt.orelse)
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    flat(stmt.iter, _store_paths(stmt))
+                    scan(stmt.body)
+                    scan(stmt.orelse)
+                elif isinstance(stmt, ast.While):
+                    flat(stmt.test)
+                    scan(stmt.body)
+                    scan(stmt.orelse)
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    for item in stmt.items:
+                        flat(item.context_expr, _store_paths(item))
+                    scan(stmt.body)
+                elif isinstance(stmt, ast.Try):
+                    scan(stmt.body)
+                    for handler in stmt.handlers:
+                        scan(handler.body)
+                    scan(stmt.orelse)
+                    scan(stmt.finalbody)
+                else:
+                    flat(stmt, _store_paths(stmt))
+
+        scan(body)
+    return findings
+
+
+# -- rule 2a: literal PRNG seeds --------------------------------------------
+
+
+@register(
+    "prng-literal-key",
+    doc="no jax.random.key / PRNGKey with a literal seed in library "
+        "code (derive from the run seed; tests/bench are exempt)",
+    applies=_in_pkg)
+def check_prng_literal(tree, ctx):
+    """Replay, failover and the qbdc mask discipline all assume every
+    key in the system derives from the ONE run seed (fold_in/split
+    chains from ``ALConfig.seed``).  A literal ``key(0)`` buried in
+    library code silently decouples that stream: two users collide, or
+    a resume replays a different committee than the original run."""
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        name = _dotted(node.func)
+        if name is None:
+            continue
+        parts = name.split(".")
+        is_key_ctor = parts[-1] == "PRNGKey" or (
+            len(parts) >= 2 and parts[-2:] == ["random", "key"])
+        if not is_key_ctor:
+            continue
+        seed = node.args[0]
+        if isinstance(seed, ast.Constant) \
+                and isinstance(seed.value, (int, float)):
+            findings.append(ctx.finding(
+                "prng-literal-key", node,
+                f"literal PRNG seed {seed.value!r} in library code; "
+                "derive the key from the run seed (config/CLI) so "
+                "replay and failover reproduce the stream"))
+    return findings
+
+
+# -- rule 2b: key reuse ------------------------------------------------------
+
+
+def _key_consumer_operand(call):
+    """``(path, fn)`` when ``call`` is a jax.random consumer taking a
+    trackable key in first position, else None."""
+    name = _dotted(call.func)
+    if name is None or not call.args:
+        return None
+    parts = name.split(".")
+    if len(parts) < 2 or parts[-2] != "random" \
+            or parts[-1] not in _KEY_CONSUMERS:
+        return None
+    path = _dotted(call.args[0])
+    return (path, parts[-1]) if path else None
+
+
+@register(
+    "prng-key-reuse",
+    doc="no key consumed by two jax.random sinks without an "
+        "interleaving split/fold_in",
+    applies=_in_pkg)
+def check_prng_key_reuse(tree, ctx):
+    """QBDC committees, dropout schedules and the rand acquisition mode
+    are bit-replayable because every sink gets its OWN key: ``k, sub =
+    split(k)`` before each use, or ``fold_in(k, i)`` per member.
+    Feeding one key to two sinks yields identical (not independent)
+    draws — a committee whose members agree by construction, an AL run
+    whose "random" arm repeats its first batch.  ``If`` branches fork
+    the tracking state and re-merge (union of consumed); loop bodies
+    are scanned twice so loop-carried reuse is caught."""
+    findings = []
+
+    def flag(call, path, fn, first):
+        findings.append(ctx.finding(
+            "prng-key-reuse", call,
+            f"key {path!r} already consumed on line {first} is fed to "
+            f"jax.random.{fn} again; split/fold_in between sinks"))
+
+    def consume_calls(node, state, seen):
+        for call in _calls_in_order(node):
+            op = _key_consumer_operand(call)
+            if op is None:
+                continue
+            path, fn = op
+            if path in state:
+                if id(call) not in seen:
+                    seen.add(id(call))
+                    flag(call, path, fn, state[path])
+            else:
+                state[path] = call.lineno
+
+    def clear_stores(paths, state):
+        for spath in paths:
+            for kpath in list(state):
+                if kpath == spath or kpath.startswith(spath + "."):
+                    del state[kpath]
+
+    def scan(stmts, state, seen) -> bool:
+        """Scan a block; returns True when it TERMINATES (every path
+        returns/raises), so an If whose taken branch exits never leaks
+        its consumed keys into the fall-through code."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.Return, ast.Raise, ast.Break,
+                                 ast.Continue)):
+                consume_calls(stmt, state, seen)
+                return True
+            if isinstance(stmt, ast.If):
+                consume_calls(stmt.test, state, seen)
+                b, o = dict(state), dict(state)
+                b_done = scan(stmt.body, b, seen)
+                o_done = scan(stmt.orelse, o, seen)
+                if b_done and o_done:
+                    return True
+                state.clear()  # re-merge: consumed in EITHER live branch
+                if not b_done:
+                    state.update(b)
+                if not o_done:
+                    state.update(o)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                consume_calls(stmt.iter, state, seen)
+                clear_stores(_store_paths(stmt), state)
+                for _ in range(2):  # twice: loop-carried reuse
+                    scan(stmt.body, state, seen)
+                scan(stmt.orelse, state, seen)
+            elif isinstance(stmt, ast.While):
+                consume_calls(stmt.test, state, seen)
+                for _ in range(2):
+                    scan(stmt.body, state, seen)
+                scan(stmt.orelse, state, seen)
+            elif isinstance(stmt, ast.Try):
+                scan(stmt.body, state, seen)
+                for h in stmt.handlers:
+                    scan(h.body, state, seen)
+                scan(stmt.orelse, state, seen)
+                scan(stmt.finalbody, state, seen)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    consume_calls(item.context_expr, state, seen)
+                    clear_stores(_store_paths(item), state)
+                scan(stmt.body, state, seen)
+            else:
+                consume_calls(stmt, state, seen)
+                clear_stores(_store_paths(stmt), state)
+        return False
+
+    for _scope, body in _iter_scopes(tree):
+        scan(body, {}, set())
+    return findings
+
+
+# -- rule 3a: wall clocks in replay-critical code ---------------------------
+
+
+@register(
+    "replay-wallclock",
+    doc="no time.time()/time.monotonic() CALLS in replay-critical "
+        "modules outside the injected-clock seams",
+    applies=_in_replay_scope)
+def check_replay_wallclock(tree, ctx):
+    """Crash-replay parity (journal replay, eviction+resume, planner
+    edge re-derivation) holds because no journaled DECISION reads the
+    wall clock.  The sanctioned pattern is the injected-clock seam — a
+    ``clock=time.monotonic`` parameter default (watchdog, breaker,
+    planner) the caller can pin in tests and drills.  Only CALLS are
+    flagged, so the uncalled seam reference is clean by construction —
+    and a CALL in a parameter default (``def f(t=time.time())``) flags
+    like any other: that is a timestamp frozen at import, reused for
+    every invocation.  Wall-stamping telemetry fields that replay
+    provably ignores is the legitimate exemption — say so in a
+    ``# cetpu: noqa`` justification."""
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if name in _WALLCLOCKS:
+            findings.append(ctx.finding(
+                "replay-wallclock", node,
+                f"{name}() in a replay-critical module; route through "
+                "an injected-clock seam (clock= parameter) or justify "
+                "via noqa that replay never reads this value"))
+    return findings
+
+
+# -- rule 3b: unseeded RNG in replay-critical code --------------------------
+
+
+@register(
+    "replay-unseeded-rng",
+    doc="no stdlib random / os.urandom / unseeded numpy RNG in "
+        "replay-critical modules",
+    applies=_in_replay_scope)
+def check_replay_unseeded_rng(tree, ctx):
+    """Every random draw on the replay surface is seeded (backoff
+    jitter, fault corruption, session keys) so a journal replay or a
+    kill-matrix drill reproduces the run bit-for-bit.  The stdlib
+    ``random`` module, ``os.urandom``, ``uuid.uuid4`` and numpy's
+    GLOBAL sampler state (``np.random.<sampler>()``, or
+    ``default_rng()`` with no seed) all break that."""
+    findings = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "random":
+                    findings.append(ctx.finding(
+                        "replay-unseeded-rng", node,
+                        "stdlib random imported in a replay-critical "
+                        "module; use a seeded np.random.default_rng or "
+                        "a jax key derived from the run seed"))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "random":
+                findings.append(ctx.finding(
+                    "replay-unseeded-rng", node,
+                    "stdlib random imported in a replay-critical "
+                    "module; use a seeded RNG"))
+        elif isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name is None:
+                continue
+            if name in ("os.urandom", "uuid.uuid4"):
+                findings.append(ctx.finding(
+                    "replay-unseeded-rng", node,
+                    f"{name}() is entropy the journal cannot replay; "
+                    "derive from the run seed"))
+                continue
+            parts = name.split(".")
+            if len(parts) >= 3 and parts[-2] == "random" \
+                    and parts[-3] in ("np", "numpy"):
+                sampler = parts[-1]
+                if sampler == "default_rng":
+                    if not node.args and not node.keywords:
+                        findings.append(ctx.finding(
+                            "replay-unseeded-rng", node,
+                            "np.random.default_rng() without a seed in "
+                            "a replay-critical module"))
+                elif sampler not in ("Generator", "SeedSequence",
+                                     "BitGenerator", "PCG64"):
+                    findings.append(ctx.finding(
+                        "replay-unseeded-rng", node,
+                        f"np.random.{sampler} uses numpy's global RNG "
+                        "state; use a seeded default_rng instance"))
+    return findings
+
+
+# -- rule 3c: set-iteration order in replay-critical code -------------------
+
+
+def _is_set_valued(node) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return _dotted(node.func) in ("set", "frozenset")
+    return False
+
+
+def _is_set_annotation(node) -> bool:
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    return _dotted(node) in ("set", "frozenset", "Set", "FrozenSet",
+                             "typing.Set", "typing.FrozenSet")
+
+
+def _set_typed_paths(tree) -> dict[int, set[str]]:
+    """Per-scope set-typed dotted paths, keyed by scope node id:
+
+    - module scope: top-level ``X = set()`` / ``X: set`` names (direct
+      statements only — a function-local ``edges = set()`` must not
+      taint the same name elsewhere in the module);
+    - each ClassDef: ``self.x`` attributes assigned/annotated a set
+      anywhere in the class body (methods included);
+    - each FunctionDef: ITS OWN locals assigned/annotated a set (no
+      descent into nested defs — they scope separately)."""
+
+    out: dict[int, set[str]] = {}
+
+    def direct_stmts(body):
+        """Statements reachable without crossing a def/class boundary."""
+        stack = list(body)
+        while stack:
+            stmt = stack.pop()
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            yield stmt
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if isinstance(sub, list):
+                    stack.extend(s for s in sub
+                                 if isinstance(s, ast.stmt))
+            for handler in getattr(stmt, "handlers", []) or []:
+                stack.extend(handler.body)
+
+    def collect(body, paths, *, attrs_only=False):
+        for stmt in direct_stmts(body):
+            if isinstance(stmt, ast.Assign) and _is_set_valued(stmt.value):
+                for t in stmt.targets:
+                    p = _dotted(t)
+                    if p and (not attrs_only or p.startswith("self.")):
+                        paths.add(p)
+            elif isinstance(stmt, ast.AnnAssign) \
+                    and _is_set_annotation(stmt.annotation):
+                p = _dotted(stmt.target)
+                if p and (not attrs_only or p.startswith("self.")):
+                    paths.add(p)
+
+    module_paths: set[str] = set()
+    collect(tree.body, module_paths)
+    out[id(tree)] = module_paths
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            paths: set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    collect(sub.body, paths, attrs_only=True)
+            out[id(node)] = paths
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            paths = set()
+            collect(node.body, paths)
+            out[id(node)] = paths
+    return out
+
+
+@register(
+    "replay-set-iteration",
+    doc="no order-dependent iteration over sets in replay-critical "
+        "modules (sorted() or an insertion-ordered dict instead)",
+    applies=_in_replay_scope)
+def check_replay_set_iteration(tree, ctx):
+    """Python set iteration order varies with insertion history and hash
+    seeds — two processes replaying the same journal can walk the same
+    set differently.  Anything that feeds journaled or emitted output
+    (finish records, assignment feeds, metrics lines) from a set walk
+    is therefore nondeterministic across restarts.  Flags: ``for``
+    loops and comprehensions iterating a set expression or a set-typed
+    attribute, and order-capturing conversions (``list``/``tuple``/
+    ``enumerate``/``iter``/``reversed``).  Order-independent reducers
+    (``sorted``/``sum``/``min``/``max``/``any``/``all``/``len``) and
+    membership tests stay silent."""
+    findings = []
+    by_scope = _set_typed_paths(tree)
+    set_paths_global = by_scope.get(id(tree), set())
+
+    #: node id -> set-typed paths visible there (module names, enclosing
+    #: class self-attrs, enclosing function locals)
+    active_at: dict[int, set[str]] = {}
+
+    def annotate(node, active: set[str]):
+        for child in ast.iter_child_nodes(node):
+            cur = active
+            if isinstance(child, (ast.ClassDef, ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                cur = active | by_scope.get(id(child), set())
+            active_at[id(child)] = cur
+            annotate(child, cur)
+
+    active_at[id(tree)] = set_paths_global
+    annotate(tree, set_paths_global)
+
+    def is_set_expr(node) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name in ("set", "frozenset"):
+                return True
+        path = _dotted(node)
+        if path is None:
+            return False
+        return path in active_at.get(id(node), set_paths_global)
+
+    # comprehensions that feed an order-free reducer directly
+    allowed_comps: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and node.args:
+            name = _dotted(node.func)
+            if name in _ORDER_FREE and isinstance(
+                    node.args[0], (ast.GeneratorExp, ast.ListComp,
+                                   ast.SetComp, ast.DictComp)):
+                allowed_comps.add(id(node.args[0]))
+
+    def flag(node, what):
+        findings.append(ctx.finding(
+            "replay-set-iteration", node,
+            f"{what} over a set in a replay-critical module is "
+            "order-nondeterministic across processes; sorted(...) it, "
+            "or keep an insertion-ordered dict"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            if is_set_expr(node.iter):
+                flag(node.iter, "for-loop iteration")
+        elif isinstance(node, (ast.GeneratorExp, ast.ListComp,
+                               ast.SetComp, ast.DictComp)):
+            if id(node) in allowed_comps:
+                continue
+            for gen in node.generators:
+                if is_set_expr(gen.iter):
+                    flag(gen.iter, "comprehension iteration")
+        elif isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name in _ORDER_CAPTURE and len(node.args) == 1 \
+                    and is_set_expr(node.args[0]):
+                flag(node, f"{name}() conversion")
+    return findings
+
+
+# -- rule 4: implicit host sync in dispatch hot paths -----------------------
+
+
+@register(
+    "implicit-host-sync",
+    doc="no float()/bool()/.item()/np.asarray in the scheduler "
+        "dispatch hot path (transfers go through Acquirer.take_h2d)",
+    applies=lambda path: path in HOT_PATH_FUNCS)
+def check_implicit_host_sync(tree, ctx):
+    """The stacked-dispatch pipeline (PR 8) stays asynchronous because
+    no result row is pulled before every bucket's dispatch is in
+    flight, and every host→device byte is graded through
+    ``Acquirer.take_h2d``.  A ``float(x)``/``bool(x)``/``x.item()``/
+    ``np.asarray(x)`` on a jax value inside the hot path is a hidden
+    blocking d2h sync — it serializes the pipeline AND escapes the
+    transfer accounting the BENCH artifacts pin."""
+    findings = []
+    hot = HOT_PATH_FUNCS.get(ctx.path, set())
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in hot:
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _dotted(sub.func)
+            msg = None
+            if name in ("float", "bool") and len(sub.args) == 1:
+                msg = (f"{name}() forces a blocking device→host sync "
+                       "in the dispatch hot path")
+            elif isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "item" and not sub.args:
+                msg = (".item() forces a blocking device→host sync in "
+                       "the dispatch hot path")
+            elif name in ("np.asarray", "numpy.asarray", "np.array",
+                          "numpy.array"):
+                msg = (f"{name}() pulls a device buffer to host outside "
+                       "the Acquirer.take_h2d transfer accounting")
+            if msg:
+                findings.append(ctx.finding(
+                    "implicit-host-sync", sub,
+                    msg + "; keep rows device-resident (lazy slices) "
+                          "or stage through the acquirer"))
+    return findings
+
+
+# -- rule 5: fault-point literals -------------------------------------------
+
+
+def _is_fire_call(call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id == "fire"
+    if isinstance(f, ast.Attribute) and f.attr == "fire":
+        base = _dotted(f.value)
+        return base is not None and base.split(".")[-1] == "faults"
+    return False
+
+
+@register(
+    "fault-point-literal",
+    doc="every faults.fire / FaultRule / fault_point string literal "
+        "must name a registered resilience.faults.FAULT_POINTS member")
+def check_fault_point_literal(tree, ctx):
+    """The fault matrix only drills boundaries that EXIST: a typo'd
+    ``faults.fire("serve.dipatch")`` never fires (the injector matches
+    nothing) and its recovery path silently stops being exercised.
+    ``FaultRule.__post_init__`` validates at RUNTIME — i.e. only when
+    the drill runs (``faults.py``); this check resolves every literal
+    statically: ``faults.fire("…")`` calls, ``FaultRule(point=…)``
+    constructions, ``fault_point = "…"`` plan attributes, and
+    ``parse_spec("point:action…")`` specs."""
+    model = ctx.model
+    if not model.fault_points:
+        return []
+    findings = []
+
+    def check_point(node, value: str):
+        if value not in model.fault_points:
+            findings.append(ctx.finding(
+                "fault-point-literal", node,
+                f"fault point {value!r} is not in resilience.faults."
+                f"FAULT_POINTS; register it there or fix the literal"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            if _is_fire_call(node) and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str):
+                    check_point(arg, arg.value)
+            name = _dotted(node.func)
+            last = name.split(".")[-1] if name else None
+            if last == "FaultRule":
+                point = None
+                if node.args and isinstance(node.args[0], ast.Constant):
+                    point = node.args[0]
+                for kw in node.keywords:
+                    if kw.arg == "point" \
+                            and isinstance(kw.value, ast.Constant):
+                        point = kw.value
+                if point is not None and isinstance(point.value, str):
+                    check_point(point, point.value)
+            elif last == "parse_spec" and node.args:
+                spec = node.args[0]
+                if isinstance(spec, ast.Constant) \
+                        and isinstance(spec.value, str):
+                    for part in spec.value.split(","):
+                        part = part.strip()
+                        if ":" in part:
+                            check_point(spec, part.split(":", 1)[0])
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, (ast.Name, ast.Attribute)) \
+                        and (t.id if isinstance(t, ast.Name) else t.attr) \
+                        == "fault_point" \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, str):
+                    check_point(node.value, node.value.value)
+    return findings
+
+
+# -- rule 6: event-schema conformance ---------------------------------------
+
+
+@register(
+    "event-schema",
+    doc="every report.event(...) / EventWriter.emit({...}) literal "
+        "emit site must match obs.export.EVENT_FIELDS")
+def check_event_schema(tree, ctx):
+    """``obs.export.validate_metrics`` rejects malformed records at READ
+    time — after the run already emitted them.  This check moves the
+    contract to the emit site: a literal event kind must be registered
+    in ``EVENT_FIELDS``, and the call's keyword set must cover the
+    kind's required fields (a ``**kwargs`` splat defeats the field
+    check but the kind is still verified).  Extra fields are fine —
+    the schema lists the floor, not the ceiling."""
+    model = ctx.model
+    if not model.event_fields:
+        return []
+    findings = []
+
+    def check_kind(node, kind, present, has_splat):
+        if kind not in model.event_fields:
+            findings.append(ctx.finding(
+                "event-schema", node,
+                f"event kind {kind!r} is not in obs.export."
+                f"EVENT_FIELDS; register it (with its required fields) "
+                "or fix the literal"))
+            return
+        if has_splat:
+            return
+        missing = [f for f in model.event_fields[kind]
+                   if f not in present]
+        if missing:
+            findings.append(ctx.finding(
+                "event-schema", node,
+                f"event {kind!r} emit site lacks required field(s) "
+                f"{missing}; EVENT_FIELDS requires "
+                f"{list(model.event_fields[kind])}"))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute):
+            continue
+        if node.func.attr == "event":
+            if not node.args:
+                continue
+            kind = node.args[0]
+            if not (isinstance(kind, ast.Constant)
+                    and isinstance(kind.value, str)):
+                continue
+            present = {kw.arg for kw in node.keywords
+                       if kw.arg is not None}
+            has_splat = any(kw.arg is None for kw in node.keywords)
+            check_kind(node, kind.value, present, has_splat)
+        elif node.func.attr == "emit" and len(node.args) == 1 \
+                and isinstance(node.args[0], ast.Dict):
+            d = node.args[0]
+            keys = {}
+            has_splat = False
+            for k, v in zip(d.keys, d.values):
+                if k is None:
+                    has_splat = True  # {**rec} merge: keys unknowable
+                elif isinstance(k, ast.Constant):
+                    keys[k.value] = v
+            kind = keys.get("event")
+            if isinstance(kind, ast.Constant) \
+                    and isinstance(kind.value, str):
+                check_kind(node, kind.value, set(keys), has_splat)
+    return findings

@@ -264,7 +264,11 @@ class FleetScheduler:
                                              thread_name_prefix="fleet-ckpt")
         #: (state, value, exc) triples whose generator can be stepped now
         self._ready: collections.deque = collections.deque()
-        self._live: set = set()
+        #: sessions holding a slot, ADMISSION-ordered (an insertion-
+        #: ordered dict used as a set: ``abort`` walks it to close
+        #: generators and set order would tear down in id()-hash order,
+        #: different every process)
+        self._live: dict = {}
         self._score_wait: list = []   # (state, ScoreStep)
         self._host_wait: dict = {}    # Future -> (state, HostStep)
         #: futures of watchdog-abandoned host steps: their zombie threads
@@ -293,7 +297,7 @@ class FleetScheduler:
         self._reap_hung_hosts()
         while self._ready:
             state, value, exc = self._ready.popleft()
-            self._live.add(state)
+            self._live[state] = None
             self._track(state, self._advance(state, value, exc))
         if self._score_wait:
             window = self.batch_window_s
@@ -435,7 +439,7 @@ class FleetScheduler:
 
     def _track(self, state: _SessionState, step) -> None:
         if step is None:
-            self._live.discard(state)
+            self._live.pop(state, None)
         elif isinstance(step, (ScoreStep, DeviceStep)):
             # DeviceSteps share the score-wait list: both are device
             # dispatches whose batches fill as peers reach their own
@@ -731,7 +735,7 @@ class FleetScheduler:
             if not use_stacked:
                 single.append((group, width, fn_key))
                 continue
-            w0 = time.time()
+            w0 = time.time()  # cetpu: noqa[replay-wallclock] span wall-stamp (telemetry; span ids stay deterministic)
             t0 = time.perf_counter()
             if isinstance(step0, DeviceStep):
                 try:
@@ -770,7 +774,7 @@ class FleetScheduler:
         # buckets, and the stacked-failure fallback
         for group, width, fn_key in single:
             for st, step in group:
-                w0 = time.time()
+                w0 = time.time()  # cetpu: noqa[replay-wallclock] span wall-stamp (telemetry; span ids stay deterministic)
                 t0 = time.perf_counter()
                 try:
                     res = self._single_call(step)

@@ -130,7 +130,7 @@ class FabricCoordinator:
     def __init__(self, journal, fabric_dir: str, config: FabricConfig, *,
                  poison: PoisonList | None = None,
                  report: FleetReport | None = None, on_poll=None,
-                 preemption=None, tracer=None):
+                 preemption=None, tracer=None, clock=time.time):
         if journal.path is None:
             raise ValueError("the fabric journal must be file-backed — it "
                              "is the coordinator's source of truth")
@@ -152,6 +152,12 @@ class FabricCoordinator:
         #: this tracer's own sink — the span-side sibling of the event
         #: transcription, so one merged file holds the fleet timeline
         self.tracer = tracer
+        #: the injected WALL clock (lease files cross processes, so
+        #: monotonic clocks don't compare): every liveness deadline —
+        #: lease age, spawn grace, drain timeouts, orphan-reap polls —
+        #: reads through this seam, pinnable in tests and drills.
+        #: Liveness is runtime-only; journal replay never reads a clock.
+        self._clock = clock
         self.hosts: dict[str, HostHandle] = {}
         self.reassignments = 0
         self.revocations = 0
@@ -248,7 +254,7 @@ class FabricCoordinator:
         self.journal.append("lease", host=host_id,
                             pid=getattr(proc, "pid", None))
         h = HostHandle(host_id, proc, _AppendFsyncFile(paths["assign"]),
-                       tail, paths["lease"], time.time())
+                       tail, paths["lease"], self._clock())
         if self.tracer is not None and self.tracer.enabled:
             h.span_tail = JsonlTail(paths["spans"])
         self.hosts[host_id] = h
@@ -285,8 +291,8 @@ class FabricCoordinator:
             except (ProcessLookupError, PermissionError):
                 pass
             else:
-                deadline = time.time() + 5.0
-                while time.time() < deadline:
+                deadline = self._clock() + 5.0
+                while self._clock() < deadline:
                     try:
                         os.kill(pid, 0)
                     except (ProcessLookupError, PermissionError):
@@ -299,7 +305,7 @@ class FabricCoordinator:
                 pass
 
     def _check_hosts(self) -> None:
-        now = time.time()
+        now = self._clock()
         for h in list(self.hosts.values()):
             if not h.alive:
                 continue
@@ -348,10 +354,10 @@ class FabricCoordinator:
             if h.alive:
                 h.closed = True
                 h.assign.append({"close": True})
-        deadline = time.time() + self.config.drain_timeout_s
+        deadline = self._clock() + self.config.drain_timeout_s
         for h in self.hosts.values():
             if h.alive:
-                while h.proc.poll() is None and time.time() < deadline:
+                while h.proc.poll() is None and self._clock() < deadline:
                     time.sleep(self.config.poll_s)
                 if h.proc.poll() is None:
                     self.report.event("drain_kill", host=h.host_id)
@@ -383,11 +389,11 @@ class FabricCoordinator:
                     h.proc.terminate()
                 except Exception:
                     pass
-        deadline = time.time() + self.config.drain_timeout_s
+        deadline = self._clock() + self.config.drain_timeout_s
         for h in self.hosts.values():
             if not h.alive:
                 continue
-            while h.proc.poll() is None and time.time() < deadline:
+            while h.proc.poll() is None and self._clock() < deadline:
                 self._transcribe(h)
                 time.sleep(self.config.poll_s)
             if h.proc.poll() is None:

@@ -89,7 +89,7 @@ def lease_age_s(path: str, now: float | None = None) -> float | None:
     rec = read_lease(path)
     if rec is None or not isinstance(rec.get("t"), (int, float)):
         return None
-    return (time.time() if now is None else now) - rec["t"]
+    return (time.time() if now is None else now) - rec["t"]  # cetpu: noqa[replay-wallclock] this IS the seam's fallback (now= is the injection point)
 
 
 class HostLease:
@@ -132,7 +132,7 @@ class HostLease:
             f.write(json.dumps(
                 {"host": self.host_id, "pid": os.getpid(),
                  "beat": self.beats,
-                 "t": round(time.time(), 3)}).encode("utf-8"))
+                 "t": round(time.time(), 3)}).encode("utf-8"))  # cetpu: noqa[replay-wallclock] heartbeat wall-stamp: liveness crosses processes, replay never reads it
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)

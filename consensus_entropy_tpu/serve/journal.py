@@ -512,7 +512,7 @@ class AdmissionJournal:
             faults.fire("serve.journal.append", event=event,
                         user=None if user is None else str(user))
             rec = {"event": event, "seq": self.state.seq + 1,
-                   "t": round(time.time(), 3), **fields}
+                   "t": round(time.time(), 3), **fields}  # cetpu: noqa[replay-wallclock] operator wall-stamp; replay keys on seq, never t
             if user is not None:
                 rec["user"] = str(user)
             self._file.append(rec)
@@ -638,7 +638,7 @@ class PoisonList:
 
     def add(self, user, *, error: str, attempts: int) -> None:
         rec = {"user": str(user), "error": error, "attempts": attempts,
-               "t": round(time.time(), 3)}
+               "t": round(time.time(), 3)}  # cetpu: noqa[replay-wallclock] operator wall-stamp; replay keys on membership, never t
         with self._lock:
             self._users[str(user)] = rec
             self._file.append(rec)
@@ -651,7 +651,7 @@ class PoisonList:
             if str(user) not in self._users:
                 return False
             self._file.append({"event": "unpoison", "user": str(user),
-                               "t": round(time.time(), 3)})
+                               "t": round(time.time(), 3)})  # cetpu: noqa[replay-wallclock] operator wall-stamp; replay keys on record order, never t
             del self._users[str(user)]
             return True
 

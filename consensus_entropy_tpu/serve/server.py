@@ -370,7 +370,11 @@ class FleetServer:
         self.results: list[dict] = []
         self._admitted: list[FleetUser] = []
         self._admitted_ids: set[int] = set()
-        self._pending: set[int] = set()
+        #: in-flight entry ids, ADMISSION-ordered (an insertion-ordered
+        #: dict, not a set: ``_collect`` walks it to journal ``finish``
+        #: records and fire ``on_result`` — set order would journal
+        #: completions in id()-hash order, different every process)
+        self._pending: dict[int, None] = {}
         #: one pulled-but-unqueued entry held when a concurrent submit()
         #: filled the queue's last slot between our pull and our put
         self._spill: FleetUser | None = None
@@ -477,7 +481,9 @@ class FleetServer:
             # it — concurrent producers cannot race the epoch boundary
             # into a sketch that replay would reconstruct differently
             self.planner.observe_enqueue(
-                pool, t=time.monotonic(),
+                # the wall read below sizes HOLDS only (when work
+                # batches), never journaled results
+                pool, t=time.monotonic(),  # cetpu: noqa[replay-wallclock] arrival EMA
                 journal_entry=lambda: self._journal(
                     "enqueue", entry.user_id, **fields))
         else:
@@ -622,7 +628,7 @@ class FleetServer:
                     timeout = max(cfg.admit_window_s, 0.05)
                     if self._requeue:
                         due = min(t for t, _ in self._requeue) \
-                            - time.monotonic()
+                            - time.monotonic()  # cetpu: noqa[replay-wallclock] wait-timeout sizing; nothing journaled
                         timeout = min(timeout, max(due, 0.01))
                     self.queue.wait_nonempty(timeout)
         except BaseException:
@@ -704,7 +710,7 @@ class FleetServer:
             if id(entry) not in self._admitted_ids:
                 self._admitted_ids.add(id(entry))
                 self._admitted.append(entry)
-            self._pending.add(id(entry))
+            self._pending[id(entry)] = None
             wait_s = time.perf_counter() - t_enq
             if self.planner is not None:
                 # headroom back-dates by the queue wait: the SLO clock
@@ -720,7 +726,7 @@ class FleetServer:
                 # wait.  The queue stamps entries BEFORE the root span
                 # opens, so clamp the span start inside its parent
                 # (strict nesting is an export invariant).
-                now = time.time()
+                now = time.time()  # cetpu: noqa[replay-wallclock] span wall-stamp (telemetry; ids stay deterministic)
                 t0 = now - wait_s
                 root_t0 = tracer.user_open_t0(uid)
                 if root_t0 is not None:
@@ -736,7 +742,7 @@ class FleetServer:
         its due time and retries next round)."""
         if not self._requeue:
             return
-        now = time.monotonic()
+        now = time.monotonic()  # cetpu: noqa[replay-wallclock] backoff due-time check; delays are seeded, nothing journaled
         still: list = []
         for due, entry in self._requeue:
             if due > now:
@@ -786,7 +792,7 @@ class FleetServer:
                               base_delay=self.config.backoff_base_s,
                               max_delay=self.config.backoff_max_s,
                               rng=self._backoff_rng)
-        self._requeue.append((time.monotonic() + delay, entry))
+        self._requeue.append((time.monotonic() + delay, entry))  # cetpu: noqa[replay-wallclock] due time is runtime scheduling; the fail record carries no clock
         self._journal("fail", uid, error=error, attempt=attempts)
         self.report.event("requeue", user=uid, attempt=attempts,
                           delay_s=round(delay, 4), error=error)
@@ -812,7 +818,7 @@ class FleetServer:
         # final workspace (idempotently) — no user is lost
         faults.fire("serve.collect", n=len(finished))
         for eid in finished:
-            self._pending.discard(eid)
+            self._pending.pop(eid, None)
             rec = self.scheduler.results[eid]
             if self.planner is not None:
                 self.planner.note_resolved(rec["user"])

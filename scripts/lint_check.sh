@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Static-analysis CI gate (ISSUE 12 tentpole; sits next to obs_check.sh
+# and slo_check.sh in the verify chain).
+#
+# Runs cetpu-lint over the whole tree — the donation / PRNG /
+# replay-determinism / host-sync / fault-point / event-schema invariant
+# rules (see README "Static analysis") — and fails on:
+#   1. any unbaselined, un-noqa'd finding (exit 1 from the linter),
+#   2. a parse error anywhere in the tree,
+#   3. a wall-clock blowout: the pass is pure AST and must stay
+#      interactive (<10 s on the CI box) so it runs on every change.
+#
+# The checked-in baseline (lint_baseline.json) is EMPTY by policy: a new
+# finding is either fixed or carries a per-line
+#   # cetpu: noqa[rule] <one-line justification>
+# — grandfathering via the baseline is for migrations only.
+#
+# Pure host: no jax import anywhere on this path (JAX_PLATFORMS unset is
+# fine); safe on a box with no accelerator.
+#
+# Extra args are passed through to cetpu-lint (e.g. --format json).
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+start=$(date +%s)
+python -m consensus_entropy_tpu.analysis.cli "$@"
+end=$(date +%s)
+
+elapsed=$((end - start))
+if [ "$elapsed" -ge 10 ]; then
+  echo "lint check FAILED: full-tree lint took ${elapsed}s (>= 10s budget)" >&2
+  exit 1
+fi
+echo "lint check passed (${elapsed}s)"

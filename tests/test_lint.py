@@ -1,0 +1,524 @@
+"""cetpu-lint (ISSUE 12): rule fixtures, suppression/baseline semantics,
+the model↔runtime registry cross-check, and the repo-lints-clean gate.
+
+Pure host and tier-1 fast: every fixture is a `lint_source` call over a
+snippet at a VIRTUAL repo path (so the path-scoped rules see the right
+scope without touching the tree), plus one full-tree integration lint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+from consensus_entropy_tpu.analysis import (
+    ProjectModel,
+    available_rules,
+    lint_paths,
+    lint_source,
+)
+from consensus_entropy_tpu.analysis.cli import (
+    DEFAULT_PATHS,
+    main as lint_main,
+)
+from consensus_entropy_tpu.analysis.engine import (
+    apply_baseline,
+    baseline_from,
+    load_baseline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MODEL = ProjectModel.from_repo(REPO)
+
+PKG_FILE = "consensus_entropy_tpu/ops/fixture.py"
+REPLAY_FILE = "consensus_entropy_tpu/serve/fixture.py"
+
+
+def rules_fired(src: str, path: str = PKG_FILE, *, model=MODEL,
+                select=None) -> list[str]:
+    src = textwrap.dedent(src)
+    return [f.rule for f in lint_source(src, path, model=model,
+                                        select=select)]
+
+
+# -- the model loader vs the runtime registries ------------------------------
+
+
+def test_model_matches_runtime_registries():
+    """The satellite cross-check: the statically parsed tables EQUAL the
+    runtime objects, so fault-point / event-schema / donation checks can
+    never drift from what the code actually enforces."""
+    from consensus_entropy_tpu.obs import export
+    from consensus_entropy_tpu.ops import scoring
+    from consensus_entropy_tpu.resilience import faults
+
+    assert MODEL.fault_points == faults.FAULT_POINTS
+    assert MODEL.event_fields == {k: tuple(v) for k, v
+                                  in export.EVENT_FIELDS.items()}
+    assert MODEL.fused_donate == {k: tuple(v) for k, v
+                                  in scoring.FUSED_DONATE.items()}
+
+
+def test_registry_has_the_contracted_rules():
+    rules = available_rules()
+    assert len(rules) >= 6
+    for name in ("donation-after-use", "prng-literal-key",
+                 "prng-key-reuse", "replay-wallclock",
+                 "replay-unseeded-rng", "replay-set-iteration",
+                 "implicit-host-sync", "fault-point-literal",
+                 "event-schema"):
+        assert name in rules, name
+
+
+# -- rule 1: donation-after-use ---------------------------------------------
+
+
+def test_donation_after_use_fires_on_read_of_donated_buffer():
+    fired = rules_fired("""
+        def step(fns, probs, mask):
+            res = fns["mc_fused"](probs, mask)
+            return res, mask.sum()
+    """)
+    assert fired == ["donation-after-use"]
+
+
+def test_donation_after_use_silent_when_result_adopted():
+    fired = rules_fired("""
+        def step(fns, probs, mask):
+            res = fns["mc_fused"](probs, mask)
+            mask = res.pool_mask
+            return res, mask.sum()
+    """)
+    assert fired == []
+
+
+def test_donation_tracks_local_jax_jit_donate_argnums():
+    src = """
+        import jax
+
+        _scatter = jax.jit(_impl, donate_argnums=0)
+
+        def stage(buf, rows, p):
+            out = _scatter(buf, rows, p)
+            return buf
+    """
+    assert rules_fired(src) == ["donation-after-use"]
+    # the repo's own idiom — rebind the donated path to the result —
+    # is clean even through an attribute chain
+    assert rules_fired("""
+        import jax
+
+        _scatter = jax.jit(_impl, donate_argnums=0)
+
+        def stage(self, rows, p):
+            self.device.probs = _scatter(self.device.probs, rows, p)
+            return self.device.probs
+    """) == []
+
+
+# -- rule 2a: prng-literal-key ----------------------------------------------
+
+
+def test_prng_literal_key_fires_in_library_code_only():
+    src = """
+        import jax
+
+        key = jax.random.key(0)
+    """
+    assert rules_fired(src) == ["prng-literal-key"]
+    assert rules_fired(src.replace("key(0)", "PRNGKey(42)")) \
+        == ["prng-literal-key"]
+    # tests and bench are exempt by scope
+    assert rules_fired(src, "tests/test_fixture.py") == []
+    # a seed-derived key is the sanctioned form
+    assert rules_fired("""
+        import jax
+
+        def make(seed):
+            return jax.random.key(seed)
+    """) == []
+
+
+# -- rule 2b: prng-key-reuse -------------------------------------------------
+
+
+def test_prng_key_reuse_fires_on_two_sinks_one_key():
+    fired = rules_fired("""
+        import jax
+
+        def draw(key):
+            a = jax.random.uniform(key, (3,))
+            b = jax.random.normal(key, (3,))
+            return a, b
+    """)
+    assert fired == ["prng-key-reuse"]
+
+
+def test_prng_key_reuse_silent_with_split_between():
+    assert rules_fired("""
+        import jax
+
+        def draw(key):
+            key, sub = jax.random.split(key)
+            a = jax.random.uniform(sub, (3,))
+            key, sub = jax.random.split(key)
+            b = jax.random.normal(sub, (3,))
+            return a, b
+    """) == []
+
+
+def test_prng_key_reuse_branches_and_loops():
+    # either-or branches each consume once: clean
+    assert rules_fired("""
+        import jax
+
+        def draw(key, flip):
+            if flip:
+                return jax.random.uniform(key, (3,))
+            return jax.random.normal(key, (3,))
+    """) == []
+    # loop-carried reuse: the same key every iteration
+    assert rules_fired("""
+        import jax
+
+        def draw(key, n):
+            out = []
+            for i in range(n):
+                out.append(jax.random.uniform(key, (3,)))
+            return out
+    """) == ["prng-key-reuse"]
+    # fold_in per iteration is the sanctioned loop form
+    assert rules_fired("""
+        import jax
+
+        def draw(key, n):
+            out = []
+            for i in range(n):
+                out.append(jax.random.uniform(
+                    jax.random.fold_in(key, i), (3,)))
+            return out
+    """) == []
+
+
+# -- rule 3a: replay-wallclock -----------------------------------------------
+
+
+def test_replay_wallclock_scoped_to_replay_modules():
+    src = """
+        import time
+
+        def stamp():
+            return time.time()
+    """
+    assert rules_fired(src, REPLAY_FILE) == ["replay-wallclock"]
+    # ops/ is not replay-critical: silent
+    assert rules_fired(src, PKG_FILE) == []
+
+
+def test_replay_wallclock_allows_injected_clock_seam():
+    assert rules_fired("""
+        import time
+
+        class Watchdog:
+            def __init__(self, deadline_s, *, clock=time.monotonic):
+                self.clock = clock
+
+            def expired(self, armed_t):
+                return self.clock() - armed_t
+    """, REPLAY_FILE) == []
+
+
+def test_replay_wallclock_flags_call_in_default_and_bare_datetime():
+    # a CALL in a parameter default is a timestamp frozen at import —
+    # the opposite of a seam — and must flag
+    assert rules_fired("""
+        import time
+
+        def f(t=time.time()):
+            return t
+    """, REPLAY_FILE) == ["replay-wallclock"]
+    # the `from datetime import datetime` spelling is covered too
+    assert rules_fired("""
+        from datetime import datetime
+
+        def stamp():
+            return datetime.now()
+    """, REPLAY_FILE) == ["replay-wallclock"]
+
+
+# -- rule 3b: replay-unseeded-rng --------------------------------------------
+
+
+def test_replay_unseeded_rng():
+    assert rules_fired("import random\n", REPLAY_FILE) \
+        == ["replay-unseeded-rng"]
+    assert rules_fired("""
+        import numpy as np
+
+        def jitter():
+            return np.random.default_rng().uniform()
+    """, REPLAY_FILE) == ["replay-unseeded-rng"]
+    assert rules_fired("""
+        import numpy as np
+
+        def jitter():
+            return np.random.rand()
+    """, REPLAY_FILE) == ["replay-unseeded-rng"]
+    # the seeded instance is the sanctioned form
+    assert rules_fired("""
+        import numpy as np
+
+        def jitter(seed):
+            return np.random.default_rng(seed).uniform()
+    """, REPLAY_FILE) == []
+
+
+# -- rule 3c: replay-set-iteration -------------------------------------------
+
+
+def test_replay_set_iteration_fires_on_order_dependent_walks():
+    assert rules_fired("""
+        def emit_all(xs, emit):
+            for x in set(xs):
+                emit(x)
+    """, REPLAY_FILE) == ["replay-set-iteration"]
+    assert rules_fired("""
+        class Server:
+            def __init__(self):
+                self.pending = set()
+
+            def collect(self):
+                return [x for x in self.pending]
+    """, REPLAY_FILE) == ["replay-set-iteration"]
+    assert rules_fired("""
+        def snapshot(live):
+            return list({u for u in live})
+    """, REPLAY_FILE) == ["replay-set-iteration"]
+
+
+def test_replay_set_iteration_allows_order_free_consumers():
+    assert rules_fired("""
+        class Server:
+            def __init__(self):
+                self.pending = set()
+
+            def collect(self):
+                return sorted(self.pending)
+
+            def depth(self, width):
+                return sum(1 for x in self.pending if x == width)
+    """, REPLAY_FILE) == []
+    # a function-local `edges = set()` must not taint the same NAME in
+    # other functions (the planner regression)
+    assert rules_fired("""
+        def derive():
+            edges = set()
+            edges.add(1)
+            return tuple(sorted(edges))
+
+        def restore(edges):
+            return tuple(int(e) for e in edges)
+    """, REPLAY_FILE) == []
+
+
+# -- rule 4: implicit-host-sync ----------------------------------------------
+
+
+def test_implicit_host_sync_scoped_to_hot_functions():
+    sched = "consensus_entropy_tpu/fleet/scheduler.py"
+    src = """
+        import numpy as np
+
+        class S:
+            def _stacked_call(self, fn, vals):
+                out = fn(vals)
+                return float(out[0]), np.asarray(out[1]), out[2].item()
+
+            def summary(self, out):
+                return float(out[0])
+    """
+    fired = rules_fired(src, sched)
+    # the hot function fires per sync site; the cold one is silent
+    assert fired == ["implicit-host-sync"] * 3
+
+
+# -- rule 5: fault-point-literal ---------------------------------------------
+
+
+def test_fault_point_literal():
+    assert rules_fired("""
+        from consensus_entropy_tpu.resilience import faults
+
+        def go():
+            faults.fire("serve.dispatch", fn="mc", width=8)
+    """) == []
+    assert rules_fired("""
+        from consensus_entropy_tpu.resilience import faults
+
+        def go():
+            faults.fire("serve.dipatch")
+    """) == ["fault-point-literal"]
+    # FaultRule construction, fault_point attributes and parse_spec
+    # specs resolve statically too
+    assert rules_fired("""
+        rule = FaultRule(point="nope", action="kill")
+    """) == ["fault-point-literal"]
+    assert rules_fired("""
+        class Plan:
+            fault_point = "pool.score"
+    """) == []
+    assert rules_fired("""
+        rules = parse_spec("checkpoint.write:kill@3,bogus.point:raise")
+    """) == ["fault-point-literal"]
+
+
+# -- rule 6: event-schema ----------------------------------------------------
+
+
+def test_event_schema():
+    assert rules_fired("""
+        def done(report, u):
+            report.event("user_done", user=str(u))
+    """) == []
+    assert rules_fired("""
+        def admit(report, u):
+            report.event("admit", user=str(u))
+    """) == ["event-schema"]  # missing width/wait_s/depth/live
+    assert rules_fired("""
+        def admit(report, u):
+            report.event("totally_new_event", user=str(u))
+    """) == ["event-schema"]  # unregistered kind
+    # a **splat defeats the field check but the kind is still verified
+    assert rules_fired("""
+        def fail(report, rec):
+            report.event("user_failed", **rec)
+    """) == []
+    assert rules_fired("""
+        def emit(writer):
+            writer.emit({"event": "enqueue", "user": "u1", "depth": 3,
+                         "t_s": 0.1})
+    """) == []
+    assert rules_fired("""
+        def emit(writer):
+            writer.emit({"event": "enqueue", "t_s": 0.1})
+    """) == ["event-schema"]
+
+
+# -- suppression + baseline semantics ----------------------------------------
+
+
+def test_noqa_suppresses_named_rule_only():
+    base = "import time\n\n\ndef f():\n    return time.time(){}\n"
+    assert rules_fired(base.format(""), REPLAY_FILE) \
+        == ["replay-wallclock"]
+    assert rules_fired(
+        base.format("  # cetpu: noqa[replay-wallclock] wall-stamp"),
+        REPLAY_FILE) == []
+    assert rules_fired(base.format("  # cetpu: noqa"), REPLAY_FILE) == []
+    # a noqa for a DIFFERENT rule does not suppress
+    assert rules_fired(
+        base.format("  # cetpu: noqa[event-schema] wrong rule"),
+        REPLAY_FILE) == ["replay-wallclock"]
+
+
+def test_baseline_counts_grandfather_then_ratchet():
+    src = textwrap.dedent("""
+        import time
+
+        def f():
+            return time.time()
+
+        def g():
+            return time.time()
+    """)
+    findings = lint_source(src, REPLAY_FILE, model=MODEL)
+    assert [f.rule for f in findings] == ["replay-wallclock"] * 2
+    baseline = baseline_from(findings)
+    assert baseline == {"replay-wallclock:" + REPLAY_FILE: 2}
+    # the full baseline absorbs everything; one-less leaves the LAST
+    # (highest-line) finding — the ratchet direction
+    assert apply_baseline(findings, baseline) == []
+    partial = {"replay-wallclock:" + REPLAY_FILE: 1}
+    left = apply_baseline(findings, partial)
+    assert [f.line for f in left] == [findings[1].line]
+
+
+def test_baseline_file_round_trip(tmp_path):
+    path = tmp_path / "lint_baseline.json"
+    path.write_text(json.dumps({"replay-wallclock:x.py": 2}))
+    assert load_baseline(str(path)) == {"replay-wallclock:x.py": 2}
+    assert load_baseline(str(tmp_path / "missing.json")) == {}
+
+
+# -- the whole-repo gate -----------------------------------------------------
+
+
+def test_repo_lints_clean_with_empty_baseline():
+    """The acceptance pin: the committed tree has NO unbaselined,
+    un-noqa'd finding, the committed baseline is empty, and the full
+    pass stays interactive (<10 s)."""
+    committed = load_baseline(os.path.join(REPO, "lint_baseline.json"))
+    assert committed == {}, "the baseline must stay empty: fix or noqa"
+    result = lint_paths(list(DEFAULT_PATHS), root=REPO, model=MODEL)
+    assert result.errors == []
+    assert result.findings == [], "\n".join(str(f)
+                                            for f in result.findings)
+    assert result.files > 100  # the walk really covered the tree
+    assert result.wall_s < 10.0
+
+
+def test_cli_end_to_end(tmp_path, capsys):
+    """The console entry against a synthetic repo root: violating file
+    → exit 1 with a JSON finding; --write-baseline grandfathers it →
+    exit 0; --list-rules prints the registry."""
+    pkg = tmp_path / "consensus_entropy_tpu"
+    for rel, name, payload in (
+            ("resilience/faults.py", "FAULT_POINTS",
+             'FAULT_POINTS = frozenset({"pool.score"})'),
+            ("obs/export.py", "EVENT_FIELDS",
+             'EVENT_FIELDS = {"enqueue": ("user", "depth")}'),
+            ("ops/scoring.py", "FUSED_DONATE",
+             'FUSED_DONATE = {"mc_fused": (1,)}')):
+        f = pkg / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(payload + "\n")
+    bad = pkg / "serve" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("import time\n\n\ndef f():\n    return time.time()\n")
+
+    rc = lint_main(["--root", str(tmp_path), "--format", "json",
+                    "consensus_entropy_tpu"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [f["rule"] for f in payload["findings"]] \
+        == ["replay-wallclock"]
+
+    rc = lint_main(["--root", str(tmp_path), "--write-baseline",
+                    "consensus_entropy_tpu"])
+    assert rc == 0
+    capsys.readouterr()
+    rc = lint_main(["--root", str(tmp_path), "consensus_entropy_tpu"])
+    assert rc == 0
+
+    assert lint_main(["--list-rules"]) == 0
+    listing = capsys.readouterr().out
+    assert "donation-after-use" in listing
+
+    # unknown rule: usage error, not a lint failure
+    assert lint_main(["--root", str(tmp_path),
+                      "--select", "no-such-rule"]) == 2
+
+    # a typo'd path must FAIL (usage error), not lint 0 files and pass
+    assert lint_main(["--root", str(tmp_path),
+                      "consensus_entropy_tpu/srve"]) == 2
+
+    # --write-baseline refuses while files are unparseable (a partial
+    # baseline would grandfather a lie) and leaves the file untouched
+    (pkg / "serve" / "torn.py").write_text("def broken(:\n")
+    baseline_path = tmp_path / "lint_baseline.json"
+    before = baseline_path.read_text()
+    assert lint_main(["--root", str(tmp_path), "--write-baseline",
+                      "consensus_entropy_tpu"]) == 2
+    assert baseline_path.read_text() == before
+    (pkg / "serve" / "torn.py").unlink()

@@ -476,7 +476,7 @@ def test_fault_rule_spec_parsing():
         ("pool.score", "delay", 1, 1),
     ]
     with pytest.raises(ValueError, match="unknown fault point"):
-        faults.parse_spec("nope:kill")
+        faults.parse_spec("nope:kill")  # cetpu: noqa[fault-point-literal] deliberately-invalid point: pins the runtime rejection
     with pytest.raises(ValueError, match="bad CETPU_FAULTS entry"):
         faults.parse_spec("checkpoint.write")
 
