@@ -2359,6 +2359,214 @@ def run_fabric_suite(args_ns) -> int:
     return 0
 
 
+def run_elastic_suite(args_ns) -> int:
+    """Elastic fabric control plane: recovered-users/sec + per-host
+    stacked-dispatch occupancy, bucket-aware vs least-loaded placement.
+
+    Both arms run the SAME drill per rep: a 2-host ELASTIC fabric
+    (``min_hosts=2``, ``max_hosts=3``) over a two-bucket workload
+    (pool sizes cycling 30,30,100,100 — two pow2 dispatch buckets), h0
+    SIGKILLed at its first admission; the autoscaler must respawn a
+    replacement (fresh id, spawn/join journaled) and every user must
+    finish bit-identical to unfaulted sequential baselines — parity
+    asserted EVERY rep of BOTH arms.  The arms differ only in
+    ``FabricConfig.placement``: ``bucket`` co-locates same-bucket users
+    so each host's stacked dispatches stay full; ``load`` is the PR 5
+    least-loaded rule, which mixes buckets per host and halves dispatch
+    occupancy.  Workers write per-host schema-v2 metrics
+    (``CETPU_FABRIC_METRICS``); the metric graded is the mean over
+    hosts of each host's dispatch occupancy, plus the fleet planner's
+    merged edges asserted IDENTICAL on every host that adopted them.
+    Interleaved best-of reps (2-vCPU drift protocol)."""
+    import os
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.fabric_workload import (
+        make_cfg,
+        read_results,
+        sequential_baselines,
+        sizes_arg,
+        user_specs,
+    )
+
+    from consensus_entropy_tpu.obs import export
+    from consensus_entropy_tpu.serve import (
+        AdmissionJournal,
+        FabricConfig,
+        FabricCoordinator,
+        validate_journal_file,
+    )
+    from consensus_entropy_tpu.serve.hosts import fabric_paths
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "tests", "fabric_worker.py")
+    n_users, hosts = args_ns.users, args_ns.hosts
+    epochs = args_ns.al_epochs
+    cfg = make_cfg("mc", epochs=epochs)
+    specs = user_specs(n_users, sizes=[30, 30, 100, 100])
+
+    _log(f"elastic workload: {n_users} users x {epochs} AL iterations "
+         f"(pool sizes 30/100 — two dispatch buckets), {hosts} worker "
+         f"hosts (min {hosts} / max {hosts + 1}), h0 SIGKILLed at its "
+         f"first admission, autoscaler respawn required; arms: "
+         f"bucket-aware vs least-loaded placement")
+
+    target_live = max(2, n_users // hosts)
+
+    def run_arm(ws, placement):
+        # each arm gets its OWN workspace root: shared workspaces would
+        # hand the second arm already-finished users (no dispatches, no
+        # placement to measure)
+        arm_ws = _mkdir(ws, f"ws_{placement}")
+        fabric_dir = _mkdir(ws, f"fabric_{placement}")
+        jp = os.path.join(fabric_dir, "serve_journal.jsonl")
+        journal = AdmissionJournal(jp)
+
+        def spawn(host_id):
+            log = open(fabric_paths(fabric_dir, host_id)["log"], "ab")
+            try:
+                return subprocess.Popen(
+                    [sys.executable, worker, fabric_dir, host_id, arm_ws,
+                     cfg.mode, str(cfg.epochs), str(n_users), "5.0",
+                     str(target_live), sizes_arg(specs)],
+                    stdout=log, stderr=subprocess.STDOUT,
+                    env={**os.environ, "PYTHONPATH": repo,
+                         "CETPU_FABRIC_METRICS": "1"})
+            finally:
+                log.close()
+
+        chaos_state = {"killed": False}
+
+        def chaos(coord):
+            if chaos_state["killed"]:
+                return
+            st = coord.journal.state
+            if any(h == "h0" and st.last.get(u) == "admit"
+                   for u, h in st.assigned.items()):
+                coord.hosts["h0"].proc.kill()
+                chaos_state["killed"] = True
+
+        coord = FabricCoordinator(
+            journal, fabric_dir,
+            FabricConfig(hosts=hosts, min_hosts=hosts,
+                         max_hosts=hosts + 1, placement=placement,
+                         planner_epoch=4),
+            on_poll=chaos)
+        t0 = time.perf_counter()
+        summary = coord.run([u for _, u, _ in specs], spawn,
+                            pools={u: n for _, u, n in specs})
+        wall = time.perf_counter() - t0
+        journal.close()
+
+        assert validate_journal_file(jp) == [], \
+            f"journal schema violations in the {placement} arm"
+        # per-host STACKED-DISPATCH occupancy: how full each host's
+        # stacked dispatches ran against its slot capacity
+        # (mean_device_batch / target_live, meaned over surviving
+        # hosts).  The summary's in-bucket `occupancy` can't see
+        # placement — it grades against same-bucket active slots only;
+        # a host whose slots hold users of DIFFERENT buckets dispatches
+        # thin stacks at in-bucket occupancy 1.0.
+        merged = export.merged_summary(fabric_dir)
+        widths = [s["mean_device_batch"] / target_live
+                  for s in merged["per_host"].values()
+                  if s.get("mean_device_batch") is not None]
+        occupancy = round(sum(widths) / len(widths), 3) if widths \
+            else None
+        # the fleet planner's broadcast edges must END identical on
+        # every surviving host (the cross-host alignment acceptance:
+        # the LAST fleet-adopted record per host — earlier epochs may
+        # legitimately differ as the merged sketch grew)
+        host_edges = set()
+        for h, state in summary["hosts"].items():
+            if state == "revoked":
+                continue
+            last = None
+            for rec in export.read_jsonl_tolerant(
+                    os.path.join(fabric_dir, f"events_{h}.jsonl")):
+                if rec.get("event") == "planner" and rec.get("fleet"):
+                    last = tuple(rec.get("edges") or ())
+            if last is not None:
+                host_edges.add(last)
+        assert len(host_edges) <= 1, \
+            f"fleet edges diverged across hosts: {host_edges}"
+        return {"summary": summary, "wall_s": wall,
+                "occupancy": occupancy,
+                "fleet_edges": sorted(host_edges),
+                "chaos": chaos_state["killed"], "fabric_dir": fabric_dir}
+
+    root = tempfile.mkdtemp(prefix="elastic_bench_")
+    best = {"bucket": None, "load": None}
+    seq_s = float("inf")
+    try:
+        for rep in range(args_ns.reps):
+            ws = _mkdir(root, f"rep{rep}")
+            t0 = time.perf_counter()
+            seq = sequential_baselines(ws, cfg, specs)
+            seq_s = min(seq_s, time.perf_counter() - t0)
+            for placement in ("bucket", "load"):
+                arm = run_arm(ws, placement)
+                summary = arm["summary"]
+                results = read_results(arm["fabric_dir"])
+                parity = (sorted(summary["finished"])
+                          == sorted(u for _, u, _ in specs)
+                          and all(results[u]["error"] is None
+                                  and results[u]["result"]["trajectory"]
+                                  == seq[u]["trajectory"]
+                                  for _, u, _ in specs))
+                ups = len(summary["finished"]) / arm["wall_s"]
+                _log(f"[rep {rep}] {placement:>6}: "
+                     f"{len(summary['finished'])}/{n_users} users in "
+                     f"{arm['wall_s']:.1f}s ({ups:.3f} u/s, "
+                     f"occupancy={arm['occupancy']}, parity={parity}, "
+                     f"spawns={summary['spawns']}, "
+                     f"joins={summary['joins']}, "
+                     f"migrations={summary['migrations']})")
+                if not (parity and arm["chaos"]
+                        and summary["revocations"] >= 1
+                        and summary["spawns"] >= 1):
+                    raise AssertionError(
+                        f"elastic {placement} rep {rep} lost parity or "
+                        f"never exercised kill+respawn: {summary}")
+                rec = {"users_per_sec": ups,
+                       "wall_s": round(arm["wall_s"], 3),
+                       "occupancy": arm["occupancy"],
+                       "fleet_edges": arm["fleet_edges"],
+                       **{k: summary[k] for k in
+                          ("revocations", "spawns", "joins",
+                           "migrations")}}
+                prev = best[placement]
+                if prev is None or ups > prev["users_per_sec"]:
+                    best[placement] = rec
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    b, l = best["bucket"], best["load"]
+    occ_ratio = (round(b["occupancy"] / l["occupancy"], 2)
+                 if b["occupancy"] and l["occupancy"] else None)
+    print(json.dumps({
+        "metric": f"elastic_recovered_users_per_sec_{n_users}u_{hosts}h",
+        "value": round(b["users_per_sec"], 4),
+        "unit": "users/s",
+        "vs_baseline": round(b["users_per_sec"] / l["users_per_sec"], 2),
+        "mean_host_occupancy_bucket": b["occupancy"],
+        "mean_host_occupancy_least_loaded": l["occupancy"],
+        "occupancy_ratio_bucket_vs_least_loaded": occ_ratio,
+        "sequential_unfaulted_users_per_sec":
+            round(n_users / seq_s, 4),
+        "spawns": b["spawns"], "joins": b["joins"],
+        "migrations": b["migrations"],
+        "fleet_edges": b["fleet_edges"],
+        "parity_with_sequential": True,
+        **_provenance(),
+    }))
+    return 0
+
+
 def _mkdir(root, name):
     import os
 
@@ -2371,7 +2579,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--suite", choices=("linear", "cnn", "retrain", "fleet",
                                         "serve", "serve-fused", "slo",
-                                        "serve-faults", "fabric",
+                                        "serve-faults", "fabric", "elastic",
                                         "qbdc", "cnn-fleet", "obs"),
                     default="linear",
                     help="linear: the north-star fused pool scoring; cnn: "
@@ -2398,7 +2606,14 @@ def main(argv=None) -> int:
                          "backoff re-admission, circuit breaker); "
                          "fabric: recovered-users/sec of a multi-host "
                          "fabric with one worker SIGKILLed mid-run "
-                         "(journal failover + compaction); qbdc: "
+                         "(journal failover + compaction); "
+                         "elastic: the elastic control plane — a worker "
+                         "SIGKILLed mid-run with the autoscaler "
+                         "respawning a replacement, bucket-aware vs "
+                         "least-loaded placement raced on per-host "
+                         "stacked-dispatch occupancy, merged planner "
+                         "edges asserted identical across hosts, parity "
+                         "asserted every rep of both arms; qbdc: "
                          "dropout-committee scoring (K-sweep) + users/sec "
                          "+ per-user memory vs the stored-committee mc "
                          "path; cnn-fleet: users/sec + mean_device_batch "
@@ -2490,6 +2705,10 @@ def main(argv=None) -> int:
     if args_ns.suite == "fabric":
         # multi-host: --users over --hosts workers, h0 killed mid-run
         return run_fabric_suite(args_ns)
+    if args_ns.suite == "elastic":
+        # elastic control plane: kill + autoscaler respawn, placement
+        # arms raced on per-host dispatch occupancy
+        return run_elastic_suite(args_ns)
     if args_ns.suite == "qbdc":
         # dropout committee vs stored committee; --pool is songs per user,
         # --members the stored-committee size (default 20, the paper's)

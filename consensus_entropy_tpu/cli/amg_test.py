@@ -199,6 +199,30 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fabric: worker heartbeat lease — a host whose "
                         "last heartbeat is older than this is declared "
                         "dead and failed over (default 5)")
+    p.add_argument("--min-hosts", type=int, default=None, metavar="N",
+                   help="elastic fabric: turn the autoscaler ON and "
+                        "never let the fleet shrink below N live "
+                        "workers — a dead/SIGKILLed worker is respawned "
+                        "(fresh host id, lease re-granted, spawn/join "
+                        "journaled so a coordinator restart replays the "
+                        "same fleet shape) and queued users rebalance "
+                        "onto joiners (default: off — the PR 5 "
+                        "survive-but-never-replace fabric)")
+    p.add_argument("--max-hosts", type=int, default=None, metavar="N",
+                   help="elastic fabric: scale-up ceiling — queue-depth "
+                        "(backlog per live host) and SLO-headroom "
+                        "(predicted queue-drain time) signals grow the "
+                        "fleet up to N workers, one journaled spawn at "
+                        "a time (default: --hosts when --min-hosts is "
+                        "given)")
+    p.add_argument("--placement", choices=("bucket", "load"),
+                   default="bucket",
+                   help="fabric: cross-host routing policy — 'bucket' "
+                        "co-locates users of the same pool-width "
+                        "dispatch bucket (within a load-skew bound) so "
+                        "stacked dispatches stay full per host; 'load' "
+                        "is pure least-loaded (the pre-elastic arm "
+                        "bench.py --suite elastic races against)")
     p.add_argument("--unpoison", default=None, metavar="USER[,USER...]",
                    help="operator command: remove users from the "
                         "persisted poison list (users/serve_poison.jsonl) "
@@ -343,7 +367,9 @@ def main(argv=None) -> int:
                          ("--journal-compact-kb",
                           args.journal_compact_kb != 0),
                          ("--hosts", args.hosts is not None),
-                         ("--lease-s", args.lease_s != 5.0)):
+                         ("--lease-s", args.lease_s != 5.0),
+                         ("--min-hosts", args.min_hosts is not None),
+                         ("--max-hosts", args.max_hosts is not None)):
         if is_set and args.serve is None:
             print(f"{flag} requires --serve")
             return 1
@@ -373,6 +399,27 @@ def main(argv=None) -> int:
             print("--hosts requires the admission journal (it is the "
                   "fabric's source of truth); drop --no-serve-journal")
             return 1
+        # elastic knobs validate through FabricConfig construction (the
+        # validate_bucket_widths precedent): a typo'd geometry fails
+        # HERE with the reason, not as a wedged fabric minutes in
+        from consensus_entropy_tpu.serve import FabricConfig
+
+        try:
+            args._fabric_config = FabricConfig(
+                hosts=args.hosts, lease_s=args.lease_s,
+                min_hosts=args.min_hosts, max_hosts=args.max_hosts,
+                placement=args.placement,
+                # the fleet planner must not fight explicit operator
+                # edges or a disabled local planner
+                fleet_planner=(not args.no_slo_planner
+                               and args.bucket_widths is None))
+        except ValueError as e:
+            print(f"invalid fabric config: {e}")
+            return 1
+    elif args.min_hosts is not None or args.max_hosts is not None:
+        print("--min-hosts/--max-hosts require --hosts (the elastic "
+              "fabric scales a multi-host fleet)")
+        return 1
     if args.fabric_worker is not None and (args.fabric_dir is None
                                            or args.serve is None):
         print("--fabric-worker is internal (spawned by --hosts) and "
@@ -876,7 +923,7 @@ def _run_unpoison(args) -> int:
     return rc
 
 
-def _run_users_fabric(args, cfg, paths, users, guard) -> None:
+def _run_users_fabric(args, cfg, paths, users, pool, anno, guard) -> None:
     """Fabric coordinator: shard the user axis across ``--hosts`` worker
     processes (each re-execing this CLI with ``--fabric-worker``),
     coordinated through the admission journal — see ``serve.fabric``.
@@ -904,11 +951,24 @@ def _run_users_fabric(args, cfg, paths, users, guard) -> None:
     report = FleetReport(os.path.join(paths.users_dir,
                                       "fleet_metrics.jsonl"))
 
-    # the worker argv is this run's argv minus the coordinator-only flag
-    worker_argv = list(args._raw_argv)
-    if "--hosts" in worker_argv:
-        i = worker_argv.index("--hosts")
-        del worker_argv[i:i + 2]
+    # the worker argv is this run's argv minus the coordinator-only
+    # flags, in both the "--flag value" and "--flag=value" spellings —
+    # a surviving --min-hosts would trip the worker's own
+    # requires---hosts validation and kill every spawn at startup
+    worker_argv = []
+    skip_next = False
+    coordinator_flags = ("--hosts", "--min-hosts", "--max-hosts",
+                         "--placement")
+    for arg in args._raw_argv:
+        if skip_next:
+            skip_next = False
+            continue
+        if arg in coordinator_flags:
+            skip_next = True
+            continue
+        if any(arg.startswith(f + "=") for f in coordinator_flags):
+            continue
+        worker_argv.append(arg)
 
     # workers must import this package regardless of their cwd
     pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -935,14 +995,23 @@ def _run_users_fabric(args, cfg, paths, users, guard) -> None:
                            os.path.join(paths.users_dir, "spans.jsonl"),
                            host="coordinator")
     coord = FabricCoordinator(
-        journal, fabric_dir,
-        FabricConfig(hosts=args.hosts, lease_s=args.lease_s),
+        journal, fabric_dir, args._fabric_config,
         poison=poison, report=report, preemption=guard, tracer=tracer)
     interactive = _interactive_set(args)
+    # enqueue-time pool sizes (songs in the feature pool the user
+    # annotated) — journaled on enqueue, so bucket-aware placement
+    # co-locates same-bucket users as a pure function of journal state
+    pool_songs = set(pool.song_ids)
+    pool_sizes = {}
+    for u in users[: args.max_users]:
+        mine = anno[anno.user_id == u]
+        pool_sizes[str(u)] = sum(1 for s in set(mine.song_id)
+                                 if s in pool_songs)
     try:
         summary = coord.run(
             [str(u) for u in users[: args.max_users]], spawn,
-            classes={u: "interactive" for u in interactive})
+            classes={u: "interactive" for u in interactive},
+            pools=pool_sizes)
     finally:
         tracer.close()
         journal.close()
@@ -954,6 +1023,8 @@ def _run_users_fabric(args, cfg, paths, users, guard) -> None:
          "poisoned": len(summary["poisoned"]),
          "revocations": summary["revocations"],
          "reassignments": summary["reassignments"],
+         "spawns": summary["spawns"], "joins": summary["joins"],
+         "migrations": summary["migrations"],
          "compactions": summary["compactions"]}, sort_keys=True))
     bad = summary["failed"] + summary["poisoned"]
     if bad:
@@ -1065,7 +1136,7 @@ def _run_users(args, cfg, paths, users, pool, anno, hc_table, store,
                                  hc_table, store, cnn_cfg, guard)
         return
     if args.hosts is not None:
-        _run_users_fabric(args, cfg, paths, users, guard)
+        _run_users_fabric(args, cfg, paths, users, pool, anno, guard)
         return
     if args.serve is not None:
         _run_users_serve(args, cfg, paths, users, pool, anno, hc_table,

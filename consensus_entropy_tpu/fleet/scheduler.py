@@ -271,6 +271,9 @@ class FleetScheduler:
         self._live: dict = {}
         self._score_wait: list = []   # (state, ScoreStep)
         self._host_wait: dict = {}    # Future -> (state, HostStep)
+        #: Future -> submit wall time (telemetry for the hold policy's
+        #: host-step duration EMA; abandoned futures just drop theirs)
+        self._host_t0: dict = {}
         #: futures of watchdog-abandoned host steps: their zombie threads
         #: run to completion against discarded session objects; we keep
         #: the handles so close() knows not to block on a truly-hung one
@@ -468,6 +471,10 @@ class FleetScheduler:
                         return fn()
             fut = self._host_pool.submit(fn)
             self._host_wait[fut] = (state, step)
+            # submit→completion wall (the obs host_step span's interval):
+            # the hold policy's telemetry seam — an EMA of these sizes
+            # dispatch holds instead of the flat max_hold_s cap
+            self._host_t0[fut] = time.monotonic()  # cetpu: noqa[replay-wallclock] hold-sizing telemetry; holds change when work batches, never results
             if self.watchdog is not None:
                 self.watchdog.arm(state, step.label or "host")
 
@@ -478,8 +485,12 @@ class FleetScheduler:
             return 0
         done, _ = wait(list(self._host_wait), timeout=timeout,
                        return_when=FIRST_COMPLETED)
+        note = getattr(self.hold, "note_host_step", None)
         for fut in done:
             state, _step = self._host_wait.pop(fut)
+            t0 = self._host_t0.pop(fut, None)
+            if note is not None and t0 is not None:
+                note(time.monotonic() - t0)  # cetpu: noqa[replay-wallclock] hold-sizing telemetry; holds change when work batches, never results
             if self.watchdog is not None:
                 self.watchdog.disarm(state)
             err = fut.exception()
@@ -509,6 +520,7 @@ class FleetScheduler:
             if state not in expired or fut.done():
                 continue  # done-but-unreaped futures drain normally
             del self._host_wait[fut]
+            self._host_t0.pop(fut, None)
             self._abandoned.append(fut)
             label, elapsed = expired[state]
             exc = self.watchdog.trip(state, label, elapsed)

@@ -63,6 +63,16 @@ EVENT_FIELDS = {
     "user_finished": ("user",),
     "user_poisoned": ("user",),
     "user_failed_final": ("user",),
+    # elastic control plane (serve.elastic / serve.placement)
+    "host_spawn": ("host",),
+    "host_join": ("host",),
+    "host_adopt": ("host",),
+    "host_adopt_refused": ("host",),
+    "migrate_request": ("user", "host"),
+    "migrate": ("user", "host"),
+    "migrate_refused": ("user",),
+    "withdraw": ("user",),
+    "fleet_edges": ("edges",),
     # stream-closing summaries (no t_s)
     "fleet_summary": (),
     "fabric_summary": (),
@@ -251,17 +261,26 @@ def planner_timeline(users_dir: str) -> dict:
     out: dict[str, dict] = {}
     for path in find_metrics_files(users_dir):
         host = _host_of_metrics_path(path)
-        edges, holds = [], 0
+        edges, fleet_edges, holds = [], [], 0
         for rec in read_jsonl_tolerant(path):
             ev = rec.get("event")
             if ev == "planner_edges":
                 edges.append({"t_s": rec.get("t_s"),
                               "edges": rec.get("edges"),
                               "observations": rec.get("observations")})
+            elif ev == "fleet_edges":
+                # coordinator-broadcast fabric-level edges (the elastic
+                # fleet planner) — rendered alongside the local epochs
+                fleet_edges.append({"t_s": rec.get("t_s"),
+                                    "edges": rec.get("edges"),
+                                    "observations":
+                                        rec.get("observations")})
             elif ev == "admission_hold":
                 holds += 1
-        if edges or holds:
+        if edges or fleet_edges or holds:
             out[host] = {"edges": edges, "admission_holds": holds}
+            if fleet_edges:
+                out[host]["fleet_edges"] = fleet_edges
     return out
 
 

@@ -39,6 +39,14 @@ import time
 SCHEMA_VERSION = 2
 
 
+def ema(prev: float | None, x: float, alpha: float = 0.3) -> float:
+    """One exponential-moving-average step, ``None``-seeded: the shared
+    smoothing kernel behind the serving stack's telemetry predictors
+    (the planner's inter-arrival and host-step EMAs, the fabric's
+    finish-interval EMA) — one alpha, one spelling."""
+    return x if prev is None else alpha * x + (1.0 - alpha) * prev
+
+
 class StepTimer:
     """Accumulates named phase durations; one JSONL record per flush.
 
@@ -304,6 +312,19 @@ class QuantileSketch(Histogram):
                 "buckets": {str(i): c for i, c in self._buckets.items()},
                 "samples": (list(self._samples)
                             if self._samples is not None else None)}
+
+    @classmethod
+    def merge_all(cls, sketches) -> "QuantileSketch":
+        """Fold an iterable of sketch DICTS (the journaled wire form —
+        per-host planner records) into one fresh sketch.  Associativity
+        makes the fold order irrelevant; the fabric coordinator's fleet
+        planner feeds this sorted by host id so the chain is canonical
+        anyway."""
+        out = None
+        for d in sketches:
+            sk = cls.from_dict(d)
+            out = sk if out is None else out.merge(sk)
+        return out if out is not None else cls()
 
     @classmethod
     def from_dict(cls, d: dict) -> "QuantileSketch":

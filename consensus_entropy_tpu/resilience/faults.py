@@ -67,6 +67,9 @@ FAULT_POINTS = frozenset({
     "fabric.compact",        # journal compaction (checkpoint + truncate
                              # stages — a kill between the two renames
                              # must replay idempotently)
+    "fabric.spawn",          # elastic autoscaler, pre-spawn-journal (a
+                             # kill here leaves no spawn record: the
+                             # restart re-decides from the same state)
     # acquisition-subsystem boundaries (the acquire registry's fault
     # domain): the qbdc dropout-mask sampler — mask keys fold from the AL
     # iteration seed, so a kill here must resume bit-identically (same

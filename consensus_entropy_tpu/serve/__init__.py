@@ -72,6 +72,11 @@ from consensus_entropy_tpu.serve.planner import (
     derive_edges,
     dispatch_hold,
 )
+from consensus_entropy_tpu.serve.elastic import (
+    FleetPlanner,
+    next_host_id,
+    target_hosts,
+)
 from consensus_entropy_tpu.serve.fabric import (
     FabricConfig,
     FabricCoordinator,
@@ -84,6 +89,14 @@ from consensus_entropy_tpu.serve.journal import (
     JsonlTail,
     PoisonList,
     SingleWriterViolation,
+    validate_journal_file,
+)
+from consensus_entropy_tpu.serve.placement import (
+    PLACEMENT_POLICIES,
+    bucket_for,
+    place,
+    place_user,
+    plan_rebalance,
 )
 from consensus_entropy_tpu.serve.server import (
     AdmissionQueue,
@@ -97,8 +110,11 @@ from consensus_entropy_tpu.serve.watchdog import Watchdog, WatchdogTimeout
 __all__ = ["AdmissionJournal", "AdmissionPlanner", "AdmissionQueue",
            "BucketRouter", "DEFAULT_CLASS", "DispatchBreaker",
            "FabricConfig", "FabricCoordinator", "FabricError",
-           "FleetServer", "HostLease", "JournalState", "JsonlTail",
-           "PRIORITY_CLASSES", "PoisonList", "QueueClosed", "QueueFull",
-           "ServeConfig", "SingleWriterViolation", "Watchdog",
-           "WatchdogTimeout", "admission_hold", "derive_edges",
-           "dispatch_hold", "run_worker", "validate_bucket_widths"]
+           "FleetPlanner", "FleetServer", "HostLease", "JournalState",
+           "JsonlTail", "PLACEMENT_POLICIES", "PRIORITY_CLASSES",
+           "PoisonList", "QueueClosed", "QueueFull", "ServeConfig",
+           "SingleWriterViolation", "Watchdog", "WatchdogTimeout",
+           "admission_hold", "bucket_for", "derive_edges",
+           "dispatch_hold", "next_host_id", "place", "place_user",
+           "plan_rebalance", "run_worker", "target_hosts",
+           "validate_bucket_widths", "validate_journal_file"]

@@ -38,17 +38,48 @@ skipped, in-flight users re-admitted first, queued users re-enqueued in
 order.  Journal growth is bounded by compaction
 (:meth:`~consensus_entropy_tpu.serve.journal.AdmissionJournal.compact`),
 which the single-writer discipline makes safe to run mid-fabric.
+
+The ELASTIC control plane (``FabricConfig.min_hosts``/``max_hosts``;
+:mod:`serve.elastic` + :mod:`serve.placement`) closes the PR 5 gaps on
+top of that base:
+
+- the AUTOSCALER replaces dead capacity and scales up on queue-depth /
+  SLO-headroom signals, journaling every decision (``spawn`` records +
+  the ``fabric.spawn`` fault point) so a restart replays the identical
+  fleet shape;
+- a fresh or operator-added host JOINs through the lease directory
+  (``join`` journaled on its first heartbeat) and queued — never
+  in-flight — users REBALANCE onto it via a drop-ack protocol over the
+  existing assignment feeds (the source worker's journaled ack commits
+  each move, so admission always wins the race and no user ever runs on
+  two hosts);
+- admitted users route by BUCKET-AWARE placement (pool-width bucket,
+  then load), a pure function of journaled state, so same-bucket users
+  co-locate and stacked dispatches stay full per host;
+- the FLEET PLANNER merges every worker's journaled quantile sketch
+  (associative ``QuantileSketch.merge``) and broadcasts one derived
+  edge set over the assignment feeds, keeping cross-host routing
+  aligned with cross-host placement.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import os
+import re
 import signal
 import time
 
 from consensus_entropy_tpu.fleet.report import FleetReport
+from consensus_entropy_tpu.obs.metrics import ema as metrics_ema
 from consensus_entropy_tpu.resilience import faults
+from consensus_entropy_tpu.serve import placement as placement_mod
+from consensus_entropy_tpu.serve.elastic import (
+    FleetPlanner,
+    PidProc,
+    next_host_id,
+    target_hosts,
+)
 from consensus_entropy_tpu.serve.hosts import (
     fabric_paths,
     lease_age_s,
@@ -59,6 +90,7 @@ from consensus_entropy_tpu.serve.journal import (
     PoisonList,
     _AppendFsyncFile,
 )
+from consensus_entropy_tpu.serve.placement import PLACEMENT_POLICIES
 
 
 class FabricError(RuntimeError):
@@ -79,13 +111,48 @@ class FabricConfig:
     FIRST heartbeat (process start + jax import) before it is presumed
     stillborn.  ``drain_timeout_s``: how long the graceful close waits
     for idle workers to exit before SIGKILLing them (their work is done
-    and durable by then — the kill is cosmetic)."""
+    and durable by then — the kill is cosmetic).
+
+    ELASTIC control-plane knobs (``serve.elastic``; setting
+    ``min_hosts``/``max_hosts`` turns the autoscaler + JOIN/rebalance +
+    fleet planner ON — unset, the fabric behaves exactly like PR 5):
+    ``min_hosts``/``max_hosts``: the autoscaler's fleet-size clamp —
+    dead capacity below the floor is respawned, scale-up stops at the
+    ceiling.  ``scale_backlog``: queued users per live host past which
+    the queue-depth signal scales up; ``scale_slo_s``: predicted
+    queue-drain seconds (observed finish EMA × backlog) past which the
+    SLO-headroom signal scales up (0 disables).  ``placement``: the
+    cross-host routing arm — ``bucket`` co-locates same-dispatch-bucket
+    users so stacked dispatches stay full per host, ``load`` keeps the
+    PR 5 least-loaded rule (the bench baseline).  ``planner_epoch`` /
+    ``planner_buckets``: the fabric-level planner's derivation cadence
+    over the MERGED per-host quantile sketches (``fleet_planner=False``
+    keeps per-host edges independent — also forced off when workers run
+    explicit ``--bucket-widths``).
+
+    All validated at CONSTRUCTION (the PR 11 ``validate_bucket_widths``
+    precedent): a typo'd geometry fails here with the reason, not as a
+    wedged fabric minutes in."""
 
     hosts: int = 2
     lease_s: float = 5.0
     poll_s: float = 0.05
     spawn_grace_s: float = 120.0
     drain_timeout_s: float = 60.0
+    min_hosts: int | None = None
+    max_hosts: int | None = None
+    scale_backlog: int = 8
+    scale_slo_s: float = 0.0
+    placement: str = "bucket"
+    fleet_planner: bool = True
+    planner_epoch: int = 8
+    planner_buckets: int = 4
+
+    @property
+    def elastic(self) -> bool:
+        """True when the elastic control plane (autoscaler, JOIN +
+        rebalance, operator adoption) is active."""
+        return self.min_hosts is not None or self.max_hosts is not None
 
     def __post_init__(self):
         if self.hosts < 1:
@@ -94,6 +161,38 @@ class FabricConfig:
             raise ValueError(f"lease_s must be > 0, got {self.lease_s}")
         if self.poll_s <= 0:
             raise ValueError(f"poll_s must be > 0, got {self.poll_s}")
+        if self.elastic:
+            # one bound given defaults the other to the initial size, so
+            # `--min-hosts 2` alone means "never shrink below 2"
+            if self.min_hosts is None:
+                self.min_hosts = min(self.hosts, self.max_hosts)
+            if self.max_hosts is None:
+                self.max_hosts = max(self.hosts, self.min_hosts)
+            if self.min_hosts < 1:
+                raise ValueError(f"min_hosts must be >= 1, "
+                                 f"got {self.min_hosts}")
+            if self.min_hosts > self.max_hosts:
+                raise ValueError(
+                    f"min_hosts must be <= max_hosts, got "
+                    f"{self.min_hosts} > {self.max_hosts}")
+            if not self.min_hosts <= self.hosts <= self.max_hosts:
+                raise ValueError(
+                    f"hosts={self.hosts} must sit inside "
+                    f"[min_hosts={self.min_hosts}, "
+                    f"max_hosts={self.max_hosts}]")
+            if self.scale_backlog < 1:
+                raise ValueError(f"scale_backlog must be >= 1, "
+                                 f"got {self.scale_backlog}")
+            if self.scale_slo_s < 0:
+                raise ValueError(f"scale_slo_s must be >= 0, "
+                                 f"got {self.scale_slo_s}")
+        if self.placement not in PLACEMENT_POLICIES:
+            raise ValueError(f"placement must be one of "
+                             f"{PLACEMENT_POLICIES}, got {self.placement!r}")
+        if self.planner_epoch < 1 or self.planner_buckets < 1:
+            raise ValueError("planner_epoch and planner_buckets must be "
+                             f">= 1, got {self.planner_epoch} / "
+                             f"{self.planner_buckets}")
 
 
 @dataclasses.dataclass(eq=False)
@@ -108,6 +207,9 @@ class HostHandle:
     spawned_t: float
     alive: bool = True
     closed: bool = False  # close sentinel sent (clean rc=0 expected)
+    #: first heartbeat observed — the elastic JOIN trigger (journaled
+    #: once, then queued users rebalance onto the joiner)
+    joined: bool = False
     #: tail of the worker's ``spans_<h>.jsonl`` (None when the
     #: coordinator runs untraced)
     span_tail: JsonlTail | None = None
@@ -161,17 +263,46 @@ class FabricCoordinator:
         self.hosts: dict[str, HostHandle] = {}
         self.reassignments = 0
         self.revocations = 0
+        self.spawns = 0
+        self.joins = 0
+        self.migrations = 0
         self._unresolved: set[str] = set()
         self._failed: set[str] = set()
         self._submitted: list[str] = []
+        #: the spawn callable ``run`` was given (the autoscaler respawns
+        #: through it mid-loop)
+        self._spawn_fn = None
+        #: in-progress rebalance migrations awaiting the source host's
+        #: drop-ack: uid → target host id.  Decisions derive from
+        #: journaled state only; the ack makes the hand-off race-free (a
+        #: user the worker admitted first refuses the drop and stays)
+        self._migrating: dict[str, str] = {}
+        #: consecutive spawned hosts that died before their FIRST
+        #: heartbeat — the autoscaler's crash-loop guard (any join
+        #: resets it)
+        self._stillborn = 0
+        #: observed per-user finish-interval EMA (wall clock — the
+        #: SLO-headroom scale-up signal's drain predictor; telemetry
+        #: only, nothing journaled reads it)
+        self._finish_ema: float | None = None
+        self._last_finish_t: float | None = None
+        #: the fabric-level planner (merged per-host sketches → one
+        #: broadcast edge set); None unless the elastic plane is on
+        self.fleet_planner: FleetPlanner | None = None
+        if config.elastic and config.fleet_planner:
+            self.fleet_planner = FleetPlanner(
+                journal, epoch=config.planner_epoch,
+                n_buckets=config.planner_buckets, report=self.report)
 
     # -- lifecycle ---------------------------------------------------------
 
-    def run(self, user_ids, spawn, *, classes: dict | None = None) -> dict:
-        """Serve ``user_ids`` across ``config.hosts`` workers; returns a
-        summary dict.  ``spawn(host_id) -> Popen``-like launches one
-        worker process (the CLI re-execs itself with ``--fabric-worker``;
-        tests launch a synthetic-workload script).
+    def run(self, user_ids, spawn, *, classes: dict | None = None,
+            pools: dict | None = None) -> dict:
+        """Serve ``user_ids`` across the worker fleet; returns a summary
+        dict.  ``spawn(host_id) -> Popen``-like launches one worker
+        process (the CLI re-execs itself with ``--fabric-worker``; tests
+        launch a synthetic-workload script) — the elastic autoscaler
+        respawns replacements and scale-ups through the same callable.
 
         ``classes``: optional ``{user_id: priority_class}`` — carried on
         the journal's ``enqueue`` records and every assignment-feed line,
@@ -180,11 +311,19 @@ class FabricCoordinator:
         journal's record wins for users it has already seen (restart /
         failover keeps first-submit classes).
 
+        ``pools``: optional ``{user_id: enqueue-time pool size}`` —
+        journaled on the ``enqueue`` records (exactly as the single-host
+        server journals them), which is what makes BUCKET-AWARE
+        placement a pure function of journal state: same-bucket users
+        co-locate so stacked dispatches stay full per host.  Without
+        pools, placement degrades to least-loaded.
+
         Any escaping ``BaseException`` (injected coordinator kill,
         Ctrl-C) SIGKILLs every worker first — mirroring the orphan-exit
         the workers would perform themselves on a real coordinator death
         — and leaves all recovery state durable in the journal."""
         os.makedirs(self.fabric_dir, exist_ok=True)
+        self._spawn_fn = spawn
         st = self.journal.state
         if st.last:
             self.report.event(
@@ -193,6 +332,7 @@ class FabricCoordinator:
                 poisoned=len(st.poisoned))
         pending: list[str] = []
         classes = {str(u): c for u, c in (classes or {}).items()}
+        pools = {str(u): int(p) for u, p in (pools or {}).items()}
         for u in st.recovery_order([str(u) for u in user_ids]):
             if u in st.finished:
                 self.report.event("skip_done", user=u)
@@ -201,16 +341,21 @@ class FabricCoordinator:
                 self.report.event("skip_poisoned", user=u)
                 continue
             if st.last.get(u) in (None, "unpoison"):
+                fields = {}
                 cls = st.classes.get(u) or classes.get(u)
-                self.journal.append(
-                    "enqueue", u, **({"cls": cls} if cls else {}))
+                if cls:
+                    fields["cls"] = cls
+                pool = st.pools.get(u) or pools.get(u)
+                if pool:
+                    fields["pool"] = int(pool)
+                self.journal.append("enqueue", u, **fields)
             pending.append(u)
         self._submitted = list(pending)
         self._unresolved = set(pending)
         try:
             if pending:  # nothing unresolved → no workers to spawn
-                for i in range(self.config.hosts):
-                    self._spawn_host(f"h{i}", spawn)
+                for host_id in self._initial_fleet():
+                    self._spawn_host(host_id, spawn)
                 # (re)route every unresolved user: prior-run assignments
                 # are void (their processes were reaped above), and
                 # recovery_order already put in-flight users ahead of the
@@ -228,7 +373,14 @@ class FabricCoordinator:
                 self._check_hosts()
                 if not self._unresolved:
                     break
+                if self.config.elastic:
+                    self._adopt_operator_hosts()
+                    self._autoscale()
+                    self._broadcast_edges()
                 if not any(h.alive for h in self.hosts.values()):
+                    # the elastic autoscaler above respawns dead capacity
+                    # up to min_hosts; reaching here means it is off (or
+                    # spawning itself failed and raised)
                     raise FabricError(
                         f"every worker host is down with "
                         f"{len(self._unresolved)} user(s) unresolved — "
@@ -243,12 +395,42 @@ class FabricCoordinator:
             raise
         return self._summary()
 
+    def _initial_fleet(self) -> list:
+        """The host ids this run stands up.  Elastic restarts replay the
+        journaled fleet SHAPE — every host whose last membership record
+        is not a revoke, clamped to ``max_hosts`` — so a coordinator
+        SIGKILL + rerun rebuilds the exact fleet the autoscaler had
+        grown (the replay-determinism contract).  Fresh runs (and the
+        non-elastic fabric, always) spawn ``h0..h{hosts-1}``."""
+        if self.config.elastic:
+            shape = self.journal.state.fleet_hosts()
+            if shape:
+                # numeric order (h2 before h10), so the max_hosts clamp
+                # keeps the lowest-numbered ids — the ones next_host_id
+                # will never hand out again
+                def _num(hid):
+                    m = re.match(r"^h(\d+)$", hid)
+                    return (0, int(m.group(1))) if m else (1, 0)
+
+                return sorted(shape, key=lambda h: (_num(h), h)) \
+                    [: self.config.max_hosts]
+        return [f"h{i}" for i in range(self.config.hosts)]
+
     # -- host management ---------------------------------------------------
 
     def _spawn_host(self, host_id: str, spawn) -> HostHandle:
         paths = fabric_paths(self.fabric_dir, host_id)
         self._reap_stale(host_id, paths)
         proc = spawn(host_id)
+        h = self._register_host(host_id, proc, paths)
+        self.report.event("host_up", host=host_id,
+                          pid=getattr(proc, "pid", None))
+        return h
+
+    def _register_host(self, host_id: str, proc, paths: dict) -> HostHandle:
+        """The shared handle wiring for spawned AND adopted hosts: event
+        tail resumed at the journaled cursor, lease membership journaled,
+        assign channel opened."""
         tail = JsonlTail(paths["events"])
         tail.seek(self.journal.state.host_cursor.get(host_id, 0))
         self.journal.append("lease", host=host_id,
@@ -258,8 +440,6 @@ class FabricCoordinator:
         if self.tracer is not None and self.tracer.enabled:
             h.span_tail = JsonlTail(paths["spans"])
         self.hosts[host_id] = h
-        self.report.event("host_up", host=host_id,
-                          pid=getattr(proc, "pid", None))
         return h
 
     def _pid_is_fabric_worker(self, pid: int) -> bool:
@@ -321,6 +501,167 @@ class FabricCoordinator:
             elif age > self.config.lease_s:
                 self._fail_over(h, f"lease expired ({age:.1f}s since "
                                    "last heartbeat)")
+            elif not h.joined:
+                self._join(h)
+
+    def _join(self, h: HostHandle) -> None:
+        """First heartbeat observed: the host is UP.  Under the elastic
+        plane the JOIN is journaled (the replayable fleet shape), the
+        fleet planner's current edges are pushed so the joiner routes
+        like everyone else, and queued users REBALANCE onto it — the
+        capacity a fresh host brings must actually absorb load, not sit
+        idle behind assignments made before it existed."""
+        h.joined = True
+        self._stillborn = 0  # spawning demonstrably works again
+        if not self.config.elastic:
+            return  # PR 5 semantics byte-for-byte: membership is lease-only
+        self.joins += 1
+        self.journal.append("join", host=h.host_id)
+        self.report.event("host_join", host=h.host_id)
+        if self.fleet_planner is not None and self.fleet_planner.edges:
+            h.assign.append({"edges": list(self.fleet_planner.edges)})
+        self._rebalance(h)
+
+    def _rebalance(self, new: HostHandle) -> None:
+        """Migrate queued (never in-flight) users onto a joined host.
+
+        The PLAN is a pure function of journaled state
+        (``placement.plan_rebalance``); the hand-off is two-phase: the
+        source worker gets a ``drop`` line on its assignment feed, and
+        only its journaled ACK (the user was still queued there) commits
+        the move — a user the worker admitted in the meantime refuses
+        the drop and stays, so no user can ever run on two hosts.  A
+        coordinator kill mid-rebalance is safe at every point: un-acked
+        users keep their journaled assignment, acked-and-reassigned
+        users carry the new one, and the restart re-derives placement
+        from the journal alone."""
+        st = self.journal.state
+        queued_by_host: dict[str, list] = {}
+        for u in st.queued:
+            if u not in self._unresolved or u in self._migrating:
+                continue
+            src = st.assigned.get(u)
+            if src is None or src == new.host_id:
+                continue
+            sh = self.hosts.get(src)
+            if sh is None or not sh.alive:
+                continue
+            queued_by_host.setdefault(src, []).append(u)
+        loads = {hh.host_id: self._load_of(hh.host_id)
+                 for hh in self.hosts.values() if hh.alive}
+        moves = placement_mod.plan_rebalance(
+            new.host_id, loads=loads, queued_by_host=queued_by_host)
+        for u, src in moves:
+            self._migrating[u] = new.host_id
+            self.hosts[src].assign.append({"drop": u})
+            self.report.event("migrate_request", user=u,
+                              host=new.host_id)
+
+    def _autoscale(self) -> None:
+        """One autoscaler decision round: respawn dead capacity below
+        ``min_hosts`` and scale up on the queue-depth / SLO-headroom
+        signals, one journaled ``spawn`` per new host so a restarted
+        coordinator replays the identical fleet shape."""
+        cfg = self.config
+        if self._spawn_fn is None:
+            return
+        if self._stillborn >= 3:
+            # crash-loop guard: respawning cannot out-run a worker that
+            # dies before its first heartbeat every time (bad argv,
+            # missing dep, OOM at import) — without this the elastic
+            # fabric would fork-storm at poll rate forever where the
+            # non-elastic fabric raises FabricError.  All state is
+            # durable: fix the worker and rerun the coordinator.
+            raise FabricError(
+                f"{self._stillborn} consecutive worker(s) died before "
+                "their first heartbeat — the spawn path looks broken; "
+                "rerun the coordinator to recover from the journal")
+        live = sum(1 for h in self.hosts.values() if h.alive)
+        queued = sum(1 for u in self.journal.state.queued
+                     if u in self._unresolved)
+        target = target_hosts(
+            live=live, queued=queued, min_hosts=cfg.min_hosts,
+            max_hosts=cfg.max_hosts, scale_backlog=cfg.scale_backlog,
+            scale_slo_s=cfg.scale_slo_s, finish_ema_s=self._finish_ema)
+        while live < target:
+            hid = next_host_id(set(self.hosts)
+                               | set(self.journal.state.hosts))
+            reason = "replace" if live < cfg.min_hosts else "scale_up"
+            # a kill here models dying between the scale decision and
+            # its journal record: nothing was spawned, the restart
+            # re-decides from the same journaled state
+            faults.fire("fabric.spawn", host=hid, reason=reason)
+            self.journal.append("spawn", host=hid, reason=reason)
+            self.spawns += 1
+            self._spawn_host(hid, self._spawn_fn)
+            self.report.event("host_spawn", host=hid, reason=reason)
+            live += 1
+
+    def _adopt_operator_hosts(self) -> None:
+        """Operator-added workers announce through the lease directory:
+        a fresh ``lease_<id>.json`` for an id the coordinator never
+        spawned is a JOIN request.  Adoption journals ``spawn`` (reason
+        ``operator``) + ``lease`` and supervises the volunteer through a
+        pid-only handle — same failover, same rebalance, same close
+        semantics as a spawned worker.  Stale lease files (dead pid or
+        expired beat) are ignored, and the ``max_hosts`` ceiling holds."""
+        try:
+            names = os.listdir(self.fabric_dir)
+        except OSError:
+            return
+        for name in sorted(names):
+            if not (name.startswith("lease_") and name.endswith(".json")):
+                continue
+            hid = name[len("lease_"):-len(".json")]
+            if not hid or hid in self.hosts:
+                continue
+            paths = fabric_paths(self.fabric_dir, hid)
+            lease = read_lease(paths["lease"])
+            pid = lease.get("pid") if lease else None
+            age = lease_age_s(paths["lease"], self._clock())
+            if not isinstance(pid, int) or pid == os.getpid() \
+                    or age is None or age > self.config.lease_s:
+                continue  # dead run's artifact, not a live volunteer
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                continue  # lease is fresh but the process already died
+            except PermissionError:
+                # another uid's process: we could never SIGKILL it, so
+                # failover could never guarantee it stopped — refuse
+                # the adoption rather than break the one-host-per-user
+                # invariant later
+                self.report.event("host_adopt_refused", host=hid,
+                                  pid=pid)
+                continue
+            if sum(1 for h in self.hosts.values() if h.alive) \
+                    >= self.config.max_hosts:
+                return  # at the ceiling: leave volunteers unadopted
+            self.journal.append("spawn", host=hid, reason="operator")
+            self.spawns += 1
+            self._register_host(hid, PidProc(pid, clock=self._clock),
+                                paths)
+            self.report.event("host_adopt", host=hid, pid=pid)
+            # the fresh lease means it already heartbeats: JOIN (and
+            # rebalance onto it) on the next _check_hosts pass; one
+            # adoption per poll keeps each join's rebalance settled
+            # before the next
+            return
+
+    def _broadcast_edges(self) -> None:
+        """One fleet-planner round: fold any newly-transcribed per-host
+        sketches, and when an epoch derives CHANGED edges (journaled
+        first — the decision is durable before anyone acts on it), push
+        them over every live assignment feed so cross-host routing stays
+        aligned with cross-host placement."""
+        if self.fleet_planner is None:
+            return
+        new = self.fleet_planner.poll()
+        if new is None:
+            return
+        for h in self.hosts.values():
+            if h.alive:
+                h.assign.append({"edges": list(new)})
 
     def _fail_over(self, h: HostHandle, reason: str) -> None:
         """Revoke one host and re-route its unresolved users.  The kill
@@ -338,11 +679,26 @@ class FabricCoordinator:
         self._transcribe_spans(h)
         self.journal.append("revoke", host=h.host_id, reason=reason)
         self.revocations += 1
+        if not h.joined:
+            # died before its first heartbeat: a stillborn spawn.  The
+            # autoscaler refuses to keep fork-storming a systematically
+            # broken worker (see _autoscale); any successful join resets
+            self._stillborn += 1
+        else:
+            self._stillborn = 0
+        # migrations whose TARGET just died stay pending on purpose: the
+        # source may have already withdrawn the user (its ack is in
+        # flight), so the ack handler must still see the entry and
+        # re-place the user — dropping it here would strand a withdrawn
+        # user in no queue at all.  Migrations whose SOURCE died are the
+        # victims below: popped, because this reassignment supersedes
+        # any stale ack.
         victims = [u for u in self.journal.state.assigned_to(h.host_id)
                    if u in self._unresolved]
         self.report.event("host_down", host=h.host_id, reason=reason,
                           reassigned=len(victims))
         for u in victims:
+            self._migrating.pop(u, None)
             self._assign(u)
             self.reassignments += 1
 
@@ -422,11 +778,34 @@ class FabricCoordinator:
         return sum(1 for u in self._unresolved
                    if assigned.get(u) == host_id)
 
+    def _fleet_edges(self) -> tuple:
+        """The bucket geometry placement co-locates by: the fleet
+        planner's broadcast edges when it runs, else the last journaled
+        planner edges (a restarted non-planner run keeps routing the
+        same), else empty — ``placement.bucket_for`` then falls through
+        to the power-of-two geometry every worker's default router
+        shares."""
+        if self.fleet_planner is not None and self.fleet_planner.edges:
+            return self.fleet_planner.edges
+        st_edges = self.journal.state.planner_edges
+        return tuple(st_edges) if st_edges else ()
+
     def _assign(self, user: str) -> None:
         live = [h for h in self.hosts.values() if h.alive]
         if not live:
             return  # the run loop raises FabricError on its next pass
-        h = min(live, key=lambda h: (self._load_of(h.host_id), h.host_id))
+        # bucket-aware placement, a pure function of journaled state
+        # (assignments, pool sizes, fleet edges): same-bucket users
+        # co-locate so stacked dispatches stay full per host; with no
+        # journaled pools it IS the PR 5 least-loaded rule
+        host_id = placement_mod.place_user(
+            user, state=self.journal.state, unresolved=self._unresolved,
+            hosts=[h.host_id for h in live], edges=self._fleet_edges(),
+            policy=self.config.placement)
+        self._assign_to(user, host_id)
+
+    def _assign_to(self, user: str, host_id: str) -> None:
+        h = self.hosts[host_id]
         # a kill here models the coordinator dying between choosing a
         # route and journaling it: the user's last record stays
         # enqueue/fail, so the restarted coordinator re-routes it
@@ -454,6 +833,8 @@ class FabricCoordinator:
                 self.journal.append("finish", u, host=h.host_id,
                                     src_off=off)
                 self._unresolved.discard(u)
+                self._migrating.pop(u, None)
+                self._note_finish()
                 self.report.event("user_finished", user=u, host=h.host_id)
             elif ev == "poison":
                 self.journal.append("poison", u, host=h.host_id,
@@ -480,9 +861,52 @@ class FabricCoordinator:
                     self.report.event("user_failed_final", user=u,
                                       host=h.host_id,
                                       error=rec.get("error"))
+            elif ev == "drop":
+                # the rebalance ack: the source worker either withdrew
+                # the still-queued user (ok → the move commits: journal
+                # the ack for the cursor, then re-assign) or had already
+                # admitted it (refused → it runs where it is).  Only a
+                # migration pending THIS run may act: a stale ack
+                # re-read after a coordinator restart (the cursor may
+                # predate it) just advances the cursor — the restart
+                # already re-routed every pending user from the journal
+                self.journal.append("drop", u, host=h.host_id,
+                                    src_off=off, ok=bool(rec.get("ok")))
+                target = self._migrating.pop(u, None)
+                if target is None:
+                    continue
+                if rec.get("ok") and u in self._unresolved:
+                    th = self.hosts.get(target)
+                    if th is not None and th.alive:
+                        self._assign_to(u, target)
+                    else:
+                        self._assign(u)  # target died mid-move: re-place
+                    self.migrations += 1
+                    self.report.event("migrate", user=u, host=target)
+                elif not rec.get("ok"):
+                    self.report.event("migrate_refused", user=u)
+            elif ev == "planner":
+                # the worker's SLO-planner epoch: its sketch state is
+                # the fleet planner's per-host telemetry feed (bytes
+                # covered by the next cursor-carrying record — re-noting
+                # a sketch after a restart is idempotent)
+                if self.fleet_planner is not None:
+                    self.fleet_planner.note_host_sketch(
+                        h.host_id, rec.get("sketch"))
             # worker-local enqueue/requeue records are flow bookkeeping,
             # not dispositions the fabric needs — skipped (their bytes
             # are covered by the next transcribed record's cursor)
+
+    def _note_finish(self) -> None:
+        """Fold one observed user completion into the finish-interval
+        EMA — the SLO-headroom scale-up signal's drain predictor (wall
+        clock through the injected seam; telemetry only, nothing
+        journaled reads it)."""
+        now = self._clock()
+        if self._last_finish_t is not None:
+            self._finish_ema = metrics_ema(
+                self._finish_ema, max(now - self._last_finish_t, 0.0))
+        self._last_finish_t = now
 
     def _transcribe_spans(self, h: HostHandle) -> None:
         """Fold the host's span WAL into the coordinator's tracer sink.
@@ -506,10 +930,15 @@ class FabricCoordinator:
             "poisoned": sorted(u for u in sub if u in st.poisoned),
             "revocations": self.revocations,
             "reassignments": self.reassignments,
+            "spawns": self.spawns,
+            "joins": self.joins,
+            "migrations": self.migrations,
             "compactions": self.journal.compactions,
             "hosts": {hid: ("revoked" if not h.alive else "closed")
                       for hid, h in self.hosts.items()},
         }
+        if self.fleet_planner is not None:
+            summary["fleet_planner"] = self.fleet_planner.summary()
         self.report.event(
             "fabric_summary", users=summary["users"],
             finished=len(summary["finished"]),
@@ -517,5 +946,7 @@ class FabricCoordinator:
             poisoned=len(summary["poisoned"]),
             revocations=self.revocations,
             reassignments=self.reassignments,
+            spawns=self.spawns, joins=self.joins,
+            migrations=self.migrations,
             compactions=summary["compactions"])
         return summary

@@ -187,12 +187,28 @@ def run_worker(fabric_dir: str, host_id: str, *, build_entry, scheduler,
     def intake():
         """Tail the assignment feed into the server's admission queue;
         runs as the 'threaded producer' the server's keep_open mode is
-        built for."""
+        built for.  Beyond user routings the feed carries the elastic
+        control plane's lines: ``{"edges": [...]}`` (fleet-planner
+        bucket edges — adopt for future admissions) and
+        ``{"drop": uid}`` (rebalance withdrawal — journal an ACK saying
+        whether the user was still queued here; the coordinator only
+        moves it on a positive ack, so admission always wins the race)."""
         while not stop.is_set():
             for rec, _off in feed.poll():
                 if rec.get("close"):
                     server.close_intake()
                     return
+                if isinstance(rec.get("edges"), list):
+                    try:
+                        server.apply_fleet_edges(rec["edges"])
+                    except (TypeError, ValueError):
+                        pass  # malformed broadcast: keep local routing
+                    continue
+                if rec.get("drop") is not None:
+                    uid = str(rec["drop"])
+                    ok = server.withdraw(uid)
+                    journal.append("drop", uid, ok=ok)
+                    continue
                 uid = rec.get("user")
                 if uid is None:
                     continue

@@ -67,11 +67,19 @@ import time
 
 from consensus_entropy_tpu.resilience import faults
 
-#: admission transitions a journal line may carry (user-scoped)
+#: admission transitions a journal line may carry (user-scoped).
+#: ``assign`` and ``drop`` are fabric ROUTING records: they move a user
+#: between hosts (or acknowledge a rebalance withdrawal) without touching
+#: its admission disposition.
 EVENTS = ("enqueue", "admit", "finish", "fail", "poison", "unpoison",
-          "assign")
-#: host-membership records (fabric): no user field
-HOST_EVENTS = ("lease", "revoke")
+          "assign", "drop")
+#: host-membership records (fabric): no user field.  ``spawn`` journals
+#: the elastic control plane's decision to add a host (autoscaler respawn
+#: / scale-up / operator adoption), ``lease`` its process coming up,
+#: ``join`` its first observed heartbeat (the rebalance trigger),
+#: ``revoke`` its death — a coordinator restart replays the same fleet
+#: shape from these records alone.
+HOST_EVENTS = ("lease", "revoke", "spawn", "join")
 #: SLO-planner epoch records (no user field): ``edges`` (the derived
 #: bucket edges in force) + ``sketch`` (the quantile-sketch state), so a
 #: restarted server re-derives IDENTICAL routing from replay alone
@@ -107,6 +115,10 @@ class JournalState:
         #: planner re-observes)
         self.classes: dict[str, str] = {}
         self.widths: dict[str, int] = {}
+        #: each user's enqueue-time pool size (from ``enqueue`` records
+        #: carrying ``pool``) — the bucket-aware placement policy's input,
+        #: so a restarted coordinator places from replay alone
+        self.pools: dict[str, int] = {}
         self.planner_edges: list | None = None
         self.planner_sketch: dict | None = None
         self.pool_obs: list[int] = []
@@ -159,6 +171,11 @@ class JournalState:
             if isinstance(host, str):
                 self.assigned[user] = host
             return
+        if event == "drop":
+            # rebalance bookkeeping (a worker acknowledged withdrawing a
+            # still-queued user): disposition unchanged — the user stays
+            # enqueued at fabric level and the follow-up assign re-routes
+            return
         self.last[user] = event
         if event == "enqueue":
             self._enqueue_seq[user] = self._seq
@@ -166,6 +183,7 @@ class JournalState:
                 self.classes[user] = rec["cls"]
             if isinstance(rec.get("pool"), int):
                 self.pool_obs.append(rec["pool"])
+                self.pools[user] = rec["pool"]
         elif event == "admit":
             self.admits[user] = self.admits.get(user, 0) + 1
             self._admit_seq.setdefault(user, self._seq)
@@ -206,7 +224,19 @@ class JournalState:
         return self.in_flight + self.queued
 
     def live_hosts(self) -> list:
-        return sorted(h for h, e in self.hosts.items() if e == "lease")
+        """Hosts whose last membership record says they are up (a lease
+        grant, or the elastic JOIN that follows the first heartbeat)."""
+        return sorted(h for h, e in self.hosts.items()
+                      if e in ("lease", "join"))
+
+    def fleet_hosts(self) -> list:
+        """The replayed fleet SHAPE: every host whose last membership
+        record is not a revoke — including ``spawn`` records whose
+        process never published a lease (the restart must still stand
+        that capacity up).  A restarted elastic coordinator respawns
+        exactly these ids, so the fleet shape is a pure function of the
+        journal."""
+        return sorted(h for h, e in self.hosts.items() if e != "revoke")
 
     def assigned_to(self, host: str) -> list:
         """This host's unresolved users, in-flight first (first-admit
@@ -243,6 +273,7 @@ class JournalState:
                 "assigned": dict(self.assigned), "hosts": dict(self.hosts),
                 "host_cursor": dict(self.host_cursor),
                 "classes": dict(self.classes), "widths": dict(self.widths),
+                "pools": dict(self.pools),
                 "planner_edges": self.planner_edges,
                 "planner_sketch": self.planner_sketch,
                 "pool_obs": list(self.pool_obs),
@@ -262,6 +293,7 @@ class JournalState:
                           for k, v in d.get("host_cursor", {}).items()}
         st.classes = dict(d.get("classes", {}))
         st.widths = {k: int(v) for k, v in d.get("widths", {}).items()}
+        st.pools = {k: int(v) for k, v in d.get("pools", {}).items()}
         edges = d.get("planner_edges")
         st.planner_edges = [int(e) for e in edges] \
             if isinstance(edges, list) else None
@@ -313,6 +345,53 @@ def _replay(path: str) -> JournalState:
                 continue
             state.apply(rec)
     return state
+
+
+def validate_journal_file(path: str) -> list[str]:
+    """Structural validation of a journal/event WAL (the
+    ``scripts/elastic_check.sh`` gate); returns human-readable error
+    strings (empty = valid).  Every line but a torn TAIL must parse to a
+    dict naming a known event with its required user/host/edges field,
+    and ``seq`` numbers must be non-decreasing (compaction replays dedupe
+    at-or-below the checkpoint seq, so equal neighbours are legal in a
+    post-crash tail, but a regression means interleaved writers)."""
+    errors: list[str] = []
+    if not os.path.exists(path):
+        return [f"{path}: missing"]
+    with open(path, "rb") as f:
+        raws = f.readlines()
+    last_seq = None
+    for i, raw in enumerate(raws, 1):
+        try:
+            rec = json.loads(raw.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            if i == len(raws):
+                continue  # torn tail: the expected crash artifact
+            errors.append(f"{path}:{i}: unparseable non-tail line")
+            continue
+        if not isinstance(rec, dict):
+            errors.append(f"{path}:{i}: non-dict record")
+            continue
+        ev = rec.get("event")
+        if ev in HOST_EVENTS:
+            if not isinstance(rec.get("host"), str):
+                errors.append(f"{path}:{i}: {ev!r} lacks host")
+        elif ev in PLANNER_EVENTS:
+            if not isinstance(rec.get("edges"), list):
+                errors.append(f"{path}:{i}: {ev!r} lacks edges")
+        elif ev in EVENTS:
+            if not isinstance(rec.get("user"), str):
+                errors.append(f"{path}:{i}: {ev!r} lacks user")
+        else:
+            errors.append(f"{path}:{i}: unknown event {ev!r}")
+            continue
+        seq = rec.get("seq")
+        if isinstance(seq, int):
+            if last_seq is not None and seq < last_seq:
+                errors.append(f"{path}:{i}: seq regressed "
+                              f"{last_seq} -> {seq}")
+            last_seq = seq
+    return errors
 
 
 try:
@@ -471,6 +550,12 @@ class AdmissionJournal:
     """
 
     def __init__(self, path: str | None, *, compact_bytes: int | None = None):
+        if compact_bytes is not None and compact_bytes <= 0:
+            # construction-time validation (the PR 11 validate_bucket_widths
+            # precedent): a zero/negative bound would compact on EVERY
+            # append — pass None to disable compaction instead
+            raise ValueError(f"compact_bytes must be > 0 (or None to "
+                             f"disable compaction), got {compact_bytes}")
         self.path = path
         self.compact_bytes = compact_bytes
         self.state = _replay(path) if path else JournalState()

@@ -1,0 +1,140 @@
+"""Bucket-aware cross-host placement for the elastic serve fabric.
+
+The PR 5 coordinator routed every user least-loaded: correct for
+failover, blind to the pool-width buckets the serve layer dispatches by.
+Two same-bucket users split across hosts each run a HALF-full stacked
+dispatch; co-located they run ONE full dispatch — the fleet-level
+committee-amortization the stacked device path (PR 3/7) was built for.
+This module is that routing policy, as PURE FUNCTIONS of replayed
+journal state:
+
+- :func:`bucket_for` maps a user's journaled enqueue-time pool size onto
+  its dispatch bucket (the fabric-level planner's merged edges when they
+  exist, the router's power-of-two geometry otherwise — the same width
+  the worker's own ``BucketRouter`` will pin at admission, so placement
+  and routing agree on what "same bucket" means).
+- :func:`place` picks the host for one admitted user: among hosts within
+  ``max_skew`` of the least load, the one with the most unresolved
+  same-bucket users (co-location), then least-loaded, then host id.
+  With no pool/bucket information it degrades EXACTLY to the PR 5
+  least-loaded rule — the ``load`` policy arm, and the baseline
+  ``bench.py --suite elastic`` races against.
+- :func:`plan_rebalance` plans the queued-user migrations a host JOIN
+  triggers: move late-enqueued queued users off the most-loaded hosts
+  until the joiner reaches the fleet's floor share.  In-flight users are
+  NEVER planned (their workspaces are mid-run on their current host).
+
+Every input is journal-replayable (assignments, pools, dispositions), so
+a restarted coordinator re-derives identical decisions — pinned by
+``tests/test_elastic.py``.
+"""
+
+from __future__ import annotations
+
+from consensus_entropy_tpu.serve.buckets import next_pow2
+
+#: routing policy arms: ``bucket`` co-locates same-bucket users (this
+#: module's reason to exist), ``load`` is the PR 5 least-loaded baseline
+PLACEMENT_POLICIES = ("bucket", "load")
+
+#: how far above the least-loaded host a host may be and still win on
+#: co-location — bounds the load imbalance bucket-affinity can create
+DEFAULT_MAX_SKEW = 4
+
+
+def bucket_for(pool_size, edges=()) -> int | None:
+    """The dispatch-bucket width a pool of this size pads to: the
+    smallest edge that fits, else the power-of-two fall-through — the
+    ``BucketRouter.width_for`` rule, reproduced here so the coordinator
+    agrees with every worker's router without holding one.  ``None``
+    pool (never journaled) → ``None`` (placement then ignores buckets).
+    """
+    if pool_size is None:
+        return None
+    n = int(pool_size)
+    for w in edges or ():
+        if int(w) >= n:
+            return int(w)
+    return next_pow2(n)
+
+
+def placement_view(state, unresolved, hosts, edges=()) -> tuple:
+    """``(loads, buckets_by_host)`` over the live ``hosts``, from
+    replayed journal state: ``loads[h]`` counts the host's unresolved
+    assigned users, ``buckets_by_host[h][bucket]`` how many of them sit
+    in each dispatch bucket (users with no journaled pool don't count
+    toward any bucket)."""
+    loads = {h: 0 for h in hosts}
+    buckets: dict[str, dict] = {h: {} for h in hosts}
+    for u in unresolved:
+        h = state.assigned.get(u)
+        if h not in loads:
+            continue
+        loads[h] += 1
+        b = bucket_for(state.pools.get(u), edges)
+        if b is not None:
+            buckets[h][b] = buckets[h].get(b, 0) + 1
+    return loads, buckets
+
+
+def place(bucket, *, loads, buckets_by_host, policy: str = "bucket",
+          max_skew: int = DEFAULT_MAX_SKEW) -> str:
+    """The host one user routes to.  Deterministic: ties break on load
+    then host id, and every input is journal-replayable."""
+    if policy not in PLACEMENT_POLICIES:
+        raise ValueError(f"unknown placement policy {policy!r} "
+                         f"(choose from {PLACEMENT_POLICIES})")
+    if not loads:
+        raise ValueError("no live hosts to place on")
+    if policy == "load" or bucket is None:
+        return min(loads, key=lambda h: (loads[h], h))
+    floor = min(loads.values())
+    eligible = [h for h in loads if loads[h] <= floor + max_skew]
+    return min(eligible,
+               key=lambda h: (-buckets_by_host.get(h, {}).get(bucket, 0),
+                              loads[h], h))
+
+
+def place_user(user, *, state, unresolved, hosts, edges=(),
+               policy: str = "bucket",
+               max_skew: int = DEFAULT_MAX_SKEW) -> str:
+    """:func:`place` driven straight from replayed journal state — the
+    coordinator's assignment seam."""
+    loads, buckets = placement_view(state, unresolved, hosts, edges)
+    return place(bucket_for(state.pools.get(str(user)), edges),
+                 loads=loads, buckets_by_host=buckets, policy=policy,
+                 max_skew=max_skew)
+
+
+def plan_rebalance(new_host, *, loads, queued_by_host) -> list:
+    """Migrations a JOIN triggers: ``[(user, source_host), ...]``.
+
+    ``loads``: unresolved-user count per live host (the joiner included,
+    typically 0).  ``queued_by_host``: each OTHER host's still-queued
+    (never in-flight) unresolved users in journal enqueue order — the
+    only users safe to move, because nothing of theirs has run yet.
+
+    Greedy and deterministic: while the joiner sits below the fleet's
+    floor share (``total // n_hosts``), take the LAST-enqueued queued
+    user from the most-loaded donor still above the floor (ties on host
+    id).  Late-enqueued users move because the earliest-enqueued keep
+    their position at the head of their current host's queue — migration
+    must never reorder who runs first."""
+    loads = {h: int(n) for h, n in loads.items()}
+    if new_host not in loads:
+        loads[new_host] = 0
+    floor = sum(loads.values()) // max(len(loads), 1)
+    queues = {h: list(q) for h, q in queued_by_host.items()
+              if h != new_host}
+    moves: list = []
+    while loads[new_host] < floor:
+        donors = [h for h, q in queues.items()
+                  if q and loads.get(h, 0) > floor]
+        if not donors:
+            break
+        donor = max(donors, key=lambda h: (loads[h], h))
+        user = queues[donor].pop()
+        moves.append((user, donor))
+        loads[donor] -= 1
+        loads[new_host] += 1
+    return moves

@@ -48,7 +48,7 @@ import math
 import threading
 import time
 
-from consensus_entropy_tpu.obs.metrics import QuantileSketch
+from consensus_entropy_tpu.obs.metrics import QuantileSketch, ema
 from consensus_entropy_tpu.serve.buckets import PAD_MULTIPLE
 from consensus_entropy_tpu.utils import round_up as _round_up
 
@@ -107,21 +107,36 @@ def admission_hold(*, free: int, queued: int, gap_s: float | None,
 
 
 def dispatch_hold(*, waiting: int, host_in_flight: int,
-                  headroom_s: float, max_hold_s: float) -> float:
+                  headroom_s: float, max_hold_s: float,
+                  step_ema_s: float | None = None) -> float:
     """Seconds to hold a partially-formed stacked dispatch.
 
     A session can only join the waiting batch by finishing an
     outstanding host step, so the predictor is structural: with
     ``host_in_flight == 0`` nothing more can join (hold buys nothing →
     0); with host work outstanding, holding raises expected occupancy —
-    hold up to the SLO ``headroom_s`` of the most-constrained live user,
-    clamped by the operator cap.  Applies identically to reduction
-    ScoreSteps and mid-run CNN ``DeviceStep`` cohorts (both wait in the
-    scheduler's score-wait list).  Pure, like :func:`admission_hold`."""
+    hold up to the SLO ``headroom_s`` of the most-constrained live user.
+
+    ``step_ema_s`` — the observed host-step duration EMA (the same
+    durations the obs ``host_step`` spans time; the scheduler feeds them
+    back through :meth:`AdmissionPlanner.note_host_step`) — SIZES the
+    hold once known: the joiners arrive when their host steps finish, so
+    the predicted useful hold IS the expected step duration, not the
+    flat operator cap.  A fleet whose host steps take 40 ms stops
+    burning ``max_hold_s`` per hold; one whose steps take 3 s holds long
+    enough to actually catch them (still inside SLO headroom).  Before
+    any telemetry exists, ``max_hold_s`` remains the structural cap.
+    Applies identically to reduction ScoreSteps and mid-run CNN
+    ``DeviceStep`` cohorts (both wait in the scheduler's score-wait
+    list).  Pure, like :func:`admission_hold`."""
     if waiting <= 0 or host_in_flight <= 0:
         return 0.0
-    if headroom_s <= 0:
+    if headroom_s <= 0 or max_hold_s <= 0:
+        # max_hold_s=0 stays the operator's OFF switch even once
+        # telemetry exists (the pre-EMA semantics)
         return 0.0
+    if step_ema_s is not None:
+        return min(max(step_ema_s, 0.0), headroom_s)
     return min(headroom_s, max_hold_s)
 
 
@@ -162,6 +177,15 @@ class AdmissionPlanner:
         self._holding = False
         self._gap_ema: float | None = None
         self._last_enq_t: float | None = None
+        #: host-step duration EMA (the scheduler feeds completed-step
+        #: walls back through :meth:`note_host_step`): sizes dispatch
+        #: holds from telemetry instead of the flat ``max_hold_s`` cap
+        self._step_ema: float | None = None
+        #: True once the fabric coordinator broadcast fleet-level edges:
+        #: the local sketch keeps journaling (it IS the coordinator's
+        #: telemetry feed) but local epochs stop deriving — the fleet
+        #: owns the routing geometry
+        self.fleet_edges = False
         #: live (admitted, unfinished) users: uid -> (class, admit_t)
         self._live: dict[str, tuple] = {}
         #: enqueue observations arrive from producer threads
@@ -232,9 +256,8 @@ class AdmissionPlanner:
                 journal_entry()
             if t is not None:
                 if self._last_enq_t is not None:
-                    gap = max(t - self._last_enq_t, 0.0)
-                    self._gap_ema = gap if self._gap_ema is None \
-                        else 0.3 * gap + 0.7 * self._gap_ema
+                    self._gap_ema = ema(self._gap_ema,
+                                        max(t - self._last_enq_t, 0.0))
                 self._last_enq_t = t
             if pool_size is None:
                 return
@@ -264,6 +287,37 @@ class AdmissionPlanner:
         if self.journal is not None and not self._restoring:
             self.journal.append("planner", edges=list(self.edges),
                                 sketch=self.sketch.to_dict())
+
+    def note_host_step(self, dur_s: float) -> None:
+        """One completed host step's wall duration (submit → completion,
+        the same interval the obs ``host_step`` span times): folds into
+        the EMA that SIZES dispatch holds — telemetry-predicted holds
+        instead of the flat ``max_hold_s`` cap (the planner follow-on
+        (d) seam; the scheduler calls this from its drain loop)."""
+        with self._lock:
+            self._step_ema = ema(self._step_ema,
+                                 max(float(dur_s), 0.0))
+
+    def set_fleet_edges(self, edges) -> None:
+        """Adopt coordinator-broadcast fleet-level bucket edges: the
+        router updates in place (future admissions route by them; pinned
+        pads stay pinned) and local epoch derivation STOPS overriding —
+        cross-host routing must stay aligned with cross-host placement.
+        The local sketch keeps journaling per epoch (it is the
+        coordinator's per-host telemetry feed), and one planner record
+        is appended now so this worker's WAL pins the edges in force."""
+        with self._lock:
+            new = tuple(int(e) for e in edges)
+            self.fleet_edges = True
+            self.adapt_edges = False
+            if new and new != self.edges:
+                self.edges = new
+                self.edge_updates += 1
+                self.router.update(new)
+            if self.journal is not None and not self._restoring:
+                self.journal.append("planner", edges=list(self.edges),
+                                    sketch=self.sketch.to_dict(),
+                                    fleet=True)
 
     def note_admit(self, user, cls: str, waited_s: float = 0.0) -> None:
         """The user took a slot; ``waited_s`` is the queue wait it
@@ -311,7 +365,8 @@ class AdmissionPlanner:
         hold = dispatch_hold(waiting=waiting,
                              host_in_flight=host_in_flight,
                              headroom_s=self.headroom_s(),
-                             max_hold_s=self.max_hold_s)
+                             max_hold_s=self.max_hold_s,
+                             step_ema_s=self._step_ema)
         if hold > 0 and not self._holding:
             self.dispatch_hold_rounds += 1
         self._holding = hold > 0
@@ -322,11 +377,16 @@ class AdmissionPlanner:
     def summary(self) -> dict:
         """The ``planner`` section of the fleet summary (and bench
         lines): current edges, derivation and hold activity."""
-        return {
+        out = {
             "edges": list(self.edges) if self.edges else None,
             "edge_updates": self.edge_updates,
             "observations": self.sketch.n,
             "admission_hold_rounds": self.admission_hold_rounds,
             "dispatch_hold_rounds": self.dispatch_hold_rounds,
             "slo_s": dict(sorted(self.slo.items())),
+            "host_step_ema_s": (round(self._step_ema, 4)
+                                if self._step_ema is not None else None),
         }
+        if self.fleet_edges:
+            out["fleet_edges"] = True
+        return out

@@ -140,9 +140,14 @@ class ServeConfig:
     headroom every adaptive hold is bounded by.  ``aging_s``: queue-wait
     past which a lower-priority user jumps strict-priority pop (the
     starvation guard; 0 = pure strict priority).  ``max_hold_s``: cap on
-    any single adaptive hold (explicit ``admit_window_s`` /
-    ``batch_window_s`` remain honored as FLOORS — the planner can only
-    hold longer, and only inside SLO headroom).
+    any single adaptive ADMISSION hold, the cap on DISPATCH holds until
+    host-step telemetry exists, and the off switch for both at 0.  Once
+    the observed host-step duration EMA is known, dispatch holds are
+    SIZED by it instead of capped here (telemetry-predicted holds —
+    ``serve.planner.dispatch_hold``) and only SLO headroom bounds them.
+    Explicit ``admit_window_s`` / ``batch_window_s`` remain honored as
+    FLOORS — the planner can only hold longer, and only inside SLO
+    headroom.
     """
 
     target_live: int = 4
@@ -164,6 +169,13 @@ class ServeConfig:
     slo_batch_s: float = 600.0
     aging_s: float = 30.0
     max_hold_s: float = 1.0
+    #: engine slots RESERVED for the ``batch`` class (clamped to
+    #: ``target_live - 1``; 0 disables): aging orders the QUEUE, but an
+    #: interactive surge could still monopolize every SLOT for
+    #: ``aging_s`` — the reserve bounds the batch tail directly, because
+    #: the last reserved slot only ever admits a batch waiter (ROADMAP
+    #: planner follow-on (b))
+    batch_reserve: int = 1
 
     def __post_init__(self):
         if self.target_live < 1:
@@ -204,6 +216,9 @@ class ServeConfig:
         if self.max_hold_s < 0:
             raise ValueError(f"max_hold_s must be >= 0, "
                              f"got {self.max_hold_s}")
+        if self.batch_reserve < 0:
+            raise ValueError(f"batch_reserve must be >= 0, "
+                             f"got {self.batch_reserve}")
 
 
 class AdmissionQueue:
@@ -219,15 +234,27 @@ class AdmissionQueue:
     guard: a lower-class head that has waited past ``aging_s`` jumps the
     order (oldest aged head first), so strict priority cannot starve the
     batch tier behind a steady interactive stream.  ``aging_s=0``
-    disables aging (pure strict priority)."""
+    disables aging (pure strict priority).
+
+    ``reserve`` (``{class: min_slots}``): per-class ENGINE-SLOT shares —
+    when the caller passes its live class composition and free-slot
+    count to :meth:`pop`, a class with waiters whose reserved share is
+    unmet claims the last free slots ahead of strict priority, so a
+    higher-priority surge can occupy at most
+    ``target_live - sum(reserves)`` slots while reserved classes wait
+    (the aging guard bounds queue ORDER; the reserve bounds SLOT
+    occupancy — starvation bound: a batch waiter admits within one slot
+    turnover instead of ``aging_s``)."""
 
     def __init__(self, maxsize: int, *, classes=PRIORITY_CLASSES,
-                 aging_s: float = 0.0):
+                 aging_s: float = 0.0, reserve: dict | None = None):
         self.maxsize = maxsize
         self.classes = tuple(classes)
         if not self.classes:
             raise ValueError("classes must be non-empty")
         self.aging_s = aging_s
+        self.reserve = {cls: int(n) for cls, n in (reserve or {}).items()
+                        if cls in self.classes and int(n) > 0}
         self._q: dict[str, collections.deque] = {
             cls: collections.deque() for cls in self.classes}
         self._cond = threading.Condition()
@@ -283,11 +310,19 @@ class AdmissionQueue:
         except QueueFull:
             return None
 
-    def pop(self):
+    def pop(self, *, live: dict | None = None, free: int | None = None):
         """``(entry, enqueue_t)`` or ``None`` when empty: the head of the
         highest-priority non-empty class — unless a lower class's head
         has AGED past ``aging_s``, in which case the oldest aged head
-        pops first (the starvation guard)."""
+        pops first (the starvation guard).
+
+        ``live`` (``{class: currently-admitted count}``) and ``free``
+        (slots this admission round may still fill) activate the
+        per-class RESERVE: when the free slots only just cover the
+        waiting reserved classes' unmet shares, the pop is restricted to
+        those classes — the last reserved slot can never go to a
+        non-reserved surge.  Omitting either keeps the pre-reserve
+        behavior (unit tests, non-slot callers)."""
         with self._cond:
             if self.aging_s > 0:
                 now = time.perf_counter()
@@ -297,10 +332,32 @@ class AdmissionQueue:
                         and now - self._q[cls][0][1] >= self.aging_s]
                 if aged:
                     return self._q[min(aged)[1]].popleft()
-            for cls in self.classes:
+            allowed = self.classes
+            if self.reserve and live is not None and free is not None:
+                deficits = {cls: self.reserve[cls] - live.get(cls, 0)
+                            for cls in self.classes
+                            if self._q[cls]
+                            and live.get(cls, 0) < self.reserve.get(cls, 0)}
+                if deficits and free <= sum(deficits.values()):
+                    allowed = tuple(deficits)
+            for cls in allowed:
                 if self._q[cls]:
                     return self._q[cls].popleft()
             return None
+
+    def remove(self, user_id) -> FleetUser | None:
+        """Withdraw a still-queued entry by user id (the fabric
+        rebalance seam): returns the entry, or ``None`` when no queued
+        entry matches — e.g. it was already admitted, which is exactly
+        the race the coordinator's drop-ack protocol exists to detect."""
+        uid = str(user_id)
+        with self._cond:
+            for dq in self._q.values():
+                for item in dq:
+                    if str(item[0].user_id) == uid:
+                        dq.remove(item)
+                        return item[0]
+        return None
 
     def head_waits(self) -> dict:
         """``{class: seconds its head entry has waited}`` for non-empty
@@ -364,8 +421,15 @@ class FleetServer:
         self.config = config
         self.preemption = preemption
         self.router = BucketRouter(config.bucket_widths)
-        self.queue = AdmissionQueue(config.max_queue,
-                                    aging_s=config.aging_s)
+        # the batch-class slot share (clamped so interactive always keeps
+        # at least one slot; a 1-slot engine cannot reserve anything)
+        reserve = min(config.batch_reserve, config.target_live - 1)
+        self.queue = AdmissionQueue(
+            config.max_queue, aging_s=config.aging_s,
+            reserve={"batch": reserve} if reserve > 0 else None)
+        #: currently-admitted users' priority classes (uid → cls): the
+        #: live composition the queue's per-class reserve pops against
+        self._live_cls: dict[str, str] = {}
         self.report = scheduler.report
         self.results: list[dict] = []
         self._admitted: list[FleetUser] = []
@@ -518,6 +582,40 @@ class FleetServer:
         """No further ``submit``s: :meth:`serve` returns once the queue
         and the engine drain."""
         self._intake_open = False
+
+    def withdraw(self, user_id) -> bool:
+        """Remove a STILL-QUEUED user (the fabric rebalance seam: the
+        coordinator migrates it to a newly-joined host).  Returns False
+        when the user is not waiting — already admitted, finished, or
+        never submitted here — which the caller must treat as a refused
+        migration: the user runs where it is.  Thread-safe (called from
+        the worker's intake thread)."""
+        uid = str(user_id)
+        entry = self.queue.remove(uid)
+        if entry is None:
+            return False
+        if self.planner is not None:
+            self.planner.note_resolved(uid)  # no admitted clock existed
+        self.report.event("withdraw", user=uid)
+        return True
+
+    def apply_fleet_edges(self, edges) -> None:
+        """Adopt coordinator-broadcast fabric-level bucket edges (the
+        fleet planner): future admissions route by them — already-pinned
+        pads stay pinned — and the local planner stops deriving its own
+        (see :meth:`~consensus_entropy_tpu.serve.planner.
+        AdmissionPlanner.set_fleet_edges`).  Explicit operator
+        ``--bucket-widths`` still win: the fabric CLI never broadcasts
+        when they are set, and an embedded caller keeps that contract by
+        not calling this."""
+        new = tuple(int(e) for e in edges)
+        if not new:
+            return
+        if self.planner is not None:
+            self.planner.set_fleet_edges(new)
+        else:
+            self.router.update(new)
+        self.report.event("fleet_edges", edges=list(new))
 
     @property
     def draining(self) -> bool:
@@ -687,7 +785,11 @@ class FleetServer:
         counted against the user's failure budget."""
         sched = self.scheduler
         while sched.n_live < self.config.target_live:
-            item = self.queue.pop()
+            live: dict = {}
+            for c in self._live_cls.values():
+                live[c] = live.get(c, 0) + 1
+            item = self.queue.pop(
+                live=live, free=self.config.target_live - sched.n_live)
             if item is None:
                 return
             entry, t_enq = item
@@ -707,6 +809,7 @@ class FleetServer:
             self._journal("admit", uid, width=width)
             self._attempts[uid] = self._attempts.get(uid, 0) + 1
             sched.admit(entry, pad=width)
+            self._live_cls[uid] = cls
             if id(entry) not in self._admitted_ids:
                 self._admitted_ids.add(id(entry))
                 self._admitted.append(entry)
@@ -764,6 +867,7 @@ class FleetServer:
         skip the user."""
         uid = str(entry.user_id)
         attempts = self._attempts.get(uid, 1)
+        self._live_cls.pop(uid, None)
         if self.planner is not None:
             # the user left the engine either way (requeue or final):
             # its SLO clock stops constraining holds until re-admission
@@ -820,6 +924,7 @@ class FleetServer:
         for eid in finished:
             self._pending.pop(eid, None)
             rec = self.scheduler.results[eid]
+            self._live_cls.pop(str(rec["user"]), None)
             if self.planner is not None:
                 self.planner.note_resolved(rec["user"])
             if on_result is not None:

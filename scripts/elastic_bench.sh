@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Elastic fabric control-plane race (ISSUE 13 acceptance: with one
+# worker SIGKILLed mid-run, the autoscaler respawns a replacement and
+# every user finishes bit-identical to sequential; bucket-aware
+# placement beats least-loaded on mean per-host stacked-dispatch
+# occupancy, with the fleet planner's merged edges identical on every
+# surviving host).
+#
+# Runs `bench.py --suite elastic`: two arms over the IDENTICAL
+# two-bucket workload (pool sizes cycling 30,30,100,100) on a 2-host
+# elastic fabric (min_hosts=2, max_hosts=3), h0 SIGKILLed at its first
+# admission in BOTH arms.  The arms differ only in
+# FabricConfig.placement — 'bucket' (co-locate same-dispatch-bucket
+# users, this PR's policy) vs 'load' (the PR 5 least-loaded baseline).
+# Occupancy is mean_device_batch / target_live per surviving host (the
+# in-bucket occupancy metric cannot see placement); parity vs unfaulted
+# sequential runs is asserted on every rep of both arms, and reps are
+# interleaved best-of per the 2-vCPU drift protocol.
+#
+# The JSON line goes to stdout (redirect to BENCH_elastic_r<N>.json to
+# commit an artifact); the per-rep log goes to stderr.  Extra bench
+# args pass through, e.g.:
+#   scripts/elastic_bench.sh --users 8 --al-epochs 2 --reps 2
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+if [ "$#" -gt 0 ]; then
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --suite elastic "$@"
+else
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --suite elastic \
+        --users 8 --hosts 2 --al-epochs 3 --reps 3
+fi

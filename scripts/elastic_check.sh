@@ -1,0 +1,138 @@
+#!/usr/bin/env bash
+# Elastic fabric CI gate (ISSUE 13 satellite; sits next to slo_check.sh
+# and is run by scripts/fault_matrix.sh).
+#
+# Runs a REAL 2-host ELASTIC fabric (worker subprocesses over the
+# synthetic tests/fabric_workload users, two pool-size buckets),
+# SIGKILLs h0 at its first admission, then:
+#   1. asserts the autoscaler RESPAWNED a replacement (spawn journaled,
+#      fresh host id in the replayed fleet shape) and every user
+#      finished bit-identical to unfaulted sequential baselines,
+#   2. schema-validates the main admission journal AND every per-host
+#      event WAL (structural: known events, required fields, monotone
+#      seq, torn tails tolerated),
+#   3. asserts the fleet planner's MERGED edges ended identical on
+#      every surviving host (each worker's last fleet-adopted planner
+#      record) and match the main journal's restored edges.
+#
+# Extra args are NOT accepted: this is a pass/fail gate, not a bench.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'PY'
+import glob
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, ".")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+from tests.fabric_workload import (
+    make_cfg,
+    read_results,
+    sequential_baselines,
+    sizes_arg,
+    user_specs,
+)
+
+from consensus_entropy_tpu.obs import export
+from consensus_entropy_tpu.serve import (
+    AdmissionJournal,
+    FabricConfig,
+    FabricCoordinator,
+    validate_journal_file,
+)
+from consensus_entropy_tpu.serve.hosts import fabric_paths
+
+cfg = make_cfg("mc", epochs=2)
+specs = user_specs(6, sizes=[30, 100])
+root = tempfile.mkdtemp(prefix="elastic_check_")
+seq = sequential_baselines(root, cfg, specs)
+fabric_dir = os.path.join(root, "fabric")
+os.makedirs(fabric_dir)
+jp = os.path.join(fabric_dir, "serve_journal.jsonl")
+journal = AdmissionJournal(jp)
+
+
+def spawn(host_id):
+    log = open(fabric_paths(fabric_dir, host_id)["log"], "ab")
+    try:
+        return subprocess.Popen(
+            [sys.executable, "tests/fabric_worker.py", fabric_dir,
+             host_id, root, cfg.mode, str(cfg.epochs), str(len(specs)),
+             "5.0", "3", sizes_arg(specs)],
+            stdout=log, stderr=subprocess.STDOUT,
+            env={**os.environ, "PYTHONPATH": "."})
+    finally:
+        log.close()
+
+
+state = {"killed": False}
+
+
+def chaos(coord):
+    st = coord.journal.state
+    if not state["killed"] and any(
+            h == "h0" and st.last.get(u) == "admit"
+            for u, h in st.assigned.items()):
+        coord.hosts["h0"].proc.kill()
+        state["killed"] = True
+
+
+coord = FabricCoordinator(
+    journal, fabric_dir,
+    FabricConfig(hosts=2, min_hosts=2, max_hosts=3, planner_epoch=4),
+    on_poll=chaos)
+summary = coord.run([u for _, u, _ in specs], spawn,
+                    pools={u: n for _, u, n in specs})
+journal.close()
+
+# 1. kill exercised, replacement respawned, all users bit-identical
+assert state["killed"], "h0 was never killed"
+assert summary["revocations"] == 1 and summary["spawns"] >= 1, summary
+assert sorted(summary["finished"]) == sorted(u for _, u, _ in specs)
+results = read_results(fabric_dir)
+for _, uid, _ in specs:
+    assert results[uid]["error"] is None
+    assert results[uid]["result"]["trajectory"] == seq[uid]["trajectory"]
+st = AdmissionJournal(jp).state
+assert st.hosts["h0"] == "revoke"
+assert "h2" in st.fleet_hosts(), st.fleet_hosts()
+print(f"elastic_check: kill+respawn recovered {len(specs)} users "
+      f"bit-identical (spawns={summary['spawns']}, "
+      f"joins={summary['joins']}, migrations={summary['migrations']})")
+
+# 2. every journal/WAL validates structurally
+bad = validate_journal_file(jp)
+for wal in sorted(glob.glob(os.path.join(fabric_dir, "events_*.jsonl"))):
+    bad += validate_journal_file(wal)
+assert bad == [], "journal violations:\n" + "\n".join(bad[:10])
+print("elastic_check: main journal + per-host WALs schema-valid")
+
+# 3. merged planner edges identical on every surviving host
+per_host = {}
+for hid, status in summary["hosts"].items():
+    if status == "revoked":
+        continue
+    last = None
+    for rec in export.read_jsonl_tolerant(
+            os.path.join(fabric_dir, f"events_{hid}.jsonl")):
+        if rec.get("event") == "planner" and rec.get("fleet"):
+            last = tuple(rec.get("edges") or ())
+    if last is not None:
+        per_host[hid] = last
+assert per_host, "no host ever adopted fleet edges"
+assert len(set(per_host.values())) == 1, per_host
+fleet = summary.get("fleet_planner") or {}
+assert list(next(iter(per_host.values()))) == fleet.get("edges"), \
+    (per_host, fleet)
+assert st.planner_edges == fleet.get("edges")
+print(f"elastic_check: merged edges identical on every host "
+      f"{sorted(per_host)} -> {fleet.get('edges')}")
+PY
+echo "elastic check passed"

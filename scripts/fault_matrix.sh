@@ -40,6 +40,14 @@
 #   finish every user bit-identical to sequential — the planner rows of
 #   the serve kill matrix (scripts/slo_check.sh is the companion
 #   schema/replay gate).
+# - elastic control plane (tests/test_elastic.py): a worker SIGKILLed
+#   out of a 2-host ELASTIC fabric must be REPLACED by the autoscaler
+#   (spawn/join journaled, users recovered bit-identical, fleet shape
+#   replayable), the coordinator-kill-mid-rebalance drill must replay
+#   to deterministic assignments, and the drop-ack migration protocol
+#   must never run a user on two hosts; scripts/elastic_check.sh (run
+#   at the end of this matrix) is the companion kill→respawn→
+#   journal-schema→merged-edges gate.
 # - acquisition registry (tests/test_acquire.py): the acquire.qbdc.masks
 #   fault point unit and the qbdc resume drill.
 # - observability (tests/test_obs.py): the traced fleet eviction+resume
@@ -56,6 +64,8 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
   tests/test_serve_faults.py tests/test_serve_fabric.py \
-  tests/test_slo.py tests/test_acquire.py tests/test_obs.py -v -m faults \
+  tests/test_slo.py tests/test_elastic.py tests/test_acquire.py \
+  tests/test_obs.py -v -m faults \
   -p no:cacheprovider "$@"
+scripts/elastic_check.sh
 echo "fault matrix passed"

@@ -4,15 +4,19 @@ Spawned by ``tests/test_serve_fabric.py`` and ``bench.py --suite fabric``
 (the production equivalent is the CLI's ``--fabric-worker`` re-exec):
 
     python tests/fabric_worker.py FABRIC_DIR HOST_ID WS_ROOT MODE \
-        EPOCHS N_USERS LEASE_S TARGET_LIVE
+        EPOCHS N_USERS LEASE_S TARGET_LIVE [SIZES_CSV]
 
 Runs one ``FleetServer`` fed from the coordinator's assignment file
 (``serve.hosts.run_worker``), persisting each finished user's result to
 ``FABRIC_DIR/results_<HOST_ID>.jsonl`` (append + fsync — the parity
-assertions read these).  Fault rules arrive via the ``CETPU_FAULTS``
-environment variable (installed at package import), so chaos drills can
-wedge THIS worker's heartbeat or kill its steps without touching its
-peers.
+assertions read these).  ``SIZES_CSV`` (optional) gives per-user pool
+sizes — the skewed workload the elastic placement drills run.  Fault
+rules arrive via the ``CETPU_FAULTS`` environment variable (installed
+at package import), so chaos drills can wedge THIS worker's heartbeat
+or kill its steps without touching its peers.  ``CETPU_FABRIC_METRICS=1``
+writes this host's schema-v2 metrics stream + fleet summary to
+``FABRIC_DIR/fleet_metrics_<HOST_ID>.jsonl`` (per-host stacked-dispatch
+occupancy — what ``bench.py --suite elastic`` grades placement by).
 """
 
 import json
@@ -24,6 +28,8 @@ import time
 def main(argv) -> int:
     (fabric_dir, host_id, ws_root, mode, epochs, n_users, lease_s,
      target) = argv[:8]
+    sizes = [int(x) for x in argv[8].split(",") if x] \
+        if len(argv) > 8 and argv[8] else None
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     from tests.fabric_workload import (
@@ -45,7 +51,7 @@ def main(argv) -> int:
     from consensus_entropy_tpu.serve.hosts import run_worker
 
     cfg = make_cfg(mode=mode, epochs=int(epochs))
-    specs = user_specs(int(n_users))
+    specs = user_specs(int(n_users), sizes=sizes)
     results_path = os.path.join(fabric_dir, f"results_{host_id}.jsonl")
 
     def on_result(rec):
@@ -70,7 +76,10 @@ def main(argv) -> int:
 
         tracer = Tracer(fabric_paths(fabric_dir, host_id)["spans"],
                         run_id=f"{cfg.mode}-{cfg.seed}", host=host_id)
-    scheduler = FleetScheduler(cfg, report=FleetReport(),
+    report = FleetReport(
+        os.path.join(fabric_dir, f"fleet_metrics_{host_id}.jsonl")
+        if os.environ.get("CETPU_FABRIC_METRICS") else None)
+    scheduler = FleetScheduler(cfg, report=report,
                                retrain_epochs=retrain_epochs_for(mode),
                                scoring_by_width=True, tracer=tracer)
     try:
@@ -78,7 +87,11 @@ def main(argv) -> int:
             run_worker(fabric_dir, host_id,
                        build_entry=build_entry_factory(ws_root, cfg, specs),
                        scheduler=scheduler,
-                       config=ServeConfig(target_live=int(target)),
+                       # planner_epoch=2: the tiny synthetic cohorts must
+                       # still journal sketch epochs, or the elastic
+                       # fleet planner would have nothing to merge
+                       config=ServeConfig(target_live=int(target),
+                                          planner_epoch=2),
                        on_result=on_result, lease_s=float(lease_s),
                        preemption=guard)
     except Preempted:
@@ -86,6 +99,11 @@ def main(argv) -> int:
     finally:
         if tracer is not None:
             tracer.close()
+        if report.jsonl_path is not None:
+            # this host's per-bucket stacked-dispatch occupancy — the
+            # elastic bench's placement metric (schema-v2 stream)
+            report.write_summary(cohort=int(target))
+            report.close()
     return 0
 
 

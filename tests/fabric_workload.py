@@ -63,9 +63,22 @@ def retrain_epochs_for(mode: str):
     return 1 if mode == "qbdc" else None
 
 
-def user_specs(n_users: int, n_songs: int = 30) -> list:
-    """``[(seed, user_id, n_songs), ...]`` — the canonical workload."""
+def user_specs(n_users: int, n_songs: int = 30, sizes=None) -> list:
+    """``[(seed, user_id, n_songs), ...]`` — the canonical workload.
+    ``sizes`` (cycled over users) builds the SKEWED shape the elastic
+    placement drills need: users land in different pool-width dispatch
+    buckets, so bucket-aware placement has something to co-locate."""
+    if sizes:
+        return [(100 + i, f"u{i}", int(sizes[i % len(sizes)]))
+                for i in range(int(n_users))]
     return [(100 + i, f"u{i}", n_songs) for i in range(int(n_users))]
+
+
+def sizes_arg(specs) -> str:
+    """The per-user size list as the comma-separated argv form
+    ``tests/fabric_worker.py`` rebuilds specs from (workers MUST build
+    the exact users the coordinator's baselines were computed from)."""
+    return ",".join(str(n) for _, _, n in specs)
 
 
 def make_data(seed: int, uid: str, n_songs: int = 30, f: int = 10,

@@ -1,0 +1,845 @@
+"""Elastic fabric control plane: autoscaler, JOIN/rebalance,
+bucket-aware placement, fabric-level planner (``serve.elastic`` +
+``serve.placement``).
+
+Tier-1 keeps the pure-host decision kernels (placement, rebalance
+planning, autoscaler sizing, host-id allocation, journal fleet-shape
+replay, the drop-record semantics, the journal validator, the
+batch-reserve queue and the telemetry-sized dispatch hold), the
+DETERMINISTIC fake-worker drills (the coordinator drives real feeds /
+leases / event WALs while the test plays the workers — join, rebalance
+drop-ack, fleet-edge broadcast and the coordinator-kill-mid-rebalance
+replay are all journal-state-scripted, no subprocess timing), and ONE
+real-subprocess acceptance drill: a 2-host elastic fabric with a worker
+SIGKILLed mid-run must end with the autoscaler having respawned a
+replacement and every user bit-identical to uninterrupted sequential
+runs.  The mode matrix and the operator-adoption subprocess drill are
+``slow`` (``scripts/fault_matrix.sh`` / ``scripts/elastic_check.sh``).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from consensus_entropy_tpu.obs.metrics import QuantileSketch
+from consensus_entropy_tpu.resilience import faults
+from consensus_entropy_tpu.resilience.faults import FaultRule, InjectedKill
+from consensus_entropy_tpu.serve import (
+    AdmissionJournal,
+    AdmissionPlanner,
+    AdmissionQueue,
+    BucketRouter,
+    FabricConfig,
+    FabricCoordinator,
+    FleetPlanner,
+    JournalState,
+    JsonlTail,
+    ServeConfig,
+    bucket_for,
+    derive_edges,
+    dispatch_hold,
+    next_host_id,
+    place,
+    place_user,
+    plan_rebalance,
+    target_hosts,
+    validate_journal_file,
+)
+from consensus_entropy_tpu.serve.hosts import fabric_paths
+from tests.fabric_workload import (
+    make_cfg,
+    read_results,
+    sequential_baselines,
+    sizes_arg,
+    user_specs,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.faults]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "fabric_worker.py")
+
+
+# -- config validation (the bugfix satellite) ------------------------------
+
+
+def test_fabric_config_elastic_validation():
+    """Elastic knobs validate at CONSTRUCTION with the reason — the
+    validate_bucket_widths precedent — and one bound defaults the
+    other."""
+    c = FabricConfig(hosts=2, min_hosts=2, max_hosts=4)
+    assert c.elastic and (c.min_hosts, c.max_hosts) == (2, 4)
+    c = FabricConfig(hosts=3, min_hosts=2)  # max defaults to hosts
+    assert (c.min_hosts, c.max_hosts) == (2, 3)
+    c = FabricConfig(hosts=2, max_hosts=5)  # min defaults to hosts
+    assert (c.min_hosts, c.max_hosts) == (2, 5)
+    assert not FabricConfig(hosts=2).elastic  # PR 5 shape: all off
+    with pytest.raises(ValueError, match="min_hosts must be <= max_hosts"):
+        FabricConfig(hosts=3, min_hosts=4, max_hosts=3)
+    with pytest.raises(ValueError, match="inside"):
+        FabricConfig(hosts=5, min_hosts=1, max_hosts=4)
+    with pytest.raises(ValueError, match="min_hosts"):
+        FabricConfig(hosts=1, min_hosts=0, max_hosts=1)
+    with pytest.raises(ValueError, match="scale_backlog"):
+        FabricConfig(hosts=2, min_hosts=2, max_hosts=2, scale_backlog=0)
+    with pytest.raises(ValueError, match="scale_slo_s"):
+        FabricConfig(hosts=2, min_hosts=2, max_hosts=2, scale_slo_s=-1)
+    with pytest.raises(ValueError, match="placement"):
+        FabricConfig(hosts=2, placement="random")
+    with pytest.raises(ValueError, match="hosts must be >= 1"):
+        FabricConfig(hosts=0)
+    with pytest.raises(ValueError, match="lease_s"):
+        FabricConfig(hosts=2, lease_s=0)
+    # the journal's compaction bound validates at construction too
+    with pytest.raises(ValueError, match="compact_bytes"):
+        AdmissionJournal(None, compact_bytes=0)
+    with pytest.raises(ValueError, match="compact_bytes"):
+        AdmissionJournal(None, compact_bytes=-4)
+
+
+def test_elastic_cli_flag_validation(tmp_path):
+    """Clean CLI errors for typo'd elastic geometry, before any data or
+    backend work."""
+    from consensus_entropy_tpu.cli.amg_test import main
+
+    base = ["-q", "1", "-e", "1", "-n", "1", "-m", "mc",
+            "--models-root", str(tmp_path)]
+    assert main(base + ["--min-hosts", "2"]) == 1  # needs --serve
+    assert main(base + ["--serve", "1", "--min-hosts", "2"]) == 1  # --hosts
+    assert main(base + ["--serve", "1", "--hosts", "2",
+                        "--min-hosts", "3", "--max-hosts", "2"]) == 1
+    assert main(base + ["--serve", "1", "--hosts", "5",
+                        "--min-hosts", "1", "--max-hosts", "4"]) == 1
+
+
+# -- autoscaler decision kernels (pure host) -------------------------------
+
+
+def test_next_host_id_never_reuses():
+    assert next_host_id([]) == "h0"
+    assert next_host_id(["h0", "h1"]) == "h2"
+    # revoked ids stay burned: their event WAL + cursor belong to the
+    # dead process
+    assert next_host_id(["h0", "h2"]) == "h3"
+    assert next_host_id(["h0", "weird", "h10"]) == "h11"
+
+
+def test_target_hosts_decision_table():
+    kw = dict(min_hosts=2, max_hosts=4, scale_backlog=4)
+    # dead capacity below the floor is replaced
+    assert target_hosts(live=0, queued=1, **kw) == 2
+    assert target_hosts(live=1, queued=0, **kw) == 2
+    # healthy fleet, light queue: hold
+    assert target_hosts(live=2, queued=8, **kw) == 2
+    # queue-depth signal: backlog per live host exceeded -> +1
+    assert target_hosts(live=2, queued=9, **kw) == 3
+    assert target_hosts(live=3, queued=13, **kw) == 4
+    # ceiling holds no matter the backlog
+    assert target_hosts(live=4, queued=1000, **kw) == 4
+    # SLO-headroom signal: predicted drain time past the target -> +1
+    assert target_hosts(live=2, queued=5, scale_slo_s=10.0,
+                        finish_ema_s=3.0, **kw) == 3
+    assert target_hosts(live=2, queued=5, scale_slo_s=60.0,
+                        finish_ema_s=3.0, **kw) == 2
+    # no finish telemetry yet -> unpredictable -> no SLO scale-up
+    assert target_hosts(live=2, queued=5, scale_slo_s=10.0,
+                        finish_ema_s=None, **kw) == 2
+
+
+# -- placement kernels (pure host) -----------------------------------------
+
+
+def test_bucket_for_edges_and_pow2():
+    assert bucket_for(None) is None
+    assert bucket_for(30) == 32 and bucket_for(100) == 128  # pow2 default
+    assert bucket_for(100, (120, 480)) == 120
+    assert bucket_for(480, (120, 480)) == 480
+    assert bucket_for(481, (120, 480)) == 512  # total: pow2 fall-through
+    # agreement with the router every worker actually pads by
+    r = BucketRouter()
+    r.update((120, 480))
+    for n in (1, 100, 120, 200, 481):
+        assert bucket_for(n, (120, 480)) == r.width_for(n)
+
+
+def test_place_colocates_buckets_within_skew():
+    loads = {"h0": 2, "h1": 2}
+    buckets = {"h0": {32: 2}, "h1": {128: 2}}
+    # same-bucket users co-locate: a 32-bucket user joins h0, a
+    # 128-bucket user joins h1 — stacked dispatches stay full per host
+    assert place(32, loads=loads, buckets_by_host=buckets) == "h0"
+    assert place(128, loads=loads, buckets_by_host=buckets) == "h1"
+    # the load-skew bound: a host too far above the floor loses the
+    # co-location claim
+    assert place(32, loads={"h0": 9, "h1": 2},
+                 buckets_by_host=buckets, max_skew=4) == "h1"
+    # no bucket info, or the 'load' arm: pure least-loaded (PR 5)
+    assert place(None, loads={"h0": 3, "h1": 1},
+                 buckets_by_host=buckets) == "h1"
+    assert place(32, loads={"h0": 3, "h1": 1}, buckets_by_host=buckets,
+                 policy="load") == "h1"
+    # deterministic tie-break on host id
+    assert place(64, loads={"h0": 1, "h1": 1},
+                 buckets_by_host={"h0": {}, "h1": {}}) == "h0"
+    with pytest.raises(ValueError, match="policy"):
+        place(32, loads=loads, buckets_by_host=buckets, policy="x")
+    with pytest.raises(ValueError, match="live hosts"):
+        place(32, loads={}, buckets_by_host={})
+
+
+def test_place_user_is_pure_function_of_journal_state(tmp_path):
+    """The replay-determinism pin: two independent replays of the same
+    journal drive identical placement decisions."""
+    jp = str(tmp_path / "j.jsonl")
+    with AdmissionJournal(jp) as j:
+        for i, pool in enumerate((30, 100, 30, 100, 30)):
+            j.append("enqueue", f"u{i}", pool=pool)
+        j.append("assign", "u0", host="h0")
+        j.append("assign", "u1", host="h1")
+    unresolved = {f"u{i}" for i in range(5)}
+    decisions = []
+    for _ in range(2):
+        st = AdmissionJournal(jp).state
+        decisions.append([
+            place_user(u, state=st, unresolved=unresolved,
+                       hosts=["h0", "h1"]) for u in sorted(unresolved)])
+    assert decisions[0] == decisions[1]
+    st = AdmissionJournal(jp).state
+    assert st.pools == {"u0": 30, "u1": 100, "u2": 30, "u3": 100,
+                        "u4": 30}
+    # u2 (32-bucket) joins u0 on h0; u3 (128-bucket) joins u1 on h1
+    assert place_user("u2", state=st, unresolved=unresolved,
+                      hosts=["h0", "h1"]) == "h0"
+    assert place_user("u3", state=st, unresolved=unresolved,
+                      hosts=["h0", "h1"]) == "h1"
+
+
+def test_plan_rebalance_moves_queue_tails_to_floor():
+    moves = plan_rebalance(
+        "h2", loads={"h0": 4, "h1": 3, "h2": 0},
+        queued_by_host={"h0": ["a", "b", "c"], "h1": ["d", "e"]})
+    # floor share = 7 // 3 = 2: two moves, LAST-enqueued first, from the
+    # most-loaded donor; earliest-enqueued users never move (they keep
+    # their run-first position)
+    assert moves == [("c", "h0"), ("e", "h1")]
+    assert plan_rebalance("h2", loads={"h0": 1, "h2": 0},
+                          queued_by_host={"h0": ["a"]}) == []
+    # donors cap at their own floor: nothing moves a host below it
+    assert plan_rebalance(
+        "h1", loads={"h0": 2, "h1": 0},
+        queued_by_host={"h0": ["a", "b"]}) == [("b", "h0")]
+    # deterministic across calls
+    kw = dict(loads={"h0": 5, "h1": 5, "h2": 0},
+              queued_by_host={"h0": ["a", "b"], "h1": ["c", "d"]})
+    assert plan_rebalance("h2", **kw) == plan_rebalance("h2", **kw)
+
+
+# -- journal records + validator (pure host) -------------------------------
+
+
+def test_journal_spawn_join_records_replay_fleet_shape(tmp_path):
+    jp = str(tmp_path / "j.jsonl")
+    with AdmissionJournal(jp) as j:
+        j.append("lease", host="h0", pid=1)
+        j.append("lease", host="h1", pid=2)
+        j.append("join", host="h1")
+        j.append("revoke", host="h0", reason="drill")
+        j.append("spawn", host="h2", reason="replace")
+        j.append("lease", host="h2", pid=3)
+        j.append("spawn", host="h3", reason="scale_up")  # never came up
+    st = AdmissionJournal(jp).state
+    assert st.fleet_hosts() == ["h1", "h2", "h3"]
+    assert st.live_hosts() == ["h1", "h2"]  # join counts as live
+    rt = JournalState.from_dict(st.to_dict())
+    assert rt.fleet_hosts() == st.fleet_hosts()
+    with pytest.raises(ValueError, match="needs host"):
+        AdmissionJournal(None).append("spawn")
+
+
+def test_journal_drop_records_keep_dispositions(tmp_path):
+    """A drop ack never changes whether a user is queued — it is pure
+    rebalance bookkeeping with a cursor, torn-tail tolerant like every
+    other record."""
+    jp = str(tmp_path / "j.jsonl")
+    with AdmissionJournal(jp) as j:
+        j.append("enqueue", "a", pool=30)
+        j.append("assign", "a", host="h0")
+        j.append("drop", "a", host="h0", src_off=64, ok=True)
+        j.append("assign", "a", host="h1")
+    with open(jp, "ab") as f:
+        f.write(b'{"event": "drop", "user"')  # torn mid-append
+    st = AdmissionJournal(jp).state
+    assert st.queued == ["a"] and st.assigned == {"a": "h1"}
+    assert st.host_cursor == {"h0": 64}
+    assert st.pools == {"a": 30}
+    rt = JournalState.from_dict(json.loads(json.dumps(st.to_dict())))
+    assert rt.pools == st.pools and rt.queued == st.queued
+
+
+def test_validate_journal_file(tmp_path):
+    jp = str(tmp_path / "j.jsonl")
+    with AdmissionJournal(jp) as j:
+        j.append("enqueue", "a", pool=30)
+        j.append("spawn", host="h0", reason="replace")
+        j.append("admit", "a")
+    assert validate_journal_file(jp) == []
+    with open(jp, "ab") as f:
+        f.write(b'{"event": "admit"')  # torn tail: allowed
+    assert validate_journal_file(jp) == []
+    with open(jp, "ab") as f:
+        f.write(b'\n{"event": "nonsense", "user": "a", "seq": 9}\n')
+        f.write(b'{"event": "admit", "user": "a", "seq": 1}\n')
+    errs = validate_journal_file(jp)
+    assert any("unknown event" in e for e in errs)
+    assert any("seq regressed" in e for e in errs)
+    assert validate_journal_file(str(tmp_path / "missing.jsonl"))
+
+
+# -- batch-reserve admission (planner follow-on (b)) -----------------------
+
+
+class _E:
+    def __init__(self, uid, priority="batch"):
+        self.user_id = uid
+        self.priority = priority
+
+
+def test_queue_batch_reserve_starvation_bound():
+    """The starvation bound: with one slot reserved, an interactive
+    surge occupies at most target_live - 1 slots — the LAST free slot
+    only ever admits the batch waiter, within ONE slot turnover instead
+    of aging_s."""
+    q = AdmissionQueue(16, reserve={"batch": 1})
+    for e in (_E("b0"), _E("i0", "interactive"), _E("i1", "interactive"),
+              _E("i2", "interactive")):
+        q.put(e)
+    # free slots above the unmet reserve: strict priority as usual
+    assert q.pop(live={}, free=4)[0].user_id == "i0"
+    assert q.pop(live={"interactive": 1}, free=3)[0].user_id == "i1"
+    assert q.pop(live={"interactive": 2}, free=2)[0].user_id == "i2"
+    # the last slot is the reserve's: batch pops ahead of any surge
+    q.put(_E("i3", "interactive"))
+    assert q.pop(live={"interactive": 3}, free=1)[0].user_id == "b0"
+    # reserve satisfied -> strict priority returns
+    assert q.pop(live={"interactive": 3, "batch": 1},
+                 free=1)[0].user_id == "i3"
+    # no batch waiters: the reserve never blocks a pop
+    q2 = AdmissionQueue(8, reserve={"batch": 1})
+    q2.put(_E("i0", "interactive"))
+    assert q2.pop(live={}, free=1)[0].user_id == "i0"
+    # legacy pop() (no slot context) keeps the pre-reserve behavior
+    q3 = AdmissionQueue(8, reserve={"batch": 1})
+    q3.put(_E("b0"))
+    q3.put(_E("i0", "interactive"))
+    assert q3.pop()[0].user_id == "i0"
+    with pytest.raises(ValueError, match="batch_reserve"):
+        ServeConfig(batch_reserve=-1)
+
+
+def test_queue_remove_withdraws_only_queued():
+    q = AdmissionQueue(8)
+    q.put(_E("a"))
+    q.put(_E("b", "interactive"))
+    assert q.remove("b").user_id == "b"
+    assert q.remove("b") is None  # gone
+    assert q.remove("zz") is None  # never queued
+    assert len(q) == 1 and q.pop()[0].user_id == "a"
+
+
+# -- telemetry-sized dispatch holds (planner follow-on (d)) ----------------
+
+
+def test_dispatch_hold_step_ema_decision_table():
+    kw = dict(waiting=2, host_in_flight=1, headroom_s=10.0,
+              max_hold_s=1.0)
+    # no telemetry yet: the structural cap (unchanged behavior)
+    assert dispatch_hold(**kw) == 1.0
+    # observed host steps SIZE the hold — shorter than the cap when the
+    # steps are fast, longer when they are slow (still inside headroom)
+    assert dispatch_hold(step_ema_s=0.04, **kw) == pytest.approx(0.04)
+    assert dispatch_hold(step_ema_s=3.0, **kw) == 3.0
+    assert dispatch_hold(step_ema_s=30.0, **kw) == 10.0  # SLO bound
+    # the structural zeros still win
+    assert dispatch_hold(waiting=0, host_in_flight=1, headroom_s=10.0,
+                         max_hold_s=1.0, step_ema_s=0.5) == 0.0
+    assert dispatch_hold(waiting=2, host_in_flight=0, headroom_s=10.0,
+                         max_hold_s=1.0, step_ema_s=0.5) == 0.0
+    assert dispatch_hold(waiting=2, host_in_flight=1, headroom_s=0.0,
+                         max_hold_s=1.0, step_ema_s=0.5) == 0.0
+    # max_hold_s=0 stays the operator OFF switch even with telemetry
+    assert dispatch_hold(waiting=2, host_in_flight=1, headroom_s=10.0,
+                         max_hold_s=0.0, step_ema_s=0.5) == 0.0
+
+
+def test_planner_note_host_step_sizes_window():
+    cfg = ServeConfig(slo_interactive_s=100.0, slo_batch_s=100.0,
+                      max_hold_s=1.0)
+    p = AdmissionPlanner(cfg, router=BucketRouter(), clock=lambda: 0.0)
+    assert p.window_s(2, 1) == 1.0  # no telemetry: the cap
+    p.note_host_step(0.05)
+    assert p.window_s(2, 1) == pytest.approx(0.05)
+    p.note_host_step(0.05)
+    ema = 0.3 * 0.05 + 0.7 * 0.05
+    assert p.window_s(2, 1) == pytest.approx(ema)
+    assert p.summary()["host_step_ema_s"] == pytest.approx(ema, abs=1e-4)
+    # the scheduler seam: completed host futures feed the EMA
+    from consensus_entropy_tpu.fleet import FleetReport, FleetScheduler
+    from tests.test_fleet import _cfg
+
+    sched = FleetScheduler(_cfg(), report=FleetReport(), hold=p)
+    assert sched.hold is p and callable(sched.hold.note_host_step)
+
+
+# -- fleet planner (merged sketches) ---------------------------------------
+
+
+def _sketch_of(vals):
+    sk = QuantileSketch()
+    for v in vals:
+        sk.add(int(v))
+    return sk
+
+
+def test_sketch_merge_all_matches_chained_merges():
+    parts = [[30] * 5, [100] * 3, [480] * 2]
+    dicts = [_sketch_of(p).to_dict() for p in parts]
+    folded = QuantileSketch.merge_all(dicts)
+    chained = QuantileSketch.from_dict(dicts[0]).merge(
+        QuantileSketch.from_dict(dicts[1])).merge(
+        QuantileSketch.from_dict(dicts[2]))
+    assert folded._buckets == chained._buckets
+    assert (folded.n, folded.min, folded.max) \
+        == (chained.n, chained.min, chained.max)
+    assert QuantileSketch.merge_all([]).n == 0
+
+
+def test_fleet_planner_merges_derives_journals_and_restores(tmp_path):
+    jp = str(tmp_path / "j.jsonl")
+    with AdmissionJournal(jp) as j:
+        fp = FleetPlanner(j, epoch=4)
+        fp.note_host_sketch("h0", _sketch_of([120] * 4).to_dict())
+        edges1 = fp.poll()
+        assert edges1 and fp.edges == edges1
+        assert edges1 == derive_edges(_sketch_of([120] * 4), n_buckets=4)
+        # below the next epoch: no re-derivation
+        fp.note_host_sketch("h1", _sketch_of([480]).to_dict())
+        assert fp.poll() is None
+        fp.note_host_sketch("h1", _sketch_of([480] * 4).to_dict())
+        edges2 = fp.poll()
+        assert edges2 and 480 in edges2
+        assert fp.summary()["hosts_sketching"] == ["h0", "h1"]
+    # the journaled fleet epochs restore: a restarted coordinator
+    # rebroadcasts the killed run's edges before any new telemetry
+    with AdmissionJournal(jp) as j2:
+        fp2 = FleetPlanner(j2, epoch=4)
+        assert fp2.edges == edges2
+        assert fp2.merged().n == 8
+    assert validate_journal_file(jp) == []
+
+
+# -- deterministic fake-worker drills --------------------------------------
+
+
+class _FakeWorker:
+    """The test plays one worker host: beats the lease, consumes the
+    assignment feed, appends admit/finish/drop-ack/planner records to
+    the event WAL — everything journal/file-driven, nothing timed, so
+    the coordinator's join/rebalance/broadcast machinery is exercised
+    deterministically in-process."""
+
+    def __init__(self, fabric_dir, host_id):
+        self.host_id = host_id
+        self.paths = fabric_paths(fabric_dir, host_id)
+        self.feed = JsonlTail(self.paths["assign"])
+        self.queued: list = []
+        self.admitted: list = []
+        self.finished: list = []
+        self.edges: list = []
+        self.dead = False
+        self._rc = None
+        self.beat()
+
+    # Popen-shaped surface the coordinator drives
+    @property
+    def pid(self):
+        return os.getpid()
+
+    def poll(self):
+        return self._rc
+
+    def kill(self):
+        self._rc = -9
+        self.dead = True
+
+    def wait(self, timeout=None):
+        return self._rc
+
+    def beat(self):
+        if self.dead:
+            return
+        tmp = self.paths["lease"] + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(json.dumps(
+                {"host": self.host_id, "pid": os.getpid(),
+                 "t": time.time()}).encode())
+        os.replace(tmp, self.paths["lease"])
+
+    def _event(self, rec):
+        with open(self.paths["events"], "ab") as f:
+            f.write((json.dumps(rec) + "\n").encode())
+
+    def pump(self):
+        """One worker round: drain the feed, ack drops, admit nothing
+        (the test script decides when to admit/finish)."""
+        if self.dead:
+            return
+        self.beat()
+        for rec, _off in self.feed.poll():
+            if rec.get("close"):
+                self._rc = 0
+                continue
+            if isinstance(rec.get("edges"), list):
+                self.edges.append(tuple(rec["edges"]))
+                continue
+            if rec.get("drop") is not None:
+                uid = str(rec["drop"])
+                ok = uid in self.queued
+                if ok:
+                    self.queued.remove(uid)
+                self._event({"event": "drop", "user": uid, "ok": ok})
+                continue
+            if rec.get("user") is not None:
+                self.queued.append(str(rec["user"]))
+
+    def admit(self, uid):
+        self.queued.remove(uid)
+        self.admitted.append(uid)
+        self._event({"event": "admit", "user": uid})
+
+    def finish(self, uid):
+        self.admitted.remove(uid)
+        self.finished.append(uid)
+        self._event({"event": "finish", "user": uid})
+
+    def journal_sketch(self, pools):
+        self._event({"event": "planner", "edges": [],
+                     "sketch": _sketch_of(pools).to_dict()})
+
+
+def _fake_fleet(tmp_path, config, users, pools, script):
+    """Run a coordinator over fake workers; ``script(round, coord,
+    workers)`` drives the scenario each poll and returns True to keep
+    going."""
+    fabric_dir = str(tmp_path / "fabric")
+    os.makedirs(fabric_dir, exist_ok=True)
+    journal = AdmissionJournal(
+        os.path.join(fabric_dir, "serve_journal.jsonl"))
+    workers: dict = {}
+
+    def spawn(host_id):
+        workers[host_id] = _FakeWorker(fabric_dir, host_id)
+        return workers[host_id]
+
+    state = {"round": 0}
+
+    def on_poll(coord):
+        state["round"] += 1
+        if state["round"] > 2000:
+            raise AssertionError("fake drill wedged: "
+                                 f"unresolved={sorted(coord._unresolved)}")
+        for w in list(workers.values()):
+            w.pump()
+        script(state["round"], coord, workers)
+
+    coord = FabricCoordinator(journal, fabric_dir, config, on_poll=on_poll)
+    try:
+        summary = coord.run(users, spawn, pools=pools)
+    finally:
+        journal.close()
+    return summary, coord, workers, fabric_dir
+
+
+def test_elastic_join_rebalance_and_fleet_edges(tmp_path):
+    """The deterministic JOIN drill: a backlogged 1-host elastic fabric
+    scales up, the joiner is journaled (spawn + join), queued users
+    migrate onto it through the drop-ack protocol (never the admitted
+    one), and the fleet planner's merged edges broadcast identically to
+    every host."""
+    users = [f"u{i}" for i in range(6)]
+    pools = {u: (30 if i % 2 == 0 else 100) for i, u in enumerate(users)}
+    # drain_timeout_s is tiny because nothing pumps the fakes once the
+    # run loop exits — the close-path SIGKILL is cosmetic (PR 5 contract)
+    cfg = FabricConfig(hosts=1, min_hosts=1, max_hosts=2,
+                       scale_backlog=2, poll_s=0.01, lease_s=5.0,
+                       planner_epoch=4, drain_timeout_s=0.2)
+
+    def script(rnd, coord, workers):
+        h0 = workers.get("h0")
+        if rnd == 2 and h0 and not h0.admitted and h0.queued:
+            h0.admit(h0.queued[0])  # one in-flight: must never migrate
+        if rnd == 4 and h0:
+            # per-host sketches -> the fleet planner derives + broadcasts
+            h0.journal_sketch([pools[u] for u in users])
+        if rnd > 6:
+            for w in workers.values():
+                for uid in list(w.admitted):
+                    w.finish(uid)
+                for uid in list(w.queued):
+                    w.admit(uid)
+
+    summary, coord, workers, fabric_dir = _fake_fleet(
+        tmp_path, cfg, users, pools, script)
+    assert sorted(summary["finished"]) == users
+    assert summary["spawns"] >= 1 and summary["joins"] >= 1
+    assert summary["migrations"] >= 1
+    assert set(workers) == {"h0", "h1"}
+    # the drop-ack protocol: every migrated user ran on exactly one host
+    ran = [u for w in workers.values() for u in w.finished]
+    assert sorted(ran) == users
+    # fleet edges broadcast identically to every live host
+    fp = summary["fleet_planner"]
+    assert fp["edges"]
+    for w in workers.values():
+        if w.edges:
+            assert w.edges[-1] == tuple(fp["edges"])
+    # the journal replays the grown fleet shape + the pools
+    st = AdmissionJournal(
+        os.path.join(fabric_dir, "serve_journal.jsonl")).state
+    assert st.fleet_hosts() == ["h0", "h1"]
+    assert st.pools == pools
+    assert validate_journal_file(
+        os.path.join(fabric_dir, "serve_journal.jsonl")) == []
+
+
+def test_elastic_coordinator_kill_mid_rebalance_replays(tmp_path):
+    """Coordinator SIGKILL mid-rebalance (drop requested, ack not yet
+    transcribed) replays to the same assignments: the rerun re-derives
+    placement from the journal alone, every user finishes exactly once,
+    and two further replays of the final journal agree on every
+    assignment."""
+    users = [f"u{i}" for i in range(6)]
+    pools = {u: 30 for u in users}
+    cfg = FabricConfig(hosts=1, min_hosts=1, max_hosts=2,
+                       scale_backlog=2, poll_s=0.01,
+                       drain_timeout_s=0.2)
+    jp = str(tmp_path / "fabric" / "serve_journal.jsonl")
+
+    def script1(rnd, coord, workers):
+        # the moment migrate requests are pending, die — the acks are
+        # stranded in h0's feed/WAL, the journal still says "assigned h0"
+        if coord._migrating:
+            raise InjectedKill("coordinator SIGKILL mid-rebalance")
+
+    with pytest.raises(InjectedKill):
+        _fake_fleet(tmp_path, cfg, users, pools, script1)
+    st_mid = AdmissionJournal(jp).state
+    assert st_mid.fleet_hosts() == ["h0", "h1"]  # shape already journaled
+    assigned_mid = dict(st_mid.assigned)
+    assert assigned_mid  # routing decisions survived the kill
+
+    def script2(rnd, coord, workers):
+        if rnd > 4:
+            for w in workers.values():
+                for uid in list(w.admitted):
+                    w.finish(uid)
+                for uid in list(w.queued):
+                    w.admit(uid)
+
+    summary, coord, workers, _ = _fake_fleet(
+        tmp_path, cfg, users, pools, script2)
+    assert sorted(summary["finished"]) == users
+    # the rerun replayed the SAME fleet shape (h1 respawned from its
+    # journaled spawn record, not re-decided)
+    assert set(workers) == {"h0", "h1"}
+    ran = [u for w in workers.values() for u in w.finished]
+    assert sorted(ran) == users  # exactly-once, no double-run
+    # replay determinism: two independent replays agree on assignments
+    a1 = AdmissionJournal(jp).state.assigned
+    a2 = AdmissionJournal(jp).state.assigned
+    assert a1 == a2
+
+
+def test_elastic_stillborn_spawns_raise_instead_of_fork_storming(
+        tmp_path):
+    """The crash-loop guard: workers that die before their first
+    heartbeat must not be respawned at poll rate forever — after 3
+    consecutive stillborn spawns the coordinator raises FabricError
+    (all state durable; the non-elastic fabric's safety semantics)."""
+    fabric_dir = str(tmp_path / "fabric")
+    os.makedirs(fabric_dir)
+    journal = AdmissionJournal(
+        os.path.join(fabric_dir, "serve_journal.jsonl"))
+    spawned = []
+
+    class _Stillborn:
+        pid = None
+
+        def poll(self):
+            return 1  # exits instantly, never heartbeats
+
+        def kill(self):
+            pass
+
+        def wait(self, timeout=None):
+            return 1
+
+    def spawn(host_id):
+        spawned.append(host_id)
+        return _Stillborn()
+
+    coord = FabricCoordinator(
+        journal, fabric_dir,
+        FabricConfig(hosts=1, min_hosts=1, max_hosts=2, poll_s=0.01,
+                     drain_timeout_s=0.1))
+    with pytest.raises(Exception, match="first heartbeat"):
+        coord.run(["u0"], spawn)
+    journal.close()
+    # bounded respawns (initial + guarded replacements), not poll-rate
+    assert 1 <= len(spawned) <= 6
+
+
+def test_elastic_operator_adoption_unit(tmp_path):
+    """An operator-added worker announces via the lease directory: a
+    fresh lease for an unknown host id is adopted (spawn reason
+    'operator' + lease journaled, pid-only handle), a stale one is
+    ignored."""
+    fabric_dir = str(tmp_path / "fabric")
+    os.makedirs(fabric_dir)
+    journal = AdmissionJournal(
+        os.path.join(fabric_dir, "serve_journal.jsonl"))
+    cfg = FabricConfig(hosts=1, min_hosts=1, max_hosts=3, poll_s=0.01)
+    coord = FabricCoordinator(journal, fabric_dir, cfg)
+    volunteer = subprocess.Popen([sys.executable, "-c",
+                                  "import time; time.sleep(60)"])
+    try:
+        for hid, pid, fresh in (("h7", volunteer.pid, True),
+                                ("h8", volunteer.pid, False)):
+            lease = fabric_paths(fabric_dir, hid)["lease"]
+            t = time.time() - (0.0 if fresh else 3600.0)
+            with open(lease, "wb") as f:
+                f.write(json.dumps({"host": hid, "pid": pid,
+                                    "t": t}).encode())
+        coord._adopt_operator_hosts()
+        assert "h7" in coord.hosts and "h8" not in coord.hosts
+        assert coord.hosts["h7"].proc.poll() is None  # pid supervised
+        st = journal.state
+        assert st.hosts["h7"] == "lease"
+        assert coord.spawns == 1
+    finally:
+        volunteer.kill()
+        volunteer.wait()
+        journal.close()
+    st = AdmissionJournal(
+        os.path.join(fabric_dir, "serve_journal.jsonl")).state
+    assert "h7" in st.fleet_hosts()
+
+
+# -- the real-subprocess respawn drill (the acceptance pin) ----------------
+
+
+def _spawn_factory(fabric_dir, ws_root, cfg, specs, *, lease_s=5.0,
+                   target=2):
+    def spawn(host_id):
+        log = open(fabric_paths(fabric_dir, host_id)["log"], "ab")
+        env = {**os.environ, "PYTHONPATH": REPO}
+        env.pop("CETPU_FAULTS", None)
+        try:
+            return subprocess.Popen(
+                [sys.executable, WORKER, fabric_dir, host_id, ws_root,
+                 cfg.mode, str(cfg.epochs), str(len(specs)),
+                 str(lease_s), str(target), sizes_arg(specs)],
+                stdout=log, stderr=subprocess.STDOUT, env=env)
+        finally:
+            log.close()
+    return spawn
+
+
+def _kill_on_first_admit(host_id="h0"):
+    state = {"done": False}
+
+    def chaos(coord):
+        if state["done"]:
+            return
+        st = coord.journal.state
+        if any(h == host_id and st.last.get(u) == "admit"
+               for u, h in st.assigned.items()):
+            coord.hosts[host_id].proc.kill()
+            state["done"] = True
+    return chaos
+
+
+def _deadline(inner, deadline_s=300.0):
+    t0 = time.monotonic()
+
+    def hook(coord):
+        if time.monotonic() - t0 > deadline_s:
+            raise AssertionError(
+                f"elastic drill exceeded {deadline_s}s; "
+                f"unresolved={sorted(coord._unresolved)}")
+        inner(coord)
+    return hook
+
+
+def _elastic_kill_drill(tmp_path, mode, *, n_users=4, epochs=2):
+    """SIGKILL one worker of a 2-host ELASTIC fabric mid-run: the
+    autoscaler must respawn a replacement (fresh id, lease re-granted,
+    spawn journaled), every user must finish bit-identical to
+    uninterrupted sequential runs, and the journal must replay the grown
+    fleet shape."""
+    cfg = make_cfg(mode, epochs=epochs)
+    specs = user_specs(n_users, sizes=[30, 100])
+    seq = sequential_baselines(str(tmp_path), cfg, specs)
+    fabric_dir = str(tmp_path / "fabric")
+    os.makedirs(fabric_dir)
+    jp = os.path.join(fabric_dir, "serve_journal.jsonl")
+    journal = AdmissionJournal(jp)
+    coord = FabricCoordinator(
+        journal, fabric_dir,
+        FabricConfig(hosts=2, min_hosts=2, max_hosts=3, lease_s=5.0),
+        on_poll=_deadline(_kill_on_first_admit("h0")))
+    try:
+        summary = coord.run(
+            [u for _, u, _ in specs],
+            _spawn_factory(fabric_dir, str(tmp_path), cfg, specs),
+            pools={u: n for _, u, n in specs})
+    finally:
+        journal.close()
+    assert sorted(summary["finished"]) == sorted(u for _, u, _ in specs)
+    assert summary["failed"] == [] and summary["poisoned"] == []
+    assert summary["revocations"] == 1
+    # THE elastic pin: dead capacity was REPLACED, not folded onto the
+    # survivor forever — h2 spawned the moment h0 was revoked
+    assert summary["spawns"] >= 1
+    assert "h2" in summary["hosts"]
+    assert summary["hosts"]["h0"] == "revoked"
+    results = read_results(fabric_dir)
+    for _, uid, _ in specs:
+        assert results[uid]["error"] is None
+        assert results[uid]["result"]["trajectory"] \
+            == seq[uid]["trajectory"]
+        assert results[uid]["result"]["final_mean_f1"] \
+            == seq[uid]["final_mean_f1"]
+    st = AdmissionJournal(jp).state
+    assert st.finished == {u for _, u, _ in specs} and not st.pending
+    assert st.hosts["h0"] == "revoke"
+    assert set(st.fleet_hosts()) >= {"h1", "h2"}
+    assert validate_journal_file(jp) == []
+    return summary
+
+
+def test_elastic_worker_sigkill_respawns_and_recovers(tmp_path):
+    """Tier-1 acceptance: worker SIGKILL → autoscaler respawn → all
+    users recovered bit-identical, fleet shape replayable."""
+    _elastic_kill_drill(tmp_path, "mc")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["hc", "wmc"])
+def test_elastic_kill_matrix_other_modes(tmp_path, mode):
+    """The respawn recovery is mode-independent (mc is tier-1 above):
+    the registry modes ride the same journal machinery."""
+    _elastic_kill_drill(tmp_path, mode)
