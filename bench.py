@@ -2567,6 +2567,208 @@ def run_elastic_suite(args_ns) -> int:
     return 0
 
 
+def run_drain_suite(args_ns) -> int:
+    """Graceful scale-down: checkpoint-FENCED in-flight migration vs
+    drain-by-waiting, raced on recovered-users/s and drain latency.
+
+    Both arms run the SAME drill per rep: a 3-host elastic fabric over
+    slow workers (a ``pool.score:delay`` rule stretches every iteration
+    — values untouched, so parity still binds), with the low-water
+    timer FORCED the moment every host holds an in-flight user, so one
+    surplus host drains mid-run.  The arms differ only in
+    ``FabricConfig.migrate_inflight``:
+
+    - ``fence``: the draining host's in-flight users checkpoint at
+      their next iteration boundary and MIGRATE (journaled fence ack →
+      committed re-assign) — the host retires as soon as the hand-offs
+      land;
+    - ``wait``: in-flight users simply FINISH on the draining host (the
+      PR 13-shaped baseline: only queued users can move), so retirement
+      waits out the slowest session.
+
+    Parity vs unfaulted sequential baselines is asserted on EVERY rep
+    of BOTH arms; the fence arm must fence >= 1 user, the wait arm
+    exactly 0.  ``drain_latency_s`` is the journal-derived
+    ``drain`` → ``drain_done`` wall delta (the time the fleet carries
+    the surplus host after deciding to shed it)."""
+    import json as json_mod
+    import os
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.fabric_workload import (
+        force_low_water as _flw,
+        make_cfg,
+        read_results,
+        sequential_baselines,
+        sizes_arg,
+        user_specs,
+    )
+
+    from consensus_entropy_tpu.serve import (
+        AdmissionJournal,
+        FabricConfig,
+        FabricCoordinator,
+        validate_journal_file,
+    )
+    from consensus_entropy_tpu.serve.hosts import fabric_paths
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "tests", "fabric_worker.py")
+    n_users, hosts = args_ns.users, max(args_ns.hosts, 3)
+    epochs = args_ns.al_epochs
+    cfg = make_cfg("mc", epochs=epochs)
+    specs = user_specs(n_users, sizes=[30, 100])
+    target_live = max(2, n_users // hosts)
+
+    _log(f"drain workload: {n_users} users x {epochs} AL iterations, "
+         f"{hosts} hosts scaling down to {hosts - 1} (forced low-water "
+         f"mark once every host is mid-run; workers slowed by a "
+         f"pool.score delay rule); arms: checkpoint-fenced in-flight "
+         f"migration vs drain-by-waiting")
+
+    def force_low_water(coord):
+        _flw(coord, hosts=hosts)
+
+    def drain_stamps(jp):
+        """``(t_drain, t_drain_done, t_last)`` wall stamps from the
+        journal (a missing ``drain_done`` — the run ended while the
+        drain still waited — degrades the latency to the run-end FLOOR
+        ``t_last - t_drain``, flagged by ``drain_done=False``)."""
+        t0 = t1 = tl = None
+        with open(jp, "rb") as f:
+            for raw in f:
+                try:
+                    rec = json_mod.loads(raw.decode("utf-8"))
+                except ValueError:
+                    continue
+                if isinstance(rec.get("t"), (int, float)):
+                    tl = rec["t"]
+                if rec.get("event") == "drain" and t0 is None:
+                    t0 = rec.get("t")
+                elif rec.get("event") == "drain_done" and t1 is None:
+                    t1 = rec.get("t")
+        return t0, t1, tl
+
+    def run_arm(ws, arm):
+        arm_ws = _mkdir(ws, f"ws_{arm}")
+        fabric_dir = _mkdir(ws, f"fabric_{arm}")
+        jp = os.path.join(fabric_dir, "serve_journal.jsonl")
+        journal = AdmissionJournal(jp)
+
+        def spawn(host_id):
+            log = open(fabric_paths(fabric_dir, host_id)["log"], "ab")
+            try:
+                return subprocess.Popen(
+                    [sys.executable, worker, fabric_dir, host_id,
+                     arm_ws, cfg.mode, str(cfg.epochs), str(n_users),
+                     "5.0", str(target_live), sizes_arg(specs)],
+                    stdout=log, stderr=subprocess.STDOUT,
+                    env={**os.environ, "PYTHONPATH": repo,
+                         "CETPU_FAULTS": "pool.score:delay=0.3@1x-1"})
+            finally:
+                log.close()
+
+        coord = FabricCoordinator(
+            journal, fabric_dir,
+            FabricConfig(hosts=hosts, min_hosts=hosts - 1,
+                         max_hosts=hosts, scale_down_s=600.0,
+                         migrate_inflight=(arm == "fence")),
+            on_poll=force_low_water)
+        t0 = time.perf_counter()
+        summary = coord.run([u for _, u, _ in specs], spawn,
+                            pools={u: n for _, u, n in specs})
+        wall = time.perf_counter() - t0
+        journal.close()
+        assert validate_journal_file(jp) == [], \
+            f"journal schema violations in the {arm} arm"
+        td, tdd, tl = drain_stamps(jp)
+        done = tdd is not None
+        latency = (round(tdd - td, 3) if done
+                   else round(tl - td, 3) if td and tl else None)
+        return {"summary": summary, "wall_s": wall,
+                "drain_latency_s": latency, "drain_done": done,
+                "fabric_dir": fabric_dir}
+
+    root = tempfile.mkdtemp(prefix="drain_bench_")
+    best = {"fence": None, "wait": None}
+    lat_best = {"fence": None, "wait": None}
+    try:
+        for rep in range(args_ns.reps):
+            ws = _mkdir(root, f"rep{rep}")
+            seq = sequential_baselines(ws, cfg, specs)
+            for arm in ("fence", "wait"):
+                out = run_arm(ws, arm)
+                summary = out["summary"]
+                results = read_results(out["fabric_dir"])
+                parity = (sorted(summary["finished"])
+                          == sorted(u for _, u, _ in specs)
+                          and all(results[u]["error"] is None
+                                  and results[u]["result"]["trajectory"]
+                                  == seq[u]["trajectory"]
+                                  for _, u, _ in specs))
+                ups = len(summary["finished"]) / out["wall_s"]
+                _log(f"[rep {rep}] {arm:>5}: "
+                     f"{len(summary['finished'])}/{n_users} users in "
+                     f"{out['wall_s']:.1f}s ({ups:.3f} u/s, "
+                     f"drain_latency={out['drain_latency_s']}s"
+                     f"{'' if out['drain_done'] else ' (floor)'}, "
+                     f"fences={summary['fences']}, parity={parity})")
+                ok_fences = (summary["fences"] >= 1 if arm == "fence"
+                             else summary["fences"] == 0)
+                if not (parity and summary["drains"] >= 1 and ok_fences
+                        and summary["revocations"] == 0):
+                    raise AssertionError(
+                        f"drain {arm} rep {rep} lost parity or never "
+                        f"exercised the drain: {summary}")
+                rec = {"users_per_sec": ups,
+                       "wall_s": round(out["wall_s"], 3),
+                       "drain_latency_s": out["drain_latency_s"],
+                       "drain_done": out["drain_done"],
+                       **{k: summary[k] for k in
+                          ("drains", "fences", "migrations")}}
+                prev = best[arm]
+                if prev is None or ups > prev["users_per_sec"]:
+                    best[arm] = rec
+                # the drain-latency pin is best-of SEPARATELY: the
+                # fastest retirement each arm achieved (a completed
+                # retirement beats any run-end floor)
+                def _lat_key(r):
+                    return (r["drain_done"],
+                            -(r["drain_latency_s"] or 1e9))
+                if lat_best[arm] is None \
+                        or _lat_key(rec) > _lat_key(lat_best[arm]):
+                    lat_best[arm] = rec
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    f, w = best["fence"], best["wait"]
+    lf, lw = lat_best["fence"], lat_best["wait"]
+    lat_ratio = (round(lw["drain_latency_s"] / lf["drain_latency_s"], 2)
+                 if lf["drain_latency_s"] and lw["drain_latency_s"]
+                 else None)
+    print(json.dumps({
+        "metric": f"drain_latency_s_{n_users}u_{hosts}h_to_"
+                  f"{hosts - 1}h",
+        "value": lf["drain_latency_s"],
+        "unit": "s",
+        "vs_baseline": lat_ratio,
+        "drain_latency_s_fence": lf["drain_latency_s"],
+        "drain_done_fence": lf["drain_done"],
+        "drain_latency_s_wait": lw["drain_latency_s"],
+        "drain_done_wait": lw["drain_done"],
+        "users_per_sec_fence": round(f["users_per_sec"], 4),
+        "users_per_sec_wait": round(w["users_per_sec"], 4),
+        "fences": lf["fences"], "migrations": lf["migrations"],
+        "parity_with_sequential": True,
+        **_provenance(),
+    }))
+    return 0
+
+
 def _mkdir(root, name):
     import os
 
@@ -2580,7 +2782,7 @@ def main(argv=None) -> int:
     ap.add_argument("--suite", choices=("linear", "cnn", "retrain", "fleet",
                                         "serve", "serve-fused", "slo",
                                         "serve-faults", "fabric", "elastic",
-                                        "qbdc", "cnn-fleet", "obs"),
+                                        "drain", "qbdc", "cnn-fleet", "obs"),
                     default="linear",
                     help="linear: the north-star fused pool scoring; cnn: "
                          "Flax ShortChunkCNN committee inference "
@@ -2613,7 +2815,13 @@ def main(argv=None) -> int:
                          "least-loaded placement raced on per-host "
                          "stacked-dispatch occupancy, merged planner "
                          "edges asserted identical across hosts, parity "
-                         "asserted every rep of both arms; qbdc: "
+                         "asserted every rep of both arms; "
+                         "drain: graceful scale-down — checkpoint-"
+                         "fenced in-flight migration vs drain-by-"
+                         "waiting on a 3-host fabric shedding one slow "
+                         "host, recovered-users/sec + journal-derived "
+                         "drain latency, parity asserted every rep of "
+                         "both arms; qbdc: "
                          "dropout-committee scoring (K-sweep) + users/sec "
                          "+ per-user memory vs the stored-committee mc "
                          "path; cnn-fleet: users/sec + mean_device_batch "
@@ -2709,6 +2917,9 @@ def main(argv=None) -> int:
         # elastic control plane: kill + autoscaler respawn, placement
         # arms raced on per-host dispatch occupancy
         return run_elastic_suite(args_ns)
+    if args_ns.suite == "drain":
+        # graceful scale-down: fenced migration vs drain-by-waiting
+        return run_drain_suite(args_ns)
     if args_ns.suite == "qbdc":
         # dropout committee vs stored committee; --pool is songs per user,
         # --members the stored-committee size (default 20, the paper's)

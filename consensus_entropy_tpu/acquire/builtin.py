@@ -84,9 +84,12 @@ class MixedConsensus(AcquisitionStrategy):
         from consensus_entropy_tpu.ops import scoring
 
         is_hc, slots = scoring.split_mix_index(res.indices, acq.n_pad)
-        valid = np.asarray(res.values) > -np.inf
+        # the mix arm's 2·k pull in its sanctioned hot-path spelling
+        # (whitelisted by cetpu-lint's implicit-host-sync rule)
+        valid = scoring.selection_scalars(res.values) > -np.inf
         raw = [acq.songs[int(s)]
-               for s, ok in zip(np.asarray(slots), valid) if ok]
+               for s, ok in zip(scoring.selection_scalars(slots), valid)
+               if ok]
         # the same song can surface from both blocks; the reference's
         # isin-based batch build dedups implicitly (amg_test.py:491)
         q_songs = list(dict.fromkeys(raw))

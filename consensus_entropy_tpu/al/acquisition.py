@@ -463,8 +463,10 @@ class Acquirer:
                     self.hc_mask[self._song_row[s]] = False
 
     def _ids(self, res: scoring.ScoreResult) -> list:
-        idx = np.asarray(res.indices)
-        valid = np.asarray(res.values) > -np.inf
+        # the intentional 2·k pull, in its sanctioned hot-path spelling
+        # (whitelisted by cetpu-lint's implicit-host-sync rule)
+        idx = scoring.selection_scalars(res.indices)
+        valid = scoring.selection_scalars(res.values) > -np.inf
         return [self.songs[int(i)] for i, ok in zip(idx, valid) if ok]
 
     def _remove_hc(self, q_songs):

@@ -41,14 +41,35 @@ REPLAY_FILES = (
 )
 
 #: dispatch hot paths: file -> function names whose whole subtree
-#: (nested closures included) must not host-sync implicitly
+#: (nested closures included) must not host-sync implicitly.  Beyond
+#: the scheduler's dispatch core this now covers the serve loop's
+#: per-round admission/collection paths and the acquirer's staging +
+#: select-finish path (cetpu-lint follow-on (c)) — made possible by
+#: the one sanctioned pull below.
 HOT_PATH_FUNCS = {
     PKG + "fleet/scheduler.py": {
         "pump", "_dispatch_scores", "_stacked_call", "_plan_call",
         "_single_call", "_result_rows", "_hold_partial_plans",
         "_h2d", "_stack", "_sig",
     },
+    PKG + "serve/server.py": {
+        "serve", "_refill", "_admit_up_to_target", "_collect",
+        "_admit_due_requeues", "_apply_fences",
+    },
+    PKG + "al/acquisition.py": {
+        "finish_select", "_ids", "scoring_inputs", "run_scoring",
+        "take_h2d", "device_masks",
+    },
+    PKG + "acquire/builtin.py": {"extract_queries", "fused_inputs",
+                                 "scoring_inputs"},
 }
+
+#: the ONE sanctioned hot-path device→host pull: the 2·k selection
+#: scalars ``finish_select`` maps back to song ids each iteration
+#: (``ops.scoring.selection_scalars``).  Spelled through a named helper
+#: so the rule can whitelist the INTENT, not a line — any other
+#: ``np.asarray``/``float()`` in a hot-path function stays a finding.
+_SANCTIONED_PULLS = {"selection_scalars"}
 
 #: wall-clock reads replay can never reproduce.  ``time.perf_counter``
 #: is deliberately ABSENT: it is the stack's sanctioned duration-
@@ -725,6 +746,9 @@ def check_implicit_host_sync(tree, ctx):
             if not isinstance(sub, ast.Call):
                 continue
             name = _dotted(sub.func)
+            if name is not None \
+                    and name.split(".")[-1] in _SANCTIONED_PULLS:
+                continue  # the one sanctioned selection-scalar pull
             msg = None
             if name in ("float", "bool") and len(sub.args) == 1:
                 msg = (f"{name}() forces a blocking device→host sync "

@@ -215,6 +215,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "fleet up to N workers, one journaled spawn at "
                         "a time (default: --hosts when --min-hosts is "
                         "given)")
+    p.add_argument("--scale-down-s", type=float, default=0.0, metavar="S",
+                   help="elastic fabric: graceful SCALE-DOWN — once the "
+                        "autoscaler's scale-up signals stay quiet at one "
+                        "host fewer for S continuous seconds and the "
+                        "fleet sits above --min-hosts, one surplus host "
+                        "drains: the decision is journaled, queued users "
+                        "rebalance away, in-flight users finish or "
+                        "migrate via a checkpoint-fenced workspace "
+                        "hand-off, and the host retires clean "
+                        "(drain_done journaled; replay-identical after "
+                        "a coordinator SIGKILL at any boundary).  "
+                        "Requires --min-hosts/--max-hosts (default: "
+                        "0 = never scale down)")
     p.add_argument("--placement", choices=("bucket", "load"),
                    default="bucket",
                    help="fabric: cross-host routing policy — 'bucket' "
@@ -369,7 +382,8 @@ def main(argv=None) -> int:
                          ("--hosts", args.hosts is not None),
                          ("--lease-s", args.lease_s != 5.0),
                          ("--min-hosts", args.min_hosts is not None),
-                         ("--max-hosts", args.max_hosts is not None)):
+                         ("--max-hosts", args.max_hosts is not None),
+                         ("--scale-down-s", args.scale_down_s != 0.0)):
         if is_set and args.serve is None:
             print(f"{flag} requires --serve")
             return 1
@@ -408,6 +422,7 @@ def main(argv=None) -> int:
             args._fabric_config = FabricConfig(
                 hosts=args.hosts, lease_s=args.lease_s,
                 min_hosts=args.min_hosts, max_hosts=args.max_hosts,
+                scale_down_s=args.scale_down_s,
                 placement=args.placement,
                 # the fleet planner must not fight explicit operator
                 # edges or a disabled local planner
@@ -416,9 +431,10 @@ def main(argv=None) -> int:
         except ValueError as e:
             print(f"invalid fabric config: {e}")
             return 1
-    elif args.min_hosts is not None or args.max_hosts is not None:
-        print("--min-hosts/--max-hosts require --hosts (the elastic "
-              "fabric scales a multi-host fleet)")
+    elif args.min_hosts is not None or args.max_hosts is not None \
+            or args.scale_down_s:
+        print("--min-hosts/--max-hosts/--scale-down-s require --hosts "
+              "(the elastic fabric scales a multi-host fleet)")
         return 1
     if args.fabric_worker is not None and (args.fabric_dir is None
                                            or args.serve is None):
@@ -958,7 +974,7 @@ def _run_users_fabric(args, cfg, paths, users, pool, anno, guard) -> None:
     worker_argv = []
     skip_next = False
     coordinator_flags = ("--hosts", "--min-hosts", "--max-hosts",
-                         "--placement")
+                         "--placement", "--scale-down-s")
     for arg in args._raw_argv:
         if skip_next:
             skip_next = False

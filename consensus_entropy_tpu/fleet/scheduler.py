@@ -110,6 +110,14 @@ class _SessionState:
     n_pad: int = 0
     started: bool = False
     resumes: int = 0
+    #: fence-marked for RELEASE (in-flight migration): the session leaves
+    #: the engine at its next completed checkpoint boundary — no result,
+    #: no failure, the driver re-places the user elsewhere
+    release: bool = False
+    #: label of the most recently COMPLETED pooled host step (cleared on
+    #: every other resume path) — ``"checkpoint"`` here is the release
+    #: point: the iteration boundary just committed
+    last_label: str | None = None
 
 
 class FleetScheduler:
@@ -278,6 +286,9 @@ class FleetScheduler:
         #: run to completion against discarded session objects; we keep
         #: the handles so close() knows not to block on a truly-hung one
         self._abandoned: list = []
+        #: uid -> checkpoint generation of sessions released at their
+        #: boundary since the driver last drained take_released()
+        self._released: dict = {}
         self._opened = True
 
     def admit(self, entry: FleetUser, *, pad: int | None = None
@@ -300,6 +311,14 @@ class FleetScheduler:
         self._reap_hung_hosts()
         while self._ready:
             state, value, exc = self._ready.popleft()
+            if (state.release and exc is None
+                    and state.last_label == "checkpoint"):
+                # the fence point: the iteration-boundary checkpoint
+                # this session just completed is the migration's resume
+                # unit — release instead of starting the next iteration
+                self._release(state)
+                continue
+            state.last_label = None
             self._live[state] = None
             self._track(state, self._advance(state, value, exc))
         if self._score_wait:
@@ -488,6 +507,9 @@ class FleetScheduler:
         note = getattr(self.hold, "note_host_step", None)
         for fut in done:
             state, _step = self._host_wait.pop(fut)
+            # pump's release check reads this: a completed "checkpoint"
+            # step means the session sits at an iteration boundary
+            state.last_label = getattr(_step, "label", None)
             t0 = self._host_t0.pop(fut, None)
             if note is not None and t0 is not None:
                 note(time.monotonic() - t0)  # cetpu: noqa[replay-wallclock] hold-sizing telemetry; holds change when work batches, never results
@@ -544,6 +566,52 @@ class FleetScheduler:
             "user": state.entry.user_id, "result": result,
             "committee": state.session.committee,
             "resumes": state.resumes, "error": None}
+
+    def request_release(self, user_id) -> bool:
+        """Fence-mark one live session for RELEASE at its next completed
+        checkpoint boundary (the in-flight-migration seam): the moment
+        its iteration-boundary checkpoint lands, the generator is closed
+        — joining the staged commit, so the workspace durably holds the
+        new generation — and the user leaves the engine with no result
+        and no failure; the driver re-places it elsewhere, where resume
+        replays the fenced workspace bit-identically (the same contract
+        failover already pins).  Returns False when no live session
+        matches (finished or evicted first — the caller must refuse the
+        fence).  Serve-loop thread only, like every engine method."""
+        uid = str(user_id)
+        for st in list(self._live) + [s for s, _, _ in self._ready]:
+            if str(st.entry.user_id) == uid:
+                st.release = True
+                return True
+        return False
+
+    def take_released(self) -> dict:
+        """``{user_id: checkpoint_generation}`` for sessions released at
+        their boundary since the last call (generation ``None`` when the
+        session never committed one — the target then starts the user
+        from its unstarted workspace, still bit-identical)."""
+        out, self._released = self._released, {}
+        return out
+
+    def _release(self, state: _SessionState) -> None:
+        """Close a fence-marked session at its just-committed checkpoint
+        boundary.  The generator close runs the session's own exit path
+        (checkpointer joined — the boundary's two-phase commit is
+        durable before we report the release), the slot frees for the
+        next admission, and the user surfaces through
+        :meth:`take_released` with the generation the migration fence
+        carries.  Sessions that never pool a checkpoint step (inline
+        boundaries) simply never hit this point and finish where they
+        are — drain-by-waiting, the safe degradation."""
+        self._live.pop(state, None)
+        try:
+            state.gen.close()
+        except Exception:
+            pass
+        uid = str(state.entry.user_id)
+        self._released[uid] = state.session.ckpt_epoch
+        self.report.event("fence_release", user=uid,
+                          gen=state.session.ckpt_epoch)
 
     def _evict(self, state: _SessionState, exc: Exception) -> None:
         """Tear one faulted session down and (when possible) resume the

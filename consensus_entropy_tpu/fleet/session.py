@@ -253,6 +253,13 @@ class UserSession:
         self.ckpt = AsyncCheckpointer(executor=ckpt_executor)
         #: last finished background job's self-timed durations (fetch/write)
         self.bg_times: dict = {}
+        #: the checkpoint GENERATION this session last staged (resume
+        #: state's next_epoch; the in-flight-migration fence reports it
+        #: after the generator close joins the commit).  A resumed
+        #: session starts at its workspace's generation; a fresh one has
+        #: none until its baseline boundary commits generation 0.
+        self.ckpt_epoch: int | None = self.start_epoch if st is not None \
+            else None
         #: WHOLE iteration blocks may run on fleet worker threads only when
         #: every one of them is guaranteed jax-free: no CNN members, no
         #: device-resident GNB/SGD inference, no mesh feeds
@@ -430,6 +437,10 @@ class UserSession:
             bg_times.update(bg)
 
         self.ckpt.submit(commit)
+        # the generation a fence release will report: by the time the
+        # release's generator close returns, the checkpointer joined
+        # this commit, so the workspace durably holds it
+        self.ckpt_epoch = next_epoch
 
     def _join_and_drain(self) -> dict:
         """Join the previous iteration's background checkpoint job in

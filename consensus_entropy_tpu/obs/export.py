@@ -73,6 +73,12 @@ EVENT_FIELDS = {
     "migrate_refused": ("user",),
     "withdraw": ("user",),
     "fleet_edges": ("edges",),
+    # graceful scale-down + checkpoint-fenced live migration
+    "host_drain": ("host",),
+    "drain_done": ("host",),
+    "migrate_fence": ("user", "host"),
+    "migrate_inflight": ("user", "host"),
+    "fence_release": ("user",),
     # stream-closing summaries (no t_s)
     "fleet_summary": (),
     "fabric_summary": (),

@@ -31,6 +31,7 @@ from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from consensus_entropy_tpu.ops.entropy import masked_entropy
 from consensus_entropy_tpu.ops.topk import masked_top_k, reveal_mask_update
@@ -179,6 +180,22 @@ def split_mix_index(indices, n_pool: int):
     indices = jnp.asarray(indices)
     return indices >= n_pool, jnp.where(indices >= n_pool,
                                         indices - n_pool, indices)
+
+
+def selection_scalars(x):
+    """The SANCTIONED device→host pull of a selection's per-iteration
+    scalars: the 2·k indices/values rows of a :class:`ScoreResult` /
+    :class:`FusedStepResult` that ``Acquirer.finish_select`` maps back
+    to song ids (plus the mix block-split's slot row).  This is the ONE
+    transfer a steady-state fused-serve iteration is ALLOWED to make on
+    the hot path (the hot-path ROADMAP follow-on (c), a device-side
+    queried ring buffer, would remove even these); spelling it through
+    this helper is what lets cetpu-lint's ``implicit-host-sync`` rule
+    cover the staging/admission paths at all — the name is whitelisted
+    (``analysis.rules._SANCTIONED_PULLS``), so any OTHER
+    ``np.asarray``/``float()`` there reads as the hidden blocking sync
+    it is."""
+    return np.asarray(x)
 
 
 def score_rand(key, pool_mask, *, k: int) -> ScoreResult:

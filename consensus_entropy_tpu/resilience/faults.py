@@ -70,6 +70,19 @@ FAULT_POINTS = frozenset({
     "fabric.spawn",          # elastic autoscaler, pre-spawn-journal (a
                              # kill here leaves no spawn record: the
                              # restart re-decides from the same state)
+    "fabric.drain",          # scale-down decision, pre-drain-journal (a
+                             # kill here leaves no drain record: the
+                             # restart keeps the full fleet and the
+                             # low-water clock restarts)
+    "fabric.migrate.fence",  # in-flight migration, pre-fence-journal (a
+                             # kill here re-reads the worker's fence ack
+                             # as cursor-only: the restart re-places the
+                             # user from the journal alone)
+    "fabric.migrate.commit", # in-flight migration, post-fence pre-assign
+                             # (a kill between fence and commit replays
+                             # to exactly ONE owner: the fenced user's
+                             # last assignment decides, and the restart
+                             # re-routes it before any worker runs it)
     # acquisition-subsystem boundaries (the acquire registry's fault
     # domain): the qbdc dropout-mask sampler — mask keys fold from the AL
     # iteration seed, so a kill here must resume bit-identically (same
@@ -249,7 +262,11 @@ def inject(*rules, seed: int = 0):
 def parse_spec(spec: str) -> list[FaultRule]:
     """Parse the ``CETPU_FAULTS`` grammar: comma-separated
     ``point:action[@at][xTIMES]`` — e.g.
-    ``checkpoint.write:kill@3,member.predict:corrupt@1x2``."""
+    ``checkpoint.write:kill@3,member.predict:corrupt@1x2``.  The
+    ``delay`` action takes an optional duration: ``delay=0.5`` sleeps
+    half a second per firing (default 0.01) — ``pool.score:delay=0.4@1x-1``
+    turns a worker into a slow host for straggler/drain drills without
+    touching any journaled value."""
     rules = []
     for part in filter(None, (p.strip() for p in spec.split(","))):
         try:
@@ -262,8 +279,11 @@ def parse_spec(spec: str) -> list[FaultRule]:
             if "@" in rest:
                 rest, at_s = rest.split("@", 1)
                 at = int(at_s)
+            delay_s = 0.01
+            if rest.startswith("delay="):
+                rest, delay_s = "delay", float(rest[len("delay="):])
             rules.append(FaultRule(point=point, action=rest, at=at,
-                                   times=times))
+                                   times=times, delay_s=delay_s))
         except ValueError as e:
             raise ValueError(
                 f"bad CETPU_FAULTS entry {part!r} (want "

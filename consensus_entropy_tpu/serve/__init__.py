@@ -74,7 +74,9 @@ from consensus_entropy_tpu.serve.planner import (
 )
 from consensus_entropy_tpu.serve.elastic import (
     FleetPlanner,
+    drain_victim,
     next_host_id,
+    scale_down_ok,
     target_hosts,
 )
 from consensus_entropy_tpu.serve.fabric import (
@@ -96,6 +98,7 @@ from consensus_entropy_tpu.serve.placement import (
     bucket_for,
     place,
     place_user,
+    plan_failover,
     plan_rebalance,
 )
 from consensus_entropy_tpu.serve.server import (
@@ -115,6 +118,7 @@ __all__ = ["AdmissionJournal", "AdmissionPlanner", "AdmissionQueue",
            "PoisonList", "QueueClosed", "QueueFull", "ServeConfig",
            "SingleWriterViolation", "Watchdog", "WatchdogTimeout",
            "admission_hold", "bucket_for", "derive_edges",
-           "dispatch_hold", "next_host_id", "place", "place_user",
-           "plan_rebalance", "run_worker", "target_hosts",
-           "validate_bucket_widths", "validate_journal_file"]
+           "dispatch_hold", "drain_victim", "next_host_id", "place",
+           "place_user", "plan_failover", "plan_rebalance", "run_worker",
+           "scale_down_ok", "target_hosts", "validate_bucket_widths",
+           "validate_journal_file"]

@@ -91,6 +91,59 @@ def target_hosts(*, live: int, queued: int, min_hosts: int,
     return max(min_hosts, min(want, max_hosts))
 
 
+def scale_down_ok(*, live: int, queued: int, min_hosts: int,
+                  scale_backlog: int = 8, scale_slo_s: float = 0.0,
+                  finish_ema_s: float | None = None) -> bool:
+    """True when the fleet could serve its load one host SMALLER without
+    immediately scaling back up — the LOW-WATER test the drain decision
+    requires to hold for a sustained ``scale_down_s`` before a surplus
+    host drains.  Pure decision kernel (pinned in ``tests/test_elastic``):
+
+    - never below ``min_hosts`` (and a 1-host fleet can't shrink);
+    - quiet queue-depth signal at ``live - 1``: the backlog would NOT
+      oversubscribe the smaller fleet (``queued <= scale_backlog *
+      (live - 1)`` — the exact inverse of :func:`target_hosts`'s
+      scale-up trigger, evaluated at the post-drain size, which is what
+      makes drain/spawn hysteresis-free at the boundary);
+    - quiet SLO-headroom signal at ``live - 1``: the predicted drain
+      time of the backlog on the smaller fleet stays inside the target
+      (scaled by ``live/(live-1)`` — one fewer host serves that much
+      slower).
+
+    The SUSTAINED requirement (the low-water mark must hold for
+    ``scale_down_s`` continuous seconds) lives in the coordinator: this
+    kernel is the instantaneous test it times."""
+    if live <= max(min_hosts, 1):
+        return False
+    smaller = live - 1
+    if queued > scale_backlog * smaller:
+        return False
+    if scale_slo_s > 0 and finish_ema_s is not None:
+        if queued * finish_ema_s * (live / smaller) > scale_slo_s:
+            return False
+    return True
+
+
+def drain_victim(loads: dict) -> str:
+    """The host a scale-down drains: fewest unresolved users (least
+    sunk work to shed), ties broken toward the HIGHEST host id — the
+    newest capacity goes first, so repeated drains walk the fleet back
+    toward its original ids (the mirror of ``_initial_fleet``'s clamp
+    keeping the lowest-numbered hosts).  ``loads``: unresolved-user
+    count per live, joined, non-draining host."""
+    if not loads:
+        raise ValueError("no drainable hosts")
+
+    def key(hid):
+        m = _HOST_ID.match(str(hid))
+        # numeric ids after non-numeric (drain hand-named volunteers
+        # first), highest number first within numeric
+        num = -int(m.group(1)) if m else float("inf")
+        return (loads[hid], 0 if m is None else 1, num, str(hid))
+
+    return min(loads, key=key)
+
+
 class FleetPlanner:
     """Fabric-level bucket planning over the per-host sketches.
 

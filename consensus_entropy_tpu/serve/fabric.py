@@ -59,7 +59,17 @@ top of that base:
 - the FLEET PLANNER merges every worker's journaled quantile sketch
   (associative ``QuantileSketch.merge``) and broadcasts one derived
   edge set over the assignment feeds, keeping cross-host routing
-  aligned with cross-host placement.
+  aligned with cross-host placement;
+- GRACEFUL SCALE-DOWN (``scale_down_s``) drains a surplus host once the
+  low-water mark holds: the decision journals (``drain`` — the host is
+  OUT of the replayed fleet shape from that record on), queued users
+  rebalance away over the drop-ack path, in-flight users finish or
+  MIGRATE via the checkpoint fence (the source session releases at its
+  next iteration-boundary checkpoint; only the journaled fence ack —
+  carrying the checkpoint generation — commits the re-assign), and the
+  host retires clean (``drain_done``).  Failover and startup re-routes
+  place their whole victim set as ONE bucket-grouped plan
+  (``placement.plan_failover``) so same-bucket victims co-locate.
 """
 
 from __future__ import annotations
@@ -77,7 +87,9 @@ from consensus_entropy_tpu.serve import placement as placement_mod
 from consensus_entropy_tpu.serve.elastic import (
     FleetPlanner,
     PidProc,
+    drain_victim,
     next_host_id,
+    scale_down_ok,
     target_hosts,
 )
 from consensus_entropy_tpu.serve.hosts import (
@@ -143,6 +155,25 @@ class FabricConfig:
     max_hosts: int | None = None
     scale_backlog: int = 8
     scale_slo_s: float = 0.0
+    #: graceful SCALE-DOWN (0 = off, the PR 13 grow-only autoscaler):
+    #: once the low-water mark (``elastic.scale_down_ok`` — both
+    #: scale-up signals quiet at ``live - 1``) holds for this many
+    #: CONTINUOUS seconds and live hosts exceed ``min_hosts``, one
+    #: surplus host drains: the decision is journaled (``drain``), the
+    #: host stops admitting, its queued users rebalance away over the
+    #: drop-ack path, its in-flight users finish or migrate
+    #: (``migrate_inflight``), and the host retires clean
+    #: (``drain_done``) — replay-identical after a coordinator SIGKILL
+    #: at any boundary
+    scale_down_s: float = 0.0
+    #: checkpoint-fenced IN-FLIGHT migration during a drain: the source
+    #: session checkpoints at its next iteration boundary, the worker
+    #: journals a fence ack carrying the checkpoint generation, and only
+    #: that ack commits the re-assign — the target resumes the fenced
+    #: workspace bit-identically.  ``False`` is drain-by-waiting (the
+    #: ``bench.py --suite drain`` baseline arm): in-flight users simply
+    #: finish on the draining host
+    migrate_inflight: bool = True
     placement: str = "bucket"
     fleet_planner: bool = True
     planner_epoch: int = 8
@@ -186,6 +217,13 @@ class FabricConfig:
             if self.scale_slo_s < 0:
                 raise ValueError(f"scale_slo_s must be >= 0, "
                                  f"got {self.scale_slo_s}")
+            if self.scale_down_s < 0:
+                raise ValueError(f"scale_down_s must be >= 0, "
+                                 f"got {self.scale_down_s}")
+        elif self.scale_down_s:
+            raise ValueError(
+                "scale_down_s requires the elastic control plane "
+                "(set min_hosts/max_hosts)")
         if self.placement not in PLACEMENT_POLICIES:
             raise ValueError(f"placement must be one of "
                              f"{PLACEMENT_POLICIES}, got {self.placement!r}")
@@ -210,6 +248,9 @@ class HostHandle:
     #: first heartbeat observed — the elastic JOIN trigger (journaled
     #: once, then queued users rebalance onto the joiner)
     joined: bool = False
+    #: scale-down in progress: the host stops receiving assignments and
+    #: sheds its users until it retires (``drain_done``)
+    draining: bool = False
     #: tail of the worker's ``spans_<h>.jsonl`` (None when the
     #: coordinator runs untraced)
     span_tail: JsonlTail | None = None
@@ -266,6 +307,8 @@ class FabricCoordinator:
         self.spawns = 0
         self.joins = 0
         self.migrations = 0
+        self.drains = 0
+        self.fences = 0
         self._unresolved: set[str] = set()
         self._failed: set[str] = set()
         self._submitted: list[str] = []
@@ -277,6 +320,18 @@ class FabricCoordinator:
         #: journaled state only; the ack makes the hand-off race-free (a
         #: user the worker admitted first refuses the drop and stays)
         self._migrating: dict[str, str] = {}
+        #: in-progress IN-FLIGHT migrations awaiting the source host's
+        #: checkpoint-fence ack: uid → source host id.  Only a positive
+        #: journaled ack commits the re-assign (the fenced workspace is
+        #: the resume unit); stale acks after a restart are cursor-only,
+        #: exactly like stale drop acks — no user ever runs on two hosts
+        self._fencing: dict[str, str] = {}
+        #: the host currently draining (one scale-down at a time), and
+        #: when the low-water mark started holding (injected clock;
+        #: liveness-only — the drain DECISION journals, replay never
+        #: reads a clock)
+        self._draining_host: str | None = None
+        self._low_since: float | None = None
         #: consecutive spawned hosts that died before their FIRST
         #: heartbeat — the autoscaler's crash-loop guard (any join
         #: resets it)
@@ -352,16 +407,25 @@ class FabricCoordinator:
             pending.append(u)
         self._submitted = list(pending)
         self._unresolved = set(pending)
+        if self.config.elastic:
+            # a drain the killed run never finished: its worker
+            # orphan-exited with the coordinator, its shape record
+            # already excludes it — journal the retirement so the ledger
+            # closes and its users re-route below like everyone else's
+            for hid in st.draining_hosts():
+                self.journal.append("drain_done", host=hid)
+                self.report.event("drain_done", host=hid)
         try:
             if pending:  # nothing unresolved → no workers to spawn
                 for host_id in self._initial_fleet():
                     self._spawn_host(host_id, spawn)
-                # (re)route every unresolved user: prior-run assignments
-                # are void (their processes were reaped above), and
-                # recovery_order already put in-flight users ahead of the
-                # queue
-                for u in pending:
-                    self._assign(u)
+                # (re)route every unresolved user AS ONE BATCH: prior-run
+                # assignments are void (their processes were reaped
+                # above), recovery_order already put in-flight users
+                # ahead of the queue, and the batch planner folds each
+                # placement into the next decision's load/bucket view so
+                # same-bucket users co-locate with each other
+                self._route_batch(pending)
             while self._unresolved:
                 if self.preemption is not None \
                         and self.preemption.requested:
@@ -376,6 +440,8 @@ class FabricCoordinator:
                 if self.config.elastic:
                     self._adopt_operator_hosts()
                     self._autoscale()
+                    self._scale_down()
+                    self._pump_drain()
                     self._broadcast_edges()
                 if not any(h.alive for h in self.hosts.values()):
                     # the elastic autoscaler above respawns dead capacity
@@ -491,6 +557,18 @@ class FabricCoordinator:
                 continue
             rc = h.proc.poll()
             if rc is not None:
+                if h.draining:
+                    # a draining worker EXITS ON ITS OWN once its intake
+                    # is closed and its last session finished or
+                    # released — that is the clean retirement, not a
+                    # death.  Only a drain that still holds unresolved
+                    # users (it died mid-shed) fails over.
+                    self._transcribe(h)
+                    self._transcribe_spans(h)
+                    if not any(u in self._unresolved for u in
+                               self.journal.state.assigned_to(h.host_id)):
+                        self._finish_drain(h)
+                        continue
                 self._fail_over(h, f"worker exited rc={rc}")
                 continue
             age = lease_age_s(h.lease_path, now)
@@ -520,6 +598,17 @@ class FabricCoordinator:
         self.report.event("host_join", host=h.host_id)
         if self.fleet_planner is not None and self.fleet_planner.edges:
             h.assign.append({"edges": list(self.fleet_planner.edges)})
+        # users STRANDED on a host that died while no live target
+        # existed (every worker down in one failover window): their
+        # re-route was deferred — the joiner is the first live target,
+        # so batch-place them now, in-flight first
+        stranded = [u for u in self.journal.state.pending
+                    if u in self._unresolved
+                    and not self._host_is_live(
+                        self.journal.state.assigned.get(u))]
+        if stranded:
+            self._route_batch(stranded)
+            self.reassignments += len(stranded)
         self._rebalance(h)
 
     def _rebalance(self, new: HostHandle) -> None:
@@ -596,6 +685,139 @@ class FabricCoordinator:
             self._spawn_host(hid, self._spawn_fn)
             self.report.event("host_spawn", host=hid, reason=reason)
             live += 1
+
+    def _scale_down(self) -> None:
+        """One scale-down decision round: once the low-water mark
+        (``elastic.scale_down_ok`` — both scale-up signals quiet at
+        ``live - 1``) has held for ``scale_down_s`` CONTINUOUS seconds
+        and the fleet sits above ``min_hosts``, drain one surplus host:
+        journal the decision (``drain`` — the ``fabric.drain`` fault
+        point fires first, so a kill leaves no record and the restart
+        re-times the mark), send the drain sentinel, and let
+        :meth:`_pump_drain` shed its users.  One drain at a time: the
+        next candidate is only timed once the current host retired."""
+        cfg = self.config
+        if not cfg.scale_down_s:
+            return
+        if self._draining_host is not None:
+            self._low_since = None
+            return
+        candidates = {h.host_id: self._load_of(h.host_id)
+                      for h in self.hosts.values()
+                      if h.alive and h.joined and not h.draining}
+        queued = sum(1 for u in self.journal.state.queued
+                     if u in self._unresolved)
+        if not scale_down_ok(live=len(candidates), queued=queued,
+                             min_hosts=cfg.min_hosts,
+                             scale_backlog=cfg.scale_backlog,
+                             scale_slo_s=cfg.scale_slo_s,
+                             finish_ema_s=self._finish_ema):
+            self._low_since = None
+            return
+        now = self._clock()
+        if self._low_since is None:
+            self._low_since = now
+            return
+        if now - self._low_since < cfg.scale_down_s:
+            return
+        victim = drain_victim(candidates)
+        h = self.hosts[victim]
+        # a kill here models dying between the scale-down decision and
+        # its journal record: nothing drained, the restart re-derives
+        # the same fleet and re-times the low-water mark
+        faults.fire("fabric.drain", host=victim)
+        self.journal.append("drain", host=victim)
+        self.drains += 1
+        self._draining_host = victim
+        self._low_since = None
+        h.draining = True
+        h.assign.append({"drain": True})
+        self.report.event("host_drain", host=victim,
+                          load=candidates[victim])
+
+    def _pump_drain(self) -> None:
+        """One shed round for the draining host: withdraw its queued
+        users over the existing drop-ack path (placement picks each
+        target among the non-draining survivors), FENCE its in-flight
+        users (``migrate_inflight``; off = drain-by-waiting, they just
+        finish), and retire the host once the journal shows it holds
+        nothing unresolved.  Requests are idempotent per user — a
+        pending drop/fence is never re-sent, and a refused one
+        re-derives from the user's post-refusal disposition (a
+        drop-refused user shows ``admit`` next round and is fenced)."""
+        hid = self._draining_host
+        if hid is None:
+            return
+        h = self.hosts.get(hid)
+        if h is None or not h.alive:
+            self._draining_host = None  # failover superseded the drain
+            return
+        st = self.journal.state
+        mine = [u for u in st.assigned_to(hid) if u in self._unresolved]
+        if not mine:
+            self._finish_drain(h)
+            return
+        targets = self._route_targets()
+        if not targets:
+            return  # nowhere to shed yet; the autoscaler may add capacity
+        queued = set(st.queued)
+        fresh = [u for u in mine
+                 if u not in self._migrating and u not in self._fencing]
+        # the round's queued withdrawals place as ONE batch plan — the
+        # same anti-herding view _fail_over uses: per-user place_user
+        # against this round's static journal view would send every
+        # queued user to the same least-loaded survivor
+        drop_target = dict(placement_mod.plan_failover(
+            [u for u in fresh if u in queued], state=st,
+            unresolved=self._unresolved, hosts=targets,
+            edges=self._fleet_edges(), policy=self.config.placement))
+        for u in fresh:
+            if u in queued:
+                target = drop_target[u]
+                self._migrating[u] = target
+                h.assign.append({"drop": u})
+                self.report.event("migrate_request", user=u, host=target)
+            elif self.config.migrate_inflight \
+                    and st.last.get(u) == "admit":
+                # genuinely admitted: request the checkpoint-fenced
+                # release.  A backoff-failed user (last event ``fail``)
+                # is skipped — it re-enqueues itself when its delay
+                # elapses and then takes the drop path above
+                self._fencing[u] = hid
+                h.assign.append({"fence": u})
+                self.report.event("migrate_request", user=u, host=hid)
+
+    def _finish_drain(self, h: HostHandle) -> None:
+        """The draining host resolved everything it held: retire it.
+        The worker's serve loop exits on its own (intake closed, nothing
+        queued or in-flight); send the close sentinel in case it is
+        still mid-exit, give it ``drain_timeout_s``, SIGKILL a straggler
+        (nothing left to lose — every disposition is journaled), drain
+        its final events, and journal ``drain_done`` — the lease
+        retirement that takes it out of the replayed fleet shape."""
+        h.alive = False
+        h.closed = True
+        if h.proc.poll() is None:
+            try:
+                h.assign.append({"close": True})
+            except Exception:
+                pass
+            deadline = self._clock() + self.config.drain_timeout_s
+            while h.proc.poll() is None and self._clock() < deadline:
+                time.sleep(self.config.poll_s)
+            if h.proc.poll() is None:
+                self.report.event("drain_kill", host=h.host_id)
+                try:
+                    h.proc.kill()
+                    h.proc.wait(timeout=10)
+                except Exception:
+                    pass
+        self._transcribe(h)
+        self._transcribe_spans(h)
+        self.journal.append("drain_done", host=h.host_id)
+        self.report.event("drain_done", host=h.host_id)
+        if h.host_id == self._draining_host:
+            self._draining_host = None
 
     def _adopt_operator_hosts(self) -> None:
         """Operator-added workers announce through the lease directory:
@@ -686,21 +908,34 @@ class FabricCoordinator:
             self._stillborn += 1
         else:
             self._stillborn = 0
+        if h.host_id == self._draining_host:
+            # it died mid-drain: failover supersedes the graceful path
+            # (revoke, not drain_done — the journal narrative says what
+            # actually happened); the scale-down clock restarts
+            self._draining_host = None
+            h.draining = False
         # migrations whose TARGET just died stay pending on purpose: the
         # source may have already withdrawn the user (its ack is in
         # flight), so the ack handler must still see the entry and
         # re-place the user — dropping it here would strand a withdrawn
         # user in no queue at all.  Migrations whose SOURCE died are the
         # victims below: popped, because this reassignment supersedes
-        # any stale ack.
+        # any stale ack (drop AND fence alike).
         victims = [u for u in self.journal.state.assigned_to(h.host_id)
                    if u in self._unresolved]
         self.report.event("host_down", host=h.host_id, reason=reason,
                           reassigned=len(victims))
         for u in victims:
             self._migrating.pop(u, None)
-            self._assign(u)
-            self.reassignments += 1
+            self._fencing.pop(u, None)
+        # the WHOLE victim set is placed as one plan (in-flight first,
+        # then queued — assigned_to's order): each placement folds into
+        # the next decision's load/bucket view, so two same-bucket
+        # victims of one dead host co-locate with each other, not just
+        # with survivors.  With no live target the re-route is deferred
+        # to the next JOIN (the stranded path) or the restart.
+        self._route_batch(victims)
+        self.reassignments += len(victims)
 
     def _close_hosts(self) -> None:
         """Graceful shutdown: every user is resolved, so workers are idle
@@ -790,19 +1025,52 @@ class FabricCoordinator:
         st_edges = self.journal.state.planner_edges
         return tuple(st_edges) if st_edges else ()
 
-    def _assign(self, user: str) -> None:
-        live = [h for h in self.hosts.values() if h.alive]
+    def _host_is_live(self, host_id) -> bool:
+        h = self.hosts.get(host_id) if host_id else None
+        return h is not None and h.alive
+
+    def _route_targets(self) -> list:
+        """Hosts a placement may target: alive and NOT draining — a
+        draining host sheds users, it never receives them."""
+        return [h.host_id for h in self.hosts.values()
+                if h.alive and not h.draining]
+
+    def _assign(self, user: str) -> str | None:
+        """Place and commit one user; returns the target host id, or
+        ``None`` when no live non-draining target exists (the user
+        keeps its stale assignment — the run loop raises FabricError,
+        the autoscaler respawns, or the next JOIN's stranded path
+        re-places it)."""
+        live = self._route_targets()
         if not live:
-            return  # the run loop raises FabricError on its next pass
+            return None
         # bucket-aware placement, a pure function of journaled state
         # (assignments, pool sizes, fleet edges): same-bucket users
         # co-locate so stacked dispatches stay full per host; with no
         # journaled pools it IS the PR 5 least-loaded rule
         host_id = placement_mod.place_user(
             user, state=self.journal.state, unresolved=self._unresolved,
-            hosts=[h.host_id for h in live], edges=self._fleet_edges(),
+            hosts=live, edges=self._fleet_edges(),
             policy=self.config.placement)
         self._assign_to(user, host_id)
+        return host_id
+
+    def _route_batch(self, users) -> None:
+        """Place ``users`` as ONE plan (``placement.plan_failover``) and
+        journal each assignment in plan order — the batched sibling of
+        :meth:`_assign`: each placement folds into the next decision's
+        load/bucket view, so same-bucket users in the batch co-locate
+        with each other.  With no live target the batch is deferred (the
+        next JOIN's stranded path, or the restart, re-routes)."""
+        live = self._route_targets()
+        if not users or not live:
+            return
+        plan = placement_mod.plan_failover(
+            users, state=self.journal.state,
+            unresolved=self._unresolved, hosts=live,
+            edges=self._fleet_edges(), policy=self.config.placement)
+        for u, target in plan:
+            self._assign_to(u, target)
 
     def _assign_to(self, user: str, host_id: str) -> None:
         h = self.hosts[host_id]
@@ -877,12 +1145,55 @@ class FabricCoordinator:
                     continue
                 if rec.get("ok") and u in self._unresolved:
                     th = self.hosts.get(target)
-                    if th is not None and th.alive:
+                    if th is not None and th.alive and not th.draining:
                         self._assign_to(u, target)
                     else:
                         self._assign(u)  # target died mid-move: re-place
                     self.migrations += 1
                     self.report.event("migrate", user=u, host=target)
+                elif not rec.get("ok"):
+                    self.report.event("migrate_refused", user=u)
+            elif ev == "fence":
+                # the in-flight-migration ack: the source worker either
+                # RELEASED the user at a checkpoint boundary (ok — the
+                # fenced workspace, generation ``gen``, is the resume
+                # unit) or refused (not running there: finished first,
+                # or never admitted).  The fence is journaled BEFORE the
+                # commit (its own fault point), and only a fence pending
+                # THIS run commits the re-assign — a stale ack re-read
+                # after a coordinator restart advances the cursor only,
+                # exactly like stale drop acks: the restart already
+                # re-routed every unresolved user from the journal.
+                faults.fire("fabric.migrate.fence", user=u,
+                            host=h.host_id)
+                self.journal.append("fence", u, host=h.host_id,
+                                    src_off=off, ok=bool(rec.get("ok")),
+                                    gen=rec.get("gen"))
+                self.report.event("migrate_fence", user=u,
+                                  host=h.host_id,
+                                  ok=bool(rec.get("ok")),
+                                  gen=rec.get("gen"))
+                src = self._fencing.pop(u, None)
+                if src is None:
+                    continue
+                if rec.get("ok") and u in self._unresolved:
+                    # a kill here dies with the fence journaled but the
+                    # re-assign uncommitted: the user's last assignment
+                    # still names the (retiring) source, so the restart
+                    # re-places it — exactly one owner either way
+                    faults.fire("fabric.migrate.commit", user=u,
+                                host=src)
+                    target = self._assign(u)
+                    if target is not None:
+                        self.migrations += 1
+                        self.fences += 1
+                        self.report.event("migrate_inflight", user=u,
+                                          host=target,
+                                          gen=rec.get("gen"))
+                    # no live target: the released user keeps its stale
+                    # assignment to the retiring source — the next JOIN
+                    # (stranded path) or the restart re-places it; no
+                    # migration happened, so nothing is counted
                 elif not rec.get("ok"):
                     self.report.event("migrate_refused", user=u)
             elif ev == "planner":
@@ -933,8 +1244,11 @@ class FabricCoordinator:
             "spawns": self.spawns,
             "joins": self.joins,
             "migrations": self.migrations,
+            "drains": self.drains,
+            "fences": self.fences,
             "compactions": self.journal.compactions,
-            "hosts": {hid: ("revoked" if not h.alive else "closed")
+            "hosts": {hid: ("drained" if h.draining and not h.alive
+                            else "revoked" if not h.alive else "closed")
                       for hid, h in self.hosts.items()},
         }
         if self.fleet_planner is not None:
@@ -947,6 +1261,7 @@ class FabricCoordinator:
             revocations=self.revocations,
             reassignments=self.reassignments,
             spawns=self.spawns, joins=self.joins,
-            migrations=self.migrations,
+            migrations=self.migrations, drains=self.drains,
+            fences=self.fences,
             compactions=summary["compactions"])
         return summary

@@ -189,15 +189,39 @@ def run_worker(fabric_dir: str, host_id: str, *, build_entry, scheduler,
         runs as the 'threaded producer' the server's keep_open mode is
         built for.  Beyond user routings the feed carries the elastic
         control plane's lines: ``{"edges": [...]}`` (fleet-planner
-        bucket edges — adopt for future admissions) and
-        ``{"drop": uid}`` (rebalance withdrawal — journal an ACK saying
-        whether the user was still queued here; the coordinator only
-        moves it on a positive ack, so admission always wins the race)."""
+        bucket edges — adopt for future admissions), ``{"drop": uid}``
+        (rebalance withdrawal — journal an ACK saying whether the user
+        was still queued here; the coordinator only moves it on a
+        positive ack, so admission always wins the race),
+        ``{"drain": true}`` (scale-down: stop admitting, shed users,
+        exit clean) and ``{"fence": uid}`` (in-flight migration:
+        release the user at its next checkpoint boundary and ack with
+        the checkpoint generation — the coordinator commits the
+        re-assign only on the journaled ack)."""
         while not stop.is_set():
             for rec, _off in feed.poll():
                 if rec.get("close"):
                     server.close_intake()
                     return
+                if rec.get("drain"):
+                    # scale-down sentinel: stop ADMITTING but keep
+                    # consuming the feed — the coordinator still sends
+                    # drop withdrawals and fence requests while this
+                    # host sheds its users; the serve loop exits on its
+                    # own once nothing queued or in-flight remains
+                    server.close_intake()
+                    continue
+                if rec.get("fence") is not None:
+                    # in-flight migration request: release the user at
+                    # its next checkpoint boundary.  Queued/unknown
+                    # verdicts ack immediately; an in-flight release
+                    # acks from the serve loop with the checkpoint
+                    # generation once the boundary commits
+                    verdict = server.fence(rec["fence"])
+                    if verdict is not None:
+                        journal.append("fence", str(rec["fence"]),
+                                       ok=bool(verdict))
+                    continue
                 if isinstance(rec.get("edges"), list):
                     try:
                         server.apply_fleet_edges(rec["edges"])

@@ -70,16 +70,24 @@ from consensus_entropy_tpu.resilience import faults
 #: admission transitions a journal line may carry (user-scoped).
 #: ``assign`` and ``drop`` are fabric ROUTING records: they move a user
 #: between hosts (or acknowledge a rebalance withdrawal) without touching
-#: its admission disposition.
+#: its admission disposition.  ``fence`` is the in-flight-migration
+#: sibling of ``drop``: the source worker's ack that it released (or
+#: refused to release) an IN-FLIGHT user at a checkpoint boundary —
+#: disposition untouched, the follow-up assign commits the move.
 EVENTS = ("enqueue", "admit", "finish", "fail", "poison", "unpoison",
-          "assign", "drop")
+          "assign", "drop", "fence")
 #: host-membership records (fabric): no user field.  ``spawn`` journals
 #: the elastic control plane's decision to add a host (autoscaler respawn
 #: / scale-up / operator adoption), ``lease`` its process coming up,
 #: ``join`` its first observed heartbeat (the rebalance trigger),
 #: ``revoke`` its death — a coordinator restart replays the same fleet
-#: shape from these records alone.
-HOST_EVENTS = ("lease", "revoke", "spawn", "join")
+#: shape from these records alone.  ``drain`` journals the scale-down
+#: decision (the host stops admitting and sheds its users) and
+#: ``drain_done`` its clean retirement: both take the host OUT of the
+#: replayed fleet shape, so a coordinator SIGKILLed mid-drain restarts
+#: at the post-drain size and simply re-routes the drained host's
+#: remaining users (never respawns capacity it decided to shed).
+HOST_EVENTS = ("lease", "revoke", "spawn", "join", "drain", "drain_done")
 #: SLO-planner epoch records (no user field): ``edges`` (the derived
 #: bucket edges in force) + ``sketch`` (the quantile-sketch state), so a
 #: restarted server re-derives IDENTICAL routing from replay alone
@@ -171,10 +179,12 @@ class JournalState:
             if isinstance(host, str):
                 self.assigned[user] = host
             return
-        if event == "drop":
-            # rebalance bookkeeping (a worker acknowledged withdrawing a
-            # still-queued user): disposition unchanged — the user stays
-            # enqueued at fabric level and the follow-up assign re-routes
+        if event in ("drop", "fence"):
+            # rebalance/migration bookkeeping (a worker acknowledged
+            # withdrawing a still-queued user, or releasing an in-flight
+            # one at a checkpoint boundary): disposition unchanged — the
+            # user stays enqueued/admitted at fabric level and the
+            # follow-up assign re-routes it
             return
         self.last[user] = event
         if event == "enqueue":
@@ -231,12 +241,24 @@ class JournalState:
 
     def fleet_hosts(self) -> list:
         """The replayed fleet SHAPE: every host whose last membership
-        record is not a revoke — including ``spawn`` records whose
-        process never published a lease (the restart must still stand
-        that capacity up).  A restarted elastic coordinator respawns
-        exactly these ids, so the fleet shape is a pure function of the
-        journal."""
-        return sorted(h for h, e in self.hosts.items() if e != "revoke")
+        record is not a revoke or a drain — including ``spawn`` records
+        whose process never published a lease (the restart must still
+        stand that capacity up).  A ``drain`` record without its
+        ``drain_done`` counts as OUT too: the scale-down decision is
+        durable the moment it journals, so a coordinator SIGKILLed
+        mid-drain restarts at the post-drain size and re-routes the
+        drained host's users instead of respawning shed capacity.  A
+        restarted elastic coordinator respawns exactly these ids, so the
+        fleet shape is a pure function of the journal."""
+        return sorted(h for h, e in self.hosts.items()
+                      if e not in ("revoke", "drain", "drain_done"))
+
+    def draining_hosts(self) -> list:
+        """Hosts whose last membership record is ``drain`` — a drain the
+        coordinator never journaled ``drain_done`` for (it was killed
+        mid-drain).  The restart retires them (their workers orphan-exit
+        with the dead coordinator) and re-routes their users."""
+        return sorted(h for h, e in self.hosts.items() if e == "drain")
 
     def assigned_to(self, host: str) -> list:
         """This host's unresolved users, in-flight first (first-admit

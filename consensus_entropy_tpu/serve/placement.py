@@ -106,6 +106,55 @@ def place_user(user, *, state, unresolved, hosts, edges=(),
                  max_skew=max_skew)
 
 
+def plan_failover(victims, *, state, unresolved, hosts, edges=(),
+                  policy: str = "bucket",
+                  max_skew: int = DEFAULT_MAX_SKEW) -> list:
+    """Place a dead (or drained) host's WHOLE victim set at once:
+    ``[(user, target_host), ...]`` in the given victim order (failover
+    passes in-flight first, then queued — the re-admission order).
+
+    The one-at-a-time loop this replaces called :func:`place_user` per
+    victim in re-admission order, which interleaves buckets (in-flight
+    users first, whatever their widths): at a ``max_skew`` boundary an
+    early victim's placement could push its host out of a later
+    same-bucket victim's eligible set, splitting a group that fits
+    together.  Planning the set at once fixes both halves: every
+    placement folds into the loads/buckets view the NEXT decision reads
+    (so victims co-locate with EACH OTHER, not just with survivors),
+    and decisions run bucket-GROUPED — largest victim bucket first, its
+    members consecutively — so a group claims its best host before
+    unrelated buckets perturb the loads.  The returned plan keeps the
+    caller's victim order: re-admission order (journal/feed append
+    order) is a recovery contract, only the DECISIONS are grouped.
+
+    Same pure-function-of-journal-state discipline as
+    :func:`place_user`: every input replays from the journal, so a
+    restarted coordinator re-derives the identical plan."""
+    loads, buckets = placement_view(state, unresolved, hosts, edges)
+    by_bucket: dict = {}
+    order: list = []
+    for u in victims:
+        b = bucket_for(state.pools.get(str(u)), edges)
+        if b not in by_bucket:
+            by_bucket[b] = []
+            order.append(b)
+        by_bucket[b].append(u)
+    # largest group first (ties: first-seen), bucketless victims last —
+    # a big group's co-location claim is worth the most
+    seen = {b: i for i, b in enumerate(order)}
+    order.sort(key=lambda b: (b is None, -len(by_bucket[b]), seen[b]))
+    target_of: dict = {}
+    for b in order:
+        for u in by_bucket[b]:
+            target = place(b, loads=loads, buckets_by_host=buckets,
+                           policy=policy, max_skew=max_skew)
+            target_of[u] = target
+            loads[target] += 1
+            if b is not None:
+                buckets[target][b] = buckets[target].get(b, 0) + 1
+    return [(u, target_of[u]) for u in victims]
+
+
 def plan_rebalance(new_host, *, loads, queued_by_host) -> list:
     """Migrations a JOIN triggers: ``[(user, source_host), ...]``.
 

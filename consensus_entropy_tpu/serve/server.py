@@ -456,6 +456,10 @@ class FleetServer:
             dict(journal.state.admits) if journal is not None else {})
         #: ``(due_monotonic, entry)`` backoff re-admissions not yet due
         self._requeue: list = []
+        #: fence requests from the intake thread, applied (and their
+        #: deferred acks journaled) on the serve-loop thread
+        self._fence_req: list = []
+        self._fence_lock = threading.Lock()
         self._backoff_rng = np.random.default_rng(config.backoff_seed)
         # the fault-domain engine hooks: install from config unless the
         # caller wired its own instances into the scheduler already
@@ -599,6 +603,55 @@ class FleetServer:
         self.report.event("withdraw", user=uid)
         return True
 
+    def fence(self, user_id) -> bool | None:
+        """The fabric's in-flight-migration seam (intake thread): the
+        coordinator asks this worker to release ``user_id`` so it can
+        run elsewhere.
+
+        - Still QUEUED here → withdrawn now, returns True (the caller
+          journals the positive ack; nothing ran, no generation).
+        - IN-FLIGHT → the release is requested and the ack DEFERRED:
+          returns None; the serve loop releases the session at its next
+          checkpoint boundary and journals ``ok`` + the checkpoint
+          generation then (:meth:`_apply_fences`).
+        - Unknown or already finished → returns False (refused: the
+          user's own finish record resolves it at the coordinator).
+        """
+        uid = str(user_id)
+        if self.withdraw(uid):  # still queued: same as a drop
+            return True
+        if uid in self._live_cls:
+            with self._fence_lock:
+                self._fence_req.append(uid)
+            return None
+        return False
+
+    def _apply_fences(self) -> None:
+        """Serve-loop half of the migration fence: turn intake-thread
+        fence requests into engine release marks, and journal the
+        deferred acks of sessions that released at their checkpoint
+        boundary.  Release bookkeeping mirrors a withdraw — the slot
+        freed, no result recorded — because the user's run CONTINUES on
+        another host from the fenced workspace."""
+        with self._fence_lock:
+            reqs, self._fence_req = self._fence_req, []
+        for uid in reqs:
+            if not self.scheduler.request_release(uid):
+                # finished or evicted between the request and this
+                # round: refuse — the user's own records resolve it
+                self._journal("fence", uid, ok=False)
+        for uid, gen in self.scheduler.take_released().items():
+            self._live_cls.pop(uid, None)
+            for e in self._admitted:
+                if str(e.user_id) == uid:
+                    self._pending.pop(id(e), None)
+            if self.planner is not None:
+                self.planner.note_resolved(uid)
+            fields = {"ok": True}
+            if gen is not None:
+                fields["gen"] = int(gen)
+            self._journal("fence", uid, **fields)
+
     def apply_fleet_edges(self, edges) -> None:
         """Adopt coordinator-broadcast fabric-level bucket edges (the
         fleet planner): future admissions route by them — already-pinned
@@ -655,6 +708,7 @@ class FleetServer:
         sched.open(cfg.target_live)
         try:
             while True:
+                self._apply_fences()
                 if (self.preemption is not None
                         and self.preemption.requested
                         and not self._draining):
@@ -736,6 +790,7 @@ class FleetServer:
             sched.close()
             self.queue.close()
             self._collect(on_result)
+            self._apply_fences()  # acks of releases in the final round
             # admission-ordered, whatever order completions landed in (a
             # backoff-re-admitted user keeps its FIRST admission slot)
             self.results = [sched.results[id(e)] for e in self._admitted

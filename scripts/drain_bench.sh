@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Graceful scale-down race (ISSUE 14 acceptance: a 3-host elastic
+# fabric sheds one host mid-run with zero user loss and parity
+# bit-identical to sequential; checkpoint-fenced in-flight migration
+# retires the surplus host faster than waiting out its sessions).
+#
+# Runs `bench.py --suite drain`: two arms over the IDENTICAL slowed
+# workload (a pool.score delay rule stretches every worker iteration —
+# values untouched) on a 3-host fabric (min_hosts=2) whose low-water
+# timer is forced once every host is mid-run.  The arms differ only in
+# FabricConfig.migrate_inflight — 'fence' (in-flight users checkpoint
+# at their next iteration boundary and migrate on the journaled fence
+# ack) vs 'wait' (the PR 13-shaped baseline: only queued users move,
+# in-flight users finish where they are).  Recovered-users/sec plus the
+# journal-derived drain->drain_done latency; parity vs unfaulted
+# sequential runs is asserted on every rep of both arms, and the fence
+# arm must fence >= 1 user while the wait arm fences exactly 0.
+#
+# The JSON line goes to stdout (redirect to BENCH_drain_r<N>.json to
+# commit an artifact); the per-rep log goes to stderr.  Extra bench
+# args pass through, e.g.:
+#   scripts/drain_bench.sh --users 6 --al-epochs 3 --reps 2
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+if [ "$#" -gt 0 ]; then
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --suite drain "$@"
+else
+    # 8 users over 3 hosts: the survivors outlast the drain victim, so
+    # the wait arm's retirement (drain_done) lands inside the run and
+    # both arms report a COMPLETED drain latency
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --suite drain \
+        --users 8 --hosts 3 --al-epochs 4 --reps 3
+fi

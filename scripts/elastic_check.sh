@@ -1,10 +1,11 @@
 #!/usr/bin/env bash
-# Elastic fabric CI gate (ISSUE 13 satellite; sits next to slo_check.sh
-# and is run by scripts/fault_matrix.sh).
+# Elastic fabric CI gate (ISSUE 13 satellite + the ISSUE 14 drain and
+# migrate leg; sits next to slo_check.sh and is run by
+# scripts/fault_matrix.sh).
 #
-# Runs a REAL 2-host ELASTIC fabric (worker subprocesses over the
-# synthetic tests/fabric_workload users, two pool-size buckets),
-# SIGKILLs h0 at its first admission, then:
+# LEG 1 — kill + respawn: a REAL 2-host ELASTIC fabric (worker
+# subprocesses over the synthetic tests/fabric_workload users, two
+# pool-size buckets), h0 SIGKILLed at its first admission, then:
 #   1. asserts the autoscaler RESPAWNED a replacement (spawn journaled,
 #      fresh host id in the replayed fleet shape) and every user
 #      finished bit-identical to unfaulted sequential baselines,
@@ -14,6 +15,15 @@
 #   3. asserts the fleet planner's MERGED edges ended identical on
 #      every surviving host (each worker's last fleet-adopted planner
 #      record) and match the main journal's restored edges.
+#
+# LEG 2 — drain + migrate: a REAL 3-host elastic fabric whose
+# low-water mark holds from the start, so it SCALES DOWN mid-run; the
+# coordinator is killed (in-process InjectedKill) at EACH new fault
+# point — fabric.drain, fabric.migrate.fence, fabric.migrate.commit —
+# and rerun; after each rerun the journal must validate, every user
+# must finish bit-identical to sequential, and the EXACTLY-ONE-OWNER
+# invariant must hold (each user has exactly one result row across
+# every host's results file — no user ever ran to completion twice).
 #
 # Extra args are NOT accepted: this is a pass/fail gate, not a bench.
 set -euo pipefail
@@ -33,6 +43,7 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
 from tests.fabric_workload import (
+    force_low_water,
     make_cfg,
     read_results,
     sequential_baselines,
@@ -134,5 +145,104 @@ assert list(next(iter(per_host.values()))) == fleet.get("edges"), \
 assert st.planner_edges == fleet.get("edges")
 print(f"elastic_check: merged edges identical on every host "
       f"{sorted(per_host)} -> {fleet.get('edges')}")
+
+# ---- LEG 2: drain + migrate, killed at every new fault point ----------
+
+from consensus_entropy_tpu.resilience import faults as faults_mod
+from consensus_entropy_tpu.resilience.faults import FaultRule, InjectedKill
+from consensus_entropy_tpu.serve import FabricError  # noqa: F401
+
+# slow-host workers (pool.score delay rule, injected via CETPU_FAULTS
+# below) keep in-flight sessions alive through the drain decision, so
+# the fence window reliably opens
+cfg2 = make_cfg("mc", epochs=3)
+specs2 = user_specs(6, sizes=[30, 100])
+root2 = tempfile.mkdtemp(prefix="elastic_check_drain_")
+seq2 = sequential_baselines(root2, cfg2, specs2)
+
+for point in ("fabric.drain", "fabric.migrate.fence",
+              "fabric.migrate.commit"):
+    slug = point.replace(".", "_")
+    fdir = os.path.join(root2, "fabric_" + slug)
+    # each leg gets its OWN workspace root: a shared one would hand the
+    # later legs already-complete fab_* workspaces (users resolve
+    # instantly, nothing in flight, no fence to kill at)
+    ws2 = os.path.join(root2, "ws_" + slug)
+    os.makedirs(fdir)
+    os.makedirs(ws2)
+    jp2 = os.path.join(fdir, "serve_journal.jsonl")
+
+    def spawn2(host_id, fdir=fdir, ws2=ws2):
+        log = open(fabric_paths(fdir, host_id)["log"], "ab")
+        try:
+            return subprocess.Popen(
+                [sys.executable, "tests/fabric_worker.py", fdir,
+                 host_id, ws2, cfg2.mode, str(cfg2.epochs),
+                 str(len(specs2)), "5.0", "2", sizes_arg(specs2)],
+                stdout=log, stderr=subprocess.STDOUT,
+                # the pool.score delay rule = slow-host simulation:
+                # sessions outlive the fence round-trip, values untouched
+                env={**os.environ, "PYTHONPATH": ".",
+                     "CETPU_FAULTS": "pool.score:delay=0.3@1x-1"})
+        finally:
+            log.close()
+
+    # the low-water TIMER is forced (tests.fabric_workload.
+    # force_low_water, via on_poll) the moment every joined host holds
+    # an in-flight user, so the drain victim always has sessions to
+    # fence — the kill lands at a deterministic state instead of racing
+    # worker start-up on a loaded CI box
+    fcfg = FabricConfig(hosts=3, min_hosts=2, max_hosts=3,
+                        scale_down_s=600.0, drain_timeout_s=30.0)
+    killed = False
+    journal2 = AdmissionJournal(jp2)
+    try:
+        with faults_mod.inject(FaultRule(point, "kill", at=1)):
+            FabricCoordinator(journal2, fdir, fcfg,
+                              on_poll=force_low_water).run(
+                [u for _, u, _ in specs2], spawn2,
+                pools={u: n for _, u, n in specs2})
+    except InjectedKill:
+        killed = True
+    finally:
+        journal2.close()
+    assert killed, f"{point} never fired (no drain/fence reached?)"
+
+    # the rerun replays the journal and finishes everything (the
+    # drain-kill leg re-decides its drain through the same forced
+    # low-water hook; the fence/commit legs already journaled theirs,
+    # so the hook's 3-joined-hosts guard never fires there)
+    journal2 = AdmissionJournal(jp2)
+    try:
+        summary2 = FabricCoordinator(journal2, fdir, fcfg,
+                                     on_poll=force_low_water).run(
+            [u for _, u, _ in specs2], spawn2,
+            pools={u: n for _, u, n in specs2})
+    finally:
+        journal2.close()
+    st2 = AdmissionJournal(jp2).state
+    assert st2.finished == {u for _, u, _ in specs2} and not st2.pending
+    assert len(st2.fleet_hosts()) == 2, st2.hosts  # scaled down
+    bad2 = validate_journal_file(jp2)
+    for wal in sorted(glob.glob(os.path.join(fdir, "events_*.jsonl"))):
+        bad2 += validate_journal_file(wal)
+    assert bad2 == [], "journal violations:\n" + "\n".join(bad2[:10])
+    # EXACTLY-ONE-OWNER: each user has exactly one result row across
+    # every host's results file, bit-identical to sequential
+    rows = {}
+    for fname in sorted(os.listdir(fdir)):
+        if fname.startswith("results_") and fname.endswith(".jsonl"):
+            for rec in export.read_jsonl_tolerant(
+                    os.path.join(fdir, fname)):
+                rows.setdefault(rec["user"], []).append(rec)
+    for _, uid, _ in specs2:
+        assert len(rows[uid]) == 1, (uid, rows[uid])
+        assert rows[uid][0]["error"] is None
+        assert rows[uid][0]["result"]["trajectory"] \
+            == seq2[uid]["trajectory"]
+    print(f"elastic_check: kill@{point} -> replayed to "
+          f"{len(st2.fleet_hosts())} hosts, {len(specs2)} users "
+          f"finished exactly once, parity exact "
+          f"(drains={summary2['drains']}, fences={summary2['fences']})")
 PY
 echo "elastic check passed"

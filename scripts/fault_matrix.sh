@@ -45,9 +45,17 @@
 #   (spawn/join journaled, users recovered bit-identical, fleet shape
 #   replayable), the coordinator-kill-mid-rebalance drill must replay
 #   to deterministic assignments, and the drop-ack migration protocol
-#   must never run a user on two hosts; scripts/elastic_check.sh (run
-#   at the end of this matrix) is the companion kill→respawn→
-#   journal-schema→merged-edges gate.
+#   must never run a user on two hosts.  The SCALE-DOWN rows drill the
+#   drain state machine + checkpoint-fenced migration: the
+#   deterministic fake-worker drain→rebalance→exit and fence drills, a
+#   coordinator-kill matrix over the three new fault points
+#   (fabric.drain / fabric.migrate.fence / fabric.migrate.commit —
+#   single-owner invariant asserted across both incarnations), and the
+#   real 3-host→2-host subprocess drill in mc (tier-1) plus hc/wmc
+#   rows here.  scripts/elastic_check.sh (run at the end of this
+#   matrix) is the companion gate: kill→respawn→journal-schema→
+#   merged-edges (leg 1) and the drain+migrate kill matrix against
+#   real workers with the exactly-one-owner check (leg 2).
 # - acquisition registry (tests/test_acquire.py): the acquire.qbdc.masks
 #   fault point unit and the qbdc resume drill.
 # - observability (tests/test_obs.py): the traced fleet eviction+resume

@@ -179,6 +179,25 @@ def build_entry_factory(ws_root: str, cfg, specs):
     return build_entry
 
 
+def force_low_water(coord, hosts: int = 3) -> None:
+    """Deterministic drain trigger for scale-down drills (pass as — or
+    call from — the coordinator's ``on_poll``, paired with a huge
+    ``scale_down_s``): the low-water TIMER is forced the moment every
+    joined host holds an in-flight user, so the drain victim has
+    sessions to fence and the drill never races worker start-up against
+    user completion."""
+    if coord.drains:
+        return
+    st = coord.journal.state
+    joined = [h for h in coord.hosts.values() if h.joined and h.alive]
+    if len(joined) < hosts:
+        return
+    in_flight = set(st.in_flight)
+    if all(any(st.assigned.get(u) == h.host_id for u in in_flight)
+           for h in joined):
+        coord._low_since = -1e18  # the mark has "held" long enough
+
+
 def sequential_baselines(ws_root: str, cfg, specs) -> dict:
     """Uninterrupted single-host ground truth: ``{uid: result}`` from
     ``ALLoop.run_user`` over the identical users and seeds."""

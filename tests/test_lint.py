@@ -343,6 +343,47 @@ def test_implicit_host_sync_scoped_to_hot_functions():
     assert fired == ["implicit-host-sync"] * 3
 
 
+def test_implicit_host_sync_server_scope_and_sanctioned_pull():
+    """The follow-on (c) scope growth: serve/server.py dispatch paths
+    and the acquirer's staging path are hot too — and the ONE sanctioned
+    pull (``selection_scalars``, the 2·k selection rows) is whitelisted
+    by its helper spelling, not by a noqa."""
+    server = "consensus_entropy_tpu/serve/server.py"
+    assert rules_fired("""
+        import numpy as np
+
+        class S:
+            def _collect(self, rows):
+                return np.asarray(rows[0])
+    """, server) == ["implicit-host-sync"]
+    acq = "consensus_entropy_tpu/al/acquisition.py"
+    assert rules_fired("""
+        from consensus_entropy_tpu.ops import scoring
+
+        class A:
+            def _ids(self, res):
+                idx = scoring.selection_scalars(res.indices)
+                ok = scoring.selection_scalars(res.values) > 0
+                return idx, ok
+    """, acq) == []
+    # the bare spelling is whitelisted too (builtin.py imports the name)
+    assert rules_fired("""
+        from consensus_entropy_tpu.ops.scoring import selection_scalars
+
+        class A:
+            def finish_select(self, res):
+                return selection_scalars(res.indices)
+    """, acq) == []
+    # anything NOT the sanctioned helper still fires there
+    assert rules_fired("""
+        import numpy as np
+
+        class A:
+            def finish_select(self, res):
+                return float(res.values[0])
+    """, acq) == ["implicit-host-sync"]
+
+
 # -- rule 5: fault-point-literal ---------------------------------------------
 
 
