@@ -1403,13 +1403,17 @@ def run_serve_fused_suite(args_ns) -> int:
 
 
 def run_obs_suite(args_ns) -> int:
-    """Tracing overhead: traced vs ``--no-trace`` serve runs (ISSUE 9).
+    """Introspection overhead: plane-ON vs plane-OFF serve runs
+    (ISSUE 9's tracing arms, grown to ISSUE 15's full plane).
 
-    Two serve runs over IDENTICAL users and seeds — one with the obs
-    span tracer writing a real ``spans.jsonl``, one with the tracer off
-    (the ``--no-trace`` arm) — interleaved with alternating order per
-    rep (throttled-box discipline), per-user trajectory parity asserted
-    against a sequential baseline on EVERY rep of BOTH arms.
+    Two serve runs over IDENTICAL users and seeds — one with the whole
+    introspection plane live (span tracer writing a real
+    ``spans.jsonl``, compile events, status snapshots refreshing, alert
+    watcher evaluating), one with everything off (the
+    ``--no-introspection --no-trace`` arm) — interleaved with
+    alternating order per rep (throttled-box discipline), per-user
+    trajectory parity asserted against a sequential baseline on EVERY
+    rep of BOTH arms.
 
     The acceptance number (overhead <= 3%) is the MEDIAN of per-rep
     paired traced/bare wall ratios (adjacent runs, warmed, order
@@ -1458,14 +1462,28 @@ def run_obs_suite(args_ns) -> int:
                                              seed=cfg.seed))
         traj_of = {r["user"]: r["trajectory"] for r in seq_results}
 
-        def serve_once(tag, rep, tracer, metrics_path=None):
+        def serve_once(tag, rep, tracer, metrics_path=None,
+                       status_dir=None):
             report = FleetReport(metrics_path)
             sched = FleetScheduler(cfg, report=report,
                                    host_workers=args_ns.host_workers,
                                    user_timings=False,
-                                   scoring_by_width=True, tracer=tracer)
+                                   scoring_by_width=True, tracer=tracer,
+                                   compile_events=status_dir is not None)
+            status = alerts = None
+            if status_dir is not None:
+                # the plane-ON arm pays the WHOLE introspection plane:
+                # snapshots refreshing at the production cadence and the
+                # alert watcher evaluating per write
+                from consensus_entropy_tpu.obs.alerts import AlertWatcher
+                from consensus_entropy_tpu.obs.status import StatusWriter
+
+                status = StatusWriter(status_dir, "local",
+                                      interval_s=0.2)
+                alerts = AlertWatcher(report)
             server = FleetServer(sched, ServeConfig(
-                target_live=target, max_queue=max(n_users, 1)))
+                target_live=target, max_queue=max(n_users, 1)),
+                status=status, alerts=alerts)
             entries = [
                 FleetUser(data.user_id, factory(), data,
                           _mkdir(root, f"{tag}_{rep}_{i}"), seed=cfg.seed)
@@ -1499,17 +1517,28 @@ def run_obs_suite(args_ns) -> int:
                 spans_path = os.path.join(root, f"spans_{rep}.jsonl")
                 metrics_path = os.path.join(
                     root, f"metrics_{rep}", "fleet_metrics.jsonl")
+                status_dir = os.path.join(root, f"status_{rep}")
                 tracer = Tracer(spans_path,
                                 run_id=f"{cfg.mode}-{cfg.seed}")
-                walls["traced"], report = serve_once("traced", rep,
-                                                     tracer, metrics_path)
+                walls["traced"], report = serve_once(
+                    "traced", rep, tracer, metrics_path,
+                    status_dir=status_dir)
                 tracer.close()
                 report.write_summary(cohort=target)
                 report.close()
                 # artifact gates, every traced rep: schema-valid metrics,
-                # orphan-free merged spans, loadable Chrome export
+                # orphan-free merged spans, loadable Chrome export, and
+                # a schema-valid final status snapshot
                 errs = export.validate_metrics_file(metrics_path)
                 assert errs == [], f"schema violations: {errs[:3]}"
+                from consensus_entropy_tpu.obs.status import (
+                    read_status,
+                    status_path,
+                    validate_status,
+                )
+
+                snap = read_status(status_path(status_dir, "local"))
+                assert snap is not None and validate_status(snap) == []
                 spans = export.load_spans([spans_path])
                 assert spans and export.orphan_spans(spans) == []
                 json.dumps(export.chrome_trace(spans))
@@ -1553,7 +1582,7 @@ def run_obs_suite(args_ns) -> int:
          f"{best['traced']:.2f}s); traced {traced_ups:.3f} vs bare "
          f"{bare_ups:.3f} users/s best-of-{reps}")
     print(json.dumps({
-        "metric": f"obs_tracing_overhead_{n_users}u",
+        "metric": f"obs_introspection_overhead_{n_users}u",
         # the acceptance number (<= 3): median of per-rep paired
         # traced/bare wall ratios — pairing cancels the box's slow
         # drift; the identical-arm noise floor below gives the error bar

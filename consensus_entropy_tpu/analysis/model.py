@@ -85,7 +85,10 @@ class ProjectModel:
             values[key] = _extract_assignment(
                 os.path.join(package_root, rel), name)
         return cls(fault_points=frozenset(values["fault_points"]),
-                   event_fields={k: tuple(v) for k, v
+                   # v2.1 table: {kind: {field: type-kind}} — dicts kept
+                   # whole so the event-schema rule can check literal
+                   # argument TYPES, not just field presence
+                   event_fields={k: dict(v) for k, v
                                  in values["event_fields"].items()},
                    fused_donate={k: tuple(v) for k, v
                                  in values["fused_donate"].items()})

@@ -848,18 +848,48 @@ def check_fault_point_literal(tree, ctx):
 # -- rule 6: event-schema conformance ---------------------------------------
 
 
+#: what a LITERAL argument node must look like per schema field kind.
+#: Only literals are judged — a Name/Attribute/Call argument's runtime
+#: type is unknowable to a pure-AST pass, so those always pass here and
+#: ``obs.export.validate_metrics`` catches them at read time instead.
+def _literal_kind_ok(kind, node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        v = node.value
+        if kind == "str":
+            return isinstance(v, str)
+        if kind == "int":
+            return isinstance(v, int) and not isinstance(v, bool)
+        if kind == "float":
+            return (isinstance(v, (int, float))
+                    and not isinstance(v, bool))
+        if kind == "list":
+            return False  # a Constant is never a list literal
+        return True
+    if isinstance(node, (ast.List, ast.Tuple, ast.ListComp)):
+        return kind == "list"
+    if isinstance(node, (ast.Dict, ast.Set, ast.DictComp, ast.SetComp)):
+        return False  # literally the wrong container for every kind
+    # Name/Call/Attribute/...: runtime type unknowable to a pure-AST
+    # pass — obs.export.validate_metrics judges it at read time
+    return True
+
+
 @register(
     "event-schema",
     doc="every report.event(...) / EventWriter.emit({...}) literal "
-        "emit site must match obs.export.EVENT_FIELDS")
+        "emit site must match obs.export.EVENT_FIELDS (fields AND "
+        "literal argument types)")
 def check_event_schema(tree, ctx):
     """``obs.export.validate_metrics`` rejects malformed records at READ
     time — after the run already emitted them.  This check moves the
     contract to the emit site: a literal event kind must be registered
-    in ``EVENT_FIELDS``, and the call's keyword set must cover the
-    kind's required fields (a ``**kwargs`` splat defeats the field
-    check but the kind is still verified).  Extra fields are fine —
-    the schema lists the floor, not the ceiling."""
+    in ``EVENT_FIELDS``, the call's keyword set must cover the kind's
+    required fields (a ``**kwargs`` splat defeats the field check but
+    the kind is still verified), and a required field passed as a
+    LITERAL must hold the field's registered type kind — the v2.1
+    schema's str/int/float/list table (lint follow-on (d); non-literal
+    arguments are left to the runtime validator).  Extra fields are
+    fine — the schema lists the floor, not the ceiling."""
     model = ctx.model
     if not model.event_fields:
         return []
@@ -873,16 +903,24 @@ def check_event_schema(tree, ctx):
                 f"EVENT_FIELDS; register it (with its required fields) "
                 "or fix the literal"))
             return
-        if has_splat:
-            return
-        missing = [f for f in model.event_fields[kind]
-                   if f not in present]
-        if missing:
-            findings.append(ctx.finding(
-                "event-schema", node,
-                f"event {kind!r} emit site lacks required field(s) "
-                f"{missing}; EVENT_FIELDS requires "
-                f"{list(model.event_fields[kind])}"))
+        fields = model.event_fields[kind]
+        if not has_splat:
+            missing = [f for f in fields if f not in present]
+            if missing:
+                findings.append(ctx.finding(
+                    "event-schema", node,
+                    f"event {kind!r} emit site lacks required field(s) "
+                    f"{missing}; EVENT_FIELDS requires "
+                    f"{list(fields)}"))
+        for field, value in present.items():
+            want = fields.get(field) if isinstance(fields, dict) else None
+            if want and value is not None \
+                    and not _literal_kind_ok(want, value):
+                findings.append(ctx.finding(
+                    "event-schema", node,
+                    f"event {kind!r} field {field!r} must be {want} "
+                    f"(EVENT_FIELDS v2.1 kind table); this literal "
+                    "argument is not"))
 
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call) \
@@ -895,7 +933,7 @@ def check_event_schema(tree, ctx):
             if not (isinstance(kind, ast.Constant)
                     and isinstance(kind.value, str)):
                 continue
-            present = {kw.arg for kw in node.keywords
+            present = {kw.arg: kw.value for kw in node.keywords
                        if kw.arg is not None}
             has_splat = any(kw.arg is None for kw in node.keywords)
             check_kind(node, kind.value, present, has_splat)
@@ -912,5 +950,5 @@ def check_event_schema(tree, ctx):
             kind = keys.get("event")
             if isinstance(kind, ast.Constant) \
                     and isinstance(kind.value, str):
-                check_kind(node, kind.value, set(keys), has_splat)
+                check_kind(node, kind.value, keys, has_splat)
     return findings

@@ -242,6 +242,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "via journaled records — never hand-edit the "
                         "jsonl — then exit (the users become submittable "
                         "again with a fresh failure budget)")
+    p.add_argument("--drain-host", default=None, metavar="H",
+                   help="elastic fabric operator command: drain host H "
+                        "through the journaled scale-down machinery the "
+                        "moment it is live (drain record + drop-ack "
+                        "rebalance + checkpoint-fenced migration + "
+                        "drain_done retirement — exactly the "
+                        "--scale-down-s path, operator-initiated); "
+                        "requires --min-hosts/--max-hosts")
+    p.add_argument("--no-introspection", action="store_true",
+                   help="fleet/serve/fabric: disable the live "
+                        "introspection plane — control-plane trace "
+                        "lane, jit-compile events, status_<host>.json "
+                        "snapshots (the cetpu-top feed) and SLO "
+                        "burn-rate alerts (ON by default; observation "
+                        "only, per-user results are bit-identical "
+                        "either way)")
     p.add_argument("--fabric-worker", default=None, help=argparse.SUPPRESS)
     p.add_argument("--fabric-dir", default=None, help=argparse.SUPPRESS)
     p.add_argument("--seed", type=int, default=1987)
@@ -423,6 +439,7 @@ def main(argv=None) -> int:
                 hosts=args.hosts, lease_s=args.lease_s,
                 min_hosts=args.min_hosts, max_hosts=args.max_hosts,
                 scale_down_s=args.scale_down_s,
+                drain_host=args.drain_host,
                 placement=args.placement,
                 # the fleet planner must not fight explicit operator
                 # edges or a disabled local planner
@@ -432,9 +449,10 @@ def main(argv=None) -> int:
             print(f"invalid fabric config: {e}")
             return 1
     elif args.min_hosts is not None or args.max_hosts is not None \
-            or args.scale_down_s:
-        print("--min-hosts/--max-hosts/--scale-down-s require --hosts "
-              "(the elastic fabric scales a multi-host fleet)")
+            or args.scale_down_s or args.drain_host is not None:
+        print("--min-hosts/--max-hosts/--scale-down-s/--drain-host "
+              "require --hosts (the elastic fabric scales a multi-host "
+              "fleet)")
         return 1
     if args.fabric_worker is not None and (args.fabric_dir is None
                                            or args.serve is None):
@@ -650,6 +668,22 @@ def _interactive_set(args) -> set:
             if u.strip()}
 
 
+def _introspection(args, paths, host, report, log=None):
+    """The live introspection plane's per-process limbs: a
+    ``status_<host>.json`` writer under ``users/status/`` and an alert
+    watcher emitting schema ``alert`` events through ``report`` (plus
+    ``log`` — the coordinator passes ``print`` so alerts reach its
+    console).  ``(None, None)`` under ``--no-introspection`` — the
+    PR 14 arm."""
+    if args.no_introspection:
+        return None, None
+    from consensus_entropy_tpu.obs.alerts import AlertWatcher
+    from consensus_entropy_tpu.obs.status import StatusWriter
+
+    status = StatusWriter(os.path.join(paths.users_dir, "status"), host)
+    return status, AlertWatcher(report, log=log)
+
+
 def _build_tracer(args, cfg, path, host=None):
     """The obs span tracer for fleet/serve/fabric drivers.  ``run_id``
     derives from (mode, seed) — deterministic, so a restarted run and
@@ -680,7 +714,8 @@ def _run_users_fleet(args, cfg, paths, users, pool, anno, hc_table, store,
         stack_cnn=not args.no_stack_cnn, plan_chunk=args.plan_chunk,
         fuse_step=not args.no_fuse_step, tracer=tracer,
         jax_profile_dir=args.jax_profile,
-        jax_profile_n=args.jax_profile_n)
+        jax_profile_n=args.jax_profile_n,
+        compile_events=not args.no_introspection)
     todo = list(users[: args.max_users])
     failed = []
     try:
@@ -809,9 +844,12 @@ def _run_users_serve(args, cfg, paths, users, pool, anno, hc_table, store,
         scoring_by_width=True, stack_cnn=not args.no_stack_cnn,
         plan_chunk=args.plan_chunk, fuse_step=not args.no_fuse_step,
         tracer=tracer, jax_profile_dir=args.jax_profile,
-        jax_profile_n=args.jax_profile_n)
+        jax_profile_n=args.jax_profile_n,
+        compile_events=not args.no_introspection)
+    status, alerts = _introspection(args, paths, "local", report)
     server = FleetServer(scheduler, _serve_config(args),
-                         preemption=guard, journal=journal, poison=poison)
+                         preemption=guard, journal=journal, poison=poison,
+                         status=status, alerts=alerts)
 
     todo = list(users[: args.max_users])
     if journal is not None and journal.recovered:
@@ -974,7 +1012,7 @@ def _run_users_fabric(args, cfg, paths, users, pool, anno, guard) -> None:
     worker_argv = []
     skip_next = False
     coordinator_flags = ("--hosts", "--min-hosts", "--max-hosts",
-                         "--placement", "--scale-down-s")
+                         "--placement", "--scale-down-s", "--drain-host")
     for arg in args._raw_argv:
         if skip_next:
             skip_next = False
@@ -1010,9 +1048,13 @@ def _run_users_fabric(args, cfg, paths, users, pool, anno, guard) -> None:
     tracer = _build_tracer(args, cfg,
                            os.path.join(paths.users_dir, "spans.jsonl"),
                            host="coordinator")
+    status, alerts = _introspection(args, paths, "coordinator", report,
+                                    log=print)
     coord = FabricCoordinator(
         journal, fabric_dir, args._fabric_config,
-        poison=poison, report=report, preemption=guard, tracer=tracer)
+        poison=poison, report=report, preemption=guard, tracer=tracer,
+        status=status, alerts=alerts,
+        introspect=not args.no_introspection)
     interactive = _interactive_set(args)
     # enqueue-time pool sizes (songs in the feature pool the user
     # annotated) — journaled on enqueue, so bucket-aware placement
@@ -1033,6 +1075,10 @@ def _run_users_fabric(args, cfg, paths, users, pool, anno, guard) -> None:
         journal.close()
         poison.close()
         report.close()
+    if summary.get("drain_host_unserviced"):
+        print(f"WARNING: --drain-host {summary['drain_host_unserviced']} "
+              "was never serviced (host never live+joined this run) — "
+              "nothing was drained")
     print("fabric summary: " + json.dumps(
         {"users": summary["users"], "finished": len(summary["finished"]),
          "failed": len(summary["failed"]),
@@ -1083,7 +1129,7 @@ def _run_users_fabric_worker(args, cfg, paths, users, pool, anno,
         host_workers=args.fleet_host_workers, report=report,
         scoring_by_width=True, stack_cnn=not args.no_stack_cnn,
         plan_chunk=args.plan_chunk, fuse_step=not args.no_fuse_step,
-        tracer=tracer)
+        tracer=tracer, compile_events=not args.no_introspection)
 
     def build_entry(uid):
         u_id = by_id.get(uid, uid)
@@ -1118,11 +1164,14 @@ def _run_users_fabric_worker(args, cfg, paths, users, pool, anno,
         print(f"user {rec['user']}: final mean F1 = "
               f"{rec['result']['final_mean_f1']:.4f}")
 
+    status, alerts = _introspection(args, paths, args.fabric_worker,
+                                    report)
     try:
         run_worker(
             args.fabric_dir, args.fabric_worker, build_entry=build_entry,
             scheduler=scheduler, config=_serve_config(args),
-            on_result=on_result, lease_s=args.lease_s, preemption=guard)
+            on_result=on_result, lease_s=args.lease_s, preemption=guard,
+            status=status, alerts=alerts)
     finally:
         tracer.close()
         # the per-host fleet_summary carries THIS host's admission→finish

@@ -1,0 +1,177 @@
+"""``cetpu-top``: the live fleet view over status snapshots.
+
+Reads the ``status_<host>.json`` files the introspection plane's writers
+(``obs.status.StatusWriter``) refresh — one per serve worker plus the
+fabric coordinator — and renders a fleet-wide console view: per-host
+queue depths and live sessions, bucket occupancy, drain/fence state,
+planner edges, jit-cache pressure and active SLO burn-rate alerts.
+
+Torn-read tolerant by construction: snapshots are atomic-rename files
+and the reader (:func:`~consensus_entropy_tpu.obs.status.read_status`)
+skips anything unparseable, so attaching mid-write, mid-copy or mid-run
+never crashes the view.  A snapshot older than ``--stale-s`` renders
+flagged — a wedged (or dead) writer LOOKS stale, which is exactly the
+signal.
+
+Pure host code, no jax: point it at a live run's ``users/`` directory
+(or the ``status/`` directory itself) on any machine the files are
+visible from::
+
+    cetpu-top models/users            # watch live (1 s refresh)
+    cetpu-top models/users --once     # one frame (CI / scripts)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def resolve_status_dir(path: str) -> str:
+    """Accept either the ``status/`` directory itself or a ``users/``
+    directory containing one."""
+    sub = os.path.join(path, "status")
+    if os.path.isdir(sub):
+        return sub
+    return path
+
+
+def _age(snap: dict, now: float) -> float | None:
+    t = snap.get("t")
+    return max(now - t, 0.0) if isinstance(t, (int, float)) else None
+
+
+def _fmt_age(age: float | None, stale_s: float) -> str:
+    if age is None:
+        return "?"
+    flag = " STALE" if age > stale_s else ""
+    return f"{age:.1f}s{flag}"
+
+
+def _alert_lines(snap: dict) -> list[str]:
+    out = []
+    for alert in snap.get("alerts") or []:
+        detail = " ".join(f"{k}={v}" for k, v in sorted(alert.items())
+                          if k not in ("kind", "key"))
+        out.append(f"    ! {alert.get('kind')}: {detail}")
+    return out
+
+
+def render(snaps: dict, *, now: float, stale_s: float = 10.0) -> str:
+    """One frame of the fleet view (pure function of the snapshots —
+    unit-testable; the watch loop just reprints it)."""
+    if not snaps:
+        return ("cetpu-top: no status snapshots yet (is the run live, "
+                "and introspection on?)")
+    lines = []
+    # the coordinator frame first (it carries the fleet shape)
+    coord_keys = [h for h, s in snaps.items() if "hosts" in s]
+    for key in sorted(coord_keys):
+        s = snaps[key]
+        age = _fmt_age(_age(s, now), stale_s)
+        lines.append(f"[{key}] fleet — updated {age} ago")
+        lines.append(
+            f"    unresolved={s.get('unresolved')} "
+            f"queued={s.get('queued')} in_flight={s.get('in_flight')} "
+            f"spawns={s.get('spawns')} joins={s.get('joins')} "
+            f"migrations={s.get('migrations')} "
+            f"fences={s.get('fences')} drains={s.get('drains')}")
+        if s.get("edges"):
+            lines.append(f"    fleet edges: {s['edges']}")
+        if s.get("draining_host"):
+            lines.append(f"    draining: {s['draining_host']}")
+        for hid, hv in sorted((s.get("hosts") or {}).items()):
+            state = ("draining" if hv.get("draining")
+                     else "live" if hv.get("alive") else "down")
+            beat = hv.get("lease_age_s")
+            beat = f"{beat:.1f}s" if isinstance(beat, (int, float)) \
+                else "-"
+            lines.append(f"    {hid:<6} {state:<9} "
+                         f"load={hv.get('load')} lease_age={beat}")
+        lines.extend(_alert_lines(s))
+    # worker frames
+    for key in sorted(h for h in snaps if h not in coord_keys):
+        s = snaps[key]
+        age = _fmt_age(_age(s, now), stale_s)
+        flags = []
+        if s.get("draining"):
+            flags.append("DRAINING")
+        if not s.get("intake_open", True):
+            flags.append("intake-closed")
+        if s.get("fences_pending"):
+            flags.append(f"fences={s['fences_pending']}")
+        queued = s.get("queued") or {}
+        qtxt = " ".join(f"{cls}:{n}" for cls, n in sorted(queued.items()))
+        lines.append(
+            f"[{key}] live={s.get('live')}/{s.get('target_live')} "
+            f"queue={s.get('queue_total')} ({qtxt or '-'}) "
+            f"done={s.get('users_done')} failed={s.get('users_failed')}"
+            f"{' ' + ' '.join(flags) if flags else ''}"
+            f" — updated {age} ago")
+        planner = s.get("planner") or {}
+        if planner.get("edges"):
+            lines.append(f"    edges={planner['edges']} "
+                         f"(obs={planner.get('observations')}, "
+                         f"holds adm={planner.get('admission_hold_rounds')}"
+                         f"/disp={planner.get('dispatch_hold_rounds')})")
+        for width, b in sorted((s.get("buckets") or {}).items(),
+                               key=lambda kv: int(kv[0])):
+            lines.append(f"    bucket {width}: occ={b.get('occupancy')} "
+                         f"batch={b.get('mean_batch')} "
+                         f"n={b.get('dispatches')}")
+        if s.get("breaker"):
+            lines.append(f"    breaker: {s['breaker']}")
+        jit = s.get("jit") or {}
+        if jit:
+            lines.append(f"    jit: families={jit.get('families')} "
+                         f"hits={jit.get('hits')} "
+                         f"builds={jit.get('builds')} "
+                         f"compiles={jit.get('compiles')} "
+                         f"resident={jit.get('resident')}")
+        lines.extend(_alert_lines(s))
+    return "\n".join(lines)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="Live fleet view over the introspection plane's "
+                    "status_<host>.json snapshots")
+    p.add_argument("status_dir",
+                   help="the run's users/ directory (or its status/ "
+                        "subdirectory)")
+    p.add_argument("--interval", type=float, default=1.0, metavar="S",
+                   help="refresh period for the watch loop (default 1)")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit (CI / scripts)")
+    p.add_argument("--stale-s", type=float, default=10.0, metavar="S",
+                   help="flag snapshots older than this as STALE "
+                        "(default 10)")
+    return p
+
+
+def main(argv=None) -> int:
+    from consensus_entropy_tpu.obs.status import read_status_dir
+
+    args = build_parser().parse_args(argv)
+    status_dir = resolve_status_dir(args.status_dir)
+    if args.once:
+        print(render(read_status_dir(status_dir), now=time.time(),
+                     stale_s=args.stale_s))
+        return 0
+    try:
+        while True:
+            frame = render(read_status_dir(status_dir), now=time.time(),
+                           stale_s=args.stale_s)
+            # clear + home, then the frame: a flicker-free enough watch
+            # loop without a curses dependency
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(max(args.interval, 0.1))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

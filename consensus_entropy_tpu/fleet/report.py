@@ -128,9 +128,11 @@ class FleetReport:
             rec["h2d_ops"] = h2d_ops
         self.dispatches.append(rec)
 
-    def event(self, kind: str, **fields) -> None:
+    def event(self, kind: str, /, **fields) -> None:
         """Cohort-level event (evict / resume / user_done / user_failed /
-        enqueue / admit / drain)."""
+        enqueue / admit / drain / compile / alert).  ``kind`` is
+        positional-ONLY so a payload field may itself be named ``kind``
+        (the ``alert`` events carry one)."""
         self._emit({"event": kind, "t_s": round(self.elapsed_s(), 3),
                     **fields})
 
@@ -201,6 +203,14 @@ class FleetReport:
         if attempts is not None:
             rec["attempts"] = attempts
         self.event("user_failed", **rec)
+
+    def class_p95s(self) -> dict:
+        """``{class: observed p95 admission→finish latency}`` (``None``
+        before a class resolved anyone) — the SLO burn-rate alert
+        kernel's input.  Thread-safe."""
+        with self._lock:
+            return {cls: h.percentile(95)
+                    for cls, h in self._class_latency.items()}
 
     def elapsed_s(self) -> float:
         return time.perf_counter() - self._t0
@@ -330,6 +340,25 @@ class FleetReport:
             n = sum(e["event"] == event for e in self.events)
             if n:
                 out[key] = n
+        compiles = [e for e in self.events if e.get("event") == "compile"]
+        if compiles:
+            # jit-compile telemetry (obs.jit_telemetry → the scheduler's
+            # compile events): family builds, dispatch-attributed XLA
+            # compiles and their summed wall — the cost feed the SLO
+            # planner's cost-aware-edges follow-on reads; absent when no
+            # family was built this run, so warm-cache summaries (and
+            # committed BENCH artifacts) stay byte-stable
+            out["jit"] = {
+                "events": len(compiles),
+                "builds": sum(1 for e in compiles
+                              if e.get("phase") == "build"),
+                "xla_compiles": sum(1 for e in compiles
+                                    if e.get("phase") == "xla"),
+                "compile_wall_s": round(sum(e.get("build_s") or 0.0
+                                            for e in compiles), 4),
+                "resident": max((e.get("resident") or 0
+                                 for e in compiles), default=0),
+            }
         per_bucket = self.per_bucket_occupancy
         if per_bucket is not None:
             out["per_bucket"] = per_bucket

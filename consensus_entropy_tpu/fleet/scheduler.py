@@ -66,6 +66,7 @@ import jax.numpy as jnp
 
 from consensus_entropy_tpu.config import ALConfig
 from consensus_entropy_tpu.fleet.report import FleetReport
+from consensus_entropy_tpu.obs import jit_telemetry
 from consensus_entropy_tpu.fleet.session import (
     DeviceStep,
     HostStep,
@@ -151,7 +152,8 @@ class FleetScheduler:
                  stack_cnn: bool = True, plan_chunk: int | None = None,
                  fuse_step: bool = True, tracer=None,
                  jax_profile_dir: str | None = None,
-                 jax_profile_n: int = 10, hold=None):
+                 jax_profile_n: int = 10, hold=None,
+                 compile_events: bool = True):
         self.config = config
         self.tie_break = tie_break
         self.retrain_epochs = retrain_epochs
@@ -248,6 +250,14 @@ class FleetScheduler:
         self._jax_profile_dir = jax_profile_dir
         self._jax_profile_left = jax_profile_n if jax_profile_dir else 0
         self._jax_profiling = False
+        #: jit-compile telemetry (``obs.jit_telemetry``): while the
+        #: engine is open, family builds and dispatch-attributed XLA
+        #: compile walls land in this report's metrics stream as
+        #: schema-registered ``compile`` events — the feed the SLO
+        #: planner's cost-aware-edges follow-on reads.  ``False`` is the
+        #: ``--no-introspection`` arm (events off; the process-wide
+        #: counters still accumulate for snapshots).
+        self.compile_events = compile_events
         self._opened = False
 
     # -- engine lifecycle --------------------------------------------------
@@ -263,6 +273,12 @@ class FleetScheduler:
         capacity = max(1, capacity)
         host_n = self.host_workers or min(capacity, os.cpu_count() or 4, 8)
         ckpt_n = self.ckpt_workers or min(capacity, 4)
+        if self.compile_events:
+            # subscribe BEFORE the first family build below, or a fresh
+            # process's fleet-family build event (often the largest
+            # wrapper build) would fire with no listener and never
+            # reach the metrics stream
+            jit_telemetry.subscribe(self._on_compile)
         self._fleet_fns = ops_scoring.make_fleet_scoring_fns(
             k=self.config.queries, tie_break=self.tie_break)
         self._results: dict = {}
@@ -399,7 +415,21 @@ class FleetScheduler:
         if self._jax_profiling:  # fewer than N stacked dispatches ran
             jax.profiler.stop_trace()
             self._jax_profiling = False
+        jit_telemetry.unsubscribe(self._on_compile)
         self._opened = False
+
+    def _on_compile(self, ev: dict) -> None:
+        """Forward one jit-telemetry event (family build, or a dispatch-
+        attributed XLA compile) into the metrics stream as a ``compile``
+        event — fires on whichever thread compiled; the report's writer
+        is locked."""
+        fields = {"fn": str(ev.get("fn")),
+                  "build_s": round(float(ev.get("build_s") or 0.0), 6),
+                  "phase": str(ev.get("phase") or "build")}
+        for key in ("width", "n_devices", "resident"):
+            if ev.get(key) is not None:
+                fields[key] = ev[key]
+        self.report.event("compile", **fields)
 
     def _shutdown_host_pool(self) -> None:
         """Join the host pool.  Without a watchdog this blocks until every
@@ -908,7 +938,10 @@ class FleetScheduler:
         def dispatch():
             faults.fire("serve.dispatch", fn=fn_key, width=width,
                         batch=len(group))
-            return self._group_fns(width)[fn_key](*stacked)
+            # attribute any XLA compile this call triggers to the
+            # (fn, width) jit family (obs.jit_telemetry compile events)
+            with jit_telemetry.dispatch_scope(fn_key, width=width):
+                return self._group_fns(width)[fn_key](*stacked)
 
         self._profile_start()
         try:
@@ -951,7 +984,8 @@ class FleetScheduler:
         def dispatch():
             faults.fire("serve.dispatch", fn=fn_key, width=width,
                         batch=len(group))
-            return committee_mod.stage_device_plans(plans)
+            with jit_telemetry.dispatch_scope(fn_key, width=width):
+                return committee_mod.stage_device_plans(plans)
 
         self._profile_start()
         computed = (self.watchdog.call(dispatch,
@@ -976,7 +1010,9 @@ class FleetScheduler:
         def dispatch():
             faults.fire("serve.dispatch", fn=fn_key,
                         width=step.session.acq.n_pad, batch=1)
-            return run()
+            with jit_telemetry.dispatch_scope(fn_key,
+                                              width=step.session.acq.n_pad):
+                return run()
 
         if self.watchdog is not None:
             return self.watchdog.call(dispatch, f"dispatch {fn_key}x1")

@@ -31,6 +31,7 @@ import numpy as np
 
 from consensus_entropy_tpu import native
 from consensus_entropy_tpu.config import CNNConfig, NUM_CLASSES, TrainConfig
+from consensus_entropy_tpu.obs import jit_telemetry
 from consensus_entropy_tpu.resilience import faults
 from consensus_entropy_tpu.data.audio import DeviceWaveformStore
 from consensus_entropy_tpu.models import short_cnn
@@ -224,8 +225,17 @@ def _concat_member_blocks(blocks):
     return xp.concatenate(blocks, axis=1)
 
 
-@functools.lru_cache(maxsize=None)
 def _infer_fns(config: CNNConfig, mesh):
+    """The telemetered cache wrapper: every lookup feeds the jit-family
+    hit/miss counters (``obs.jit_telemetry``), the once-per-key build is
+    timed inside the cached impl."""
+    jit_telemetry.note_lookup("cnn_infer",
+                              n_devices=mesh.size if mesh else 1)
+    return _infer_fns_cached(config, mesh)
+
+
+@functools.lru_cache(maxsize=None)
+def _infer_fns_cached(config: CNNConfig, mesh):
     """Process-wide jitted committee-inference programs for ``config``.
 
     Returns ``(infer, infer_windows)``: the stacked-member crop forward and
@@ -242,6 +252,8 @@ def _infer_fns(config: CNNConfig, mesh):
     hashes by value, so an equal mesh rebuilt per round still hits.
     """
 
+    b0 = jit_telemetry.build_timer()
+
     def infer(stacked, x):
         return short_cnn.committee_infer(stacked, x, config)
 
@@ -256,37 +268,58 @@ def _infer_fns(config: CNNConfig, mesh):
                 / jnp.sum(weight, axis=1)[None, :, None])
 
     if mesh is None:
-        return jax.jit(infer), jax.jit(windows_forward)
+        fns = (jax.jit(infer), jax.jit(windows_forward))
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from jax.sharding import NamedSharding, PartitionSpec as P
+        from consensus_entropy_tpu.parallel.mesh import POOL_AXIS
 
-    from consensus_entropy_tpu.parallel.mesh import POOL_AXIS
+        repl = NamedSharding(mesh, P())
+        rows_sh = NamedSharding(mesh, P(POOL_AXIS))
+        out_sh = NamedSharding(mesh, P(None, POOL_AXIS, None))
+        fns = (jax.jit(infer, in_shardings=(repl, rows_sh),
+                       out_shardings=out_sh),
+               jax.jit(windows_forward,
+                       in_shardings=(repl, rows_sh, rows_sh),
+                       out_shardings=out_sh))
+    jit_telemetry.note_build("cnn_infer",
+                             n_devices=mesh.size if mesh else 1,
+                             build_s=jit_telemetry.build_timer() - b0,
+                             jit_fns=fns)
+    return fns
 
-    repl = NamedSharding(mesh, P())
-    rows_sh = NamedSharding(mesh, P(POOL_AXIS))
-    out_sh = NamedSharding(mesh, P(None, POOL_AXIS, None))
-    return (jax.jit(infer, in_shardings=(repl, rows_sh),
-                    out_shardings=out_sh),
-            jax.jit(windows_forward, in_shardings=(repl, rows_sh, rows_sh),
-                    out_shardings=out_sh))
+
+def _qbdc_infer_fn(config: CNNConfig):
+    jit_telemetry.note_lookup("qbdc_infer")
+    return _qbdc_infer_fn_cached(config)
 
 
 @functools.lru_cache(maxsize=None)
-def _qbdc_infer_fn(config: CNNConfig):
+def _qbdc_infer_fn_cached(config: CNNConfig):
     """Process-wide jitted QBDC forward for ``config`` (same sharing
     rationale as :func:`_infer_fns`: committees are rebuilt per user, the
     program is pure in its operands).  One executable serves every user
     and every K — the mask-key operand's leading axis is the committee
     width, so jit specializes per K, cached like any shape."""
+    b0 = jit_telemetry.build_timer()
 
     def infer(variables, x, mask_keys):
         return short_cnn.qbdc_infer(variables, x, mask_keys, config)
 
-    return jax.jit(infer)
+    fn = jax.jit(infer)
+    jit_telemetry.note_build("qbdc_infer",
+                             build_s=jit_telemetry.build_timer() - b0,
+                             jit_fns=(fn,))
+    return fn
+
+
+def _user_infer_fn(config: CNNConfig):
+    jit_telemetry.note_lookup("cnn_infer_users")
+    return _user_infer_fn_cached(config)
 
 
 @functools.lru_cache(maxsize=None)
-def _user_infer_fn(config: CNNConfig):
+def _user_infer_fn_cached(config: CNNConfig):
     """Process-wide jitted CROSS-USER committee forward for ``config``:
     ``short_cnn.committee_infer_users`` over ``(U, M, …)`` stacked user
     params and ``(U, bucket, L)`` crop batches.  One cache entry per
@@ -294,24 +327,39 @@ def _user_infer_fn(config: CNNConfig):
     bucket's cohort geometry owns its compiled program — the per-width
     executable-lifetime property ``fleet_scoring_fns_for_width`` gives the
     reduction scorers, inherited here through shape keying."""
+    b0 = jit_telemetry.build_timer()
 
     def infer(user_stacked, x):
         return short_cnn.committee_infer_users(user_stacked, x, config)
 
-    return jax.jit(infer)
+    fn = jax.jit(infer)
+    jit_telemetry.note_build("cnn_infer_users",
+                             build_s=jit_telemetry.build_timer() - b0,
+                             jit_fns=(fn,))
+    return fn
+
+
+def _user_qbdc_infer_fn(config: CNNConfig):
+    jit_telemetry.note_lookup("qbdc_infer_users")
+    return _user_qbdc_infer_fn_cached(config)
 
 
 @functools.lru_cache(maxsize=None)
-def _user_qbdc_infer_fn(config: CNNConfig):
+def _user_qbdc_infer_fn_cached(config: CNNConfig):
     """Cross-user QBDC forward (``short_cnn.qbdc_infer_users``), cached
     like :func:`_user_infer_fn`.  Takes raw mask-key DATA ``(U, K, …)``
     (typed keys re-wrapped inside the jit)."""
+    b0 = jit_telemetry.build_timer()
 
     def infer(user_variables, x, mask_key_data):
         return short_cnn.qbdc_infer_users(user_variables, x, mask_key_data,
                                           config)
 
-    return jax.jit(infer)
+    fn = jax.jit(infer)
+    jit_telemetry.note_build("qbdc_infer_users",
+                             build_s=jit_telemetry.build_timer() - b0,
+                             jit_fns=(fn,))
+    return fn
 
 
 class Committee:

@@ -14,10 +14,26 @@ files nobody merged):
   retrain, checkpoint, admission_wait}``); trace/span ids derive
   deterministically from ``(run_id, user, iteration)`` so a resumed or
   failed-over user CONTINUES its trace instead of starting a new one.
-- :mod:`obs.export` — torn-tail-tolerant readers, schema-v2 validation,
-  the multi-host spans+metrics merge, Chrome trace-event export
-  (Perfetto-loadable, one lane per host/worker/bucket) and the text
-  report behind ``python -m consensus_entropy_tpu.cli.report``.
+- :mod:`obs.export` — torn-tail-tolerant readers, schema-v2 validation
+  (field presence AND per-field kinds), the multi-host spans+metrics
+  merge, Chrome trace-event export (Perfetto-loadable, one lane per
+  host/worker/bucket plus the ``control-plane`` decision lane with flow
+  links into user traces) and the text report behind ``python -m
+  consensus_entropy_tpu.cli.report``.
+
+The LIVE introspection plane (ISSUE 15) rides on top:
+
+- :mod:`obs.jit_telemetry` — process-wide jit-family build/lookup/compile
+  counters with resident-executable polling, fed by the ``ops.scoring``
+  and ``models.committee`` family caches and attributed per dispatch by
+  the fleet scheduler; the cost feed the SLO planner's cost-aware-edges
+  follow-on needs.
+- :mod:`obs.status` — atomic-rename per-host ``status_<h>.json``
+  snapshots (torn-read tolerant by construction) that ``cetpu-top``
+  renders into a live fleet view.
+- :mod:`obs.alerts` — pure-function SLO burn-rate watchers over existing
+  planner/queue/breaker/lease telemetry, surfaced as edge-triggered
+  schema-registered ``alert`` events.
 """
 
 from consensus_entropy_tpu.obs.metrics import (  # noqa: F401

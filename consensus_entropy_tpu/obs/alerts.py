@@ -1,0 +1,157 @@
+"""SLO burn-rate alerts: pure-function watchers over telemetry the
+stack already records.
+
+The serving stack KNOWS when it is in trouble — the planner tracks
+per-class SLO headroom, the queue knows how long its batch head has
+aged, the breaker knows which widths are degraded, the coordinator knows
+which leases are about to expire — but until this module nothing TOLD
+anyone: an operator discovered a breaker-open bucket by reading
+``fleet_metrics.jsonl`` after the run.  Each kernel here is a pure
+function of observed telemetry (injected ``now``, unit-testable to the
+boundary), and :class:`AlertWatcher` edge-triggers the schema-registered
+``alert`` event (``obs.export.EVENT_FIELDS``) when an alert RISES —
+re-evaluations while it stays active are silent, so a wedged fleet
+doesn't flood its own metrics stream.
+
+Alerts change WHEN operators look, never results: nothing journaled or
+replayed reads an alert, and ``--no-introspection`` removes the watcher
+wholesale (the PR 14 arm — bit-exact parity pinned by the obs bench).
+
+Alert kinds (the README "Observability" table renders these):
+
+- ``slo_headroom`` — a priority class's p95 admission→finish latency has
+  burned past ``burn_frac`` of its SLO target: the tail is about to
+  breach, before it actually does.
+- ``batch_aging`` — the queue's batch-class head has waited past the
+  aging bound: strict priority is starving throughput work and the aging
+  guard is doing real work.
+- ``breaker_open`` — a bucket width is degraded to per-user dispatch
+  (open or spent breaker): stacked throughput is gone on that width.
+- ``lease_expiry`` — a worker's lease age has burned past ``burn_frac``
+  of the lease: the host is about to be declared dead and failed over.
+"""
+
+from __future__ import annotations
+
+ALERT_KINDS = ("slo_headroom", "batch_aging", "breaker_open",
+               "lease_expiry")
+
+#: default fraction of a bound an observation may burn before alerting
+BURN_FRAC = 0.8
+
+
+def slo_headroom_alerts(per_class_p95: dict, slo_s: dict, *,
+                        burn_frac: float = BURN_FRAC) -> list[dict]:
+    """``per_class_p95``: observed p95 admission→finish latency per
+    priority class; ``slo_s``: the per-class targets.  Fires per class
+    whose p95 burned past ``burn_frac`` of its target."""
+    out = []
+    for cls in sorted(per_class_p95):
+        p95, target = per_class_p95[cls], slo_s.get(cls)
+        if p95 is None or not target or target <= 0:
+            continue
+        if p95 >= burn_frac * target:
+            out.append({"kind": "slo_headroom", "key": cls, "cls": cls,
+                        "p95_s": round(float(p95), 4),
+                        "slo_s": float(target),
+                        "burn": round(float(p95) / target, 4)})
+    return out
+
+
+def batch_aging_alerts(head_waits: dict, aging_s: float) -> list[dict]:
+    """``head_waits``: seconds each non-empty queue class's head entry
+    has waited (``AdmissionQueue.head_waits``).  Fires per non-top class
+    whose head aged past the bound (aging 0 = guard off, never fires)."""
+    if not aging_s or aging_s <= 0:
+        return []
+    out = []
+    for cls in sorted(head_waits):
+        if cls == "interactive":
+            continue  # the top class never ages past itself
+        wait = head_waits[cls]
+        if wait is not None and wait >= aging_s:
+            out.append({"kind": "batch_aging", "key": cls, "cls": cls,
+                        "head_wait_s": round(float(wait), 4),
+                        "aging_s": float(aging_s)})
+    return out
+
+
+def breaker_alerts(breaker_states: dict | None) -> list[dict]:
+    """``breaker_states``: ``{width: state}`` from
+    ``DispatchBreaker.summary`` — which also lists CLOSED widths that
+    merely have recent failures, so closed entries are skipped here:
+    only a width actually degraded to per-user dispatch (open /
+    half_open probing / given up) alerts."""
+    out = []
+    for width, state in sorted((breaker_states or {}).items()):
+        if str(state) == "closed":
+            continue  # failures counted, but stacked dispatch intact
+        out.append({"kind": "breaker_open", "key": str(width),
+                    "width": int(width), "state": str(state)})
+    return out
+
+
+def lease_alerts(lease_ages: dict, lease_s: float, *,
+                 burn_frac: float = BURN_FRAC) -> list[dict]:
+    """``lease_ages``: seconds since each live host's last heartbeat
+    (``None`` = never beat yet, not alertable — spawn grace owns that).
+    Fires per host whose age burned past ``burn_frac`` of the lease."""
+    if not lease_s or lease_s <= 0:
+        return []
+    out = []
+    for host in sorted(lease_ages):
+        age = lease_ages[host]
+        if age is not None and age >= burn_frac * lease_s:
+            out.append({"kind": "lease_expiry", "key": str(host),
+                        "host": str(host),
+                        "age_s": round(float(age), 4),
+                        "lease_s": float(lease_s)})
+    return out
+
+
+class AlertWatcher:
+    """Edge-triggered alert surface: :meth:`update` takes the round's
+    full evaluated alert list, emits a schema ``alert`` event (plus an
+    operator log line via ``log``) for each NEWLY-risen ``(kind, key)``,
+    and keeps the active set for snapshots.  An alert that stops holding
+    simply leaves the active set — re-rising re-emits."""
+
+    def __init__(self, report=None, *, log=None):
+        self.report = report
+        self.log = log
+        self.fired = 0
+        #: (kind, key) -> the alert dict, as currently active
+        self._active: dict[tuple, dict] = {}
+
+    def update(self, alerts: list[dict]) -> list[dict]:
+        """Fold one evaluation round; returns the alerts that ROSE."""
+        now_keys = set()
+        rose = []
+        for alert in alerts:
+            key = (alert.get("kind"), alert.get("key"))
+            now_keys.add(key)
+            if key not in self._active:
+                rose.append(alert)
+            self._active[key] = alert
+        for key in list(self._active):
+            if key not in now_keys:
+                del self._active[key]
+        for alert in rose:
+            self.fired += 1
+            if self.report is not None:
+                fields = {k: v for k, v in alert.items() if k != "key"}
+                self.report.event("alert", **fields)
+            if self.log is not None:
+                detail = " ".join(f"{k}={v}" for k, v in
+                                  sorted(alert.items())
+                                  if k not in ("kind", "key"))
+                self.log(f"ALERT [{alert.get('kind')}] {detail}")
+        return rose
+
+    @property
+    def active(self) -> list[dict]:
+        """The currently-active alerts (snapshot surface), stable
+        order."""
+        return [self._active[k] for k in sorted(self._active,
+                                                key=lambda kv: (str(kv[0]),
+                                                                str(kv[1])))]

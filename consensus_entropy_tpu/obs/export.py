@@ -20,68 +20,94 @@ import glob
 import json
 import os
 
-#: schema-v2 event table: event kind -> fields every record of that kind
-#: must carry (beyond ``schema``/``event``; ``t_s`` is required for all
-#: but the summary records, which close a stream rather than timestamp a
-#: transition).  README "Observability" renders this as the docs table.
+#: schema-v2 event table: event kind -> ``{field: kind}`` — the fields
+#: every record of that kind must carry (beyond ``schema``/``event``;
+#: ``t_s`` is required for all but the summary records, which close a
+#: stream rather than timestamp a transition) AND the value kind each
+#: must hold.  Kinds: ``str`` / ``int`` (bools excluded) / ``float``
+#: (ints accepted — JSON round-trips may narrow) / ``list``.  v2 of the
+#: table listed field names only; the per-field kinds are what keeps the
+#: ``compile``/``alert``/snapshot events honest at the emit site (the
+#: ``event-schema`` lint rule checks literal argument types) and at read
+#: time (:func:`validate_metrics`).  README "Observability" renders this
+#: as the docs table.
 EVENT_FIELDS = {
     # admission flow (enqueue/admit also carry a ``cls`` priority-class
     # field since the SLO planner — OPTIONAL here so pre-planner v2
     # streams keep validating; scripts/slo_check.sh asserts it on
     # planner runs)
-    "enqueue": ("user", "depth"),
-    "admit": ("user", "width", "wait_s", "depth", "live"),
-    "user_done": ("user",),
-    "user_failed": ("user", "error"),
-    "skip_done": ("user",),
-    "skip_poisoned": ("user",),
+    "enqueue": {"user": "str", "depth": "int"},
+    "admit": {"user": "str", "width": "int", "wait_s": "float",
+              "depth": "int", "live": "int"},
+    "user_done": {"user": "str"},
+    "user_failed": {"user": "str", "error": "str"},
+    "skip_done": {"user": "str"},
+    "skip_poisoned": {"user": "str"},
     # engine lifecycle
-    "evict": ("user", "error"),
-    "resume": ("user", "attempt"),
-    "watchdog_evict": ("user",),
-    "dispatch_failed": ("fn", "width"),
-    "dispatch_session_error": ("user", "fn"),
+    "evict": {"user": "str", "error": "str"},
+    "resume": {"user": "str", "attempt": "int"},
+    "watchdog_evict": {"user": "str"},
+    "dispatch_failed": {"fn": "str", "width": "int"},
+    "dispatch_session_error": {"user": "str", "fn": "str"},
     # fault domain
-    "breaker_open": ("width",),
-    "breaker_close": ("width",),
-    "breaker_probe": ("width",),
-    "breaker_giveup": ("width",),
-    "requeue": ("user", "attempt"),
-    "requeue_reload_failed": ("user",),
-    "poison": ("user",),
-    "drain": (),
-    "journal_recover": (),
+    "breaker_open": {"width": "int"},
+    "breaker_close": {"width": "int"},
+    "breaker_probe": {"width": "int"},
+    "breaker_giveup": {"width": "int"},
+    "requeue": {"user": "str", "attempt": "int"},
+    "requeue_reload_failed": {"user": "str"},
+    "poison": {"user": "str"},
+    "drain": {},
+    "journal_recover": {},
     # SLO planner decisions (serve.planner)
-    "planner_edges": ("edges",),
-    "admission_hold": ("window_s",),
+    "planner_edges": {"edges": "list"},
+    "admission_hold": {"window_s": "float"},
+    # jit-compile telemetry (obs.jit_telemetry): one event per jit-family
+    # build / per observed XLA compile — the feed the planner's
+    # cost-aware-edges follow-on needs to trade padding waste against
+    # jit-cache pressure (width/n_devices/compile_s/resident ride along)
+    "compile": {"fn": "str", "build_s": "float"},
+    # SLO burn-rate alerts (obs.alerts): edge-triggered operator signals
+    "alert": {"kind": "str"},
     # fabric
-    "assign": ("user", "host"),
-    "host_up": ("host",),
-    "host_down": ("host",),
-    "orphan_reaped": ("host",),
-    "drain_kill": ("host",),
-    "user_finished": ("user",),
-    "user_poisoned": ("user",),
-    "user_failed_final": ("user",),
+    "assign": {"user": "str", "host": "str"},
+    "host_up": {"host": "str"},
+    "host_down": {"host": "str"},
+    "orphan_reaped": {"host": "str"},
+    "drain_kill": {"host": "str"},
+    "user_finished": {"user": "str"},
+    "user_poisoned": {"user": "str"},
+    "user_failed_final": {"user": "str"},
     # elastic control plane (serve.elastic / serve.placement)
-    "host_spawn": ("host",),
-    "host_join": ("host",),
-    "host_adopt": ("host",),
-    "host_adopt_refused": ("host",),
-    "migrate_request": ("user", "host"),
-    "migrate": ("user", "host"),
-    "migrate_refused": ("user",),
-    "withdraw": ("user",),
-    "fleet_edges": ("edges",),
+    "host_spawn": {"host": "str"},
+    "host_join": {"host": "str"},
+    "host_adopt": {"host": "str"},
+    "host_adopt_refused": {"host": "str"},
+    "migrate_request": {"user": "str", "host": "str"},
+    "migrate": {"user": "str", "host": "str"},
+    "migrate_refused": {"user": "str"},
+    "withdraw": {"user": "str"},
+    "fleet_edges": {"edges": "list"},
     # graceful scale-down + checkpoint-fenced live migration
-    "host_drain": ("host",),
-    "drain_done": ("host",),
-    "migrate_fence": ("user", "host"),
-    "migrate_inflight": ("user", "host"),
-    "fence_release": ("user",),
+    "host_drain": {"host": "str"},
+    "drain_done": {"host": "str"},
+    "migrate_fence": {"user": "str", "host": "str"},
+    "migrate_inflight": {"user": "str", "host": "str"},
+    "fence_release": {"user": "str"},
     # stream-closing summaries (no t_s)
-    "fleet_summary": (),
-    "fabric_summary": (),
+    "fleet_summary": {},
+    "fabric_summary": {},
+}
+
+#: the value check per field kind.  ``float`` accepts ints (a JSON
+#: round-trip of ``1.0`` may come back ``1``); bools are never ints
+#: here (``json.dumps(True)`` is not a count).
+FIELD_KINDS = {
+    "str": lambda v: isinstance(v, str),
+    "int": lambda v: isinstance(v, int) and not isinstance(v, bool),
+    "float": lambda v: (isinstance(v, (int, float))
+                        and not isinstance(v, bool)),
+    "list": lambda v: isinstance(v, list),
 }
 
 #: events that close a stream instead of timestamping a transition
@@ -124,8 +150,10 @@ def find_span_files(users_dir: str) -> list[str]:
 
 def validate_metrics(records: list[dict], *, path: str = "") -> list[str]:
     """Schema-v2 validation; returns human-readable error strings (empty
-    = valid).  Every line must be a tagged dict with a known event and
-    that event's required fields; non-summary events must carry ``t_s``.
+    = valid).  Every line must be a tagged dict with a known event, that
+    event's required fields AT their registered kinds (the per-field
+    type check the v2.1 table added), and — for non-summary events — a
+    numeric ``t_s``.
     """
     errors = []
     where = f"{path}:" if path else "line "
@@ -141,9 +169,13 @@ def validate_metrics(records: list[dict], *, path: str = "") -> list[str]:
         if ev not in _SUMMARY_EVENTS \
                 and not isinstance(rec.get("t_s"), (int, float)):
             errors.append(f"{where}{i}: event {ev!r} lacks numeric t_s")
-        for field in EVENT_FIELDS[ev]:
+        for field, kind in EVENT_FIELDS[ev].items():
             if field not in rec:
                 errors.append(f"{where}{i}: event {ev!r} lacks {field!r}")
+            elif not FIELD_KINDS[kind](rec[field]):
+                errors.append(
+                    f"{where}{i}: event {ev!r} field {field!r} must be "
+                    f"{kind}, got {rec[field]!r}")
     return errors
 
 
@@ -191,35 +223,92 @@ def _lane_of(rec: dict) -> str:
     return "dispatch"
 
 
+def _flow_id(rec: dict) -> int:
+    """Deterministic Chrome flow-event id for a control span (derived
+    from the span's own deterministic id, so re-exports and kill+replay
+    merges draw the same arrows)."""
+    import hashlib
+
+    h = hashlib.sha1(f"flow:{rec.get('trace')}:{rec.get('span')}"
+                     .encode("utf-8"))
+    return int.from_bytes(h.digest()[:6], "big")
+
+
 def chrome_trace(spans: list[dict]) -> dict:
     """Render merged spans as Chrome trace-event JSON (Perfetto-loadable):
-    complete (``ph: "X"``) events on one process per host and one thread
-    per user/bucket/run lane, with metadata naming events."""
+    complete (``ph: "X"``) events on one process per host — plus a
+    dedicated ``control-plane`` process whose thread lanes are the
+    ``ctl.*`` decision kinds — and one thread per user/bucket/run lane,
+    with metadata naming events.  Control spans carrying ``flow_user``
+    additionally emit a Chrome flow pair (``ph: "s"`` at the decision,
+    ``ph: "f"`` binding into the user's root span), so a fence/migrate
+    decision visibly threads into the session it moved."""
     pids: dict[str, int] = {}
     tids: dict[tuple, int] = {}
     events = []
-    for rec in spans:
-        host = rec.get("host") or "local"
-        if host not in pids:
-            pids[host] = len(pids) + 1
+    #: user -> that user's root-span placement (filled as lanes are
+    #: assigned; flow arrows bind to it)
+    user_slice: dict[str, dict] = {}
+    flows = []
+
+    def lane_for(pkey: str, pname: str, lane: str) -> tuple:
+        if pkey not in pids:
+            pids[pkey] = len(pids) + 1
             events.append({"name": "process_name", "ph": "M",
-                           "pid": pids[host], "tid": 0,
-                           "args": {"name": f"host {host}"}})
-        lane = _lane_of(rec)
-        tkey = (host, lane)
+                           "pid": pids[pkey], "tid": 0,
+                           "args": {"name": pname}})
+        tkey = (pkey, lane)
         if tkey not in tids:
             tids[tkey] = len(tids) + 1
             events.append({"name": "thread_name", "ph": "M",
-                           "pid": pids[host], "tid": tids[tkey],
+                           "pid": pids[pkey], "tid": tids[tkey],
                            "args": {"name": lane}})
+        return pids[pkey], tids[tkey]
+
+    for rec in spans:
+        host = rec.get("host") or "local"
+        if rec.get("ctl"):
+            # the control-plane lane: one process, one thread per
+            # decision kind (instant spans of one kind never nest)
+            pid, tid = lane_for("__ctl__", "control-plane",
+                                rec.get("name") or "ctl")
+        else:
+            pid, tid = lane_for(host, f"host {host}", _lane_of(rec))
         args = {k: v for k, v in rec.items()
                 if k not in ("ev", "name", "t0", "dur_s", "host")}
+        ts = int(round((rec.get("t0") or 0) * 1e6))
+        dur = max(int(round((rec.get("dur_s") or 0) * 1e6)), 1)
         events.append({
             "name": rec.get("name") or "span", "cat": "obs", "ph": "X",
-            "ts": int(round((rec.get("t0") or 0) * 1e6)),
-            "dur": max(int(round((rec.get("dur_s") or 0) * 1e6)), 1),
-            "pid": pids[host], "tid": tids[tkey], "args": args,
+            "ts": ts, "dur": dur, "pid": pid, "tid": tid, "args": args,
         })
+        user = rec.get("user")
+        if user is not None and not rec.get("ctl"):
+            best = user_slice.get(str(user))
+            # the user ROOT span is the flow anchor; any other span of
+            # the user's stands in when the root never closed
+            if best is None or (rec.get("name") == "user"
+                                and best["name"] != "user"):
+                user_slice[str(user)] = {"name": rec.get("name"),
+                                         "pid": pid, "tid": tid,
+                                         "ts": ts, "dur": dur}
+        if rec.get("flow_user") is not None:
+            flows.append((rec, pid, tid, ts))
+    for rec, pid, tid, ts in flows:
+        target = user_slice.get(str(rec["flow_user"]))
+        if target is None:
+            continue  # the user never traced (e.g. --no-trace worker)
+        fid = _flow_id(rec)
+        name = f"{rec.get('name') or 'ctl'} → {rec['flow_user']}"
+        events.append({"name": name, "cat": "obs.flow", "ph": "s",
+                       "id": fid, "pid": pid, "tid": tid, "ts": ts})
+        # bind the arrow INSIDE the user slice (Chrome attaches flow
+        # ends to the enclosing slice at that instant)
+        t_end = min(max(ts + 1, target["ts"]),
+                    target["ts"] + target["dur"])
+        events.append({"name": name, "cat": "obs.flow", "ph": "f",
+                       "bp": "e", "id": fid, "pid": target["pid"],
+                       "tid": target["tid"], "ts": t_end})
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
@@ -260,23 +349,35 @@ def merged_summary(users_dir: str) -> dict:
 
 
 def planner_timeline(users_dir: str) -> dict:
-    """The SLO planner's decision history per host, from the schema-v2
-    event stream: every ``planner_edges`` event (derived edges over
-    time) and the ``admission_hold`` count — the ``cetpu-report``
-    planner section's data."""
-    out: dict[str, dict] = {}
+    """The SLO planner's decision history: per-host ``planner_edges``
+    events (locally derived edges over time), per-host ``fleet_edges``
+    events (coordinator broadcasts the host ADOPTED), the
+    ``admission_hold`` counts, and — the piece the per-worker streams
+    cannot carry — the main journal's own ``planner`` epochs, which in
+    fabric mode are the coordinator ``FleetPlanner``'s derivations over
+    the MERGED per-host sketches (PR 13): the edges workers actually
+    routed by.  Fired ``alert`` events ride along in the same pass
+    (one read per metrics file, not one per report section).  Returns
+    ``{"per_host": {host: {...}}, "journal_epochs": [...],
+    "alerts": [...]}`` — the ``cetpu-report`` planner/alert sections'
+    data."""
+    per_host: dict[str, dict] = {}
+    alert_events: list[dict] = []
     for path in find_metrics_files(users_dir):
         host = _host_of_metrics_path(path)
         edges, fleet_edges, holds = [], [], 0
         for rec in read_jsonl_tolerant(path):
             ev = rec.get("event")
-            if ev == "planner_edges":
+            if ev == "alert":
+                alert_events.append({"host": host, **rec})
+            elif ev == "planner_edges":
                 edges.append({"t_s": rec.get("t_s"),
                               "edges": rec.get("edges"),
                               "observations": rec.get("observations")})
             elif ev == "fleet_edges":
                 # coordinator-broadcast fabric-level edges (the elastic
-                # fleet planner) — rendered alongside the local epochs
+                # fleet planner) as this host adopted them — rendered
+                # alongside the local epochs
                 fleet_edges.append({"t_s": rec.get("t_s"),
                                     "edges": rec.get("edges"),
                                     "observations":
@@ -284,10 +385,20 @@ def planner_timeline(users_dir: str) -> dict:
             elif ev == "admission_hold":
                 holds += 1
         if edges or fleet_edges or holds:
-            out[host] = {"edges": edges, "admission_holds": holds}
+            per_host[host] = {"edges": edges, "admission_holds": holds}
             if fleet_edges:
-                out[host]["fleet_edges"] = fleet_edges
-    return out
+                per_host[host]["fleet_edges"] = fleet_edges
+    epochs = []
+    for rec in read_jsonl_tolerant(os.path.join(users_dir,
+                                                "serve_journal.jsonl")):
+        if rec.get("event") == "planner":
+            epochs.append({"seq": rec.get("seq"),
+                           "edges": rec.get("edges"),
+                           "observations":
+                               (rec.get("sketch") or {}).get("n"),
+                           "fleet": bool(rec.get("fleet"))})
+    return {"per_host": per_host, "journal_epochs": epochs,
+            "alerts": alert_events}
 
 
 def text_report(users_dir: str) -> str:
@@ -346,12 +457,36 @@ def text_report(users_dir: str) -> str:
                          f"{b.get('mean_batch')} "
                          f"dispatches={b.get('dispatches')}")
     timeline = planner_timeline(users_dir)
-    for host, t in sorted(timeline.items()):
+    for host, t in sorted(timeline["per_host"].items()):
         if t["edges"]:
             lines.append(f"planner edges over time [{host}]:")
             for e in t["edges"]:
                 lines.append(f"    t={e.get('t_s')}s -> {e.get('edges')} "
                              f"(after {e.get('observations')} obs)")
+        if t.get("fleet_edges"):
+            lines.append(f"fleet edges adopted [{host}]:")
+            for e in t["fleet_edges"]:
+                lines.append(f"    t={e.get('t_s')}s -> {e.get('edges')} "
+                             f"(after {e.get('observations')} merged "
+                             "obs)")
+    if timeline["journal_epochs"]:
+        # the journal's own planner epochs — in fabric mode the
+        # coordinator FleetPlanner's merged-sketch derivations (the
+        # edges broadcast to every worker), single-host the local
+        # planner's (the PR 15 report bugfix: these never showed)
+        lines.append("journal planner epochs:")
+        for e in timeline["journal_epochs"]:
+            tag = " [fleet-adopt]" if e.get("fleet") else ""
+            lines.append(f"    seq={e.get('seq')} -> {e.get('edges')} "
+                         f"(sketch n={e.get('observations')}){tag}")
+    if timeline["alerts"]:
+        lines.append(f"alerts fired: {len(timeline['alerts'])}")
+        for r in timeline["alerts"]:
+            detail = " ".join(
+                f"{k}={v}" for k, v in sorted(r.items())
+                if k not in ("schema", "event", "t_s", "kind"))
+            lines.append(f"    t={r.get('t_s')}s [{r.get('kind')}] "
+                         f"{detail}")
     spans = load_spans(find_span_files(users_dir))
     if spans:
         by_name: dict[str, list[float]] = {}

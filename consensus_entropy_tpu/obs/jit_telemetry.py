@@ -1,0 +1,237 @@
+"""Jit-family compile telemetry: the process-wide view of what the
+stack has compiled, is compiling, and keeps resident.
+
+The serving stack's device work flows through a small set of PROCESS-WIDE
+jit-family caches — ``ops.scoring.make_scoring_fns`` /
+``make_fleet_scoring_fns`` / ``fleet_scoring_fns_for_width`` (one wrapper
+family per (k, tie_break[, width])) and ``models.committee``'s per-config
+infer programs — each of which owns jit objects whose per-shape
+executables compile lazily at first dispatch.  Until this module nobody
+RECORDED any of it: the SLO planner's cost-aware-edges follow-on (ROADMAP
+SLO (a)) needs compile wall × resident executables per family to trade
+padding waste against jit-cache pressure, and an operator watching a
+serve process grow has no way to see which bucket geometry is paying.
+
+Three feeds, all cheap:
+
+- :func:`note_build` — called INSIDE each lru-cached family builder (runs
+  exactly once per key per process): family registered, wrapper-build
+  wall recorded, the family's jit objects kept for resident-executable
+  counts (``_cache_size()``; gone executables decrement naturally).
+- :func:`note_lookup` — called by the public cache wrappers on every
+  lookup; ``hits = lookups - builds`` is the cache-pressure counter.
+- :func:`dispatch_scope` — the scheduler wraps each device dispatch in
+  this thread-local scope; a ``jax.monitoring`` backend-compile duration
+  landing inside it is attributed to that (fn, width) family and fired to
+  subscribers as a first-class ``compile`` event (schema-registered in
+  ``obs.export.EVENT_FIELDS``).  Without ``jax.monitoring`` (older jax)
+  the build/lookup feeds still flow — the listener install is best-effort.
+
+Subscribers (``FleetScheduler`` forwards to its ``FleetReport``) receive
+plain dicts shaped for ``report.event("compile", ...)``.  Everything here
+is pure host bookkeeping behind one lock; no jax import happens at module
+load (the monitoring hook imports lazily), so CLI tooling can import the
+snapshot surface backend-free.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+_LOCK = threading.RLock()
+_FAMILIES: dict[tuple, dict] = {}
+_LISTENERS: list = []
+_SCOPE = threading.local()
+_MONITOR = {"installed": False}
+
+#: the family key XLA compile walls land in when no dispatch scope is
+#: active (a compile triggered outside the scheduler's dispatch path)
+_UNATTRIBUTED = ("unattributed", None, None)
+
+
+def family_key(fn: str, width=None, n_devices=None) -> tuple:
+    return (str(fn), width, n_devices)
+
+
+def _new_family(key: tuple) -> dict:
+    return {"fn": key[0], "width": key[1], "n_devices": key[2],
+            "builds": 0, "lookups": 0, "build_s": 0.0,
+            "compiles": 0, "compile_s": 0.0, "jit_fns": ()}
+
+
+def subscribe(listener) -> None:
+    """Register a listener for build/compile events (idempotent)."""
+    with _LOCK:
+        if listener not in _LISTENERS:
+            _LISTENERS.append(listener)
+
+
+def unsubscribe(listener) -> None:
+    with _LOCK:
+        if listener in _LISTENERS:
+            _LISTENERS.remove(listener)
+
+
+def _fire(event: dict) -> None:
+    with _LOCK:
+        listeners = list(_LISTENERS)
+    for listener in listeners:
+        try:
+            listener(dict(event))
+        except Exception:
+            pass  # telemetry must never take down a dispatch
+
+
+def _family_resident(fam: dict) -> int:
+    n = 0
+    for fn in fam["jit_fns"]:
+        try:
+            n += int(fn._cache_size())
+        except Exception:
+            pass  # older jax without _cache_size: resident reads 0
+    return n
+
+
+def note_build(fn: str, *, width=None, n_devices=None,
+               build_s: float = 0.0, jit_fns=()) -> None:
+    """One jit-family BUILD (the lru-cache miss path: tracing wrappers
+    constructed, nothing XLA-compiled yet).  ``jit_fns``: the family's
+    jit objects, retained for resident-executable counts."""
+    key = family_key(fn, width, n_devices)
+    with _LOCK:
+        fam = _FAMILIES.setdefault(key, _new_family(key))
+        fam["builds"] += 1
+        fam["build_s"] += build_s
+        fam["jit_fns"] = tuple(jit_fns)
+    event = {"fn": key[0], "build_s": round(build_s, 6),
+             "phase": "build"}
+    if width is not None:
+        event["width"] = width
+    if n_devices is not None:
+        event["n_devices"] = n_devices
+    _fire(event)
+
+
+def note_lookup(fn: str, width=None, n_devices=None) -> None:
+    """One cache lookup of the family (hit or the miss that built it:
+    ``hits = lookups - builds``)."""
+    key = family_key(fn, width, n_devices)
+    with _LOCK:
+        fam = _FAMILIES.setdefault(key, _new_family(key))
+        fam["lookups"] += 1
+
+
+@contextlib.contextmanager
+def dispatch_scope(fn: str, width=None, n_devices=None):
+    """Attribute XLA backend-compile walls observed during this dispatch
+    to the (fn, width, n_devices) family — the scheduler wraps each
+    stacked/plan/single device call in one.  Thread-local: concurrent
+    dispatch threads attribute independently."""
+    _install_monitor()
+    prev = getattr(_SCOPE, "key", None)
+    _SCOPE.key = family_key(fn, width, n_devices)
+    try:
+        yield
+    finally:
+        _SCOPE.key = prev
+
+
+def _install_monitor() -> None:
+    """Best-effort, once: hook ``jax.monitoring``'s duration events so
+    real backend-compile walls (not just wrapper builds) reach the
+    stream.  Missing API → the build/lookup feeds still flow."""
+    if _MONITOR["installed"]:
+        return
+    with _LOCK:
+        if _MONITOR["installed"]:
+            return
+        _MONITOR["installed"] = True
+        try:
+            from jax import monitoring
+            monitoring.register_event_duration_secs_listener(
+                _on_jax_duration)
+        except Exception:
+            pass
+
+
+def _on_jax_duration(name: str, dur: float, **_kw) -> None:
+    if not str(name).endswith("/backend_compile_duration"):
+        return
+    key = getattr(_SCOPE, "key", None) or _UNATTRIBUTED
+    with _LOCK:
+        fam = _FAMILIES.setdefault(key, _new_family(key))
+        fam["compiles"] += 1
+        fam["compile_s"] += float(dur)
+        resident = _family_resident(fam)
+    event = {"fn": key[0], "build_s": round(float(dur), 6),
+             "phase": "xla", "resident": resident}
+    if key[1] is not None:
+        event["width"] = key[1]
+    if key[2] is not None:
+        event["n_devices"] = key[2]
+    _fire(event)
+
+
+def _label(key: tuple) -> str:
+    label = key[0]
+    if key[1] is not None:
+        label += f"@w{key[1]}"
+    if key[2] is not None:
+        label += f"/d{key[2]}"
+    return label
+
+
+def family_labels() -> list[str]:
+    """Sorted labels of every family this process has touched — the
+    determinism pin (same workload → same families, restart included)."""
+    with _LOCK:
+        return sorted(_label(k) for k in _FAMILIES)
+
+
+def snapshot() -> dict:
+    """The process-wide roll-up (status snapshots and ``cetpu-top`` read
+    this): totals plus a per-family table with resident-executable
+    counts polled live."""
+    with _LOCK:
+        fams = {k: dict(f) for k, f in _FAMILIES.items()}
+    per_family = {}
+    totals = {"families": len(fams), "lookups": 0, "builds": 0,
+              "hits": 0, "build_s": 0.0, "compiles": 0,
+              "compile_s": 0.0, "resident": 0}
+    for key, fam in sorted(fams.items(),
+                           key=lambda kv: _label(kv[0])):
+        resident = _family_resident(fam)
+        hits = max(fam["lookups"] - fam["builds"], 0)
+        per_family[_label(key)] = {
+            "lookups": fam["lookups"], "builds": fam["builds"],
+            "hits": hits, "build_s": round(fam["build_s"], 6),
+            "compiles": fam["compiles"],
+            "compile_s": round(fam["compile_s"], 6),
+            "resident": resident,
+        }
+        totals["lookups"] += fam["lookups"]
+        totals["builds"] += fam["builds"]
+        totals["hits"] += hits
+        totals["build_s"] += fam["build_s"]
+        totals["compiles"] += fam["compiles"]
+        totals["compile_s"] += fam["compile_s"]
+        totals["resident"] += resident
+    totals["build_s"] = round(totals["build_s"], 6)
+    totals["compile_s"] = round(totals["compile_s"], 6)
+    totals["per_family"] = per_family
+    return totals
+
+
+def build_timer() -> float:
+    """The builders' wall source (one spelling, mockable)."""
+    return time.perf_counter()
+
+
+def _reset_for_tests() -> None:
+    """Drop family state and listeners (the jit caches themselves are
+    process-wide and stay warm — tests pin LOOKUP growth, not rebuild)."""
+    with _LOCK:
+        _FAMILIES.clear()
+        _LISTENERS.clear()

@@ -1,0 +1,158 @@
+"""Per-host live status snapshots: the introspection plane's "what is
+the fleet doing RIGHT NOW" surface.
+
+Every metrics stream this stack writes is append-only and post-hoc: an
+operator can replay ``fleet_metrics.jsonl`` after the run, but cannot see
+a live serve process's queue depths, bucket occupancy or drain state
+without attaching a debugger.  This module closes that gap with the
+cheapest possible mechanism — each worker (and the fabric coordinator)
+periodically rewrites ONE small ``status_<host>.json`` via the
+write-tmp-then-``os.replace`` discipline the lease heartbeats already
+use, so a reader sees the previous snapshot or the current one, never a
+torn file.  ``cetpu-top`` (``cli/top.py``) renders the snapshot
+directory as a live fleet view.
+
+Torn-read tolerance is layered anyway (:func:`read_status` returns
+``None`` on any parse failure) because operators copy these files around
+and network filesystems break rename atomicity; the reader must never
+crash on a half-copied snapshot.
+
+The writer takes an injected ``clock=`` seam (the same discipline as
+every liveness surface — cetpu-lint's replay rules stay clean because
+callers in ``serve/`` never read a wall clock themselves), and snapshots
+are TELEMETRY: nothing journaled or replayed ever reads one back, so the
+introspection plane cannot change results.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+
+#: snapshot schema floor: every status file must carry these at these
+#: kinds (the same str/int/float vocabulary as the event table)
+STATUS_FIELDS = {"kind": "str", "host": "str", "t": "float",
+                 "schema": "int"}
+
+#: the snapshot-file schema version (independent of the event stream's)
+STATUS_SCHEMA = 1
+
+
+def status_path(status_dir: str, host: str) -> str:
+    return os.path.join(status_dir, f"status_{host}.json")
+
+
+class StatusWriter:
+    """Atomic-rename snapshot writer for one host, rate-limited.
+
+    ``interval_s``: minimum seconds between writes (:meth:`maybe_write`
+    is called every loop round; most rounds return without I/O).
+    ``clock``: the injected wall clock — snapshots cross processes, so
+    wall time is the right axis, and the seam keeps callers clock-free.
+    """
+
+    def __init__(self, status_dir: str, host: str, *,
+                 interval_s: float = 1.0, clock=time.time):
+        if interval_s < 0:
+            raise ValueError(f"interval_s must be >= 0, got {interval_s}")
+        self.path = status_path(status_dir, host)
+        self.host = host
+        self.interval_s = interval_s
+        self.writes = 0
+        #: swallowed best-effort failures (see :meth:`maybe_write`)
+        self.errors = 0
+        self._clock = clock
+        self._last_write: float | None = None
+
+    def maybe_write(self, build) -> bool:
+        """Write a fresh snapshot when the interval elapsed; ``build()``
+        (a nullary callable returning the payload dict) only runs when a
+        write actually happens, so idle rounds cost one clock read.
+
+        BEST-EFFORT: any failure (disk full, network-FS rename error, a
+        payload-builder bug) is swallowed and counted — the serve loop
+        and the fabric coordinator call this inline, and the
+        introspection plane must never take down the fleet it observes
+        (:meth:`write` itself still raises, for callers that want the
+        error)."""
+        now = self._clock()
+        if self._last_write is not None \
+                and now - self._last_write < self.interval_s:
+            return False
+        try:
+            self.write(build())
+        except Exception:
+            self.errors += 1
+            self._last_write = now  # don't retry at poll rate
+            return False
+        return True
+
+    def write(self, payload: dict) -> dict:
+        """One snapshot: payload + the schema floor (kind/host/t), then
+        tmp-write + ``os.replace`` so readers never see a torn file."""
+        now = self._clock()
+        snap = {"schema": STATUS_SCHEMA, "kind": "status",
+                "host": self.host, "t": round(now, 3), **payload}
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        tmp = f"{self.path}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(json.dumps(snap).encode("utf-8"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self._last_write = now
+        self.writes += 1
+        return snap
+
+
+def read_status(path: str) -> dict | None:
+    """One snapshot, or ``None`` for missing/torn/non-dict files — the
+    reader half of the torn-read tolerance contract (the atomic rename
+    makes tears rare; copies and network filesystems make them
+    possible)."""
+    try:
+        with open(path, "rb") as f:
+            rec = json.loads(f.read().decode("utf-8"))
+    except (OSError, ValueError, UnicodeDecodeError):
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+def read_status_dir(status_dir: str) -> dict[str, dict]:
+    """``{host: snapshot}`` over every readable ``status_*.json`` in the
+    directory (unreadable ones skipped, per the tolerance contract)."""
+    out: dict[str, dict] = {}
+    for path in sorted(glob.glob(status_path(status_dir, "*"))):
+        snap = read_status(path)
+        if snap is None:
+            continue
+        base = os.path.basename(path)
+        host = base[len("status_"):-len(".json")]
+        out[snap.get("host") or host] = snap
+    return out
+
+
+def validate_status(snap: dict) -> list[str]:
+    """Schema-floor validation for one snapshot (``scripts/obs_check.sh``
+    asserts this on MID-RUN snapshots); returns error strings, empty =
+    valid."""
+    from consensus_entropy_tpu.obs.export import FIELD_KINDS
+
+    errors = []
+    for field, kind in STATUS_FIELDS.items():
+        if field not in snap:
+            errors.append(f"status snapshot lacks {field!r}")
+        elif not FIELD_KINDS[kind](snap[field]):
+            errors.append(f"status field {field!r} must be {kind}, "
+                          f"got {snap[field]!r}")
+    if not errors and snap.get("kind") != "status":
+        errors.append(f"kind must be 'status', got {snap.get('kind')!r}")
+    alerts = snap.get("alerts")
+    if alerts is not None and not (
+            isinstance(alerts, list)
+            and all(isinstance(a, dict) and isinstance(a.get("kind"), str)
+                    for a in alerts)):
+        errors.append("alerts must be a list of {kind: str, ...} dicts")
+    return errors

@@ -4,7 +4,10 @@ The span hierarchy mirrors the serving stack::
 
     run ── user ── al_iter ── {host_step, checkpoint}
      │      └──── admission_wait            (serve mode: enqueue→admit)
-     └──── {score_dispatch, retrain}        (stacked: one span, N users)
+     ├──── {score_dispatch, retrain}        (stacked: one span, N users)
+     └──── ctl.*                            (control-plane decisions:
+            spawn/join/drain/fence/migrate/failover/planner_epoch — the
+            fabric coordinator's lane, see :meth:`Tracer.control_event`)
 
 **Determinism is the recovery story.**  Trace ids derive from
 ``(run_id, user)`` and the user/iteration span ids from
@@ -261,6 +264,41 @@ class Tracer:
             a.update(attrs)
             self._emit(self._span_rec(ctx, self.run_ctx, "user", t0,
                                       time.time(), a))
+        self.cost_s += time.perf_counter() - c0
+
+    # -- control-plane lane (fabric coordinator) ---------------------------
+
+    def control_event(self, name: str, *, key, flow_user=None,
+                      **attrs) -> None:
+        """One control-plane DECISION as an instantaneous span in the
+        coordinator's own Perfetto lane (``ctl.*`` names, ``ctl: True``
+        attr — the export routes these to a ``control-plane`` process).
+
+        ``key`` is the decision's DURABLE identity: the journal record's
+        ``seq`` for coordinator-originated decisions (spawn / drain /
+        drain_done / revoke / planner epochs — journaled exactly once),
+        or ``(host, src_off)`` for transcribed worker acks (drop/fence —
+        a restarted coordinator re-reads a stale ack and re-journals it
+        under a NEW seq, but the worker-WAL byte offset it came from
+        never changes).  Same discipline as the run/user/epoch ids: a
+        coordinator SIGKILL + replay re-emits identical span ids and the
+        merge dedupes, so the control timeline survives the kill.
+
+        ``flow_user``: the user this decision acts on — the Chrome
+        export draws a flow arrow from this span to that user's trace
+        (fence/migrate decisions visibly thread into the session they
+        moved)."""
+        if not self.enabled:
+            return
+        c0 = time.perf_counter()
+        key = key if isinstance(key, tuple) else (key,)
+        a = {"ctl": True}
+        if flow_user is not None:
+            a["flow_user"] = str(flow_user)
+        a.update(attrs)
+        now = time.time()
+        ctx = self._child_ctx(name, self.run_ctx, ("ctl", name) + key)
+        self._emit(self._span_rec(ctx, self.run_ctx, name, now, now, a))
         self.cost_s += time.perf_counter() - c0
 
     # -- transcription (fabric coordinator) --------------------------------

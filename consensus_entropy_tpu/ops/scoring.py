@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from consensus_entropy_tpu.obs import jit_telemetry
 from consensus_entropy_tpu.ops.entropy import masked_entropy
 from consensus_entropy_tpu.ops.topk import masked_top_k, reveal_mask_update
 
@@ -332,6 +333,7 @@ def make_scoring_fns(*, k: int,
     tie_break="fast")`` share one entry (a raw ``lru_cache`` keys on the
     literal argument tuple and would silently duplicate the programs).
     """
+    jit_telemetry.note_lookup(f"scoring:k{k}:{tie_break}")
     return _make_scoring_fns_cached(k, tie_break)
 
 
@@ -354,6 +356,7 @@ def _fused_partial(key: str, k: int, tie_break: str) -> Callable:
 
 @functools.lru_cache(maxsize=None)
 def _make_scoring_fns_cached(k: int, tie_break: str) -> dict[str, Callable]:
+    b0 = jit_telemetry.build_timer()
     mc = jax.jit(functools.partial(score_mc, k=k, tie_break=tie_break))
     hc = jax.jit(functools.partial(score_hc, k=k, tie_break=tie_break))
     hc_pre = jax.jit(functools.partial(score_hc_precomputed, k=k,
@@ -367,6 +370,9 @@ def _make_scoring_fns_cached(k: int, tie_break: str) -> dict[str, Callable]:
     for key in _FUSED_IMPLS:
         fns[key] = jax.jit(_fused_partial(key, k, tie_break),
                            donate_argnums=FUSED_DONATE[key])
+    jit_telemetry.note_build(f"scoring:k{k}:{tie_break}",
+                             build_s=jit_telemetry.build_timer() - b0,
+                             jit_fns=fns.values())
     return fns
 
 
@@ -405,6 +411,7 @@ def make_fleet_scoring_fns(*, k: int,
     graph per (k, tie_break) process-wide; callers must not mutate the
     returned dict.
     """
+    jit_telemetry.note_lookup(f"fleet:k{k}:{tie_break}")
     return _make_fleet_scoring_fns_cached(k, tie_break)
 
 
@@ -460,9 +467,14 @@ def _fleet_base_fns(k: int, tie_break: str) -> dict[str, Callable]:
 def _make_fleet_scoring_fns_cached(k: int, tie_break: str) -> dict[str, Callable]:
     # the fused keys donate their STACKED mask operands: the whole
     # bucket's device-resident pool state updates in place per dispatch
-    return {key: jax.jit(jax.vmap(fn),
-                         donate_argnums=FUSED_DONATE.get(key, ()))
-            for key, fn in _fleet_base_fns(k, tie_break).items()}
+    b0 = jit_telemetry.build_timer()
+    fns = {key: jax.jit(jax.vmap(fn),
+                        donate_argnums=FUSED_DONATE.get(key, ()))
+           for key, fn in _fleet_base_fns(k, tie_break).items()}
+    jit_telemetry.note_build(f"fleet:k{k}:{tie_break}",
+                             build_s=jit_telemetry.build_timer() - b0,
+                             jit_fns=fns.values())
+    return fns
 
 
 #: which positional operand of each fleet scorer carries the (U, N) pool
@@ -498,15 +510,20 @@ def fleet_scoring_fns_for_width(*, k: int, tie_break: str = "fast",
     Cached per (k, tie_break, width) process-wide — one wrapper family per
     bucket, not per admission.  Callers must not mutate the returned dict.
     """
+    jit_telemetry.note_lookup(f"fleet:k{k}:{tie_break}", width=width)
     return _fleet_fns_for_width_cached(k, tie_break, width)
 
 
 @functools.lru_cache(maxsize=None)
 def _fleet_fns_for_width_cached(k: int, tie_break: str,
                                 width: int) -> dict[str, Callable]:
+    b0 = jit_telemetry.build_timer()
     base = {key: jax.jit(jax.vmap(fn),
                          donate_argnums=FUSED_DONATE.get(key, ()))
             for key, fn in _fleet_base_fns(k, tie_break).items()}
+    jit_telemetry.note_build(f"fleet:k{k}:{tie_break}", width=width,
+                             build_s=jit_telemetry.build_timer() - b0,
+                             jit_fns=base.values())
 
     def guarded(fn_key, fn):
         pos = _POOL_MASK_POS[fn_key]

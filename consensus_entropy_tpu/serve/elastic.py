@@ -159,11 +159,14 @@ class FleetPlanner:
     acts on it."""
 
     def __init__(self, journal, *, epoch: int = 8, n_buckets: int = 4,
-                 report=None):
+                 report=None, tracer=None):
         self.journal = journal
         self.epoch = epoch
         self.n_buckets = n_buckets
         self.report = report
+        #: optional ``obs.trace.Tracer``: each derivation epoch lands in
+        #: the control-plane lane, keyed by its journal record's seq
+        self.tracer = tracer
         self.edges: tuple = ()
         self.edge_updates = 0
         #: latest journaled sketch per worker host (dict form — merged
@@ -217,12 +220,19 @@ class FleetPlanner:
         if changed:
             self.edges = edges
             self.edge_updates += 1
+        rec = None
         if self.journal is not None:
-            self.journal.append("planner", edges=list(self.edges),
-                                sketch=sk.to_dict())
+            rec = self.journal.append("planner", edges=list(self.edges),
+                                      sketch=sk.to_dict())
         if changed and self.report is not None:
             self.report.event("fleet_edges", edges=list(edges),
                               observations=sk.n)
+        if rec is not None and self.tracer is not None \
+                and self.tracer.enabled:
+            self.tracer.control_event(
+                "ctl.planner_epoch", key=rec["seq"],
+                edges=list(self.edges), observations=sk.n,
+                changed=changed)
         return edges if changed else None
 
     def summary(self) -> dict:

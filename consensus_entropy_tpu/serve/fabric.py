@@ -166,6 +166,15 @@ class FabricConfig:
     #: (``drain_done``) — replay-identical after a coordinator SIGKILL
     #: at any boundary
     scale_down_s: float = 0.0
+    #: OPERATOR drain command (``--drain-host h3``, ROADMAP elastic
+    #: follow-on (c2)): drain this host through exactly the journaled
+    #: scale-down machinery — same ``drain`` record, same fault point,
+    #: same drop-ack/fence shed, same ``drain_done`` retirement — but
+    #: initiated by the operator instead of the low-water mark (no
+    #: ``scale_down_s`` needed, and the ``min_hosts`` floor is NOT
+    #: applied: the operator said so).  One-shot per run; requires the
+    #: elastic plane (the shed paths are its machinery).
+    drain_host: str | None = None
     #: checkpoint-fenced IN-FLIGHT migration during a drain: the source
     #: session checkpoints at its next iteration boundary, the worker
     #: journals a fence ack carrying the checkpoint generation, and only
@@ -224,6 +233,11 @@ class FabricConfig:
             raise ValueError(
                 "scale_down_s requires the elastic control plane "
                 "(set min_hosts/max_hosts)")
+        if self.drain_host is not None and not self.elastic:
+            raise ValueError(
+                "drain_host requires the elastic control plane "
+                "(set min_hosts/max_hosts — the drain shed paths are "
+                "its machinery)")
         if self.placement not in PLACEMENT_POLICIES:
             raise ValueError(f"placement must be one of "
                              f"{PLACEMENT_POLICIES}, got {self.placement!r}")
@@ -273,7 +287,8 @@ class FabricCoordinator:
     def __init__(self, journal, fabric_dir: str, config: FabricConfig, *,
                  poison: PoisonList | None = None,
                  report: FleetReport | None = None, on_poll=None,
-                 preemption=None, tracer=None, clock=time.time):
+                 preemption=None, tracer=None, clock=time.time,
+                 status=None, alerts=None, introspect: bool = True):
         if journal.path is None:
             raise ValueError("the fabric journal must be file-backed — it "
                              "is the coordinator's source of truth")
@@ -295,6 +310,15 @@ class FabricCoordinator:
         #: this tracer's own sink — the span-side sibling of the event
         #: transcription, so one merged file holds the fleet timeline
         self.tracer = tracer
+        #: the live introspection plane (``--no-introspection`` turns
+        #: every limb off at once — the PR 14 arm): control-plane spans
+        #: (gated here), the coordinator's status snapshot writer
+        #: (``obs.status.StatusWriter`` or None) and the SLO burn-rate
+        #: alert watcher (``obs.alerts.AlertWatcher`` or None).
+        #: Introspection changes what operators can SEE, never results.
+        self.introspect = introspect
+        self.status = status if introspect else None
+        self.alerts = alerts if introspect else None
         #: the injected WALL clock (lease files cross processes, so
         #: monotonic clocks don't compare): every liveness deadline —
         #: lease age, spawn grace, drain timeouts, orphan-reap polls —
@@ -332,6 +356,8 @@ class FabricCoordinator:
         #: reads a clock)
         self._draining_host: str | None = None
         self._low_since: float | None = None
+        #: the one-shot latch of the operator ``--drain-host`` command
+        self._operator_drained = False
         #: consecutive spawned hosts that died before their FIRST
         #: heartbeat — the autoscaler's crash-loop guard (any join
         #: resets it)
@@ -347,7 +373,8 @@ class FabricCoordinator:
         if config.elastic and config.fleet_planner:
             self.fleet_planner = FleetPlanner(
                 journal, epoch=config.planner_epoch,
-                n_buckets=config.planner_buckets, report=self.report)
+                n_buckets=config.planner_buckets, report=self.report,
+                tracer=tracer if introspect else None)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -413,8 +440,10 @@ class FabricCoordinator:
             # already excludes it — journal the retirement so the ledger
             # closes and its users re-route below like everyone else's
             for hid in st.draining_hosts():
-                self.journal.append("drain_done", host=hid)
+                rec = self.journal.append("drain_done", host=hid)
                 self.report.event("drain_done", host=hid)
+                self._ctl("ctl.drain_done", key=rec["seq"], host=hid,
+                          startup=True)
         try:
             if pending:  # nothing unresolved → no workers to spawn
                 for host_id in self._initial_fleet():
@@ -440,6 +469,7 @@ class FabricCoordinator:
                 if self.config.elastic:
                     self._adopt_operator_hosts()
                     self._autoscale()
+                    self._operator_drain()
                     self._scale_down()
                     self._pump_drain()
                     self._broadcast_edges()
@@ -452,6 +482,8 @@ class FabricCoordinator:
                         f"{len(self._unresolved)} user(s) unresolved — "
                         "rerun the coordinator to recover from the "
                         "journal")
+                if self.status is not None:
+                    self.status.maybe_write(self._status_payload)
                 if self.on_poll is not None:
                     self.on_poll(self)
                 time.sleep(self.config.poll_s)
@@ -594,8 +626,9 @@ class FabricCoordinator:
         if not self.config.elastic:
             return  # PR 5 semantics byte-for-byte: membership is lease-only
         self.joins += 1
-        self.journal.append("join", host=h.host_id)
+        rec = self.journal.append("join", host=h.host_id)
         self.report.event("host_join", host=h.host_id)
+        self._ctl("ctl.join", key=rec["seq"], host=h.host_id)
         if self.fleet_planner is not None and self.fleet_planner.edges:
             h.assign.append({"edges": list(self.fleet_planner.edges)})
         # users STRANDED on a host that died while no live target
@@ -680,10 +713,12 @@ class FabricCoordinator:
             # its journal record: nothing was spawned, the restart
             # re-decides from the same journaled state
             faults.fire("fabric.spawn", host=hid, reason=reason)
-            self.journal.append("spawn", host=hid, reason=reason)
+            rec = self.journal.append("spawn", host=hid, reason=reason)
             self.spawns += 1
             self._spawn_host(hid, self._spawn_fn)
             self.report.event("host_spawn", host=hid, reason=reason)
+            self._ctl("ctl.spawn", key=rec["seq"], host=hid,
+                      reason=reason)
             live += 1
 
     def _scale_down(self) -> None:
@@ -721,19 +756,52 @@ class FabricCoordinator:
         if now - self._low_since < cfg.scale_down_s:
             return
         victim = drain_victim(candidates)
+        self._start_drain(victim, "scale_down", candidates[victim])
+
+    def _start_drain(self, victim: str, reason: str, load: int) -> None:
+        """Journal one drain decision and send the sentinel — shared by
+        the autoscaler's low-water path and the operator's
+        ``--drain-host`` command (same record, same fault point, same
+        replay semantics)."""
         h = self.hosts[victim]
         # a kill here models dying between the scale-down decision and
         # its journal record: nothing drained, the restart re-derives
         # the same fleet and re-times the low-water mark
         faults.fire("fabric.drain", host=victim)
-        self.journal.append("drain", host=victim)
+        rec = self.journal.append("drain", host=victim)
         self.drains += 1
         self._draining_host = victim
         self._low_since = None
         h.draining = True
         h.assign.append({"drain": True})
-        self.report.event("host_drain", host=victim,
-                          load=candidates[victim])
+        self.report.event("host_drain", host=victim, load=load,
+                          reason=reason)
+        self._ctl("ctl.drain", key=rec["seq"], host=victim,
+                  reason=reason, load=load)
+
+    def _operator_drain(self) -> None:
+        """The ``--drain-host`` command (elastic follow-on (c2)): drain
+        the named host through the scale-down machinery the moment it is
+        live and joined — one shot per run, deferred while another drain
+        is in progress.  A restarted coordinator whose journal already
+        shows the host shed (drained, retired or revoked) does NOT
+        re-drain a replacement that happens to reuse the name: the
+        command is about the journaled host, and its disposition is
+        durable."""
+        hid = self.config.drain_host
+        if hid is None or self._operator_drained:
+            return
+        if self.journal.state.hosts.get(hid) in ("drain", "drain_done",
+                                                 "revoke"):
+            self._operator_drained = True
+            return
+        if self._draining_host is not None:
+            return  # one drain at a time; retry next poll
+        h = self.hosts.get(hid)
+        if h is None or not h.alive or not h.joined or h.draining:
+            return  # not up yet: retry next poll
+        self._operator_drained = True
+        self._start_drain(hid, "operator", self._load_of(hid))
 
     def _pump_drain(self) -> None:
         """One shed round for the draining host: withdraw its queued
@@ -814,8 +882,9 @@ class FabricCoordinator:
                     pass
         self._transcribe(h)
         self._transcribe_spans(h)
-        self.journal.append("drain_done", host=h.host_id)
+        rec = self.journal.append("drain_done", host=h.host_id)
         self.report.event("drain_done", host=h.host_id)
+        self._ctl("ctl.drain_done", key=rec["seq"], host=h.host_id)
         if h.host_id == self._draining_host:
             self._draining_host = None
 
@@ -859,11 +928,14 @@ class FabricCoordinator:
             if sum(1 for h in self.hosts.values() if h.alive) \
                     >= self.config.max_hosts:
                 return  # at the ceiling: leave volunteers unadopted
-            self.journal.append("spawn", host=hid, reason="operator")
+            rec = self.journal.append("spawn", host=hid,
+                                      reason="operator")
             self.spawns += 1
             self._register_host(hid, PidProc(pid, clock=self._clock),
                                 paths)
             self.report.event("host_adopt", host=hid, pid=pid)
+            self._ctl("ctl.spawn", key=rec["seq"], host=hid,
+                      reason="operator")
             # the fresh lease means it already heartbeats: JOIN (and
             # rebalance onto it) on the next _check_hosts pass; one
             # adoption per poll keeps each join's rebalance settled
@@ -899,7 +971,8 @@ class FabricCoordinator:
             pass
         self._transcribe(h)
         self._transcribe_spans(h)
-        self.journal.append("revoke", host=h.host_id, reason=reason)
+        revoke_rec = self.journal.append("revoke", host=h.host_id,
+                                         reason=reason)
         self.revocations += 1
         if not h.joined:
             # died before its first heartbeat: a stillborn spawn.  The
@@ -925,6 +998,8 @@ class FabricCoordinator:
                    if u in self._unresolved]
         self.report.event("host_down", host=h.host_id, reason=reason,
                           reassigned=len(victims))
+        self._ctl("ctl.failover", key=revoke_rec["seq"], host=h.host_id,
+                  reason=reason, reassigned=len(victims))
         for u in victims:
             self._migrating.pop(u, None)
             self._fencing.pop(u, None)
@@ -1005,6 +1080,21 @@ class FabricCoordinator:
                 h.proc.kill()
             except Exception:
                 pass
+
+    # -- the control-plane trace lane --------------------------------------
+
+    def _ctl(self, name: str, *, key, flow_user=None, **attrs) -> None:
+        """One control-plane decision span (``obs.trace.Tracer.
+        control_event``): every journaled elastic/fabric decision lands
+        in its own Perfetto lane, keyed by the decision's durable
+        identity so a coordinator SIGKILL + replay re-emits identical
+        ids and the merge dedupes.  Off under ``--no-trace`` (no tracer)
+        and ``--no-introspection`` (the PR 14 arm)."""
+        if self.tracer is None or not self.tracer.enabled \
+                or not self.introspect:
+            return
+        self.tracer.control_event(name, key=key, flow_user=flow_user,
+                                  **attrs)
 
     # -- routing + transcription -------------------------------------------
 
@@ -1140,6 +1230,14 @@ class FabricCoordinator:
                 # already re-routed every pending user from the journal
                 self.journal.append("drop", u, host=h.host_id,
                                     src_off=off, ok=bool(rec.get("ok")))
+                # the ack span keys on (host, src_off) — the worker-WAL
+                # byte identity a stale re-read after a coordinator
+                # restart shares, so replay re-emits the SAME id and the
+                # merge dedupes (journal seq would fork: stale acks
+                # re-journal under a new seq)
+                self._ctl("ctl.rebalance", key=(h.host_id, off), user=u,
+                          ok=bool(rec.get("ok")),
+                          flow_user=u if rec.get("ok") else None)
                 target = self._migrating.pop(u, None)
                 if target is None:
                     continue
@@ -1151,6 +1249,9 @@ class FabricCoordinator:
                         self._assign(u)  # target died mid-move: re-place
                     self.migrations += 1
                     self.report.event("migrate", user=u, host=target)
+                    self._ctl("ctl.migrate", key=("q", h.host_id, off),
+                              user=u, host=target, kind="queued",
+                              flow_user=u)
                 elif not rec.get("ok"):
                     self.report.event("migrate_refused", user=u)
             elif ev == "fence":
@@ -1173,6 +1274,11 @@ class FabricCoordinator:
                                   host=h.host_id,
                                   ok=bool(rec.get("ok")),
                                   gen=rec.get("gen"))
+                # keyed on the worker-WAL byte identity, like drop acks
+                self._ctl("ctl.fence", key=(h.host_id, off), user=u,
+                          host=h.host_id, ok=bool(rec.get("ok")),
+                          gen=rec.get("gen"),
+                          flow_user=u if rec.get("ok") else None)
                 src = self._fencing.pop(u, None)
                 if src is None:
                     continue
@@ -1190,6 +1296,10 @@ class FabricCoordinator:
                         self.report.event("migrate_inflight", user=u,
                                           host=target,
                                           gen=rec.get("gen"))
+                        self._ctl("ctl.migrate",
+                                  key=("i", h.host_id, off), user=u,
+                                  host=target, kind="inflight",
+                                  gen=rec.get("gen"), flow_user=u)
                     # no live target: the released user keeps its stale
                     # assignment to the retiring source — the next JOIN
                     # (stranded path) or the restart re-places it; no
@@ -1229,6 +1339,52 @@ class FabricCoordinator:
         for rec, _off in h.span_tail.poll():
             self.tracer.transcribe(rec, host=h.host_id)
 
+    # -- live introspection ------------------------------------------------
+
+    def _status_payload(self) -> dict:
+        """The coordinator's fleet-wide snapshot: per-host liveness
+        (lease ages through the injected clock), drain/fence/migration
+        progress, unresolved counts, the broadcast bucket edges and the
+        active alerts.  Lease-expiry burn alerts evaluate here — the
+        coordinator is the only process that watches every lease."""
+        now = self._clock()
+        st = self.journal.state
+        hosts: dict = {}
+        lease_ages: dict = {}
+        for hid, h in self.hosts.items():
+            age = lease_age_s(h.lease_path, now) if h.alive else None
+            hosts[hid] = {
+                "alive": h.alive, "joined": h.joined,
+                "draining": h.draining,
+                "lease_age_s": round(age, 3) if age is not None else None,
+                "load": self._load_of(hid),
+            }
+            if h.alive and h.joined:
+                lease_ages[hid] = age
+        if self.alerts is not None:
+            from consensus_entropy_tpu.obs import alerts as alerts_mod
+
+            self.alerts.update(alerts_mod.lease_alerts(
+                lease_ages, self.config.lease_s))
+        payload = {
+            "hosts": hosts,
+            "unresolved": len(self._unresolved),
+            "queued": sum(1 for u in st.queued
+                          if u in self._unresolved),
+            "in_flight": sum(1 for u in st.in_flight
+                             if u in self._unresolved),
+            "spawns": self.spawns, "joins": self.joins,
+            "migrations": self.migrations, "drains": self.drains,
+            "fences": self.fences, "revocations": self.revocations,
+            "draining_host": self._draining_host,
+            "edges": list(self._fleet_edges()) or None,
+        }
+        if self.fleet_planner is not None:
+            payload["fleet_planner"] = self.fleet_planner.summary()
+        if self.alerts is not None:
+            payload["alerts"] = self.alerts.active
+        return payload
+
     # -- summary -----------------------------------------------------------
 
     def _summary(self) -> dict:
@@ -1253,6 +1409,17 @@ class FabricCoordinator:
         }
         if self.fleet_planner is not None:
             summary["fleet_planner"] = self.fleet_planner.summary()
+        if self.config.drain_host is not None \
+                and not self._operator_drained:
+            # the operator command was never serviced (typo'd host id,
+            # or the run resolved before the host ever joined) — a
+            # silent exit 0 would read as "drained"; surface it in the
+            # summary AND the event stream so the CLI can warn
+            summary["drain_host_unserviced"] = self.config.drain_host
+            self.report.event(
+                "drain", reason=f"--drain-host {self.config.drain_host} "
+                "was never serviced: the host never became live+joined "
+                "during this run")
         self.report.event(
             "fabric_summary", users=summary["users"],
             finished=len(summary["finished"]),

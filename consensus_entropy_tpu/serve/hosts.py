@@ -159,7 +159,8 @@ class HostLease:
 
 def run_worker(fabric_dir: str, host_id: str, *, build_entry, scheduler,
                config, on_result=None, lease_s: float = 5.0,
-               preemption=None, poll_s: float = 0.05) -> list:
+               preemption=None, poll_s: float = 0.05,
+               status=None, alerts=None) -> list:
     """Run one fabric worker to completion; returns the server's results.
 
     ``build_entry(user_id) -> FleetUser | None``: constructs the user's
@@ -179,8 +180,11 @@ def run_worker(fabric_dir: str, host_id: str, *, build_entry, scheduler,
     """
     paths = fabric_paths(fabric_dir, host_id)
     journal = AdmissionJournal(paths["events"])
+    # ``status``/``alerts``: the worker's live-introspection limbs
+    # (obs.status.StatusWriter / obs.alerts.AlertWatcher), None under
+    # --no-introspection
     server = FleetServer(scheduler, config, preemption=preemption,
-                         journal=journal)
+                         journal=journal, status=status, alerts=alerts)
     feed = JsonlTail(paths["assign"])
     stop = threading.Event()
 

@@ -598,13 +598,16 @@ class AdmissionJournal:
     def ckpt_path(self) -> str | None:
         return _ckpt_path(self.path) if self.path else None
 
-    def append(self, event: str, user=None, **fields) -> None:
-        """Durably record one transition; thread-safe.  The
-        ``serve.journal.append`` fault point fires BEFORE the write: an
-        injected kill there models dying with the transition un-journaled,
-        which recovery must treat as 'never happened' (the enclosing step
-        is re-done on restart).  Host-membership records (``lease`` /
-        ``revoke``) carry a ``host=`` field instead of a user."""
+    def append(self, event: str, user=None, **fields) -> dict:
+        """Durably record one transition; thread-safe.  Returns the
+        record as written — its ``seq`` is the decision's durable
+        identity (the control-plane trace lane keys span ids on it).
+        The ``serve.journal.append`` fault point fires BEFORE the write:
+        an injected kill there models dying with the transition
+        un-journaled, which recovery must treat as 'never happened' (the
+        enclosing step is re-done on restart).  Host-membership records
+        (``lease`` / ``revoke``) carry a ``host=`` field instead of a
+        user."""
         if event in HOST_EVENTS:
             if not isinstance(fields.get("host"), str):
                 raise ValueError(f"journal event {event!r} needs host=")
@@ -627,6 +630,7 @@ class AdmissionJournal:
             if (self.compact_bytes
                     and self._file.size() > self.compact_bytes):
                 self._compact_locked()
+            return rec
 
     def is_finished(self, user) -> bool:
         """Thread-safe finished-check for producer-side skip decisions

@@ -359,6 +359,12 @@ class AdmissionQueue:
                         return item[0]
         return None
 
+    def depths(self) -> dict:
+        """``{class: queued count}`` over every class (empty classes
+        included) — the status snapshot's queue view."""
+        with self._cond:
+            return {cls: len(dq) for cls, dq in self._q.items()}
+
     def head_waits(self) -> dict:
         """``{class: seconds its head entry has waited}`` for non-empty
         classes — the SLO-headroom input of the planner's admission
@@ -410,7 +416,8 @@ class FleetServer:
     """
 
     def __init__(self, scheduler: FleetScheduler, config: ServeConfig, *,
-                 preemption=None, journal=None, poison=None):
+                 preemption=None, journal=None, poison=None,
+                 status=None, alerts=None):
         if scheduler.preemption is not None:
             raise ValueError(
                 "serve mode owns preemption: build the FleetScheduler with "
@@ -460,6 +467,15 @@ class FleetServer:
         #: deferred acks journaled) on the serve-loop thread
         self._fence_req: list = []
         self._fence_lock = threading.Lock()
+        #: the live introspection plane (``--no-introspection`` passes
+        #: neither — the PR 14 arm): ``status`` is an ``obs.status.
+        #: StatusWriter`` the serve loop refreshes (rate-limited inside
+        #: the writer), ``alerts`` an ``obs.alerts.AlertWatcher``
+        #: evaluated on the same cadence over the telemetry this server
+        #: already records.  Pure observation: neither feeds any
+        #: journaled or replayed decision.
+        self.status = status
+        self.alerts = alerts
         self._backoff_rng = np.random.default_rng(config.backoff_seed)
         # the fault-domain engine hooks: install from config unless the
         # caller wired its own instances into the scheduler already
@@ -709,6 +725,7 @@ class FleetServer:
         try:
             while True:
                 self._apply_fences()
+                self._introspect()
                 if (self.preemption is not None
                         and self.preemption.requested
                         and not self._draining):
@@ -804,6 +821,78 @@ class FleetServer:
         return self.results
 
     # -- internals ---------------------------------------------------------
+
+    def _introspect(self) -> None:
+        """One live-introspection round: refresh this host's status
+        snapshot (rate-limited inside the writer — most rounds cost one
+        clock read) and, on the same cadence, evaluate the SLO burn-rate
+        alerts.  Observation only; absent under ``--no-introspection``."""
+        if self.status is not None:
+            self.status.maybe_write(self._status_payload)
+
+    def _evaluate_alerts(self) -> list:
+        from consensus_entropy_tpu.obs import alerts as alerts_mod
+
+        slo = self.planner.slo if self.planner is not None else {
+            "interactive": self.config.slo_interactive_s,
+            "batch": self.config.slo_batch_s}
+        out = alerts_mod.slo_headroom_alerts(self.report.class_p95s(),
+                                             slo)
+        out += alerts_mod.batch_aging_alerts(self.queue.head_waits(),
+                                             self.config.aging_s)
+        breaker = self.scheduler.breaker
+        if breaker is not None:
+            out += alerts_mod.breaker_alerts(breaker.summary())
+        return out
+
+    def _status_payload(self) -> dict:
+        """This host's live state, as the snapshot payload: queue depth
+        per class, live sessions (and their class mix), drain/fence
+        state, bucket occupancy, planner edges, jit-cache pressure and
+        the active alerts."""
+        if self.alerts is not None:
+            self.alerts.update(self._evaluate_alerts())
+        from consensus_entropy_tpu.obs import jit_telemetry
+
+        sched = self.scheduler
+        depths = self.queue.depths()
+        live_cls: dict = {}
+        for c in self._live_cls.values():
+            live_cls[c] = live_cls.get(c, 0) + 1
+        with self._fence_lock:
+            fences_pending = len(self._fence_req)
+        payload = {
+            "queued": depths,
+            "queue_total": sum(depths.values()),
+            "live": sched.n_live,
+            "live_cls": live_cls,
+            "target_live": self.config.target_live,
+            "draining": self._draining,
+            "intake_open": self._intake_open,
+            "fences_pending": fences_pending,
+            "requeued": len(self._requeue),
+            "users_done": self.report.users_done,
+            "users_failed": self.report.users_failed,
+        }
+        if self.planner is not None:
+            payload["planner"] = self.planner.summary()
+        breaker = sched.breaker
+        if breaker is not None:
+            degraded = breaker.summary()
+            if degraded:
+                payload["breaker"] = {str(w): s
+                                      for w, s in degraded.items()}
+        per_bucket = self.report.per_bucket_occupancy
+        if per_bucket is not None:
+            payload["buckets"] = {str(w): b
+                                  for w, b in per_bucket.items()}
+        jit = jit_telemetry.snapshot()
+        payload["jit"] = {k: jit[k] for k in
+                          ("families", "lookups", "builds", "hits",
+                           "compiles", "resident")}
+        if self.alerts is not None:
+            payload["alerts"] = self.alerts.active
+        return payload
 
     def _refill(self, src, src_live: bool) -> bool:
         """Top the waiting queue up from the pull source — never past the

@@ -1,16 +1,19 @@
 #!/usr/bin/env bash
-# Tracing-overhead race (ISSUE 9 acceptance: traced-vs-untraced serve
-# throughput overhead <= 3%, parity asserted every rep).
+# Introspection-overhead race (ISSUE 9 acceptance: traced-vs-untraced
+# serve throughput overhead <= 3%, parity asserted every rep; the
+# ISSUE 15 plane rides the same arms).
 #
-# Runs `bench.py --suite obs`: a serve run with the obs span tracer
-# writing a real spans.jsonl vs the --no-trace arm over IDENTICAL users
-# and seeds, interleaved with alternating order per rep.  The headline
-# is the MEDIAN of per-rep paired wall ratios (pairing cancels the
-# throttled box's slow drift); the identical-arm noise floor and the
-# deterministic per-span emit cost ride along in the artifact so the
-# number reads in context.  Every traced rep also schema-validates its
-# fleet_metrics.jsonl and asserts the merged span set is orphan-free
-# with a loadable Chrome export.
+# Runs `bench.py --suite obs`: a serve run with the WHOLE introspection
+# plane live (span tracer writing a real spans.jsonl, compile events,
+# status snapshots refreshing, alert watcher evaluating) vs the
+# everything-off arm over IDENTICAL users and seeds, interleaved with
+# alternating order per rep.  The headline is the MEDIAN of per-rep
+# paired wall ratios (pairing cancels the throttled box's slow drift);
+# the identical-arm noise floor and the deterministic per-span emit
+# cost ride along in the artifact so the number reads in context.
+# Every plane-on rep also schema-validates its fleet_metrics.jsonl,
+# asserts the merged span set is orphan-free with a loadable Chrome
+# export, and validates its final status snapshot.
 #
 # The JSON line goes to stdout (redirect to BENCH_obs_r<N>.json to
 # commit an artifact); the per-rep log goes to stderr.  Extra bench

@@ -1,14 +1,26 @@
 #!/usr/bin/env bash
-# Observability CI gate (ISSUE 9 satellite; sits next to fault_matrix.sh).
+# Observability CI gate (ISSUE 9 satellite; sits next to fault_matrix.sh;
+# the ISSUE 15 live-introspection leg rides below).
 #
-# Runs a REAL traced 2-user serve cohort over the synthetic workload,
-# then:
+# LEG 1 — runs a REAL traced 2-user serve cohort over the synthetic
+# workload, then:
 #   1. validates EVERY fleet_metrics.jsonl line against the schema-v2
 #      event table (obs.export.validate_metrics_file),
 #   2. asserts the span WAL merges orphan-free and the Chrome trace
 #      export loads as JSON with complete events,
 #   3. round-trips the `report` CLI subcommand (--validate --out) over
 #      the run's users dir.
+#
+# LEG 2 — the LIVE leg: a REAL traced 3-host elastic drain+migrate run
+# (worker subprocesses slowed by a pool.score:delay= rule so sessions
+# outlive the drain decision), introspection plane ON, and:
+#   1. MID-RUN status snapshots (coordinator + workers) schema-validate
+#      while the fabric is still serving,
+#   2. at least one SLO burn-rate alert fires (batch aging under the
+#      tiny aging bound) as a schema-valid `alert` event,
+#   3. the exported Chrome trace carries the control-plane lane with
+#      drain→fence→migrate spans FLOW-LINKED into the migrated user's
+#      trace, and `cetpu-top --once` renders the snapshot directory.
 #
 # Extra args are NOT accepted: this is a pass/fail gate, not a bench.
 set -euo pipefail
@@ -79,5 +91,151 @@ assert report_main([users_dir, "--validate", "--out", out,
                     "--no-text"]) == 0
 assert json.load(open(out))["traceEvents"]
 print("obs_check: report CLI validate+export ok")
+
+# ---- LEG 2: the live introspection leg (ISSUE 15) ---------------------
+
+import glob as glob_mod
+import subprocess
+
+from consensus_entropy_tpu.obs.alerts import AlertWatcher
+from consensus_entropy_tpu.obs.status import (
+    StatusWriter,
+    read_status_dir,
+    validate_status,
+)
+from consensus_entropy_tpu.serve import (
+    AdmissionJournal,
+    FabricConfig,
+    FabricCoordinator,
+)
+from consensus_entropy_tpu.serve.hosts import fabric_paths
+from tests.fabric_workload import (
+    force_low_water,
+    make_cfg,
+    read_results,
+    sizes_arg,
+    user_specs,
+)
+
+cfg2 = make_cfg("mc", epochs=3)
+specs2 = user_specs(6, sizes=[30, 100])
+root2 = tempfile.mkdtemp(prefix="obs_check_live_")
+fdir = os.path.join(root2, "fabric")
+status_dir = os.path.join(root2, "status")
+os.makedirs(fdir)
+jp = os.path.join(fdir, "serve_journal.jsonl")
+
+
+def spawn(host_id):
+    log = open(fabric_paths(fdir, host_id)["log"], "ab")
+    try:
+        return subprocess.Popen(
+            [sys.executable, "tests/fabric_worker.py", fdir, host_id,
+             root2, cfg2.mode, str(cfg2.epochs), str(len(specs2)),
+             "5.0", "1", sizes_arg(specs2)],
+            stdout=log, stderr=subprocess.STDOUT,
+            # the pool.score delay rule is the SLOW-HOST simulation:
+            # sessions outlive the drain decision so the fence window
+            # opens; target_live=1 queues the second user per host so
+            # the tiny aging bound fires a batch_aging alert
+            env={**os.environ, "PYTHONPATH": ".",
+                 "CETPU_FAULTS": "pool.score:delay=0.2@1x-1",
+                 "CETPU_OBS_TRACE": "1", "CETPU_FABRIC_METRICS": "1",
+                 "CETPU_OBS_STATUS": status_dir,
+                 "CETPU_OBS_AGING": "0.2"})
+    finally:
+        log.close()
+
+
+from consensus_entropy_tpu.fleet import FleetReport
+from consensus_entropy_tpu.obs.trace import Tracer
+
+spans_path = os.path.join(root2, "spans.jsonl")
+coord_metrics = os.path.join(root2, "fleet_metrics.jsonl")
+tracer = Tracer(spans_path, run_id=f"{cfg2.mode}-{cfg2.seed}",
+                host="coordinator")
+report2 = FleetReport(coord_metrics)
+mid_run = {"snaps": {}, "checked": 0}
+
+
+def on_poll(coord):
+    force_low_water(coord)
+    # the MID-RUN snapshot gate: while users are still unresolved, every
+    # snapshot present must already schema-validate
+    if coord._unresolved and mid_run["checked"] < 200:
+        mid_run["checked"] += 1
+        for host, snap in read_status_dir(status_dir).items():
+            errs = validate_status(snap)
+            assert errs == [], (host, errs)
+            mid_run["snaps"][host] = snap
+
+
+journal = AdmissionJournal(jp)
+status = StatusWriter(status_dir, "coordinator", interval_s=0.2)
+alerts = AlertWatcher(report2, log=print)
+coord = FabricCoordinator(
+    journal, fdir,
+    FabricConfig(hosts=3, min_hosts=2, max_hosts=3, scale_down_s=600.0,
+                 drain_timeout_s=30.0),
+    report=report2, tracer=tracer, status=status, alerts=alerts,
+    on_poll=on_poll)
+try:
+    summary2 = coord.run([u for _, u, _ in specs2], spawn,
+                         pools={u: n for _, u, n in specs2})
+finally:
+    tracer.close()
+    journal.close()
+    report2.write_summary(cohort=len(specs2))
+    report2.close()
+
+assert sorted(summary2["finished"]) == sorted(u for _, u, _ in specs2)
+assert summary2["drains"] == 1 and summary2["fences"] >= 1, summary2
+results2 = read_results(fdir)
+assert all(results2[u]["error"] is None for _, u, _ in specs2)
+
+# 1. mid-run snapshots were seen (coordinator + at least one worker)
+# and validated while the fabric was serving
+assert "coordinator" in mid_run["snaps"], sorted(mid_run["snaps"])
+assert any(h.startswith("h") for h in mid_run["snaps"]), \
+    sorted(mid_run["snaps"])
+print(f"obs_check live: {len(mid_run['snaps'])} mid-run snapshots "
+      f"schema-valid ({sorted(mid_run['snaps'])})")
+
+# 2. at least one burn-rate alert fired, schema-valid in a metrics
+# stream (the workers' batch_aging under the tiny bound)
+alert_events = []
+for path in [coord_metrics] + sorted(
+        glob_mod.glob(os.path.join(fdir, "fleet_metrics_*.jsonl"))):
+    recs = export.read_jsonl_tolerant(path)
+    assert export.validate_metrics(recs) == [], path
+    alert_events += [r for r in recs if r.get("event") == "alert"]
+assert alert_events, "no alert fired in the live leg"
+print(f"obs_check live: {len(alert_events)} alert event(s) "
+      f"({sorted({a.get('kind') for a in alert_events})})")
+
+# 3. the export carries the control-plane lane, drain→fence→migrate
+# spans, and flow links into the migrated user's trace
+spans2 = export.load_spans([spans_path])
+ctl = [s for s in spans2 if s.get("ctl")]
+names = {s["name"] for s in ctl}
+assert {"ctl.drain", "ctl.fence", "ctl.migrate",
+        "ctl.drain_done"} <= names, sorted(names)
+trace2 = export.chrome_trace(spans2)
+procs = {e["args"]["name"] for e in trace2["traceEvents"]
+         if e.get("name") == "process_name"}
+assert "control-plane" in procs, procs
+starts = [e for e in trace2["traceEvents"] if e.get("ph") == "s"]
+ends = {e["id"] for e in trace2["traceEvents"] if e.get("ph") == "f"}
+assert starts and all(e["id"] in ends for e in starts), \
+    (len(starts), len(ends))
+json.dumps(trace2)
+print(f"obs_check live: control lane {sorted(names)} with "
+      f"{len(starts)} flow link(s) into user traces")
+
+# 4. cetpu-top renders the final snapshot directory
+from consensus_entropy_tpu.cli.top import main as top_main
+
+assert top_main([root2, "--once"]) == 0
+print("obs_check live: cetpu-top rendered the fleet view")
 PY
 echo "obs check passed"

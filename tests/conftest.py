@@ -71,10 +71,10 @@ def _clear_process_wide_jit_caches():
     from consensus_entropy_tpu.parallel import sharding
 
     cnn_trainer._EPOCH_FNS.clear()
-    committee._infer_fns.cache_clear()
-    committee._qbdc_infer_fn.cache_clear()
-    committee._user_infer_fn.cache_clear()
-    committee._user_qbdc_infer_fn.cache_clear()
+    committee._infer_fns_cached.cache_clear()
+    committee._qbdc_infer_fn_cached.cache_clear()
+    committee._user_infer_fn_cached.cache_clear()
+    committee._user_qbdc_infer_fn_cached.cache_clear()
     scoring._make_scoring_fns_cached.cache_clear()
     scoring._make_fleet_scoring_fns_cached.cache_clear()
     scoring._fleet_fns_for_width_cached.cache_clear()

@@ -82,6 +82,17 @@ def main(argv) -> int:
     scheduler = FleetScheduler(cfg, report=report,
                                retrain_epochs=retrain_epochs_for(mode),
                                scoring_by_width=True, tracer=tracer)
+    status = alerts = None
+    if os.environ.get("CETPU_OBS_STATUS"):
+        # the live-introspection drill arm (scripts/obs_check.sh leg 2):
+        # status snapshots into the named directory + the alert watcher
+        # over this worker's own telemetry, exactly as the CLI wires them
+        from consensus_entropy_tpu.obs.alerts import AlertWatcher
+        from consensus_entropy_tpu.obs.status import StatusWriter
+
+        status = StatusWriter(os.environ["CETPU_OBS_STATUS"], host_id,
+                              interval_s=0.2)
+        alerts = AlertWatcher(report)
     try:
         with PreemptionGuard() as guard:
             run_worker(fabric_dir, host_id,
@@ -90,10 +101,12 @@ def main(argv) -> int:
                        # planner_epoch=2: the tiny synthetic cohorts must
                        # still journal sketch epochs, or the elastic
                        # fleet planner would have nothing to merge
-                       config=ServeConfig(target_live=int(target),
-                                          planner_epoch=2),
+                       config=ServeConfig(
+                           target_live=int(target), planner_epoch=2,
+                           aging_s=float(os.environ.get(
+                               "CETPU_OBS_AGING", 30.0))),
                        on_result=on_result, lease_s=float(lease_s),
-                       preemption=guard)
+                       preemption=guard, status=status, alerts=alerts)
     except Preempted:
         return EXIT_PREEMPTED
     finally:

@@ -685,10 +685,13 @@ class _FakeWorker:
                      "sketch": _sketch_of(pools).to_dict()})
 
 
-def _fake_fleet(tmp_path, config, users, pools, script):
+def _fake_fleet(tmp_path, config, users, pools, script, tracer=None,
+                status=None, alerts=None):
     """Run a coordinator over fake workers; ``script(round, coord,
     workers)`` drives the scenario each poll and returns True to keep
-    going."""
+    going.  ``tracer``/``status``/``alerts``: the introspection-plane
+    limbs (``tests/test_introspection.py`` passes them; the base drills
+    run bare)."""
     fabric_dir = str(tmp_path / "fabric")
     os.makedirs(fabric_dir, exist_ok=True)
     journal = AdmissionJournal(
@@ -710,11 +713,15 @@ def _fake_fleet(tmp_path, config, users, pools, script):
             w.pump()
         script(state["round"], coord, workers)
 
-    coord = FabricCoordinator(journal, fabric_dir, config, on_poll=on_poll)
+    coord = FabricCoordinator(journal, fabric_dir, config,
+                              on_poll=on_poll, tracer=tracer,
+                              status=status, alerts=alerts)
     try:
         summary = coord.run(users, spawn, pools=pools)
     finally:
         journal.close()
+        if tracer is not None:
+            tracer.close()
     return summary, coord, workers, fabric_dir
 
 

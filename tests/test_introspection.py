@@ -1,0 +1,528 @@
+"""The live introspection plane (ISSUE 15): control-plane trace lane,
+status snapshots, SLO burn-rate alerts, jit-compile telemetry, and the
+``--drain-host`` operator command.
+
+Tier-1 keeps the pure-host units (status atomic-rename/torn-read,
+alert-threshold kernels with injected inputs, edge-triggered watcher,
+``cetpu-top`` rendering, control-span id dedupe, ``planner_timeline``'s
+journal-epoch leg, config validation) plus three deterministic drills:
+the traced fake-fleet DRAIN drill (ctl.drain → ctl.fence → ctl.migrate
+→ ctl.drain_done spans in the control lane, flow-linked to the migrated
+user, continuity across a coordinator SIGKILL+replay), the operator
+``--drain-host`` fake-fleet drill (same journaled machinery, operator
+initiated), and a 2-user serve smoke pinning compile-event family
+determinism across a serve restart.  The live 2-host subprocess leg
+runs in ``scripts/obs_check.sh``.
+"""
+
+import json
+import os
+
+import pytest
+
+from consensus_entropy_tpu.obs import alerts as alerts_mod
+from consensus_entropy_tpu.obs import export, jit_telemetry
+from consensus_entropy_tpu.obs.status import (
+    StatusWriter,
+    read_status,
+    read_status_dir,
+    status_path,
+    validate_status,
+)
+from consensus_entropy_tpu.obs.trace import Tracer
+from consensus_entropy_tpu.resilience.faults import InjectedKill
+from consensus_entropy_tpu.serve import AdmissionJournal, FabricConfig
+from tests.test_elastic import _drain_script, _fake_fleet
+
+pytestmark = [pytest.mark.obs, pytest.mark.serve]
+
+
+# -- status snapshots ------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_status_writer_atomic_rename_and_rate_limit(tmp_path):
+    clock = _Clock()
+    w = StatusWriter(str(tmp_path), "h0", interval_s=1.0, clock=clock)
+    built = []
+
+    def build():
+        built.append(1)
+        return {"live": 2, "queued": {"batch": 1}}
+
+    assert w.maybe_write(build) is True
+    snap = read_status(status_path(str(tmp_path), "h0"))
+    assert snap["host"] == "h0" and snap["live"] == 2
+    assert snap["t"] == 100.0 and snap["kind"] == "status"
+    assert validate_status(snap) == []
+    # inside the interval: no write, and build() not even called
+    assert w.maybe_write(build) is False
+    assert len(built) == 1
+    clock.t += 1.5
+    assert w.maybe_write(build) is True
+    assert len(built) == 2
+    # no .tmp litter (the rename completed)
+    assert not os.path.exists(status_path(str(tmp_path), "h0") + ".tmp")
+
+
+def test_status_maybe_write_is_best_effort(tmp_path):
+    """The introspection plane must never take down the loop it
+    observes: a failing payload builder (or a failing filesystem) is
+    swallowed and counted, and the writer backs off to its interval
+    instead of retrying at poll rate."""
+    clock = _Clock()
+    w = StatusWriter(str(tmp_path), "h0", interval_s=1.0, clock=clock)
+
+    def boom():
+        raise OSError("disk full")
+
+    assert w.maybe_write(boom) is False
+    assert w.errors == 1 and w.writes == 0
+    assert w.maybe_write(boom) is False  # inside the backoff interval
+    assert w.errors == 1
+    clock.t += 1.5
+    assert w.maybe_write(lambda: {"live": 1}) is True
+    assert w.writes == 1
+    # write() itself still raises (unit-test/diagnostic surface)
+    with pytest.raises(TypeError):
+        w.write(object())
+
+
+def test_status_reader_tolerates_torn_and_foreign_files(tmp_path):
+    StatusWriter(str(tmp_path), "h0", clock=_Clock()).write({"live": 1})
+    # a torn copy (half a JSON object) and a non-dict file
+    (tmp_path / "status_h1.json").write_text('{"kind": "status", "ho')
+    (tmp_path / "status_h2.json").write_text("[1, 2, 3]")
+    assert read_status(str(tmp_path / "status_h1.json")) is None
+    assert read_status(str(tmp_path / "status_h2.json")) is None
+    snaps = read_status_dir(str(tmp_path))
+    assert list(snaps) == ["h0"]
+    # schema-floor violations are named
+    assert validate_status({"kind": "status", "host": "h0"})
+    assert validate_status({"schema": 1, "kind": "status", "host": "h0",
+                            "t": "late"})
+    assert validate_status({"schema": 1, "kind": "status", "host": "h0",
+                            "t": 1.0, "alerts": [{"no_kind": 1}]})
+
+
+# -- alert kernels + watcher -----------------------------------------------
+
+
+def test_alert_kernels_threshold_tables():
+    slo = {"interactive": 60.0, "batch": 600.0}
+    # below the burn fraction: quiet
+    assert alerts_mod.slo_headroom_alerts(
+        {"interactive": 40.0}, slo) == []
+    fired = alerts_mod.slo_headroom_alerts(
+        {"interactive": 50.0, "batch": 10.0}, slo)
+    assert [a["cls"] for a in fired] == ["interactive"]
+    assert fired[0]["kind"] == "slo_headroom" and fired[0]["burn"] > 0.8
+    # unknown class target / None p95: quiet
+    assert alerts_mod.slo_headroom_alerts({"vip": 99.0}, slo) == []
+    assert alerts_mod.slo_headroom_alerts({"batch": None}, slo) == []
+
+    assert alerts_mod.batch_aging_alerts({"batch": 31.0}, 0.0) == []
+    assert alerts_mod.batch_aging_alerts({"batch": 29.0}, 30.0) == []
+    assert alerts_mod.batch_aging_alerts(
+        {"interactive": 99.0}, 30.0) == []  # the top class never ages
+    fired = alerts_mod.batch_aging_alerts({"batch": 31.0}, 30.0)
+    assert fired and fired[0]["kind"] == "batch_aging"
+
+    assert alerts_mod.breaker_alerts(None) == []
+    # a CLOSED width with recent failures rides along in
+    # DispatchBreaker.summary() — it must NOT alert (stacked dispatch
+    # is intact)
+    fired = alerts_mod.breaker_alerts({512: "open", 64: "gave_up",
+                                       128: "closed"})
+    assert [(a["width"], a["state"]) for a in fired] \
+        == [(64, "gave_up"), (512, "open")]
+
+    assert alerts_mod.lease_alerts({"h0": None}, 5.0) == []
+    assert alerts_mod.lease_alerts({"h0": 1.0}, 5.0) == []
+    fired = alerts_mod.lease_alerts({"h0": 4.5, "h1": 0.1}, 5.0)
+    assert [a["host"] for a in fired] == ["h0"]
+    assert fired[0]["kind"] == "lease_expiry"
+
+
+def test_alert_watcher_edge_triggers_and_schema(tmp_path):
+    from consensus_entropy_tpu.fleet.report import FleetReport
+
+    path = str(tmp_path / "fleet_metrics.jsonl")
+    report = FleetReport(path)
+    logged = []
+    w = alerts_mod.AlertWatcher(report, log=logged.append)
+    a = {"kind": "breaker_open", "key": "512", "width": 512,
+         "state": "open"}
+    assert w.update([a]) == [a]          # rises → fires
+    assert w.update([a]) == []           # still active → silent
+    assert w.active == [a]
+    assert w.update([]) == []            # clears
+    assert w.active == []
+    assert w.update([a]) == [a]          # re-rises → re-fires
+    assert w.fired == 2
+    assert logged and "breaker_open" in logged[0]
+    report.close()
+    recs = export.read_jsonl_tolerant(path)
+    alerts = [r for r in recs if r.get("event") == "alert"]
+    assert len(alerts) == 2
+    assert export.validate_metrics(recs) == []
+
+
+# -- control-plane trace lane ----------------------------------------------
+
+
+def test_control_event_ids_deterministic_and_dedupe(tmp_path):
+    """The replay contract at unit level: two tracers (two coordinator
+    incarnations) emitting the same decision under the same durable key
+    produce ONE merged span; different keys stay distinct."""
+    p1, p2 = str(tmp_path / "s1.jsonl"), str(tmp_path / "s2.jsonl")
+    for path in (p1, p2):
+        t = Tracer(path, run_id="mc-7", host="coordinator")
+        t.control_event("ctl.fence", key=("h1", 184), flow_user="u3",
+                        ok=True, gen=2)
+        t.control_event("ctl.drain", key=41, host="h1")
+        t.close()
+    spans = export.load_spans([p1, p2])
+    ctl = [s for s in spans if s.get("ctl")]
+    assert sorted(s["name"] for s in ctl) == ["ctl.drain", "ctl.fence"]
+    # and a DIFFERENT key forks a different id
+    t = Tracer(p1, run_id="mc-7", host="coordinator")
+    t.control_event("ctl.fence", key=("h1", 999), flow_user="u3")
+    t.close()
+    ctl2 = [s for s in export.load_spans([p1, p2]) if s.get("ctl")]
+    assert len(ctl2) == 3
+
+
+def test_chrome_trace_control_lane_and_flow_links():
+    t = Tracer(None, run_id="mc-7", host="coordinator")
+    t.open_user("u3")
+    t.control_event("ctl.migrate", key=("i", "h1", 184), flow_user="u3",
+                    host="h0", kind="inflight")
+    t.control_event("ctl.spawn", key=7, host="h2")
+    t.close_user("u3")
+    t.close()
+    trace = export.chrome_trace(t.records)
+    procs = {e["args"]["name"]: e["pid"] for e in trace["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert "control-plane" in procs
+    ctl_x = [e for e in trace["traceEvents"] if e.get("ph") == "X"
+             and e.get("pid") == procs["control-plane"]]
+    assert sorted(e["name"] for e in ctl_x) \
+        == ["ctl.migrate", "ctl.spawn"]
+    starts = [e for e in trace["traceEvents"] if e.get("ph") == "s"]
+    ends = [e for e in trace["traceEvents"] if e.get("ph") == "f"]
+    assert len(starts) == 1 and len(ends) == 1
+    assert starts[0]["id"] == ends[0]["id"]
+    assert starts[0]["pid"] == procs["control-plane"]
+    assert ends[0]["pid"] == procs["host coordinator"]  # the user lane
+
+
+def test_traced_drain_drill_control_spans_and_kill_replay(tmp_path):
+    """The acceptance drill, fake-fleet shape: a traced elastic
+    drain+migrate run lands ctl.drain → ctl.fence → ctl.migrate →
+    ctl.drain_done in the control lane with flow links to the migrated
+    user; a coordinator SIGKILL mid-drain + replay appends to the same
+    span WAL and the merge keeps the pre-kill decisions exactly once."""
+    users = [f"u{i}" for i in range(6)]
+    pools = {u: (30 if i % 2 == 0 else 100)
+             for i, u in enumerate(users)}
+    spans_path = str(tmp_path / "spans.jsonl")
+
+    def run(script, subdir):
+        cfg = FabricConfig(hosts=2, min_hosts=1, max_hosts=2,
+                           scale_down_s=0.05, poll_s=0.01,
+                           drain_timeout_s=0.2)
+        tracer = Tracer(spans_path, run_id="mc-7", host="coordinator")
+        return _fake_fleet(tmp_path / subdir, cfg, users, pools, script,
+                           tracer=tracer)
+
+    # -- phase 1: kill the coordinator mid-drain (fences requested) --------
+    def kill_mid_drain(rnd, coord, workers):
+        _drain_script(rnd, coord, workers)
+        if coord._fencing:
+            raise InjectedKill("coordinator SIGKILL mid-drain")
+
+    with pytest.raises(InjectedKill):
+        run(kill_mid_drain, "run")
+    pre_kill = [s for s in export.load_spans([spans_path])
+                if s.get("ctl")]
+    assert any(s["name"] == "ctl.drain" for s in pre_kill)
+
+    # -- phase 2: replay the SAME journal dir to completion ----------------
+    summary, coord, workers, fabric_dir = run(_drain_script, "run")
+    assert sorted(summary["finished"]) == users
+    spans = export.load_spans([spans_path])
+    ctl = [s for s in spans if s.get("ctl")]
+    names = {s["name"] for s in ctl}
+    # the drain decision came from incarnation 1, the retirement from
+    # incarnation 2's startup ledger-close — one merged timeline
+    assert {"ctl.drain", "ctl.drain_done"} <= names
+    drains = [s for s in ctl if s["name"] == "ctl.drain"]
+    assert len(drains) == 1  # pre-kill decision survived, deduped
+    assert any(s["name"] == "ctl.drain_done" and s.get("startup")
+               for s in ctl)
+    # every span id is unique post-merge (the dedupe invariant)
+    ids = [(s["trace"], s["span"]) for s in spans]
+    assert len(ids) == len(set(ids))
+    assert export.validate_metrics([]) == []  # smoke: import path sane
+
+    # -- phase 3: a clean, UNKILLED drill shows the full chain + flows -----
+    summary2, _c, _w, _f = run(_drain_script, "clean")
+    assert sorted(summary2["finished"]) == users
+    assert summary2["drains"] == 1 and summary2["fences"] >= 1
+    spans2 = export.load_spans([spans_path])
+    ctl2 = [s for s in spans2 if s.get("ctl")]
+    names2 = {s["name"] for s in ctl2}
+    assert {"ctl.drain", "ctl.fence", "ctl.migrate",
+            "ctl.drain_done"} <= names2
+    migrated = [s for s in ctl2 if s["name"] == "ctl.migrate"]
+    assert any(s.get("kind") == "inflight" for s in migrated)
+    assert all(s.get("flow_user") for s in migrated)
+    # user root spans for the flow targets (the serve layer writes them
+    # in production; the drill emits them through the same tracer)
+    t = Tracer(spans_path, run_id="mc-7", host="coordinator")
+    for s in migrated:
+        t.open_user(s["flow_user"])
+        t.close_user(s["flow_user"])
+    t.close()
+    trace = export.chrome_trace(export.load_spans([spans_path]))
+    starts = [e for e in trace["traceEvents"] if e.get("ph") == "s"]
+    ends = {e["id"] for e in trace["traceEvents"] if e.get("ph") == "f"}
+    assert starts and all(e["id"] in ends for e in starts)
+    json.dumps(trace)  # export loads
+
+
+# -- the operator drain command --------------------------------------------
+
+
+def test_drain_host_requires_elastic():
+    with pytest.raises(ValueError, match="drain_host requires"):
+        FabricConfig(hosts=2, drain_host="h1")
+
+
+def test_operator_drain_host_drill(tmp_path):
+    """``--drain-host h1``: the named host drains through exactly the
+    journaled scale-down machinery — no low-water mark involved — and
+    retires with ``drain_done``; its in-flight user migrates via the
+    fence."""
+    users = [f"u{i}" for i in range(6)]
+    pools = {u: 30 for u in users}
+    cfg = FabricConfig(hosts=2, min_hosts=1, max_hosts=2,
+                       drain_host="h1", poll_s=0.01,
+                       drain_timeout_s=0.2)
+    summary, coord, workers, fabric_dir = _fake_fleet(
+        tmp_path, cfg, users, pools, _drain_script)
+    assert sorted(summary["finished"]) == users
+    assert summary["drains"] == 1
+    assert summary["hosts"]["h1"] == "drained"
+    jp = os.path.join(fabric_dir, "serve_journal.jsonl")
+    st = AdmissionJournal(jp).state
+    assert st.hosts["h1"] == "drain_done"
+    assert st.fleet_hosts() == ["h0"]
+    # the one-shot latch: the drill ended with the drain spent
+    assert coord._operator_drained
+    # the drain event carries the operator reason
+    drains = [e for e in coord.report.events
+              if e.get("event") == "host_drain"]
+    assert drains and drains[0]["reason"] == "operator"
+
+
+def test_operator_drain_host_unserviced_is_surfaced(tmp_path):
+    """A typo'd --drain-host (the host never exists) must not read as a
+    successful drain: the run completes, but the summary and the event
+    stream carry the unserviced command."""
+    users = [f"u{i}" for i in range(4)]
+    pools = {u: 30 for u in users}
+    cfg = FabricConfig(hosts=2, min_hosts=1, max_hosts=2,
+                       drain_host="h9", poll_s=0.01,
+                       drain_timeout_s=0.2)
+
+    def script(rnd, coord, workers):
+        if rnd > 2:
+            for w in workers.values():
+                for uid in list(w.admitted):
+                    w.finish(uid)
+                for uid in list(w.queued):
+                    w.admit(uid)
+
+    summary, coord, workers, _f = _fake_fleet(
+        tmp_path, cfg, users, pools, script)
+    assert sorted(summary["finished"]) == users
+    assert summary["drains"] == 0
+    assert summary["drain_host_unserviced"] == "h9"
+    assert any(e.get("event") == "drain" and "never serviced"
+               in (e.get("reason") or "")
+               for e in coord.report.events)
+
+
+# -- planner_timeline: the coordinator-epoch leg (report bugfix) -----------
+
+
+def test_planner_timeline_includes_journal_epochs(tmp_path):
+    users_dir = tmp_path / "users"
+    users_dir.mkdir()
+    journal = AdmissionJournal(str(users_dir / "serve_journal.jsonl"))
+    journal.append("enqueue", "u1", pool=40)
+    journal.append("planner", edges=[64, 128],
+                   sketch={"n": 9, "buckets": {}})
+    journal.append("planner", edges=[64, 256],
+                   sketch={"n": 17, "buckets": {}}, fleet=True)
+    journal.close()
+    (users_dir / "fleet_metrics_h0.jsonl").write_text(json.dumps(
+        {"schema": 2, "event": "fleet_edges", "t_s": 1.0,
+         "edges": [64, 256], "observations": 17}) + "\n")
+    timeline = export.planner_timeline(str(users_dir))
+    assert [e["edges"] for e in timeline["journal_epochs"]] \
+        == [[64, 128], [64, 256]]
+    assert timeline["journal_epochs"][0]["observations"] == 9
+    assert timeline["journal_epochs"][1]["fleet"] is True
+    assert timeline["per_host"]["h0"]["fleet_edges"][0]["edges"] \
+        == [64, 256]
+    text = export.text_report(str(users_dir))
+    assert "journal planner epochs" in text
+    assert "fleet edges adopted [h0]" in text
+
+
+# -- jit-compile telemetry -------------------------------------------------
+
+
+def test_jit_telemetry_counters_and_events():
+    from consensus_entropy_tpu.ops import scoring
+
+    events = []
+    jit_telemetry.subscribe(events.append)
+    try:
+        # a distinctive family key no other test builds
+        scoring.fleet_scoring_fns_for_width(k=3, tie_break="numpy",
+                                            width=48)
+        scoring.fleet_scoring_fns_for_width(k=3, tie_break="numpy",
+                                            width=48)
+    finally:
+        jit_telemetry.unsubscribe(events.append)
+    snap = jit_telemetry.snapshot()
+    fam = snap["per_family"]["fleet:k3:numpy@w48"]
+    assert fam["builds"] == 1 and fam["lookups"] >= 2
+    assert fam["hits"] == fam["lookups"] - 1
+    builds = [e for e in events if e.get("phase") == "build"]
+    assert len(builds) == 1
+    assert builds[0]["fn"] == "fleet:k3:numpy" \
+        and builds[0]["width"] == 48
+    assert builds[0]["build_s"] >= 0.0
+    assert jit_telemetry.family_labels().count("fleet:k3:numpy@w48") == 1
+
+
+def test_compile_events_deterministic_across_serve_restart(tmp_path):
+    """The family keys a serve run builds are a pure function of its
+    workload geometry: a restarted run (same users, same journal dir)
+    re-looks-up the SAME families and — the caches being process-wide —
+    builds nothing new.  Compile events land schema-valid in the
+    metrics stream."""
+    from consensus_entropy_tpu.fleet import (
+        FleetReport,
+        FleetScheduler,
+        FleetUser,
+    )
+    from consensus_entropy_tpu.serve import FleetServer, ServeConfig
+    from tests.fabric_workload import (
+        make_cfg,
+        make_committee,
+        make_data,
+    )
+
+    cfg = make_cfg(mode="mc", epochs=2, queries=5)
+
+    def serve_once(tag):
+        report = FleetReport(str(tmp_path / f"metrics_{tag}.jsonl"))
+        sched = FleetScheduler(cfg, report=report, scoring_by_width=True,
+                               user_timings=False)
+        server = FleetServer(
+            sched, ServeConfig(target_live=2),
+            journal=AdmissionJournal(str(tmp_path / "journal.jsonl")))
+        entries = []
+        for i in range(2):
+            data = make_data(cfg.seed, f"u{i}", n_songs=30, mode="mc")
+            ws = str(tmp_path / tag / f"u{i}")
+            os.makedirs(ws)
+            entries.append(FleetUser(
+                data.user_id, make_committee(data, mode="mc"), data, ws,
+                seed=cfg.seed))
+        recs = server.serve(iter(entries))
+        server.journal.close()
+        report.write_summary(cohort=2)
+        report.close()
+        assert all(r["error"] is None for r in recs)
+        evs = export.read_jsonl_tolerant(
+            str(tmp_path / f"metrics_{tag}.jsonl"))
+        assert export.validate_metrics(evs) == []
+        return [e for e in evs if e.get("event") == "compile"]
+
+    first = serve_once("a")
+    again = serve_once("b")
+    # run 1 built the (k=5) families for this workload's one bucket;
+    # the "restart" re-uses every one of them — no new builds, and any
+    # events it does emit (xla compiles of new shapes) name the same
+    # family set or less
+    built_first = {(e["fn"], e.get("width")) for e in first
+                   if e.get("phase") == "build"}
+    assert ("fleet:k5:fast", 32) in built_first
+    assert [e for e in again if e.get("phase") == "build"] == []
+    again_fns = {(e["fn"], e.get("width")) for e in again}
+    assert again_fns <= {(e["fn"], e.get("width")) for e in first}
+
+
+# -- cetpu-top -------------------------------------------------------------
+
+
+def test_cetpu_top_renders_fleet_view(tmp_path, capsys):
+    from consensus_entropy_tpu.cli.top import main, render
+
+    clock = _Clock(200.0)
+    StatusWriter(str(tmp_path / "status"), "coordinator",
+                 clock=clock).write({
+                     "hosts": {"h0": {"alive": True, "joined": True,
+                                      "draining": False,
+                                      "lease_age_s": 0.4, "load": 3},
+                               "h1": {"alive": True, "joined": True,
+                                      "draining": True,
+                                      "lease_age_s": 1.2, "load": 1}},
+                     "unresolved": 4, "queued": 2, "in_flight": 2,
+                     "spawns": 2, "joins": 2, "migrations": 1,
+                     "fences": 1, "drains": 1, "revocations": 0,
+                     "draining_host": "h1", "edges": [64, 128],
+                     "alerts": [{"kind": "lease_expiry", "key": "h1",
+                                 "host": "h1", "age_s": 4.2,
+                                 "lease_s": 5.0}]})
+    StatusWriter(str(tmp_path / "status"), "h0", clock=clock).write({
+        "queued": {"interactive": 1, "batch": 1}, "queue_total": 2,
+        "live": 2, "live_cls": {"batch": 2}, "target_live": 2,
+        "draining": False, "intake_open": True, "fences_pending": 0,
+        "requeued": 0, "users_done": 3, "users_failed": 0,
+        "planner": {"edges": [64, 128], "observations": 12,
+                    "admission_hold_rounds": 1,
+                    "dispatch_hold_rounds": 2},
+        "buckets": {"64": {"occupancy": 1.0, "mean_batch": 2.0,
+                           "dispatches": 7}},
+        "jit": {"families": 3, "lookups": 9, "builds": 3, "hits": 6,
+                "compiles": 4, "resident": 5}})
+    frame = render(read_status_dir(str(tmp_path / "status")),
+                   now=200.5)
+    assert "[coordinator] fleet" in frame
+    assert "h1     draining" in frame
+    assert "! lease_expiry" in frame
+    assert "[h0] live=2/2" in frame and "edges=[64, 128]" in frame
+    assert "STALE" not in frame
+    # a stale snapshot flags
+    assert "STALE" in render(read_status_dir(str(tmp_path / "status")),
+                             now=300.0)
+    # the console entry, --once (resolves users_dir -> status/)
+    assert main([str(tmp_path), "--once"]) == 0
+    assert "[coordinator] fleet" in capsys.readouterr().out
+    # empty dir: a calm message, not a crash
+    assert main([str(tmp_path / "nowhere"), "--once"]) == 0

@@ -54,8 +54,14 @@ def test_model_matches_runtime_registries():
     from consensus_entropy_tpu.resilience import faults
 
     assert MODEL.fault_points == faults.FAULT_POINTS
-    assert MODEL.event_fields == {k: tuple(v) for k, v
+    # the v2.1 table carries per-field KINDS — pinned dict-equal so the
+    # lint model's type checks can never drift from the runtime
+    # validator's (obs.export.validate_metrics)
+    assert MODEL.event_fields == {k: dict(v) for k, v
                                   in export.EVENT_FIELDS.items()}
+    assert all(kind in export.FIELD_KINDS
+               for fields in MODEL.event_fields.values()
+               for kind in fields.values())
     assert MODEL.fused_donate == {k: tuple(v) for k, v
                                   in scoring.FUSED_DONATE.items()}
 
@@ -446,6 +452,39 @@ def test_event_schema():
     """) == ["event-schema"]
 
 
+def test_event_schema_literal_types():
+    """Lint follow-on (d): a required field passed as a LITERAL must
+    hold its registered kind — a literal of the wrong type fires, a
+    non-literal (runtime-typed) argument stays the read-time
+    validator's job."""
+    assert rules_fired("""
+        def done(report):
+            report.event("user_done", user="u1")
+    """) == []
+    assert rules_fired("""
+        def done(report):
+            report.event("user_done", user=3)
+    """) == ["event-schema"]  # user must be str
+    assert rules_fired("""
+        def enq(report):
+            report.event("enqueue", user="u1", depth=True)
+    """) == ["event-schema"]  # bool is not an int count
+    assert rules_fired("""
+        def edges(report):
+            report.event("planner_edges", edges="32,64")
+    """) == ["event-schema"]  # list kind needs a list
+    assert rules_fired("""
+        def edges(report, e):
+            report.event("planner_edges", edges=[32, 64])
+            report.event("planner_edges", edges=list(e))
+    """) == []  # list literal ok; Call is runtime-typed
+    assert rules_fired("""
+        def emit(writer):
+            writer.emit({"event": "enqueue", "user": "u1",
+                         "depth": "3", "t_s": 0.1})
+    """) == ["event-schema"]  # dict-form literals are checked too
+
+
 # -- suppression + baseline semantics ----------------------------------------
 
 
@@ -518,7 +557,8 @@ def test_cli_end_to_end(tmp_path, capsys):
             ("resilience/faults.py", "FAULT_POINTS",
              'FAULT_POINTS = frozenset({"pool.score"})'),
             ("obs/export.py", "EVENT_FIELDS",
-             'EVENT_FIELDS = {"enqueue": ("user", "depth")}'),
+             'EVENT_FIELDS = {"enqueue": {"user": "str", '
+             '"depth": "int"}}'),
             ("ops/scoring.py", "FUSED_DONATE",
              'FUSED_DONATE = {"mc_fused": (1,)}')):
         f = pkg / rel
