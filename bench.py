@@ -2798,6 +2798,187 @@ def run_drain_suite(args_ns) -> int:
     return 0
 
 
+def run_remedy_suite(args_ns) -> int:
+    """Self-healing remediation vs alert-only, raced on users/sec.
+
+    Both arms run the SAME drill per rep: a 3-host fabric where ONLY h0
+    carries a ``pool.score:delay`` rule (one degraded host in an
+    otherwise healthy fleet — values untouched, so parity still binds)
+    and least-loaded placement splits the users evenly.  The fast hosts
+    drain their shares and the slow host's unresolved load becomes a
+    sustained placement-skew alert.  The arms differ only in
+    ``FabricConfig.remedy``:
+
+    - ``remedy``: the coordinator acts on the sustained alert —
+      drain-for-rebalance sheds the slow host's surplus (queued users
+      over the drop-ack path, in-flight users over the checkpoint
+      fence) onto the idle fast hosts, WITHOUT retiring the host;
+    - ``alert``: the alert fires but nothing acts (the PR 15-shaped
+      baseline) — every user placed on the slow host grinds to the
+      finish there.
+
+    Parity vs unfaulted sequential baselines is asserted on EVERY rep
+    of BOTH arms; the remedy arm must journal >= 1 ``remedy`` rebalance
+    and migrate >= 1 user, the alert arm exactly 0 of each.
+    ``remedy_handoff_s`` is the journal-derived delta from the
+    ``remedy`` decision to the last shed user's committed re-assign
+    (how long the fleet takes to complete the hand-off it decided)."""
+    import json as json_mod
+    import os
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.fabric_workload import (
+        make_cfg,
+        read_results,
+        sequential_baselines,
+        sizes_arg,
+        user_specs,
+    )
+
+    from consensus_entropy_tpu.serve import (
+        AdmissionJournal,
+        FabricConfig,
+        FabricCoordinator,
+        validate_journal_file,
+    )
+    from consensus_entropy_tpu.serve.hosts import fabric_paths
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "tests", "fabric_worker.py")
+    n_users, hosts = args_ns.users, max(args_ns.hosts, 3)
+    epochs = args_ns.al_epochs
+    cfg = make_cfg("mc", epochs=epochs)
+    specs = user_specs(n_users, sizes=[30, 100])
+    target_live = max(2, n_users // hosts)
+
+    _log(f"remedy workload: {n_users} users x {epochs} AL iterations, "
+         f"{hosts} hosts with ONLY h0 slowed by a pool.score delay "
+         f"rule; arms: alert-driven drain-for-rebalance vs alert-only")
+
+    def handoff_stamp(jp):
+        """``(t_remedy, t_last_assign)`` wall stamps from the journal:
+        the first ``remedy`` decision and the LAST committed
+        ``assign`` after it (the shed users landing on new hosts)."""
+        t0 = t1 = None
+        with open(jp, "rb") as f:
+            for raw in f:
+                try:
+                    rec = json_mod.loads(raw.decode("utf-8"))
+                except ValueError:
+                    continue
+                if rec.get("event") == "remedy" and t0 is None:
+                    t0 = rec.get("t")
+                elif rec.get("event") == "assign" and t0 is not None:
+                    t1 = rec.get("t")
+        return t0, t1
+
+    def run_arm(ws, arm):
+        arm_ws = _mkdir(ws, f"ws_{arm}")
+        fabric_dir = _mkdir(ws, f"fabric_{arm}")
+        jp = os.path.join(fabric_dir, "serve_journal.jsonl")
+        journal = AdmissionJournal(jp)
+
+        def spawn(host_id):
+            log = open(fabric_paths(fabric_dir, host_id)["log"], "ab")
+            env = {**os.environ, "PYTHONPATH": repo}
+            if host_id == "h0":
+                env["CETPU_FAULTS"] = "pool.score:delay=0.5@1x-1"
+            try:
+                return subprocess.Popen(
+                    [sys.executable, worker, fabric_dir, host_id,
+                     arm_ws, cfg.mode, str(cfg.epochs), str(n_users),
+                     "5.0", str(target_live), sizes_arg(specs)],
+                    stdout=log, stderr=subprocess.STDOUT, env=env)
+            finally:
+                log.close()
+
+        coord = FabricCoordinator(
+            journal, fabric_dir,
+            FabricConfig(hosts=hosts, min_hosts=hosts, max_hosts=hosts,
+                         placement="load", remedy=(arm == "remedy"),
+                         remedy_hold_s=0.2, remedy_cooldown_s=600.0,
+                         remedy_skew=1))
+        t0 = time.perf_counter()
+        summary = coord.run([u for _, u, _ in specs], spawn,
+                            pools={u: n for _, u, n in specs})
+        wall = time.perf_counter() - t0
+        journal.close()
+        assert validate_journal_file(jp) == [], \
+            f"journal schema violations in the {arm} arm"
+        tr, ta = handoff_stamp(jp)
+        handoff = round(ta - tr, 3) if tr and ta else None
+        return {"summary": summary, "wall_s": wall,
+                "remedy_handoff_s": handoff, "fabric_dir": fabric_dir}
+
+    root = tempfile.mkdtemp(prefix="remedy_bench_")
+    best = {"remedy": None, "alert": None}
+    try:
+        for rep in range(args_ns.reps):
+            ws = _mkdir(root, f"rep{rep}")
+            seq = sequential_baselines(ws, cfg, specs)
+            for arm in ("remedy", "alert"):
+                out = run_arm(ws, arm)
+                summary = out["summary"]
+                results = read_results(out["fabric_dir"])
+                parity = (sorted(summary["finished"])
+                          == sorted(u for _, u, _ in specs)
+                          and all(results[u]["error"] is None
+                                  and results[u]["result"]["trajectory"]
+                                  == seq[u]["trajectory"]
+                                  for _, u, _ in specs))
+                ups = len(summary["finished"]) / out["wall_s"]
+                _log(f"[rep {rep}] {arm:>6}: "
+                     f"{len(summary['finished'])}/{n_users} users in "
+                     f"{out['wall_s']:.1f}s ({ups:.3f} u/s, "
+                     f"remedies={summary['remedies']}, "
+                     f"migrations={summary['migrations']}, "
+                     f"handoff={out['remedy_handoff_s']}s, "
+                     f"parity={parity})")
+                ok_remedy = (
+                    summary["remedies"] >= 1
+                    and summary["migrations"] >= 1
+                    if arm == "remedy"
+                    else summary["remedies"] == 0
+                    and summary["migrations"] == 0)
+                if not (parity and ok_remedy and summary["drains"] == 0
+                        and summary["revocations"] == 0):
+                    raise AssertionError(
+                        f"remedy {arm} rep {rep} lost parity or the "
+                        f"wrong arm remediated: {summary}")
+                rec = {"users_per_sec": ups,
+                       "wall_s": round(out["wall_s"], 3),
+                       "remedy_handoff_s": out["remedy_handoff_s"],
+                       **{k: summary[k] for k in
+                          ("remedies", "migrations", "fences",
+                           "fence_timeouts")}}
+                prev = best[arm]
+                if prev is None or ups > prev["users_per_sec"]:
+                    best[arm] = rec
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    r, a = best["remedy"], best["alert"]
+    print(json.dumps({
+        "metric": f"remedy_users_per_sec_{n_users}u_{hosts}h_slow1",
+        "value": round(r["users_per_sec"], 4),
+        "unit": "users/s",
+        "vs_baseline": round(r["users_per_sec"] / a["users_per_sec"], 2),
+        "users_per_sec_remedy": round(r["users_per_sec"], 4),
+        "users_per_sec_alert": round(a["users_per_sec"], 4),
+        "wall_s_remedy": r["wall_s"], "wall_s_alert": a["wall_s"],
+        "remedy_handoff_s": r["remedy_handoff_s"],
+        "remedies": r["remedies"], "migrations": r["migrations"],
+        "fences": r["fences"],
+        "parity_with_sequential": True,
+        **_provenance(),
+    }))
+    return 0
+
+
 def _mkdir(root, name):
     import os
 
@@ -2811,7 +2992,8 @@ def main(argv=None) -> int:
     ap.add_argument("--suite", choices=("linear", "cnn", "retrain", "fleet",
                                         "serve", "serve-fused", "slo",
                                         "serve-faults", "fabric", "elastic",
-                                        "drain", "qbdc", "cnn-fleet", "obs"),
+                                        "drain", "remedy", "qbdc",
+                                        "cnn-fleet", "obs"),
                     default="linear",
                     help="linear: the north-star fused pool scoring; cnn: "
                          "Flax ShortChunkCNN committee inference "
@@ -2850,7 +3032,12 @@ def main(argv=None) -> int:
                          "waiting on a 3-host fabric shedding one slow "
                          "host, recovered-users/sec + journal-derived "
                          "drain latency, parity asserted every rep of "
-                         "both arms; qbdc: "
+                         "both arms; remedy: the self-healing plane — "
+                         "alert-driven drain-for-rebalance off ONE "
+                         "degraded host vs alert-only, users/sec + "
+                         "journal-derived remedy hand-off latency, "
+                         "parity asserted every rep of both arms; "
+                         "qbdc: "
                          "dropout-committee scoring (K-sweep) + users/sec "
                          "+ per-user memory vs the stored-committee mc "
                          "path; cnn-fleet: users/sec + mean_device_batch "
@@ -2949,6 +3136,10 @@ def main(argv=None) -> int:
     if args_ns.suite == "drain":
         # graceful scale-down: fenced migration vs drain-by-waiting
         return run_drain_suite(args_ns)
+    if args_ns.suite == "remedy":
+        # self-healing: alert-driven rebalance off one slow host vs
+        # alert-only
+        return run_remedy_suite(args_ns)
     if args_ns.suite == "qbdc":
         # dropout committee vs stored committee; --pool is songs per user,
         # --members the stored-committee size (default 20, the paper's)

@@ -19,6 +19,13 @@ Scoping tables (kept here, next to the rules that read them):
   PR 8 h2d/d2h accounting assumes every transfer goes through
   ``Acquirer.take_h2d`` and an implicit ``float()``/``.item()`` sync
   would both stall the pipeline and escape the accounting.
+- :data:`LOCK_ORDER` — the documented lock-acquisition order table for
+  the ``lock-discipline`` rule.  EMPTY by design: the stack's threading
+  convention is single-lock critical sections (``with self._lock:``),
+  never nested locks — a nested acquisition is a latent deadlock the
+  moment a second code path takes the pair in the other order.  Adding
+  a pair here is the sanctioned way to introduce an ordering (and the
+  review surface for it).
 """
 
 from __future__ import annotations
@@ -98,6 +105,13 @@ _ORDER_FREE = {"sorted", "sum", "min", "max", "any", "all", "len",
 
 #: order-CAPTURING conversions of an iterable
 _ORDER_CAPTURE = {"list", "tuple", "enumerate", "iter", "reversed"}
+
+#: documented lock-acquisition order: ``(outer_path, inner_path)`` pairs
+#: a nested ``with`` acquisition is allowed to take.  Empty — the stack
+#: has no sanctioned nested-lock pair today (see the module docstring);
+#: the coordinator/worker planes stay deadlock-free by construction
+#: because every critical section holds exactly one lock.
+LOCK_ORDER: tuple = ()
 
 
 def _in_pkg(path: str) -> bool:
@@ -574,16 +588,16 @@ def _is_set_annotation(node) -> bool:
                              "typing.Set", "typing.FrozenSet")
 
 
-def _set_typed_paths(tree) -> dict[int, set[str]]:
-    """Per-scope set-typed dotted paths, keyed by scope node id:
+def _typed_paths(tree, is_value, is_annotation) -> dict[int, set[str]]:
+    """Per-scope dotted paths whose assigned value satisfies ``is_value``
+    (or annotation ``is_annotation``), keyed by scope node id:
 
-    - module scope: top-level ``X = set()`` / ``X: set`` names (direct
-      statements only — a function-local ``edges = set()`` must not
-      taint the same name elsewhere in the module);
-    - each ClassDef: ``self.x`` attributes assigned/annotated a set
-      anywhere in the class body (methods included);
-    - each FunctionDef: ITS OWN locals assigned/annotated a set (no
-      descent into nested defs — they scope separately)."""
+    - module scope: top-level names (direct statements only — a
+      function-local of the same name must not taint the module);
+    - each ClassDef: ``self.x`` attributes assigned/annotated anywhere
+      in the class body (methods included);
+    - each FunctionDef: ITS OWN locals (no descent into nested defs —
+      they scope separately)."""
 
     out: dict[int, set[str]] = {}
 
@@ -606,13 +620,13 @@ def _set_typed_paths(tree) -> dict[int, set[str]]:
 
     def collect(body, paths, *, attrs_only=False):
         for stmt in direct_stmts(body):
-            if isinstance(stmt, ast.Assign) and _is_set_valued(stmt.value):
+            if isinstance(stmt, ast.Assign) and is_value(stmt.value):
                 for t in stmt.targets:
                     p = _dotted(t)
                     if p and (not attrs_only or p.startswith("self.")):
                         paths.add(p)
             elif isinstance(stmt, ast.AnnAssign) \
-                    and _is_set_annotation(stmt.annotation):
+                    and is_annotation(stmt.annotation):
                 p = _dotted(stmt.target)
                 if p and (not attrs_only or p.startswith("self.")):
                     paths.add(p)
@@ -635,6 +649,31 @@ def _set_typed_paths(tree) -> dict[int, set[str]]:
     return out
 
 
+def _set_typed_paths(tree) -> dict[int, set[str]]:
+    """Per-scope set-typed dotted paths (see :func:`_typed_paths`)."""
+    return _typed_paths(tree, _is_set_valued, _is_set_annotation)
+
+
+def _annotate_active(tree, by_scope) -> dict[int, set[str]]:
+    """node id -> typed paths visible there (module names, enclosing
+    class self-attrs, enclosing function locals)."""
+    active_at: dict[int, set[str]] = {}
+    root = by_scope.get(id(tree), set())
+
+    def annotate(node, active: set[str]):
+        for child in ast.iter_child_nodes(node):
+            cur = active
+            if isinstance(child, (ast.ClassDef, ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                cur = active | by_scope.get(id(child), set())
+            active_at[id(child)] = cur
+            annotate(child, cur)
+
+    active_at[id(tree)] = root
+    annotate(tree, root)
+    return active_at
+
+
 @register(
     "replay-set-iteration",
     doc="no order-dependent iteration over sets in replay-critical "
@@ -654,22 +693,7 @@ def check_replay_set_iteration(tree, ctx):
     findings = []
     by_scope = _set_typed_paths(tree)
     set_paths_global = by_scope.get(id(tree), set())
-
-    #: node id -> set-typed paths visible there (module names, enclosing
-    #: class self-attrs, enclosing function locals)
-    active_at: dict[int, set[str]] = {}
-
-    def annotate(node, active: set[str]):
-        for child in ast.iter_child_nodes(node):
-            cur = active
-            if isinstance(child, (ast.ClassDef, ast.FunctionDef,
-                                  ast.AsyncFunctionDef)):
-                cur = active | by_scope.get(id(child), set())
-            active_at[id(child)] = cur
-            annotate(child, cur)
-
-    active_at[id(tree)] = set_paths_global
-    annotate(tree, set_paths_global)
+    active_at = _annotate_active(tree, by_scope)
 
     def is_set_expr(node) -> bool:
         if isinstance(node, (ast.Set, ast.SetComp)):
@@ -951,4 +975,108 @@ def check_event_schema(tree, ctx):
             if isinstance(kind, ast.Constant) \
                     and isinstance(kind.value, str):
                 check_kind(node, kind.value, keys, has_splat)
+    return findings
+
+
+# -- rule 7: lock discipline -------------------------------------------------
+
+
+_LOCK_CTORS = ("threading.Lock", "threading.RLock", "Lock", "RLock")
+
+
+def _is_lock_valued(node) -> bool:
+    return isinstance(node, ast.Call) and _dotted(node.func) in _LOCK_CTORS
+
+
+def _is_lock_annotation(node) -> bool:
+    return _dotted(node) in _LOCK_CTORS
+
+
+def _lock_typed_paths(tree) -> dict[int, set[str]]:
+    """Per-scope lock-typed dotted paths (see :func:`_typed_paths`):
+    names/attributes assigned ``threading.Lock()`` / ``RLock()`` or
+    annotated as such.  ``Condition``/``Semaphore`` are deliberately NOT
+    tracked — their wait/notify protocols have their own shapes and the
+    queue's ``with self._cond:`` idiom is already the sanctioned form."""
+    return _typed_paths(tree, _is_lock_valued, _is_lock_annotation)
+
+
+@register(
+    "lock-discipline",
+    doc="locks are held via `with` only (no bare .acquire()), and a "
+        "second lock is never taken while one is held unless the pair "
+        "is in the documented LOCK_ORDER table",
+    applies=_in_pkg)
+def check_lock_discipline(tree, ctx):
+    """The fabric's threading model survives SIGKILL drills because its
+    critical sections are trivially correct: every lock is taken with
+    ``with`` (released on ANY exit — an exception inside a bare
+    ``acquire()``/``release()`` pair leaks the lock and wedges the
+    worker's intake or fence queue forever), and no code path holds two
+    locks at once (two paths nesting the same pair in opposite orders is
+    a deadlock that only fires under load, i.e. in the chaos soak, not
+    in unit runs).  Flags: (a) any ``.acquire()`` call on a lock-typed
+    path — ``with`` never spells it, so a bare acquire is always a
+    hand-rolled critical section; (b) a ``with`` acquiring a lock-typed
+    path while an enclosing ``with`` in the same function already holds
+    one, unless that exact ``(outer, inner)`` pair is documented in
+    :data:`LOCK_ORDER`.  Nested defs are separate control flow (they
+    run later, maybe on another thread) and are scanned as their own
+    scopes."""
+    findings = []
+    by_scope = _lock_typed_paths(tree)
+    if not any(by_scope.values()):
+        return findings
+    active_at = _annotate_active(tree, by_scope)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "acquire":
+            path = _dotted(node.func.value)
+            if path and path in active_at.get(id(node), set()):
+                findings.append(ctx.finding(
+                    "lock-discipline", node,
+                    f"bare {path}.acquire() — hold locks via `with "
+                    f"{path}:` so every exit path (including "
+                    "exceptions) releases"))
+
+    def with_lock_paths(stmt) -> list[str]:
+        out = []
+        for item in stmt.items:
+            p = _dotted(item.context_expr)
+            if p and p in active_at.get(id(stmt), set()):
+                out.append(p)
+        return out
+
+    def scan(stmts, held: list[str]):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                acquired = with_lock_paths(stmt)
+                # a multi-item `with a, b:` acquires left-to-right — the
+                # earlier items are held while the later ones acquire
+                for i, p in enumerate(acquired):
+                    for h in held + acquired[:i]:
+                        if (h, p) not in LOCK_ORDER:
+                            findings.append(ctx.finding(
+                                "lock-discipline", stmt,
+                                f"lock {p!r} acquired while {h!r} is "
+                                "held and the pair is not in the "
+                                "documented LOCK_ORDER table; nested "
+                                "locks deadlock the first time two "
+                                "paths disagree on the order"))
+                scan(stmt.body, held + acquired)
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if isinstance(sub, list):
+                    scan(sub, held)
+            for handler in getattr(stmt, "handlers", []) or []:
+                scan(handler.body, held)
+
+    for _scope, body in _iter_scopes(tree):
+        scan(body, [])
     return findings

@@ -250,6 +250,51 @@ def build_parser() -> argparse.ArgumentParser:
                         "drain_done retirement — exactly the "
                         "--scale-down-s path, operator-initiated); "
                         "requires --min-hosts/--max-hosts")
+    p.add_argument("--fence-deadline-s", type=float, default=0.0,
+                   metavar="S",
+                   help="elastic fabric: deadline-fenced DEGRADATION — a "
+                        "checkpoint-fence migration not acked within S "
+                        "seconds falls back to evict+resume (the user "
+                        "force-releases at its next step boundary instead "
+                        "of the iteration checkpoint, journaled as a "
+                        "remedy record); bounds how long one slow "
+                        "iteration can hold a migration open (default: "
+                        "0 = wait for the checkpoint forever; requires "
+                        "--min-hosts/--max-hosts)")
+    p.add_argument("--remedy", action="store_true",
+                   help="elastic fabric: alert-driven SELF-HEALING — a "
+                        "placement-skew alert held for --remedy-hold-s "
+                        "triggers a journaled drain-for-rebalance on the "
+                        "overloaded host (queued users move via drop-ack, "
+                        "in-flight users via checkpoint fences, the host "
+                        "keeps serving); replay re-derives the identical "
+                        "remediation sequence (requires --min-hosts/"
+                        "--max-hosts)")
+    p.add_argument("--remedy-hold-s", type=float, default=1.0, metavar="S",
+                   help="remedy: a skew alert must stay continuously "
+                        "raised this long before the pump acts — the "
+                        "hysteresis that keeps transient imbalance from "
+                        "thrashing users (default 1)")
+    p.add_argument("--remedy-cooldown-s", type=float, default=5.0,
+                   metavar="S",
+                   help="remedy: minimum spacing between remediation "
+                        "waves, fleet-wide (default 5)")
+    p.add_argument("--remedy-skew", type=int, default=None, metavar="N",
+                   help="remedy: per-host load above the fleet minimum "
+                        "that counts as placement skew — both the alert "
+                        "threshold and the shed target, so one wave "
+                        "sheds exactly down to the non-alerting level "
+                        "(default: the placement policies' skew bound)")
+    p.add_argument("--alert-sink", action="append", default=None,
+                   metavar="SPEC",
+                   help="route alert transitions to a sink (repeatable): "
+                        "'console' (stderr lines), 'jsonl:<path>' "
+                        "(append one record per transition), or "
+                        "'cmd:<argv>' (run a command per transition, "
+                        "the record as JSON on argv[-1] — webhook-"
+                        "shaped); sink failures count in the status "
+                        "snapshot but never affect serving (requires "
+                        "the introspection plane)")
     p.add_argument("--no-introspection", action="store_true",
                    help="fleet/serve/fabric: disable the live "
                         "introspection plane — control-plane trace "
@@ -441,6 +486,14 @@ def main(argv=None) -> int:
                 scale_down_s=args.scale_down_s,
                 drain_host=args.drain_host,
                 placement=args.placement,
+                fence_deadline_s=args.fence_deadline_s,
+                remedy=args.remedy,
+                remedy_hold_s=args.remedy_hold_s,
+                remedy_cooldown_s=args.remedy_cooldown_s,
+                # None = take FabricConfig's default (the placement
+                # policies' skew bound)
+                **({} if args.remedy_skew is None
+                   else {"remedy_skew": args.remedy_skew}),
                 # the fleet planner must not fight explicit operator
                 # edges or a disabled local planner
                 fleet_planner=(not args.no_slo_planner
@@ -449,11 +502,27 @@ def main(argv=None) -> int:
             print(f"invalid fabric config: {e}")
             return 1
     elif args.min_hosts is not None or args.max_hosts is not None \
-            or args.scale_down_s or args.drain_host is not None:
-        print("--min-hosts/--max-hosts/--scale-down-s/--drain-host "
-              "require --hosts (the elastic fabric scales a multi-host "
-              "fleet)")
+            or args.scale_down_s or args.drain_host is not None \
+            or args.fence_deadline_s or args.remedy:
+        print("--min-hosts/--max-hosts/--scale-down-s/--drain-host/"
+              "--fence-deadline-s/--remedy require --hosts (the "
+              "elastic fabric scales a multi-host fleet)")
         return 1
+    if args.alert_sink:
+        if args.no_introspection:
+            print("--alert-sink needs the introspection plane; drop "
+                  "--no-introspection")
+            return 1
+        # a typo'd sink spec fails HERE with the reason, not as a
+        # silently-dropped alert minutes into a run
+        from consensus_entropy_tpu.obs.alerts import make_sink
+
+        try:
+            for spec in args.alert_sink:
+                make_sink(spec)
+        except ValueError as e:
+            print(f"invalid --alert-sink: {e}")
+            return 1
     if args.fabric_worker is not None and (args.fabric_dir is None
                                            or args.serve is None):
         print("--fabric-worker is internal (spawned by --hosts) and "
@@ -677,11 +746,13 @@ def _introspection(args, paths, host, report, log=None):
     PR 14 arm."""
     if args.no_introspection:
         return None, None
-    from consensus_entropy_tpu.obs.alerts import AlertWatcher
+    from consensus_entropy_tpu.obs.alerts import AlertWatcher, make_sink
     from consensus_entropy_tpu.obs.status import StatusWriter
 
     status = StatusWriter(os.path.join(paths.users_dir, "status"), host)
-    return status, AlertWatcher(report, log=log)
+    sinks = tuple(make_sink(spec, log=log)
+                  for spec in (getattr(args, "alert_sink", None) or ()))
+    return status, AlertWatcher(report, log=log, sinks=sinks)
 
 
 def _build_tracer(args, cfg, path, host=None):
@@ -1012,13 +1083,21 @@ def _run_users_fabric(args, cfg, paths, users, pool, anno, guard) -> None:
     worker_argv = []
     skip_next = False
     coordinator_flags = ("--hosts", "--min-hosts", "--max-hosts",
-                         "--placement", "--scale-down-s", "--drain-host")
+                         "--placement", "--scale-down-s", "--drain-host",
+                         "--fence-deadline-s", "--remedy-hold-s",
+                         "--remedy-cooldown-s", "--remedy-skew",
+                         "--alert-sink")
+    # value-less coordinator switches: strip the flag alone (skipping
+    # the next token would eat an unrelated argument)
+    coordinator_switches = ("--remedy",)
     for arg in args._raw_argv:
         if skip_next:
             skip_next = False
             continue
         if arg in coordinator_flags:
             skip_next = True
+            continue
+        if arg in coordinator_switches:
             continue
         if any(arg.startswith(f + "=") for f in coordinator_flags):
             continue

@@ -115,6 +115,11 @@ class _SessionState:
     #: the engine at its next completed checkpoint boundary — no result,
     #: no failure, the driver re-places the user elsewhere
     release: bool = False
+    #: force-marked (the fence-deadline evict+resume fallback): the
+    #: session releases at its NEXT ready pop — any step boundary, not
+    #: the checkpoint boundary — discarding current-iteration progress;
+    #: the workspace stays at its last committed generation
+    force_release: bool = False
     #: label of the most recently COMPLETED pooled host step (cleared on
     #: every other resume path) — ``"checkpoint"`` here is the release
     #: point: the iteration boundary just committed
@@ -327,11 +332,17 @@ class FleetScheduler:
         self._reap_hung_hosts()
         while self._ready:
             state, value, exc = self._ready.popleft()
-            if (state.release and exc is None
-                    and state.last_label == "checkpoint"):
+            if exc is None and (state.force_release
+                                or (state.release
+                                    and state.last_label == "checkpoint")):
                 # the fence point: the iteration-boundary checkpoint
                 # this session just completed is the migration's resume
-                # unit — release instead of starting the next iteration
+                # unit — release instead of starting the next iteration.
+                # A FORCE-marked session (fence-deadline fallback)
+                # releases at any step boundary instead: the generator
+                # close discards current-iteration progress and the
+                # workspace stays at its last committed generation —
+                # the eviction semantics resume elsewhere already pins.
                 self._release(state)
                 continue
             state.last_label = None
@@ -612,6 +623,24 @@ class FleetScheduler:
         for st in list(self._live) + [s for s, _, _ in self._ready]:
             if str(st.entry.user_id) == uid:
                 st.release = True
+                return True
+        return False
+
+    def force_release(self, user_id) -> bool:
+        """The fence's evict+resume fallback (the remediation plane's
+        ``--fence-deadline-s`` path): release the session at its NEXT
+        ready pop — ANY step boundary, not the iteration-boundary
+        checkpoint :meth:`request_release` waits for — so one long
+        iteration can never hold a migration open.  Current-iteration
+        in-memory progress is discarded (the generator's close path);
+        the workspace stays at its last two-phase-committed generation,
+        which is exactly what resume on another host replays — the
+        single-host eviction semantics, minus the fault.  Returns False
+        when no live session matches (finished or evicted first)."""
+        uid = str(user_id)
+        for st in list(self._live) + [s for s, _, _ in self._ready]:
+            if str(st.entry.user_id) == uid:
+                st.force_release = True
                 return True
         return False
 

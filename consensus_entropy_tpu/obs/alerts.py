@@ -29,12 +29,24 @@ Alert kinds (the README "Observability" table renders these):
   (open or spent breaker): stacked throughput is gone on that width.
 - ``lease_expiry`` — a worker's lease age has burned past ``burn_frac``
   of the lease: the host is about to be declared dead and failed over.
+- ``placement_skew`` — a live host's unresolved load sits more than
+  ``max_skew`` above the fleet's floor: the placement invariant is being
+  violated by attrition or degradation, and the remediation plane's
+  drain-for-rebalance (``serve.remedy``) is the journaled response.
+
+Alerts can also ROUTE: :class:`AlertWatcher` takes a tuple of SINKS
+(:class:`ConsoleSink` — operator log line, :class:`JsonlSink` —
+append-only ``alerts.jsonl`` for ``tail -f``, :class:`CommandSink` —
+webhook-shaped command invocation per alert; build from a CLI spec with
+:func:`make_sink`), each fed every RISEN alert.  Sinks are telemetry
+delivery, never control flow: a raising sink is counted
+(``sink_errors``) and skipped, and no journaled decision reads one.
 """
 
 from __future__ import annotations
 
 ALERT_KINDS = ("slo_headroom", "batch_aging", "breaker_open",
-               "lease_expiry")
+               "lease_expiry", "placement_skew")
 
 #: default fraction of a bound an observation may burn before alerting
 BURN_FRAC = 0.8
@@ -109,17 +121,135 @@ def lease_alerts(lease_ages: dict, lease_s: float, *,
     return out
 
 
+def skew_alerts(loads: dict, *, max_skew: int) -> list[dict]:
+    """``loads``: unresolved-user count per live, non-draining host
+    (journal-replayed — the same view ``serve.placement`` places by).
+    Fires per host whose load sits MORE than ``max_skew`` above the
+    fleet's floor (the least-loaded host) — the exact complement of the
+    placement rule, which only admits onto hosts within the skew bound,
+    so a firing alert means attrition or degradation broke an invariant
+    placement alone cannot restore.  A one-host fleet has no skew."""
+    if len(loads) < 2:
+        return []
+    floor = min(loads.values())
+    out = []
+    for host in sorted(loads):
+        load = loads[host]
+        if load - floor > max_skew:
+            out.append({"kind": "placement_skew", "key": str(host),
+                        "host": str(host), "load": int(load),
+                        "floor": int(floor), "max_skew": int(max_skew)})
+    return out
+
+
+class ConsoleSink:
+    """Operator console delivery: one human log line per risen alert.
+    ``write`` defaults to ``print`` (the CLI passes its own logger)."""
+
+    def __init__(self, write=None):
+        self._write = write if write is not None else print
+
+    def emit(self, alert: dict) -> None:
+        detail = " ".join(f"{k}={v}" for k, v in sorted(alert.items())
+                          if k not in ("kind", "key"))
+        self._write(f"ALERT [{alert.get('kind')}] {detail}")
+
+
+class JsonlSink:
+    """Append-only JSONL alert log (the ``tail -f`` surface): one JSON
+    line per risen alert, flushed per emit so a follower sees it
+    promptly.  Telemetry, not a ledger — no fsync, no lock."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+
+    def emit(self, alert: dict) -> None:
+        import json
+        import os
+
+        if self._f is None:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            self._f = open(self.path, "ab")
+        self._f.write((json.dumps(alert) + "\n").encode("utf-8"))
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class CommandSink:
+    """Webhook-shaped delivery without a network dependency: run
+    ``argv + [json-encoded alert]`` per risen alert (a curl wrapper, a
+    pager script, a chat-post hook).  Bounded by ``timeout_s`` and
+    fire-and-forget — a failing or hanging command is the WATCHER's
+    problem to count, never the serve loop's to wait on."""
+
+    def __init__(self, argv: list, *, timeout_s: float = 5.0):
+        if not argv:
+            raise ValueError("CommandSink needs a non-empty argv")
+        self.argv = [str(a) for a in argv]
+        self.timeout_s = timeout_s
+
+    def emit(self, alert: dict) -> None:
+        import json
+        import subprocess
+
+        subprocess.run(self.argv + [json.dumps(alert)],
+                       check=True, timeout=self.timeout_s,
+                       stdout=subprocess.DEVNULL,
+                       stderr=subprocess.DEVNULL)
+
+
+def make_sink(spec: str, *, log=None):
+    """Build one sink from its CLI spec (``--alert-sink``, repeatable):
+    ``console`` | ``jsonl:<path>`` | ``cmd:<shell-words>``.  Unknown
+    kinds and missing arguments fail HERE at construction (the
+    validate-at-the-edge precedent), not as a silently-dropped alert."""
+    kind, _, arg = str(spec).partition(":")
+    if kind == "console":
+        return ConsoleSink(log)
+    if kind == "jsonl":
+        if not arg:
+            raise ValueError("jsonl sink needs a path: jsonl:<path>")
+        return JsonlSink(arg)
+    if kind == "cmd":
+        if not arg:
+            raise ValueError("cmd sink needs a command: cmd:<command>")
+        import shlex
+
+        return CommandSink(shlex.split(arg))
+    raise ValueError(f"unknown alert sink {spec!r} "
+                     "(choose console | jsonl:<path> | cmd:<command>)")
+
+
 class AlertWatcher:
     """Edge-triggered alert surface: :meth:`update` takes the round's
     full evaluated alert list, emits a schema ``alert`` event (plus an
     operator log line via ``log``) for each NEWLY-risen ``(kind, key)``,
     and keeps the active set for snapshots.  An alert that stops holding
-    simply leaves the active set — re-rising re-emits."""
+    simply leaves the active set — re-rising re-emits.
 
-    def __init__(self, report=None, *, log=None):
+    ``sinks``: delivery fan-out (see :func:`make_sink`) — each risen
+    alert goes to every sink; a raising sink increments ``sink_errors``
+    and is skipped for that alert (delivery is telemetry, never control
+    flow).
+
+    Edge-triggering is SNAPSHOT-based, so a condition that clears and
+    re-rises BETWEEN two :meth:`update` calls looks continuously active
+    and the second rise would be silently coalesced into the first.
+    Whoever CLEARS a condition mid-interval (the remediation plane,
+    after acting on an alert) must call :meth:`rearm` so the next
+    evaluation re-fires if the condition still — or again — holds."""
+
+    def __init__(self, report=None, *, log=None, sinks=()):
         self.report = report
         self.log = log
+        self.sinks = tuple(sinks)
         self.fired = 0
+        self.sink_errors = 0
         #: (kind, key) -> the alert dict, as currently active
         self._active: dict[tuple, dict] = {}
 
@@ -146,7 +276,31 @@ class AlertWatcher:
                                   sorted(alert.items())
                                   if k not in ("kind", "key"))
                 self.log(f"ALERT [{alert.get('kind')}] {detail}")
+            for sink in self.sinks:
+                try:
+                    sink.emit(alert)
+                except Exception:
+                    # a broken pager script must never wedge the serve
+                    # loop — count it and keep the round going
+                    self.sink_errors += 1
         return rose
+
+    def rearm(self, kind: str, key=None) -> None:
+        """Drop ``(kind, key)`` — or every key of ``kind`` when ``key``
+        is ``None`` — from the active set, so the NEXT evaluation round
+        re-emits the alert if its condition still (or again) holds.
+
+        The edge-trigger REARM (this PR's watcher bugfix): a remediation
+        that clears a condition mid-poll-interval would otherwise leave
+        the stale entry active, and a re-risen condition inside the same
+        interval would be coalesced into the original edge — the second
+        ``alert`` event never fired.  Acting on an alert consumes it."""
+        if key is None:
+            for k in list(self._active):
+                if k[0] == kind:
+                    del self._active[k]
+        else:
+            self._active.pop((kind, key), None)
 
     @property
     def active(self) -> list[dict]:

@@ -94,6 +94,11 @@ EVENT_FIELDS = {
     "migrate_fence": {"user": "str", "host": "str"},
     "migrate_inflight": {"user": "str", "host": "str"},
     "fence_release": {"user": "str"},
+    # the remediation plane (serve.remedy): a journaled self-healing
+    # decision (drain-for-rebalance / deadline fallback) and the fence
+    # that burned past --fence-deadline-s into evict+resume
+    "remedy": {"host": "str", "action": "str"},
+    "fence_timeout": {"user": "str", "host": "str"},
     # stream-closing summaries (no t_s)
     "fleet_summary": {},
     "fabric_summary": {},

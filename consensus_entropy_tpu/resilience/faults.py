@@ -83,6 +83,13 @@ FAULT_POINTS = frozenset({
                              # to exactly ONE owner: the fenced user's
                              # last assignment decides, and the restart
                              # re-routes it before any worker runs it)
+    "fabric.remedy",         # remediation decision, pre-remedy-journal
+                             # (drain-for-rebalance / fence-deadline
+                             # fallback — a kill here leaves no record:
+                             # the restart re-detects the condition and
+                             # re-derives the identical action sequence;
+                             # every move stays ack-gated, so no user is
+                             # ever double-moved)
     # acquisition-subsystem boundaries (the acquire registry's fault
     # domain): the qbdc dropout-mask sampler — mask keys fold from the AL
     # iteration seed, so a kill here must resume bit-identically (same

@@ -50,6 +50,12 @@ And a MULTI-HOST fabric (PR 5) scales the user axis across processes:
   (file-based coordination — no CPU multiprocess collectives on this
   image; ``parallel.multihost`` stays for real multi-controller
   runtimes).
+- :mod:`serve.remedy` — the SELF-HEALING policy kernels (PR 16): pure
+  decision functions — flap-free shed counts, hold/cooldown hysteresis,
+  fence deadlines, victim picks — that the coordinator's remediation
+  pump drives to turn placement-skew alerts into journaled
+  drain-for-rebalance actions and overdue checkpoint fences into
+  deadline-bounded evict+resume fallbacks.
 
 Parity is inherited, not re-proven: the server drives the SAME engine
 (``FleetScheduler.open/admit/pump``) over the SAME session generators,
@@ -101,6 +107,13 @@ from consensus_entropy_tpu.serve.placement import (
     plan_failover,
     plan_rebalance,
 )
+from consensus_entropy_tpu.serve.remedy import (
+    cooldown_ok,
+    fence_expired,
+    pick_shed,
+    remedy_due,
+    shed_count,
+)
 from consensus_entropy_tpu.serve.server import (
     AdmissionQueue,
     FleetServer,
@@ -117,8 +130,10 @@ __all__ = ["AdmissionJournal", "AdmissionPlanner", "AdmissionQueue",
            "JsonlTail", "PLACEMENT_POLICIES", "PRIORITY_CLASSES",
            "PoisonList", "QueueClosed", "QueueFull", "ServeConfig",
            "SingleWriterViolation", "Watchdog", "WatchdogTimeout",
-           "admission_hold", "bucket_for", "derive_edges",
-           "dispatch_hold", "drain_victim", "next_host_id", "place",
-           "place_user", "plan_failover", "plan_rebalance", "run_worker",
-           "scale_down_ok", "target_hosts", "validate_bucket_widths",
+           "admission_hold", "bucket_for", "cooldown_ok",
+           "derive_edges", "dispatch_hold", "drain_victim",
+           "fence_expired", "next_host_id", "pick_shed", "place",
+           "place_user", "plan_failover", "plan_rebalance",
+           "remedy_due", "run_worker", "scale_down_ok", "shed_count",
+           "target_hosts", "validate_bucket_widths",
            "validate_journal_file"]

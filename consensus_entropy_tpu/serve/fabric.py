@@ -70,6 +70,28 @@ top of that base:
   host retires clean (``drain_done``).  Failover and startup re-routes
   place their whole victim set as ONE bucket-grouped plan
   (``placement.plan_failover``) so same-bucket victims co-locate.
+
+The SELF-HEALING plane (``FabricConfig.remedy`` /
+``fence_deadline_s``; :mod:`serve.remedy`) closes the loop from the
+PR 15 alerts back into these journaled verbs:
+
+- DRAIN-FOR-REBALANCE: a placement-skew alert that holds past the
+  hysteresis window triggers one journaled ``remedy`` decision (its own
+  ``fabric.remedy`` fault point fires first): the overloaded host sheds
+  exactly enough users to return inside the skew bound — queued users
+  over the drop-ack path, in-flight users via checkpoint fences —
+  WITHOUT retiring (no drain record; the host keeps admitting).  The
+  shed count (``remedy.shed_count``) lands the host at the highest
+  non-alerting load, so remediation can never flap;
+- DEADLINE-FENCED degradation: a fence not acked within
+  ``fence_deadline_s`` demotes to evict+resume — the timeout journals
+  (``remedy``, action ``fence_timeout``), the session releases at its
+  next STEP boundary and resumes elsewhere from its last committed
+  generation, and a checkpoint ack racing the evict still commits (the
+  fallback set) — no fence stays open past the deadline plus one poll;
+- every action is ack-gated and derives from journaled state, so a
+  coordinator SIGKILL at ``fabric.remedy`` (or anywhere else) replays
+  to the identical action sequence and no user is ever double-moved.
 """
 
 from __future__ import annotations
@@ -102,7 +124,11 @@ from consensus_entropy_tpu.serve.journal import (
     PoisonList,
     _AppendFsyncFile,
 )
-from consensus_entropy_tpu.serve.placement import PLACEMENT_POLICIES
+from consensus_entropy_tpu.serve import remedy as remedy_mod
+from consensus_entropy_tpu.serve.placement import (
+    DEFAULT_MAX_SKEW,
+    PLACEMENT_POLICIES,
+)
 
 
 class FabricError(RuntimeError):
@@ -187,6 +213,35 @@ class FabricConfig:
     fleet_planner: bool = True
     planner_epoch: int = 8
     planner_buckets: int = 4
+    #: DEADLINE-FENCED degradation (0 = wait forever, the PR 14
+    #: semantics): a checkpoint fence not acked within this many seconds
+    #: falls back to evict+resume — the coordinator journals the timeout
+    #: (``remedy`` record, action ``fence_timeout``), demotes the fence,
+    #: and sends an evict drop; the session releases at its next STEP
+    #: boundary (any step, not the iteration checkpoint) and resumes
+    #: elsewhere from its last committed generation.  One long iteration
+    #: can then never hold a migration open past the deadline plus one
+    #: poll interval.  Requires the elastic plane (fences are its
+    #: machinery).
+    fence_deadline_s: float = 0.0
+    #: the REMEDIATION plane (``serve.remedy``): act on sustained
+    #: placement-skew alerts with a journaled drain-for-rebalance — the
+    #: overloaded host sheds just enough users (queued via drop-acks,
+    #: in-flight via checkpoint fences) to return inside the skew bound,
+    #: WITHOUT retiring.  Every action is ack-gated and derives from
+    #: journaled state, so a coordinator SIGKILL mid-remediation replays
+    #: to the identical action sequence.  Requires the elastic plane.
+    remedy: bool = False
+    #: hysteresis: the skew condition must hold CONTINUOUSLY this long
+    #: before a remediation fires (transient imbalance self-resolves)
+    remedy_hold_s: float = remedy_mod.DEFAULT_HOLD_S
+    #: minimum seconds between remediations (fleet-wide): the previous
+    #: wave's moves must land before the loads justify another
+    remedy_cooldown_s: float = remedy_mod.DEFAULT_COOLDOWN_S
+    #: the skew bound the remediation restores (and the placement-skew
+    #: alert fires past) — matches placement's admission-side bound, so
+    #: a shed never undoes what placement would redo
+    remedy_skew: int = DEFAULT_MAX_SKEW
 
     @property
     def elastic(self) -> bool:
@@ -238,6 +293,26 @@ class FabricConfig:
                 "drain_host requires the elastic control plane "
                 "(set min_hosts/max_hosts — the drain shed paths are "
                 "its machinery)")
+        if self.fence_deadline_s < 0:
+            raise ValueError(f"fence_deadline_s must be >= 0, "
+                             f"got {self.fence_deadline_s}")
+        if self.fence_deadline_s and not self.elastic:
+            raise ValueError(
+                "fence_deadline_s requires the elastic control plane "
+                "(set min_hosts/max_hosts — checkpoint fences are its "
+                "machinery)")
+        if self.remedy and not self.elastic:
+            raise ValueError(
+                "remedy requires the elastic control plane (set "
+                "min_hosts/max_hosts — the drop-ack and fence shed "
+                "paths are its machinery)")
+        if self.remedy_hold_s < 0 or self.remedy_cooldown_s < 0:
+            raise ValueError(
+                f"remedy_hold_s and remedy_cooldown_s must be >= 0, got "
+                f"{self.remedy_hold_s} / {self.remedy_cooldown_s}")
+        if self.remedy_skew < 1:
+            raise ValueError(f"remedy_skew must be >= 1, "
+                             f"got {self.remedy_skew}")
         if self.placement not in PLACEMENT_POLICIES:
             raise ValueError(f"placement must be one of "
                              f"{PLACEMENT_POLICIES}, got {self.placement!r}")
@@ -350,6 +425,24 @@ class FabricCoordinator:
         #: the resume unit); stale acks after a restart are cursor-only,
         #: exactly like stale drop acks — no user ever runs on two hosts
         self._fencing: dict[str, str] = {}
+        #: when each pending fence was REQUESTED (injected clock;
+        #: liveness-only): the ``fence_deadline_s`` bound reads these —
+        #: a fence older than the deadline demotes to evict+resume
+        self._fence_t: dict[str, float] = {}
+        #: deadline-DEMOTED fences: uid → source host.  The evict drop
+        #: was sent, but a checkpoint-boundary fence ack racing it must
+        #: still commit the move (the boundary release is strictly
+        #: better than the evict we fell back to); a true stale ack
+        #: (coordinator restart) has no entry here and stays cursor-only
+        self._fence_fallback: dict[str, str] = {}
+        #: placement-skew hysteresis: host → when its skew alert was
+        #: first seen holding (injected clock; liveness-only — the
+        #: remediation DECISION journals, replay never reads a clock)
+        self._remedy_hot: dict[str, float] = {}
+        #: when the last remediation fired (the cooldown clock)
+        self._remedy_last: float | None = None
+        self.remedies = 0
+        self.fences_timed_out = 0
         #: the host currently draining (one scale-down at a time), and
         #: when the low-water mark started holding (injected clock;
         #: liveness-only — the drain DECISION journals, replay never
@@ -472,6 +565,8 @@ class FabricCoordinator:
                     self._operator_drain()
                     self._scale_down()
                     self._pump_drain()
+                    self._check_fence_deadlines()
+                    self._pump_remedy()
                     self._broadcast_edges()
                 if not any(h.alive for h in self.hosts.values()):
                     # the elastic autoscaler above respawns dead capacity
@@ -852,6 +947,7 @@ class FabricCoordinator:
                 # is skipped — it re-enqueues itself when its delay
                 # elapses and then takes the drop path above
                 self._fencing[u] = hid
+                self._fence_t[u] = self._clock()
                 h.assign.append({"fence": u})
                 self.report.event("migrate_request", user=u, host=hid)
 
@@ -887,6 +983,185 @@ class FabricCoordinator:
         self._ctl("ctl.drain_done", key=rec["seq"], host=h.host_id)
         if h.host_id == self._draining_host:
             self._draining_host = None
+
+    def _check_fence_deadlines(self) -> None:
+        """DEADLINE-FENCED degradation (``fence_deadline_s``): a pending
+        checkpoint fence the source host has not acked within the
+        deadline demotes to evict+resume — journal the timeout
+        (``remedy`` record, action ``fence_timeout``; the
+        ``fabric.remedy`` fault point fires first, so a kill leaves no
+        record and the restart re-routes from the journal alone), move
+        the fence to the fallback set, pick the resume target NOW (the
+        evict drop ack commits it), and send the evict.  The session
+        releases at its next STEP boundary — any step, not the iteration
+        checkpoint — so no fence stays open longer than the deadline
+        plus one poll interval.  A checkpoint ack racing the evict still
+        commits via the fallback set (:meth:`_transcribe`)."""
+        cfg = self.config
+        if not cfg.fence_deadline_s or not self._fencing:
+            return
+        now = self._clock()
+        for u in list(self._fencing):
+            if u not in self._unresolved:
+                continue  # its resolution ack is in flight; let it land
+            if not remedy_mod.fence_expired(
+                    self._fence_t.get(u), now,
+                    deadline_s=cfg.fence_deadline_s):
+                continue
+            src = self._fencing[u]
+            sh = self.hosts.get(src)
+            if sh is None or not sh.alive:
+                continue  # failover supersedes (it pops the fence)
+            targets = [t for t in self._route_targets() if t != src]
+            if not targets:
+                continue  # nowhere to resume yet; keep waiting
+            # a kill here models dying between the timeout decision and
+            # its journal record: the fence stays pending in no one's
+            # memory — the restart re-places the user from the journal
+            faults.fire("fabric.remedy", user=u, host=src,
+                        action="fence_timeout")
+            rec = self.journal.append("remedy", u, host=src,
+                                      action="fence_timeout")
+            self.fences_timed_out += 1
+            self.report.event("fence_timeout", user=u, host=src)
+            self._ctl("ctl.remedy", key=rec["seq"], host=src,
+                      action="fence_timeout", user=u, flow_user=u)
+            del self._fencing[u]
+            self._fence_t.pop(u, None)
+            self._fence_fallback[u] = src
+            target = placement_mod.place_user(
+                u, state=self.journal.state,
+                unresolved=self._unresolved, hosts=targets,
+                edges=self._fleet_edges(), policy=cfg.placement)
+            self._migrating[u] = target
+            sh.assign.append({"drop": u, "evict": True})
+            self.report.event("migrate_request", user=u, host=target)
+
+    def _evaluate_alerts(self) -> list:
+        """The coordinator's COMPOSED alert list — every kind this
+        process watches (lease burn + placement skew) in one list,
+        because ``AlertWatcher.update`` is snapshot-based: two call
+        sites feeding partial lists would delete each other's active
+        keys."""
+        from consensus_entropy_tpu.obs import alerts as alerts_mod
+
+        now = self._clock()
+        lease_ages = {hid: lease_age_s(h.lease_path, now)
+                      for hid, h in self.hosts.items()
+                      if h.alive and h.joined}
+        out = alerts_mod.lease_alerts(lease_ages, self.config.lease_s)
+        out += alerts_mod.skew_alerts(
+            self._live_loads(), max_skew=self.config.remedy_skew)
+        return out
+
+    def _live_loads(self) -> dict:
+        """Unresolved-user load per live, joined, non-draining host —
+        the skew kernel's input (journal-replayed, same view placement
+        places by)."""
+        return {h.host_id: self._load_of(h.host_id)
+                for h in self.hosts.values()
+                if h.alive and h.joined and not h.draining}
+
+    def _pump_remedy(self) -> None:
+        """One remediation round (``remedy``): when a live host's
+        placement-skew alert has held CONTINUOUSLY for ``remedy_hold_s``
+        (and the fleet-wide cooldown elapsed), journal one ``remedy``
+        decision (the ``fabric.remedy`` fault point fires first) and
+        DRAIN-FOR-REBALANCE the host: shed exactly ``shed_count`` users
+        — ``load - floor - max_skew``, which lands the host AT the
+        highest non-alerting load, so the remediation can never flap —
+        queued users over the drop-ack path, in-flight users (newest
+        admissions first — most sunk work sheds last) via checkpoint
+        fences.  The host is NOT retired: no drain record, no sentinel,
+        it keeps admitting.  Gated off while any migration, fence or
+        drain is in flight — one ack-gated wave at a time keeps replay
+        auditable.  After acting, the watcher's skew alert REARMS so a
+        re-risen condition fires a second ``alert`` event (the
+        edge-trigger bugfix this PR pins)."""
+        from consensus_entropy_tpu.obs import alerts as alerts_mod
+
+        cfg = self.config
+        if not cfg.remedy:
+            return
+        if self.alerts is not None:
+            # the remediation plane evaluates every poll; feed the
+            # watcher the same COMPOSED list _status_payload does so
+            # the two sites never delete each other's active keys
+            self.alerts.update(self._evaluate_alerts())
+        if self._migrating or self._fencing or self._draining_host:
+            return
+        loads = self._live_loads()
+        now = self._clock()
+        hot = {a["host"] for a in alerts_mod.skew_alerts(
+            loads, max_skew=cfg.remedy_skew)}
+        for hid in list(self._remedy_hot):
+            if hid not in hot:
+                del self._remedy_hot[hid]  # condition cleared: re-time
+        for hid in sorted(hot):
+            self._remedy_hot.setdefault(hid, now)
+        if not remedy_mod.cooldown_ok(self._remedy_last, now,
+                                      cooldown_s=cfg.remedy_cooldown_s):
+            return
+        due = [hid for hid, t0 in self._remedy_hot.items()
+               if remedy_mod.remedy_due(t0, now,
+                                        hold_s=cfg.remedy_hold_s)]
+        if not due:
+            return
+        # worst offender first; host-id tie-break keeps the pick stable
+        victim = max(due, key=lambda hid: (loads.get(hid, 0), hid))
+        h = self.hosts.get(victim)
+        if h is None or not h.alive or h.draining:
+            self._remedy_hot.pop(victim, None)
+            return
+        targets = [t for t in self._route_targets() if t != victim]
+        if not targets:
+            return  # nowhere to shed; the autoscaler may add capacity
+        st = self.journal.state
+        count = remedy_mod.shed_count(
+            loads[victim], min(loads.values()), max_skew=cfg.remedy_skew)
+        mine = [u for u in st.assigned_to(victim)
+                if u in self._unresolved]
+        queued = [u for u in mine if st.last.get(u) == "enqueue"]
+        in_flight = [u for u in mine if st.last.get(u) == "admit"]
+        drops, fences = remedy_mod.pick_shed(
+            queued, in_flight, count,
+            migrate_inflight=cfg.migrate_inflight)
+        if not drops and not fences:
+            return
+        # a kill here models dying between the remediation decision and
+        # its journal record: nothing moved, no request sent — the
+        # restart re-detects the (journal-derived) skew, re-times the
+        # hold, and re-derives the identical shed; every move below is
+        # ack-gated, so no user is ever double-moved either way
+        faults.fire("fabric.remedy", host=victim, action="rebalance")
+        rec = self.journal.append("remedy", host=victim,
+                                  action="rebalance")
+        self.remedies += 1
+        self._remedy_last = now
+        self._remedy_hot.pop(victim, None)
+        self.report.event("remedy", host=victim, action="rebalance")
+        self._ctl("ctl.remedy", key=rec["seq"], host=victim,
+                  action="rebalance", drops=len(drops),
+                  fences=len(fences))
+        # the round's withdrawals place as ONE batch plan (the
+        # _pump_drain anti-herding discipline)
+        drop_target = dict(placement_mod.plan_failover(
+            drops, state=st, unresolved=self._unresolved, hosts=targets,
+            edges=self._fleet_edges(), policy=cfg.placement))
+        for u in drops:
+            self._migrating[u] = drop_target[u]
+            h.assign.append({"drop": u})
+            self.report.event("migrate_request", user=u,
+                              host=drop_target[u])
+        for u in fences:
+            self._fencing[u] = victim
+            self._fence_t[u] = now
+            h.assign.append({"fence": u})
+            self.report.event("migrate_request", user=u, host=victim)
+        if self.alerts is not None:
+            # acting on the alert CONSUMES it: the next evaluation
+            # re-fires if the condition still (or again) holds
+            self.alerts.rearm("placement_skew", victim)
 
     def _adopt_operator_hosts(self) -> None:
         """Operator-added workers announce through the lease directory:
@@ -1003,6 +1278,8 @@ class FabricCoordinator:
         for u in victims:
             self._migrating.pop(u, None)
             self._fencing.pop(u, None)
+            self._fence_t.pop(u, None)
+            self._fence_fallback.pop(u, None)
         # the WHOLE victim set is placed as one plan (in-flight first,
         # then queued — assigned_to's order): each placement folds into
         # the next decision's load/bucket view, so two same-bucket
@@ -1125,13 +1402,21 @@ class FabricCoordinator:
         return [h.host_id for h in self.hosts.values()
                 if h.alive and not h.draining]
 
-    def _assign(self, user: str) -> str | None:
+    def _assign(self, user: str, exclude: str | None = None) -> str | None:
         """Place and commit one user; returns the target host id, or
         ``None`` when no live non-draining target exists (the user
         keeps its stale assignment — the run loop raises FabricError,
         the autoscaler respawns, or the next JOIN's stranded path
-        re-places it)."""
+        re-places it).  ``exclude``: a host this placement should avoid
+        — the remedy fence commit passes the shed SOURCE, which (unlike
+        a draining source) is still a live route target and would
+        otherwise be re-picked the moment its released user lowered its
+        load, flapping the user straight back onto the overloaded host.
+        Preference, not a hard ban: when the source is the only live
+        target the user still lands there (progress over purity)."""
         live = self._route_targets()
+        if exclude is not None:
+            live = [hid for hid in live if hid != exclude] or live
         if not live:
             return None
         # bucket-aware placement, a pure function of journaled state
@@ -1192,6 +1477,9 @@ class FabricCoordinator:
                                     src_off=off)
                 self._unresolved.discard(u)
                 self._migrating.pop(u, None)
+                self._fencing.pop(u, None)
+                self._fence_t.pop(u, None)
+                self._fence_fallback.pop(u, None)
                 self._note_finish()
                 self.report.event("user_finished", user=u, host=h.host_id)
             elif ev == "poison":
@@ -1239,6 +1527,10 @@ class FabricCoordinator:
                           ok=bool(rec.get("ok")),
                           flow_user=u if rec.get("ok") else None)
                 target = self._migrating.pop(u, None)
+                # whichever ack commits a deadline-demoted fence first
+                # (this drop, or the racing checkpoint fence) clears the
+                # fallback entry; the loser's ack is then cursor-only
+                self._fence_fallback.pop(u, None)
                 if target is None:
                     continue
                 if rec.get("ok") and u in self._unresolved:
@@ -1280,7 +1572,42 @@ class FabricCoordinator:
                           gen=rec.get("gen"),
                           flow_user=u if rec.get("ok") else None)
                 src = self._fencing.pop(u, None)
+                self._fence_t.pop(u, None)
                 if src is None:
+                    src = self._fence_fallback.pop(u, None)
+                    if src is None:
+                        continue  # stale ack (restart): cursor-only
+                    # a deadline-DEMOTED fence whose checkpoint-boundary
+                    # release raced the evict verb and won: the boundary
+                    # release is strictly better than the evict we fell
+                    # back to — commit the move to the demotion's target
+                    # (the evict's refused drop ack is then cursor-only,
+                    # its _migrating entry popped here)
+                    target = self._migrating.pop(u, None)
+                    if rec.get("ok") and u in self._unresolved:
+                        faults.fire("fabric.migrate.commit", user=u,
+                                    host=src)
+                        th = self.hosts.get(target) if target else None
+                        if th is not None and th.alive \
+                                and not th.draining:
+                            self._assign_to(u, target)
+                        else:
+                            # demotion target died mid-race: re-place,
+                            # still avoiding the shed source
+                            target = self._assign(u, exclude=src)
+                        if target is not None:
+                            self.migrations += 1
+                            self.fences += 1
+                            self.report.event("migrate_inflight",
+                                              user=u, host=target,
+                                              gen=rec.get("gen"))
+                            self._ctl("ctl.migrate",
+                                      key=("i", h.host_id, off),
+                                      user=u, host=target,
+                                      kind="inflight",
+                                      gen=rec.get("gen"), flow_user=u)
+                    elif not rec.get("ok"):
+                        self.report.event("migrate_refused", user=u)
                     continue
                 if rec.get("ok") and u in self._unresolved:
                     # a kill here dies with the fence journaled but the
@@ -1289,7 +1616,10 @@ class FabricCoordinator:
                     # re-places it — exactly one owner either way
                     faults.fire("fabric.migrate.commit", user=u,
                                 host=src)
-                    target = self._assign(u)
+                    # a draining source is already off the route-target
+                    # list; a remedy-shed source is NOT — exclude it so
+                    # the released user cannot flap straight back
+                    target = self._assign(u, exclude=src)
                     if target is not None:
                         self.migrations += 1
                         self.fences += 1
@@ -1350,7 +1680,6 @@ class FabricCoordinator:
         now = self._clock()
         st = self.journal.state
         hosts: dict = {}
-        lease_ages: dict = {}
         for hid, h in self.hosts.items():
             age = lease_age_s(h.lease_path, now) if h.alive else None
             hosts[hid] = {
@@ -1359,13 +1688,11 @@ class FabricCoordinator:
                 "lease_age_s": round(age, 3) if age is not None else None,
                 "load": self._load_of(hid),
             }
-            if h.alive and h.joined:
-                lease_ages[hid] = age
         if self.alerts is not None:
-            from consensus_entropy_tpu.obs import alerts as alerts_mod
-
-            self.alerts.update(alerts_mod.lease_alerts(
-                lease_ages, self.config.lease_s))
+            # the COMPOSED list (lease burn + placement skew) — the
+            # same one _pump_remedy feeds, so the snapshot-based
+            # watcher's two call sites never delete each other's keys
+            self.alerts.update(self._evaluate_alerts())
         payload = {
             "hosts": hosts,
             "unresolved": len(self._unresolved),
@@ -1376,6 +1703,9 @@ class FabricCoordinator:
             "spawns": self.spawns, "joins": self.joins,
             "migrations": self.migrations, "drains": self.drains,
             "fences": self.fences, "revocations": self.revocations,
+            "remedies": self.remedies,
+            "fence_timeouts": self.fences_timed_out,
+            "fencing": len(self._fencing),
             "draining_host": self._draining_host,
             "edges": list(self._fleet_edges()) or None,
         }
@@ -1402,6 +1732,8 @@ class FabricCoordinator:
             "migrations": self.migrations,
             "drains": self.drains,
             "fences": self.fences,
+            "remedies": self.remedies,
+            "fence_timeouts": self.fences_timed_out,
             "compactions": self.journal.compactions,
             "hosts": {hid: ("drained" if h.draining and not h.alive
                             else "revoked" if not h.alive else "closed")

@@ -201,7 +201,11 @@ def run_worker(fabric_dir: str, host_id: str, *, build_entry, scheduler,
         exit clean) and ``{"fence": uid}`` (in-flight migration:
         release the user at its next checkpoint boundary and ack with
         the checkpoint generation — the coordinator commits the
-        re-assign only on the journaled ack)."""
+        re-assign only on the journaled ack).  A drop carrying
+        ``"evict": true`` is the fence's DEADLINE fallback: force-
+        release the user at its next step boundary (evict+resume
+        semantics) and ack as a ``drop`` — deferred when in-flight,
+        exactly like a fence."""
         while not stop.is_set():
             for rec, _off in feed.poll():
                 if rec.get("close"):
@@ -234,8 +238,17 @@ def run_worker(fabric_dir: str, host_id: str, *, build_entry, scheduler,
                     continue
                 if rec.get("drop") is not None:
                     uid = str(rec["drop"])
-                    ok = server.withdraw(uid)
-                    journal.append("drop", uid, ok=ok)
+                    if rec.get("evict"):
+                        # deadline-fenced degradation: queued/unknown
+                        # verdicts ack now; an in-flight force-release
+                        # acks from the serve loop once the session's
+                        # next ready pop releases it
+                        verdict = server.evict(uid)
+                        if verdict is not None:
+                            journal.append("drop", uid, ok=bool(verdict))
+                    else:
+                        ok = server.withdraw(uid)
+                        journal.append("drop", uid, ok=ok)
                     continue
                 uid = rec.get("user")
                 if uid is None:

@@ -92,6 +92,16 @@ HOST_EVENTS = ("lease", "revoke", "spawn", "join", "drain", "drain_done")
 #: bucket edges in force) + ``sketch`` (the quantile-sketch state), so a
 #: restarted server re-derives IDENTICAL routing from replay alone
 PLANNER_EVENTS = ("planner",)
+#: remediation-plane decisions (``serve.remedy`` / the coordinator's
+#: remedy pump): ``host`` + ``action`` (``rebalance`` — drain-for-
+#: rebalance on an overloaded host; ``fence_timeout`` — a checkpoint
+#: fence unacked past the operator deadline fell back to evict+resume,
+#: carrying the fenced ``user``).  A SEPARATE kind from ``HOST_EVENTS``
+#: on purpose: a remedy record is an audit ledger entry — it changes no
+#: membership (the host stays live and joined), no disposition and no
+#: routing, so replay folds it into the cursor/seq only and the actions
+#: it drove re-derive from the ack-gated records that follow it.
+REMEDY_EVENTS = ("remedy",)
 
 
 class JournalState:
@@ -142,7 +152,8 @@ class JournalState:
     def apply(self, rec: dict) -> None:
         event = rec.get("event")
         if event not in EVENTS and event not in HOST_EVENTS \
-                and event not in PLANNER_EVENTS:
+                and event not in PLANNER_EVENTS \
+                and event not in REMEDY_EVENTS:
             return  # foreign/corrupt line: disposition unchanged
         seq = rec.get("seq")
         if isinstance(seq, int):
@@ -155,6 +166,13 @@ class JournalState:
         if isinstance(host, str) and isinstance(rec.get("src_off"), int):
             self.host_cursor[host] = max(self.host_cursor.get(host, 0),
                                          rec["src_off"])
+        if event in REMEDY_EVENTS:
+            # an audit ledger entry: no membership change (the host
+            # stays live — this is what distinguishes a remedy from a
+            # drain), no disposition, no routing.  The seq/cursor fold
+            # above is all replay needs; the actions the decision drove
+            # re-derive from the ack-gated records that follow it.
+            return
         if event in HOST_EVENTS:
             if isinstance(host, str):
                 self.hosts[host] = event
@@ -398,6 +416,10 @@ def validate_journal_file(path: str) -> list[str]:
         if ev in HOST_EVENTS:
             if not isinstance(rec.get("host"), str):
                 errors.append(f"{path}:{i}: {ev!r} lacks host")
+        elif ev in REMEDY_EVENTS:
+            if not isinstance(rec.get("host"), str) \
+                    or not isinstance(rec.get("action"), str):
+                errors.append(f"{path}:{i}: {ev!r} lacks host/action")
         elif ev in PLANNER_EVENTS:
             if not isinstance(rec.get("edges"), list):
                 errors.append(f"{path}:{i}: {ev!r} lacks edges")
@@ -611,6 +633,11 @@ class AdmissionJournal:
         if event in HOST_EVENTS:
             if not isinstance(fields.get("host"), str):
                 raise ValueError(f"journal event {event!r} needs host=")
+        elif event in REMEDY_EVENTS:
+            if not isinstance(fields.get("host"), str) \
+                    or not isinstance(fields.get("action"), str):
+                raise ValueError(
+                    f"journal event {event!r} needs host= and action=")
         elif event in PLANNER_EVENTS:
             if not isinstance(fields.get("edges"), list):
                 raise ValueError(f"journal event {event!r} needs edges=")

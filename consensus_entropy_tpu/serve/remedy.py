@@ -1,0 +1,110 @@
+"""Remediation policy kernels: alert + journal state → fabric action.
+
+The REMEDIATION PLANE closes the loop from the introspection plane's
+alerts (:mod:`obs.alerts`) back into the fabric's journaled control-plane
+verbs: a sustained ``placement_skew`` alert on an overloaded live host
+triggers a DRAIN-FOR-REBALANCE (its queued users move over the PR 13
+drop-ack path, its in-flight users over the PR 14 checkpoint fence —
+WITHOUT retiring the host), and a fence that is never acked within the
+operator's deadline falls back to evict+resume so one long iteration can
+never hold a drain, migration or rebalance open.
+
+Everything in this module is a PURE decision kernel — no clock reads, no
+journal writes, no I/O.  The coordinator's pump
+(:meth:`~consensus_entropy_tpu.serve.fabric.FabricCoordinator.
+_pump_remedy`) supplies journal-replayed loads and injected-clock
+timestamps, journals the decision (``remedy`` records behind the
+``fabric.remedy`` fault point) BEFORE acting, and drives the action over
+the existing ack-gated verbs — which is what keeps the whole plane
+replay-deterministic: a coordinator SIGKILLed mid-remediation re-derives
+the identical action sequence from the journal and never double-moves a
+user (every move still commits only on the source worker's journaled
+ack).
+
+Flap-freedom is arithmetic, not tuning: :func:`shed_count` sheds exactly
+down to ``floor + max_skew``, the highest load that does NOT alert — so
+one remediation clears its own trigger condition and the skew alert
+cannot re-fire from the same imbalance (see the sweep table in
+``tests/test_remedy.py``, the ``scale_down_ok`` precedent).
+"""
+
+from __future__ import annotations
+
+#: how long a skew alert must hold CONTINUOUSLY before the pump acts —
+#: the hysteresis guard against remediating a transient imbalance the
+#: normal placement flow is about to absorb anyway
+DEFAULT_HOLD_S = 1.0
+#: minimum seconds between journaled remediations — the rate limit that
+#: keeps a pathological workload from turning the remedy pump into a
+#: migration storm
+DEFAULT_COOLDOWN_S = 5.0
+
+
+def shed_count(load: int, floor: int, *, max_skew: int) -> int:
+    """How many users an overloaded host sheds to clear a skew alert.
+
+    Pure decision kernel (pinned in ``tests/test_remedy.py``): the host
+    sheds down to exactly ``floor + max_skew`` — the highest load that
+    does NOT trip :func:`~consensus_entropy_tpu.obs.alerts.skew_alerts`
+    (which fires on ``load - floor > max_skew``).  Flap-free by
+    construction:
+
+    - shedding onto other hosts can only RAISE the fleet's floor, never
+      lower it, so the post-shed host sits at or below the alert line;
+    - a host at or below the line sheds nothing (``max(0, ...)``), so a
+      cleared condition never re-triggers from the same imbalance.
+    """
+    return max(0, int(load) - int(floor) - int(max_skew))
+
+
+def remedy_due(held_since: float | None, now: float, *,
+               hold_s: float) -> bool:
+    """True once an alert condition has held CONTINUOUSLY for
+    ``hold_s`` seconds (``held_since`` is the injected-clock time the
+    pump first saw it; ``None`` means it is not currently active).  The
+    hysteresis guard: a transient skew that clears within the hold never
+    triggers a remediation — mirroring the scale-down low-water timer."""
+    return held_since is not None and now - held_since >= hold_s
+
+
+def cooldown_ok(last_t: float | None, now: float, *,
+                cooldown_s: float) -> bool:
+    """True when enough time has passed since the LAST journaled
+    remediation (``None`` = never remediated) for another to fire — the
+    pump's rate limit."""
+    return last_t is None or now - last_t >= cooldown_s
+
+
+def fence_expired(fenced_t: float | None, now: float, *,
+                  deadline_s: float) -> bool:
+    """True when a checkpoint fence sent at ``fenced_t`` has gone
+    unacked past the operator's ``--fence-deadline-s`` — the degradation
+    trigger: the coordinator stops waiting for the iteration boundary
+    and falls back to evict+resume (the session releases mid-iteration;
+    its workspace stays at the last committed checkpoint, exactly the
+    single-host eviction semantics).  ``deadline_s <= 0`` disables the
+    deadline (PR 14 semantics: a fence waits for its boundary forever);
+    ``fenced_t is None`` means no fence is pending."""
+    return deadline_s > 0 and fenced_t is not None \
+        and now - fenced_t >= deadline_s
+
+
+def pick_shed(queued: list, in_flight: list, count: int, *,
+              migrate_inflight: bool = True) -> tuple[list, list]:
+    """Split an overloaded host's shed set into ``(drops, fences)``.
+
+    Pure selection kernel: queued users shed FIRST (a drop is free — the
+    user never started), latest-enqueued first (the ``plan_rebalance``
+    contract: users most recently routed to the hot host are the ones a
+    better-informed placement would have sent elsewhere); in-flight
+    users fill the remainder via checkpoint fences, earliest-admitted
+    first (the longest-running session has the most sunk work per move —
+    shed it last... i.e. in-flight victims are taken from the END of the
+    first-admit-ordered list).  ``migrate_inflight=False`` sheds queued
+    users only (the drain-by-waiting arm)."""
+    n = max(0, int(count))
+    drops = list(reversed(queued))[:n]
+    fences: list = []
+    if migrate_inflight and len(drops) < n:
+        fences = list(reversed(in_flight))[: n - len(drops)]
+    return drops, fences

@@ -466,7 +466,18 @@ class FleetServer:
         #: fence requests from the intake thread, applied (and their
         #: deferred acks journaled) on the serve-loop thread
         self._fence_req: list = []
+        #: evict requests (the fence's DEADLINE fallback): force-released
+        #: at the next ready pop — any step boundary — and acked as
+        #: ``drop`` records instead of ``fence`` ones
+        self._evict_req: list = []
+        #: uids whose deferred release must ack as a ``drop`` (evicted),
+        #: not a ``fence`` — insertion-ordered for deterministic acks
+        self._evicting: dict[str, None] = {}
         self._fence_lock = threading.Lock()
+        #: serve-local control-lane bookkeeping (``ctl.*`` spans): last
+        #: observed journal compaction count and breaker width states
+        self._ctl_compactions = 0
+        self._ctl_breaker: dict = {}
         #: the live introspection plane (``--no-introspection`` passes
         #: neither — the PR 14 arm): ``status`` is an ``obs.status.
         #: StatusWriter`` the serve loop refreshes (rate-limited inside
@@ -642,6 +653,32 @@ class FleetServer:
             return None
         return False
 
+    def evict(self, user_id) -> bool | None:
+        """The fence's DEADLINE fallback (intake thread): the coordinator
+        gave up waiting for a checkpoint-boundary release
+        (``--fence-deadline-s``) and demands an evict+resume instead.
+
+        - Still QUEUED here → withdrawn now, returns True (nothing ran,
+          no generation; the caller journals the positive ``drop`` ack).
+        - IN-FLIGHT → the force-release is requested and the ack
+          DEFERRED: returns None; the serve loop releases the session at
+          its next READY pop — ANY step boundary, not the iteration
+          checkpoint — discarding the current iteration's in-memory
+          progress (the workspace stays at its last two-phase-committed
+          generation, which is what resume elsewhere replays), and
+          journals the ``drop`` ack then (:meth:`_apply_fences`).
+        - Unknown or already finished/released → returns False (refused:
+          the user's own records resolve it at the coordinator).
+        """
+        uid = str(user_id)
+        if self.withdraw(uid):
+            return True
+        if uid in self._live_cls:
+            with self._fence_lock:
+                self._evict_req.append(uid)
+            return None
+        return False
+
     def _apply_fences(self) -> None:
         """Serve-loop half of the migration fence: turn intake-thread
         fence requests into engine release marks, and journal the
@@ -651,11 +688,21 @@ class FleetServer:
         another host from the fenced workspace."""
         with self._fence_lock:
             reqs, self._fence_req = self._fence_req, []
+            evicts, self._evict_req = self._evict_req, []
         for uid in reqs:
             if not self.scheduler.request_release(uid):
                 # finished or evicted between the request and this
                 # round: refuse — the user's own records resolve it
                 self._journal("fence", uid, ok=False)
+        for uid in evicts:
+            if self.scheduler.force_release(uid):
+                self._evicting[uid] = None
+            else:
+                # finished — or its earlier FENCE released it at a
+                # checkpoint boundary just before the deadline demotion
+                # arrived: refuse; the fence ack (or finish record)
+                # already resolves the user at the coordinator
+                self._journal("drop", uid, ok=False)
         for uid, gen in self.scheduler.take_released().items():
             self._live_cls.pop(uid, None)
             for e in self._admitted:
@@ -666,7 +713,20 @@ class FleetServer:
             fields = {"ok": True}
             if gen is not None:
                 fields["gen"] = int(gen)
-            self._journal("fence", uid, **fields)
+            # an evicted session acks as a DROP (the coordinator's
+            # drop-ack commit path completes the move); a fenced one as
+            # the deferred FENCE ack.  Either way the released session's
+            # workspace is durable at ``gen`` and the run continues
+            # elsewhere from exactly that state.
+            kind = "drop" if uid in self._evicting else "fence"
+            self._evicting.pop(uid, None)
+            self._journal(kind, uid, **fields)
+            tracer = self.scheduler.tracer
+            if tracer.enabled and self.journal is not None:
+                tracer.control_event(
+                    "ctl.release", key=self.journal.state.seq,
+                    flow_user=uid, kind=kind,
+                    gen=None if gen is None else int(gen))
 
     def apply_fleet_edges(self, edges) -> None:
         """Adopt coordinator-broadcast fabric-level bucket edges (the
@@ -829,6 +889,42 @@ class FleetServer:
         alerts.  Observation only; absent under ``--no-introspection``."""
         if self.status is not None:
             self.status.maybe_write(self._status_payload)
+        self._ctl_spans()
+
+    def _ctl_spans(self) -> None:
+        """The serve-LOCAL control-plane trace lane: single-host
+        ``--serve`` runs get the same ``ctl.*`` Perfetto lane the fabric
+        coordinator has — journal compactions and breaker open/close
+        transitions land as instantaneous decision spans, keyed on the
+        journal seq at which the transition was observed (the durable
+        identity discipline of ``Tracer.control_event``: a restarted
+        server re-observes from replayed state and the merge dedupes).
+        Observation only — nothing journaled or replayed reads a span."""
+        tracer = self.scheduler.tracer
+        if not tracer.enabled or self.journal is None:
+            return
+        n = self.journal.compactions
+        if n > self._ctl_compactions:
+            seq = self.journal.state.seq
+            for i in range(self._ctl_compactions + 1, n + 1):
+                tracer.control_event("ctl.compact", key=(seq, i),
+                                     compactions=i)
+            self._ctl_compactions = n
+        breaker = self.scheduler.breaker
+        if breaker is not None:
+            states = {str(w): str(s)
+                      for w, s in (breaker.summary() or {}).items()}
+            if states != self._ctl_breaker:
+                seq = self.journal.state.seq
+                for w in sorted(set(states) | set(self._ctl_breaker)):
+                    old = self._ctl_breaker.get(w, "closed")
+                    new = states.get(w, "closed")
+                    if old != new:
+                        tracer.control_event("ctl.breaker",
+                                             key=(seq, w, new),
+                                             width=w, state=new,
+                                             prev=old)
+                self._ctl_breaker = states
 
     def _evaluate_alerts(self) -> list:
         from consensus_entropy_tpu.obs import alerts as alerts_mod
@@ -860,7 +956,8 @@ class FleetServer:
         for c in self._live_cls.values():
             live_cls[c] = live_cls.get(c, 0) + 1
         with self._fence_lock:
-            fences_pending = len(self._fence_req)
+            fences_pending = (len(self._fence_req) + len(self._evict_req)
+                              + len(self._evicting))
         payload = {
             "queued": depths,
             "queue_total": sum(depths.values()),

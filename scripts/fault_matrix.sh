@@ -56,6 +56,23 @@
 #   matrix) is the companion gate: kill→respawn→journal-schema→
 #   merged-edges (leg 1) and the drain+migrate kill matrix against
 #   real workers with the exactly-one-owner check (leg 2).
+# - self-healing remediation plane (tests/test_remedy.py): the pure
+#   decision-kernel sweep tables (shed_count flap-freedom, hysteresis/
+#   cooldown/deadline truth tables, pick_shed order+budget), alert-sink
+#   grammar/delivery/isolation, the edge-trigger rearm pin, and the
+#   deterministic fake-worker drills — drain-for-rebalance off an
+#   overloaded live host (drop-ack + checkpoint fence, host NOT
+#   retired), fence-deadline demotion to evict+resume (both the
+#   evict-ack and late-fence-ack winners), and the coordinator-kill
+#   matrix at the fabric.remedy decision point (fires BEFORE the
+#   journal append; single-owner invariant across both incarnations).
+#   scripts/remedy_check.sh (run at the end of this matrix) is the
+#   companion gate against REAL workers: a slow host (pool.score
+#   delay) must be rebalanced without retirement, a fence the slow
+#   host cannot ack inside fence_deadline_s must demote to
+#   evict+resume, and a coordinator killed at fabric.remedy must
+#   replay to an exactly-once finish — every leg bit-identical to
+#   sequential baselines.
 # - acquisition registry (tests/test_acquire.py): the acquire.qbdc.masks
 #   fault point unit and the qbdc resume drill.
 # - observability (tests/test_obs.py): the traced fleet eviction+resume
@@ -72,8 +89,9 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
   tests/test_serve_faults.py tests/test_serve_fabric.py \
-  tests/test_slo.py tests/test_elastic.py tests/test_acquire.py \
-  tests/test_obs.py -v -m faults \
+  tests/test_slo.py tests/test_elastic.py tests/test_remedy.py \
+  tests/test_acquire.py tests/test_obs.py -v -m faults \
   -p no:cacheprovider "$@"
 scripts/elastic_check.sh
+scripts/remedy_check.sh
 echo "fault matrix passed"

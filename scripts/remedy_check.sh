@@ -1,0 +1,198 @@
+#!/usr/bin/env bash
+# Self-healing fabric CI gate (ISSUE 16 tentpole; sits next to
+# elastic_check.sh and is run by scripts/fault_matrix.sh).
+#
+# LEG 1 — drain-for-rebalance: a REAL 3-host fabric where h0 runs with
+# an injected pool.score delay (the slow-host simulation — values
+# untouched, iterations slow), so its unresolved load holds while the
+# fast hosts drain to zero: the sustained placement-skew alert must
+# trigger a journaled ``remedy`` rebalance that moves h0's queued users
+# over the drop-ack path and its in-flight users over the checkpoint
+# fence WITHOUT retiring the host — every user bit-identical to
+# unfaulted sequential baselines, no drains, no revocations, and the
+# main journal + every per-host WAL schema-valid.
+#
+# LEG 2 — deadline-fenced degradation: same geometry, but the slow
+# host's iterations (~0.5 s) cannot ack a checkpoint fence inside
+# ``fence_deadline_s`` (0.01 s): the coordinator must journal the
+# ``fence_timeout`` remedy and demote to evict+resume — the session
+# force-releases at its next step boundary and resumes on a fast host,
+# still bit-identical.
+#
+# LEG 3 — kill at the decision point: the coordinator is killed
+# (in-process InjectedKill) at ``fabric.remedy`` — which fires BEFORE
+# the decision journals, so the kill leaves no half-journaled remedy —
+# and rerun; the rerun must replay the journal, finish every user
+# EXACTLY ONCE across every host's results file, and keep parity.
+#
+# Extra args are NOT accepted: this is a pass/fail gate, not a bench.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'PY'
+import glob
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, ".")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+from tests.fabric_workload import (
+    make_cfg,
+    sequential_baselines,
+    sizes_arg,
+    user_specs,
+)
+
+from consensus_entropy_tpu.obs import export
+from consensus_entropy_tpu.resilience import faults as faults_mod
+from consensus_entropy_tpu.resilience.faults import FaultRule, InjectedKill
+from consensus_entropy_tpu.serve import (
+    AdmissionJournal,
+    FabricConfig,
+    FabricCoordinator,
+    validate_journal_file,
+)
+from consensus_entropy_tpu.serve.hosts import fabric_paths
+
+cfg = make_cfg("mc", epochs=2)
+specs = user_specs(8, sizes=[30, 100])
+root = tempfile.mkdtemp(prefix="remedy_check_")
+seq = sequential_baselines(root, cfg, specs)
+
+
+def run_leg(slug, fcfg, *, slow_s, inject_point=None, on_poll=None):
+    """One coordinator run over real workers; h0 is the slow host
+    (pool.score delay, every scan).  Returns (summary_or_None, killed,
+    fabric_dir) — summary is None when the injected kill fired."""
+    fdir = os.path.join(root, "fabric_" + slug)
+    ws = os.path.join(root, "ws_" + slug)
+    os.makedirs(fdir, exist_ok=True)
+    os.makedirs(ws, exist_ok=True)
+
+    def spawn(host_id, fdir=fdir, ws=ws):
+        log = open(fabric_paths(fdir, host_id)["log"], "ab")
+        env = {**os.environ, "PYTHONPATH": "."}
+        if host_id == "h0":
+            env["CETPU_FAULTS"] = f"pool.score:delay={slow_s}@1x-1"
+        try:
+            return subprocess.Popen(
+                [sys.executable, "tests/fabric_worker.py", fdir,
+                 host_id, ws, cfg.mode, str(cfg.epochs), str(len(specs)),
+                 "5.0", "2", sizes_arg(specs)],
+                stdout=log, stderr=subprocess.STDOUT, env=env)
+        finally:
+            log.close()
+
+    jp = os.path.join(fdir, "serve_journal.jsonl")
+    journal = AdmissionJournal(jp)
+    killed = False
+    summary = None
+    try:
+        if inject_point is None:
+            summary = FabricCoordinator(journal, fdir, fcfg,
+                                        on_poll=on_poll).run(
+                [u for _, u, _ in specs], spawn,
+                pools={u: n for _, u, n in specs})
+        else:
+            try:
+                with faults_mod.inject(FaultRule(inject_point, "kill",
+                                                 at=1)):
+                    FabricCoordinator(journal, fdir, fcfg,
+                                      on_poll=on_poll).run(
+                        [u for _, u, _ in specs], spawn,
+                        pools={u: n for _, u, n in specs})
+            except InjectedKill:
+                killed = True
+    finally:
+        journal.close()
+    return summary, killed, fdir
+
+
+def check_parity_and_owners(fdir, label):
+    """Schema-validate every journal/WAL, then the EXACTLY-ONE-OWNER +
+    parity gate: each user has exactly one result row across every
+    host's results file, bit-identical to sequential."""
+    jp = os.path.join(fdir, "serve_journal.jsonl")
+    bad = validate_journal_file(jp)
+    for wal in sorted(glob.glob(os.path.join(fdir, "events_*.jsonl"))):
+        bad += validate_journal_file(wal)
+    assert bad == [], "journal violations:\n" + "\n".join(bad[:10])
+    rows = {}
+    for fname in sorted(os.listdir(fdir)):
+        if fname.startswith("results_") and fname.endswith(".jsonl"):
+            for rec in export.read_jsonl_tolerant(
+                    os.path.join(fdir, fname)):
+                rows.setdefault(rec["user"], []).append(rec)
+    for _, uid, _ in specs:
+        assert len(rows[uid]) == 1, (label, uid, rows.get(uid))
+        assert rows[uid][0]["error"] is None, (label, uid)
+        assert rows[uid][0]["result"]["trajectory"] \
+            == seq[uid]["trajectory"], (label, uid)
+
+
+def remedy_actions(fdir):
+    st_recs = []
+    for rec in export.read_jsonl_tolerant(
+            os.path.join(fdir, "serve_journal.jsonl")):
+        if rec.get("event") == "remedy":
+            st_recs.append(rec.get("action"))
+    return st_recs
+
+
+# ---- LEG 1: drain-for-rebalance on a live slow host -------------------
+# placement="load" gives the even 3/3/2 initial split (inside the
+# remedy_skew=1 bound), so the ONLY sustained skew is the slow host
+# holding its share while the fast hosts drain to zero
+fcfg1 = FabricConfig(hosts=3, min_hosts=3, max_hosts=3, remedy=True,
+                     remedy_hold_s=0.2, remedy_cooldown_s=600.0,
+                     remedy_skew=1, placement="load")
+summary1, _, fdir1 = run_leg("rebalance", fcfg1, slow_s=0.3)
+assert sorted(summary1["finished"]) == sorted(u for _, u, _ in specs)
+assert summary1["remedies"] >= 1, summary1
+assert summary1["migrations"] >= 1, summary1
+assert summary1["fence_timeouts"] == 0, summary1  # deadline disabled
+assert summary1["drains"] == 0 and summary1["revocations"] == 0, summary1
+assert "rebalance" in remedy_actions(fdir1)
+st1 = AdmissionJournal(os.path.join(fdir1, "serve_journal.jsonl")).state
+assert sorted(st1.fleet_hosts()) == ["h0", "h1", "h2"]  # nobody retired
+check_parity_and_owners(fdir1, "rebalance")
+print(f"remedy_check: drain-for-rebalance moved load off the slow host "
+      f"(remedies={summary1['remedies']}, "
+      f"migrations={summary1['migrations']}, "
+      f"fences={summary1['fences']}), host kept, parity exact")
+
+# ---- LEG 2: deadline-fenced degradation -------------------------------
+fcfg2 = FabricConfig(hosts=3, min_hosts=3, max_hosts=3, remedy=True,
+                     remedy_hold_s=0.2, remedy_cooldown_s=600.0,
+                     remedy_skew=1, placement="load",
+                     fence_deadline_s=0.01)
+summary2, _, fdir2 = run_leg("deadline", fcfg2, slow_s=0.5)
+assert sorted(summary2["finished"]) == sorted(u for _, u, _ in specs)
+assert summary2["remedies"] >= 1, summary2
+assert summary2["fence_timeouts"] >= 1, summary2
+assert "fence_timeout" in remedy_actions(fdir2)
+check_parity_and_owners(fdir2, "deadline")
+print(f"remedy_check: fence deadline demoted to evict+resume "
+      f"(fence_timeouts={summary2['fence_timeouts']}), parity exact")
+
+# ---- LEG 3: coordinator killed at the decision point ------------------
+_, killed, fdir3 = run_leg("kill", fcfg1, slow_s=0.3,
+                           inject_point="fabric.remedy")
+assert killed, "fabric.remedy never fired (no skew developed?)"
+# fired-before-append: the killed decision never reached the journal
+assert remedy_actions(fdir3) == [], remedy_actions(fdir3)
+summary3, _, _ = run_leg("kill", fcfg1, slow_s=0.3)
+st3 = AdmissionJournal(os.path.join(fdir3, "serve_journal.jsonl")).state
+assert st3.finished == {u for _, u, _ in specs} and not st3.pending
+check_parity_and_owners(fdir3, "kill")
+print(f"remedy_check: kill@fabric.remedy replayed clean — "
+      f"{len(specs)} users finished exactly once, parity exact "
+      f"(rerun remedies={summary3['remedies']})")
+PY
+echo "remedy check passed"

@@ -73,7 +73,7 @@ def test_registry_has_the_contracted_rules():
                  "prng-key-reuse", "replay-wallclock",
                  "replay-unseeded-rng", "replay-set-iteration",
                  "implicit-host-sync", "fault-point-literal",
-                 "event-schema"):
+                 "event-schema", "lock-discipline"):
         assert name in rules, name
 
 
@@ -483,6 +483,120 @@ def test_event_schema_literal_types():
             writer.emit({"event": "enqueue", "user": "u1",
                          "depth": "3", "t_s": 0.1})
     """) == ["event-schema"]  # dict-form literals are checked too
+
+
+# -- rule 7: lock-discipline -------------------------------------------------
+
+
+def test_lock_discipline_bare_acquire_fires():
+    assert rules_fired("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def go(self):
+                self._lock.acquire()
+                try:
+                    return 1
+                finally:
+                    self._lock.release()
+    """) == ["lock-discipline"]
+    # module-level locks are tracked too
+    assert rules_fired("""
+        import threading
+
+        _REG = threading.Lock()
+
+        def go():
+            _REG.acquire()
+    """) == ["lock-discipline"]
+
+
+def test_lock_discipline_with_form_is_clean():
+    assert rules_fired("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def go(self):
+                with self._lock:
+                    return 1
+    """) == []
+    # Condition has its own wait/notify protocol: not a tracked lock
+    assert rules_fired("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cond = threading.Condition()
+
+            def go(self):
+                self._cond.acquire()
+    """) == []
+
+
+def test_lock_discipline_nested_locks_fire():
+    src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.RLock()
+
+            def go(self):
+                with self._a:
+                    with self._b:
+                        return 1
+    """
+    assert rules_fired(src) == ["lock-discipline"]
+    # a multi-item `with a, b:` is the same nested acquisition
+    assert rules_fired("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def go(self):
+                with self._a, self._b:
+                    return 1
+    """) == ["lock-discipline"]
+    # a non-lock inner context manager under a lock is fine
+    assert rules_fired("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a = threading.Lock()
+
+            def go(self, path):
+                with self._a:
+                    with open(path) as f:
+                        return f.read()
+    """) == []
+    # nested defs are separate control flow: a callback that takes its
+    # OWN lock later does not count as held-under the enclosing with
+    assert rules_fired("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def go(self):
+                with self._a:
+                    def cb():
+                        with self._b:
+                            return 1
+                return cb
+    """) == []
 
 
 # -- suppression + baseline semantics ----------------------------------------
