@@ -41,6 +41,7 @@ REPLAY_PREFIXES = (
     PKG + "serve/",
     PKG + "fleet/",
     PKG + "resilience/",
+    PKG + "workload/",
 )
 REPLAY_FILES = (
     PKG + "al/state.py",

@@ -59,9 +59,35 @@ def _alert_lines(snap: dict) -> list[str]:
     return out
 
 
-def render(snaps: dict, *, now: float, stale_s: float = 10.0) -> str:
+#: per-host counters the history ring turns into deltas — coordinator
+#: frames (left) and worker frames (right) share the tuple; fields a
+#: frame lacks are simply omitted from its delta line
+DELTA_FIELDS = ("unresolved", "queued", "in_flight", "migrations",
+                "queue_total", "live", "users_done", "users_failed",
+                "holds")
+
+
+def _delta_line(ring, host: str) -> str | None:
+    """The movement annotation under a frame: ``Δ60s queue:-3 done:+5``
+    over the ring's retained window.  None until the ring holds two
+    distinct snapshots for the host (no movement measurable yet)."""
+    if ring is None:
+        return None
+    d = ring.deltas(host, DELTA_FIELDS)
+    span = d.pop("span_s", None)
+    moved = {k: v for k, v in d.items() if v}
+    if span is None or not moved:
+        return None
+    parts = " ".join(f"{k}:{v:+g}" for k, v in sorted(moved.items()))
+    return f"    Δ{span:.0f}s {parts}"
+
+
+def render(snaps: dict, *, now: float, stale_s: float = 10.0,
+           ring=None) -> str:
     """One frame of the fleet view (pure function of the snapshots —
-    unit-testable; the watch loop just reprints it)."""
+    unit-testable; the watch loop just reprints it).  ``ring`` (an
+    ``obs.status.HistoryRing`` the watch loop owns) adds per-host
+    depth/occupancy delta lines over its retained window."""
     if not snaps:
         return ("cetpu-top: no status snapshots yet (is the run live, "
                 "and introspection on?)")
@@ -78,10 +104,19 @@ def render(snaps: dict, *, now: float, stale_s: float = 10.0) -> str:
             f"spawns={s.get('spawns')} joins={s.get('joins')} "
             f"migrations={s.get('migrations')} "
             f"fences={s.get('fences')} drains={s.get('drains')}")
+        delta = _delta_line(ring, key)
+        if delta:
+            lines.append(delta)
         if s.get("edges"):
             lines.append(f"    fleet edges: {s['edges']}")
         if s.get("draining_host"):
             lines.append(f"    draining: {s['draining_host']}")
+        if s.get("hold_active"):
+            lines.append(f"    ADMISSION HOLD (holds={s.get('holds')})")
+        if s.get("parked"):
+            lines.append(f"    parked={s.get('parked')} "
+                         f"(disconnects={s.get('disconnects')} "
+                         f"reconnects={s.get('reconnects')})")
         for hid, hv in sorted((s.get("hosts") or {}).items()):
             state = ("draining" if hv.get("draining")
                      else "live" if hv.get("alive") else "down")
@@ -110,6 +145,9 @@ def render(snaps: dict, *, now: float, stale_s: float = 10.0) -> str:
             f"done={s.get('users_done')} failed={s.get('users_failed')}"
             f"{' ' + ' '.join(flags) if flags else ''}"
             f" — updated {age} ago")
+        delta = _delta_line(ring, key)
+        if delta:
+            lines.append(delta)
         planner = s.get("planner") or {}
         if planner.get("edges"):
             lines.append(f"    edges={planner['edges']} "
@@ -148,11 +186,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stale-s", type=float, default=10.0, metavar="S",
                    help="flag snapshots older than this as STALE "
                         "(default 10)")
+    p.add_argument("--history", type=int, default=60, metavar="N",
+                   help="snapshots retained per host for the Δ movement "
+                        "lines in watch mode (default 60)")
     return p
 
 
 def main(argv=None) -> int:
-    from consensus_entropy_tpu.obs.status import read_status_dir
+    from consensus_entropy_tpu.obs.status import HistoryRing, \
+        read_status_dir
 
     args = build_parser().parse_args(argv)
     status_dir = resolve_status_dir(args.status_dir)
@@ -160,10 +202,13 @@ def main(argv=None) -> int:
         print(render(read_status_dir(status_dir), now=time.time(),
                      stale_s=args.stale_s))
         return 0
+    ring = HistoryRing(depth=args.history)
     try:
         while True:
-            frame = render(read_status_dir(status_dir), now=time.time(),
-                           stale_s=args.stale_s)
+            snaps = read_status_dir(status_dir)
+            ring.push(snaps)
+            frame = render(snaps, now=time.time(),
+                           stale_s=args.stale_s, ring=ring)
             # clear + home, then the frame: a flicker-free enough watch
             # loop without a curses dependency
             sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")

@@ -99,6 +99,11 @@ EVENT_FIELDS = {
     # that burned past --fence-deadline-s into evict+resume
     "remedy": {"host": "str", "action": "str"},
     "fence_timeout": {"user": "str", "host": "str"},
+    # live intake churn (workload traces): a producer disconnected a
+    # user mid-run (parked; workspace kept) / reconnected it (resumes
+    # from the workspace over the journal re-admission path)
+    "disconnect": {"user": "str"},
+    "reconnect": {"user": "str"},
     # stream-closing summaries (no t_s)
     "fleet_summary": {},
     "fabric_summary": {},
@@ -404,6 +409,19 @@ def planner_timeline(users_dir: str) -> dict:
                            "fleet": bool(rec.get("fleet"))})
     return {"per_host": per_host, "journal_epochs": epochs,
             "alerts": alert_events}
+
+
+def alert_counts(users_dir: str) -> dict:
+    """Fired-alert counts by kind across every host's metrics stream —
+    the soak grader's "did the control plane notice" column (and the
+    quick health read: a clean steady-state soak fires few; a saturated
+    one burns slo_headroom/batch_aging continuously)."""
+    counts: dict = {}
+    for rec in planner_timeline(users_dir)["alerts"]:
+        kind = rec.get("kind")
+        if isinstance(kind, str):
+            counts[kind] = counts.get(kind, 0) + 1
+    return dict(sorted(counts.items()))
 
 
 def text_report(users_dir: str) -> str:

@@ -134,6 +134,62 @@ def read_status_dir(status_dir: str) -> dict[str, dict]:
     return out
 
 
+class HistoryRing:
+    """The last-N snapshots per host (ROADMAP introspection follow-on
+    (d)): ``cetpu-top``'s watch loop pushes each poll's snapshots here
+    and renders depth/occupancy DELTAS against the ring, so a soak is
+    watchable as movement — queue draining or building, users
+    finishing — not just absolute numbers.  Pure in-memory bookkeeping:
+    snapshots are telemetry, nothing replayed reads them.
+
+    A host's snapshot only enters the ring when its ``t`` advanced (the
+    writer is rate-limited; re-reading an unchanged file must not
+    flatten the deltas to zero)."""
+
+    def __init__(self, depth: int = 60):
+        if depth < 2:
+            raise ValueError(f"depth must be >= 2, got {depth}")
+        self.depth = depth
+        self._ring: dict[str, list] = {}
+
+    def push(self, snaps: dict) -> None:
+        """Fold one ``read_status_dir`` result in (stale/unchanged
+        snapshots — same ``t`` as the host's newest entry — are
+        skipped)."""
+        for host, snap in snaps.items():
+            dq = self._ring.setdefault(host, [])
+            if dq and dq[-1].get("t") == snap.get("t"):
+                continue
+            dq.append(snap)
+            del dq[:-self.depth]
+
+    def history(self, host: str) -> list:
+        """Oldest → newest retained snapshots for one host."""
+        return list(self._ring.get(host, ()))
+
+    def deltas(self, host: str, fields: tuple) -> dict:
+        """``{field: newest - oldest}`` over the retained window for
+        the numeric ``fields`` present at both ends (missing or
+        non-numeric at either end → field omitted), plus ``span_s`` —
+        the window's wall span.  One entry in the ring → empty dict (no
+        movement measurable yet)."""
+        hist = self._ring.get(host, ())
+        if len(hist) < 2:
+            return {}
+        lo, hi = hist[0], hist[-1]
+        out = {}
+        for f in fields:
+            a, b = lo.get(f), hi.get(f)
+            if isinstance(a, (int, float)) and not isinstance(a, bool) \
+                    and isinstance(b, (int, float)) \
+                    and not isinstance(b, bool):
+                out[f] = b - a
+        if out and isinstance(lo.get("t"), (int, float)) \
+                and isinstance(hi.get("t"), (int, float)):
+            out["span_s"] = round(hi["t"] - lo["t"], 3)
+        return out
+
+
 def validate_status(snap: dict) -> list[str]:
     """Schema-floor validation for one snapshot (``scripts/obs_check.sh``
     asserts this on MID-RUN snapshots); returns error strings, empty =

@@ -96,10 +96,12 @@ PR 15 alerts back into these journaled verbs:
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import os
 import re
 import signal
+import threading
 import time
 
 from consensus_entropy_tpu.fleet.report import FleetReport
@@ -129,6 +131,11 @@ from consensus_entropy_tpu.serve.placement import (
     DEFAULT_MAX_SKEW,
     PLACEMENT_POLICIES,
 )
+from consensus_entropy_tpu.serve.server import QueueClosed, QueueFull
+
+#: per-class latency samples the burn detector keeps (enough for a
+#: stable p95, small enough that old load shapes age out fast)
+HOLD_WINDOW = 64
 
 
 class FabricError(RuntimeError):
@@ -242,6 +249,30 @@ class FabricConfig:
     #: alert fires past) — matches placement's admission-side bound, so
     #: a shed never undoes what placement would redo
     remedy_skew: int = DEFAULT_MAX_SKEW
+    #: LIVE-INTAKE bound (``run(..., keep_open=True)``): how many
+    #: submitted-but-unpumped users the coordinator's intake may hold
+    #: before :meth:`FabricCoordinator.submit` raises ``QueueFull`` —
+    #: the fabric-level backpressure surface trace drivers retry against
+    intake_max: int = 64
+    #: the BURN-RATE admission hold (ROADMAP cost-aware follow-on; the
+    #: soak PR's alert→remedy wiring): when a priority class's observed
+    #: end-to-end p95 has burned past ``obs.alerts.BURN_FRAC`` of its
+    #: SLO target CONTINUOUSLY for ``remedy_hold_s`` (and the
+    #: ``remedy_cooldown_s`` fleet-wide cooldown elapsed), the
+    #: coordinator journals one ``remedy`` record (action
+    #: ``admission_hold``; the ``fabric.remedy`` fault point fires
+    #: first) and DEFERS ROUTING of newly-submitted users for
+    #: ``admission_hold_s`` — arrivals stay journaled and durable, they
+    #: just don't land on workers until the backlog drains.  Remedy
+    #: records are audit-only on replay, so a kill at the fault point
+    #: replays to the identical dispositions.
+    hold_on_burn: bool = False
+    #: how long one admission hold defers routing
+    admission_hold_s: float = 2.0
+    #: per-class end-to-end SLO targets the burn detector grades
+    #: against (defaults mirror ``ServeConfig``)
+    slo_interactive_s: float = 60.0
+    slo_batch_s: float = 600.0
 
     @property
     def elastic(self) -> bool:
@@ -313,6 +344,16 @@ class FabricConfig:
         if self.remedy_skew < 1:
             raise ValueError(f"remedy_skew must be >= 1, "
                              f"got {self.remedy_skew}")
+        if self.intake_max < 1:
+            raise ValueError(f"intake_max must be >= 1, "
+                             f"got {self.intake_max}")
+        if self.admission_hold_s <= 0:
+            raise ValueError(f"admission_hold_s must be > 0, "
+                             f"got {self.admission_hold_s}")
+        if self.slo_interactive_s <= 0 or self.slo_batch_s <= 0:
+            raise ValueError("per-class SLO targets must be > 0, got "
+                             f"interactive={self.slo_interactive_s} "
+                             f"batch={self.slo_batch_s}")
         if self.placement not in PLACEMENT_POLICIES:
             raise ValueError(f"placement must be one of "
                              f"{PLACEMENT_POLICIES}, got {self.placement!r}")
@@ -468,11 +509,49 @@ class FabricCoordinator:
                 journal, epoch=config.planner_epoch,
                 n_buckets=config.planner_buckets, report=self.report,
                 tracer=tracer if introspect else None)
+        # -- live intake (run(..., keep_open=True)): the producer
+        # surface trace drivers submit through.  Ops append under the
+        # lock from producer threads; _pump_intake drains them on the
+        # coordinator thread, so every journal append stays
+        # single-threaded (the single-writer discipline).
+        self._intake: list = []
+        self._intake_lock = threading.Lock()
+        self._intake_open = False
+        #: the close_intake latch: distinguishes "not open YET" (a
+        #: producer that started before ``run`` — retryable, QueueFull)
+        #: from "closed for good" (QueueClosed — stop submitting)
+        self._intake_closed = False
+        #: users a producer DISCONNECTED (evict sent, workspace kept at
+        #: its last committed generation) awaiting reconnect — parked:
+        #: still unresolved, but not re-routed until they return
+        self._parked: set = set()
+        #: disconnect evict-drops awaiting the owner's journaled ack —
+        #: a reconnect must NOT re-route until the ack lands (the same
+        #: exactly-one-owner discipline as migration: routing before the
+        #: old owner provably released could run the user on two hosts)
+        self._evict_pending: set = set()
+        #: journaled-but-unrouted arrivals (routing deferred while an
+        #: admission hold is active)
+        self._unrouted: list = []
+        self.disconnects = 0
+        self.reconnects = 0
+        # -- burn-rate admission hold (hold_on_burn): end-to-end
+        # latency samples from transcribed admit→finish pairs feed the
+        # slo_headroom burn detector; a sustained burn journals one
+        # remedy record and defers routing.  All liveness-only state —
+        # replay never reads it.
+        self._admit_t: dict = {}
+        self._lat: dict = collections.defaultdict(
+            lambda: collections.deque(maxlen=HOLD_WINDOW))
+        self._burn_hot: dict = {}
+        self._hold_last: float | None = None
+        self._hold_until: float | None = None
+        self.holds = 0
 
     # -- lifecycle ---------------------------------------------------------
 
     def run(self, user_ids, spawn, *, classes: dict | None = None,
-            pools: dict | None = None) -> dict:
+            pools: dict | None = None, keep_open: bool = False) -> dict:
         """Serve ``user_ids`` across the worker fleet; returns a summary
         dict.  ``spawn(host_id) -> Popen``-like launches one worker
         process (the CLI re-execs itself with ``--fabric-worker``; tests
@@ -493,12 +572,21 @@ class FabricCoordinator:
         co-locate so stacked dispatches stay full per host.  Without
         pools, placement degrades to least-loaded.
 
+        ``keep_open=True`` turns the run into a LIVE SERVICE: the fleet
+        spawns even with zero initial users, producers feed it through
+        :meth:`submit` / :meth:`disconnect` from other threads (the
+        trace-driver surface), and the loop only exits once
+        :meth:`close_intake` was called and everything resolved — the
+        fabric sibling of ``FleetServer.serve(keep_open=True)``.
+
         Any escaping ``BaseException`` (injected coordinator kill,
         Ctrl-C) SIGKILLs every worker first — mirroring the orphan-exit
         the workers would perform themselves on a real coordinator death
         — and leaves all recovery state durable in the journal."""
         os.makedirs(self.fabric_dir, exist_ok=True)
         self._spawn_fn = spawn
+        with self._intake_lock:  # a pre-run close_intake stays closed
+            self._intake_open = keep_open and not self._intake_closed
         st = self.journal.state
         if st.last:
             self.report.event(
@@ -538,7 +626,7 @@ class FabricCoordinator:
                 self._ctl("ctl.drain_done", key=rec["seq"], host=hid,
                           startup=True)
         try:
-            if pending:  # nothing unresolved → no workers to spawn
+            if pending or keep_open:  # a live service spawns up front
                 for host_id in self._initial_fleet():
                     self._spawn_host(host_id, spawn)
                 # (re)route every unresolved user AS ONE BATCH: prior-run
@@ -547,17 +635,20 @@ class FabricCoordinator:
                 # ahead of the queue, and the batch planner folds each
                 # placement into the next decision's load/bucket view so
                 # same-bucket users co-locate with each other
-                self._route_batch(pending)
-            while self._unresolved:
+                if pending:
+                    self._route_batch(pending)
+            while self._unresolved or self._intake_live():
                 if self.preemption is not None \
                         and self.preemption.requested:
                     self._preempt_drain()
+                self._pump_intake()
                 for h in list(self.hosts.values()):
                     if h.alive:
                         self._transcribe(h)
                         self._transcribe_spans(h)
                 self._check_hosts()
-                if not self._unresolved:
+                self._pump_hold()
+                if not self._unresolved and not self._intake_live():
                     break
                 if self.config.elastic:
                     self._adopt_operator_hosts()
@@ -587,6 +678,230 @@ class FabricCoordinator:
             self._kill_all()
             raise
         return self._summary()
+
+    # -- live intake (the trace-driver producer surface) -------------------
+
+    def submit(self, user, *, cls: str | None = None,
+               pool: int | None = None) -> None:
+        """Thread-safe live submission (``run(..., keep_open=True)``):
+        park one arrival in the bounded intake for the coordinator
+        thread to journal and route on its next poll.  Raises
+        ``QueueFull`` at ``intake_max`` (the producer must back off —
+        the same backpressure contract as ``FleetServer.submit``) and
+        ``QueueClosed`` once :meth:`close_intake` was called."""
+        uid = str(user)
+        with self._intake_lock:
+            if self._intake_closed:
+                raise QueueClosed(
+                    "fabric intake is closed; stop submitting")
+            if not self._intake_open:
+                # the producer beat run() to its first event: the
+                # intake opens on the coordinator thread — back off
+                # exactly as at the bound
+                raise QueueFull(
+                    "fabric intake is not open yet (run(..., "
+                    "keep_open=True) opens it); retry")
+            if len(self._intake) >= self.config.intake_max:
+                raise QueueFull(
+                    f"fabric intake is at its bound "
+                    f"({self.config.intake_max}); retry after the "
+                    "coordinator pumps")
+            self._intake.append(
+                ("submit", uid, cls, int(pool) if pool else None))
+
+    def disconnect(self, user) -> None:
+        """Thread-safe live disconnect: the user's session is released
+        at its next step boundary (workspace kept at its last committed
+        generation) and the user PARKS — still journaled, still owed a
+        result, but not scheduled — until a later :meth:`submit` of the
+        same id reconnects it, resuming from the workspace (the journal
+        re-admission path).  Users still away at :meth:`close_intake`
+        are re-admitted automatically so the run drains to zero loss."""
+        uid = str(user)
+        with self._intake_lock:
+            if self._intake_closed:
+                raise QueueClosed("fabric intake is closed")
+            if not self._intake_open:
+                raise QueueFull("fabric intake is not open yet; retry")
+            self._intake.append(("disconnect", uid))
+
+    def close_intake(self) -> None:
+        """No further submissions; the run exits once every accepted
+        user resolves.  Idempotent, callable from any thread."""
+        with self._intake_lock:
+            self._intake_open = False
+            self._intake_closed = True
+
+    def _intake_live(self) -> bool:
+        with self._intake_lock:
+            return self._intake_open or bool(self._intake)
+
+    def _pump_intake(self) -> None:
+        """Drain the producer intake on the coordinator thread: journal
+        fresh arrivals (the journal's record wins for users it has seen
+        — restart keeps first-submit classes), unpark reconnects, apply
+        disconnects, then route the round AS ONE BATCH — deferred to
+        ``_unrouted`` while an admission hold is active."""
+        with self._intake_lock:
+            ops, self._intake = self._intake, []
+            open_ = self._intake_open
+        if not ops and not (not open_ and self._parked):
+            return
+        st = self.journal.state
+        fresh: list = []
+        for op in ops:
+            if op[0] == "disconnect":
+                self._disconnect(op[1])
+                continue
+            _, u, cls, pool = op
+            if u in st.finished:
+                self.report.event("skip_done", user=u)
+                continue
+            if u in self.poison or u in st.poisoned:
+                self.report.event("skip_poisoned", user=u)
+                continue
+            if u in self._parked:
+                # the reconnect: resume scheduling from the workspace.
+                # Routing waits for a still-pending evict ack (the
+                # exactly-one-owner discipline) — the ack handler
+                # routes the moment the old owner provably released.
+                self._parked.discard(u)
+                self.reconnects += 1
+                self.report.event("reconnect", user=u)
+                if u not in self._evict_pending:
+                    fresh.append(u)
+                continue
+            if u in self._unresolved:
+                continue  # duplicate submit: already live
+            if st.last.get(u) in (None, "unpoison"):
+                fields = {}
+                c = st.classes.get(u) or cls
+                if c:
+                    fields["cls"] = c
+                p = st.pools.get(u) or pool
+                if p:
+                    fields["pool"] = int(p)
+                self.journal.append("enqueue", u, **fields)
+                self.report.event("enqueue", user=u,
+                                  depth=len(self._unresolved) + 1)
+            self._submitted.append(u)
+            self._unresolved.add(u)
+            fresh.append(u)
+        if not open_ and self._parked:
+            # intake closed with users still away: no reconnect is
+            # coming — re-admit them so their journaled work finishes
+            # (the zero-loss drain; a real service would expire them)
+            for u in sorted(self._parked):
+                self.report.event("reconnect", user=u, forced=True)
+                if u not in self._evict_pending:
+                    fresh.append(u)
+            self._parked.clear()
+        fresh = [u for u in fresh if u in self._unresolved]
+        if not fresh:
+            return
+        if self._hold_until is not None:
+            self._unrouted.extend(fresh)
+        else:
+            self._route_batch(fresh)
+
+    def _disconnect(self, u: str) -> None:
+        """Apply one disconnect on the coordinator thread: park the
+        user and ask its owner to release at the next step boundary
+        (the evict drop — acked, so a reconnect can never race the
+        release into two owners).  A user mid-migration/fence keeps its
+        in-flight verb — one ack-gated verb at a time."""
+        if u not in self._unresolved or u in self._parked:
+            return  # unknown, resolved, or already away
+        if u in self._migrating or u in self._fencing:
+            return  # its current verb's ack supersedes; nothing to park
+        self._parked.add(u)
+        self.disconnects += 1
+        self.report.event("disconnect", user=u)
+        hid = self.journal.state.assigned.get(u)
+        h = self.hosts.get(hid) if hid is not None else None
+        if h is not None and h.alive:
+            self._evict_pending.add(u)
+            h.assign.append({"drop": u, "evict": True})
+
+    # -- burn-rate admission hold (hold_on_burn) ---------------------------
+
+    def _class_p95s(self) -> dict:
+        """Observed end-to-end p95 per class over the rolling latency
+        window (transcribed admit→finish pairs)."""
+        out = {}
+        for cls, dq in self._lat.items():
+            if dq:
+                xs = sorted(dq)
+                out[cls] = xs[min(len(xs) - 1,
+                                  max(0, int(0.95 * len(xs))))]
+        return out
+
+    def _pump_hold(self) -> None:
+        """One burn-detector round (``hold_on_burn``): when a class's
+        observed p95 has burned past ``BURN_FRAC`` of its SLO target
+        CONTINUOUSLY for ``remedy_hold_s`` (same hysteresis kernel as
+        the skew remedy) and the cooldown elapsed, journal one
+        ``remedy`` record (action ``admission_hold``; the
+        ``fabric.remedy`` fault point fires first — a kill leaves no
+        record and the restart re-times the burn) and DEFER ROUTING of
+        new arrivals for ``admission_hold_s``.  Arrivals stay journaled
+        (durability is never deferred); only placement waits.  Acting
+        REARMS the watcher's ``slo_headroom`` key so a re-risen burn
+        fires a fresh alert event."""
+        from consensus_entropy_tpu.obs import alerts as alerts_mod
+
+        cfg = self.config
+        if not cfg.hold_on_burn:
+            return
+        now = self._clock()
+        if self._hold_until is not None and now >= self._hold_until:
+            self._hold_until = None
+            if self._unrouted:
+                batch = [u for u in self._unrouted
+                         if u in self._unresolved
+                         and u not in self._parked]
+                self._unrouted = []
+                if batch:
+                    self._route_batch(batch)
+        slo = {"interactive": cfg.slo_interactive_s,
+               "batch": cfg.slo_batch_s}
+        burning = {a["cls"] for a in alerts_mod.slo_headroom_alerts(
+            self._class_p95s(), slo)}
+        for cls in list(self._burn_hot):
+            if cls not in burning:
+                del self._burn_hot[cls]  # burn cleared: re-time
+        for cls in sorted(burning):
+            self._burn_hot.setdefault(cls, now)
+        if self._hold_until is not None:
+            return  # one hold at a time
+        if not remedy_mod.cooldown_ok(self._hold_last, now,
+                                      cooldown_s=cfg.remedy_cooldown_s):
+            return
+        due = sorted(cls for cls, t0 in self._burn_hot.items()
+                     if remedy_mod.remedy_due(t0, now,
+                                              hold_s=cfg.remedy_hold_s))
+        if not due:
+            return
+        cls = due[0]
+        # a kill here models dying between the hold decision and its
+        # journal record: nothing was deferred (arrivals are journaled
+        # either way), the restart re-times the burn — dispositions
+        # replay identically because a remedy record is audit-only
+        faults.fire("fabric.remedy", host="fleet", action="admission_hold")
+        rec = self.journal.append("remedy", host="fleet",
+                                  action="admission_hold", cls=cls,
+                                  hold_s=float(cfg.admission_hold_s))
+        self.holds += 1
+        self._hold_last = now
+        self._hold_until = now + cfg.admission_hold_s
+        self._burn_hot.pop(cls, None)
+        self.report.event("admission_hold",
+                          window_s=float(cfg.admission_hold_s), cls=cls)
+        self._ctl("ctl.remedy", key=rec["seq"], host="fleet",
+                  action="admission_hold", cls=cls)
+        if self.alerts is not None:
+            # acting on the alert CONSUMES it (the rearm discipline)
+            self.alerts.rearm("slo_headroom", cls)
 
     def _initial_fleet(self) -> list:
         """The host ids this run stands up.  Elastic restarts replay the
@@ -1052,6 +1367,13 @@ class FabricCoordinator:
         out = alerts_mod.lease_alerts(lease_ages, self.config.lease_s)
         out += alerts_mod.skew_alerts(
             self._live_loads(), max_skew=self.config.remedy_skew)
+        if self.config.hold_on_burn:
+            # the burn detector's view rides the SAME composed list (the
+            # snapshot-based watcher would otherwise drop these keys)
+            out += alerts_mod.slo_headroom_alerts(
+                self._class_p95s(),
+                {"interactive": self.config.slo_interactive_s,
+                 "batch": self.config.slo_batch_s})
         return out
 
     def _live_loads(self) -> dict:
@@ -1280,6 +1602,12 @@ class FabricCoordinator:
             self._fencing.pop(u, None)
             self._fence_t.pop(u, None)
             self._fence_fallback.pop(u, None)
+            # a parked (disconnected) victim is re-admitted by the
+            # failover itself — the owner that was releasing it is dead,
+            # so the pending evict ack will never come; resuming on a
+            # survivor is exactly what the journal prescribes
+            self._parked.discard(u)
+            self._evict_pending.discard(u)
         # the WHOLE victim set is placed as one plan (in-flight first,
         # then queued — assigned_to's order): each placement folds into
         # the next decision's load/bucket view, so two same-bucket
@@ -1472,10 +1800,19 @@ class FabricCoordinator:
             if ev == "admit":
                 self.journal.append("admit", u, host=h.host_id,
                                     src_off=off)
+                # burn-detector sample start (liveness-only telemetry;
+                # replay never reads it)
+                self._admit_t.setdefault(u, self._clock())
             elif ev == "finish":
                 self.journal.append("finish", u, host=h.host_id,
                                     src_off=off)
+                t_admit = self._admit_t.pop(u, None)
+                if t_admit is not None:
+                    self._lat[self.journal.state.classes.get(
+                        u, "batch")].append(self._clock() - t_admit)
                 self._unresolved.discard(u)
+                self._parked.discard(u)
+                self._evict_pending.discard(u)
                 self._migrating.pop(u, None)
                 self._fencing.pop(u, None)
                 self._fence_t.pop(u, None)
@@ -1489,6 +1826,8 @@ class FabricCoordinator:
                     self.poison.add(u, error=str(rec.get("error")),
                                     attempts=int(rec.get("attempts") or 0))
                 self._unresolved.discard(u)
+                self._parked.discard(u)
+                self._evict_pending.discard(u)
                 self.report.event("user_poisoned", user=u,
                                   host=h.host_id)
             elif ev == "fail":
@@ -1504,6 +1843,8 @@ class FabricCoordinator:
                     # same as the single-host journal semantics
                     self._failed.add(u)
                     self._unresolved.discard(u)
+                    self._parked.discard(u)
+                    self._evict_pending.discard(u)
                     self.report.event("user_failed_final", user=u,
                                       host=h.host_id,
                                       error=rec.get("error"))
@@ -1531,6 +1872,19 @@ class FabricCoordinator:
                 # (this drop, or the racing checkpoint fence) clears the
                 # fallback entry; the loser's ack is then cursor-only
                 self._fence_fallback.pop(u, None)
+                if u in self._evict_pending:
+                    # the DISCONNECT evict ack: the old owner provably
+                    # released (or never held) the user — a reconnect
+                    # that already arrived may now route; a still-parked
+                    # user waits for its reconnect (or the close-time
+                    # re-admission)
+                    self._evict_pending.discard(u)
+                    if u not in self._parked and u in self._unresolved:
+                        if self._hold_until is not None:
+                            self._unrouted.append(u)
+                        else:
+                            self._assign(u)
+                    continue
                 if target is None:
                     continue
                 if rec.get("ok") and u in self._unresolved:
@@ -1708,6 +2062,11 @@ class FabricCoordinator:
             "fencing": len(self._fencing),
             "draining_host": self._draining_host,
             "edges": list(self._fleet_edges()) or None,
+            "holds": self.holds,
+            "hold_active": self._hold_until is not None,
+            "parked": len(self._parked),
+            "disconnects": self.disconnects,
+            "reconnects": self.reconnects,
         }
         if self.fleet_planner is not None:
             payload["fleet_planner"] = self.fleet_planner.summary()
@@ -1734,6 +2093,9 @@ class FabricCoordinator:
             "fences": self.fences,
             "remedies": self.remedies,
             "fence_timeouts": self.fences_timed_out,
+            "holds": self.holds,
+            "disconnects": self.disconnects,
+            "reconnects": self.reconnects,
             "compactions": self.journal.compactions,
             "hosts": {hid: ("drained" if h.draining and not h.alive
                             else "revoked" if not h.alive else "closed")

@@ -40,8 +40,12 @@ from __future__ import annotations
 import os
 import threading
 import time
+import zlib
+
+import numpy as np
 
 from consensus_entropy_tpu.resilience import faults
+from consensus_entropy_tpu.resilience.retry import backoff_delay
 from consensus_entropy_tpu.serve.journal import AdmissionJournal, JsonlTail
 from consensus_entropy_tpu.serve.server import (
     FleetServer,
@@ -187,6 +191,10 @@ def run_worker(fabric_dir: str, host_id: str, *, build_entry, scheduler,
                          journal=journal, status=status, alerts=alerts)
     feed = JsonlTail(paths["assign"])
     stop = threading.Event()
+    # QueueFull-retry jitter stream, seeded per host (crc32, not hash():
+    # stable across processes so a replayed fabric run backs off on the
+    # same schedule on every host)
+    retry_rng = np.random.default_rng(zlib.crc32(str(host_id).encode()))
 
     def intake():
         """Tail the assignment feed into the server's admission queue;
@@ -266,12 +274,21 @@ def run_worker(fabric_dir: str, host_id: str, *, build_entry, scheduler,
                     # the coordinator routed the priority class along
                     # with the user (serve.planner classes)
                     entry.priority = rec["cls"]
+                attempt = 0
                 while not stop.is_set():
                     try:
                         server.submit(entry)
                         break
                     except QueueFull:
-                        stop.wait(poll_s)  # backpressure: retry
+                        # backpressure: seeded-jitter exponential backoff
+                        # (per-host stream) instead of a fixed period, so
+                        # a fleet of saturated workers' producers don't
+                        # re-poll the bound in lockstep
+                        stop.wait(backoff_delay(attempt,
+                                                base_delay=poll_s,
+                                                max_delay=20 * poll_s,
+                                                rng=retry_rng))
+                        attempt += 1
                     except (QueueClosed, RuntimeError):
                         return  # draining: the rerun picks the user up
             stop.wait(poll_s)

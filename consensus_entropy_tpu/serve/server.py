@@ -244,10 +244,25 @@ class AdmissionQueue:
     ``target_live - sum(reserves)`` slots while reserved classes wait
     (the aging guard bounds queue ORDER; the reserve bounds SLOT
     occupancy — starvation bound: a batch waiter admits within one slot
-    turnover instead of ``aging_s``)."""
+    turnover instead of ``aging_s``).
+
+    ``bound_reserve`` (``{class: queue_slots}``): per-class shares of
+    the QUEUE BOUND itself — a class's :meth:`put` fails once the queue
+    holds ``maxsize`` minus the other classes' UNMET bound reservations.
+    Without it, a never-stopping higher-priority producer stream fills
+    all ``maxsize`` slots and lower-class producers get ``QueueFull``
+    forever, so the aging guard never even SEES a lower-class head to
+    promote — starvation moved from the pop order (fixed by aging) to
+    the bound.  ``None`` (the default) keeps the class-blind bound.
+
+    ``clock`` injects the timestamp source the aging guard and
+    :meth:`head_waits` measure with (default ``time.perf_counter``) —
+    compressed-time soak tests age entries without real waiting."""
 
     def __init__(self, maxsize: int, *, classes=PRIORITY_CLASSES,
-                 aging_s: float = 0.0, reserve: dict | None = None):
+                 aging_s: float = 0.0, reserve: dict | None = None,
+                 bound_reserve: dict | None = None,
+                 clock=time.perf_counter):
         self.maxsize = maxsize
         self.classes = tuple(classes)
         if not self.classes:
@@ -255,6 +270,14 @@ class AdmissionQueue:
         self.aging_s = aging_s
         self.reserve = {cls: int(n) for cls, n in (reserve or {}).items()
                         if cls in self.classes and int(n) > 0}
+        self.bound_reserve = {
+            cls: int(n) for cls, n in (bound_reserve or {}).items()
+            if cls in self.classes and int(n) > 0}
+        if sum(self.bound_reserve.values()) >= maxsize:
+            raise ValueError(
+                f"bound_reserve {self.bound_reserve} must leave at "
+                f"least one unreserved queue slot of {maxsize}")
+        self._clock = clock
         self._q: dict[str, collections.deque] = {
             cls: collections.deque() for cls in self.classes}
         self._cond = threading.Condition()
@@ -283,20 +306,29 @@ class AdmissionQueue:
         with self._cond:
             return self._closed
 
+    def _bound_for(self, cls: str) -> int:
+        """The queue-slot count ``cls`` may fill: ``maxsize`` minus the
+        OTHER classes' unmet bound reservations (a reservation already
+        covered by queued entries restricts nobody)."""
+        held = sum(max(0, n - len(self._q[c]))
+                   for c, n in self.bound_reserve.items() if c != cls)
+        return self.maxsize - held
+
     def put(self, entry: FleetUser) -> int:
         """Enqueue; returns the depth AFTER.  Raises :class:`QueueFull`
-        at the bound — the caller (a producer) must back off — and
+        at the entry class's share of the bound (see ``bound_reserve``)
+        — the caller (a producer) must back off — and
         :class:`QueueClosed` once the queue closed (stop retrying)."""
         with self._cond:
             if self._closed:
                 raise QueueClosed("admission queue is closed (drain); "
                                   "stop submitting")
-            if self._total() >= self.maxsize:
+            cls = self._class_of(entry)
+            if self._total() >= self._bound_for(cls):
                 raise QueueFull(
-                    f"admission queue is at its bound ({self.maxsize}); "
-                    "retry after sessions drain")
-            self._q[self._class_of(entry)].append(
-                (entry, time.perf_counter()))
+                    f"admission queue is at its bound ({self.maxsize}) "
+                    f"for class {cls!r}; retry after sessions drain")
+            self._q[cls].append((entry, self._clock()))
             self._cond.notify_all()
             return self._total()
 
@@ -325,7 +357,7 @@ class AdmissionQueue:
         behavior (unit tests, non-slot callers)."""
         with self._cond:
             if self.aging_s > 0:
-                now = time.perf_counter()
+                now = self._clock()
                 aged = [(self._q[cls][0][1], cls)
                         for cls in self.classes[1:]
                         if self._q[cls]
@@ -370,7 +402,7 @@ class AdmissionQueue:
         classes — the SLO-headroom input of the planner's admission
         hold."""
         with self._cond:
-            now = time.perf_counter()
+            now = self._clock()
             return {cls: now - dq[0][1]
                     for cls, dq in self._q.items() if dq}
 
@@ -431,9 +463,16 @@ class FleetServer:
         # the batch-class slot share (clamped so interactive always keeps
         # at least one slot; a 1-slot engine cannot reserve anything)
         reserve = min(config.batch_reserve, config.target_live - 1)
+        # the batch share of the queue BOUND mirrors its slot share
+        # (clamped to leave an unreserved slot): a never-stopping
+        # interactive producer stream cannot fill the whole waiting room
+        # and starve batch producers at put() — without it the aging
+        # guard never sees a batch head to promote
+        bound = min(reserve, config.max_queue - 1)
         self.queue = AdmissionQueue(
             config.max_queue, aging_s=config.aging_s,
-            reserve={"batch": reserve} if reserve > 0 else None)
+            reserve={"batch": reserve} if reserve > 0 else None,
+            bound_reserve={"batch": bound} if bound > 0 else None)
         #: currently-admitted users' priority classes (uid → cls): the
         #: live composition the queue's per-class reserve pops against
         self._live_cls: dict[str, str] = {}

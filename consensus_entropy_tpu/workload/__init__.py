@@ -1,0 +1,17 @@
+"""Trace-driven load generation and soak grading.
+
+``trace`` decides the load shape (pure, seeded, serializable);
+``driver`` plays a trace against a serving target through the existing
+enqueue/backpressure surface; ``grade`` turns the run's durable
+artifacts into the steady-state summary.  The whole package is in the
+replay-critical lint scope: a soak must replay bit-for-bit from its
+trace file.
+"""
+
+from consensus_entropy_tpu.workload.driver import (  # noqa: F401
+    DriverStats, FabricTarget, ServerTarget, TraceDriver)
+from consensus_entropy_tpu.workload.grade import (  # noqa: F401
+    deterministic_equal, grade_run, percentile)
+from consensus_entropy_tpu.workload.trace import (  # noqa: F401
+    Trace, TraceSpec, generate, load, save, spec_from_meta,
+    trace_digest, validate_records)

@@ -81,6 +81,16 @@
 #   survivor (one deterministic trace id per user, spans from both
 #   hosts, orphan-free merge).  scripts/obs_check.sh is the companion
 #   schema/export gate.
+# - workload / soak (tests/test_workload.py): the live-fabric churn
+#   drill — a trace-driven keep-open soak where a user disconnects
+#   mid-iteration (journaled evict, workspace kept) and reconnects
+#   (journal re-admission, evict-ack gated), draining to zero loss with
+#   trajectories bit-identical to sequential.  scripts/soak_check.sh
+#   (run at the end of this matrix) is the companion gate: a
+#   compressed deterministic soak (zero loss, schema-valid streams,
+#   >= 1 slo_headroom alert fired AND graded, >= 1 journaled admission
+#   hold, parity) plus a coordinator killed MID-SOAK at fabric.remedy
+#   whose journal replay must finish every trace user exactly once.
 #
 # Extra pytest args pass through, e.g.:
 #   scripts/fault_matrix.sh -k kill_at_every_boundary
@@ -90,8 +100,9 @@ cd "$(dirname "$0")/.."
 JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
   tests/test_serve_faults.py tests/test_serve_fabric.py \
   tests/test_slo.py tests/test_elastic.py tests/test_remedy.py \
-  tests/test_acquire.py tests/test_obs.py -v -m faults \
-  -p no:cacheprovider "$@"
+  tests/test_acquire.py tests/test_obs.py tests/test_workload.py \
+  -v -m faults -p no:cacheprovider "$@"
 scripts/elastic_check.sh
 scripts/remedy_check.sh
+scripts/soak_check.sh
 echo "fault matrix passed"

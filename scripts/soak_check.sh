@@ -1,0 +1,270 @@
+#!/usr/bin/env bash
+# Soak / load-generation CI gate (ISSUE 17 tentpole; sits next to
+# remedy_check.sh and is run by scripts/fault_matrix.sh).
+#
+# LEG 1 — compressed deterministic soak: a seeded trace (poisson
+# arrivals, interactive/batch mix, cycled pool sizes, churn) is
+# generated, saved, LOADED BACK and played at 0.1x against a REAL
+# 2-host keep-open fabric with the burn-rate admission hold armed on a
+# deliberately tight interactive SLO.  Asserted:
+#   1. zero user loss (journal dispositions) + schema-valid journal and
+#      metrics streams, graded through workload.grade,
+#   2. at least one slo_headroom alert FIRED (schema-valid `alert`
+#      event in a metrics stream) and GRADED (alert counts),
+#   3. at least one journaled admission hold (`remedy` record, action
+#      admission_hold) and at least one churn disconnect,
+#   4. per-user parity vs unfaulted sequential baselines,
+#   5. the cetpu-soak CLI round-trips: `digest` pins the trace file,
+#      `grade` exits 0 over the finished run directory.
+#
+# LEG 2 — coordinator killed MID-SOAK at the ``fabric.remedy`` fault
+# point (which fires BEFORE the hold decision journals, so the kill
+# leaves no half-journaled remedy): the driver is stopped, the journal
+# replayed by a fresh coordinator which re-admits every trace user, and
+# the rerun must finish EVERY user EXACTLY ONCE across every host's
+# results file — still bit-identical to sequential.
+#
+# Extra args are NOT accepted: this is a pass/fail gate, not a bench.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'PY'
+import glob
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, ".")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+from tests.fabric_workload import (
+    make_cfg,
+    sequential_baselines,
+    sizes_arg,
+    user_specs,
+)
+
+from consensus_entropy_tpu.cli.soak import main as soak_main
+from consensus_entropy_tpu.fleet import FleetReport
+from consensus_entropy_tpu.obs import export
+from consensus_entropy_tpu.obs.alerts import AlertWatcher
+from consensus_entropy_tpu.obs.status import StatusWriter
+from consensus_entropy_tpu.resilience import faults as faults_mod
+from consensus_entropy_tpu.resilience.faults import FaultRule, InjectedKill
+from consensus_entropy_tpu.serve import (
+    AdmissionJournal,
+    FabricConfig,
+    FabricCoordinator,
+    validate_journal_file,
+)
+from consensus_entropy_tpu.serve.hosts import fabric_paths
+from consensus_entropy_tpu.workload import (
+    FabricTarget,
+    TraceDriver,
+    TraceSpec,
+    generate,
+    grade_run,
+    load,
+    save,
+)
+
+N_USERS = 6
+cfg = make_cfg("mc", epochs=2)
+# cycled 30/100 pools: the known-trainable sizing every fabric gate
+# uses, and the skewed two-bucket shape the planner sketch sees
+specs = user_specs(N_USERS, sizes=[30, 100])
+root = tempfile.mkdtemp(prefix="soak_check_")
+seq = sequential_baselines(root, cfg, specs)
+
+# a 60-virtual-second trace played at 0.1x (the compressed clock); the
+# seed scan (deterministic — first hit wins) guarantees the class mix
+# actually drew both classes, so the tight interactive SLO has users to
+# burn on and the batch lane stays populated
+spec = None
+for seed in range(5, 105):
+    cand = TraceSpec(
+        seed=seed, n_users=N_USERS, arrival="poisson", rate=1.0,
+        class_mix=(("interactive", 0.5), ("batch", 0.5)),
+        pool_dist="cycle", pool_sizes=(30, 100),
+        churn_frac=0.34, churn_delay_s=10.0, reconnect_s=20.0,
+        horizon_s=60.0)
+    classes = {e["cls"] for e in generate(cand).events
+               if e["kind"] == "arrive"}
+    if classes == {"interactive", "batch"}:
+        spec = cand
+        break
+assert spec is not None, "no two-class trace seed in the scan range"
+trace_path = os.path.join(root, "trace.jsonl")
+save(generate(spec), trace_path)
+tr = load(trace_path)
+pools = {e["user"]: e["pool"] for e in tr.events
+         if e["kind"] == "arrive"}
+cls_of = {e["user"]: e["cls"] for e in tr.events
+          if e["kind"] == "arrive"}
+SLO = {"interactive": 0.5, "batch": 600.0}
+
+
+def fabric_cfg():
+    # the tight interactive SLO: real AL users take seconds end to
+    # end, so the burn detector MUST arm and the hold MUST fire
+    return FabricConfig(hosts=2, lease_s=5.0, hold_on_burn=True,
+                        admission_hold_s=0.5, remedy_hold_s=0.3,
+                        remedy_cooldown_s=3.0,
+                        slo_interactive_s=SLO["interactive"],
+                        slo_batch_s=SLO["batch"])
+
+
+def make_spawn(fdir, ws):
+    def spawn(host_id):
+        log = open(fabric_paths(fdir, host_id)["log"], "ab")
+        env = {**os.environ, "PYTHONPATH": ".",
+               "CETPU_FABRIC_METRICS": "1"}
+        env.pop("CETPU_FAULTS", None)
+        try:
+            return subprocess.Popen(
+                [sys.executable, "tests/fabric_worker.py", fdir,
+                 host_id, ws, cfg.mode, str(cfg.epochs), str(N_USERS),
+                 "5.0", "2", sizes_arg(specs)],
+                stdout=log, stderr=subprocess.STDOUT, env=env)
+        finally:
+            log.close()
+    return spawn
+
+
+def check_parity_and_owners(fdir, label):
+    jp = os.path.join(fdir, "serve_journal.jsonl")
+    bad = validate_journal_file(jp)
+    for wal in sorted(glob.glob(os.path.join(fdir, "events_*.jsonl"))):
+        bad += validate_journal_file(wal)
+    assert bad == [], "journal violations:\n" + "\n".join(bad[:10])
+    rows = {}
+    for fname in sorted(os.listdir(fdir)):
+        if fname.startswith("results_") and fname.endswith(".jsonl"):
+            for rec in export.read_jsonl_tolerant(
+                    os.path.join(fdir, fname)):
+                rows.setdefault(rec["user"], []).append(rec)
+    for _, uid, _ in specs:
+        assert len(rows.get(uid, [])) == 1, (label, uid, rows.get(uid))
+        assert rows[uid][0]["error"] is None, (label, uid)
+        assert rows[uid][0]["result"]["trajectory"] \
+            == seq[uid]["trajectory"], (label, uid)
+
+
+# ---- LEG 1: the compressed soak ---------------------------------------
+fdir1 = os.path.join(root, "fabric_soak")
+ws1 = os.path.join(root, "ws_soak")
+os.makedirs(fdir1)
+os.makedirs(ws1)
+jp1 = os.path.join(fdir1, "serve_journal.jsonl")
+journal = AdmissionJournal(jp1)
+report = FleetReport(os.path.join(fdir1, "fleet_metrics_fleet.jsonl"))
+# the StatusWriter matters: alert evaluation runs on the status-write
+# path, so without it the watcher never emits the slo_headroom event
+coord = FabricCoordinator(
+    journal, fdir1, fabric_cfg(), report=report,
+    alerts=AlertWatcher(report),
+    status=StatusWriter(os.path.join(fdir1, "status"), "coordinator",
+                        interval_s=0.2))
+driver = TraceDriver(tr, FabricTarget(coord), time_scale=0.1,
+                     backoff_seed=3)
+driver.start()
+try:
+    summary = coord.run([], make_spawn(fdir1, ws1), keep_open=True)
+finally:
+    assert driver.join(timeout=120.0), "trace driver wedged"
+    journal.close()
+    report.close()
+
+g = grade_run(fdir1, journal_path=jp1, trace=tr, slo_s=SLO,
+              driver_stats=driver.stats.as_dict())
+det = g["deterministic"]
+assert det["zero_loss"], det["lost_users"]
+assert det["journal_ok"], g["measured"]["journal_errors"]
+assert det["stream_ok"], g["measured"]["stream_errors"]
+assert summary["holds"] >= 1, summary
+assert summary["disconnects"] >= 1, summary
+assert g["measured"]["alerts"].get("slo_headroom", 0) >= 1, \
+    g["measured"]["alerts"]
+hold_recs = [r for r in export.read_jsonl_tolerant(jp1)
+             if r.get("event") == "remedy"
+             and r.get("action") == "admission_hold"]
+assert hold_recs, "no journaled admission hold"
+check_parity_and_owners(fdir1, "soak")
+print(f"soak_check: compressed soak drained clean — "
+      f"{det['finished']}/{N_USERS} finished, holds={summary['holds']}, "
+      f"disconnects={summary['disconnects']}, "
+      f"alerts={g['measured']['alerts']}, parity exact")
+
+# the CLI round-trip: digest pins the trace, grade gates the run dir
+assert soak_main(["digest", trace_path]) == 0
+assert soak_main(["grade", fdir1, "--journal", jp1, "--trace",
+                  trace_path, "--slo",
+                  "interactive=0.5,batch=600"]) == 0
+print("soak_check: cetpu-soak digest + grade ok")
+
+# ---- LEG 2: coordinator killed mid-soak at fabric.remedy --------------
+fdir2 = os.path.join(root, "fabric_kill")
+ws2 = os.path.join(root, "ws_kill")
+os.makedirs(fdir2)
+os.makedirs(ws2)
+jp2 = os.path.join(fdir2, "serve_journal.jsonl")
+journal2 = AdmissionJournal(jp2)
+coord2 = FabricCoordinator(journal2, fdir2, fabric_cfg(),
+                           report=FleetReport())
+driver2 = TraceDriver(tr, FabricTarget(coord2), time_scale=0.1,
+                      backoff_seed=3)
+killed = False
+driver2.start()
+try:
+    try:
+        with faults_mod.inject(FaultRule("fabric.remedy", "kill", at=1)):
+            coord2.run([], make_spawn(fdir2, ws2), keep_open=True)
+    except InjectedKill:
+        killed = True
+finally:
+    driver2.stop()
+    driver2.join(timeout=30.0)
+    journal2.close()
+assert killed, "fabric.remedy never fired mid-soak"
+# the in-process kill leaves the dead coordinator's per-host WAL
+# handles (and their single-writer flocks) open — release them so the
+# rerun coordinator can take the locks, then drop the object
+for h in coord2.hosts.values():
+    h.assign.close()
+    h.tail.close()
+    if h.span_tail is not None:
+        h.span_tail.close()
+del coord2
+# fired-before-append: the killed hold never reached the journal
+assert [r for r in export.read_jsonl_tolerant(jp2)
+        if r.get("event") == "remedy"] == []
+
+# the rerun: replay the journal AND re-admit every trace user (arrivals
+# the dead intake swallowed were never journaled).  Users the killed
+# incarnation already finished replay as terminal — the rerun must
+# finish EXACTLY the complement, and the ownership check below proves
+# nobody ran twice across the two incarnations.
+done_before = {
+    u for u, d in grade_run(fdir2, journal_path=jp2, trace=tr)
+    ["deterministic"]["dispositions"].items() if d == "finish"}
+journal3 = AdmissionJournal(jp2)
+try:
+    summary3 = FabricCoordinator(journal3, fdir2, fabric_cfg(),
+                                 report=FleetReport()).run(
+        tr.users, make_spawn(fdir2, ws2),
+        classes=cls_of, pools=pools)
+finally:
+    journal3.close()
+assert sorted(summary3["finished"]) \
+    == sorted(set(tr.users) - done_before), (summary3, done_before)
+check_parity_and_owners(fdir2, "kill")
+g2 = grade_run(fdir2, journal_path=jp2, trace=tr)
+assert g2["deterministic"]["zero_loss"], g2["deterministic"]
+print(f"soak_check: kill@fabric.remedy mid-soak replayed clean — "
+      f"{N_USERS} users finished exactly once, parity exact")
+PY
+echo "soak check passed"
