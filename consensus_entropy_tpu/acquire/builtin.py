@@ -112,7 +112,9 @@ class RandomBaseline(AcquisitionStrategy):
     def fused_inputs(self, acq, member_probs=None, *, rand_key=None):
         if rand_key is None:
             acq._rand_key, rand_key = jax.random.split(acq._rand_key)
-        return "rand_fused", (rand_key, acq.device_masks().pool_mask)
+        # _feed_key: replicated mesh feed; identity when unsharded
+        return "rand_fused", (acq._feed_key(rand_key),
+                              acq.device_masks().pool_mask)
 
     def extract_queries(self, acq, res) -> list:
         return acq._ids(res)

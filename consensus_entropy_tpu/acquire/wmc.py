@@ -42,7 +42,7 @@ class WeightedMachineConsensus(AcquisitionStrategy):
     def fused_inputs(self, acq, member_probs=None, *, rand_key=None):
         staged, w = self._staged(acq, member_probs)
         return "wmc_fused", (staged, acq.device_masks().pool_mask,
-                             jnp.asarray(w))
+                             acq._feed_repl(jnp.asarray(w)))
 
     @staticmethod
     def _staged(acq, member_probs):

@@ -130,9 +130,10 @@ class Acquirer:
         #: bit-identical to the two-call arm (pinned by
         #: ``tests/test_fused_step.py``); ``False`` (``--no-fuse-step``)
         #: keeps the host-round-trip path — the breaker/fallback arm.
-        #: Mesh committees keep the unfused path: the sharded fns carry
-        #: per-operand placements the donated twins don't model.
-        self.fuse_step = fuse_step and mesh is None
+        #: Mesh committees run it too: ``parallel.pool_mesh`` compiles
+        #: the ``*_fused`` graphs with pool-axis shardings and donation
+        #: intact, so the device twins live sharded across the mesh.
+        self.fuse_step = fuse_step
         #: the registered strategy this acquirer delegates mode behavior to
         self.strategy = acquire.get(mode)
         #: per-member reliability weights ((M,) float32, committee order of
@@ -167,12 +168,12 @@ class Acquirer:
             self._fns = scoring.make_scoring_fns(k=queries,
                                                  tie_break=tie_break)
         else:
-            from consensus_entropy_tpu.parallel.sharding import (
-                make_sharded_scoring_fns,
+            from consensus_entropy_tpu.parallel.pool_mesh import (
+                make_sharded_step_fns,
             )
 
-            self._fns = make_sharded_scoring_fns(mesh, k=queries,
-                                                 tie_break=tie_break)
+            self._fns = make_sharded_step_fns(mesh, k=queries,
+                                              tie_break=tie_break)
         self._rand_key = jax.random.key(seed)
         #: the device-resident pool state (masks adopted from each fused
         #: step's in-graph update; probs scatter buffer; hc tables)
@@ -307,8 +308,15 @@ class Acquirer:
         instead of the full ``(M, n_pad, C)`` padded table.  With the masks
         device-resident, that live block is the iteration's ONLY
         bulk host→device transfer.
+
+        Fused MESH arm: same live-block staging, but the persistent
+        buffer lives POOL-SHARDED across the mesh and the scatter is the
+        sharded donated variant (``parallel.pool_mesh``) — each chip
+        writes only the rows landing in its shard.
         """
         if self._mesh is not None:
+            if self.fuse_step and isinstance(member_probs, np.ndarray):
+                return self._staged_probs_mesh(member_probs)
             return self._feed(self.pad_probs(member_probs), 1)
         if isinstance(member_probs, np.ndarray):
             if not self.fuse_step:
@@ -342,6 +350,37 @@ class Acquirer:
             member_probs.astype(jnp.float32))
         return self.device.probs
 
+    def _staged_probs_mesh(self, member_probs: np.ndarray):
+        """The fused-mesh half of :meth:`_staged_probs`: host-pad the live
+        block to the fixed :meth:`staging_width`, feed it replicated, and
+        scatter it into the persistent pool-sharded buffer in place
+        (donated — ``parallel.pool_mesh.sharded_scatter_rows``)."""
+        from consensus_entropy_tpu.parallel import pool_mesh
+
+        member_probs = np.asarray(member_probs, np.float32)
+        w = self.staging_width(member_probs.shape[1])
+        if member_probs.shape[1] < w:  # host pad: fixed upload shape
+            member_probs = np.pad(
+                member_probs,
+                ((0, 0), (0, w - member_probs.shape[1]), (0, 0)))
+        self.device.h2d_bytes += member_probs.nbytes
+        self.device.h2d_ops += 1
+        m = member_probs.shape[0]
+        if self.device.probs is None or self.device.probs.shape[0] != m:
+            self.device.probs = pool_mesh.sharded_probs_buffer(
+                self._mesh, m, self.n_pad, NUM_CLASSES)
+        live = np.flatnonzero(self.pool_mask)
+        if w < len(live):
+            raise ValueError(
+                f"member_probs width {w} < {len(live)} live songs")
+        if w > len(live):
+            live = np.concatenate(  # OOB slots → scatter mode='drop'
+                [live, np.full(w - len(live), self.n_pad, live.dtype)])
+        self.device.probs = pool_mesh.sharded_scatter_rows(self._mesh)(
+            self.device.probs, self._feed_repl(live),
+            self._feed_repl(member_probs))
+        return self.device.probs
+
     def take_h2d(self) -> tuple:
         """Drain the ``(bytes, ops)`` staged onto the device since the
         last read (the probs-table uploads of :meth:`_staged_probs`) —
@@ -365,12 +404,17 @@ class Acquirer:
         if d.pool_mask is None:
             # the one-time mask upload is charged to the transfer
             # counters like any other host→device feed — the fused arm's
-            # h2d accounting must not hide its own (re)admission cost
-            d.pool_mask = jnp.asarray(self.pool_mask)
+            # h2d accounting must not hide its own (re)admission cost.
+            # Mesh: the twins materialize pool-sharded (``_feed``), so
+            # every fused dispatch consumes/returns them shard-in-place.
+            d.pool_mask = self._feed(self.pool_mask, 0) \
+                if self._mesh is not None else jnp.asarray(self.pool_mask)
             d.h2d_bytes += self.pool_mask.nbytes
             d.h2d_ops += 1
             if self.strategy.uses_hc_table:
-                d.hc_mask = jnp.asarray(self.hc_mask)
+                d.hc_mask = self._feed(self.hc_mask, 0) \
+                    if self._mesh is not None \
+                    else jnp.asarray(self.hc_mask)
                 d.h2d_bytes += self.hc_mask.nbytes
                 d.h2d_ops += 1
         return d

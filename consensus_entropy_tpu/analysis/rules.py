@@ -241,15 +241,32 @@ def check_donation_after_use(tree, ctx):
     and dies under serve load.  The only valid continuation is the
     RETURNED buffer (``finish_select`` adopts ``FusedStepResult``
     masks).  Linear over branches — a donate in one branch and a read
-    in the other flags conservatively."""
+    in the other flags conservatively.
+
+    FLOW-SENSITIVE over local rebinds: a pure alias assignment
+    (``m = mask`` / ``m = self.device.probs``) links the names, so
+    donating EITHER spends both — a read through the other spelling
+    still flags — while rebinding a name to the returned buffer (or
+    anything else) breaks only ITS link.  Rebinding the alias TARGET
+    carries the pending consumption onto the surviving alias: the old
+    name's buffer is gone, but the alias still holds the spent one."""
     findings = []
     local = _local_donated_fns(tree)
     for _scope, body in _iter_scopes(tree):
-        consumed: dict[str, int] = {}  # path -> donating line
+        consumed: dict[str, int] = {}  # canonical path -> donating line
+        aliases: dict[str, str] = {}   # name -> canonical dotted path
+
+        def canon(path):
+            """Resolve a path's leading name through the alias table
+            (alias values are stored pre-canonicalized, so one hop)."""
+            head, _, rest = path.partition(".")
+            head = aliases.get(head, head)
+            return head + ("." + rest) if rest else head
 
         def flat(node, store_paths=()):
             """Process one straight-line node: register donations, flag
-            loads of already-donated paths, then clear stores."""
+            loads of already-donated paths, then clear stores and
+            update the alias links the node's assignments create."""
             donated_args: set[int] = set()
             for call in _calls_in_order(node):
                 pos = _donated_positions(call, ctx.model, local)
@@ -260,7 +277,7 @@ def check_donation_after_use(tree, ctx):
                         path = _dotted(call.args[p])
                         if path:
                             donated_args.add(id(call.args[p]))
-                            consumed[path] = call.lineno
+                            consumed[canon(path)] = call.lineno
             if consumed:
                 flagged: set[tuple] = set()  # one per (path, line)
                 for sub in ast.walk(node):
@@ -274,23 +291,51 @@ def check_donation_after_use(tree, ctx):
                     path = _dotted(sub)
                     if path is None:
                         continue
+                    cpath0 = canon(path)
                     for cpath, at in consumed.items():
-                        if path != cpath \
-                                and not path.startswith(cpath + "."):
+                        if cpath0 != cpath \
+                                and not cpath0.startswith(cpath + "."):
                             continue
                         if (cpath, sub.lineno) in flagged:
                             continue  # mask and mask.sum are ONE read
                         flagged.add((cpath, sub.lineno))
+                        label = repr(path) if cpath0 == path else \
+                            f"{path!r} (an alias of {cpath!r})"
                         findings.append(ctx.finding(
                             "donation-after-use", sub,
-                            f"{path!r} was donated to a fused call "
+                            f"{label} was donated to a fused call "
                             f"on line {at} and is read here; use "
                             "the returned buffer instead (the "
                             "donated operand is spent)"))
             for spath in store_paths:
+                aliases.pop(spath, None)  # the rebind breaks ITS link
+                for a, v in list(aliases.items()):
+                    if v != spath and not v.startswith(spath + "."):
+                        continue
+                    # the alias outlives its rebound target: it still
+                    # references the OLD buffer, so a pending
+                    # consumption survives under the alias's own name
+                    at = consumed.get(v)
+                    if at is not None:
+                        consumed[a] = at
+                    del aliases[a]
                 for cpath in list(consumed):
                     if cpath == spath or cpath.startswith(spath + "."):
                         del consumed[cpath]
+            # pure alias assigns (no call on the value side) link AFTER
+            # the store cleared the target's previous state
+            value = getattr(node, "value", None) \
+                if isinstance(node, (ast.Assign, ast.AnnAssign)) \
+                else None
+            vpath = _dotted(value) if value is not None else None
+            if vpath:
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        cv = canon(vpath)
+                        if cv != t.id:
+                            aliases[t.id] = cv
 
         def scan(stmts):
             for stmt in stmts:

@@ -228,6 +228,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "a coordinator SIGKILL at any boundary).  "
                         "Requires --min-hosts/--max-hosts (default: "
                         "0 = never scale down)")
+    p.add_argument("--mesh-devices", default=None, metavar="N|N0,N1,...",
+                   help="fabric: chips per worker host — one int applies "
+                        "fleet-wide, a comma list gives per-host widths "
+                        "(length must equal --hosts).  Each worker serves "
+                        "with a pool-axis mesh of that width (spawned "
+                        "with --mesh K and, on CPU, K forced host "
+                        "devices), advertises it in every heartbeat, and "
+                        "devices-aware placement routes wide-pool "
+                        "buckets toward the multi-chip hosts (requires "
+                        "--hosts)")
     p.add_argument("--placement", choices=("bucket", "load"),
                    default="bucket",
                    help="fabric: cross-host routing policy — 'bucket' "
@@ -385,12 +395,15 @@ def main(argv=None) -> int:
         if n_val < 1:
             print(f"{n_flag} must be >= 1, got {n_val}")
             return 1
-        if args.distributed or args.mesh:
-            # the fleet batches by vmapping the single-device scorers; the
-            # pool-sharded fns carry per-user mesh placements that cannot
-            # be stacked — multi-host/mesh fleets are a ROADMAP open item
-            print(f"{n_flag} is single-process/single-mesh only (drop "
-                  "--distributed/--mesh)")
+        if args.distributed:
+            # mesh × users composes in-process (parallel.pool_mesh vmaps
+            # the POOL-SHARDED scorers, shardings riding the batch axis);
+            # multi-CONTROLLER fleets remain a ROADMAP open item
+            print(f"{n_flag} is single-process only (drop --distributed)")
+            return 1
+        if args.mesh == "auto":
+            print(f"{n_flag} shards pools on an explicit mesh width "
+                  "(--mesh N) — 'auto' is the sequential path's spelling")
             return 1
     if args.serve is not None and args.pad_pool_to is not None:
         print("--serve pads per bucket; use --bucket-widths instead of "
@@ -479,9 +492,29 @@ def main(argv=None) -> int:
         # HERE with the reason, not as a wedged fabric minutes in
         from consensus_entropy_tpu.serve import FabricConfig
 
+        if args.mesh_devices is not None and args.mesh:
+            print("--mesh-devices and --mesh are two spellings of the "
+                  "same fleet shape: give the fabric --mesh-devices "
+                  "(per-host) OR --mesh N (fleet-wide), not both")
+            return 1
+        mesh_devices = int(args.mesh) if args.mesh else 1
+        if args.mesh_devices is not None:
+            try:
+                parts = tuple(int(x) for x in
+                              str(args.mesh_devices).split(",")
+                              if x.strip())
+                if not parts:
+                    raise ValueError
+            except ValueError:
+                print(f"--mesh-devices must be an int or comma-separated "
+                      f"ints, got {args.mesh_devices!r}")
+                return 1
+            mesh_devices = parts[0] if len(parts) == 1 else parts
+
         try:
             args._fabric_config = FabricConfig(
                 hosts=args.hosts, lease_s=args.lease_s,
+                mesh_devices=mesh_devices,
                 min_hosts=args.min_hosts, max_hosts=args.max_hosts,
                 scale_down_s=args.scale_down_s,
                 drain_host=args.drain_host,
@@ -503,10 +536,11 @@ def main(argv=None) -> int:
             return 1
     elif args.min_hosts is not None or args.max_hosts is not None \
             or args.scale_down_s or args.drain_host is not None \
-            or args.fence_deadline_s or args.remedy:
+            or args.fence_deadline_s or args.remedy \
+            or args.mesh_devices is not None:
         print("--min-hosts/--max-hosts/--scale-down-s/--drain-host/"
-              "--fence-deadline-s/--remedy require --hosts (the "
-              "elastic fabric scales a multi-host fleet)")
+              "--fence-deadline-s/--remedy/--mesh-devices require "
+              "--hosts (the elastic fabric scales a multi-host fleet)")
         return 1
     if args.alert_sink:
         if args.no_introspection:
@@ -557,6 +591,22 @@ def main(argv=None) -> int:
                   f"{e}")
             return 1
     args._bucket_widths = bucket_widths
+
+    if args.serve is not None and args.mesh:
+        # construction-time validation of the mesh × bucket-geometry
+        # interaction (the validate_bucket_widths precedent): an edge
+        # that does not divide across the pool mesh fails HERE with the
+        # reason, not as a shard mismatch at the first dispatch
+        from consensus_entropy_tpu.serve import ServeConfig
+
+        try:
+            ServeConfig(target_live=args.serve,
+                        bucket_widths=args._bucket_widths,
+                        mesh_devices=int(args.mesh))
+        except ValueError as e:
+            print(f"--mesh {args.mesh} is invalid with this serve "
+                  f"config: {e}")
+            return 1
 
     if args.distributed:
         # must precede every other jax call (jax.distributed contract)
@@ -623,17 +673,24 @@ def main(argv=None) -> int:
               "CNN registry first")
         return 1
 
-    if args.mode == "qbdc" and args.mesh:
-        # statically known incompatibility: fail here, not minutes later at
-        # the first scoring pass (Committee.qbdc_pool_probs is single-mesh
-        # only — stack users via --fleet/--serve instead of sharding a pool)
+    if args.mode == "qbdc" and args.mesh \
+            and args.fleet is None and args.serve is None:
+        # statically known incompatibility: fail here, not minutes later
+        # at the first scoring pass (the SEQUENTIAL path threads the mesh
+        # into Committee.qbdc_pool_probs, which is single-mesh only; the
+        # fleet/serve engines shard only the scoring graphs via
+        # parallel.pool_mesh, so qbdc composes with --mesh there)
         print("--al-mode qbdc does not support --mesh (qbdc scoring is "
               "single-mesh only; use --fleet/--serve to batch users)")
         return 1
 
     mesh = None
     train_mesh = None
-    if args.mesh:
+    # the fabric COORDINATOR never scores: --mesh there names the fleet
+    # width its spawned workers force their own device counts for, so
+    # building (and device-count-validating) a local mesh would reject
+    # a perfectly good fleet shape on a 1-device coordinator
+    if args.mesh and args.hosts is None:
         import jax
 
         from consensus_entropy_tpu.parallel.mesh import (
@@ -664,11 +721,14 @@ def main(argv=None) -> int:
         else:
             mesh = make_pool_mesh(devs[:n_dev])
             print(f"Scoring mesh: {n_dev} device(s) on the pool axis")
-        if store is not None:
+        if store is not None and args.fleet is None \
+                and args.serve is None:
             # Retraining dominates the AL iteration wall-clock: give it
             # every meshed chip on the member axis (fit_many pads a
             # non-dividing committee; multi-host runs feed each process's
             # member block and replicate the winning checkpoints back).
+            # Fleet/serve engines keep CNN steps inline (sessions gate
+            # offload on mesh), so the member-axis mesh is sequential-only.
             train_mesh = make_training_mesh(dp=1, member=n_dev,
                                             devices=devs[:n_dev])
             print(f"Training mesh: {n_dev} device(s) on the member axis")
@@ -717,6 +777,10 @@ def _serve_config(args):
         target_live=args.serve,
         admit_window_s=args.admit_window_ms / 1000.0,
         bucket_widths=args._bucket_widths,
+        # numeric --mesh (auto is rejected for serve up front): the
+        # server installs the pool mesh on its scheduler, and fabric
+        # workers advertise the width in their heartbeats
+        mesh_devices=int(args.mesh) if args.mesh else 1,
         watchdog_s=args.watchdog_s,
         failure_budget=args.failure_budget,
         breaker_threshold=args.breaker_threshold,
@@ -778,6 +842,15 @@ def _run_users_fleet(args, cfg, paths, users, pool, anno, hc_table, store,
                                       "fleet_metrics.jsonl"))
     tracer = _build_tracer(args, cfg,
                            os.path.join(paths.users_dir, "spans.jsonl"))
+    mesh = None
+    if args.mesh:
+        # numeric by construction (auto is rejected for fleet up front):
+        # stack users AND shard pools — mesh × users composition
+        from consensus_entropy_tpu.parallel.pool_mesh import (
+            make_pool_mesh_for,
+        )
+
+        mesh = make_pool_mesh_for(int(args.mesh))
     scheduler = FleetScheduler(
         cfg, tie_break=args.tie_break, retrain_epochs=args.retrain_epochs,
         host_workers=args.fleet_host_workers, preemption=guard,
@@ -786,7 +859,7 @@ def _run_users_fleet(args, cfg, paths, users, pool, anno, hc_table, store,
         fuse_step=not args.no_fuse_step, tracer=tracer,
         jax_profile_dir=args.jax_profile,
         jax_profile_n=args.jax_profile_n,
-        compile_events=not args.no_introspection)
+        compile_events=not args.no_introspection, mesh=mesh)
     todo = list(users[: args.max_users])
     failed = []
     try:
@@ -1086,7 +1159,7 @@ def _run_users_fabric(args, cfg, paths, users, pool, anno, guard) -> None:
                          "--placement", "--scale-down-s", "--drain-host",
                          "--fence-deadline-s", "--remedy-hold-s",
                          "--remedy-cooldown-s", "--remedy-skew",
-                         "--alert-sink")
+                         "--alert-sink", "--mesh-devices", "--mesh")
     # value-less coordinator switches: strip the flag alone (skipping
     # the next token would eat an unrelated argument)
     coordinator_switches = ("--remedy",)
@@ -1111,13 +1184,30 @@ def _run_users_fabric(args, cfg, paths, users, pool, anno, guard) -> None:
         os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
 
     def spawn(host_id):
+        # chips-per-host: the i-th slot's width from --mesh-devices (or
+        # the fleet-wide --mesh); the worker re-exec gets --mesh K
+        # (stripped from the passthrough argv above, so per-host wins)
+        # and, for the CPU backend, K forced host devices — the XLA
+        # flag must precede jax init, which a spawn env guarantees
+        digits = "".join(ch for ch in host_id if ch.isdigit())
+        n_dev = args._fabric_config.devices_for(int(digits) if digits
+                                                else 0)
+        mesh_argv, wenv = [], env
+        if n_dev > 1:
+            mesh_argv = ["--mesh", str(n_dev)]
+            wenv = dict(env)
+            flags = wenv.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                wenv["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count="
+                    + str(n_dev)).strip()
         log = open(fabric_paths(fabric_dir, host_id)["log"], "ab")
         try:
             return subprocess.Popen(
                 [sys.executable, "-m", "consensus_entropy_tpu.cli.amg_test",
-                 *worker_argv, "--fabric-worker", host_id,
+                 *worker_argv, *mesh_argv, "--fabric-worker", host_id,
                  "--fabric-dir", fabric_dir],
-                stdout=log, stderr=subprocess.STDOUT, env=env)
+                stdout=log, stderr=subprocess.STDOUT, env=wenv)
         finally:
             log.close()  # the child holds its own fd
 

@@ -158,8 +158,15 @@ class FleetScheduler:
                  fuse_step: bool = True, tracer=None,
                  jax_profile_dir: str | None = None,
                  jax_profile_n: int = 10, hold=None,
-                 compile_events: bool = True):
+                 compile_events: bool = True, mesh=None):
         self.config = config
+        #: optional pool-axis ``jax.sharding.Mesh`` (``parallel.
+        #: pool_mesh.make_pool_mesh_for``): sessions build mesh-sharded
+        #: acquirers, score groups dispatch through the sharded
+        #: per-width families (mesh × users — one multichip dispatch
+        #: stacks a bucket AND splits every pool across the chips), and
+        #: dispatch telemetry carries ``n_devices`` in its family keys
+        self.mesh = mesh
         self.tie_break = tie_break
         self.retrain_epochs = retrain_epochs
         self.host_workers = host_workers
@@ -284,8 +291,13 @@ class FleetScheduler:
             # wrapper build) would fire with no listener and never
             # reach the metrics stream
             jit_telemetry.subscribe(self._on_compile)
-        self._fleet_fns = ops_scoring.make_fleet_scoring_fns(
-            k=self.config.queries, tie_break=self.tie_break)
+        # mesh engines route every group through the per-width SHARDED
+        # families (_group_fns) — building the unsharded fleet family
+        # here would register a jit family the run never dispatches,
+        # breaking the family-set determinism pin across arms
+        self._fleet_fns = None if self.mesh is not None else \
+            ops_scoring.make_fleet_scoring_fns(
+                k=self.config.queries, tie_break=self.tie_break)
         self._results: dict = {}
         self._host_pool = ThreadPoolExecutor(max_workers=host_n,
                                              thread_name_prefix="fleet-host")
@@ -472,7 +484,7 @@ class FleetScheduler:
         session = UserSession(
             self.config, committee, entry.data, entry.user_path,
             seed=entry.seed, tie_break=self.tie_break,
-            retrain_epochs=self.retrain_epochs,
+            retrain_epochs=self.retrain_epochs, mesh=self.mesh,
             pad_pool_to=pad, timer=timer,
             preemption=self.preemption, ckpt_executor=self._ckpt_pool,
             pin_pad=pin_pad, cnn_steps=self.stack_cnn,
@@ -745,10 +757,23 @@ class FleetScheduler:
         host = [v for v in vals if not isinstance(v, jax.Array)]
         return (sum(getattr(v, "nbytes", 0) for v in host), len(host))
 
+    def _n_devices(self):
+        """The telemetry n_devices key: the mesh size, or None so
+        single-device family labels keep their historical spelling."""
+        return self.mesh.size if self.mesh is not None else None
+
     def _group_fns(self, width: int) -> dict:
         """The vmapped scorer family for one dispatch group: the shared
-        fleet fns, or the per-bucket width-guarded family when the driver
-        admits by bucket."""
+        fleet fns, the per-bucket width-guarded family when the driver
+        admits by bucket, or — on a mesh engine — the pool-sharded
+        per-width family (``parallel.pool_mesh``), always width-keyed so
+        the (fn, width, n_devices) jit families stay separable."""
+        if self.mesh is not None:
+            from consensus_entropy_tpu.parallel import pool_mesh
+
+            return pool_mesh.sharded_fleet_fns_for_width(
+                self.mesh, k=self.config.queries,
+                tie_break=self.tie_break, width=width)
         if not self.scoring_by_width:
             return self._fleet_fns
         return ops_scoring.fleet_scoring_fns_for_width(
@@ -968,8 +993,10 @@ class FleetScheduler:
             faults.fire("serve.dispatch", fn=fn_key, width=width,
                         batch=len(group))
             # attribute any XLA compile this call triggers to the
-            # (fn, width) jit family (obs.jit_telemetry compile events)
-            with jit_telemetry.dispatch_scope(fn_key, width=width):
+            # (fn, width, n_devices) jit family (obs.jit_telemetry
+            # compile events)
+            with jit_telemetry.dispatch_scope(
+                    fn_key, width=width, n_devices=self._n_devices()):
                 return self._group_fns(width)[fn_key](*stacked)
 
         self._profile_start()
@@ -1013,7 +1040,8 @@ class FleetScheduler:
         def dispatch():
             faults.fire("serve.dispatch", fn=fn_key, width=width,
                         batch=len(group))
-            with jit_telemetry.dispatch_scope(fn_key, width=width):
+            with jit_telemetry.dispatch_scope(
+                    fn_key, width=width, n_devices=self._n_devices()):
                 return committee_mod.stage_device_plans(plans)
 
         self._profile_start()
@@ -1039,8 +1067,9 @@ class FleetScheduler:
         def dispatch():
             faults.fire("serve.dispatch", fn=fn_key,
                         width=step.session.acq.n_pad, batch=1)
-            with jit_telemetry.dispatch_scope(fn_key,
-                                              width=step.session.acq.n_pad):
+            with jit_telemetry.dispatch_scope(
+                    fn_key, width=step.session.acq.n_pad,
+                    n_devices=self._n_devices()):
                 return run()
 
         if self.watchdog is not None:

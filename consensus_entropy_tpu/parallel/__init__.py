@@ -18,3 +18,8 @@ from consensus_entropy_tpu.parallel.sharding import (  # noqa: F401
     make_sharded_scoring_fns,
     make_shardmap_mc_scorer,
 )
+from consensus_entropy_tpu.parallel.pool_mesh import (  # noqa: F401
+    make_pool_mesh_for,
+    make_sharded_step_fns,
+    sharded_fleet_fns_for_width,
+)

@@ -1,0 +1,311 @@
+"""Pool-axis mesh serving: the sharded jit families the serve stack runs.
+
+``parallel.sharding`` proved the sharding rules (pool-axis
+``NamedSharding`` over the unfused scorers, shard_map top-k); this module
+turns them into the PRODUCTION families the rest of the stack composes
+through:
+
+- **all six acquisition modes, fused included** — the ``*_fused``
+  select→reveal→mask graphs run sharded with their donation intact: the
+  pool/hc mask twins and the probs buffer live sharded across the mesh,
+  the reveal scatter updates them in place, and only the 2·k selection
+  scalars cross to host (``ops.scoring.selection_scalars``).
+- **mesh × users composition** — :func:`sharded_fleet_fns_for_width`
+  wraps the fleet's vmapped per-bucket scorers with pool-axis shardings
+  on the trailing pool dim, so one multichip worker stacks a whole
+  admission bucket AND splits every user's pool across its chips in the
+  same dispatch.
+- **jit families keyed per (fn, width, n_devices)** — every build and
+  lookup lands in ``obs.jit_telemetry`` under the mesh size, the key the
+  compile-telemetry feed already records, so cost-aware edge derivation
+  can see what each (width, n_devices) geometry pays.
+
+Sharding rules (the partition-rule table, matched by operand name):
+probs ``(M, N, C)`` split on N; pool/hc masks ``(N,)`` and hoisted hc
+entropies split on N; the hc table ``(N, C)`` split on rows; PRNG keys,
+reliability weights and member masks replicate.  Every reduction axis
+(member mean, class entropy) is row-local — never the sharded axis — so
+sharded results are BIT-IDENTICAL to the single-device graphs, not merely
+close (pinned by ``tests/test_pool_mesh.py``).  ``mix`` concatenates the
+mc and hc blocks along the row axis; its full entropy vector replicates
+(irregular layout), matching ``parallel.sharding``.
+
+Single-controller contract: buffers are placed with ``jax.device_put``
+onto the process-local mesh (the virtual-device CI shape and one-host
+multichip serving).  Multi-controller pool feeding stays in
+``parallel.multihost`` / ``Acquirer._feed``.
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from consensus_entropy_tpu.obs import jit_telemetry
+from consensus_entropy_tpu.ops.scoring import (
+    FUSED_DONATE,
+    FusedStepResult,
+    ScoreResult,
+    _fleet_base_fns,
+    _fused_partial,
+    _POOL_MASK_POS,
+    score_hc,
+    score_hc_precomputed,
+    score_mc,
+    score_mix,
+    score_qbdc,
+    score_rand,
+    score_wmc,
+)
+from consensus_entropy_tpu.parallel.mesh import POOL_AXIS, make_pool_mesh
+
+
+@functools.lru_cache(maxsize=None)
+def make_pool_mesh_for(n_devices: int) -> Mesh:
+    """A 1-D pool-axis mesh over the first ``n_devices`` local devices.
+
+    Validated here (not at first dispatch) so CLI/serve configuration
+    errors surface as one clean message: ``n_devices`` must be >= 1 and
+    must not exceed what the process actually has.
+    """
+    n_devices = int(n_devices)
+    if n_devices < 1:
+        raise ValueError(
+            f"pool mesh needs at least 1 device, got {n_devices}")
+    devs = jax.devices()
+    if n_devices > len(devs):
+        raise ValueError(
+            f"pool mesh wants {n_devices} device(s) but this process has "
+            f"{len(devs)} — lower --mesh / mesh_devices or run with more "
+            f"chips (CI simulates them via "
+            f"--xla_force_host_platform_device_count)")
+    return make_pool_mesh(devs[:n_devices])
+
+
+#: operand-name regex → PartitionSpec (the SNIPPETS.md [2] partition-rule
+#: idiom, applied to scoring operands instead of parameter trees).  First
+#: match wins; every scoring operand name must match exactly one row.
+PARTITION_RULES = (
+    (r"probs$", P(None, POOL_AXIS, None)),
+    (r"(pool_mask|hc_mask|hc_ent)$", P(POOL_AXIS)),
+    (r"hc_freq$", P(POOL_AXIS, None)),
+    (r"(key|weights|member_mask)$", P()),
+)
+
+
+def match_partition_rules(names) -> tuple:
+    """Resolve each operand name through :data:`PARTITION_RULES`."""
+    specs = []
+    for name in names:
+        for pat, spec in PARTITION_RULES:
+            if re.search(pat, name):
+                specs.append(spec)
+                break
+        else:
+            raise ValueError(f"no partition rule matches operand {name!r}")
+    return tuple(specs)
+
+
+#: fn key → its positional operand names (the partition-rule lookup keys);
+#: the ``*_masked`` variants exist only in the vmapped fleet families
+_OPERANDS = {
+    "mc": ("probs", "pool_mask"),
+    "mc_masked": ("probs", "pool_mask", "member_mask"),
+    "hc": ("hc_freq", "hc_mask"),
+    "hc_pre": ("hc_ent", "hc_mask"),
+    "mix": ("probs", "pool_mask", "hc_freq", "hc_mask"),
+    "mix_masked": ("probs", "pool_mask", "hc_freq", "hc_mask",
+                   "member_mask"),
+    "rand": ("key", "pool_mask"),
+    "qbdc": ("probs", "pool_mask"),
+    "wmc": ("probs", "pool_mask", "weights"),
+    "wmc_masked": ("probs", "pool_mask", "weights", "member_mask"),
+    "mc_fused": ("probs", "pool_mask"),
+    "qbdc_fused": ("probs", "pool_mask"),
+    "wmc_fused": ("probs", "pool_mask", "weights"),
+    "rand_fused": ("key", "pool_mask"),
+    "hc_pre_fused": ("hc_ent", "hc_mask", "pool_mask"),
+    "mix_fused": ("probs", "pool_mask", "hc_freq", "hc_mask"),
+}
+
+#: fn keys whose ranking runs over the concatenated [mc; hc] row space —
+#: their full entropy vector replicates (irregular layout after concat)
+_MIX_KEYS = frozenset(
+    k for k in _OPERANDS if k.startswith("mix"))
+
+
+def _out_specs(key: str) -> tuple:
+    """The result PartitionSpec tree for one fn key (single-user shapes;
+    :func:`_batched` lifts them onto the stacked fleet shapes)."""
+    vec, repl = P(POOL_AXIS), P()
+    ent = repl if key in _MIX_KEYS else vec
+    if key.endswith("_fused"):
+        hc_mask = vec if key in ("hc_pre_fused", "mix_fused") else None
+        return FusedStepResult(entropy=ent, values=repl, indices=repl,
+                               pool_mask=vec, hc_mask=hc_mask)
+    return ScoreResult(entropy=ent, values=repl, indices=repl)
+
+
+def _batched(spec):
+    """Prepend the stacked USER axis (unsharded) to one PartitionSpec —
+    the mesh × users composition: every device holds every user's slice
+    of its own pool shard."""
+    if spec is None:
+        return None
+    return P(None, *spec)
+
+
+def _shard(mesh, tree):
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), tree,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def make_sharded_step_fns(mesh: Mesh, *, k: int, tie_break: str = "fast"):
+    """The single-user sharded scorer family — all six modes, UNFUSED and
+    FUSED, pool-axis sharded with the fused donation intact.
+
+    Supersedes ``parallel.sharding.make_sharded_scoring_fns`` for the
+    acquirer: same keys plus the ``*_fused`` entries, whose mask operands
+    are donated (``ops.scoring.FUSED_DONATE``) at matching in/out
+    shardings so XLA reuses the sharded buffers in place — the sharded
+    ``DevicePoolState`` mutates on device and only the 2·k selection
+    scalars cross to host.
+
+    Cached per (mesh, k, tie_break); telemetry-keyed per
+    ``(fn, n_devices)`` via ``obs.jit_telemetry``.
+    """
+    jit_telemetry.note_lookup(f"scoring:k{k}:{tie_break}",
+                              n_devices=mesh.size)
+    return _sharded_step_fns_cached(mesh, k, tie_break)
+
+
+def _single_user_impls(k: int, tie_break: str) -> dict:
+    impls = {
+        "mc": functools.partial(score_mc, k=k, tie_break=tie_break),
+        "hc": functools.partial(score_hc, k=k, tie_break=tie_break),
+        "hc_pre": functools.partial(score_hc_precomputed, k=k,
+                                    tie_break=tie_break),
+        "mix": functools.partial(score_mix, k=k, tie_break=tie_break),
+        "rand": functools.partial(score_rand, k=k),
+        "qbdc": functools.partial(score_qbdc, k=k, tie_break=tie_break),
+        "wmc": functools.partial(score_wmc, k=k, tie_break=tie_break),
+    }
+    for key in FUSED_DONATE:
+        impls[key] = _fused_partial(key, k, tie_break)
+    return impls
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_step_fns_cached(mesh: Mesh, k: int, tie_break: str) -> dict:
+    b0 = jit_telemetry.build_timer()
+    fns = {}
+    for key, fn in _single_user_impls(k, tie_break).items():
+        in_s = _shard(mesh, match_partition_rules(_OPERANDS[key]))
+        out_s = _shard(mesh, _out_specs(key))
+        fns[key] = jax.jit(fn, in_shardings=in_s, out_shardings=out_s,
+                           donate_argnums=FUSED_DONATE.get(key, ()))
+    jit_telemetry.note_build(f"scoring:k{k}:{tie_break}",
+                             n_devices=mesh.size,
+                             build_s=jit_telemetry.build_timer() - b0,
+                             jit_fns=fns.values())
+    return fns
+
+
+def sharded_fleet_fns_for_width(mesh: Mesh, *, k: int,
+                                tie_break: str = "fast",
+                                width: int) -> dict:
+    """Per-bucket vmapped scorers sharded on the pool axis — the mesh ×
+    users composition.  Input shapes are the fleet shapes with the
+    trailing pool dim split across the mesh: stacked probs
+    ``(U, M, N, C)`` on N, stacked masks ``(U, N)`` on N, hc tables
+    ``(U, N, C)`` on rows; keys/weights/member masks replicate.  The
+    fused keys donate their stacked sharded mask operands, so a whole
+    bucket's pool state updates in place per dispatch.
+
+    Width-guarded like ``ops.scoring.fleet_scoring_fns_for_width`` (a
+    mis-routed session fails loudly at dispatch) and additionally checks
+    the bucket width divides evenly across the mesh.  Telemetry-keyed
+    per ``(fn, width, n_devices)``.
+    """
+    if width % mesh.size:
+        raise ValueError(
+            f"bucket width {width} does not divide across the "
+            f"{mesh.size}-device pool mesh — admission must pad buckets "
+            f"to a multiple of the mesh size")
+    jit_telemetry.note_lookup(f"fleet:k{k}:{tie_break}", width=width,
+                              n_devices=mesh.size)
+    return _sharded_fleet_fns_cached(mesh, k, tie_break, width)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_fleet_fns_cached(mesh: Mesh, k: int, tie_break: str,
+                              width: int) -> dict:
+    b0 = jit_telemetry.build_timer()
+    base = {}
+    for key, fn in _fleet_base_fns(k, tie_break).items():
+        in_s = _shard(mesh, tuple(
+            _batched(s) for s in match_partition_rules(_OPERANDS[key])))
+        out_s = _shard(mesh, jax.tree_util.tree_map(
+            _batched, _out_specs(key),
+            is_leaf=lambda x: isinstance(x, P)))
+        base[key] = jax.jit(jax.vmap(fn), in_shardings=in_s,
+                            out_shardings=out_s,
+                            donate_argnums=FUSED_DONATE.get(key, ()))
+    jit_telemetry.note_build(f"fleet:k{k}:{tie_break}", width=width,
+                             n_devices=mesh.size,
+                             build_s=jit_telemetry.build_timer() - b0,
+                             jit_fns=base.values())
+
+    def guarded(fn_key, fn):
+        pos = _POOL_MASK_POS[fn_key]
+
+        def call(*args):
+            got = args[pos].shape[-1]
+            if got != width:
+                raise ValueError(
+                    f"bucket routing error: {fn_key!r} mesh scorer for "
+                    f"pool width {width} got inputs of width {got}")
+            return fn(*args)
+
+        return call
+
+    return {key: guarded(key, fn) for key, fn in base.items()}
+
+
+def _scatter_rows_sharded_impl(buf, rows, p):
+    # mirrors al.acquisition._scatter_rows_impl (OOB staging slots are
+    # dropped); duplicated rather than imported so parallel/ never
+    # depends on the al/ layer
+    return buf.at[:, rows].set(p, mode="drop")
+
+
+def sharded_scatter_rows(mesh: Mesh):
+    """The donated probs scatter for the SHARDED persistent buffer: buf
+    ``(M, N, C)`` split on N and reused in place; the live-row index
+    vector and the staged probs block replicate (each device writes only
+    the rows landing in its shard — XLA drops the rest like the OOB
+    staging slots)."""
+    return _sharded_scatter_cached(mesh)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_scatter_cached(mesh: Mesh):
+    probs_s = NamedSharding(mesh, P(None, POOL_AXIS, None))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(_scatter_rows_sharded_impl,
+                   in_shardings=(probs_s, repl, repl),
+                   out_shardings=probs_s, donate_argnums=0)
+
+
+def sharded_probs_buffer(mesh: Mesh, m: int, n_pad: int,
+                         n_classes: int) -> jax.Array:
+    """A zeroed persistent ``(M, n_pad, C)`` probs buffer laid out for
+    the sharded scatter (single-controller placement)."""
+    return jax.device_put(
+        np.zeros((m, n_pad, n_classes), np.float32),
+        NamedSharding(mesh, P(None, POOL_AXIS, None)))

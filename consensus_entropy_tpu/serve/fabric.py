@@ -220,6 +220,15 @@ class FabricConfig:
     fleet_planner: bool = True
     planner_epoch: int = 8
     planner_buckets: int = 4
+    #: chips per worker host (the pool-mesh width each spawned worker
+    #: serves with): an int applies fleet-wide; a tuple gives per-host
+    #: widths and its length MUST equal ``hosts`` — a 4-entry shape over
+    #: a 3-host fleet is a config typo that fails here, not as a worker
+    #: crash-loop.  Workers advertise their width in every heartbeat;
+    #: devices-aware placement then routes wide-pool buckets toward the
+    #: multi-chip hosts.  Autoscaler respawns/scale-ups past the initial
+    #: shape default to 1 chip (:meth:`devices_for`).
+    mesh_devices: int | tuple = 1
     #: DEADLINE-FENCED degradation (0 = wait forever, the PR 14
     #: semantics): a checkpoint fence not acked within this many seconds
     #: falls back to evict+resume — the coordinator journals the timeout
@@ -279,6 +288,17 @@ class FabricConfig:
         """True when the elastic control plane (autoscaler, JOIN +
         rebalance, operator adoption) is active."""
         return self.min_hosts is not None or self.max_hosts is not None
+
+    def devices_for(self, index: int) -> int:
+        """Chips the ``index``-th spawned worker serves with: the
+        per-host tuple entry when one was given (scale-ups past the
+        initial shape default to 1 chip — heterogeneity is declared up
+        front, respawns of a NAMED slot keep its width), the fleet-wide
+        int otherwise."""
+        if isinstance(self.mesh_devices, tuple):
+            return (self.mesh_devices[index]
+                    if 0 <= index < len(self.mesh_devices) else 1)
+        return self.mesh_devices
 
     def __post_init__(self):
         if self.hosts < 1:
@@ -357,6 +377,22 @@ class FabricConfig:
         if self.placement not in PLACEMENT_POLICIES:
             raise ValueError(f"placement must be one of "
                              f"{PLACEMENT_POLICIES}, got {self.placement!r}")
+        if isinstance(self.mesh_devices, (list, tuple)):
+            self.mesh_devices = tuple(int(d) for d in self.mesh_devices)
+            if len(self.mesh_devices) != self.hosts:
+                raise ValueError(
+                    f"mesh_devices shape {self.mesh_devices} names "
+                    f"{len(self.mesh_devices)} hosts but hosts="
+                    f"{self.hosts} — give one chips-per-host entry per "
+                    f"spawned worker (or a single int fleet-wide)")
+            if any(d < 1 for d in self.mesh_devices):
+                raise ValueError(f"every mesh_devices entry must be "
+                                 f">= 1, got {self.mesh_devices}")
+        elif int(self.mesh_devices) < 1:
+            raise ValueError(f"mesh_devices must be >= 1, "
+                             f"got {self.mesh_devices}")
+        else:
+            self.mesh_devices = int(self.mesh_devices)
         if self.planner_epoch < 1 or self.planner_buckets < 1:
             raise ValueError("planner_epoch and planner_buckets must be "
                              f">= 1, got {self.planner_epoch} / "
@@ -384,6 +420,10 @@ class HostHandle:
     #: tail of the worker's ``spans_<h>.jsonl`` (None when the
     #: coordinator runs untraced)
     span_tail: JsonlTail | None = None
+    #: chips-per-host the worker advertises in its heartbeat (read at
+    #: JOIN); ``None`` until the first beat or for legacy workers —
+    #: devices-aware placement treats it as 1
+    devices: int | None = None
 
 
 class FabricCoordinator:
@@ -1033,10 +1073,17 @@ class FabricCoordinator:
         idle behind assignments made before it existed."""
         h.joined = True
         self._stillborn = 0  # spawning demonstrably works again
+        beat = read_lease(h.lease_path)
+        if beat is not None and isinstance(beat.get("devices"), int):
+            # chips-per-host heterogeneity: advertised in the heartbeat
+            # (same channel liveness itself rides), read once at JOIN —
+            # placement then routes wide-pool buckets toward this host
+            h.devices = beat["devices"]
         if not self.config.elastic:
             return  # PR 5 semantics byte-for-byte: membership is lease-only
         self.joins += 1
-        rec = self.journal.append("join", host=h.host_id)
+        rec = self.journal.append("join", host=h.host_id,
+                                  devices=h.devices)
         self.report.event("host_join", host=h.host_id)
         self._ctl("ctl.join", key=rec["seq"], host=h.host_id)
         if self.fleet_planner is not None and self.fleet_planner.edges:
@@ -1248,7 +1295,8 @@ class FabricCoordinator:
         drop_target = dict(placement_mod.plan_failover(
             [u for u in fresh if u in queued], state=st,
             unresolved=self._unresolved, hosts=targets,
-            edges=self._fleet_edges(), policy=self.config.placement))
+            edges=self._fleet_edges(), policy=self.config.placement,
+            devices=self._host_devices()))
         for u in fresh:
             if u in queued:
                 target = drop_target[u]
@@ -1347,7 +1395,8 @@ class FabricCoordinator:
             target = placement_mod.place_user(
                 u, state=self.journal.state,
                 unresolved=self._unresolved, hosts=targets,
-                edges=self._fleet_edges(), policy=cfg.placement)
+                edges=self._fleet_edges(), policy=cfg.placement,
+                devices=self._host_devices())
             self._migrating[u] = target
             sh.assign.append({"drop": u, "evict": True})
             self.report.event("migrate_request", user=u, host=target)
@@ -1469,7 +1518,8 @@ class FabricCoordinator:
         # _pump_drain anti-herding discipline)
         drop_target = dict(placement_mod.plan_failover(
             drops, state=st, unresolved=self._unresolved, hosts=targets,
-            edges=self._fleet_edges(), policy=cfg.placement))
+            edges=self._fleet_edges(), policy=cfg.placement,
+            devices=self._host_devices()))
         for u in drops:
             self._migrating[u] = drop_target[u]
             h.assign.append({"drop": u})
@@ -1724,6 +1774,15 @@ class FabricCoordinator:
         h = self.hosts.get(host_id) if host_id else None
         return h is not None and h.alive
 
+    def _host_devices(self) -> dict | None:
+        """``{host: chips}`` for devices-aware placement, from the
+        widths workers advertise in their heartbeats (read at JOIN).
+        ``None`` for an all-1-chip (or pre-mesh) fleet — placement then
+        keeps the legacy co-location key bit-for-bit."""
+        devs = {h.host_id: h.devices for h in self.hosts.values()
+                if h.alive and h.devices and h.devices > 1}
+        return devs or None
+
     def _route_targets(self) -> list:
         """Hosts a placement may target: alive and NOT draining — a
         draining host sheds users, it never receives them."""
@@ -1754,7 +1813,8 @@ class FabricCoordinator:
         host_id = placement_mod.place_user(
             user, state=self.journal.state, unresolved=self._unresolved,
             hosts=live, edges=self._fleet_edges(),
-            policy=self.config.placement)
+            policy=self.config.placement,
+            devices=self._host_devices())
         self._assign_to(user, host_id)
         return host_id
 
@@ -1771,7 +1831,8 @@ class FabricCoordinator:
         plan = placement_mod.plan_failover(
             users, state=self.journal.state,
             unresolved=self._unresolved, hosts=live,
-            edges=self._fleet_edges(), policy=self.config.placement)
+            edges=self._fleet_edges(), policy=self.config.placement,
+            devices=self._host_devices())
         for u, target in plan:
             self._assign_to(u, target)
 
@@ -2041,6 +2102,7 @@ class FabricCoordinator:
                 "draining": h.draining,
                 "lease_age_s": round(age, 3) if age is not None else None,
                 "load": self._load_of(hid),
+                "devices": h.devices,
             }
         if self.alerts is not None:
             # the COMPOSED list (lease burn + placement skew) — the

@@ -112,12 +112,17 @@ class HostLease:
     workspaces."""
 
     def __init__(self, path: str, host_id: str, interval_s: float, *,
-                 orphan_check: bool = True):
+                 orphan_check: bool = True, devices: int | None = None):
         if interval_s <= 0:
             raise ValueError(f"interval_s must be > 0, got {interval_s}")
         self.path = path
         self.host_id = host_id
         self.interval_s = interval_s
+        #: chips this worker serves with (its pool-mesh width); carried
+        #: in every beat so the coordinator's placement can route wide
+        #: buckets toward multi-chip hosts.  ``None`` = legacy beat
+        #: (no ``devices`` field), coordinator treats as 1
+        self.devices = devices
         self.beats = 0
         self._orphan_check = orphan_check
         self._ppid = os.getppid()
@@ -132,11 +137,13 @@ class HostLease:
         self.beats += 1
         faults.fire("fabric.lease", host=self.host_id, beat=self.beats)
         tmp = self.path + ".tmp"
+        rec = {"host": self.host_id, "pid": os.getpid(),
+               "beat": self.beats,
+               "t": round(time.time(), 3)}  # cetpu: noqa[replay-wallclock] heartbeat wall-stamp: liveness crosses processes, replay never reads it
+        if self.devices is not None:
+            rec["devices"] = int(self.devices)
         with open(tmp, "wb") as f:
-            f.write(json.dumps(
-                {"host": self.host_id, "pid": os.getpid(),
-                 "beat": self.beats,
-                 "t": round(time.time(), 3)}).encode("utf-8"))  # cetpu: noqa[replay-wallclock] heartbeat wall-stamp: liveness crosses processes, replay never reads it
+            f.write(json.dumps(rec).encode("utf-8"))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
@@ -164,7 +171,7 @@ class HostLease:
 def run_worker(fabric_dir: str, host_id: str, *, build_entry, scheduler,
                config, on_result=None, lease_s: float = 5.0,
                preemption=None, poll_s: float = 0.05,
-               status=None, alerts=None) -> list:
+               status=None, alerts=None, devices: int | None = None) -> list:
     """Run one fabric worker to completion; returns the server's results.
 
     ``build_entry(user_id) -> FleetUser | None``: constructs the user's
@@ -181,6 +188,9 @@ def run_worker(fabric_dir: str, host_id: str, *, build_entry, scheduler,
     ``config``: the worker's :class:`~consensus_entropy_tpu.serve.server.
     ServeConfig`.  ``lease_s``: the coordinator's lease — heartbeats run
     at a third of it so one missed beat never looks like death.
+    ``devices``: chips this worker serves with, advertised in every
+    heartbeat for devices-aware placement; defaults to the config's
+    ``mesh_devices`` (1 when unsharded).
     """
     paths = fabric_paths(fabric_dir, host_id)
     journal = AdmissionJournal(paths["events"])
@@ -293,8 +303,11 @@ def run_worker(fabric_dir: str, host_id: str, *, build_entry, scheduler,
                         return  # draining: the rerun picks the user up
             stop.wait(poll_s)
 
+    if devices is None:
+        devices = int(getattr(config, "mesh_devices", 1) or 1)
     lease = HostLease(paths["lease"], host_id,
-                      max(lease_s / 3.0, 0.05)).start()
+                      max(lease_s / 3.0, 0.05),
+                      devices=devices).start()
     thread = threading.Thread(target=intake, daemon=True,
                               name=f"fabric-intake-{host_id}")
     thread.start()

@@ -78,9 +78,18 @@ def placement_view(state, unresolved, hosts, edges=()) -> tuple:
 
 
 def place(bucket, *, loads, buckets_by_host, policy: str = "bucket",
-          max_skew: int = DEFAULT_MAX_SKEW) -> str:
+          max_skew: int = DEFAULT_MAX_SKEW, devices=None) -> str:
     """The host one user routes to.  Deterministic: ties break on load
-    then host id, and every input is journal-replayable."""
+    then host id, and every input is journal-replayable.
+
+    ``devices`` (``{host: chips}``, workers advertise it in their
+    heartbeats): chips-per-host heterogeneity.  Among equally
+    co-located eligible hosts, prefer one whose chip count DIVIDES the
+    bucket width (the pool axis shards evenly there), widest such mesh
+    first — a 4-chip worker attracts the wide-pool buckets while 1-chip
+    survivors keep the narrow ones.  ``None`` (or hosts missing from
+    it, treated as 1 chip — 1 divides everything) reproduces the
+    legacy co-location → load → id key bit-for-bit."""
     if policy not in PLACEMENT_POLICIES:
         raise ValueError(f"unknown placement policy {policy!r} "
                          f"(choose from {PLACEMENT_POLICIES})")
@@ -90,25 +99,34 @@ def place(bucket, *, loads, buckets_by_host, policy: str = "bucket",
         return min(loads, key=lambda h: (loads[h], h))
     floor = min(loads.values())
     eligible = [h for h in loads if loads[h] <= floor + max_skew]
-    return min(eligible,
-               key=lambda h: (-buckets_by_host.get(h, {}).get(bucket, 0),
-                              loads[h], h))
+
+    def _key(h):
+        co = -buckets_by_host.get(h, {}).get(bucket, 0)
+        if not devices:
+            return (co, loads[h], h)
+        d = int(devices.get(h) or 1)
+        # divisibility first (a non-dividing mesh would be a routing
+        # error at dispatch), then the widest mesh the bucket can use
+        return (co, 0 if bucket % d == 0 else 1, -min(d, bucket),
+                loads[h], h)
+
+    return min(eligible, key=_key)
 
 
 def place_user(user, *, state, unresolved, hosts, edges=(),
                policy: str = "bucket",
-               max_skew: int = DEFAULT_MAX_SKEW) -> str:
+               max_skew: int = DEFAULT_MAX_SKEW, devices=None) -> str:
     """:func:`place` driven straight from replayed journal state — the
     coordinator's assignment seam."""
     loads, buckets = placement_view(state, unresolved, hosts, edges)
     return place(bucket_for(state.pools.get(str(user)), edges),
                  loads=loads, buckets_by_host=buckets, policy=policy,
-                 max_skew=max_skew)
+                 max_skew=max_skew, devices=devices)
 
 
 def plan_failover(victims, *, state, unresolved, hosts, edges=(),
                   policy: str = "bucket",
-                  max_skew: int = DEFAULT_MAX_SKEW) -> list:
+                  max_skew: int = DEFAULT_MAX_SKEW, devices=None) -> list:
     """Place a dead (or drained) host's WHOLE victim set at once:
     ``[(user, target_host), ...]`` in the given victim order (failover
     passes in-flight first, then queued — the re-admission order).
@@ -147,7 +165,8 @@ def plan_failover(victims, *, state, unresolved, hosts, edges=(),
     for b in order:
         for u in by_bucket[b]:
             target = place(b, loads=loads, buckets_by_host=buckets,
-                           policy=policy, max_skew=max_skew)
+                           policy=policy, max_skew=max_skew,
+                           devices=devices)
             target_of[u] = target
             loads[target] += 1
             if b is not None:

@@ -154,6 +154,14 @@ class ServeConfig:
     max_queue: int = 64
     admit_window_s: float = 0.0
     bucket_widths: tuple | None = None
+    #: pool-axis mesh width: shard every stacked scoring dispatch (and
+    #: the fused select→reveal→mask step) across this many local devices
+    #: (``parallel.pool_mesh``).  1 = the unsharded single-device arm.
+    #: Every bucket width must divide by it — the pool axis splits a
+    #: bucket's padded width evenly across chips, so an explicit edge
+    #: geometry that doesn't divide fails HERE, not as a shard-mismatch
+    #: inside jit at the first dispatch
+    mesh_devices: int = 1
     watchdog_s: float = 0.0
     failure_budget: int = 3
     backoff_base_s: float = 0.25
@@ -189,6 +197,28 @@ class ServeConfig:
             # PAD_MULTIPLE family) fails HERE, not as silent misrouting
             # to the wrong jit family at admission time
             self.bucket_widths = validate_bucket_widths(self.bucket_widths)
+        if self.mesh_devices < 1:
+            raise ValueError(f"mesh_devices must be >= 1, "
+                             f"got {self.mesh_devices}")
+        if self.mesh_devices > 1 and self.bucket_widths is not None:
+            bad = [w for w in self.bucket_widths
+                   if w % self.mesh_devices]
+            if bad:
+                raise ValueError(
+                    f"bucket widths {bad} do not divide across a "
+                    f"{self.mesh_devices}-device pool mesh — every "
+                    f"explicit --bucket-widths edge must be a multiple "
+                    f"of --mesh-devices so the pool axis shards evenly")
+        if (self.mesh_devices > 1 and self.bucket_widths is None
+                and self.mesh_devices & (self.mesh_devices - 1)):
+            # implicit geometry (planner quantiles, power-of-two
+            # fall-through) only ever emits PAD_MULTIPLE-rounded
+            # power-of-two-friendly widths; a 3- or 6-device mesh can
+            # never divide them and would fail at first dispatch instead
+            raise ValueError(
+                f"mesh_devices={self.mesh_devices} must be a power of "
+                f"two under the implicit bucket geometry — pass explicit "
+                f"--bucket-widths that divide it instead")
         if self.watchdog_s < 0:
             raise ValueError(f"watchdog_s must be >= 0, "
                              f"got {self.watchdog_s}")
@@ -458,6 +488,21 @@ class FleetServer:
                 "of finishing")
         self.scheduler = scheduler
         self.config = config
+        if config.mesh_devices > 1:
+            # install the pool mesh before the engine opens: the
+            # scheduler builds its jit families lazily per width, so a
+            # mesh set here routes every dispatch through the sharded
+            # (fn, width, n_devices) families from the first admission
+            from consensus_entropy_tpu.parallel.pool_mesh import (
+                make_pool_mesh_for)
+            if scheduler.mesh is None:
+                scheduler.mesh = make_pool_mesh_for(config.mesh_devices)
+            elif scheduler.mesh.size != config.mesh_devices:
+                raise ValueError(
+                    f"scheduler carries a {scheduler.mesh.size}-device "
+                    f"pool mesh but ServeConfig.mesh_devices="
+                    f"{config.mesh_devices} — build one or the other, "
+                    f"not a disagreeing pair")
         self.preemption = preemption
         self.router = BucketRouter(config.bucket_widths)
         # the batch-class slot share (clamped so interactive always keeps

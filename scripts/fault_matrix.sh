@@ -81,6 +81,16 @@
 #   survivor (one deterministic trace id per user, spans from both
 #   hosts, orphan-free merge).  scripts/obs_check.sh is the companion
 #   schema/export gate.
+# - pool-axis mesh serving (tests/test_pool_mesh.py): the
+#   sharded-worker SIGKILL failover drill — a 2-host fabric where h0
+#   scores through a 4-device mesh and h1 through one chip, h0
+#   SIGKILLed at its first admission; every user must fail over to the
+#   NARROWER survivor bit-identical to sequential baselines (sharded
+#   and unsharded execution of the same journaled state are
+#   interchangeable mid-flight).  scripts/mesh_check.sh (run at the
+#   end of this matrix) is the companion gate: the 4/8-device parity
+#   sweep, the jit-family telemetry determinism pin, and the
+#   bench-path selection-digest parity leg.
 # - workload / soak (tests/test_workload.py): the live-fabric churn
 #   drill — a trace-driven keep-open soak where a user disconnects
 #   mid-iteration (journaled evict, workspace kept) and reconnects
@@ -101,8 +111,10 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
   tests/test_serve_faults.py tests/test_serve_fabric.py \
   tests/test_slo.py tests/test_elastic.py tests/test_remedy.py \
   tests/test_acquire.py tests/test_obs.py tests/test_workload.py \
+  tests/test_pool_mesh.py \
   -v -m faults -p no:cacheprovider "$@"
 scripts/elastic_check.sh
 scripts/remedy_check.sh
 scripts/soak_check.sh
+scripts/mesh_check.sh
 echo "fault matrix passed"

@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Pool-axis mesh serving CI gate (ISSUE 18 satellite; sits next to
+# elastic_check.sh and is run by scripts/fault_matrix.sh).
+#
+# LEG 1 — multi-device parity + telemetry: every mesh-marked test
+# EXCEPT the fault drill — the 2-device parity pin over all thirteen
+# scoring/fused families, the slow 4/8-device sweep of the sharded
+# fleet families + donated scatter, the (fn, width, n_devices)
+# jit-family telemetry determinism pin (family set identical across an
+# in-process restart), the mesh/composition config validation units,
+# the devices-aware placement units, and the mesh-arm serve run whose
+# compile events must carry the real device count.  The tests run
+# under tests/conftest.py's 8 virtual CPU devices — the same code path
+# XLA uses on a TPU slice, minus ICI.
+#
+# LEG 2 — sharded-worker SIGKILL failover: a REAL 2-host fabric where
+# h0 serves through a 4-device mesh (CETPU_MESH_DEVICES=4 in the
+# worker) and h1 through a single chip; h0 is SIGKILLed at its first
+# admission and every user must fail over to the NARROWER survivor and
+# finish bit-identical to unfaulted sequential baselines — pinning
+# that sharded and unsharded execution of the same journaled state are
+# interchangeable mid-flight.
+#
+# LEG 3 — bench-path digest parity: a compressed `bench.py --suite
+# mesh` run (small pool, K in {1,2,4}) whose per-iteration selection
+# digests must be bit-equal across every arm — the same gate the full
+# BENCH_mesh artifact asserts, exercised cheaply on every CI run.
+#
+# Extra pytest args pass through to LEG 1, e.g.:
+#   scripts/mesh_check.sh -k parity
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+echo "mesh_check leg 1/3: multi-device parity sweep + telemetry pins"
+JAX_PLATFORMS=cpu python -m pytest tests/test_pool_mesh.py \
+  -v -m "mesh and not faults" -p no:cacheprovider "$@"
+
+echo "mesh_check leg 2/3: sharded-worker SIGKILL failover drill"
+JAX_PLATFORMS=cpu python -m pytest \
+  "tests/test_pool_mesh.py::test_mesh_worker_sigkill_fails_over_to_narrow_survivor" \
+  -v -p no:cacheprovider
+
+echo "mesh_check leg 3/3: bench-path selection-digest parity (K=1,2,4)"
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --suite mesh \
+  --mesh-sweep 1 2 4 --pool 20000 --mesh-iters 5 --reps 1 > /dev/null
+
+echo "mesh check passed"

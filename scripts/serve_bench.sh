@@ -23,10 +23,28 @@
 # select→reveal→mask) vs `--no-fuse-step` over identical users, parity
 # asserted on every rep, reporting host↔device bytes + device calls per
 # iteration alongside users/sec (redirect to BENCH_serve_fused_r<N>.json).
+#
+# `scripts/serve_bench.sh mesh [...]` runs the pool-axis mesh K-sweep
+# instead (`bench.py --suite mesh`, ISSUE 18): one worker, K simulated
+# devices (each K in its own subprocess with
+# --xla_force_host_platform_device_count=K), all six fused serve-step
+# modes over a >=100k pool through the NamedSharding families — donated
+# masks, sharded reveal scatter — with the per-iteration selection
+# digest asserted BIT-EQUAL to the unsharded K=1 arm on every rep
+# before any steps/sec is reported (redirect to BENCH_mesh_r<N>.json).
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
-if [ "${1:-}" = "fused" ]; then
+if [ "${1:-}" = "mesh" ]; then
+    shift
+    if [ "$#" -gt 0 ]; then
+        JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py \
+            --suite mesh "$@"
+    else
+        JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py \
+            --suite mesh --mesh-sweep 1 2 4 8 --reps 3
+    fi
+elif [ "${1:-}" = "fused" ]; then
     shift
     if [ "$#" -gt 0 ]; then
         JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py \

@@ -24,6 +24,16 @@
 # the rerun must finish EVERY user EXACTLY ONCE across every host's
 # results file — still bit-identical to sequential.
 #
+# LEG 3 — the adversarial SKEW pool distribution (ISSUE 18 satellite):
+# a second compressed soak whose trace piles 80% of users onto ONE
+# seeded hot pool size (workload.trace SKEW_FRAC) — the single-bucket
+# stampede the planner sketch and bucketed admission must absorb.
+# Asserted: the drawn shape is actually skewed (hot size holds a
+# strict majority, the cold size still drawn), zero loss, schema-valid
+# streams, per-class p50/p95/p99 percentile rows graded for BOTH
+# classes, and per-user parity vs sequential baselines over the
+# trace-drawn sizes.
+#
 # Extra args are NOT accepted: this is a pass/fail gate, not a bench.
 set -euo pipefail
 
@@ -118,7 +128,7 @@ def fabric_cfg():
                         slo_batch_s=SLO["batch"])
 
 
-def make_spawn(fdir, ws):
+def make_spawn(fdir, ws, specs_=specs):
     def spawn(host_id):
         log = open(fabric_paths(fdir, host_id)["log"], "ab")
         env = {**os.environ, "PYTHONPATH": ".",
@@ -128,14 +138,14 @@ def make_spawn(fdir, ws):
             return subprocess.Popen(
                 [sys.executable, "tests/fabric_worker.py", fdir,
                  host_id, ws, cfg.mode, str(cfg.epochs), str(N_USERS),
-                 "5.0", "2", sizes_arg(specs)],
+                 "5.0", "2", sizes_arg(specs_)],
                 stdout=log, stderr=subprocess.STDOUT, env=env)
         finally:
             log.close()
     return spawn
 
 
-def check_parity_and_owners(fdir, label):
+def check_parity_and_owners(fdir, label, specs_=specs, seq_=seq):
     jp = os.path.join(fdir, "serve_journal.jsonl")
     bad = validate_journal_file(jp)
     for wal in sorted(glob.glob(os.path.join(fdir, "events_*.jsonl"))):
@@ -147,11 +157,11 @@ def check_parity_and_owners(fdir, label):
             for rec in export.read_jsonl_tolerant(
                     os.path.join(fdir, fname)):
                 rows.setdefault(rec["user"], []).append(rec)
-    for _, uid, _ in specs:
+    for _, uid, _ in specs_:
         assert len(rows.get(uid, [])) == 1, (label, uid, rows.get(uid))
         assert rows[uid][0]["error"] is None, (label, uid)
         assert rows[uid][0]["result"]["trajectory"] \
-            == seq[uid]["trajectory"], (label, uid)
+            == seq_[uid]["trajectory"], (label, uid)
 
 
 # ---- LEG 1: the compressed soak ---------------------------------------
@@ -266,5 +276,84 @@ g2 = grade_run(fdir2, journal_path=jp2, trace=tr)
 assert g2["deterministic"]["zero_loss"], g2["deterministic"]
 print(f"soak_check: kill@fabric.remedy mid-soak replayed clean — "
       f"{N_USERS} users finished exactly once, parity exact")
+
+# ---- LEG 3: the adversarial SKEW pool distribution --------------------
+# 80% of users pile onto ONE seeded hot size (workload.trace
+# SKEW_FRAC): the single-bucket stampede.  The seed scan (first hit
+# wins) requires both classes, a STRICT hot-size majority with the
+# cold size still drawn, and every user's trace-drawn pool trainable
+# (all 4 classes present in its pre-training labels).
+from tests.fabric_workload import make_data
+
+spec3 = sizes3 = None
+for seed in range(11, 211):
+    cand = TraceSpec(
+        seed=seed, n_users=N_USERS, arrival="poisson", rate=1.0,
+        class_mix=(("interactive", 0.5), ("batch", 0.5)),
+        pool_dist="skew", pool_sizes=(30, 100),
+        churn_frac=0.34, churn_delay_s=10.0, reconnect_s=20.0,
+        horizon_s=60.0)
+    ev = [e for e in generate(cand).events if e["kind"] == "arrive"]
+    pool_of = {e["user"]: e["pool"] for e in ev}
+    sizes = [pool_of[f"u{i}"] for i in range(N_USERS)]
+    hot_n = max(sizes.count(s) for s in set(sizes))
+    if ({e["cls"] for e in ev} == {"interactive", "batch"}
+            and len(set(sizes)) == 2 and hot_n > N_USERS // 2 + 1
+            and all(len(set(make_data(100 + i, f"u{i}", n_songs=n)
+                            .labels.values())) == 4
+                    for i, n in enumerate(sizes))):
+        spec3, sizes3 = cand, sizes
+        break
+assert spec3 is not None, "no skewed trace seed in the scan range"
+hot = max(set(sizes3), key=sizes3.count)
+trace3_path = os.path.join(root, "trace_skew.jsonl")
+save(generate(spec3), trace3_path)
+tr3 = load(trace3_path)
+specs3 = user_specs(N_USERS, sizes=sizes3)
+root3 = os.path.join(root, "seq_skew")
+os.makedirs(root3)
+seq3 = sequential_baselines(root3, cfg, specs3)
+
+fdir3 = os.path.join(root, "fabric_skew")
+ws3 = os.path.join(root, "ws_skew")
+os.makedirs(fdir3)
+os.makedirs(ws3)
+jp3 = os.path.join(fdir3, "serve_journal.jsonl")
+journal3b = AdmissionJournal(jp3)
+report3 = FleetReport(os.path.join(fdir3, "fleet_metrics_fleet.jsonl"))
+coord3 = FabricCoordinator(
+    journal3b, fdir3, fabric_cfg(), report=report3,
+    alerts=AlertWatcher(report3),
+    status=StatusWriter(os.path.join(fdir3, "status"), "coordinator",
+                        interval_s=0.2))
+driver3 = TraceDriver(tr3, FabricTarget(coord3), time_scale=0.1,
+                      backoff_seed=3)
+driver3.start()
+try:
+    summary_skew = coord3.run([], make_spawn(fdir3, ws3, specs3),
+                              keep_open=True)
+finally:
+    assert driver3.join(timeout=120.0), "skew trace driver wedged"
+    journal3b.close()
+    report3.close()
+
+g3 = grade_run(fdir3, journal_path=jp3, trace=tr3, slo_s=SLO,
+               driver_stats=driver3.stats.as_dict())
+det3 = g3["deterministic"]
+assert det3["zero_loss"], det3["lost_users"]
+assert det3["journal_ok"], g3["measured"]["journal_errors"]
+assert det3["stream_ok"], g3["measured"]["stream_errors"]
+# per-class percentile rows graded for BOTH classes of the stampede
+per_cls = g3["measured"]["per_class"]
+for cls in ("interactive", "batch"):
+    row = per_cls.get(cls)
+    assert row and row["n"] >= 1, (cls, per_cls)
+    assert all(k in row for k in ("p50_s", "p95_s", "p99_s")), row
+check_parity_and_owners(fdir3, "skew", specs3, seq3)
+print(f"soak_check: skew soak drained clean — hot pool {hot} held "
+      f"{sizes3.count(hot)}/{N_USERS} users (sizes={sizes3}), "
+      f"per-class percentiles graded "
+      f"{ {c: round(r['p95_s'], 2) for c, r in per_cls.items()} }, "
+      f"parity exact")
 PY
 echo "soak check passed"

@@ -68,7 +68,7 @@ def _clear_process_wide_jit_caches():
     yield
     from consensus_entropy_tpu.models import cnn_trainer, committee
     from consensus_entropy_tpu.ops import scoring
-    from consensus_entropy_tpu.parallel import sharding
+    from consensus_entropy_tpu.parallel import pool_mesh, sharding
 
     cnn_trainer._EPOCH_FNS.clear()
     committee._infer_fns_cached.cache_clear()
@@ -79,4 +79,7 @@ def _clear_process_wide_jit_caches():
     scoring._make_fleet_scoring_fns_cached.cache_clear()
     scoring._fleet_fns_for_width_cached.cache_clear()
     sharding._make_sharded_scoring_fns_cached.cache_clear()
+    pool_mesh._sharded_step_fns_cached.cache_clear()
+    pool_mesh._sharded_fleet_fns_cached.cache_clear()
+    pool_mesh._sharded_scatter_cached.cache_clear()
     jax.clear_caches()

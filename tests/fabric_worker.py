@@ -17,6 +17,8 @@ or kill its steps without touching its peers.  ``CETPU_FABRIC_METRICS=1``
 writes this host's schema-v2 metrics stream + fleet summary to
 ``FABRIC_DIR/fleet_metrics_<HOST_ID>.jsonl`` (per-host stacked-dispatch
 occupancy — what ``bench.py --suite elastic`` grades placement by).
+``CETPU_MESH_DEVICES=K`` serves sharded over a K-device pool mesh (the
+worker's heartbeat then advertises K chips — the mesh failover drill).
 """
 
 import json
@@ -101,8 +103,16 @@ def main(argv) -> int:
                        # planner_epoch=2: the tiny synthetic cohorts must
                        # still journal sketch epochs, or the elastic
                        # fleet planner would have nothing to merge
+                       # CETPU_MESH_DEVICES=K: serve sharded — the
+                       # server installs a K-device pool mesh and the
+                       # heartbeat advertises the width (the failover
+                       # drill kills a 4-chip worker into a 1-chip
+                       # survivor); configure_jax() already forces 8
+                       # virtual CPU devices, so K <= 8 always resolves
                        config=ServeConfig(
                            target_live=int(target), planner_epoch=2,
+                           mesh_devices=int(os.environ.get(
+                               "CETPU_MESH_DEVICES", 1)),
                            aging_s=float(os.environ.get(
                                "CETPU_OBS_AGING", 30.0))),
                        on_result=on_result, lease_s=float(lease_s),

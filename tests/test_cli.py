@@ -270,8 +270,10 @@ def test_fleet_rejects_mesh_and_distributed(synth_roots, capsys):
             "--models-root", synth_roots["models"],
             "--deam-root", synth_roots["deam"],
             "--amg-root", synth_roots["amg"], "--device", "cpu"]
+    # an explicit width composes (pool-axis mesh serving); the 'auto'
+    # spelling stays sequential-only — rejected with the pointer to N
     assert amg_test.main(base + ["--fleet", "2", "--mesh", "auto"]) == 1
-    assert "single-process" in capsys.readouterr().out
+    assert "sequential path's spelling" in capsys.readouterr().out
     assert amg_test.main(base + ["--fleet", "0"]) == 1
     assert ">= 1" in capsys.readouterr().out
 
@@ -285,8 +287,10 @@ def test_serve_flag_validation(synth_roots, capsys):
     assert "exclusive" in capsys.readouterr().out
     assert amg_test.main(base + ["--serve", "0"]) == 1
     assert ">= 1" in capsys.readouterr().out
+    # --serve composes with an explicit mesh width (pool-axis mesh
+    # serving); only the 'auto' spelling is rejected
     assert amg_test.main(base + ["--serve", "2", "--mesh", "auto"]) == 1
-    assert "single-process" in capsys.readouterr().out
+    assert "sequential path's spelling" in capsys.readouterr().out
     assert amg_test.main(base + ["--serve", "2", "--pad-pool-to", "64"]) == 1
     assert "--bucket-widths" in capsys.readouterr().out
     assert amg_test.main(base + ["--serve", "2",

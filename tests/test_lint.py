@@ -123,6 +123,66 @@ def test_donation_tracks_local_jax_jit_donate_argnums():
     """) == []
 
 
+def test_donation_flows_through_local_aliases():
+    """Flow-sensitive rebind tracking: a pure alias assignment links the
+    names, so donating through EITHER spelling spends both."""
+    # donate the alias, read the original
+    assert rules_fired("""
+        def step(fns, probs, mask):
+            m = mask
+            res = fns["mc_fused"](probs, m)
+            return res, mask.sum()
+    """) == ["donation-after-use"]
+    # donate the original, read the alias
+    assert rules_fired("""
+        def step(fns, probs, mask):
+            m = mask
+            res = fns["mc_fused"](probs, mask)
+            return res, m.sum()
+    """) == ["donation-after-use"]
+    # aliases chase attribute chains too (the persistent-buffer idiom)
+    assert rules_fired("""
+        import jax
+
+        _scatter = jax.jit(_impl, donate_argnums=0)
+
+        def stage(self, rows, p):
+            buf = self.device.probs
+            self.device.probs = _scatter(buf, rows, p)
+            return buf
+    """) == ["donation-after-use"]
+
+
+def test_donation_alias_rebind_is_clean_and_carries_consumption():
+    """Rebinding breaks exactly ONE link: the rebound name is fresh,
+    while a surviving alias still holds the spent buffer."""
+    # the repo idiom through an alias: rebind it to the returned buffer
+    assert rules_fired("""
+        def step(fns, probs, mask):
+            m = mask
+            res = fns["mc_fused"](probs, m)
+            m = res.pool_mask
+            return res, m.sum()
+    """) == []
+    # rebinding the alias TARGET does not launder the alias: m still
+    # references the donated buffer after mask moves on
+    assert rules_fired("""
+        def step(fns, probs, mask):
+            m = mask
+            res = fns["mc_fused"](probs, mask)
+            mask = res.pool_mask
+            return res, m.sum()
+    """) == ["donation-after-use"]
+    # ... and the rebound target itself reads clean
+    assert rules_fired("""
+        def step(fns, probs, mask):
+            m = mask
+            res = fns["mc_fused"](probs, mask)
+            mask = res.pool_mask
+            return res, mask.sum()
+    """) == []
+
+
 # -- rule 2a: prng-literal-key ----------------------------------------------
 
 

@@ -1,0 +1,419 @@
+"""Pool-axis mesh serving: sharded-scorer parity, mesh telemetry keys,
+devices-aware placement, and the config seams that compose them.
+
+The headline pin: every acquisition mode — fused select→reveal→mask
+included — scores BIT-IDENTICALLY on a pool-axis mesh and on a single
+device (row-local reductions never cross the sharded axis), for the
+single-user family and the vmapped mesh × users fleet family alike.
+Tier-1 keeps the 2-device parity sweep, the pure validation/placement
+units, the (fn, width, n_devices) telemetry determinism and ONE
+mesh-arm serve run pinning device-keyed compile events; the 4/8-device
+sweep and the sharded-worker SIGKILL failover drill are ``slow`` and
+run via ``scripts/mesh_check.sh``.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from consensus_entropy_tpu.obs import export, jit_telemetry
+from consensus_entropy_tpu.ops import scoring
+from consensus_entropy_tpu.parallel import pool_mesh
+from consensus_entropy_tpu.parallel.pool_mesh import (
+    make_pool_mesh_for,
+    make_sharded_step_fns,
+    match_partition_rules,
+    sharded_fleet_fns_for_width,
+    sharded_probs_buffer,
+    sharded_scatter_rows,
+)
+from consensus_entropy_tpu.serve import FabricConfig, ServeConfig
+from consensus_entropy_tpu.serve.placement import place, plan_failover
+
+pytestmark = pytest.mark.mesh
+
+#: single-user operand geometry for the parity sweeps — N divides every
+#: mesh width the tests build (2, 4 and 8 of the harness's 8 virtual
+#: devices)
+M, N, C = 3, 16, 4
+
+#: the single-user family keys (the ``*_masked`` variants exist only in
+#: the vmapped fleet families)
+_STEP_KEYS = tuple(k for k in pool_mesh._OPERANDS
+                   if not k.endswith("_masked"))
+
+
+def _operand_values(seed=11):
+    """One coherent operand set covering every scorer's signature.
+    Plain numpy — each call transfers fresh device buffers, so the
+    donated fused arms never see a consumed input."""
+    import jax
+
+    rng = np.random.default_rng(seed)
+    probs = rng.random((M, N, C)).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    hc_freq = rng.random((N, C)).astype(np.float32)
+    hc_freq /= hc_freq.sum(-1, keepdims=True)
+    hc_ent = (-np.sum(hc_freq * np.log(hc_freq), axis=-1)
+              ).astype(np.float32)
+    pool_mask = rng.random(N) < 0.8
+    pool_mask[:4] = True  # always enough valid rows for top-k
+    hc_mask = rng.random(N) < 0.8
+    hc_mask[:4] = True
+    return {"probs": probs, "pool_mask": pool_mask, "hc_freq": hc_freq,
+            "hc_mask": hc_mask, "hc_ent": hc_ent,
+            "weights": (rng.random(M) + 0.5).astype(np.float32),
+            "key": jax.random.PRNGKey(3)}
+
+
+def _args_for(fn_key, vals):
+    return tuple(vals[op] for op in pool_mesh._OPERANDS[fn_key])
+
+
+def _assert_results_equal(fn_key, got, want):
+    for field, a, b in zip(want._fields, got, want):
+        if b is None:
+            assert a is None, (fn_key, field)
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=f"{fn_key}.{field} diverged under sharding")
+
+
+def _step_parity(n_devices, k=2):
+    """All modes, fused included: sharded vs single-device, bit-exact."""
+    mesh = make_pool_mesh_for(n_devices)
+    sharded = make_sharded_step_fns(mesh, k=k)
+    base = scoring.make_scoring_fns(k=k)
+    vals = _operand_values()
+    for fn_key in _STEP_KEYS:
+        got = sharded[fn_key](*_args_for(fn_key, vals))
+        want = base[fn_key](*_args_for(fn_key, vals))
+        _assert_results_equal(fn_key, got, want)
+
+
+def _fleet_parity(n_devices, keys, k=2, users=2, width=N):
+    """The mesh × users composition: stacked-bucket scorers sharded on
+    the trailing pool axis vs the unsharded vmapped family."""
+    from consensus_entropy_tpu.ops.scoring import (
+        fleet_scoring_fns_for_width,
+        stack_user_keys,
+    )
+
+    mesh = make_pool_mesh_for(n_devices)
+    sharded = sharded_fleet_fns_for_width(mesh, k=k, width=width)
+    base = fleet_scoring_fns_for_width(k=k, width=width)
+    per_user = [_operand_values(seed=20 + u) for u in range(users)]
+    stacked = {op: np.stack([vals[op] for vals in per_user])
+               for op in ("probs", "pool_mask", "hc_freq", "hc_mask",
+                          "hc_ent", "weights")}
+    import jax
+
+    stacked["key"] = stack_user_keys(
+        [jax.random.PRNGKey(50 + u) for u in range(users)])
+    stacked["member_mask"] = np.array([[True, True, False]] * users)
+    for fn_key in keys:
+        args = tuple(stacked[op]
+                     for op in pool_mesh._OPERANDS[fn_key])
+        _assert_results_equal(fn_key, sharded[fn_key](*args),
+                              base[fn_key](*args))
+
+
+# -- pure validation units -------------------------------------------------
+
+
+def test_mesh_construction_and_partition_rule_validation():
+    """Config-time errors surface as one clean message each: mesh bounds
+    name the CI device-count knob, unmatched operands name themselves,
+    and a non-dividing bucket width is rejected at family lookup."""
+    with pytest.raises(ValueError, match="at least 1 device"):
+        make_pool_mesh_for(0)
+    with pytest.raises(ValueError,
+                       match="xla_force_host_platform_device_count"):
+        make_pool_mesh_for(64)
+    assert make_pool_mesh_for(2).size == 2
+    assert make_pool_mesh_for(2) is make_pool_mesh_for(2)  # cached
+    with pytest.raises(ValueError, match="no partition rule"):
+        match_partition_rules(("probs", "bogus_operand"))
+    mesh = make_pool_mesh_for(4)
+    with pytest.raises(ValueError, match="does not divide across"):
+        sharded_fleet_fns_for_width(mesh, k=2, width=10)
+    # a mis-routed session still fails loudly at dispatch (the unsharded
+    # family's width guard, plus the mesh spelling)
+    fns = sharded_fleet_fns_for_width(make_pool_mesh_for(2), k=2,
+                                      width=32)
+    vals = _operand_values()
+    with pytest.raises(ValueError, match="bucket routing error"):
+        fns["mc"](np.stack([vals["probs"]] * 2),
+                  np.stack([vals["pool_mask"]] * 2))
+
+
+def test_serve_and_fabric_config_mesh_validation():
+    """Mesh/composition flags fail at CONFIG CONSTRUCTION, not at first
+    dispatch: device-count vs bucket-geometry mismatches and malformed
+    per-host shapes each get a clean error."""
+    with pytest.raises(ValueError, match="mesh_devices must be >= 1"):
+        ServeConfig(mesh_devices=0)
+    # the divisibility check runs on POST-ROUNDING widths (validate_
+    # bucket_widths pads to a multiple of 8): (16, 24) stays (16, 24)
+    # and 24 does not split 16 ways
+    with pytest.raises(ValueError, match="do not divide"):
+        ServeConfig(mesh_devices=16, bucket_widths=(16, 24))
+    with pytest.raises(ValueError, match="power of\\s+two"):
+        ServeConfig(mesh_devices=6)  # implicit pow2/planner geometry
+    ServeConfig(mesh_devices=4, bucket_widths=(16, 32))
+    ServeConfig(mesh_devices=4)
+    with pytest.raises(ValueError, match="names 2 hosts but hosts=3"):
+        FabricConfig(hosts=3, mesh_devices=(4, 1))
+    with pytest.raises(ValueError, match="entry must be\\s+>= 1"):
+        FabricConfig(hosts=2, mesh_devices=(4, 0))
+    fc = FabricConfig(hosts=2, mesh_devices=(4, 1))
+    assert fc.devices_for(0) == 4 and fc.devices_for(1) == 1
+    assert fc.devices_for(5) == 1  # autoscaler scale-ups default 1 chip
+    assert FabricConfig(hosts=2, mesh_devices=4).devices_for(7) == 4
+
+
+def test_placement_devices_key_is_legacy_compatible_and_chip_aware():
+    """Chips-per-host heterogeneity: a 4-chip worker attracts the
+    wide-pool buckets — but ONLY when someone advertises >1 chip, and
+    only behind co-location; with no (or all-1-chip) device info the
+    PR 5 key is reproduced bit-for-bit."""
+    loads = {"h0": 1, "h1": 1}
+    empty = {"h0": {}, "h1": {}}
+    # legacy identity: None, {}, and explicit 1-chip maps all agree
+    for devices in (None, {}, {"h0": 1, "h1": 1}):
+        assert place(32, loads=loads, buckets_by_host=empty,
+                     devices=devices) == "h0"
+    # the 4-chip host wins the wide bucket the id-tiebreak gave to h0
+    assert place(32, loads=loads, buckets_by_host=empty,
+                 devices={"h1": 4}) == "h1"
+    # a non-dividing mesh would be a routing error at dispatch: the
+    # 1-chip host (1 divides everything) outranks a 16-chip one for a
+    # width-24 bucket
+    assert place(24, loads=loads, buckets_by_host=empty,
+                 devices={"h0": 1, "h1": 16}) == "h0"
+    # co-location still dominates chips
+    assert place(32, loads=loads,
+                 buckets_by_host={"h0": {32: 2}, "h1": {}},
+                 devices={"h1": 4}) == "h0"
+    # plan_failover threads devices: both same-bucket victims land on
+    # the wide survivor together
+    from types import SimpleNamespace
+
+    state = SimpleNamespace(assigned={}, pools={"a": 30, "b": 30})
+    plan = plan_failover(["a", "b"], state=state, unresolved=[],
+                         hosts=["h1", "h2"],
+                         devices={"h1": 4, "h2": 1})
+    assert plan == [("a", "h1"), ("b", "h1")]
+
+
+# -- sharded parity --------------------------------------------------------
+
+
+def test_sharded_step_parity_all_modes_two_devices():
+    """THE acceptance pin (tier-1 case): all six acquisition modes —
+    the FUSED select→reveal→mask graphs included, donation intact —
+    score bit-identically on a 2-device pool mesh and on one device.
+    Row-local reductions never cross the sharded axis, so this is exact
+    equality, not allclose."""
+    _step_parity(2)
+
+
+def test_sharded_fleet_and_scatter_parity_two_devices():
+    """The mesh × users composition and the sharded pool-state plumbing:
+    stacked-bucket scorers (masked + fused + PRNG arms) match the
+    unsharded vmapped family bit-for-bit, and the donated sharded
+    scatter composes like the host-side update it replaces."""
+    _fleet_parity(2, ("mc_fused", "mix_fused", "wmc_masked", "rand",
+                      "hc_pre_fused"))
+    mesh = make_pool_mesh_for(2)
+    scatter = sharded_scatter_rows(mesh)
+    buf = sharded_probs_buffer(mesh, M, N, C)
+    rng = np.random.default_rng(5)
+    p1 = rng.random((M, 3, C)).astype(np.float32)
+    p2 = rng.random((M, 2, C)).astype(np.float32)
+    # N (=16) is an OOB staging slot: dropped, like the host pad rows
+    buf = scatter(buf, np.array([1, 5, N]), p1)
+    buf = scatter(buf, np.array([5, 7]), p2)
+    want = np.zeros((M, N, C), np.float32)
+    want[:, [1, 5]] = p1[:, :2]
+    want[:, [5, 7]] = p2
+    np.testing.assert_array_equal(np.asarray(buf), want)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n_devices", [4, 8])
+def test_sharded_parity_device_sweep(n_devices):
+    """Acceptance: the same bit-exact parity holds across the mesh-width
+    sweep (every width the 8-virtual-device harness can host), fleet
+    family included — ``scripts/mesh_check.sh`` runs this leg."""
+    _step_parity(n_devices)
+    _fleet_parity(n_devices, tuple(pool_mesh._OPERANDS))
+
+
+# -- (fn, width, n_devices) jit-family telemetry ---------------------------
+
+
+def test_mesh_jit_families_keyed_and_deterministic_across_reset():
+    """Mesh families land in telemetry keyed per (fn, width, n_devices)
+    — and the family SET is a pure function of the lookups: after an
+    in-process restart (``_reset_for_tests`` drops family state; the
+    jit caches stay warm) the same lookups rebuild the identical label
+    set with zero new builds."""
+    events = []
+    jit_telemetry.subscribe(events.append)
+    try:
+        # a distinctive k no other test builds mesh families for
+        mesh = make_pool_mesh_for(2)
+        make_sharded_step_fns(mesh, k=6)
+        make_sharded_step_fns(mesh, k=6)
+        sharded_fleet_fns_for_width(mesh, k=6, width=16)
+    finally:
+        jit_telemetry.unsubscribe(events.append)
+    snap = jit_telemetry.snapshot()
+    fam = snap["per_family"]["scoring:k6:fast/d2"]
+    assert fam["builds"] == 1 and fam["lookups"] >= 2
+    assert fam["hits"] == fam["lookups"] - 1
+    assert snap["per_family"]["fleet:k6:fast@w16/d2"]["builds"] == 1
+    assert {(e["fn"], e.get("width"), e.get("n_devices"))
+            for e in events if e.get("phase") == "build"} \
+        == {("scoring:k6:fast", None, 2), ("fleet:k6:fast", 16, 2)}
+    mine = sorted(l for l in jit_telemetry.family_labels()
+                  if ":k6:" in l and l.endswith("/d2"))
+    assert mine == ["fleet:k6:fast@w16/d2", "scoring:k6:fast/d2"]
+    # in-process restart: family state drops, the lru caches stay warm
+    jit_telemetry._reset_for_tests()
+    make_sharded_step_fns(mesh, k=6)
+    sharded_fleet_fns_for_width(mesh, k=6, width=16)
+    snap2 = jit_telemetry.snapshot()
+    assert sorted(jit_telemetry.family_labels()) == mine
+    for label in mine:
+        assert snap2["per_family"][label]["builds"] == 0  # warm cache
+        assert snap2["per_family"][label]["lookups"] == 1
+
+
+@pytest.mark.serve
+def test_serve_mesh_run_emits_device_keyed_compile_events(tmp_path):
+    """A mesh-arm serve run: results match the unsharded geometry's
+    ground truth, the scheduler's compile events carry the REAL device
+    count, and a restarted run re-looks-up the same family set with no
+    new builds (the satellite-4 determinism pin, mesh edition)."""
+    from consensus_entropy_tpu.fleet import (
+        FleetReport,
+        FleetScheduler,
+        FleetUser,
+    )
+    from consensus_entropy_tpu.serve import AdmissionJournal, FleetServer
+    from tests.fabric_workload import make_cfg, make_committee, make_data
+
+    cfg = make_cfg(mode="mc", epochs=2, queries=5)
+
+    def serve_once(tag):
+        report = FleetReport(str(tmp_path / f"metrics_{tag}.jsonl"))
+        sched = FleetScheduler(cfg, report=report, scoring_by_width=True,
+                               user_timings=False)
+        server = FleetServer(
+            sched, ServeConfig(target_live=2, mesh_devices=2),
+            journal=AdmissionJournal(str(tmp_path / "journal.jsonl")))
+        assert sched.mesh is not None and sched.mesh.size == 2
+        entries = []
+        for i in range(2):
+            data = make_data(cfg.seed, f"u{i}", n_songs=30, mode="mc")
+            ws = str(tmp_path / tag / f"u{i}")
+            os.makedirs(ws)
+            entries.append(FleetUser(
+                data.user_id, make_committee(data, mode="mc"), data, ws,
+                seed=cfg.seed))
+        recs = server.serve(iter(entries))
+        server.journal.close()
+        report.close()
+        assert all(r["error"] is None for r in recs)
+        evs = export.read_jsonl_tolerant(
+            str(tmp_path / f"metrics_{tag}.jsonl"))
+        return [e for e in evs if e.get("event") == "compile"]
+
+    first = serve_once("a")
+    # the scheduler's one bucket built its mesh fleet family under the
+    # real device count — and every event naming a mesh family says so
+    built = {(e["fn"], e.get("width"), e.get("n_devices"))
+             for e in first if e.get("phase") == "build"}
+    assert ("fleet:k5:fast", 32, 2) in built
+    fleet_evs = [e for e in first if e["fn"].startswith("fleet:")]
+    assert fleet_evs and all(e.get("n_devices") == 2 for e in fleet_evs)
+    assert "fleet:k5:fast@w32/d2" in jit_telemetry.family_labels()
+    # restart: same journal dir, same users — the family set replays
+    # exactly (no new builds; any xla events name a known family)
+    again = serve_once("b")
+    assert [e for e in again if e.get("phase") == "build"] == []
+    assert {(e["fn"], e.get("width"), e.get("n_devices"))
+            for e in again} \
+        <= {(e["fn"], e.get("width"), e.get("n_devices")) for e in first}
+
+
+# -- the sharded-worker failover drill -------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.serve
+@pytest.mark.faults
+def test_mesh_worker_sigkill_fails_over_to_narrow_survivor(tmp_path):
+    """Acceptance (``scripts/mesh_check.sh`` leg 2): a 2-host fabric
+    whose h0 serves SHARDED over a 4-device pool mesh is SIGKILLed
+    mid-iteration; its users fail over to the 1-chip survivor and
+    finish with trajectories bit-identical to uninterrupted sequential
+    runs — sharded partial progress resumes exactly on an unsharded
+    host, because the sharded graphs are bit-equal, not merely close.
+    The victim's chip count rode its heartbeat into the coordinator
+    (the devices-aware placement feed)."""
+    from consensus_entropy_tpu.fleet import FleetReport
+    from consensus_entropy_tpu.serve import (
+        AdmissionJournal,
+        FabricCoordinator,
+    )
+    from tests.fabric_workload import (
+        make_cfg,
+        read_results,
+        sequential_baselines,
+        user_specs,
+    )
+    from tests.test_serve_fabric import (
+        _kill_on_first_admit,
+        _spawn_factory,
+        _with_deadline,
+    )
+
+    cfg = make_cfg("mc", epochs=2)
+    specs = user_specs(3)
+    seq = sequential_baselines(str(tmp_path), cfg, specs)
+    fabric_dir = str(tmp_path / "fabric")
+    os.makedirs(fabric_dir)
+    jp = os.path.join(fabric_dir, "serve_journal.jsonl")
+    journal = AdmissionJournal(jp)
+    report = FleetReport()
+    coord = FabricCoordinator(
+        journal, fabric_dir,
+        FabricConfig(hosts=2, lease_s=5.0, mesh_devices=(4, 1)),
+        report=report,
+        on_poll=_with_deadline(_kill_on_first_admit("h0")))
+    spawn = _spawn_factory(
+        fabric_dir, str(tmp_path), cfg, 3,
+        env_extra={"h0": {"CETPU_MESH_DEVICES": "4"}})
+    try:
+        summary = coord.run([u for _, u, _ in specs], spawn)
+    finally:
+        journal.close()
+    assert sorted(summary["finished"]) == [u for _, u, _ in specs]
+    assert summary["failed"] == [] and summary["poisoned"] == []
+    assert summary["revocations"] == 1
+    assert summary["hosts"]["h0"] == "revoked"
+    # the heartbeat advertised each host's chips before the kill
+    assert coord.hosts["h0"].devices == 4
+    assert coord.hosts["h1"].devices == 1
+    results = read_results(fabric_dir)
+    for _, uid, _ in specs:
+        assert results[uid]["error"] is None
+        assert results[uid]["result"]["trajectory"] \
+            == seq[uid]["trajectory"]
+        assert results[uid]["result"]["final_mean_f1"] \
+            == seq[uid]["final_mean_f1"]
