@@ -3432,6 +3432,88 @@ def run_mesh_suite(args_ns) -> int:
     return 0
 
 
+def run_durability_suite(args_ns) -> int:
+    """CRC-framed vs legacy journal overhead (ISSUE 19 acceptance).
+
+    Pure host, no device work: the same mixed admission workload
+    (enqueue/admit/finish over a recycled user set) is appended through
+    ``AdmissionJournal(frame=True)`` (the ``w1 <crc32> <json>`` default)
+    and ``frame=False`` (the pre-PR legacy plain-JSON arm), interleaved
+    per rep with best-of-reps throughput (the 2-vCPU drift protocol).
+    Replay parity is asserted EVERY rep — both arms must reconstruct
+    bit-identical state dicts and validate schema-clean — before any
+    throughput is reported.  Acceptance: the framed arm's append path
+    costs < 5% (CRC32 of the payload bytes is noise next to the
+    per-record fsync).  Redirect stdout to ``BENCH_durability_r<N>.json``
+    to commit the artifact."""
+    import os
+    import tempfile
+    import time
+
+    from consensus_entropy_tpu.serve.journal import (
+        AdmissionJournal,
+        validate_journal_file,
+    )
+
+    n = 5000
+    users = 50
+    reps = args_ns.reps
+    root = tempfile.mkdtemp(prefix="bench_durability_")
+    _log(f"durability: {n} appends x {reps} reps, framed vs legacy, "
+         f"parity every rep")
+
+    def workload(journal):
+        for i in range(n):
+            u = f"u{i % users}"
+            ev = ("enqueue", "admit", "finish")[i % 3]
+            journal.append(ev, u)
+
+    best = {"framed": {"append": 0.0, "replay": 0.0},
+            "legacy": {"append": 0.0, "replay": 0.0}}
+    for rep in range(reps):
+        states = {}
+        for arm, frame in (("framed", True), ("legacy", False)):
+            jp = os.path.join(root, f"j_{rep}_{arm}.jsonl")
+            t0 = time.perf_counter()
+            with AdmissionJournal(jp, frame=frame) as j:
+                workload(j)
+            best[arm]["append"] = max(
+                best[arm]["append"], n / (time.perf_counter() - t0))
+            t0 = time.perf_counter()
+            states[arm] = AdmissionJournal(jp).state.to_dict()
+            best[arm]["replay"] = max(
+                best[arm]["replay"], n / (time.perf_counter() - t0))
+            assert validate_journal_file(jp) == [], arm
+        assert states["framed"] == states["legacy"], \
+            f"rep {rep}: framed and legacy replay diverged"
+        _log(f"rep {rep}: parity ok, framed "
+             f"{best['framed']['append']:.0f} appends/s, legacy "
+             f"{best['legacy']['append']:.0f}")
+
+    overhead = (best["legacy"]["append"] / best["framed"]["append"]
+                - 1.0) * 100.0
+    assert overhead < 5.0, \
+        (f"CRC framing costs {overhead:.1f}% on the append path "
+         f"(acceptance < 5%)")
+    print(json.dumps({
+        "metric": "journal_framed_appends_per_sec",
+        "value": round(best["framed"]["append"], 1),
+        "unit": "appends/s",
+        "records": n,
+        "reps": reps,
+        "framed": {k: round(v, 1) for k, v in best["framed"].items()},
+        "legacy": {k: round(v, 1) for k, v in best["legacy"].items()},
+        "append_overhead_pct": round(overhead, 2),
+        "replay_overhead_pct": round(
+            (best["legacy"]["replay"] / best["framed"]["replay"] - 1.0)
+            * 100.0, 2),
+        "acceptance_append_overhead_lt_pct": 5.0,
+        "parity_bit_exact_all_reps": True,
+        **_provenance(),
+    }))
+    return 0
+
+
 def _mkdir(root, name):
     import os
 
@@ -3446,7 +3528,8 @@ def main(argv=None) -> int:
                                         "serve", "serve-fused", "slo",
                                         "serve-faults", "fabric", "elastic",
                                         "drain", "remedy", "soak", "mesh",
-                                        "qbdc", "cnn-fleet", "obs"),
+                                        "qbdc", "cnn-fleet", "obs",
+                                        "durability"),
                     default="linear",
                     help="linear: the north-star fused pool scoring; cnn: "
                          "Flax ShortChunkCNN committee inference "
@@ -3508,6 +3591,10 @@ def main(argv=None) -> int:
                          "(K, mode) with the per-iteration selection "
                          "digest asserted bit-equal to the unsharded "
                          "K=1 arm on every rep; "
+                         "durability: CRC-framed vs legacy journal "
+                         "append/replay throughput (pure host), replay "
+                         "parity asserted every rep, acceptance < 5%% "
+                         "append overhead; "
                          "qbdc: "
                          "dropout-committee scoring (K-sweep) + users/sec "
                          "+ per-user memory vs the stored-committee mc "
@@ -3636,6 +3723,9 @@ def main(argv=None) -> int:
         # steady-state: a seeded shaped-load trace played wall-clock
         # for --soak-s seconds, plus the compressed determinism replay
         return run_soak_suite(args_ns)
+    if args_ns.suite == "durability":
+        # pure host: framed vs legacy journal, no device work at all
+        return run_durability_suite(args_ns)
     if args_ns.suite == "mesh":
         if args_ns.mesh_child is not None:
             args_ns.pool = 100_000 if args_ns.pool is None else args_ns.pool

@@ -72,8 +72,14 @@ def create_user(users_root: str, pretrained_dir: str, user, mode: str,
 
 
 def mark_done(path: str) -> None:
-    with open(os.path.join(path, _DONE), "w") as f:
-        f.write("ok\n")
+    """The user-completion marker is durability-critical (a missing or
+    half-written one only costs a redo, but it gates the skip-forever
+    path) — written through the storage-integrity seam so crash drills
+    can fault it."""
+    from consensus_entropy_tpu.resilience import io as dio
+
+    dio.atomic_write(os.path.join(path, _DONE), b"ok\n",
+                     member="workspace")
 
 
 def load_committee(path: str, config: CNNConfig = CNNConfig(),

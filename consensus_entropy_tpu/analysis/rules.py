@@ -1024,7 +1024,81 @@ def check_event_schema(tree, ctx):
     return findings
 
 
-# -- rule 7: lock discipline -------------------------------------------------
+# -- rule 7: raw durable IO --------------------------------------------------
+
+
+#: the durability-critical surface: every byte written here is either a
+#: ledger (journal / WAL / feed), a lease, a checkpoint marker or a
+#:  quarantine sidecar — all must route through ``resilience.io`` so the
+#: ``io.*`` fault points cover them and the CRC framing discipline is
+#: uniform
+DURABLE_PREFIXES = (
+    PKG + "serve/",
+    PKG + "resilience/",
+)
+DURABLE_FILES = (
+    PKG + "al/workspace.py",
+)
+
+
+def _in_durable_scope(path: str) -> bool:
+    return path.startswith(DURABLE_PREFIXES) or path in DURABLE_FILES
+
+
+@register(
+    "raw-durable-io",
+    doc="no direct open(w/a/x) / os.replace / os.fsync in "
+        "durability-critical modules (route through resilience.io so "
+        "the io.* fault points and CRC framing cover the write)",
+    applies=_in_durable_scope)
+def check_raw_durable_io(tree, ctx):
+    """The storage-integrity guarantees (PR 19) hold only if every
+    durable byte flows through ONE seam: ``resilience.io`` is where the
+    ``io.write.*`` / ``io.fsync`` / ``io.rename`` fault points fire,
+    where short writes and silent fsync drops are injected in the kill
+    matrix, and where the CRC frame discipline lives.  A raw
+    ``open(path, "w")`` in serve/ or resilience/ is a write the fault
+    matrix cannot drill and fsck cannot reason about — it reintroduces
+    exactly the torn-write blind spot the seam closed.  Flags literal
+    write/append/exclusive open modes (positional or ``mode=``),
+    ``os.replace`` and ``os.fsync``.  Read opens, ``r+b`` byte-surgery
+    (the fault injector's corrupt action) and non-literal modes pass.
+    The sanctioned escapes — the seam's own primitives, zero-byte lock
+    siblings that carry no data — say so in a ``# cetpu: noqa`` why."""
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if name in ("os.replace", "os.fsync"):
+            findings.append(ctx.finding(
+                "raw-durable-io", node,
+                f"direct {name}() in a durability-critical module; use "
+                "resilience.io.replace/fsync (or atomic_write) so the "
+                "io.* fault points cover the commit"))
+            continue
+        if name not in ("open", "io.open", "builtins.open"):
+            continue
+        mode = None
+        if len(node.args) >= 2:
+            mode = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if not (isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)):
+            continue  # no/unknowable mode: a read, or runtime-chosen
+        if any(c in mode.value for c in "wax"):
+            findings.append(ctx.finding(
+                "raw-durable-io", node,
+                f"raw open(..., {mode.value!r}) in a durability-"
+                "critical module; route the write through "
+                "resilience.io (open_append/atomic_write/write) so "
+                "fault drills and CRC framing cover it"))
+    return findings
+
+
+# -- rule 8: lock discipline -------------------------------------------------
 
 
 _LOCK_CTORS = ("threading.Lock", "threading.RLock", "Lock", "RLock")

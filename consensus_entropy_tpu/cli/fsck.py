@@ -1,0 +1,274 @@
+"""cetpu-fsck — offline integrity check for a serve users directory.
+
+Scans every durable artifact a run leaves behind and verifies it frame
+by frame, without importing jax (pure host: CI can gate on it).
+
+- **journal / WALs** (``serve_journal.jsonl`` + its ``.ckpt``,
+  ``serve_poison.jsonl``, ``fabric/events_*.jsonl`` /
+  ``fabric/assign_*.jsonl``): every complete line must be a valid CRC
+  frame (or parseable legacy JSON); a torn TAIL — the expected SIGKILL
+  artifact — is reported but not an error.  The MAIN journal
+  additionally gets the structural replay validation
+  (:func:`~consensus_entropy_tpu.serve.journal.validate_journal_file`:
+  known events, required fields, seq monotonicity).
+- **checkpoints** (any ``CETPU1`` container under the tree —
+  committee ``*.msgpack``, AL state snapshots): header parse + payload
+  CRC, using the container format directly so no model code loads.
+- **stale temporaries**: ``*.tmp`` siblings a killed
+  compaction/atomic-write left behind (writers sweep their OWN on next
+  open; fsck reports strays anywhere).
+
+``--repair`` quarantines corrupt/torn WAL lines into each file's
+``.quarantine`` sidecar (single-writer locked — a LIVE writer makes the
+file unrepairable, never racily rewritten), deletes stale temporaries,
+and re-verifies.  Corrupt checkpoints are never "repaired" (there is no
+redundancy to rebuild from) — recovery rolls back to the previous
+committed generation (``al.state.recover_workspace``); fsck just makes
+the damage visible before a run trusts the file.
+
+Exit codes: **0** clean (or everything repaired and re-verified),
+**1** corruption found (and left, or unrepairable-by-design like a
+checkpoint), **2** repair impossible (live writer holds the WAL lock,
+or the filesystem refused).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import struct
+import sys
+import zlib
+
+#: the checkpoint container magic (``utils.checkpoint``) — matched
+#: byte-wise here so fsck never imports the jax/flax loader stack
+_CKPT_MAGIC = b"CETPU1\n"
+
+
+def find_wals(users_dir: str) -> list[str]:
+    """Every single-writer ledger file under ``users_dir``: the main
+    journal + compaction checkpoint, the poison list, and each worker's
+    event/assignment WAL.  Telemetry streams (metrics, spans, logs) are
+    deliberately absent — their readers are tolerant by contract."""
+    out = []
+    for name in ("serve_journal.jsonl", "serve_journal.jsonl.ckpt",
+                 "serve_poison.jsonl"):
+        p = os.path.join(users_dir, name)
+        if os.path.exists(p):
+            out.append(p)
+    fabric = os.path.join(users_dir, "fabric")
+    out += sorted(glob.glob(os.path.join(fabric, "events_*.jsonl")))
+    out += sorted(glob.glob(os.path.join(fabric, "assign_*.jsonl")))
+    return out
+
+
+def find_checkpoints(users_dir: str) -> list[str]:
+    """Every ``CETPU1`` container under the tree (sniffed by magic, not
+    extension — workspaces hold ``.msgpack`` members and state blobs)."""
+    out = []
+    for root, _dirs, files in os.walk(users_dir):
+        for name in sorted(files):
+            if name.endswith(".tmp"):
+                continue
+            p = os.path.join(root, name)
+            try:
+                with open(p, "rb") as f:
+                    if f.read(len(_CKPT_MAGIC)) == _CKPT_MAGIC:
+                        out.append(p)
+            except OSError:
+                continue
+    return out
+
+
+def find_stale_tmps(users_dir: str) -> list[str]:
+    out = []
+    for root, _dirs, files in os.walk(users_dir):
+        out += [os.path.join(root, n) for n in sorted(files)
+                if n.endswith(".tmp")]
+    return out
+
+
+def verify_checkpoint(path: str) -> str | None:
+    """None when the container verifies, else a human-readable error.
+    Mirrors ``utils.checkpoint.load_variables``'s integrity half
+    (truncation + payload CRC) without deserializing the pytree."""
+    try:
+        with open(path, "rb") as f:
+            f.read(len(_CKPT_MAGIC))  # caller already matched the magic
+            raw_len = f.read(4)
+            if len(raw_len) != 4:
+                return "truncated header"
+            (hlen,) = struct.unpack("<I", raw_len)
+            raw_meta = f.read(hlen)
+            if len(raw_meta) != hlen:
+                return "truncated meta"
+            try:
+                meta = json.loads(raw_meta.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                return "unparseable meta header"
+            payload = f.read()
+    except OSError as e:
+        return f"unreadable: {e}"
+    crc = meta.get("crc32") if isinstance(meta, dict) else None
+    if crc is None:
+        return None  # pre-CRC checkpoint: loadable by contract
+    got = zlib.crc32(payload)
+    if got != crc:
+        return f"payload CRC mismatch (expected {crc}, got {got})"
+    return None
+
+
+def scan_users_dir(users_dir: str) -> dict:
+    """The full report: per-WAL frame scans, checkpoint verdicts, stale
+    temporaries, and the main journal's structural errors."""
+    from consensus_entropy_tpu.resilience import io as dio
+    from consensus_entropy_tpu.serve.journal import validate_journal_file
+
+    report: dict = {"users_dir": users_dir, "wals": [], "checkpoints": [],
+                    "stale_tmps": find_stale_tmps(users_dir),
+                    "journal_errors": []}
+    for path in find_wals(users_dir):
+        report["wals"].append(dio.scan_wal(path))
+    main = os.path.join(users_dir, "serve_journal.jsonl")
+    if os.path.exists(main):
+        report["journal_errors"] = validate_journal_file(main)
+    for path in find_checkpoints(users_dir):
+        report["checkpoints"].append(
+            {"path": path, "error": verify_checkpoint(path)})
+    return report
+
+
+def _wal_bad(scan: dict) -> bool:
+    return bool(scan["corrupt"]) or scan["torn_tail"]
+
+
+def repair_users_dir(users_dir: str, report: dict) -> dict:
+    """Quarantine corrupt/torn WAL lines and sweep stale temporaries.
+    Returns ``{"repaired": [...], "failed": [(path, why), ...]}``."""
+    from consensus_entropy_tpu.resilience import io as dio
+
+    repaired, failed = [], []
+    # sweep temporaries FIRST: repair_wal's atomic rewrite reuses the
+    # same ``<path>.tmp`` slot a killed compaction left behind
+    for tmp in report["stale_tmps"]:
+        try:
+            os.remove(tmp)
+            repaired.append({"path": tmp, "removed": True})
+        except FileNotFoundError:
+            pass
+        except OSError as e:
+            failed.append((tmp, f"remove failed: {e}"))
+    for scan in report["wals"]:
+        if not _wal_bad(scan):
+            continue
+        try:
+            res = dio.repair_wal(scan["path"])
+        except dio.WalLocked:
+            failed.append((scan["path"],
+                           "a live writer holds the WAL lock — stop the "
+                           "run (or let it finish) before repairing"))
+        except OSError as e:
+            failed.append((scan["path"], f"repair failed: {e}"))
+        else:
+            repaired.append({"path": scan["path"], **res})
+    return {"repaired": repaired, "failed": failed}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="cetpu-fsck", description=__doc__)
+    p.add_argument("users_dir",
+                   help="the run's users directory (holds "
+                        "serve_journal.jsonl and/or fabric/)")
+    p.add_argument("--repair", action="store_true",
+                   help="quarantine corrupt/torn WAL lines into "
+                        "<file>.quarantine sidecars, delete stale .tmp "
+                        "files, then re-verify")
+    p.add_argument("--json", action="store_true",
+                   help="emit the machine-readable report on stdout")
+    return p
+
+
+def _print_report(report: dict) -> int:
+    """Human summary; returns the number of integrity errors."""
+    errors = 0
+    for scan in report["wals"]:
+        state = []
+        if scan["corrupt"]:
+            errors += len(scan["corrupt"])
+            state.append(f"{len(scan['corrupt'])} corrupt")
+        if scan["torn_tail"]:
+            state.append("torn tail")
+        label = ", ".join(state) if state else "ok"
+        print(f"  wal  {scan['path']}: {scan['lines']} line(s), {label}")
+        for c in scan["corrupt"]:
+            print(f"         line {c['line']} (byte {c['off']}): "
+                  f"{c['reason']}")
+    for err in report["journal_errors"]:
+        errors += 1
+        print(f"  journal  {err}")
+    for ck in report["checkpoints"]:
+        if ck["error"]:
+            errors += 1
+            print(f"  ckpt {ck['path']}: {ck['error']}")
+        else:
+            print(f"  ckpt {ck['path']}: ok")
+    for tmp in report["stale_tmps"]:
+        print(f"  tmp  {tmp}: stale temporary (a killed writer's "
+              "leftover; --repair removes)")
+    return errors
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if not os.path.isdir(args.users_dir):
+        print(f"cetpu-fsck: {args.users_dir}: not a directory",
+              file=sys.stderr)
+        return 2
+    report = scan_users_dir(args.users_dir)
+    errors = _print_report(report)
+    dirty = errors or report["stale_tmps"]
+    if not args.repair:
+        if dirty:
+            print(f"cetpu-fsck: {errors} integrity error(s), "
+                  f"{len(report['stale_tmps'])} stale tmp(s) in "
+                  f"{args.users_dir}")
+        else:
+            print(f"cetpu-fsck: clean — {args.users_dir}")
+        if args.json:
+            print(json.dumps(report, indent=2))
+        return 1 if dirty else 0
+    actions = repair_users_dir(args.users_dir, report)
+    for r in actions["repaired"]:
+        print(f"  repaired {r['path']}: "
+              + (f"quarantined {r['dropped']} line(s) -> "
+                 f"{r['quarantine']}" if "dropped" in r else "removed"))
+    for path, why in actions["failed"]:
+        print(f"  FAILED {path}: {why}")
+    # re-verify: the only trustworthy definition of "repaired"
+    after = scan_users_dir(args.users_dir)
+    remaining = sum(len(s["corrupt"]) + (1 if s["torn_tail"] else 0)
+                    for s in after["wals"])
+    remaining += len(after["journal_errors"])
+    ckpt_bad = sum(1 for c in after["checkpoints"] if c["error"])
+    if args.json:
+        print(json.dumps({"before": report, "after": after,
+                          "actions": {"repaired": actions["repaired"],
+                                      "failed": actions["failed"]}},
+                         indent=2))
+    if actions["failed"]:
+        print("cetpu-fsck: repair incomplete (see FAILED above)")
+        return 2
+    if remaining or ckpt_bad:
+        # corrupt checkpoints (no redundancy) or residual journal
+        # structure errors survive repair by design: report, exit 1
+        print(f"cetpu-fsck: {remaining} WAL/journal error(s) and "
+              f"{ckpt_bad} corrupt checkpoint(s) remain after repair")
+        return 1
+    print(f"cetpu-fsck: repaired and re-verified — {args.users_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

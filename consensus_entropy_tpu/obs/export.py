@@ -104,6 +104,15 @@ EVENT_FIELDS = {
     # from the workspace over the journal re-admission path)
     "disconnect": {"user": "str"},
     "reconnect": {"user": "str"},
+    # storage integrity (resilience.io + fencing epochs): an injected or
+    # real disk fault surfaced through the io seam; a corrupt WAL record
+    # CRC-quarantined to its sidecar; a coordinator incarnation claiming
+    # its fencing epoch; a stale incarnation's feed line or ack refused
+    # (epoch_fenced also carries ``user`` when the line named one)
+    "io_fault": {"kind": "str", "path": "str"},
+    "record_quarantined": {"host": "str", "path": "str"},
+    "epoch_claim": {"epoch": "int"},
+    "epoch_fenced": {"host": "str", "epoch": "int"},
     # stream-closing summaries (no t_s)
     "fleet_summary": {},
     "fabric_summary": {},
@@ -128,17 +137,20 @@ def read_jsonl_tolerant(path: str) -> list[dict]:
     """Read a JSONL telemetry file, SKIPPING a torn tail line (the
     expected SIGKILL artifact — the same discipline ``serve.journal``
     applies to its WALs) and any other unparseable line, instead of
-    raising.  Non-dict lines are dropped too."""
+    raising.  Non-dict lines are dropped too.  CRC-framed journal lines
+    (``w1 <crc> {...}``, the storage-integrity format) are unframed
+    transparently — a frame failing its CRC is skipped like any other
+    corrupt line, because these readers OBSERVE; only replay halts."""
+    from consensus_entropy_tpu.resilience import io as dio
     out: list[dict] = []
     if not os.path.exists(path):
         return out
     with open(path, "rb") as f:
         for raw in f:
-            try:
-                rec = json.loads(raw.decode("utf-8"))
-            except (ValueError, UnicodeDecodeError):
+            status, rec = dio.parse_frame(raw)
+            if status == "corrupt":
                 continue  # torn/corrupt line: telemetry, not a ledger
-            if isinstance(rec, dict):
+            if isinstance(rec, dict) and not dio.is_header(rec):
                 out.append(rec)
     return out
 

@@ -95,6 +95,28 @@ FAULT_POINTS = frozenset({
     # iteration seed, so a kill here must resume bit-identically (same
     # masks, same consensus) from checkpoint/journal state
     "acquire.qbdc.masks",    # Committee.qbdc_pool_probs, pre-mask-sampling
+    # filesystem-seam boundaries (resilience.io): the disk-fault species
+    # below the process boundary — every journal/WAL/feed/lease/ckpt
+    # write routes through the seam, so these drill the BYTES themselves.
+    # The seam translates a ``raise`` action into the matching OSError
+    # (or drops the fsync); ``kill`` still dies at the boundary.  Seam
+    # calls carry member= context (wal/compact/lease/workspace) for
+    # per-family targeting.
+    "io.write.short",        # half the payload lands, then the action
+                             # fires (short-write-then-SIGKILL: the torn
+                             # frame must replay as never-written)
+    "io.write.enospc",       # raise → OSError(ENOSPC) before any byte
+    "io.write.eio",          # raise → OSError(EIO) before any byte
+    "io.fsync",              # raise → fsync silently DROPPED (lying
+                             # disk); kill → death at the barrier
+    "io.rename",             # raise → the atomic-rename commit point
+                             # fails as EIO (tmp sibling left for the
+                             # caller's cleanup path)
+    # coordinator fencing-epoch claim (serve.fabric): fires before the
+    # epoch record journals — a kill here dies unclaimed, and the
+    # restart re-derives the SAME epoch (correct: no feed line stamped
+    # with it ever reached a worker)
+    "fabric.epoch",
 })
 
 ACTIONS = ("kill", "raise", "transient", "corrupt", "delay")

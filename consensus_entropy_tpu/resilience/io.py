@@ -1,0 +1,343 @@
+"""The injectable filesystem seam + per-record CRC32 framing.
+
+Every durability-critical writer in the framework — the admission
+journal and per-host event WALs (:mod:`serve.journal`), the assignment
+feeds, the lease heartbeats (:mod:`serve.hosts`), compaction
+checkpoints, workspace DONE markers — routes its raw ``write`` /
+``fsync`` / ``rename`` syscalls through this module instead of calling
+them directly (enforced by the ``raw-durable-io`` lint rule).  That buys
+two things:
+
+1. **Disk-fault injection.**  Each seam call fires the matching ``io.*``
+   fault point, so the existing ``CETPU_FAULTS`` grammar can drill the
+   failure species real disks produce, at the exact byte boundary:
+
+   - ``io.write.short`` — the write lands HALF the payload and then the
+     fault action fires: ``kill`` models a short-write-then-SIGKILL
+     (torn frame on disk), ``raise`` a short write surfaced as ``EIO``.
+   - ``io.write.enospc`` / ``io.write.eio`` — a ``raise`` action is
+     translated into ``OSError(ENOSPC)`` / ``OSError(EIO)`` BEFORE any
+     byte lands, the errors callers must survive or die cleanly on.
+   - ``io.fsync`` — a ``raise`` action silently DROPS the fsync (the
+     lying-disk model: the write sits in the page cache and a power cut
+     would lose it); ``kill`` dies at the barrier.
+   - ``io.rename`` — a ``raise`` action fails the atomic-rename commit
+     point as ``EIO``, leaving the tmp sibling for cleanup paths.
+
+   Seam calls carry ``member=`` context (``wal`` / ``compact`` /
+   ``lease`` / ``workspace``) so rules can target one write family —
+   ``member``-filtered rules count hits per family, e.g. ENOSPC on the
+   compaction checkpoint only, never the appends around it.
+
+2. **Frame primitives.**  The ``w1`` record frame the journal/WAL layer
+   writes (one line per record)::
+
+       w1 <crc32 as 8 hex chars> <json payload>\\n
+
+   The CRC covers exactly the payload bytes, so a bit flip ANYWHERE in
+   a durably-written line is detected on read instead of silently
+   replayed.  Files open with a framed header record ``{"wal": 2}``;
+   legacy plain-JSON lines (pre-frame writers) still parse — see
+   :func:`parse_frame`.  Corrupt lines are quarantined into a
+   ``<path>.quarantine`` JSONL sidecar (offset + reason + raw bytes,
+   base64) by the repair paths, never silently dropped.
+
+Observability: :func:`add_listener` registers ``fn(kind, path)``
+callbacks fired on every injected io fault and every quarantined
+record — the fabric coordinator forwards them as ``io_fault`` /
+``record_quarantined`` events.  Listener errors are swallowed: telemetry
+must never turn a survivable disk fault into a new failure.
+"""
+
+from __future__ import annotations
+
+import base64
+import errno
+import json
+import os
+import zlib
+
+from consensus_entropy_tpu.resilience import faults
+
+try:
+    import fcntl
+except ImportError:  # non-POSIX: repair falls back to lock-less rewrite
+    fcntl = None
+
+#: frame version written in the header record ``{"wal": 2}`` (version 1
+#: is the implicit legacy plain-JSON format, which has no header)
+WAL_VERSION = 2
+_MAGIC = b"w1 "
+_CRC_LEN = 8  # crc32 as zero-padded hex
+
+# -- fault/quarantine listeners (the coordinator's obs bridge) -------------
+
+_listeners: list = []
+
+
+def add_listener(fn) -> None:
+    """Register ``fn(kind, path)`` for io-fault / quarantine events."""
+    _listeners.append(fn)
+
+
+def remove_listener(fn) -> None:
+    try:
+        _listeners.remove(fn)
+    except ValueError:
+        pass
+
+
+def _notify(kind: str, path: str) -> None:
+    for fn in list(_listeners):
+        try:
+            fn(kind, path)
+        except Exception:
+            pass  # observability must never amplify a disk fault
+
+
+# -- the syscall seam ------------------------------------------------------
+
+
+def open_append(path: str):
+    """Open ``path`` for appending (the WAL writers' open)."""
+    return open(path, "ab")  # cetpu: noqa[raw-durable-io] this IS the seam
+
+
+def write(f, data: bytes, *, path: str, member: str = "wal") -> None:
+    """Write ``data`` to handle ``f`` through the three write fault
+    points (short / ENOSPC / EIO).  The short-write point flushes its
+    half-payload before failing, so the torn bytes are really on disk
+    for the recovery path under test to trip over."""
+    try:
+        faults.fire("io.write.short", member=member, path=path)
+    except faults.InjectedKill:
+        f.write(data[: len(data) // 2])
+        f.flush()
+        _notify("io.write.short", path)
+        raise
+    except faults.InjectedFault as e:
+        f.write(data[: len(data) // 2])
+        f.flush()
+        _notify("io.write.short", path)
+        raise OSError(errno.EIO, f"injected short write: {path}") from e
+    try:
+        faults.fire("io.write.enospc", member=member, path=path)
+    except faults.InjectedFault as e:
+        _notify("io.write.enospc", path)
+        raise OSError(errno.ENOSPC,
+                      f"injected ENOSPC (disk full): {path}") from e
+    try:
+        faults.fire("io.write.eio", member=member, path=path)
+    except faults.InjectedFault as e:
+        _notify("io.write.eio", path)
+        raise OSError(errno.EIO, f"injected EIO: {path}") from e
+    f.write(data)
+
+
+def fsync(f, *, path: str, member: str = "wal") -> None:
+    """The durability barrier.  An injected ``raise`` here DROPS the
+    fsync silently (the lying-disk model — the caller believes the
+    record is durable); everything else fsyncs for real."""
+    try:
+        faults.fire("io.fsync", member=member, path=path)
+    except faults.InjectedFault:
+        _notify("io.fsync", path)
+        return
+    os.fsync(f.fileno())  # cetpu: noqa[raw-durable-io] this IS the seam
+
+
+def replace(src: str, dst: str, *, member: str = "wal") -> None:
+    """Atomic-rename commit point (``os.replace`` through the
+    ``io.rename`` fault point)."""
+    try:
+        faults.fire("io.rename", member=member, path=dst)
+    except faults.InjectedFault as e:
+        _notify("io.rename", dst)
+        raise OSError(errno.EIO, f"injected rename failure: {dst}") from e
+    os.replace(src, dst)  # cetpu: noqa[raw-durable-io] this IS the seam
+
+
+def atomic_write(path: str, data: bytes, *, member: str = "wal") -> None:
+    """Write-new-then-rename through the seam: a reader sees the old
+    content or the new, never a torn file.  A surfaced ``OSError``
+    (ENOSPC, EIO, rename failure) removes the tmp sibling before
+    propagating — only a genuine process death (``InjectedKill`` /
+    SIGKILL) can leak one, and the journal's open-time sweep reclaims
+    those."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as f:  # cetpu: noqa[raw-durable-io] this IS the seam
+            write(f, data, path=tmp, member=member)
+            f.flush()
+            fsync(f, path=tmp, member=member)
+        replace(tmp, path, member=member)
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+# -- record framing --------------------------------------------------------
+
+
+def frame_record(rec: dict) -> bytes:
+    """One framed JSONL line: ``w1 <crc32:08x> <json>\\n``."""
+    payload = json.dumps(rec).encode("utf-8")
+    crc = zlib.crc32(payload)
+    return _MAGIC + f"{crc:08x}".encode("ascii") + b" " + payload + b"\n"
+
+
+def frame_header() -> bytes:
+    """The framed version header a fresh WAL opens with."""
+    return frame_record({"wal": WAL_VERSION})
+
+
+def is_header(rec) -> bool:
+    """True for the ``{"wal": N}`` version-header record (carries no
+    event — readers skip it)."""
+    return isinstance(rec, dict) and "wal" in rec and "event" not in rec
+
+
+def parse_frame(line: bytes):
+    """Parse one complete line → ``(status, rec)``.
+
+    - ``("ok", rec)`` — a ``w1`` frame whose CRC matched.
+    - ``("legacy", rec)`` — a plain-JSON line (pre-frame writer).
+    - ``("corrupt", None)`` — a broken frame (bad CRC, mangled header,
+      unparseable payload) or a non-JSON legacy line.  The CALLER
+      decides tail-ness: a line without its newline is a torn tail
+      (expected crash artifact), anything else is bit-rot.
+
+    ``rec`` may be any JSON value; non-dict records are the caller's
+    ``isinstance`` problem, exactly as before framing."""
+    body = line[:-1] if line.endswith(b"\n") else line
+    if body.endswith(b"\r"):
+        body = body[:-1]
+    if body.startswith(_MAGIC):
+        crc_end = len(_MAGIC) + _CRC_LEN
+        if len(body) <= crc_end or body[crc_end:crc_end + 1] != b" ":
+            return ("corrupt", None)
+        try:
+            crc = int(body[len(_MAGIC):crc_end], 16)
+        except ValueError:
+            return ("corrupt", None)
+        payload = body[crc_end + 1:]
+        if zlib.crc32(payload) != crc:
+            return ("corrupt", None)
+        try:
+            return ("ok", json.loads(payload.decode("utf-8")))
+        except (ValueError, UnicodeDecodeError):
+            return ("corrupt", None)
+    try:
+        return ("legacy", json.loads(body.decode("utf-8")))
+    except (ValueError, UnicodeDecodeError):
+        return ("corrupt", None)
+
+
+# -- quarantine sidecar ----------------------------------------------------
+
+
+def quarantine_path(path: str) -> str:
+    return path + ".quarantine"
+
+
+def quarantine_append(path: str, *, off: int, raw: bytes,
+                      reason: str) -> str:
+    """Append one quarantine record (offset + reason + raw bytes,
+    base64) to ``<path>.quarantine``; returns the sidecar path.  One
+    buffered write + fsync per record — the sidecar is an audit trail,
+    never replayed, so readers AND writers of ``path`` may both append
+    to it."""
+    qpath = quarantine_path(path)
+    rec = {"off": int(off), "len": len(raw), "reason": reason,
+           "raw_b64": base64.b64encode(raw).decode("ascii")}
+    with open_append(qpath) as f:
+        write(f, (json.dumps(rec) + "\n").encode("utf-8"),
+              path=qpath, member="quarantine")
+        f.flush()
+        fsync(f, path=qpath, member="quarantine")
+    _notify("record_quarantined", path)
+    return qpath
+
+
+# -- scan / repair (the cetpu-fsck core) -----------------------------------
+
+
+def scan_wal(path: str) -> dict:
+    """Structural frame scan of one JSONL WAL.  Returns::
+
+        {"path", "lines", "ok", "legacy", "corrupt": [entry...],
+         "torn_tail": bool}
+
+    where each corrupt ``entry`` is ``{"line", "off", "len", "reason"}``
+    (1-based line, byte offset).  A final line missing its newline is
+    reported as ``torn_tail`` (the expected crash artifact), NOT as
+    corruption."""
+    out = {"path": path, "lines": 0, "ok": 0, "legacy": 0,
+           "corrupt": [], "torn_tail": False}
+    if not os.path.exists(path):
+        return out
+    with open(path, "rb") as f:
+        raws = f.readlines()
+    off = 0
+    for i, raw in enumerate(raws, 1):
+        out["lines"] += 1
+        if not raw.endswith(b"\n"):
+            out["torn_tail"] = True  # readlines: only the last line can
+            off += len(raw)
+            continue
+        status, _rec = parse_frame(raw)
+        if status == "corrupt":
+            out["corrupt"].append({"line": i, "off": off, "len": len(raw),
+                                   "reason": "frame CRC/parse failure"})
+        else:
+            out[status if status == "legacy" else "ok"] += 1
+        off += len(raw)
+    return out
+
+
+class WalLocked(RuntimeError):
+    """The WAL's writer lock is held — a live process owns this file;
+    repairing under it would race the single-writer discipline."""
+
+
+def repair_wal(path: str) -> dict:
+    """Drop every corrupt line (and any torn tail) out of ``path`` into
+    its quarantine sidecar and rewrite the file atomically.  Refuses to
+    run against a live writer (the ``<path>.lock`` flock —
+    :class:`WalLocked`).  Returns ``{"dropped": n, "quarantine": path
+    or None}``."""
+    lockf = None
+    if fcntl is not None:
+        lockf = open(path + ".lock", "ab")  # cetpu: noqa[raw-durable-io] zero-byte lock sibling, never fsynced
+        try:
+            fcntl.flock(lockf.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            lockf.close()
+            raise WalLocked(
+                f"{path}: a live writer holds this WAL's lock — stop the "
+                "server before repairing")
+    try:
+        with open(path, "rb") as f:
+            raws = f.readlines()
+        kept, dropped, qpath, off = [], 0, None, 0
+        for raw in raws:
+            torn = not raw.endswith(b"\n")
+            status = parse_frame(raw)[0] if not torn else "corrupt"
+            if status == "corrupt":
+                qpath = quarantine_append(
+                    path, off=off, raw=raw,
+                    reason="torn tail" if torn else "frame CRC/parse "
+                                                   "failure")
+                dropped += 1
+            else:
+                kept.append(raw)
+            off += len(raw)
+        if dropped:
+            atomic_write(path, b"".join(kept), member="repair")
+        return {"dropped": dropped, "quarantine": qpath}
+    finally:
+        if lockf is not None:
+            lockf.close()

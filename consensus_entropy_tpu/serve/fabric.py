@@ -107,6 +107,7 @@ import time
 from consensus_entropy_tpu.fleet.report import FleetReport
 from consensus_entropy_tpu.obs.metrics import ema as metrics_ema
 from consensus_entropy_tpu.resilience import faults
+from consensus_entropy_tpu.resilience import io as dio
 from consensus_entropy_tpu.serve import placement as placement_mod
 from consensus_entropy_tpu.serve.elastic import (
     FleetPlanner,
@@ -399,6 +400,27 @@ class FabricConfig:
                              f"{self.planner_buckets}")
 
 
+class _EpochFeed:
+    """Assignment-feed writer that stamps the coordinator's fencing
+    epoch (``ep``) on every line.  Workers latch the highest epoch seen
+    and reject lines below it, so a wedged predecessor's late writes can
+    never route users after a successor took over — the single-owner
+    invariant extended from SIGKILL to double-start.  Everything else
+    (``close``/``rotate``/``size``/``path``) passes through to the
+    wrapped :class:`~consensus_entropy_tpu.serve.journal.
+    _AppendFsyncFile`."""
+
+    def __init__(self, inner, epoch: int):
+        self._inner = inner
+        self.epoch = int(epoch)
+
+    def append(self, rec: dict) -> None:
+        self._inner.append({**rec, "ep": self.epoch})
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
 @dataclasses.dataclass(eq=False)
 class HostHandle:
     """Coordinator-side view of one worker host process."""
@@ -424,6 +446,9 @@ class HostHandle:
     #: JOIN); ``None`` until the first beat or for legacy workers —
     #: devices-aware placement treats it as 1
     devices: int | None = None
+    #: corrupt event-WAL lines already surfaced as ``record_quarantined``
+    #: (the tail's counter high-water mark)
+    corrupt_seen: int = 0
 
 
 class FabricCoordinator:
@@ -451,6 +476,13 @@ class FabricCoordinator:
         self.journal = journal
         self.fabric_dir = fabric_dir
         self.config = config
+        #: this incarnation's fencing epoch — one greater than any the
+        #: journal has seen, claimed DURABLY at the top of ``run`` (the
+        #: ``fabric.epoch`` fault point fires first).  Every assignment-
+        #: feed line carries it; workers latch the highest seen and
+        #: reject older lines, and acks echo it back so this coordinator
+        #: never commits a hand-off another incarnation negotiated.
+        self.epoch = journal.state.coordinator_epoch + 1
         self.poison = poison if poison is not None else PoisonList()
         self.report = report or FleetReport()
         self.on_poll = on_poll
@@ -625,6 +657,21 @@ class FabricCoordinator:
         — and leaves all recovery state durable in the journal."""
         os.makedirs(self.fabric_dir, exist_ok=True)
         self._spawn_fn = spawn
+        # claim this incarnation's fencing epoch FIRST — every feed line
+        # and echoed ack below carries it.  A kill at the fault point
+        # dies unclaimed; the restart re-derives the SAME number, which
+        # is correct because no line stamped with it ever reached a
+        # worker.  (A literal concurrent double-start on one filesystem
+        # dies earlier still: the journal's flock raises
+        # SingleWriterViolation on this very append.)
+        faults.fire("fabric.epoch", epoch=self.epoch)
+        self.journal.append("epoch", epoch=self.epoch)
+        self.report.event("epoch_claim", epoch=self.epoch)
+        # surface injected disk faults and quarantined records as fleet
+        # events for the whole run (removed in the finally below)
+        self._io_listener = lambda kind, path: self.report.event(
+            "io_fault", kind=kind, path=path)
+        dio.add_listener(self._io_listener)
         with self._intake_lock:  # a pre-run close_intake stays closed
             self._intake_open = keep_open and not self._intake_closed
         st = self.journal.state
@@ -716,7 +763,14 @@ class FabricCoordinator:
             self._close_hosts()
         except BaseException:
             self._kill_all()
+            # an in-process "death" (InjectedKill drills) must also drop
+            # the per-host channel handles — a real process death would
+            # release their single-writer flocks, and the successor
+            # incarnation reopens the same assign WALs
+            self._release_channels()
             raise
+        finally:
+            dio.remove_listener(self._io_listener)
         return self._summary()
 
     # -- live intake (the trace-driver producer surface) -------------------
@@ -983,7 +1037,9 @@ class FabricCoordinator:
         tail.seek(self.journal.state.host_cursor.get(host_id, 0))
         self.journal.append("lease", host=host_id,
                             pid=getattr(proc, "pid", None))
-        h = HostHandle(host_id, proc, _AppendFsyncFile(paths["assign"]),
+        h = HostHandle(host_id, proc,
+                       _EpochFeed(_AppendFsyncFile(paths["assign"]),
+                                  self.epoch),
                        tail, paths["lease"], self._clock())
         if self.tracer is not None and self.tracer.enabled:
             h.span_tail = JsonlTail(paths["spans"])
@@ -1736,6 +1792,15 @@ class FabricCoordinator:
             except Exception:
                 pass
 
+    def _release_channels(self) -> None:
+        for h in self.hosts.values():
+            for ch in (h.assign, h.tail, h.span_tail):
+                try:
+                    if ch is not None:
+                        ch.close()
+                except Exception:
+                    pass
+
     # -- the control-plane trace lane --------------------------------------
 
     def _ctl(self, name: str, *, key, flow_user=None, **attrs) -> None:
@@ -1918,8 +1983,11 @@ class FabricCoordinator:
                 # re-read after a coordinator restart (the cursor may
                 # predate it) just advances the cursor — the restart
                 # already re-routed every pending user from the journal
-                self.journal.append("drop", u, host=h.host_id,
-                                    src_off=off, ok=bool(rec.get("ok")))
+                self.journal.append(
+                    "drop", u, host=h.host_id, src_off=off,
+                    ok=bool(rec.get("ok")),
+                    **({"ep": rec["ep"]}
+                       if isinstance(rec.get("ep"), int) else {}))
                 # the ack span keys on (host, src_off) — the worker-WAL
                 # byte identity a stale re-read after a coordinator
                 # restart shares, so replay re-emits the SAME id and the
@@ -1928,6 +1996,16 @@ class FabricCoordinator:
                 self._ctl("ctl.rebalance", key=(h.host_id, off), user=u,
                           ok=bool(rec.get("ok")),
                           flow_user=u if rec.get("ok") else None)
+                ep = rec.get("ep")
+                if isinstance(ep, int) and ep != self.epoch:
+                    # an ack stamped by ANOTHER coordinator incarnation:
+                    # cursor-only (journaled above), and this run's own
+                    # pending state stays UNTOUCHED — committing a
+                    # predecessor's negotiated hand-off could double-own
+                    # the user the restart already re-routed
+                    self.report.event("epoch_fenced", user=u,
+                                      host=h.host_id, epoch=ep)
+                    continue
                 target = self._migrating.pop(u, None)
                 # whichever ack commits a deadline-demoted fence first
                 # (this drop, or the racing checkpoint fence) clears the
@@ -1974,9 +2052,11 @@ class FabricCoordinator:
                 # re-routed every unresolved user from the journal.
                 faults.fire("fabric.migrate.fence", user=u,
                             host=h.host_id)
-                self.journal.append("fence", u, host=h.host_id,
-                                    src_off=off, ok=bool(rec.get("ok")),
-                                    gen=rec.get("gen"))
+                self.journal.append(
+                    "fence", u, host=h.host_id, src_off=off,
+                    ok=bool(rec.get("ok")), gen=rec.get("gen"),
+                    **({"ep": rec["ep"]}
+                       if isinstance(rec.get("ep"), int) else {}))
                 self.report.event("migrate_fence", user=u,
                                   host=h.host_id,
                                   ok=bool(rec.get("ok")),
@@ -1986,6 +2066,13 @@ class FabricCoordinator:
                           host=h.host_id, ok=bool(rec.get("ok")),
                           gen=rec.get("gen"),
                           flow_user=u if rec.get("ok") else None)
+                ep = rec.get("ep")
+                if isinstance(ep, int) and ep != self.epoch:
+                    # foreign-incarnation fence ack: cursor-only, same
+                    # rule as stale drop acks above
+                    self.report.event("epoch_fenced", user=u,
+                                      host=h.host_id, epoch=ep)
+                    continue
                 src = self._fencing.pop(u, None)
                 self._fence_t.pop(u, None)
                 if src is None:
@@ -2059,9 +2146,25 @@ class FabricCoordinator:
                 if self.fleet_planner is not None:
                     self.fleet_planner.note_host_sketch(
                         h.host_id, rec.get("sketch"))
+            elif ev == "epoch_fenced":
+                # the worker refused a stale-incarnation feed line: fold
+                # the audit record (cursor advance) and surface it
+                self.journal.append("epoch_fenced", u, host=h.host_id,
+                                    src_off=off,
+                                    epoch=int(rec.get("epoch") or 0))
+                self.report.event("epoch_fenced", host=h.host_id,
+                                  epoch=int(rec.get("epoch") or 0),
+                                  **({"user": u} if u else {}))
             # worker-local enqueue/requeue records are flow bookkeeping,
             # not dispositions the fabric needs — skipped (their bytes
             # are covered by the next transcribed record's cursor)
+        if h.tail.corrupt > h.corrupt_seen:
+            # the tail skipped complete-but-corrupt WAL lines (bit-rot
+            # on another process's file — quarantined to the sidecar,
+            # never acted on): surface each batch once
+            self.report.event("record_quarantined", host=h.host_id,
+                              path=h.tail.path)
+            h.corrupt_seen = h.tail.corrupt
 
     def _note_finish(self) -> None:
         """Fold one observed user completion into the finish-interval

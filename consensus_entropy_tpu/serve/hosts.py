@@ -45,6 +45,7 @@ import zlib
 import numpy as np
 
 from consensus_entropy_tpu.resilience import faults
+from consensus_entropy_tpu.resilience import io as dio
 from consensus_entropy_tpu.resilience.retry import backoff_delay
 from consensus_entropy_tpu.serve.journal import AdmissionJournal, JsonlTail
 from consensus_entropy_tpu.serve.server import (
@@ -96,6 +97,38 @@ def lease_age_s(path: str, now: float | None = None) -> float | None:
     return (time.time() if now is None else now) - rec["t"]  # cetpu: noqa[replay-wallclock] this IS the seam's fallback (now= is the injection point)
 
 
+class EpochGate:
+    """Worker-side half of the coordinator fencing-epoch protocol (pure
+    logic — unit-testable without a fabric).
+
+    The coordinator stamps every assignment-feed line with its fencing
+    epoch (``ep``, claimed monotonically in the journal per
+    incarnation).  The gate latches the HIGHEST epoch it has seen and
+    :meth:`admit` rejects any line below it: once a successor
+    coordinator's first line arrives, a wedged predecessor's late writes
+    can never route users, request fences, or withdraw sessions here —
+    the split-brain half of the single-owner invariant.  Legacy feeds
+    (no ``ep`` field) pass untouched, and the latched epoch is echoed on
+    every ack so the coordinator can discard foreign-incarnation acks as
+    cursor-only."""
+
+    def __init__(self):
+        self.epoch: int | None = None
+        self.fenced = 0
+
+    def admit(self, rec: dict) -> bool:
+        ep = rec.get("ep")
+        if not isinstance(ep, int):
+            return True
+        if self.epoch is None or ep > self.epoch:
+            self.epoch = ep
+            return True
+        if ep < self.epoch:
+            self.fenced += 1
+            return False
+        return True
+
+
 class HostLease:
     """The worker's heartbeat writer (daemon thread).
 
@@ -136,17 +169,13 @@ class HostLease:
 
         self.beats += 1
         faults.fire("fabric.lease", host=self.host_id, beat=self.beats)
-        tmp = self.path + ".tmp"
         rec = {"host": self.host_id, "pid": os.getpid(),
                "beat": self.beats,
                "t": round(time.time(), 3)}  # cetpu: noqa[replay-wallclock] heartbeat wall-stamp: liveness crosses processes, replay never reads it
         if self.devices is not None:
             rec["devices"] = int(self.devices)
-        with open(tmp, "wb") as f:
-            f.write(json.dumps(rec).encode("utf-8"))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
+        dio.atomic_write(self.path, json.dumps(rec).encode("utf-8"),
+                         member="lease")
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -200,6 +229,7 @@ def run_worker(fabric_dir: str, host_id: str, *, build_entry, scheduler,
     server = FleetServer(scheduler, config, preemption=preemption,
                          journal=journal, status=status, alerts=alerts)
     feed = JsonlTail(paths["assign"])
+    gate = EpochGate()  # fencing-epoch latch over every feed line
     stop = threading.Event()
     # QueueFull-retry jitter stream, seeded per host (crc32, not hash():
     # stable across processes so a replayed fabric run backs off on the
@@ -226,6 +256,23 @@ def run_worker(fabric_dir: str, host_id: str, *, build_entry, scheduler,
         exactly like a fence."""
         while not stop.is_set():
             for rec, _off in feed.poll():
+                if not gate.admit(rec):
+                    # a stale coordinator incarnation's line: journal
+                    # the refusal (the coordinator transcribes it as an
+                    # audit record + obs event) and act on NOTHING —
+                    # routing, fences and withdrawals all belong to the
+                    # incarnation whose epoch the gate has latched
+                    stale = rec.get("user") or rec.get("drop") \
+                        or rec.get("fence")
+                    journal.append(
+                        "epoch_fenced",
+                        None if stale is None else str(stale),
+                        epoch=int(rec["ep"]))
+                    continue
+                if gate.epoch is not None:
+                    # the latched epoch rides on every DEFERRED ack the
+                    # serve loop journals (fence/drop releases)
+                    server.epoch = gate.epoch
                 if rec.get("close"):
                     server.close_intake()
                     return
@@ -246,7 +293,8 @@ def run_worker(fabric_dir: str, host_id: str, *, build_entry, scheduler,
                     verdict = server.fence(rec["fence"])
                     if verdict is not None:
                         journal.append("fence", str(rec["fence"]),
-                                       ok=bool(verdict))
+                                       ok=bool(verdict),
+                                       **server.ack_epoch())
                     continue
                 if isinstance(rec.get("edges"), list):
                     try:
@@ -263,10 +311,12 @@ def run_worker(fabric_dir: str, host_id: str, *, build_entry, scheduler,
                         # next ready pop releases it
                         verdict = server.evict(uid)
                         if verdict is not None:
-                            journal.append("drop", uid, ok=bool(verdict))
+                            journal.append("drop", uid, ok=bool(verdict),
+                                           **server.ack_epoch())
                     else:
                         ok = server.withdraw(uid)
-                        journal.append("drop", uid, ok=ok)
+                        journal.append("drop", uid, ok=ok,
+                                       **server.ack_epoch())
                     continue
                 uid = rec.get("user")
                 if uid is None:

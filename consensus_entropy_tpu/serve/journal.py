@@ -66,6 +66,7 @@ import threading
 import time
 
 from consensus_entropy_tpu.resilience import faults
+from consensus_entropy_tpu.resilience import io as dio
 
 #: admission transitions a journal line may carry (user-scoped).
 #: ``assign`` and ``drop`` are fabric ROUTING records: they move a user
@@ -102,6 +103,15 @@ PLANNER_EVENTS = ("planner",)
 #: routing, so replay folds it into the cursor/seq only and the actions
 #: it drove re-derive from the ack-gated records that follow it.
 REMEDY_EVENTS = ("remedy",)
+#: coordinator fencing-epoch records: ``epoch`` journals an incarnation's
+#: claim (monotonic — each coordinator claims one greater than any the
+#: journal has seen, so feed lines and acks are attributable to exactly
+#: one incarnation), ``epoch_fenced`` the audit record of a STALE
+#: incarnation being refused (a worker rejecting an old feed line, or
+#: the coordinator discarding an old-epoch ack as cursor-only).  Neither
+#: touches dispositions/membership/routing: replay folds the claim into
+#: ``coordinator_epoch`` and the fence records into the cursor only.
+EPOCH_EVENTS = ("epoch", "epoch_fenced")
 
 
 class JournalState:
@@ -140,6 +150,9 @@ class JournalState:
         self.planner_edges: list | None = None
         self.planner_sketch: dict | None = None
         self.pool_obs: list[int] = []
+        #: the highest coordinator fencing epoch the journal has seen —
+        #: a new incarnation claims ``coordinator_epoch + 1``
+        self.coordinator_epoch = 0
         self._enqueue_seq: dict[str, int] = {}
         self._admit_seq: dict[str, int] = {}
         self._seq = 0
@@ -153,7 +166,8 @@ class JournalState:
         event = rec.get("event")
         if event not in EVENTS and event not in HOST_EVENTS \
                 and event not in PLANNER_EVENTS \
-                and event not in REMEDY_EVENTS:
+                and event not in REMEDY_EVENTS \
+                and event not in EPOCH_EVENTS:
             return  # foreign/corrupt line: disposition unchanged
         seq = rec.get("seq")
         if isinstance(seq, int):
@@ -166,6 +180,14 @@ class JournalState:
         if isinstance(host, str) and isinstance(rec.get("src_off"), int):
             self.host_cursor[host] = max(self.host_cursor.get(host, 0),
                                          rec["src_off"])
+        if event in EPOCH_EVENTS:
+            # the claim folds into the monotonic epoch watermark; an
+            # ``epoch_fenced`` audit record is seq/cursor-only (the fold
+            # above), like a remedy — no disposition, no routing
+            if event == "epoch" and isinstance(rec.get("epoch"), int):
+                self.coordinator_epoch = max(self.coordinator_epoch,
+                                             rec["epoch"])
+            return
         if event in REMEDY_EVENTS:
             # an audit ledger entry: no membership change (the host
             # stays live — this is what distinguishes a remedy from a
@@ -317,6 +339,7 @@ class JournalState:
                 "planner_edges": self.planner_edges,
                 "planner_sketch": self.planner_sketch,
                 "pool_obs": list(self.pool_obs),
+                "coordinator_epoch": self.coordinator_epoch,
                 "enqueue_seq": dict(self._enqueue_seq),
                 "admit_seq": dict(self._admit_seq)}
 
@@ -340,6 +363,7 @@ class JournalState:
         sketch = d.get("planner_sketch")
         st.planner_sketch = sketch if isinstance(sketch, dict) else None
         st.pool_obs = [int(p) for p in d.get("pool_obs", [])]
+        st.coordinator_epoch = int(d.get("coordinator_epoch", 0))
         st._enqueue_seq = {k: int(v)
                            for k, v in d.get("enqueue_seq", {}).items()}
         st._admit_seq = {k: int(v)
@@ -349,6 +373,19 @@ class JournalState:
 
 def _ckpt_path(path: str) -> str:
     return path + ".ckpt"
+
+
+class JournalCorruption(RuntimeError):
+    """A durably-written journal/WAL line (newline-terminated, so NOT a
+    crash's torn tail — every complete line was flushed and fsynced
+    before the writer proceeded) failed its frame CRC or did not parse:
+    bit-rot, a short write that a later writer papered over, or a
+    foreign writer.  Replay HALTS instead of silently diverging from
+    the state the lost record carried; run ``cetpu-fsck`` on the users
+    dir to diagnose, and ``cetpu-fsck --repair`` to quarantine the
+    rotten line and replay from the surviving records (transcribed
+    worker state re-derives through the per-host cursor, which the
+    repair rolls back past the lost bytes)."""
 
 
 def _replay(path: str) -> JournalState:
@@ -366,16 +403,26 @@ def _replay(path: str) -> JournalState:
     if not os.path.exists(path):
         return state
     with open(path, "rb") as f:
-        for raw in f:
-            try:
-                rec = json.loads(raw.decode("utf-8"))
-            except (ValueError, UnicodeDecodeError):
-                # a half-written tail line IS the expected crash artifact:
-                # its transition never happened as far as recovery cares
+        off = 0
+        for i, raw in enumerate(f.readlines(), 1):
+            if not raw.endswith(b"\n"):
+                # a half-written TAIL (no newline — only the last line
+                # can lack one) IS the expected crash artifact: its
+                # transition never happened as far as recovery cares
+                off += len(raw)
                 continue
-            if not isinstance(rec, dict):
+            status, rec = dio.parse_frame(raw)
+            if status == "corrupt":
+                raise JournalCorruption(
+                    f"{path}:{i} (byte {off}): corrupt record — the line "
+                    "is newline-terminated, so it was durably written "
+                    "and then damaged; refusing to replay around it "
+                    "(run `cetpu-fsck --repair` to quarantine it)")
+            off += len(raw)
+            if not isinstance(rec, dict) or dio.is_header(rec):
                 continue
-            if has_ckpt and not isinstance(rec.get("seq"), int):
+            if has_ckpt and status == "legacy" \
+                    and not isinstance(rec.get("seq"), int):
                 # legacy pre-seq line surviving a crash between the two
                 # compaction renames: only pre-upgrade writers omit seq
                 # and only post-upgrade writers produce checkpoints, so
@@ -402,16 +449,18 @@ def validate_journal_file(path: str) -> list[str]:
         raws = f.readlines()
     last_seq = None
     for i, raw in enumerate(raws, 1):
-        try:
-            rec = json.loads(raw.decode("utf-8"))
-        except (ValueError, UnicodeDecodeError):
-            if i == len(raws):
-                continue  # torn tail: the expected crash artifact
-            errors.append(f"{path}:{i}: unparseable non-tail line")
+        if not raw.endswith(b"\n") and i == len(raws):
+            continue  # torn tail: the expected crash artifact
+        status, rec = dio.parse_frame(raw)
+        if status == "corrupt":
+            errors.append(f"{path}:{i}: corrupt record (frame CRC/parse "
+                          "failure on a durably-written line)")
             continue
         if not isinstance(rec, dict):
             errors.append(f"{path}:{i}: non-dict record")
             continue
+        if dio.is_header(rec):
+            continue  # the {"wal": N} version header carries no event
         ev = rec.get("event")
         if ev in HOST_EVENTS:
             if not isinstance(rec.get("host"), str):
@@ -423,6 +472,9 @@ def validate_journal_file(path: str) -> list[str]:
         elif ev in PLANNER_EVENTS:
             if not isinstance(rec.get("edges"), list):
                 errors.append(f"{path}:{i}: {ev!r} lacks edges")
+        elif ev in EPOCH_EVENTS:
+            if not isinstance(rec.get("epoch"), int):
+                errors.append(f"{path}:{i}: {ev!r} lacks epoch")
         elif ev in EVENTS:
             if not isinstance(rec.get("user"), str):
                 errors.append(f"{path}:{i}: {ev!r} lacks user")
@@ -457,12 +509,23 @@ class _AppendFsyncFile:
     """One JSONL record per call, durable before return (flush + fsync).
     The handle is opened lazily and kept open — the fsync per append is
     the durability point, reopening per line would only add syscalls.
+    Every write/fsync routes through the :mod:`resilience.io` seam, so
+    disk-fault drills hit the real byte boundaries.
+
+    ``frame=True`` (the default) writes CRC32-framed records
+    (``w1 <crc> <json>``, see :func:`resilience.io.frame_record`) and
+    opens a fresh file with the ``{"wal": 2}`` version header; a
+    pre-frame file is appended to in place (mixed files read fine —
+    framing is per-line).  ``frame=False`` keeps the legacy plain-JSON
+    format (the bench baseline arm).
 
     Opening REPAIRS a torn tail first: a file whose last line lacks its
-    newline (the process died mid-append) gets one appended, so the torn
-    record stays an ignorable line of its own instead of swallowing the
-    NEXT append into one unparseable blob (which would silently lose a
-    healthy post-restart record along with the torn one).
+    newline (the process died mid-append) has the torn bytes moved into
+    the ``<path>.quarantine`` sidecar and truncated off, so the file
+    stays fully parseable and a later complete-but-corrupt line can
+    only mean bit-rot — which replay refuses to skip
+    (:class:`JournalCorruption`) instead of mistaking it for a crash
+    artifact.
 
     The single-writer discipline is ENFORCED, not assumed: the first
     append takes an exclusive ``flock`` on a sibling ``<path>.lock``
@@ -472,15 +535,18 @@ class _AppendFsyncFile:
     second writer gets :class:`SingleWriterViolation` instead of
     silently corrupting the seq stream."""
 
-    def __init__(self, path: str | None):
+    def __init__(self, path: str | None, *, frame: bool = True,
+                 member: str = "wal"):
         self.path = path
+        self.frame = frame
+        self.member = member
         self._f = None
         self._lockf = None
 
     def _open(self):
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         if self._lockf is None and fcntl is not None:
-            lockf = open(self.path + ".lock", "ab")
+            lockf = open(self.path + ".lock", "ab")  # cetpu: noqa[raw-durable-io] zero-byte lock sibling: carries no data, never fsynced
             try:
                 fcntl.flock(lockf.fileno(),
                             fcntl.LOCK_EX | fcntl.LOCK_NB)
@@ -491,24 +557,33 @@ class _AppendFsyncFile:
                     "write lock (append-fsync WALs are single-writer); "
                     "is a server still running against this users dir?")
             self._lockf = lockf
-        self._f = open(self.path, "ab")
+        self._f = dio.open_append(self.path)
         if self._f.tell() > 0:
             with open(self.path, "rb") as r:
-                r.seek(-1, os.SEEK_END)
-                torn = r.read(1) != b"\n"
-            if torn:
-                self._f.write(b"\n")
+                data = r.read()
+            keep = data.rfind(b"\n") + 1
+            if keep < len(data):
+                dio.quarantine_append(self.path, off=keep,
+                                      raw=data[keep:], reason="torn tail")
+                self._f.truncate(keep)
                 self._f.flush()
-                os.fsync(self._f.fileno())
+                dio.fsync(self._f, path=self.path, member=self.member)
+        elif self.frame:
+            dio.write(self._f, dio.frame_header(), path=self.path,
+                      member=self.member)
+            self._f.flush()
+            dio.fsync(self._f, path=self.path, member=self.member)
 
     def append(self, rec: dict) -> None:
         if self.path is None:
             return
         if self._f is None:
             self._open()
-        self._f.write((json.dumps(rec) + "\n").encode("utf-8"))
+        line = dio.frame_record(rec) if self.frame \
+            else (json.dumps(rec) + "\n").encode("utf-8")
+        dio.write(self._f, line, path=self.path, member=self.member)
         self._f.flush()
-        os.fsync(self._f.fileno())
+        dio.fsync(self._f, path=self.path, member=self.member)
 
     def size(self) -> int:
         """Bytes written so far (0 before the first append this run)."""
@@ -537,15 +612,24 @@ class JsonlTail:
     :meth:`poll` yields ``(record, offset_after)`` for every COMPLETE line
     appended since the last poll — a line still missing its newline (the
     writer is mid-append, or died there) is left unconsumed, so a record
-    is either seen whole or not yet.  Unparseable complete lines are
-    skipped with their offset advanced (the torn-tail artifact after a
-    writer crash).  ``seek`` resumes from a durable cursor (the fabric
-    coordinator journals each transcription's ``offset_after``)."""
+    is either seen whole or not yet.  CRC-framed and legacy lines both
+    parse (:func:`resilience.io.parse_frame`); the ``{"wal": N}``
+    version header is consumed silently.  A complete line that fails
+    its frame is CORRUPT (the writer fsynced it whole, so this is
+    bit-rot, not a crash artifact): it is counted on :attr:`corrupt`,
+    quarantined into the sidecar for audit, and skipped with its offset
+    advanced — a reader cannot repair another process's file, but it
+    must never act on rotten bytes either.  ``seek`` resumes from a
+    durable cursor (the fabric coordinator journals each
+    transcription's ``offset_after``)."""
 
     def __init__(self, path: str):
         self.path = path
         self._f = None
         self.offset = 0
+        #: complete-but-corrupt lines skipped so far (the coordinator
+        #: surfaces deltas as ``record_quarantined`` events)
+        self.corrupt = 0
 
     def seek(self, offset: int) -> None:
         self.offset = max(int(offset), 0)
@@ -567,11 +651,17 @@ class JsonlTail:
                 self._f.seek(self.offset)
                 break
             self.offset += len(line)
-            try:
-                rec = json.loads(line.decode("utf-8"))
-            except (ValueError, UnicodeDecodeError):
+            status, rec = dio.parse_frame(line)
+            if status == "corrupt":
+                self.corrupt += 1
+                try:
+                    dio.quarantine_append(
+                        self.path, off=self.offset - len(line), raw=line,
+                        reason="corrupt frame (reader skip)")
+                except OSError:
+                    pass  # quarantine is audit-only: never block the tail
                 continue
-            if isinstance(rec, dict):
+            if isinstance(rec, dict) and not dio.is_header(rec):
                 out.append((rec, self.offset))
         return out
 
@@ -591,9 +681,18 @@ class AdmissionJournal:
     keeping the interface.  ``compact_bytes`` bounds the journal file:
     once an append pushes it past the bound, the state is checkpointed
     and the journal truncated in place (crash-safe, see :meth:`compact`).
+    ``frame=False`` writes the legacy plain-JSON record format (no CRC
+    frame — the bench comparison arm; replay reads both).
+
+    Opening SWEEPS any ``*.tmp`` sibling a mid-compaction death left
+    behind (the rename never happened, so the tmp is garbage and the
+    live files are authoritative); a compaction that hits a surfaced
+    disk error (ENOSPC/EIO) cleans up its own tmp and simply retries at
+    the next append over the threshold.
     """
 
-    def __init__(self, path: str | None, *, compact_bytes: int | None = None):
+    def __init__(self, path: str | None, *, compact_bytes: int | None = None,
+                 frame: bool = True):
         if compact_bytes is not None and compact_bytes <= 0:
             # construction-time validation (the PR 11 validate_bucket_widths
             # precedent): a zero/negative bound would compact on EVERY
@@ -602,8 +701,14 @@ class AdmissionJournal:
                              f"disable compaction), got {compact_bytes}")
         self.path = path
         self.compact_bytes = compact_bytes
+        if path:
+            for stale in (path + ".tmp", _ckpt_path(path) + ".tmp"):
+                try:
+                    os.remove(stale)
+                except OSError:
+                    pass
         self.state = _replay(path) if path else JournalState()
-        self._file = _AppendFsyncFile(path)
+        self._file = _AppendFsyncFile(path, frame=frame)
         self.compactions = 0
         #: appends happen on the serve-loop thread, but ``FleetServer.
         #: submit`` (producer threads) both appends (enqueue) and reads
@@ -641,6 +746,11 @@ class AdmissionJournal:
         elif event in PLANNER_EVENTS:
             if not isinstance(fields.get("edges"), list):
                 raise ValueError(f"journal event {event!r} needs edges=")
+        elif event in EPOCH_EVENTS:
+            # user= is optional (a worker's epoch_fenced names the line's
+            # user when it carried one; a claim names nobody)
+            if not isinstance(fields.get("epoch"), int):
+                raise ValueError(f"journal event {event!r} needs epoch=")
         elif event not in EVENTS:
             raise ValueError(f"unknown journal event {event!r}")
         elif user is None:
@@ -656,7 +766,14 @@ class AdmissionJournal:
             self.state.apply(rec)
             if (self.compact_bytes
                     and self._file.size() > self.compact_bytes):
-                self._compact_locked()
+                try:
+                    self._compact_locked()
+                except OSError:
+                    # a surfaced disk error (ENOSPC/EIO) mid-compaction:
+                    # atomic_write already removed its tmp, the append
+                    # itself IS durable, and the journal is merely still
+                    # long — the next over-threshold append retries
+                    pass
             return rec
 
     def is_finished(self, user) -> bool:
@@ -714,20 +831,16 @@ class AdmissionJournal:
             return
         faults.fire("fabric.compact", stage="checkpoint",
                     seq=self.state.seq)
-        ckpt = _ckpt_path(self.path)
-        tmp = ckpt + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(json.dumps(self.state.to_dict()).encode("utf-8"))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, ckpt)
+        dio.atomic_write(_ckpt_path(self.path),
+                         json.dumps(self.state.to_dict()).encode("utf-8"),
+                         member="compact")
         faults.fire("fabric.compact", stage="truncate", seq=self.state.seq)
         self._file.rotate()  # keep the write lock across the rename
-        jtmp = self.path + ".tmp"
-        with open(jtmp, "wb") as f:
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(jtmp, self.path)
+        # the truncated journal opens with the frame header right away,
+        # so the rotated file self-describes even before its next append
+        dio.atomic_write(self.path,
+                         dio.frame_header() if self._file.frame else b"",
+                         member="compact")
         self.compactions += 1
 
     def close(self) -> None:
@@ -759,10 +872,9 @@ class PoisonList:
         if path and os.path.exists(path):
             with open(path, "rb") as f:
                 for raw in f:
-                    try:
-                        rec = json.loads(raw.decode("utf-8"))
-                    except (ValueError, UnicodeDecodeError):
+                    if not raw.endswith(b"\n"):
                         continue  # half-written tail from a crash
+                    rec = dio.parse_frame(raw)[1]
                     if not isinstance(rec, dict) or "user" not in rec:
                         continue
                     if rec.get("event") == "unpoison":

@@ -558,6 +558,12 @@ class FleetServer:
         #: not a ``fence`` — insertion-ordered for deterministic acks
         self._evicting: dict[str, None] = {}
         self._fence_lock = threading.Lock()
+        #: the coordinator fencing epoch this worker's feed has latched
+        #: (serve.hosts.EpochGate sets it from the highest ``ep`` seen);
+        #: echoed on every fence/drop ack so a coordinator incarnation
+        #: can discard acks addressed to a predecessor.  None outside a
+        #: fabric (embedded/standalone serving journals bare acks).
+        self.epoch: int | None = None
         #: serve-local control-lane bookkeeping (``ctl.*`` spans): last
         #: observed journal compaction count and breaker width states
         self._ctl_compactions = 0
@@ -763,6 +769,12 @@ class FleetServer:
             return None
         return False
 
+    def ack_epoch(self) -> dict:
+        """Fields stamping the latched coordinator epoch onto an ack
+        record — empty when no epoch has been seen (legacy feeds,
+        embedded serving), so standalone journals stay byte-identical."""
+        return {"ep": self.epoch} if isinstance(self.epoch, int) else {}
+
     def _apply_fences(self) -> None:
         """Serve-loop half of the migration fence: turn intake-thread
         fence requests into engine release marks, and journal the
@@ -777,7 +789,7 @@ class FleetServer:
             if not self.scheduler.request_release(uid):
                 # finished or evicted between the request and this
                 # round: refuse — the user's own records resolve it
-                self._journal("fence", uid, ok=False)
+                self._journal("fence", uid, ok=False, **self.ack_epoch())
         for uid in evicts:
             if self.scheduler.force_release(uid):
                 self._evicting[uid] = None
@@ -786,7 +798,7 @@ class FleetServer:
                 # checkpoint boundary just before the deadline demotion
                 # arrived: refuse; the fence ack (or finish record)
                 # already resolves the user at the coordinator
-                self._journal("drop", uid, ok=False)
+                self._journal("drop", uid, ok=False, **self.ack_epoch())
         for uid, gen in self.scheduler.take_released().items():
             self._live_cls.pop(uid, None)
             for e in self._admitted:
@@ -794,7 +806,7 @@ class FleetServer:
                     self._pending.pop(id(e), None)
             if self.planner is not None:
                 self.planner.note_resolved(uid)
-            fields = {"ok": True}
+            fields = {"ok": True, **self.ack_epoch()}
             if gen is not None:
                 fields["gen"] = int(gen)
             # an evicted session acks as a DROP (the coordinator's

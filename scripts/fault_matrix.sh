@@ -101,6 +101,22 @@
 #   >= 1 slo_headroom alert fired AND graded, >= 1 journaled admission
 #   hold, parity) plus a coordinator killed MID-SOAK at fabric.remedy
 #   whose journal replay must finish every trace user exactly once.
+# - storage integrity (tests/test_durability.py): the io.* fault-point
+#   rows — corrupt-mid-file (a complete CRC-framed line that fails its
+#   check HALTS replay with a file:line:byte diagnosis, never silently
+#   replayed), short-write-then-SIGKILL (the torn tail is quarantine-
+#   truncated on reopen and the retried append lands), ENOSPC and
+#   rename-kill during journal compaction (tmp cleaned/swept, the next
+#   compaction retries, no record lost), the fsync-drop listener
+#   surface, plus the fencing-epoch units (EpochGate, stamped feeds,
+#   monotonic claims) and the cetpu-fsck detect/repair/replay drills.
+#   scripts/fsck_check.sh (run at the end of this matrix) is the
+#   companion gate against a REAL fabric: a byte flipped mid-journal
+#   after a full 2-host run must halt replay, be quarantined by
+#   cetpu-fsck --repair and replay to exact parity; a second
+#   coordinator incarnation must claim a strictly higher epoch; and a
+#   split-brain zombie drop ack (stale "ep" stamp) must be fenced
+#   cursor-only with the migration committed exactly once.
 #
 # Extra pytest args pass through, e.g.:
 #   scripts/fault_matrix.sh -k kill_at_every_boundary
@@ -111,10 +127,11 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
   tests/test_serve_faults.py tests/test_serve_fabric.py \
   tests/test_slo.py tests/test_elastic.py tests/test_remedy.py \
   tests/test_acquire.py tests/test_obs.py tests/test_workload.py \
-  tests/test_pool_mesh.py \
+  tests/test_pool_mesh.py tests/test_durability.py \
   -v -m faults -p no:cacheprovider "$@"
 scripts/elastic_check.sh
 scripts/remedy_check.sh
 scripts/soak_check.sh
 scripts/mesh_check.sh
+scripts/fsck_check.sh
 echo "fault matrix passed"

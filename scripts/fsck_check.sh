@@ -1,0 +1,245 @@
+#!/usr/bin/env bash
+# Storage-integrity CI gate (ISSUE 19 tentpole; sits next to
+# remedy_check.sh and is run by scripts/fault_matrix.sh).
+#
+# LEG 1 — byte-flip under a REAL fabric: a 2-host fabric runs a full
+# workload to completion (parity vs unfaulted sequential baselines
+# asserted first), then one byte of a mid-file CRC-framed journal
+# record is flipped.  Replay must HALT with ``JournalCorruption`` (a
+# complete-but-damaged line is bit-rot, NEVER silently replayed),
+# ``cetpu-fsck`` must detect it (exit 1) and ``--repair`` must
+# quarantine the damaged line into the ``.quarantine`` sidecar, sweep a
+# planted stale ``.tmp``, and re-verify clean (exit 0) — after which
+# the journal replays with every committed disposition intact and the
+# per-user results still bit-identical.
+#
+# LEG 2 — double-coordinator fencing: a SECOND coordinator incarnation
+# over the repaired journal (real workers again) must claim a STRICTLY
+# HIGHER fencing epoch — the journal's epoch events read [1, 2] — and
+# finish with nothing re-run (every user skip_done).  Then the
+# deterministic split-brain drill: a fake-worker fleet whose journal a
+# dead incarnation stamped at epoch 7, where the migration drop ack
+# arrives TWICE — once carrying the dead incarnation's ``"ep": 7`` (the
+# zombie's ack) and once live.  The live coordinator (epoch 8) must
+# journal the stale ack CURSOR-ONLY (report ``epoch_fenced``, commit
+# nothing from it) and commit the migration exactly once off the live
+# ack — no user runs on two hosts, and every feed line it wrote is
+# stamped ``"ep": 8``.
+#
+# Extra args are NOT accepted: this is a pass/fail gate, not a bench.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'PY'
+import glob
+import os
+import pathlib
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, ".")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+from tests.fabric_workload import (
+    make_cfg,
+    sequential_baselines,
+    sizes_arg,
+    user_specs,
+)
+
+from consensus_entropy_tpu.cli.fsck import main as fsck_main
+from consensus_entropy_tpu.obs import export
+from consensus_entropy_tpu.resilience import io as dio
+from consensus_entropy_tpu.serve import (
+    AdmissionJournal,
+    FabricConfig,
+    FabricCoordinator,
+    validate_journal_file,
+)
+from consensus_entropy_tpu.serve.hosts import fabric_paths
+from consensus_entropy_tpu.serve.journal import JournalCorruption
+
+cfg = make_cfg("mc", epochs=2)
+specs = user_specs(6, sizes=[30, 100])
+users = [u for _, u, _ in specs]
+pools = {u: n for _, u, n in specs}
+root = tempfile.mkdtemp(prefix="fsck_check_")
+seq = sequential_baselines(root, cfg, specs)
+
+fdir = os.path.join(root, "fabric")
+ws = os.path.join(root, "ws")
+os.makedirs(fdir, exist_ok=True)
+os.makedirs(ws, exist_ok=True)
+jp = os.path.join(fdir, "serve_journal.jsonl")
+
+
+def spawn(host_id):
+    log = open(fabric_paths(fdir, host_id)["log"], "ab")
+    try:
+        return subprocess.Popen(
+            [sys.executable, "tests/fabric_worker.py", fdir, host_id,
+             ws, cfg.mode, str(cfg.epochs), str(len(specs)), "5.0", "2",
+             sizes_arg(specs)],
+            stdout=log, stderr=subprocess.STDOUT,
+            env={**os.environ, "PYTHONPATH": "."})
+    finally:
+        log.close()
+
+
+def run_coordinator():
+    fcfg = FabricConfig(hosts=2, min_hosts=2, max_hosts=2)
+    journal = AdmissionJournal(jp)
+    coord = FabricCoordinator(journal, fdir, fcfg)
+    try:
+        return coord.run(users, spawn, pools=pools), coord.epoch
+    finally:
+        journal.close()
+
+
+def check_parity(label):
+    rows = {}
+    for fname in sorted(os.listdir(fdir)):
+        if fname.startswith("results_") and fname.endswith(".jsonl"):
+            for rec in export.read_jsonl_tolerant(
+                    os.path.join(fdir, fname)):
+                rows.setdefault(rec["user"], []).append(rec)
+    for uid in users:
+        assert len(rows[uid]) == 1, (label, uid, rows.get(uid))
+        assert rows[uid][0]["error"] is None, (label, uid)
+        assert rows[uid][0]["result"]["trajectory"] \
+            == seq[uid]["trajectory"], (label, uid)
+
+
+# ---- LEG 1: byte-flip under a real fabric -----------------------------
+summary1, epoch1 = run_coordinator()
+assert sorted(summary1["finished"]) == sorted(users), summary1
+assert epoch1 == 1, epoch1
+check_parity("pre-flip")
+bad = validate_journal_file(jp)
+for wal in sorted(glob.glob(os.path.join(fdir, "events_*.jsonl"))):
+    bad += validate_journal_file(wal)
+assert bad == [], "journal violations:\n" + "\n".join(bad[:10])
+pre = AdmissionJournal(jp).state.to_dict()
+
+# flip one byte of a mid-file framed ``enqueue`` record (disposition-
+# neutral damage: the user's assign/admit/finish records all survive)
+with open(jp, "rb") as f:
+    lines = f.read().split(b"\n")
+target = next(i for i, ln in enumerate(lines)
+              if i >= 2 and dio.parse_frame(ln + b"\n")[0] == "ok"
+              and dio.parse_frame(ln + b"\n")[1].get("event")
+              == "enqueue")
+mut = bytearray(lines[target])
+mut[len(mut) // 2] ^= 0xFF
+lines[target] = bytes(mut)
+with open(jp, "wb") as f:
+    f.write(b"\n".join(lines))
+
+try:
+    AdmissionJournal(jp)
+except JournalCorruption as e:
+    assert "cetpu-fsck" in str(e), e
+else:
+    raise AssertionError("bit-rot was silently replayed")
+
+# plant a killed compaction's stray AFTER the replay probe (opening the
+# journal sweeps its OWN .tmp siblings, corrupt or not)
+open(jp + ".tmp", "wb").close()
+
+assert fsck_main([fdir]) == 1, "fsck missed the flipped byte"
+assert fsck_main([fdir, "--repair"]) == 0, "repair did not re-verify"
+assert fsck_main([fdir]) == 0, "repaired dir not clean"
+assert os.path.exists(dio.quarantine_path(jp))
+assert not os.path.exists(jp + ".tmp")
+st = AdmissionJournal(jp).state
+assert st.finished == set(users), st.finished
+assert st.seq == pre["seq"], (st.seq, pre["seq"])
+check_parity("post-repair")
+print(f"fsck_check: byte-flip at line {target + 1} halted replay, "
+      "detected, quarantined, replayed to parity")
+
+# ---- LEG 2a: second incarnation claims a strictly higher epoch --------
+summary2, epoch2 = run_coordinator()
+assert epoch2 == 2, epoch2
+# every user was already finished: skip_done filters them out before
+# submission, so the incarnation resolves with nothing to run
+assert summary2["users"] == 0 and summary2["finished"] == [], summary2
+claims = [rec["epoch"] for rec in export.read_jsonl_tolerant(jp)
+          if rec.get("event") == "epoch"]
+assert claims == [1, 2], claims
+assert AdmissionJournal(jp).state.coordinator_epoch == 2
+# the parity check is the re-run detector: a second incarnation that
+# RE-RAN a finished user would append a second results row
+check_parity("double-coordinator")
+print("fsck_check: double-coordinator claimed epochs [1, 2], "
+      "every finished user skip_done, parity intact")
+
+# ---- LEG 2b: the split-brain zombie ack is fenced out -----------------
+import tests.test_elastic as te
+
+_BaseWorker = te._FakeWorker
+
+
+class _ZombieAckWorker(_BaseWorker):
+    """Answers every successful drop request TWICE: once stamped with
+    the DEAD incarnation's epoch (the split-brain zombie's ack), then
+    the live ack — the coordinator must treat the stale-stamped ack as
+    cursor-only and commit the migration exactly once."""
+
+    def _event(self, rec):
+        if rec.get("event") == "drop" and rec.get("ok"):
+            _BaseWorker._event(self, {**rec, "ep": 7})
+        _BaseWorker._event(self, rec)
+
+
+te._FakeWorker = _ZombieAckWorker
+leg2 = os.path.join(root, "leg2")
+os.makedirs(os.path.join(leg2, "fabric"), exist_ok=True)
+with AdmissionJournal(os.path.join(leg2, "fabric",
+                                   "serve_journal.jsonl")) as j:
+    j.append("epoch", epoch=7)  # the dead incarnation's claim
+
+fusers = [f"u{i}" for i in range(6)]
+fpools = {u: (30 if i % 2 == 0 else 100) for i, u in enumerate(fusers)}
+fcfg = FabricConfig(hosts=1, min_hosts=1, max_hosts=2, scale_backlog=2,
+                    poll_s=0.01, lease_s=5.0, drain_timeout_s=0.2)
+
+
+def script(rnd, coord, workers):
+    h0 = workers.get("h0")
+    if rnd == 2 and h0 and not h0.admitted and h0.queued:
+        h0.admit(h0.queued[0])  # one in-flight: must never migrate
+    if rnd > 6:
+        for w in workers.values():
+            for uid in list(w.admitted):
+                w.finish(uid)
+            for uid in list(w.queued):
+                w.admit(uid)
+
+
+fsum, coord, workers, fab2 = te._fake_fleet(
+    pathlib.Path(leg2), fcfg, fusers, fpools, script)
+assert coord.epoch == 8, coord.epoch
+assert sorted(fsum["finished"]) == fusers, fsum
+assert fsum["migrations"] >= 1, fsum
+ran = sorted(u for w in workers.values() for u in w.finished)
+assert ran == fusers, ran  # exactly one owner despite the double ack
+fenced = [e for e in coord.report.events
+          if e.get("event") == "epoch_fenced"]
+assert fenced and all(e["epoch"] == 7 for e in fenced), fenced
+jp2 = os.path.join(fab2, "serve_journal.jsonl")
+stale_acks = [rec for rec in export.read_jsonl_tolerant(jp2)
+              if rec.get("event") == "drop" and rec.get("ep") == 7]
+assert stale_acks, "the zombie ack never reached the journal cursor"
+for ap in sorted(glob.glob(os.path.join(fab2, "assign_*.jsonl"))):
+    for rec in export.read_jsonl_tolerant(ap):
+        assert rec.get("ep") == 8, (ap, rec)
+assert validate_journal_file(jp2) == []
+print(f"fsck_check: zombie ack (ep=7) fenced {len(fenced)} time(s) by "
+      f"the epoch-8 incarnation, migration committed exactly once")
+PY
+echo "fsck check passed"

@@ -1,0 +1,470 @@
+"""Storage-integrity hardening (ISSUE 19): CRC framing, the
+resilience.io fault seam, fencing epochs, and cetpu-fsck.
+
+All pure host and tier-1 fast (no jax import).  The invariant under
+test, end to end: a COMPLETE (newline-terminated) journal line was
+durably written — if it fails its frame CRC that is bit-rot and replay
+HALTS with a precise diagnosis instead of silently diverging; a line
+WITHOUT its newline is the one artifact a crash can leave and is
+quarantine-truncated on reopen.  The real-process versions of these
+drills (byte-flip under a live fabric, the double-coordinator fencing
+drill) run in ``scripts/fsck_check.sh`` / ``scripts/fault_matrix.sh``.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+
+import pytest
+
+from consensus_entropy_tpu.resilience import faults
+from consensus_entropy_tpu.resilience import io as dio
+from consensus_entropy_tpu.resilience.faults import (
+    FaultRule,
+    InjectedKill,
+)
+from consensus_entropy_tpu.serve.hosts import EpochGate
+from consensus_entropy_tpu.serve.journal import (
+    AdmissionJournal,
+    JournalCorruption,
+    JsonlTail,
+    _AppendFsyncFile,
+    validate_journal_file,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.faults]
+
+
+# -- frame format ------------------------------------------------------------
+
+
+def test_frame_roundtrip_and_header():
+    rec = {"event": "admit", "seq": 3, "user": "u1"}
+    line = dio.frame_record(rec)
+    assert line.startswith(b"w1 ") and line.endswith(b"\n")
+    status, out = dio.parse_frame(line)
+    assert status == "ok" and out == rec
+    status, hdr = dio.parse_frame(dio.frame_header())
+    assert status == "ok" and dio.is_header(hdr)
+    assert hdr == {"wal": dio.WAL_VERSION}
+    assert not dio.is_header(rec)
+
+
+def test_legacy_line_parses_as_legacy():
+    status, rec = dio.parse_frame(b'{"event": "admit", "seq": 1}\n')
+    assert status == "legacy" and rec["event"] == "admit"
+
+
+def test_every_single_byte_flip_is_detected():
+    """The acceptance criterion verbatim: a byte flipped ANYWHERE in a
+    framed record (magic, CRC hex, payload) is detected — no flip
+    yields a silently different parsed record."""
+    line = dio.frame_record({"event": "finish", "seq": 9, "user": "u"})
+    for i in range(len(line) - 1):  # final newline: framing, not data
+        flipped = bytearray(line)
+        flipped[i] ^= 0x01
+        status, _rec = dio.parse_frame(bytes(flipped))
+        assert status == "corrupt", f"flip at byte {i} undetected"
+
+
+# -- replay: legacy compatibility, corruption halt, torn tail ----------------
+
+
+def _raw_lines(path):
+    with open(path, "rb") as f:
+        return f.read().split(b"\n")
+
+
+def test_legacy_journal_still_loads_and_new_appends_are_framed(tmp_path):
+    jp = str(tmp_path / "j.jsonl")
+    with open(jp, "wb") as f:  # a pre-framing (v1) journal
+        f.write(b'{"event": "enqueue", "seq": 1, "user": "a"}\n'
+                b'{"event": "admit", "seq": 2, "user": "a"}\n')
+    j = AdmissionJournal(jp)
+    assert j.state.last == {"a": "admit"}
+    j.append("finish", "a")
+    j.close()
+    lines = _raw_lines(jp)
+    assert lines[0].startswith(b"{")       # legacy lines untouched
+    assert lines[2].startswith(b"w1 ")     # new append framed
+    assert AdmissionJournal(jp).state.finished == {"a"}
+
+
+def test_corrupt_midfile_record_halts_replay_with_diagnosis(tmp_path):
+    jp = str(tmp_path / "j.jsonl")
+    with AdmissionJournal(jp) as j:
+        for i in range(4):
+            j.append("enqueue", f"u{i}")
+    lines = _raw_lines(jp)
+    bad = bytearray(lines[2])
+    bad[len(bad) // 2] ^= 0xFF
+    lines[2] = bytes(bad)
+    with open(jp, "wb") as f:
+        f.write(b"\n".join(lines))
+    with pytest.raises(JournalCorruption) as ei:
+        AdmissionJournal(jp)
+    # the diagnosis names file, line and byte offset — the fsck handoff
+    assert jp in str(ei.value) and ":3" in str(ei.value)
+    assert "cetpu-fsck" in str(ei.value)
+
+
+def test_torn_tail_quarantined_and_truncated_on_reopen(tmp_path):
+    jp = str(tmp_path / "j.jsonl")
+    with AdmissionJournal(jp) as j:
+        j.append("enqueue", "a")
+        j.append("admit", "a")
+    durable = open(jp, "rb").read()
+    with open(jp, "ab") as f:
+        f.write(b"w1 deadbeef {\"event\": \"fini")  # no newline: torn
+    j2 = AdmissionJournal(jp)
+    assert j2.state.last == {"a": "admit"}  # torn bytes never replayed
+    # the writer's first append repairs: torn bytes quarantined, file
+    # truncated back to its durable tail, then the new record lands
+    j2.append("finish", "a")
+    j2.close()
+    qpath = dio.quarantine_path(jp)
+    assert os.path.exists(qpath)
+    qrec = json.loads(open(qpath, "rb").read().split(b"\n")[0])
+    assert qrec["reason"] == "torn tail"
+    repaired = open(jp, "rb").read()
+    assert repaired[:len(durable)] == durable  # durable prefix intact
+    status, last = dio.parse_frame(repaired[len(durable):])
+    assert status == "ok" and last["event"] == "finish"  # clean splice
+    assert AdmissionJournal(jp).state.finished == {"a"}
+    assert validate_journal_file(jp) == []
+
+
+def test_complete_corrupt_line_is_never_torn_tail(tmp_path):
+    """A newline-TERMINATED garbage line is bit-rot, not a crash
+    artifact: reopen halts instead of quietly quarantining, because a
+    durably-written record vanished."""
+    jp = str(tmp_path / "j.jsonl")
+    with AdmissionJournal(jp) as j:
+        j.append("enqueue", "a")
+    with open(jp, "ab") as f:
+        f.write(b"w1 deadbeef {\"event\": \"fini\n")  # terminated!
+    with pytest.raises(JournalCorruption):
+        AdmissionJournal(jp)
+
+
+# -- the io fault seam -------------------------------------------------------
+
+
+def test_io_write_enospc_and_eio_raise_before_any_byte(tmp_path):
+    p = str(tmp_path / "w.bin")
+    for point, eno in (("io.write.enospc", errno.ENOSPC),
+                       ("io.write.eio", errno.EIO)):
+        with faults.inject(FaultRule(point, "raise")) as inj:
+            with open(p, "wb") as f:
+                with pytest.raises(OSError) as ei:
+                    dio.write(f, b"payload", path=p)
+            assert ei.value.errno == eno and inj.fired
+        assert os.path.getsize(p) == 0  # nothing reached the file
+
+
+def test_io_write_short_leaves_half_the_payload(tmp_path):
+    p = str(tmp_path / "w.bin")
+    with faults.inject(FaultRule("io.write.short", "kill")):
+        with open(p, "wb") as f:
+            with pytest.raises(InjectedKill):
+                dio.write(f, b"0123456789", path=p)
+    assert open(p, "rb").read() == b"01234"  # half, flushed, then died
+
+
+def test_io_fsync_drop_is_silent(tmp_path):
+    p = str(tmp_path / "w.bin")
+    seen = []
+    listener = lambda kind, path: seen.append(kind)  # noqa: E731
+    dio.add_listener(listener)
+    try:
+        with faults.inject(FaultRule("io.fsync", "raise")) as inj:
+            with open(p, "wb") as f:
+                f.write(b"x")
+                dio.fsync(f, path=p, member="wal")  # no exception
+            assert inj.fired
+    finally:
+        dio.remove_listener(listener)
+    assert "io.fsync" in seen  # dropped silently but SURFACED
+
+
+def test_io_rename_fault_fails_the_commit_and_cleans_tmp(tmp_path):
+    p = str(tmp_path / "a.json")
+    with faults.inject(FaultRule("io.rename", "raise")):
+        with pytest.raises(OSError):
+            dio.atomic_write(p, b"data", member="lease")
+    assert not os.path.exists(p)
+    assert not os.path.exists(p + ".tmp")  # OSError path cleans up
+
+
+def test_member_filter_targets_one_writer(tmp_path):
+    """``member=compact`` fault rules must not fire on WAL appends."""
+    p = str(tmp_path / "w.bin")
+    rule = FaultRule("io.write.eio", "raise", member="compact")
+    with faults.inject(rule) as inj:
+        with open(p, "ab") as f:
+            dio.write(f, b"fine", path=p, member="wal")
+        assert not inj.fired
+
+
+# -- crash drills through the journal ---------------------------------------
+
+
+def test_short_write_kill_then_replay_is_bit_identical(tmp_path):
+    """The short-write-then-SIGKILL kill-matrix row, in-process: die
+    mid-append, reopen, and the journal replays exactly the pre-kill
+    state with the torn half-line quarantined."""
+    jp = str(tmp_path / "j.jsonl")
+    j = AdmissionJournal(jp)
+    j.append("enqueue", "a")
+    j.append("admit", "a")
+    pre = j.state.to_dict()
+    with faults.inject(FaultRule("io.write.short", "kill")):
+        with pytest.raises(InjectedKill):
+            j.append("finish", "a")  # dies with half a line on disk
+    j.close()  # what the kernel does to the dead process's flock
+    j2 = AdmissionJournal(jp)
+    post = j2.state.to_dict()
+    assert post == pre  # bit-identical replay: the append never happened
+    j2.append("finish", "a")  # the retried transition lands cleanly
+    assert os.path.exists(dio.quarantine_path(jp))
+    j2.close()
+    assert AdmissionJournal(jp).state.finished == {"a"}
+
+
+def test_enospc_during_compaction_leaks_no_tmp_and_retries(tmp_path):
+    """The satellite fix: auto-compaction hitting ENOSPC must not kill
+    the append (the record is already durable), must not leak a .tmp
+    sibling, and the next compaction succeeds once space returns."""
+    jp = str(tmp_path / "j.jsonl")
+    j = AdmissionJournal(jp, compact_bytes=300)
+    with faults.inject(FaultRule("io.write.enospc", "raise",
+                                 member="compact", times=1)) as inj:
+        for i in range(12):  # enough appends to cross compact_bytes
+            j.append("enqueue", f"u{i}")
+        assert inj.fired
+    assert not os.path.exists(jp + ".tmp")
+    assert not os.path.exists(jp + ".ckpt.tmp")
+    n0 = j.state.seq
+    for i in range(12, 30):
+        j.append("enqueue", f"u{i}")  # triggers a successful compaction
+    assert j.compactions >= 1
+    j.close()
+    st = AdmissionJournal(jp).state
+    assert st.seq == n0 + 18 and len(st.queued) == 30
+
+
+def test_kill_mid_compaction_sweeps_tmp_on_reopen(tmp_path):
+    """Dying between the checkpoint tmp write and its rename leaves a
+    ``.tmp`` stray; the next open sweeps it and replays the intact WAL."""
+    jp = str(tmp_path / "j.jsonl")
+    j = AdmissionJournal(jp, compact_bytes=300)
+    with faults.inject(FaultRule("io.rename", "kill", member="compact")):
+        with pytest.raises(InjectedKill):
+            for i in range(12):
+                j.append("enqueue", f"u{i}")
+    j.close()  # what the kernel does to the dead process's flock
+    leftovers = [n for n in os.listdir(str(tmp_path))
+                 if n.endswith(".tmp")]
+    assert leftovers  # the kill left the stray...
+    j2 = AdmissionJournal(jp)
+    assert not [n for n in os.listdir(str(tmp_path))
+                if n.endswith(".tmp")]  # ...and reopen swept it
+    # every append BEFORE the compaction kill is durable and replayed
+    # (the triggering append's record lands before compaction runs)
+    survived = len(j2.state.queued)
+    assert 0 < survived < 12
+    assert j2.state.queued == [f"u{i}" for i in range(survived)]
+    for i in range(survived, 12):
+        j2.append("enqueue", f"u{i}")  # the rerun finishes the intake
+    j2.close()
+    assert len(AdmissionJournal(jp).state.queued) == 12
+    assert validate_journal_file(jp) == []
+
+
+def test_jsonl_tail_skips_and_counts_corrupt_lines(tmp_path):
+    """The coordinator's reader half: a corrupt line in another
+    process's WAL is counted + quarantined (sidecar), never delivered,
+    and the cursor moves past it."""
+    p = str(tmp_path / "events.jsonl")
+    w = _AppendFsyncFile(p)
+    w.append({"event": "admit", "seq": 1, "user": "a"})
+    w.append({"event": "finish", "seq": 2, "user": "a"})
+    w.append({"event": "admit", "seq": 3, "user": "b"})
+    w.close()
+    lines = _raw_lines(p)
+    bad = bytearray(lines[2])
+    bad[-3] ^= 0xFF
+    lines[2] = bytes(bad)
+    with open(p, "wb") as f:
+        f.write(b"\n".join(lines))
+    tail = JsonlTail(p)
+    got = [rec["seq"] for rec, _off in tail.poll()]
+    assert got == [1, 3]
+    assert tail.corrupt == 1
+    assert os.path.exists(dio.quarantine_path(p))
+
+
+# -- fencing epochs ----------------------------------------------------------
+
+
+def test_epoch_gate_latches_highest_and_fences_stale():
+    g = EpochGate()
+    assert g.admit({"user": "a"})            # legacy line: no ep field
+    assert g.epoch is None
+    assert g.admit({"user": "a", "ep": 2})
+    assert g.epoch == 2
+    assert not g.admit({"user": "b", "ep": 1})   # stale incarnation
+    assert g.admit({"user": "c", "ep": 2})       # same incarnation
+    assert g.admit({"user": "d", "ep": 5})       # successor takes over
+    assert not g.admit({"user": "e", "ep": 2})   # old one now stale too
+    assert g.epoch == 5 and g.fenced == 2
+
+
+def test_epoch_feed_stamps_every_line(tmp_path):
+    from consensus_entropy_tpu.serve.fabric import _EpochFeed
+
+    p = str(tmp_path / "assign.jsonl")
+    feed = _EpochFeed(_AppendFsyncFile(p), 3)
+    feed.append({"user": "a"})
+    feed.append({"drain": True})
+    feed.close()
+    recs = [rec for rec, _off in JsonlTail(p).poll()]
+    assert [r.get("ep") for r in recs] == [3, 3]
+    assert recs[0]["user"] == "a" and recs[1]["drain"] is True
+
+
+def test_journal_coordinator_epoch_is_monotonic(tmp_path):
+    jp = str(tmp_path / "j.jsonl")
+    j = AdmissionJournal(jp)
+    assert j.state.coordinator_epoch == 0
+    j.append("epoch", epoch=1)
+    j.append("epoch_fenced", "u1", epoch=0)  # audit record: no effect
+    j.append("epoch", epoch=3)
+    assert j.state.coordinator_epoch == 3
+    # a replayed stale claim can never move the epoch backwards
+    j.append("epoch", epoch=2)
+    assert j.state.coordinator_epoch == 3
+    j.close()
+    st = AdmissionJournal(jp).state
+    assert st.coordinator_epoch == 3
+    # and the snapshot round-trip preserves it (compaction path)
+    from consensus_entropy_tpu.serve.journal import JournalState
+
+    assert JournalState.from_dict(st.to_dict()).coordinator_epoch == 3
+    assert validate_journal_file(jp) == []
+
+
+def test_successive_coordinators_claim_increasing_epochs(tmp_path):
+    """Split-brain seed: each incarnation over the SAME journal claims
+    strictly higher — the stale one's stamped lines are rejectable."""
+    from consensus_entropy_tpu.serve.fabric import (
+        FabricConfig,
+        FabricCoordinator,
+    )
+
+    jp = str(tmp_path / "j.jsonl")
+    epochs = []
+    for _ in range(3):
+        j = AdmissionJournal(jp)
+        coord = FabricCoordinator(j, str(tmp_path),
+                                  FabricConfig(hosts=1))
+        epochs.append(coord.epoch)
+        j.append("epoch", epoch=coord.epoch)  # what run() journals
+        j.close()
+    assert epochs == [1, 2, 3]
+
+
+def test_server_ack_epoch_fields():
+    from consensus_entropy_tpu.serve.server import FleetServer
+
+    ack = FleetServer.ack_epoch
+    srv = type("S", (), {"epoch": None})()
+    assert ack(srv) == {}
+    srv.epoch = 4
+    assert ack(srv) == {"ep": 4}
+
+
+# -- cetpu-fsck --------------------------------------------------------------
+
+
+def _build_users_dir(tmp_path) -> tuple[str, dict]:
+    d = str(tmp_path / "users")
+    os.makedirs(d)
+    jp = os.path.join(d, "serve_journal.jsonl")
+    with AdmissionJournal(jp) as j:
+        for i in range(5):
+            j.append("enqueue", f"u{i}")
+            j.append("admit", f"u{i}")
+        j.append("finish", "u0")
+        state = j.state.to_dict()
+    return d, state
+
+
+def _flip_byte(path: str, line_no: int):
+    with open(path, "rb") as f:
+        lines = f.read().split(b"\n")
+    bad = bytearray(lines[line_no])
+    bad[len(bad) // 2] ^= 0xFF
+    lines[line_no] = bytes(bad)
+    with open(path, "wb") as f:
+        f.write(b"\n".join(lines))
+
+
+def test_fsck_detects_repairs_and_replays_to_parity(tmp_path, capsys):
+    from consensus_entropy_tpu.cli.fsck import main as fsck_main
+
+    d, pre = _build_users_dir(tmp_path)
+    jp = os.path.join(d, "serve_journal.jsonl")
+    _flip_byte(jp, 3)  # an enqueue record: disposition-neutral damage
+    open(jp + ".tmp", "wb").close()  # a killed compaction's stray
+    assert fsck_main([d]) == 1                   # detect, exit nonzero
+    assert "corrupt" in capsys.readouterr().out
+    assert fsck_main([d, "--repair"]) == 0       # repair + re-verify
+    assert fsck_main([d]) == 0                   # now clean
+    assert not os.path.exists(jp + ".tmp")
+    assert os.path.exists(dio.quarantine_path(jp))
+    # replay parity: only the quarantined line's own record is gone;
+    # every disposition the journal committed is intact
+    st = AdmissionJournal(jp).state
+    assert st.finished == {"u0"}
+    assert st.last["u4"] == "admit" and st.seq == pre["seq"]
+
+
+def test_fsck_refuses_a_live_wal(tmp_path):
+    from consensus_entropy_tpu.cli.fsck import main as fsck_main
+
+    d, _ = _build_users_dir(tmp_path)
+    jp = os.path.join(d, "serve_journal.jsonl")
+    j = AdmissionJournal(jp)
+    j.append("enqueue", "live")  # the first append takes the flock
+    _flip_byte(jp, 2)            # bit-rot lands while the writer is live
+    try:
+        assert fsck_main([d, "--repair"]) == 2
+        assert os.path.exists(jp)  # untouched: never racily rewritten
+    finally:
+        j.close()
+
+
+def test_fsck_verifies_checkpoint_containers(tmp_path):
+    """Corrupt CETPU1 containers are detected (and never 'repaired' —
+    there is no redundancy; recovery rolls back a generation)."""
+    import struct
+    import zlib
+
+    from consensus_entropy_tpu.cli.fsck import main as fsck_main
+
+    d, _ = _build_users_dir(tmp_path)
+    payload = b"\x01" * 64
+    meta = json.dumps({"crc32": zlib.crc32(payload)}).encode()
+    ck = os.path.join(d, "member.msgpack")
+    with open(ck, "wb") as f:
+        f.write(b"CETPU1\n" + struct.pack("<I", len(meta)) + meta
+                + payload)
+    assert fsck_main([d]) == 0  # intact container passes
+    with open(ck, "r+b") as f:
+        f.seek(-20, os.SEEK_END)
+        f.write(b"\xff")
+    assert fsck_main([d]) == 1
+    assert fsck_main([d, "--repair"]) == 1  # unrepairable by design

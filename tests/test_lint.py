@@ -73,7 +73,7 @@ def test_registry_has_the_contracted_rules():
                  "prng-key-reuse", "replay-wallclock",
                  "replay-unseeded-rng", "replay-set-iteration",
                  "implicit-host-sync", "fault-point-literal",
-                 "event-schema", "lock-discipline"):
+                 "event-schema", "lock-discipline", "raw-durable-io"):
         assert name in rules, name
 
 
@@ -657,6 +657,64 @@ def test_lock_discipline_nested_locks_fire():
                             return 1
                 return cb
     """) == []
+
+
+# -- rule: raw-durable-io ----------------------------------------------------
+
+
+def test_raw_durable_io_fires_on_write_opens_in_scope():
+    """Durability-critical modules (serve/, resilience/,
+    al/workspace.py) must route writes through the resilience.io seam so
+    the io.* fault points and CRC framing cover them."""
+    src = """
+        import os
+
+        def persist(path, data):
+            with open(path + ".tmp", "wb") as f:
+                f.write(data)
+                os.fsync(f.fileno())
+            os.replace(path + ".tmp", path)
+    """
+    fired = rules_fired(src, REPLAY_FILE, select=["raw-durable-io"])
+    assert fired == ["raw-durable-io"] * 3  # open + fsync + replace
+
+
+def test_raw_durable_io_flags_mode_kw_and_append():
+    fired = rules_fired("""
+        def log(path, line):
+            with open(path, mode="a") as f:
+                f.write(line)
+    """, REPLAY_FILE, select=["raw-durable-io"])
+    assert fired == ["raw-durable-io"]
+
+
+def test_raw_durable_io_silent_on_reads_and_out_of_scope():
+    src = """
+        def load(path):
+            with open(path, "rb") as f:
+                return f.read()
+
+        def surgery(path):
+            with open(path, "r+b") as f:  # the fault injector's corrupt
+                f.write(b"x")
+    """
+    assert rules_fired(src, REPLAY_FILE,
+                       select=["raw-durable-io"]) == []
+    # the same write-open outside the durable scope is not this rule's
+    # business (ops/ writes are artifacts, not ledgers)
+    assert rules_fired("""
+        def dump(path, data):
+            with open(path, "w") as f:
+                f.write(data)
+    """, PKG_FILE, select=["raw-durable-io"]) == []
+
+
+def test_raw_durable_io_noqa_escape():
+    fired = rules_fired("""
+        def lock_sibling(path):
+            return open(path + ".lock", "ab")  # cetpu: noqa[raw-durable-io] zero-byte lock sibling
+    """, REPLAY_FILE, select=["raw-durable-io"])
+    assert fired == []
 
 
 # -- suppression + baseline semantics ----------------------------------------

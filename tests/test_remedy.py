@@ -358,9 +358,19 @@ def _remedy_fleet(tmp_path, config, users, pools, script, workers=None):
 
 
 def _journal_records(fabric_dir):
+    from consensus_entropy_tpu.resilience import io as dio
+
     path = os.path.join(fabric_dir, "serve_journal.jsonl")
+    out = []
     with open(path, "rb") as f:
-        return [json.loads(ln) for ln in f.read().splitlines() if ln]
+        for ln in f.read().splitlines():
+            if not ln:
+                continue
+            status, rec = dio.parse_frame(ln + b"\n")
+            assert status != "corrupt", ln
+            if not dio.is_header(rec):
+                out.append(rec)
+    return out
 
 
 def _setup_skew(state, users, workers):

@@ -498,8 +498,9 @@ def test_fabric_admission_hold_journals_and_defers_routing(tmp_path):
     coord._pump_hold()  # burned continuously past remedy_hold_s: act
     assert coord.holds == 1
     assert coord._hold_until == pytest.approx(fake[0] + 2.0)
+    from consensus_entropy_tpu.resilience import io as dio
     with open(jp, "rb") as f:
-        remedies = [json.loads(raw) for raw in f
+        remedies = [dio.parse_frame(raw)[1] for raw in f
                     if b'"remedy"' in raw]
     assert len(remedies) == 1
     assert remedies[0]["action"] == "admission_hold"
