@@ -2823,7 +2823,6 @@ def run_remedy_suite(args_ns) -> int:
     ``remedy_handoff_s`` is the journal-derived delta from the
     ``remedy`` decision to the last shed user's committed re-assign
     (how long the fleet takes to complete the hand-off it decided)."""
-    import json as json_mod
     import os
     import shutil
     import subprocess
@@ -2839,6 +2838,7 @@ def run_remedy_suite(args_ns) -> int:
         user_specs,
     )
 
+    from consensus_entropy_tpu.obs import export
     from consensus_entropy_tpu.serve import (
         AdmissionJournal,
         FabricConfig,
@@ -2862,18 +2862,15 @@ def run_remedy_suite(args_ns) -> int:
     def handoff_stamp(jp):
         """``(t_remedy, t_last_assign)`` wall stamps from the journal:
         the first ``remedy`` decision and the LAST committed
-        ``assign`` after it (the shed users landing on new hosts)."""
+        ``assign`` after it (the shed users landing on new hosts).
+        Framed-record tolerant: the journal is CRC-framed since the
+        durability PR, so a plain-JSON parse would see no rows."""
         t0 = t1 = None
-        with open(jp, "rb") as f:
-            for raw in f:
-                try:
-                    rec = json_mod.loads(raw.decode("utf-8"))
-                except ValueError:
-                    continue
-                if rec.get("event") == "remedy" and t0 is None:
-                    t0 = rec.get("t")
-                elif rec.get("event") == "assign" and t0 is not None:
-                    t1 = rec.get("t")
+        for rec in export.read_jsonl_tolerant(jp):
+            if rec.get("event") == "remedy" and t0 is None:
+                t0 = rec.get("t")
+            elif rec.get("event") == "assign" and t0 is not None:
+                t1 = rec.get("t")
         return t0, t1
 
     def run_arm(ws, arm):
@@ -2973,6 +2970,265 @@ def run_remedy_suite(args_ns) -> int:
         "remedy_handoff_s": r["remedy_handoff_s"],
         "remedies": r["remedies"], "migrations": r["migrations"],
         "fences": r["fences"],
+        "parity_with_sequential": True,
+        **_provenance(),
+    }))
+    return 0
+
+
+def run_gray_suite(args_ns) -> int:
+    """Gray-failure ladder vs skew-only remediation, raced on recovery.
+
+    Both arms run the SAME drill per rep: a 3-host fabric where ONLY h0
+    carries ``serve.dispatch:stall=3@1x-1`` (the slow-not-dead wedge:
+    EVERY dispatch on h0 holds 3 s — values untouched so parity still
+    binds, the process alive and beating its lease) and least-loaded
+    placement splits the users evenly.  The arms differ only in which
+    remediation plane watches:
+
+    - ``ladder``: ``FabricConfig.gray`` — peer-relative detection
+      (step walls, append ages) journals PROBATION off the stall
+      evidence itself, then ``gray_drain`` sheds ALL of h0's users
+      onto the healthy peers;
+    - ``skew``: ``FabricConfig.remedy`` (the PR 16 baseline) — only a
+      sustained unresolved-LOAD skew triggers drain-for-rebalance,
+      which sheds just the surplus; h0 keeps grinding its remaining
+      share through the stall.
+
+    Metrics (journal-``t`` derived, per rep; best-of-reps per arm):
+
+    - ``time_to_recover_s``: first journal record -> the moment NO
+      unfinished user is placed on the gray host (the last record
+      that empties h0's unresolved set) — detection latency plus the
+      completed hand-off;
+    - ``interactive_p99_s``: per-user first-assign -> finish latency,
+      p99 across users (the users parked behind the stall dominate).
+
+    Parity vs unfaulted sequential baselines is asserted on EVERY rep
+    of BOTH arms; the ladder arm must journal >= 1 probation and >= 1
+    ``gray_drain``, the skew arm exactly 0 probations and >= 1
+    ``remedy``; the ladder journal must REPLAY deterministically (two
+    independent folds agree on the probation set, schema clean)."""
+    import math
+    import os
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.fabric_workload import (
+        make_cfg,
+        read_results,
+        sequential_baselines,
+        sizes_arg,
+        user_specs,
+    )
+
+    from consensus_entropy_tpu.obs import export
+    from consensus_entropy_tpu.serve import (
+        AdmissionJournal,
+        FabricConfig,
+        FabricCoordinator,
+        validate_journal_file,
+    )
+    from consensus_entropy_tpu.serve.hosts import fabric_paths
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "tests", "fabric_worker.py")
+    n_users, hosts = args_ns.users, max(args_ns.hosts, 3)
+    epochs = args_ns.al_epochs
+    cfg = make_cfg("mc", epochs=epochs)
+    specs = user_specs(n_users, sizes=[30, 100])
+    target_live = max(2, n_users // hosts)
+
+    _log(f"gray workload: {n_users} users x {epochs} AL iterations, "
+         f"{hosts} hosts with ONLY h0 stalling 3 s on every dispatch; "
+         f"arms: gray ladder (probation+gray_drain) vs skew-only "
+         f"remediation")
+
+    def journal_rows(jp):
+        # CRC-framed since PR 19: the tolerant reader parses both
+        # framed and legacy lines
+        return export.read_jsonl_tolerant(jp)
+
+    def recover_stamp(jp):
+        """Seconds from the journal's first record to the LAST record
+        that left the gray host with zero unfinished users (assign-away
+        and finish both clear; a later assign back onto h0 re-opens
+        the window, so the stamp is the final transition to empty)."""
+        t_first = t_clear = None
+        on_h0: set = set()
+        for rec in journal_rows(jp):
+            t = rec.get("t")
+            if t is None:
+                continue
+            if t_first is None:
+                t_first = t
+            ev, u = rec.get("event"), rec.get("user")
+            prev = len(on_h0)
+            if ev == "assign":
+                if rec.get("host") == "h0":
+                    on_h0.add(u)
+                else:
+                    on_h0.discard(u)
+            elif ev == "finish":
+                on_h0.discard(u)
+            if prev > 0 and not on_h0:
+                t_clear = t
+        if t_first is None or t_clear is None:
+            return None
+        return t_clear - t_first
+
+    def interactive_p99(jp):
+        """p99 of per-user first-``assign`` -> ``finish`` latency."""
+        t0: dict = {}
+        lat: dict = {}
+        for rec in journal_rows(jp):
+            t, u = rec.get("t"), rec.get("user")
+            if t is None or u is None:
+                continue
+            ev = rec.get("event")
+            if ev == "assign":
+                t0.setdefault(u, t)
+            elif ev == "finish" and u in t0:
+                lat[u] = t - t0[u]
+        if not lat:
+            return None
+        ranked = sorted(lat.values())
+        return ranked[max(0, math.ceil(0.99 * len(ranked)) - 1)]
+
+    def run_arm(ws, arm):
+        arm_ws = _mkdir(ws, f"ws_{arm}")
+        fabric_dir = _mkdir(ws, f"fabric_{arm}")
+        jp = os.path.join(fabric_dir, "serve_journal.jsonl")
+        journal = AdmissionJournal(jp)
+
+        def spawn(host_id):
+            log = open(fabric_paths(fabric_dir, host_id)["log"], "ab")
+            env = {**os.environ, "PYTHONPATH": repo}
+            if host_id == "h0":
+                env["CETPU_FAULTS"] = "serve.dispatch:stall=3@1x-1"
+            try:
+                return subprocess.Popen(
+                    [sys.executable, worker, fabric_dir, host_id,
+                     arm_ws, cfg.mode, str(cfg.epochs), str(n_users),
+                     "5.0", str(target_live), sizes_arg(specs)],
+                    stdout=log, stderr=subprocess.STDOUT, env=env)
+            finally:
+                log.close()
+
+        if arm == "ladder":
+            fcfg = FabricConfig(
+                hosts=hosts, min_hosts=hosts, max_hosts=hosts,
+                placement="load", gray=True, gray_ratio=2.5,
+                gray_min_s=1.5, gray_hold_s=0.3, gray_drain_s=0.5,
+                gray_clear_s=600.0)
+        else:
+            fcfg = FabricConfig(
+                hosts=hosts, min_hosts=hosts, max_hosts=hosts,
+                placement="load", remedy=True, remedy_hold_s=0.2,
+                remedy_cooldown_s=600.0, remedy_skew=1)
+        coord = FabricCoordinator(journal, fabric_dir, fcfg)
+        t0 = time.perf_counter()
+        summary = coord.run([u for _, u, _ in specs], spawn,
+                            pools={u: n for _, u, n in specs})
+        wall = time.perf_counter() - t0
+        journal.close()
+        assert validate_journal_file(jp) == [], \
+            f"journal schema violations in the {arm} arm"
+        if arm == "ladder":
+            # replay determinism: two independent folds of the ladder
+            # journal must agree on the probation set, and the gray
+            # host must be on it
+            folds = []
+            for _ in range(2):
+                j = AdmissionJournal(jp)
+                folds.append(set(j.state.probation))
+                j.close()
+            assert folds[0] == folds[1] and "h0" in folds[0], \
+                f"ladder journal replay diverged: {folds}"
+        return {"summary": summary, "wall_s": wall,
+                "recover_s": recover_stamp(jp),
+                "p99_s": interactive_p99(jp),
+                "fabric_dir": fabric_dir}
+
+    root = tempfile.mkdtemp(prefix="gray_bench_")
+    best = {"ladder": None, "skew": None}
+    try:
+        for rep in range(args_ns.reps):
+            ws = _mkdir(root, f"rep{rep}")
+            seq = sequential_baselines(ws, cfg, specs)
+            for arm in ("ladder", "skew"):
+                out = run_arm(ws, arm)
+                summary = out["summary"]
+                results = read_results(out["fabric_dir"])
+                parity = (sorted(summary["finished"])
+                          == sorted(u for _, u, _ in specs)
+                          and all(results[u]["error"] is None
+                                  and results[u]["result"]["trajectory"]
+                                  == seq[u]["trajectory"]
+                                  for _, u, _ in specs))
+                _log(f"[rep {rep}] {arm:>6}: "
+                     f"{len(summary['finished'])}/{n_users} users in "
+                     f"{out['wall_s']:.1f}s (recover="
+                     f"{out['recover_s'] and round(out['recover_s'], 2)}"
+                     f"s, p99={out['p99_s'] and round(out['p99_s'], 2)}"
+                     f"s, probations={summary['probations']}, "
+                     f"gray_drains={summary['gray_drains']}, "
+                     f"remedies={summary['remedies']}, "
+                     f"migrations={summary['migrations']}, "
+                     f"parity={parity})")
+                ok_arm = (
+                    summary["probations"] >= 1
+                    and summary["gray_drains"] >= 1
+                    and summary["migrations"] >= 1
+                    if arm == "ladder"
+                    else summary["probations"] == 0
+                    and summary["remedies"] >= 1)
+                if not (parity and ok_arm and summary["drains"] == 0
+                        and summary["revocations"] == 0
+                        and out["recover_s"] is not None
+                        and out["p99_s"] is not None):
+                    raise AssertionError(
+                        f"gray {arm} rep {rep} lost parity or the "
+                        f"wrong plane remediated: parity={parity}, "
+                        f"recover_s={out['recover_s']}, "
+                        f"p99_s={out['p99_s']}, {summary}")
+                rec = {"wall_s": round(out["wall_s"], 3),
+                       "time_to_recover_s": round(out["recover_s"], 3),
+                       "interactive_p99_s": round(out["p99_s"], 3),
+                       **{k: summary[k] for k in
+                          ("probations", "gray_drains", "remedies",
+                           "migrations", "fences", "depth_changes")}}
+                prev = best[arm]
+                if prev is None or rec["time_to_recover_s"] \
+                        < prev["time_to_recover_s"]:
+                    best[arm] = rec
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    lad, skw = best["ladder"], best["skew"]
+    print(json.dumps({
+        "metric": f"gray_recover_s_{n_users}u_{hosts}h_stall1",
+        "value": lad["time_to_recover_s"],
+        "unit": "s",
+        "vs_baseline": round(skw["time_to_recover_s"]
+                             / lad["time_to_recover_s"], 2),
+        "time_to_recover_s_ladder": lad["time_to_recover_s"],
+        "time_to_recover_s_skew": skw["time_to_recover_s"],
+        "interactive_p99_s_ladder": lad["interactive_p99_s"],
+        "interactive_p99_s_skew": skw["interactive_p99_s"],
+        "wall_s_ladder": lad["wall_s"], "wall_s_skew": skw["wall_s"],
+        "probations": lad["probations"],
+        "gray_drains": lad["gray_drains"],
+        "migrations_ladder": lad["migrations"],
+        "remedies_skew": skw["remedies"],
+        "ladder_beats_skew_recover": lad["time_to_recover_s"]
+        < skw["time_to_recover_s"],
+        "ladder_beats_skew_p99": lad["interactive_p99_s"]
+        < skw["interactive_p99_s"],
+        "replay_deterministic": True,
         "parity_with_sequential": True,
         **_provenance(),
     }))
@@ -3529,7 +3785,7 @@ def main(argv=None) -> int:
                                         "serve-faults", "fabric", "elastic",
                                         "drain", "remedy", "soak", "mesh",
                                         "qbdc", "cnn-fleet", "obs",
-                                        "durability"),
+                                        "durability", "gray"),
                     default="linear",
                     help="linear: the north-star fused pool scoring; cnn: "
                          "Flax ShortChunkCNN committee inference "
@@ -3719,6 +3975,10 @@ def main(argv=None) -> int:
         # self-healing: alert-driven rebalance off one slow host vs
         # alert-only
         return run_remedy_suite(args_ns)
+    if args_ns.suite == "gray":
+        # gray failure: the detection+ladder plane vs the PR 16
+        # skew-only remediation under one stalling host
+        return run_gray_suite(args_ns)
     if args_ns.suite == "soak":
         # steady-state: a seeded shaped-load trace played wall-clock
         # for --soak-s seconds, plus the compressed determinism replay

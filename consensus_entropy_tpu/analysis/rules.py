@@ -1200,3 +1200,100 @@ def check_lock_discipline(tree, ctx):
     for _scope, body in _iter_scopes(tree):
         scan(body, [])
     return findings
+
+
+# -- rule: deadline-discipline ----------------------------------------------
+
+
+def _in_serve(path: str) -> bool:
+    return path.startswith(PKG + "serve/")
+
+
+def _sleep_calls(loop) -> list:
+    """Sleep calls inside ``loop`` (nested defs excluded — separate
+    control flow, scanned as their own loops if they have any)."""
+    skip: set[int] = set()
+    for node in ast.walk(loop):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            for sub in ast.walk(node):
+                skip.add(id(sub))
+    out = []
+    for node in ast.walk(loop):
+        if id(node) in skip or not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if name and name.split(".")[-1] == "sleep":
+            out.append(node)
+    return out
+
+
+def _has_deadline_seam(loop) -> bool:
+    """True when the loop's subtree references a bounding seam: a name
+    or attribute whose spelling carries ``deadline``/``timeout``, or a
+    clock read through the injected seam (``clock``/``_clock``) — the
+    shapes every bounded poll loop in serve/ already uses."""
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Name):
+            ident = node.id
+        elif isinstance(node, ast.Attribute):
+            ident = node.attr
+        else:
+            continue
+        low = ident.lower()
+        if "deadline" in low or "timeout" in low or "clock" in low:
+            return True
+    return False
+
+
+@register(
+    "deadline-discipline",
+    doc="serve/ never waits unboundedly: thread/process .join() calls "
+        "carry a timeout, and a constant-condition poll loop that "
+        "sleeps must read a deadline or the injected clock seam",
+    applies=_in_serve)
+def check_deadline_discipline(tree, ctx):
+    """The gray-failure lesson, machine-checked: a wedged peer doesn't
+    crash, it STALLS — and any unbounded wait in the serve plane turns
+    one gray host into a wedged coordinator (the exact failure the
+    stall/slow fault actions inject).  Two shapes are flagged:
+
+    (a) a zero-argument ``.join()`` call — joining a thread or process
+        with no timeout waits forever on a stalled peer (string
+        ``sep.join(parts)`` always takes an argument, so a bare join is
+        never the str method);
+    (b) a ``while`` loop with a CONSTANT-truthy test whose body sleeps
+        (``time.sleep`` et al.) but never references a bounding seam —
+        no ``deadline``/``timeout`` name, no injected ``clock`` read —
+        so nothing inside it can ever decide "too long".  Loops with a
+        real exit condition (``while self._clock() < deadline``, the
+        run loop's work-remaining test) are bounded by construction
+        and stay clean.
+
+    The escape hatch is the usual ``# cetpu: noqa[deadline-discipline]
+    <why>`` — e.g. a loop whose bound lives one call down."""
+    findings = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "join" \
+                and not node.args and not node.keywords:
+            findings.append(ctx.finding(
+                "deadline-discipline", node,
+                "bare .join() — pass timeout= (and handle the still-"
+                "alive case) so a stalled peer can't hold this plane "
+                "forever"))
+        if isinstance(node, ast.While):
+            test = node.test
+            constant_truthy = (isinstance(test, ast.Constant)
+                               and bool(test.value))
+            if not constant_truthy:
+                continue
+            if _sleep_calls(node) and not _has_deadline_seam(node):
+                findings.append(ctx.finding(
+                    "deadline-discipline", node,
+                    "unbounded poll loop: `while True` + sleep with no "
+                    "deadline/timeout/injected-clock reference — give "
+                    "it a deadline (or route the bound through the "
+                    "clock seam) so a gray peer can't wedge it"))
+    return findings

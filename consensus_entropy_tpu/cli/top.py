@@ -9,9 +9,11 @@ planner edges, jit-cache pressure and active SLO burn-rate alerts.
 Torn-read tolerant by construction: snapshots are atomic-rename files
 and the reader (:func:`~consensus_entropy_tpu.obs.status.read_status`)
 skips anything unparseable, so attaching mid-write, mid-copy or mid-run
-never crashes the view.  A snapshot older than ``--stale-s`` renders
-flagged — a wedged (or dead) writer LOOKS stale, which is exactly the
-signal.
+never crashes the view.  A snapshot older than ``STALE_INTERVALS``
+times its writer's own advertised cadence (``interval_s``, stamped on
+every snapshot; ``--stale-s`` is the fallback for pre-interval
+snapshots) renders flagged AND dimmed with its age — a wedged (or
+dead, or gray-slow) writer LOOKS stale, which is exactly the signal.
 
 Pure host code, no jax: point it at a live run's ``users/`` directory
 (or the ``status/`` directory itself) on any machine the files are
@@ -38,9 +40,34 @@ def resolve_status_dir(path: str) -> str:
     return path
 
 
+#: a snapshot older than this many of its WRITER'S OWN write intervals
+#: is stale — the gray-failure cue: a wedged-but-alive writer stops
+#: refreshing long before its lease expires, and judging age in units
+#: of the writer's advertised cadence (``interval_s`` on the snapshot)
+#: beats one fleet-wide ``--stale-s`` when workers write at different
+#: rates
+STALE_INTERVALS = 3.0
+
+
 def _age(snap: dict, now: float) -> float | None:
     t = snap.get("t")
     return max(now - t, 0.0) if isinstance(t, (int, float)) else None
+
+
+def _stale_bound(snap: dict, stale_s: float) -> float:
+    """The snapshot's own staleness bound: ``STALE_INTERVALS`` times
+    its writer's advertised ``interval_s`` when present (newer
+    writers), the fleet-wide ``--stale-s`` fallback otherwise."""
+    iv = snap.get("interval_s")
+    if isinstance(iv, (int, float)) and not isinstance(iv, bool) \
+            and iv > 0:
+        return STALE_INTERVALS * float(iv)
+    return stale_s
+
+
+def _is_stale(snap: dict, now: float, stale_s: float) -> bool:
+    age = _age(snap, now)
+    return age is None or age > _stale_bound(snap, stale_s)
 
 
 def _fmt_age(age: float | None, stale_s: float) -> str:
@@ -48,6 +75,12 @@ def _fmt_age(age: float | None, stale_s: float) -> str:
         return "?"
     flag = " STALE" if age > stale_s else ""
     return f"{age:.1f}s{flag}"
+
+
+def _dim(text: str) -> str:
+    """ANSI-dim a stale frame (the flag text stays greppable — the dim
+    is the at-a-glance cue, the word STALE the scriptable one)."""
+    return f"\x1b[2m{text}\x1b[0m"
 
 
 def _alert_lines(snap: dict) -> list[str]:
@@ -96,8 +129,9 @@ def render(snaps: dict, *, now: float, stale_s: float = 10.0,
     coord_keys = [h for h, s in snaps.items() if "hosts" in s]
     for key in sorted(coord_keys):
         s = snaps[key]
-        age = _fmt_age(_age(s, now), stale_s)
-        lines.append(f"[{key}] fleet — updated {age} ago")
+        age = _fmt_age(_age(s, now), _stale_bound(s, stale_s))
+        head = f"[{key}] fleet — updated {age} ago"
+        lines.append(_dim(head) if _is_stale(s, now, stale_s) else head)
         lines.append(
             f"    unresolved={s.get('unresolved')} "
             f"queued={s.get('queued')} in_flight={s.get('in_flight')} "
@@ -129,7 +163,8 @@ def render(snaps: dict, *, now: float, stale_s: float = 10.0,
     # worker frames
     for key in sorted(h for h in snaps if h not in coord_keys):
         s = snaps[key]
-        age = _fmt_age(_age(s, now), stale_s)
+        age = _fmt_age(_age(s, now), _stale_bound(s, stale_s))
+        stale = _is_stale(s, now, stale_s)
         flags = []
         if s.get("draining"):
             flags.append("DRAINING")
@@ -139,12 +174,13 @@ def render(snaps: dict, *, now: float, stale_s: float = 10.0,
             flags.append(f"fences={s['fences_pending']}")
         queued = s.get("queued") or {}
         qtxt = " ".join(f"{cls}:{n}" for cls, n in sorted(queued.items()))
-        lines.append(
+        head = (
             f"[{key}] live={s.get('live')}/{s.get('target_live')} "
             f"queue={s.get('queue_total')} ({qtxt or '-'}) "
             f"done={s.get('users_done')} failed={s.get('users_failed')}"
             f"{' ' + ' '.join(flags) if flags else ''}"
             f" — updated {age} ago")
+        lines.append(_dim(head) if stale else head)
         delta = _delta_line(ring, key)
         if delta:
             lines.append(delta)

@@ -270,6 +270,19 @@ class FleetScheduler:
         #: ``--no-introspection`` arm (events off; the process-wide
         #: counters still accumulate for snapshots).
         self.compile_events = compile_events
+        #: EMA of recent device-dispatch walls (seconds), every dispatch
+        #: shape folded — the gray detector's per-host step-wall signal,
+        #: advertised by the worker's lease heartbeats (``serve.hosts.
+        #: HostLease.step_source``).  Telemetry only: replay never reads
+        #: it, and ``None`` until the first dispatch grades.
+        self.step_wall_ema: float | None = None
+        #: the gray-degradation committee-depth dial: ``"full"`` (every
+        #: active member scores) or ``"cheap"`` (each session's committee
+        #: capped at its ``min_members`` floor — the fastest members
+        #: keep scoring, the slow tail is shed).  Set via
+        #: :meth:`set_depth` by the serve layer when the coordinator
+        #: degrades a probation host under sustained SLO burn.
+        self.depth = "full"
         self._opened = False
 
     # -- engine lifecycle --------------------------------------------------
@@ -330,9 +343,32 @@ class FleetScheduler:
         ``pad_pool_to`` — pinned for the whole run (resume-after-eviction
         rebuilds at the same width); a serving driver passes the user's
         bucket width here."""
+        self._apply_depth(getattr(entry, "committee", None))
         st = self._make_session(entry, entry.committee, pad=pad)
         self._ready.append((st, None, None))
         return st
+
+    def set_depth(self, depth: str) -> None:
+        """Flip the committee-depth dial for every live session and
+        future admission.  ``"cheap"`` caps each session's committee at
+        its ``min_members`` floor (``Committee.depth_cap`` — the scoring
+        path re-reads active members every staging pass, so in-flight
+        sessions pick the cap up at their next step); ``"full"``
+        restores every non-quarantined member.  Depth changes RESULTS by
+        design (a degraded committee is a different committee), which is
+        why the coordinator journals and events every flip and parity
+        drills keep the dial off."""
+        if depth not in ("full", "cheap"):
+            raise ValueError(f"unknown depth {depth!r} (full | cheap)")
+        self.depth = depth
+        for st in list(getattr(self, "_live", ())):
+            self._apply_depth(getattr(st.entry, "committee", None))
+
+    def _apply_depth(self, committee) -> None:
+        if committee is None or not hasattr(committee, "depth_cap"):
+            return
+        committee.depth_cap = (max(1, int(committee.min_members))
+                               if self.depth == "cheap" else None)
 
     def pump(self) -> bool:
         """One scheduling round: step every ready session, then either
@@ -866,6 +902,9 @@ class FleetScheduler:
             # width tags only BUCKETED dispatches: a plain fleet cohort
             # is one width by construction and its summaries/BENCH
             # artifacts must not grow a per-bucket section
+            self.step_wall_ema = (
+                wall if self.step_wall_ema is None
+                else 0.8 * self.step_wall_ema + 0.2 * wall)
             h2d_bytes, h2d_ops = h2d if h2d is not None else (None, None)
             self.report.dispatch(
                 fn_key, batch,
@@ -995,9 +1034,16 @@ class FleetScheduler:
             # attribute any XLA compile this call triggers to the
             # (fn, width, n_devices) jit family (obs.jit_telemetry
             # compile events)
+            d0 = time.perf_counter()
             with jit_telemetry.dispatch_scope(
                     fn_key, width=width, n_devices=self._n_devices()):
-                return self._group_fns(width)[fn_key](*stacked)
+                res = self._group_fns(width)[fn_key](*stacked)
+            # a pending slow rule (gray straggler) stretches the call on
+            # THIS thread — under a watchdog the stretch counts against
+            # the dispatch deadline, so a slow-enough host degrades to
+            # the per-user path through the existing breaker
+            faults.slow_hold("serve.dispatch", time.perf_counter() - d0)
+            return res
 
         self._profile_start()
         try:
@@ -1040,9 +1086,12 @@ class FleetScheduler:
         def dispatch():
             faults.fire("serve.dispatch", fn=fn_key, width=width,
                         batch=len(group))
+            d0 = time.perf_counter()
             with jit_telemetry.dispatch_scope(
                     fn_key, width=width, n_devices=self._n_devices()):
-                return committee_mod.stage_device_plans(plans)
+                res = committee_mod.stage_device_plans(plans)
+            faults.slow_hold("serve.dispatch", time.perf_counter() - d0)
+            return res
 
         self._profile_start()
         computed = (self.watchdog.call(dispatch,
@@ -1067,10 +1116,13 @@ class FleetScheduler:
         def dispatch():
             faults.fire("serve.dispatch", fn=fn_key,
                         width=step.session.acq.n_pad, batch=1)
+            d0 = time.perf_counter()
             with jit_telemetry.dispatch_scope(
                     fn_key, width=step.session.acq.n_pad,
                     n_devices=self._n_devices()):
-                return run()
+                res = run()
+            faults.slow_hold("serve.dispatch", time.perf_counter() - d0)
+            return res
 
         if self.watchdog is not None:
             return self.watchdog.call(dispatch, f"dispatch {fn_key}x1")

@@ -411,6 +411,16 @@ class Committee:
         self.quarantined: dict[str, str] = {}   # member name → reason
         self.quarantine_log: list[dict] = []    # full audit trail
         self._pending_events: list[dict] = []   # drained by the AL loop
+        #: the gray-degradation depth dial (``fleet.scheduler.
+        #: FleetScheduler.set_depth``): ``None`` = full committee; an int
+        #: caps how many ACTIVE members score — CNN (device-stacked,
+        #: fast) members keep their seats first, the slow host-member
+        #: tail is shed.  Reversible and volatile: nothing checkpointed
+        #: or journaled reads it, quarantine (permanent, audited) is
+        #: unaffected, and clearing it restores every survivor.  Floored
+        #: at ``min_members`` so degradation can never exhaust the
+        #: committee.
+        self.depth_cap: int | None = None
         if cnn_members:
             # the committee scores all CNN members as ONE stacked pytree, so
             # they must share a trunk family AND frontend geometry; the
@@ -518,18 +528,35 @@ class Committee:
         without a ``name`` (allowed by ``pool_probs``) key by type."""
         return getattr(m, "name", type(m).__name__)
 
+    def _active_pair(self) -> tuple[list, list]:
+        """(cnn, host) members still participating: quarantined members
+        excluded, then the ``depth_cap`` dial applied jointly — CNN
+        members (the device-stacked fast stage) keep their seats first,
+        host members fill what the cap leaves.  Cap ``None`` (the
+        default) is behavior-identical to the pre-dial committee."""
+        cnn = [m for m in self.cnn_members
+               if self._member_name(m) not in self.quarantined]
+        host = [m for m in self.host_members
+                if self._member_name(m) not in self.quarantined]
+        if self.depth_cap is None:
+            return cnn, host
+        cap = max(int(self.depth_cap), int(self.min_members), 1)
+        if len(cnn) + len(host) <= cap:
+            return cnn, host
+        kept_cnn = cnn[:cap]
+        return kept_cnn, host[:cap - len(kept_cnn)]
+
     @property
     def active_host_members(self) -> list[Member]:
         """Host members still participating (quarantined ones excluded);
-        identical to ``host_members`` until a quarantine fires, so the
-        unfaulted path is behavior-identical."""
-        return [m for m in self.host_members
-                if self._member_name(m) not in self.quarantined]
+        identical to ``host_members`` until a quarantine fires or the
+        depth dial caps the committee, so the unfaulted full-depth path
+        is behavior-identical."""
+        return self._active_pair()[1]
 
     @property
     def active_cnn_members(self) -> list[CNNMember]:
-        return [m for m in self.cnn_members
-                if self._member_name(m) not in self.quarantined]
+        return self._active_pair()[0]
 
     @property
     def active_size(self) -> int:

@@ -33,6 +33,13 @@ Alert kinds (the README "Observability" table renders these):
   ``max_skew`` above the fleet's floor: the placement invariant is being
   violated by attrition or degradation, and the remediation plane's
   drain-for-rebalance (``serve.remedy``) is the journaled response.
+- ``gray_suspect`` — a host is SLOW relative to its peers without being
+  dead: one or more gray signals (journal-append age, feed-ack lag,
+  lease-age skew, step-wall EMA) sit at ``gray_ratio`` times the peer
+  median AND past an absolute floor.  Peer-RELATIVE on purpose: a
+  constant threshold either fires on every cold start or sleeps through
+  a 10x-slow host on a fast fleet.  The coordinator's gray ladder
+  (``serve.remedy``) is the journaled response.
 
 Alerts can also ROUTE: :class:`AlertWatcher` takes a tuple of SINKS
 (:class:`ConsoleSink` — operator log line, :class:`JsonlSink` —
@@ -46,10 +53,18 @@ delivery, never control flow: a raising sink is counted
 from __future__ import annotations
 
 ALERT_KINDS = ("slo_headroom", "batch_aging", "breaker_open",
-               "lease_expiry", "placement_skew")
+               "lease_expiry", "placement_skew", "gray_suspect")
 
 #: default fraction of a bound an observation may burn before alerting
 BURN_FRAC = 0.8
+
+#: gray-failure outlier gates: a host is suspect when its signal is at
+#: least ``GRAY_RATIO`` times the PEER MEDIAN (the median of the OTHER
+#: hosts — a fleet-wide slowdown is load, not a gray failure) AND at
+#: least ``GRAY_MIN_ABS_S`` in absolute terms (ratio alone would flag
+#: microsecond noise on an idle fleet)
+GRAY_RATIO = 3.0
+GRAY_MIN_ABS_S = 1.0
 
 
 def slo_headroom_alerts(per_class_p95: dict, slo_s: dict, *,
@@ -140,6 +155,79 @@ def skew_alerts(loads: dict, *, max_skew: int) -> list[dict]:
                         "host": str(host), "load": int(load),
                         "floor": int(floor), "max_skew": int(max_skew)})
     return out
+
+
+def _median(vals: list) -> float:
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    return float(s[mid]) if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def _gray_outliers(values: dict, *, ratio: float,
+                   min_abs_s: float) -> list[tuple]:
+    """The peer-relative outlier kernel shared by every gray signal:
+    ``values`` maps host -> observed seconds (``None`` = no observation,
+    excluded from both sides).  For each host the PEER baseline is the
+    median of the OTHER hosts' values — excluding self, so one sick host
+    cannot drag the baseline toward itself on a small fleet.  Fires
+    ``(host, value, peer_median)`` when the value clears BOTH gates (see
+    ``GRAY_RATIO`` / ``GRAY_MIN_ABS_S``) and strictly exceeds its peers
+    (a fleet that is uniformly slow is load, not gray).  Fewer than two
+    observed hosts → no peers → no outliers."""
+    obs = {h: float(v) for h, v in values.items() if v is not None}
+    if len(obs) < 2:
+        return []
+    out = []
+    for host in sorted(obs):
+        peers = [v for h, v in obs.items() if h != host]
+        peer = _median(peers)
+        v = obs[host]
+        if v >= min_abs_s and v >= ratio * max(peer, 0.0) and v > peer:
+            out.append((host, v, peer))
+    return out
+
+
+def gray_suspect_alerts(*, append_ages: dict | None = None,
+                        ack_lags: dict | None = None,
+                        lease_ages: dict | None = None,
+                        step_walls: dict | None = None,
+                        ratio: float = GRAY_RATIO,
+                        min_abs_s: float = GRAY_MIN_ABS_S) -> list[dict]:
+    """The gray-failure detector: four peer-relative signals, one alert
+    per suspect host with the evidence attached.
+
+    - ``append_ages``: seconds since each LOADED host's event journal
+      last grew (an idle host legitimately appends nothing — callers
+      must pass only hosts with unresolved users).
+    - ``ack_lags``: age of each host's oldest unacked fence/drop
+      (``0.0`` — not ``None`` — for hosts with nothing pending, so only
+      a genuinely lagging host skews against its peers).
+    - ``lease_ages``: seconds since each host's last heartbeat (the
+      same view ``lease_alerts`` reads — gray catches the host whose
+      beats land LATE but never late enough to expire the lease).
+    - ``step_walls``: each host's self-advertised dispatch step-wall
+      EMA (``step_ema_s`` on its lease record).
+
+    Each signal runs :func:`_gray_outliers` independently; a host
+    flagged by ANY signal gets one ``gray_suspect`` alert listing every
+    firing signal plus its value/peer pair — the evidence the ladder
+    journals and the operator reads."""
+    signals = (("append_age", append_ages), ("ack_lag", ack_lags),
+               ("lease_age", lease_ages), ("step_wall", step_walls))
+    by_host: dict[str, dict] = {}
+    for name, values in signals:
+        if not values:
+            continue
+        for host, v, peer in _gray_outliers(values, ratio=ratio,
+                                            min_abs_s=min_abs_s):
+            alert = by_host.setdefault(
+                str(host), {"kind": "gray_suspect", "key": str(host),
+                            "host": str(host), "signals": []})
+            alert["signals"].append(name)
+            alert[f"{name}_s"] = round(float(v), 4)
+            alert[f"{name}_peer_s"] = round(float(peer), 4)
+    return [by_host[h] for h in sorted(by_host)]
 
 
 class ConsoleSink:

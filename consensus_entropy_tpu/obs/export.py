@@ -99,6 +99,13 @@ EVENT_FIELDS = {
     # that burned past --fence-deadline-s into evict+resume
     "remedy": {"host": "str", "action": "str"},
     "fence_timeout": {"user": "str", "host": "str"},
+    # the gray-failure ladder (serve.remedy gray kernels): a host placed
+    # on / lifted from probation (placement stops/resumes routing NEW
+    # users to it — journaled, so the rung survives a coordinator kill),
+    # and a probation host's committee scoring depth dialed between
+    # ``full`` and ``cheap`` under sustained SLO burn
+    "probation": {"host": "str"},
+    "depth_change": {"host": "str", "depth": "str"},
     # live intake churn (workload traces): a producer disconnected a
     # user mid-run (parked; workspace kept) / reconnected it (resumes
     # from the workspace over the journal re-admission path)

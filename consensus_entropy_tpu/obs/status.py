@@ -90,11 +90,16 @@ class StatusWriter:
         return True
 
     def write(self, payload: dict) -> dict:
-        """One snapshot: payload + the schema floor (kind/host/t), then
-        tmp-write + ``os.replace`` so readers never see a torn file."""
+        """One snapshot: payload + the schema floor (kind/host/t) +
+        this writer's ``interval_s`` (so a READER can judge staleness
+        in units of the writer's own cadence — ``cetpu-top`` flags a
+        snapshot older than a few write intervals without the operator
+        re-deriving the fleet's ``--status-interval``), then tmp-write
+        + ``os.replace`` so readers never see a torn file."""
         now = self._clock()
         snap = {"schema": STATUS_SCHEMA, "kind": "status",
-                "host": self.host, "t": round(now, 3), **payload}
+                "host": self.host, "t": round(now, 3),
+                "interval_s": self.interval_s, **payload}
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         tmp = f"{self.path}.tmp"
         with open(tmp, "wb") as f:

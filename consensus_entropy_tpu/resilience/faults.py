@@ -31,6 +31,20 @@ Fault actions model distinct failure species:
   pickle STOP opcode), an ndarray gets its first row set to NaN
   (degenerate member output).
 - ``delay`` sleeps ``delay_s`` (slow-I/O / straggler simulation).
+- ``stall`` holds the hit for ``stall_s`` seconds — the GRAY-failure
+  species: the process is alive (heartbeats keep flowing from their own
+  thread) but the guarded operation wedges.  ``stall=inf`` hangs until
+  the process is killed, the hung-but-alive worker every lease-based
+  failure detector is blind to.
+- ``slow`` multiplies the guarded operation's WALL TIME by
+  ``slow_factor`` — sticky for the rule's hit window: :func:`fire`
+  records the factor and the instrumented site calls :func:`slow_hold`
+  with the operation's measured elapsed time AFTER it completes, which
+  sleeps ``elapsed × (factor - 1)``.  Unlike ``delay`` (a fixed sleep),
+  ``slow`` scales with the real work, so a 20x-slow host stays
+  proportionally slow across mixed workloads — and unlike ``stall`` it
+  never blocks progress, only throughput: every journaled value is
+  untouched, so parity drills bind bit-identically.
 """
 
 from __future__ import annotations
@@ -117,9 +131,22 @@ FAULT_POINTS = frozenset({
     # restart re-derives the SAME epoch (correct: no feed line stamped
     # with it ever reached a worker)
     "fabric.epoch",
+    # gray-failure boundaries (the slow-not-dead fault domain): the
+    # escalation-ladder decision point and the feed-read seam — the two
+    # places PR 20 adds that earlier kill matrices never exercised
+    "fabric.gray",           # gray-ladder rung transition, pre-probation-
+                             # journal (a kill here leaves no record: the
+                             # restart re-times the suspicion from the
+                             # same peer-relative evidence and replays to
+                             # the same rung)
+    "serve.feed.poll",       # JsonlTail.poll — a stall here models a
+                             # LAGGING TAIL: the reader is alive but its
+                             # view of the feed/WAL goes stale, the gray
+                             # symptom the append-age detector catches
 })
 
-ACTIONS = ("kill", "raise", "transient", "corrupt", "delay")
+ACTIONS = ("kill", "raise", "transient", "corrupt", "delay", "stall",
+           "slow")
 
 
 class InjectedFault(Exception):
@@ -155,6 +182,10 @@ class FaultRule:
     times: int = 1
     delay_s: float = 0.01
     member: str | None = None
+    #: ``stall`` hold in seconds; ``float("inf")`` hangs until killed
+    stall_s: float = 1.0
+    #: ``slow`` wall-time multiplier honored by :func:`slow_hold`
+    slow_factor: float = 2.0
 
     def __post_init__(self):
         if self.point not in FAULT_POINTS:
@@ -165,6 +196,11 @@ class FaultRule:
                              f"(have {ACTIONS})")
         if self.at < 1:
             raise ValueError(f"at must be >= 1 (1-based hit), got {self.at}")
+        if self.stall_s < 0:
+            raise ValueError(f"stall_s must be >= 0, got {self.stall_s}")
+        if self.slow_factor < 1:
+            raise ValueError("slow_factor must be >= 1 (a multiplier on "
+                             f"the guarded op's wall), got {self.slow_factor}")
 
     def matches(self, hit: int, ctx: dict) -> bool:
         if self.member is not None and ctx.get("member") != self.member:
@@ -204,6 +240,11 @@ class FaultInjector:
         self.fired: list[dict] = []  # (point, action, hit) audit trail
         self.rng = np.random.default_rng(seed)
         self._lock = threading.Lock()
+        #: (thread id, point) -> pending slow factor, armed by a matched
+        #: ``slow`` rule in :meth:`fire` and consumed by the site's
+        #: :meth:`slow_hold` after the guarded op completes.  Thread-keyed
+        #: so one thread's slow dispatch never stretches a sibling's.
+        self._slow_pending: dict[tuple, float] = {}
 
     def fire(self, point: str, payload=None, **ctx):
         with self._lock:
@@ -219,6 +260,10 @@ class FaultInjector:
             for r in todo:
                 self.fired.append({"point": point, "action": r.action,
                                    "hit": hit, **ctx})
+                if r.action == "slow":
+                    skey = (threading.get_ident(), point)
+                    self._slow_pending[skey] = max(
+                        self._slow_pending.get(skey, 1.0), r.slow_factor)
         for r in todo:
             where = f"{point} hit {hit}" + (
                 f" ({ctx['member']})" if "member" in ctx else "")
@@ -230,9 +275,25 @@ class FaultInjector:
                 raise TransientFault(f"injected transient error at {where}")
             if r.action == "delay":
                 time.sleep(r.delay_s)
+            elif r.action == "stall":
+                # the gray hold: the hit wedges here while the rest of
+                # the process (heartbeat thread, intake thread) runs on
+                while r.stall_s == float("inf"):
+                    time.sleep(3600)
+                time.sleep(r.stall_s)
             elif r.action == "corrupt":
                 payload = self._corrupt(payload, where)
         return payload
+
+    def slow_hold(self, point: str, elapsed_s: float) -> None:
+        """Honor a pending ``slow`` factor armed by this thread's last
+        :meth:`fire` of ``point``: sleep ``elapsed × (factor - 1)`` so
+        the guarded operation's total wall is ``elapsed × factor``."""
+        with self._lock:
+            factor = self._slow_pending.pop(
+                (threading.get_ident(), point), None)
+        if factor is not None and factor > 1.0 and elapsed_s > 0:
+            time.sleep(elapsed_s * (factor - 1.0))
 
     def _corrupt(self, payload, where: str):
         if isinstance(payload, (str, os.PathLike)):
@@ -274,6 +335,18 @@ def fire(point: str, payload=None, **ctx):
     return inj.fire(point, payload=payload, **ctx)
 
 
+def slow_hold(point: str, elapsed_s: float) -> None:
+    """The ``slow``-action honor hook: instrumented sites bracket their
+    guarded operation with a perf-counter and call this with the measured
+    elapsed seconds — a pending factor (armed by this thread's preceding
+    :func:`fire` of the same point) stretches the op to ``elapsed ×
+    factor`` total wall.  No-op (one attribute check) when no injector is
+    installed or no ``slow`` rule matched the hit."""
+    inj = _injector
+    if inj is not None:
+        inj.slow_hold(point, elapsed_s)
+
+
 @contextlib.contextmanager
 def inject(*rules, seed: int = 0):
     """Install an injector for the block; yields it (``.fired`` is the
@@ -288,14 +361,50 @@ def inject(*rules, seed: int = 0):
         install(prev) if prev is not None else uninstall()
 
 
+#: ``action=value`` suffix grammar: which actions take a float value and
+#: which :class:`FaultRule` field it lands in.  One table, one validated
+#: parse path — adding a valued action is a row here, never a fourth
+#: inline ``startswith`` branch.
+_VALUED_ACTIONS = {"delay": "delay_s", "stall": "stall_s",
+                   "slow": "slow_factor"}
+
+
+def _parse_action(token: str) -> tuple[str, dict]:
+    """Parse one ``action`` or ``action=value`` token into ``(action,
+    rule-field overrides)`` with clean errors for malformed floats and
+    keys that take no value.  The action NAME is still validated by
+    :class:`FaultRule` (one place owns the action list)."""
+    action, sep, value = token.partition("=")
+    if not sep:
+        return action, {}
+    field = _VALUED_ACTIONS.get(action)
+    if field is None:
+        keys = ", ".join(f"{k}=" for k in sorted(_VALUED_ACTIONS))
+        raise ValueError(f"action {action!r} takes no '=value' suffix "
+                         f"(valued actions: {keys})")
+    try:
+        parsed = float(value)
+    except ValueError:
+        raise ValueError(f"malformed float {value!r} for "
+                         f"{action}=") from None
+    return action, {field: parsed}
+
+
 def parse_spec(spec: str) -> list[FaultRule]:
     """Parse the ``CETPU_FAULTS`` grammar: comma-separated
-    ``point:action[@at][xTIMES]`` — e.g.
-    ``checkpoint.write:kill@3,member.predict:corrupt@1x2``.  The
-    ``delay`` action takes an optional duration: ``delay=0.5`` sleeps
-    half a second per firing (default 0.01) — ``pool.score:delay=0.4@1x-1``
-    turns a worker into a slow host for straggler/drain drills without
-    touching any journaled value."""
+    ``point:action[=value][@at][xTIMES]`` — e.g.
+    ``checkpoint.write:kill@3,member.predict:corrupt@1x2``.  Valued
+    actions (see ``_VALUED_ACTIONS``):
+
+    - ``delay=0.5`` sleeps half a second per firing (default 0.01) —
+      ``pool.score:delay=0.4@1x-1`` turns a worker into a slow host for
+      straggler/drain drills without touching any journaled value.
+    - ``stall=5`` holds each hit five seconds (``stall=inf`` hangs until
+      killed) — the gray wedge: ``serve.dispatch:stall=5@1x-1`` is the
+      hung-but-heartbeating worker.
+    - ``slow=20`` multiplies the guarded op's wall 20x for the rule's
+      hit window — the gray straggler, proportional to real work.
+    """
     rules = []
     for part in filter(None, (p.strip() for p in spec.split(","))):
         try:
@@ -308,15 +417,13 @@ def parse_spec(spec: str) -> list[FaultRule]:
             if "@" in rest:
                 rest, at_s = rest.split("@", 1)
                 at = int(at_s)
-            delay_s = 0.01
-            if rest.startswith("delay="):
-                rest, delay_s = "delay", float(rest[len("delay="):])
-            rules.append(FaultRule(point=point, action=rest, at=at,
-                                   times=times, delay_s=delay_s))
+            action, overrides = _parse_action(rest)
+            rules.append(FaultRule(point=point, action=action, at=at,
+                                   times=times, **overrides))
         except ValueError as e:
             raise ValueError(
                 f"bad CETPU_FAULTS entry {part!r} (want "
-                f"point:action[@at][xTIMES]): {e}") from e
+                f"point:action[=value][@at][xTIMES]): {e}") from e
     return rules
 
 

@@ -55,6 +55,7 @@ import base64
 import errno
 import json
 import os
+import time
 import zlib
 
 from consensus_entropy_tpu.resilience import faults
@@ -137,13 +138,20 @@ def write(f, data: bytes, *, path: str, member: str = "wal") -> None:
 def fsync(f, *, path: str, member: str = "wal") -> None:
     """The durability barrier.  An injected ``raise`` here DROPS the
     fsync silently (the lying-disk model — the caller believes the
-    record is durable); everything else fsyncs for real."""
+    record is durable); everything else fsyncs for real.  A ``slow``
+    rule multiplies the barrier's measured wall (the gray slow-disk
+    model — every durable append pays it, so ``io.fsync:slow=F`` is the
+    whole WAL path running F-times slow); a ``stall`` rule wedges the
+    barrier inside :func:`~consensus_entropy_tpu.resilience.faults.fire`
+    itself."""
     try:
         faults.fire("io.fsync", member=member, path=path)
     except faults.InjectedFault:
         _notify("io.fsync", path)
         return
+    t0 = time.perf_counter()
     os.fsync(f.fileno())  # cetpu: noqa[raw-durable-io] this IS the seam
+    faults.slow_hold("io.fsync", time.perf_counter() - t0)
 
 
 def replace(src: str, dst: str, *, member: str = "wal") -> None:

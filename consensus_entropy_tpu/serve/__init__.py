@@ -108,9 +108,13 @@ from consensus_entropy_tpu.serve.placement import (
     plan_rebalance,
 )
 from consensus_entropy_tpu.serve.remedy import (
+    GRAY_RUNGS,
     cooldown_ok,
+    degrade_depth,
     fence_expired,
+    gray_rung,
     pick_shed,
+    probation_clear,
     remedy_due,
     shed_count,
 )
@@ -130,10 +134,11 @@ __all__ = ["AdmissionJournal", "AdmissionPlanner", "AdmissionQueue",
            "JsonlTail", "PLACEMENT_POLICIES", "PRIORITY_CLASSES",
            "PoisonList", "QueueClosed", "QueueFull", "ServeConfig",
            "SingleWriterViolation", "Watchdog", "WatchdogTimeout",
-           "admission_hold", "bucket_for", "cooldown_ok",
-           "derive_edges", "dispatch_hold", "drain_victim",
-           "fence_expired", "next_host_id", "pick_shed", "place",
-           "place_user", "plan_failover", "plan_rebalance",
-           "remedy_due", "run_worker", "scale_down_ok", "shed_count",
+           "GRAY_RUNGS", "admission_hold", "bucket_for", "cooldown_ok",
+           "degrade_depth", "derive_edges", "dispatch_hold",
+           "drain_victim", "fence_expired", "gray_rung", "next_host_id",
+           "pick_shed", "place", "place_user", "plan_failover",
+           "plan_rebalance", "probation_clear", "remedy_due",
+           "run_worker", "scale_down_ok", "shed_count",
            "target_hosts", "validate_bucket_widths",
            "validate_journal_file"]

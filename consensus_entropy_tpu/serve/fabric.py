@@ -283,6 +283,36 @@ class FabricConfig:
     #: against (defaults mirror ``ServeConfig``)
     slo_interactive_s: float = 60.0
     slo_batch_s: float = 600.0
+    #: the GRAY-FAILURE ladder (``obs.alerts.gray_suspect_alerts`` +
+    #: the ``serve.remedy`` gray kernels): detect hosts that are SLOW
+    #: RELATIVE TO THEIR PEERS (journal-append age, fence-ack lag,
+    #: lease-age skew, step-wall EMA — none of which a liveness lease
+    #: catches, because the host still beats) and walk a journaled
+    #: suspicion → probation → drain ladder, each rung gated on
+    #: sustained evidence.  Probation records replay
+    #: (``JournalState.probation``), so a coordinator SIGKILL mid-ladder
+    #: restarts at the same rung.  Requires the elastic plane (the
+    #: drain rung is its drop-ack/fence machinery).
+    gray: bool = False
+    #: peer-relative outlier gates (see ``obs.alerts.GRAY_RATIO`` /
+    #: ``GRAY_MIN_ABS_S``): a signal fires at ``gray_ratio`` times the
+    #: peer median AND at least ``gray_min_s`` absolute
+    gray_ratio: float = 3.0
+    gray_min_s: float = 1.0
+    #: ladder hysteresis: continuous suspect evidence for
+    #: ``gray_hold_s`` → probation; ``gray_drain_s`` MORE → drain the
+    #: host's users; clean for ``gray_clear_s`` → probation lifts
+    gray_hold_s: float = remedy_mod.DEFAULT_GRAY_HOLD_S
+    gray_drain_s: float = remedy_mod.DEFAULT_GRAY_DRAIN_S
+    gray_clear_s: float = remedy_mod.DEFAULT_GRAY_CLEAR_S
+    #: DEGRADATION dial: a probation host under sustained slo_headroom
+    #: burn is told to score with the cheap committee stage
+    #: (``depth: cheap`` feed verb → ``Committee.depth_cap``), restored
+    #: when the burn clears or probation lifts.  Default OFF: capping
+    #: committee depth changes scores, so parity-pinned runs leave it
+    #: off (the dial's own test covers it).
+    depth_on_burn: bool = False
+    depth_hold_s: float = remedy_mod.DEFAULT_DEPTH_HOLD_S
 
     @property
     def elastic(self) -> bool:
@@ -365,6 +395,30 @@ class FabricConfig:
         if self.remedy_skew < 1:
             raise ValueError(f"remedy_skew must be >= 1, "
                              f"got {self.remedy_skew}")
+        if self.gray and not self.elastic:
+            raise ValueError(
+                "gray requires the elastic control plane (set "
+                "min_hosts/max_hosts — the drain rung is its drop-ack "
+                "and fence machinery)")
+        if self.gray_ratio < 1:
+            raise ValueError(f"gray_ratio must be >= 1, "
+                             f"got {self.gray_ratio}")
+        if self.gray_min_s < 0:
+            raise ValueError(f"gray_min_s must be >= 0, "
+                             f"got {self.gray_min_s}")
+        if self.gray_hold_s < 0 or self.gray_drain_s < 0 \
+                or self.gray_clear_s < 0:
+            raise ValueError(
+                f"gray_hold_s/gray_drain_s/gray_clear_s must be >= 0, "
+                f"got {self.gray_hold_s} / {self.gray_drain_s} / "
+                f"{self.gray_clear_s}")
+        if self.depth_on_burn and not self.gray:
+            raise ValueError(
+                "depth_on_burn requires the gray ladder (set gray=True "
+                "— the dial only ever degrades probation hosts)")
+        if self.depth_hold_s < 0:
+            raise ValueError(f"depth_hold_s must be >= 0, "
+                             f"got {self.depth_hold_s}")
         if self.intake_max < 1:
             raise ValueError(f"intake_max must be >= 1, "
                              f"got {self.intake_max}")
@@ -556,6 +610,23 @@ class FabricCoordinator:
         self._remedy_last: float | None = None
         self.remedies = 0
         self.fences_timed_out = 0
+        # -- gray-failure ladder state (all liveness-only EXCEPT the
+        # probation set, which lives in journal.state.probation and
+        # replays): host → when its gray_suspect alert was first seen
+        # holding, probation host → when it was last seen CLEAN, host →
+        # wall time of its last transcribed event (the append-age
+        # signal's input), and the depth dial's burn timers
+        self._gray_hot: dict[str, float] = {}
+        self._gray_clean: dict[str, float] = {}
+        self._gray_last_event_t: dict[str, float] = {}
+        self._depth_burn: dict[str, float] = {}
+        #: hosts currently dialed to cheap-stage scoring (subset of the
+        #: probation set; liveness-only — the depth_change journals as a
+        #: remedy audit record)
+        self._depth_cheap: set = set()
+        self.probations = 0
+        self.gray_drains = 0
+        self.depth_changes = 0
         #: the host currently draining (one scale-down at a time), and
         #: when the low-water mark started holding (injected clock;
         #: liveness-only — the drain DECISION journals, replay never
@@ -745,6 +816,7 @@ class FabricCoordinator:
                     self._pump_drain()
                     self._check_fence_deadlines()
                     self._pump_remedy()
+                    self._pump_gray()
                     self._broadcast_edges()
                 if not any(h.alive for h in self.hosts.values()):
                     # the elastic autoscaler above respawns dead capacity
@@ -1479,6 +1551,11 @@ class FabricCoordinator:
                 self._class_p95s(),
                 {"interactive": self.config.slo_interactive_s,
                  "batch": self.config.slo_batch_s})
+        if self.config.gray:
+            # the gray detector rides the composed list too — the
+            # ladder pump reads the same kernels directly for its
+            # hysteresis, the watcher only edge-triggers the event
+            out += self._gray_alerts(now)
         return out
 
     def _live_loads(self) -> dict:
@@ -1591,6 +1668,229 @@ class FabricCoordinator:
             # re-fires if the condition still (or again) holds
             self.alerts.rearm("placement_skew", victim)
 
+    def _gray_alerts(self, now: float) -> list:
+        """Assemble the four peer-relative gray signals from state the
+        coordinator already watches and run the detector
+        (``obs.alerts.gray_suspect_alerts``):
+
+        - append age: seconds since each LOADED host's event journal
+          last yielded a transcription (idle hosts excluded — they
+          legitimately append nothing; a loaded host that has not yet
+          transcribed its FIRST event is unobserved rather than aged,
+          so a cold worker still compiling is never accused of going
+          quiet before it ever spoke);
+        - ack lag: age of each host's oldest pending checkpoint fence
+          (``0.0`` for hosts with nothing pending, so only a genuinely
+          lagging source skews);
+        - lease age: the same injected-clock view ``lease_alerts``
+          reads — gray catches beats that land LATE without expiring;
+        - step wall: the worker's self-advertised dispatch EMA
+          (``step_ema_s`` on its lease record)."""
+        from consensus_entropy_tpu.obs import alerts as alerts_mod
+
+        cfg = self.config
+        append_ages: dict = {}
+        ack_lags: dict = {}
+        lease_ages: dict = {}
+        step_walls: dict = {}
+        for hid, h in self.hosts.items():
+            if not (h.alive and h.joined):
+                continue
+            lease_ages[hid] = lease_age_s(h.lease_path, now)
+            if self._load_of(hid) > 0:
+                t0 = self._gray_last_event_t.get(hid)
+                append_ages[hid] = None if t0 is None \
+                    else max(now - t0, 0.0)
+            beat = read_lease(h.lease_path)
+            step = (beat or {}).get("step_ema_s")
+            step_walls[hid] = float(step) \
+                if isinstance(step, (int, float)) else None
+            ack_lags[hid] = 0.0
+        for u, src in self._fencing.items():
+            t0 = self._fence_t.get(u)
+            if src in ack_lags and t0 is not None:
+                ack_lags[src] = max(ack_lags[src], now - t0)
+        return alerts_mod.gray_suspect_alerts(
+            append_ages=append_ages, ack_lags=ack_lags,
+            lease_ages=lease_ages, step_walls=step_walls,
+            ratio=cfg.gray_ratio, min_abs_s=cfg.gray_min_s)
+
+    def _pump_gray(self) -> None:
+        """One gray-ladder round (``gray``): fold each host's
+        gray_suspect evidence into the hysteresis timers and walk the
+        ladder — sustained suspicion journals PROBATION (placement
+        stops routing NEW users; the record REPLAYS, so a coordinator
+        SIGKILL mid-ladder restarts at the same rung), more of the same
+        drains the host's existing users over the drain-for-rebalance
+        machinery (``remedy`` record, action ``gray_drain``; every move
+        ack-gated), and a sustained clean streak lifts probation.  The
+        deadline-fenced EVICT beyond drain is not driven here — it is
+        ``_check_fence_deadlines`` firing on the drain's own fences."""
+        cfg = self.config
+        if not cfg.gray:
+            return
+        if self.alerts is not None:
+            # feed the watcher the same COMPOSED list every other call
+            # site does (snapshot-based: partial lists delete keys)
+            self.alerts.update(self._evaluate_alerts())
+        now = self._clock()
+        st = self.journal.state
+        suspects = {a["host"]: a for a in self._gray_alerts(now)}
+        for hid in list(self._gray_hot):
+            if hid not in suspects:
+                del self._gray_hot[hid]  # condition cleared: re-time
+        for hid in sorted(suspects):
+            self._gray_hot.setdefault(hid, now)
+        for hid in list(self._gray_clean):
+            if hid in suspects or hid not in st.probation:
+                del self._gray_clean[hid]
+        for hid in sorted(st.probation):
+            if hid not in suspects:
+                self._gray_clean.setdefault(hid, now)
+        # the DOWN ladder first: a host that earned its lift is a route
+        # target again before this round's escalations place anything
+        for hid in sorted(st.probation):
+            if not remedy_mod.probation_clear(
+                    self._gray_clean.get(hid), now,
+                    clear_s=cfg.gray_clear_s):
+                continue
+            faults.fire("fabric.gray", host=hid, rung="lift")
+            rec = self.journal.append("probation", host=hid, on=False)
+            self.report.event("probation", host=hid, on=False)
+            self._ctl("ctl.gray", key=rec["seq"], host=hid,
+                      rung="healthy")
+            self._gray_clean.pop(hid, None)
+            self._restore_depth(hid)
+        self._pump_depth(now)
+        for hid in sorted(suspects):
+            h = self.hosts.get(hid)
+            if h is None or not h.alive or h.draining:
+                continue
+            rung = remedy_mod.gray_rung(
+                self._gray_hot.get(hid), now,
+                hold_s=cfg.gray_hold_s, drain_s=cfg.gray_drain_s)
+            if rung in ("probation", "drain") \
+                    and hid not in st.probation:
+                # a kill here models dying between the rung decision
+                # and its journal record: nothing routed differently
+                # yet — the restart re-times the evidence and re-derives
+                # the same escalation from the journal alone
+                faults.fire("fabric.gray", host=hid, rung="probation")
+                rec = self.journal.append("probation", host=hid,
+                                          on=True)
+                self.probations += 1
+                self.report.event("probation", host=hid, on=True)
+                self._ctl("ctl.gray", key=rec["seq"], host=hid,
+                          rung="probation")
+                if self.alerts is not None:
+                    # acting on the alert CONSUMES it (rearm discipline)
+                    self.alerts.rearm("gray_suspect", hid)
+            if rung == "drain":
+                self._gray_drain(hid, now)
+
+    def _gray_drain(self, victim: str, now: float) -> None:
+        """The ladder's drain rung: shed EVERY unresolved user off the
+        probation host — queued via drop-acks, in-flight via checkpoint
+        fences — WITHOUT retiring it (no drain record: probation
+        already stops new routing, and a recovered host lifts back into
+        rotation with its capacity intact).  Same one-wave-at-a-time /
+        batch-plan discipline as ``_pump_remedy``; the journaled
+        ``remedy`` record (action ``gray_drain``) is audit-only, every
+        move commits on the source worker's ack."""
+        if self._migrating or self._fencing or self._draining_host:
+            return  # one ack-gated wave at a time keeps replay auditable
+        cfg = self.config
+        h = self.hosts.get(victim)
+        targets = [t for t in self._route_targets() if t != victim]
+        if h is None or not targets:
+            return  # nowhere to shed; the autoscaler may add capacity
+        st = self.journal.state
+        mine = [u for u in st.assigned_to(victim)
+                if u in self._unresolved]
+        queued = [u for u in mine if st.last.get(u) == "enqueue"]
+        in_flight = [u for u in mine if st.last.get(u) == "admit"]
+        drops, fences = remedy_mod.pick_shed(
+            queued, in_flight, len(mine),
+            migrate_inflight=cfg.migrate_inflight)
+        if not drops and not fences:
+            return  # already empty: probation alone holds the line
+        faults.fire("fabric.remedy", host=victim, action="gray_drain")
+        rec = self.journal.append("remedy", host=victim,
+                                  action="gray_drain")
+        self.gray_drains += 1
+        self.report.event("remedy", host=victim, action="gray_drain")
+        self._ctl("ctl.remedy", key=rec["seq"], host=victim,
+                  action="gray_drain", drops=len(drops),
+                  fences=len(fences))
+        drop_target = dict(placement_mod.plan_failover(
+            drops, state=st, unresolved=self._unresolved, hosts=targets,
+            edges=self._fleet_edges(), policy=cfg.placement,
+            devices=self._host_devices()))
+        for u in drops:
+            self._migrating[u] = drop_target[u]
+            h.assign.append({"drop": u})
+            self.report.event("migrate_request", user=u,
+                              host=drop_target[u])
+        for u in fences:
+            self._fencing[u] = victim
+            self._fence_t[u] = now
+            h.assign.append({"fence": u})
+            self.report.event("migrate_request", user=u, host=victim)
+
+    def _pump_depth(self, now: float) -> None:
+        """The DEGRADATION dial (``depth_on_burn``): a probation host
+        while the fleet's slo_headroom burn holds for ``depth_hold_s``
+        is told to score with the cheap committee stage (``depth`` feed
+        verb → ``Committee.depth_cap`` on the worker), restored the
+        moment the burn clears (probation lift also restores).  The
+        change is journaled (``remedy`` audit record, ``depth_change``
+        event) and graded in telemetry; nothing replayed reads it."""
+        cfg = self.config
+        if not cfg.depth_on_burn:
+            return
+        from consensus_entropy_tpu.obs import alerts as alerts_mod
+
+        burning = bool(alerts_mod.slo_headroom_alerts(
+            self._class_p95s(),
+            {"interactive": cfg.slo_interactive_s,
+             "batch": cfg.slo_batch_s}))
+        for hid in sorted(self.journal.state.probation):
+            if burning:
+                self._depth_burn.setdefault(hid, now)
+            else:
+                self._depth_burn.pop(hid, None)
+            held = self._depth_burn.get(hid)
+            burn_held = None if held is None else now - held
+            if remedy_mod.degrade_depth(True, burn_held,
+                                        hold_s=cfg.depth_hold_s):
+                if hid not in self._depth_cheap:
+                    self._set_depth(hid, "cheap")
+            elif hid in self._depth_cheap and not burning:
+                self._set_depth(hid, "full")
+
+    def _set_depth(self, hid: str, depth: str) -> None:
+        h = self.hosts.get(hid)
+        if h is None or not h.alive:
+            return
+        rec = self.journal.append("remedy", host=hid,
+                                  action=f"depth_{depth}")
+        self.depth_changes += 1
+        self.report.event("depth_change", host=hid, depth=depth)
+        self._ctl("ctl.depth", key=rec["seq"], host=hid, depth=depth)
+        h.assign.append({"depth": depth})
+        if depth == "cheap":
+            self._depth_cheap.add(hid)
+        else:
+            self._depth_cheap.discard(hid)
+            self._depth_burn.pop(hid, None)
+
+    def _restore_depth(self, hid: str) -> None:
+        """Probation lifted (or the host died): dial it back to full
+        scoring if this coordinator degraded it."""
+        if hid in self._depth_cheap:
+            self._set_depth(hid, "full")
+        self._depth_burn.pop(hid, None)
+
     def _adopt_operator_hosts(self) -> None:
         """Operator-added workers announce through the lease directory:
         a fresh ``lease_<id>.json`` for an id the coordinator never
@@ -1690,6 +1990,18 @@ class FabricCoordinator:
             # actually happened); the scale-down clock restarts
             self._draining_host = None
             h.draining = False
+        # death supersedes the gray ladder: drop the liveness-only
+        # evidence timers, and journal the probation lift so a respawn
+        # of this slot starts back in rotation (the ladder re-earns any
+        # new suspicion from fresh evidence)
+        self._gray_hot.pop(h.host_id, None)
+        self._gray_clean.pop(h.host_id, None)
+        self._gray_last_event_t.pop(h.host_id, None)
+        self._depth_burn.pop(h.host_id, None)
+        self._depth_cheap.discard(h.host_id)
+        if h.host_id in self.journal.state.probation:
+            self.journal.append("probation", host=h.host_id, on=False)
+            self.report.event("probation", host=h.host_id, on=False)
         # migrations whose TARGET just died stay pending on purpose: the
         # source may have already withdrawn the user (its ack is in
         # flight), so the ack handler must still see the entry and
@@ -1849,10 +2161,19 @@ class FabricCoordinator:
         return devs or None
 
     def _route_targets(self) -> list:
-        """Hosts a placement may target: alive and NOT draining — a
-        draining host sheds users, it never receives them."""
-        return [h.host_id for h in self.hosts.values()
+        """Hosts a placement may target: alive, NOT draining — a
+        draining host sheds users, it never receives them — and not on
+        gray-failure PROBATION (the ladder's routing rung: a suspect
+        host keeps its existing users but takes no new ones).  The
+        probation exclusion is a preference, not a hard ban: when every
+        live host is on probation the full list stands (progress over
+        purity, the ``_assign`` exclude precedent)."""
+        live = [h.host_id for h in self.hosts.values()
                 if h.alive and not h.draining]
+        prob = self.journal.state.probation
+        if prob:
+            live = [hid for hid in live if hid not in prob] or live
+        return live
 
     def _assign(self, user: str, exclude: str | None = None) -> str | None:
         """Place and commit one user; returns the target host id, or
@@ -1922,6 +2243,9 @@ class FabricCoordinator:
         tail exactly where the journal proves it left off (an event is
         transcribed at-least-zero, never twice)."""
         for rec, off in h.tail.poll():
+            # any transcribed event resets the host's append-age gray
+            # signal (liveness-only telemetry; replay never reads it)
+            self._gray_last_event_t[h.host_id] = self._clock()
             ev, u = rec.get("event"), rec.get("user")
             if ev == "admit":
                 self.journal.append("admit", u, host=h.host_id,
@@ -2226,6 +2550,11 @@ class FabricCoordinator:
             "fence_timeouts": self.fences_timed_out,
             "fencing": len(self._fencing),
             "draining_host": self._draining_host,
+            "probation": sorted(st.probation),
+            "probations": self.probations,
+            "gray_drains": self.gray_drains,
+            "depth_changes": self.depth_changes,
+            "depth_cheap": sorted(self._depth_cheap),
             "edges": list(self._fleet_edges()) or None,
             "holds": self.holds,
             "hold_active": self._hold_until is not None,
@@ -2258,6 +2587,9 @@ class FabricCoordinator:
             "fences": self.fences,
             "remedies": self.remedies,
             "fence_timeouts": self.fences_timed_out,
+            "probations": self.probations,
+            "gray_drains": self.gray_drains,
+            "depth_changes": self.depth_changes,
             "holds": self.holds,
             "disconnects": self.disconnects,
             "reconnects": self.reconnects,

@@ -145,7 +145,8 @@ class HostLease:
     workspaces."""
 
     def __init__(self, path: str, host_id: str, interval_s: float, *,
-                 orphan_check: bool = True, devices: int | None = None):
+                 orphan_check: bool = True, devices: int | None = None,
+                 step_source=None):
         if interval_s <= 0:
             raise ValueError(f"interval_s must be > 0, got {interval_s}")
         self.path = path
@@ -156,6 +157,12 @@ class HostLease:
         #: buckets toward multi-chip hosts.  ``None`` = legacy beat
         #: (no ``devices`` field), coordinator treats as 1
         self.devices = devices
+        #: optional zero-arg callable returning this worker's current
+        #: dispatch step-wall EMA in seconds (or ``None``); carried in
+        #: every beat as ``step_ema_s`` so the coordinator's gray
+        #: detector can compare each host's device-step wall against the
+        #: fleet's peers.  Telemetry only — replay never reads a lease.
+        self.step_source = step_source
         self.beats = 0
         self._orphan_check = orphan_check
         self._ppid = os.getppid()
@@ -164,7 +171,10 @@ class HostLease:
 
     def beat_once(self) -> None:
         """One heartbeat: fault point, then tmp-write + atomic rename (a
-        reader sees the previous beat or this one, never a torn file)."""
+        reader sees the previous beat or this one, never a torn file).
+        A ``slow`` rule on ``fabric.lease`` stretches the whole beat
+        PERIOD (``slow_hold`` over ``interval_s``) — the late-heartbeat
+        gray species: beats keep landing, each one F intervals apart."""
         import json
 
         self.beats += 1
@@ -174,8 +184,13 @@ class HostLease:
                "t": round(time.time(), 3)}  # cetpu: noqa[replay-wallclock] heartbeat wall-stamp: liveness crosses processes, replay never reads it
         if self.devices is not None:
             rec["devices"] = int(self.devices)
+        if self.step_source is not None:
+            step = self.step_source()
+            if isinstance(step, (int, float)):
+                rec["step_ema_s"] = round(float(step), 4)
         dio.atomic_write(self.path, json.dumps(rec).encode("utf-8"),
                          member="lease")
+        faults.slow_hold("fabric.lease", self.interval_s)
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -302,6 +317,16 @@ def run_worker(fabric_dir: str, host_id: str, *, build_entry, scheduler,
                     except (TypeError, ValueError):
                         pass  # malformed broadcast: keep local routing
                     continue
+                if isinstance(rec.get("depth"), str):
+                    # gray-ladder degradation dial: score with the
+                    # cheap committee stage ("cheap") or restore
+                    # ("full").  Telemetry-graded, never journaled —
+                    # a malformed value keeps the current depth
+                    try:
+                        server.set_depth(rec["depth"])
+                    except (AttributeError, ValueError):
+                        pass
+                    continue
                 if rec.get("drop") is not None:
                     uid = str(rec["drop"])
                     if rec.get("evict"):
@@ -357,7 +382,9 @@ def run_worker(fabric_dir: str, host_id: str, *, build_entry, scheduler,
         devices = int(getattr(config, "mesh_devices", 1) or 1)
     lease = HostLease(paths["lease"], host_id,
                       max(lease_s / 3.0, 0.05),
-                      devices=devices).start()
+                      devices=devices,
+                      step_source=lambda: getattr(
+                          scheduler, "step_wall_ema", None)).start()
     thread = threading.Thread(target=intake, daemon=True,
                               name=f"fabric-intake-{host_id}")
     thread.start()

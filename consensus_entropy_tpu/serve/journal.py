@@ -103,6 +103,14 @@ PLANNER_EVENTS = ("planner",)
 #: routing, so replay folds it into the cursor/seq only and the actions
 #: it drove re-derive from the ack-gated records that follow it.
 REMEDY_EVENTS = ("remedy",)
+#: gray-failure ladder records (``serve.remedy`` ladder / the
+#: coordinator's gray pump): ``probation`` carries ``host`` + ``on``
+#: (bool).  UNLIKE a remedy record this one IS replayed: probation is
+#: ROUTING state (placement stops handing NEW users to the host), so a
+#: coordinator SIGKILLed mid-ladder must restart with the same hosts
+#: still on probation — the set folds into ``JournalState.probation``
+#: and survives compaction via the checkpoint.
+PROBATION_EVENTS = ("probation",)
 #: coordinator fencing-epoch records: ``epoch`` journals an incarnation's
 #: claim (monotonic — each coordinator claims one greater than any the
 #: journal has seen, so feed lines and acks are attributable to exactly
@@ -153,6 +161,10 @@ class JournalState:
         #: the highest coordinator fencing epoch the journal has seen —
         #: a new incarnation claims ``coordinator_epoch + 1``
         self.coordinator_epoch = 0
+        #: hosts currently on gray-failure probation (``probation``
+        #: records with ``on`` toggling membership): placement must not
+        #: route NEW users to them, so the set is part of replayed state
+        self.probation: set = set()
         self._enqueue_seq: dict[str, int] = {}
         self._admit_seq: dict[str, int] = {}
         self._seq = 0
@@ -167,6 +179,7 @@ class JournalState:
         if event not in EVENTS and event not in HOST_EVENTS \
                 and event not in PLANNER_EVENTS \
                 and event not in REMEDY_EVENTS \
+                and event not in PROBATION_EVENTS \
                 and event not in EPOCH_EVENTS:
             return  # foreign/corrupt line: disposition unchanged
         seq = rec.get("seq")
@@ -194,6 +207,16 @@ class JournalState:
             # drain), no disposition, no routing.  The seq/cursor fold
             # above is all replay needs; the actions the decision drove
             # re-derive from the ack-gated records that follow it.
+            return
+        if event in PROBATION_EVENTS:
+            # routing state, NOT membership: the host stays live and
+            # joined, but placement must not hand it NEW users until a
+            # lift record (``on: false``) clears it
+            if isinstance(host, str):
+                if rec.get("on") is False:
+                    self.probation.discard(host)
+                else:
+                    self.probation.add(host)
             return
         if event in HOST_EVENTS:
             if isinstance(host, str):
@@ -340,6 +363,7 @@ class JournalState:
                 "planner_sketch": self.planner_sketch,
                 "pool_obs": list(self.pool_obs),
                 "coordinator_epoch": self.coordinator_epoch,
+                "probation": sorted(self.probation),
                 "enqueue_seq": dict(self._enqueue_seq),
                 "admit_seq": dict(self._admit_seq)}
 
@@ -364,6 +388,7 @@ class JournalState:
         st.planner_sketch = sketch if isinstance(sketch, dict) else None
         st.pool_obs = [int(p) for p in d.get("pool_obs", [])]
         st.coordinator_epoch = int(d.get("coordinator_epoch", 0))
+        st.probation = {str(h) for h in d.get("probation", [])}
         st._enqueue_seq = {k: int(v)
                            for k, v in d.get("enqueue_seq", {}).items()}
         st._admit_seq = {k: int(v)
@@ -469,6 +494,10 @@ def validate_journal_file(path: str) -> list[str]:
             if not isinstance(rec.get("host"), str) \
                     or not isinstance(rec.get("action"), str):
                 errors.append(f"{path}:{i}: {ev!r} lacks host/action")
+        elif ev in PROBATION_EVENTS:
+            if not isinstance(rec.get("host"), str) \
+                    or not isinstance(rec.get("on"), bool):
+                errors.append(f"{path}:{i}: {ev!r} lacks host/on")
         elif ev in PLANNER_EVENTS:
             if not isinstance(rec.get("edges"), list):
                 errors.append(f"{path}:{i}: {ev!r} lacks edges")
@@ -637,6 +666,13 @@ class JsonlTail:
             self._f.seek(self.offset)
 
     def poll(self) -> list:
+        # the lagging-tail gray seam: ``serve.feed.poll:stall=S`` holds
+        # the reader here (a worker whose assignment feed falls behind,
+        # a coordinator whose transcription lags), ``slow=F`` stretches
+        # the read below — peers keep polling on time, so the victim's
+        # ack/append ages skew against the fleet
+        faults.fire("serve.feed.poll", path=self.path)
+        t0 = time.perf_counter()
         if self._f is None:
             if not os.path.exists(self.path):
                 return []
@@ -663,6 +699,7 @@ class JsonlTail:
                 continue
             if isinstance(rec, dict) and not dio.is_header(rec):
                 out.append((rec, self.offset))
+        faults.slow_hold("serve.feed.poll", time.perf_counter() - t0)
         return out
 
     def close(self) -> None:
@@ -743,6 +780,11 @@ class AdmissionJournal:
                     or not isinstance(fields.get("action"), str):
                 raise ValueError(
                     f"journal event {event!r} needs host= and action=")
+        elif event in PROBATION_EVENTS:
+            if not isinstance(fields.get("host"), str) \
+                    or not isinstance(fields.get("on"), bool):
+                raise ValueError(
+                    f"journal event {event!r} needs host= and on=")
         elif event in PLANNER_EVENTS:
             if not isinstance(fields.get("edges"), list):
                 raise ValueError(f"journal event {event!r} needs edges=")

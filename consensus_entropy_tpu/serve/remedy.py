@@ -89,6 +89,72 @@ def fence_expired(fenced_t: float | None, now: float, *,
         and now - fenced_t >= deadline_s
 
 
+#: the gray-failure escalation ladder, in rung order.  ``suspect`` is
+#: the detector's edge (an active ``gray_suspect`` alert); ``probation``
+#: stops routing NEW users to the host (journaled — replay-deterministic);
+#: ``drain`` moves its existing users off over the drain-for-rebalance
+#: machinery; the deadline-fenced EVICT beyond it is not a rung of its
+#: own — it is the existing fence-deadline fallback firing on the
+#: drain's fences.
+GRAY_RUNGS = ("healthy", "suspect", "probation", "drain")
+
+#: how long a gray_suspect alert must hold continuously before the host
+#: goes on probation (longer than the skew hold: probation is a routing
+#: change, and gray signals are noisier than replayed load counts)
+DEFAULT_GRAY_HOLD_S = 2.0
+#: how much LONGER the alert must keep holding (after probation) before
+#: the ladder escalates to draining the host's existing users
+DEFAULT_GRAY_DRAIN_S = 4.0
+#: how long a probation host must stay CLEAN (no gray_suspect alert)
+#: before probation lifts — the down-ladder hysteresis, so a host that
+#: oscillates around the gate doesn't flap in and out of rotation
+DEFAULT_GRAY_CLEAR_S = 4.0
+#: how long a probation host's slo_headroom burn must hold before the
+#: coordinator degrades it to cheap-stage committee scoring
+DEFAULT_DEPTH_HOLD_S = 2.0
+
+
+def gray_rung(held_since: float | None, now: float, *, hold_s: float,
+              drain_s: float) -> str:
+    """Map CONTINUOUS gray-suspect evidence age onto the ladder rung the
+    host has earned (see :data:`GRAY_RUNGS`).  ``held_since`` is the
+    injected-clock time the pump first saw the host's gray_suspect alert
+    (``None`` = not currently suspect).  Each rung is gated on SUSTAINED
+    evidence — the same hysteresis shape as :func:`remedy_due`, stacked:
+    suspect immediately, probation after ``hold_s``, drain after
+    ``hold_s + drain_s`` more of the same."""
+    if held_since is None:
+        return "healthy"
+    held = now - held_since
+    if held >= hold_s + drain_s:
+        return "drain"
+    if held >= hold_s:
+        return "probation"
+    return "suspect"
+
+
+def probation_clear(clean_since: float | None, now: float, *,
+                    clear_s: float) -> bool:
+    """True once a probation host has been CLEAN (no active gray_suspect
+    alert) continuously for ``clear_s`` — the lift gate.  ``clean_since``
+    is the injected-clock time the pump last saw the host's alert clear
+    (``None`` = still suspect, never lifts)."""
+    return clean_since is not None and now - clean_since >= clear_s
+
+
+def degrade_depth(on_probation: bool, burn_held_s: float | None, *,
+                  hold_s: float) -> bool:
+    """True when a probation host should drop to cheap-stage committee
+    scoring: only ON probation (a healthy host under burn is a load
+    problem — the remedy plane's job, not depth's) and only after its
+    ``slo_headroom`` burn has held continuously for ``hold_s``
+    (``burn_held_s`` = seconds the burn alert has held; ``None`` = not
+    burning).  The restore edge is the complement: not on probation, or
+    burn cleared."""
+    return bool(on_probation) and burn_held_s is not None \
+        and burn_held_s >= hold_s
+
+
 def pick_shed(queued: list, in_flight: list, count: int, *,
               migrate_inflight: bool = True) -> tuple[list, list]:
     """Split an overloaded host's shed set into ``(drops, fences)``.

@@ -842,6 +842,17 @@ class FleetServer:
             self.router.update(new)
         self.report.event("fleet_edges", edges=list(new))
 
+    def set_depth(self, depth: str) -> None:
+        """Dial committee scoring depth (the gray ladder's degradation
+        verb): ``"cheap"`` caps every session's committee at its
+        minimum viable size, ``"full"`` restores.  Delegates to the
+        scheduler, which applies the cap to live sessions and future
+        admissions alike; an unknown depth raises (the feed intake
+        swallows it — a malformed line never wedges a worker).  The
+        coordinator's ``depth_change`` event is the graded record; the
+        worker applies silently."""
+        self.scheduler.set_depth(depth)
+
     @property
     def draining(self) -> bool:
         return self._draining

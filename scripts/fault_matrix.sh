@@ -128,10 +128,12 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
   tests/test_slo.py tests/test_elastic.py tests/test_remedy.py \
   tests/test_acquire.py tests/test_obs.py tests/test_workload.py \
   tests/test_pool_mesh.py tests/test_durability.py \
+  tests/test_gray.py \
   -v -m faults -p no:cacheprovider "$@"
 scripts/elastic_check.sh
 scripts/remedy_check.sh
 scripts/soak_check.sh
 scripts/mesh_check.sh
 scripts/fsck_check.sh
+scripts/gray_check.sh
 echo "fault matrix passed"

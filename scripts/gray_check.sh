@@ -1,0 +1,224 @@
+#!/usr/bin/env bash
+# Gray-failure CI gate (ISSUE 20 tentpole; sits next to remedy_check.sh
+# and is run by scripts/fault_matrix.sh).
+#
+# LEG 1 — the ladder on a live gray host: a REAL 3-host fabric where
+# h0 runs with an injected ``serve.dispatch:stall=5@1x-1`` (the
+# slow-not-dead wedge: EVERY dispatch on h0 holds 5 s — values
+# untouched, the process alive and beating its lease).  The
+# peer-relative detector must fire ``gray_suspect`` with evidence, the
+# coordinator must journal PROBATION and escalate to ``gray_drain``,
+# and every migrated user must finish EXACTLY ONCE, bit-identical to
+# unfaulted sequential baselines — with h0 never retired from the
+# fleet shape.
+#
+# LEG 2 — kill at the rung transition: the coordinator is killed
+# (in-process InjectedKill) at ``fabric.gray`` — which fires BEFORE the
+# probation record journals, so the kill leaves no half-journaled rung.
+# The restarted coordinator claims a fresh fencing epoch and re-places
+# every previous-incarnation in-flight user at startup (failover
+# resume, old host excluded) — the users h0 was holding hostage are
+# FREED by the restart itself, a strictly stronger remediation than
+# re-deriving the rung (that replay determinism is pinned by the
+# tier-1 fake-fleet kill matrix in tests/test_gray.py).  The gate:
+# the hostages finish on healthy hosts, every user exactly once
+# across every host's results file, parity bit-identical.
+#
+# Extra args are NOT accepted: this is a pass/fail gate, not a bench.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'PY'
+import glob
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, ".")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+from tests.fabric_workload import (
+    make_cfg,
+    sequential_baselines,
+    sizes_arg,
+    user_specs,
+)
+
+from consensus_entropy_tpu.obs import export
+from consensus_entropy_tpu.obs.alerts import AlertWatcher
+from consensus_entropy_tpu.resilience import faults as faults_mod
+from consensus_entropy_tpu.resilience.faults import FaultRule, InjectedKill
+from consensus_entropy_tpu.serve import (
+    AdmissionJournal,
+    FabricConfig,
+    FabricCoordinator,
+    validate_journal_file,
+)
+from consensus_entropy_tpu.serve.hosts import fabric_paths
+
+cfg = make_cfg("mc", epochs=2)
+specs = user_specs(8, sizes=[30, 100])
+root = tempfile.mkdtemp(prefix="gray_check_")
+seq = sequential_baselines(root, cfg, specs)
+
+GRAY_FAULT = "serve.dispatch:stall=5@1x-1"
+
+
+class _Rec:
+    def __init__(self):
+        self.events = []
+
+    def event(self, kind, /, **kw):
+        self.events.append((kind, kw))
+
+
+def run_leg(slug, fcfg, *, inject_point=None):
+    """One coordinator run over real workers; h0 is the gray host
+    (every dispatch stalls, lease still beating).  Returns (summary or
+    None, killed, fabric_dir, alert recorder)."""
+    fdir = os.path.join(root, "fabric_" + slug)
+    ws = os.path.join(root, "ws_" + slug)
+    os.makedirs(fdir, exist_ok=True)
+    os.makedirs(ws, exist_ok=True)
+
+    def spawn(host_id, fdir=fdir, ws=ws):
+        log = open(fabric_paths(fdir, host_id)["log"], "ab")
+        env = {**os.environ, "PYTHONPATH": "."}
+        if host_id == "h0":
+            env["CETPU_FAULTS"] = GRAY_FAULT
+        try:
+            return subprocess.Popen(
+                [sys.executable, "tests/fabric_worker.py", fdir,
+                 host_id, ws, cfg.mode, str(cfg.epochs), str(len(specs)),
+                 "5.0", "2", sizes_arg(specs)],
+                stdout=log, stderr=subprocess.STDOUT, env=env)
+        finally:
+            log.close()
+
+    jp = os.path.join(fdir, "serve_journal.jsonl")
+    journal = AdmissionJournal(jp)
+    rep = _Rec()
+    killed = False
+    summary = None
+    try:
+        if inject_point is None:
+            summary = FabricCoordinator(journal, fdir, fcfg,
+                                        alerts=AlertWatcher(rep)).run(
+                [u for _, u, _ in specs], spawn,
+                pools={u: n for _, u, n in specs})
+        else:
+            try:
+                with faults_mod.inject(FaultRule(inject_point, "kill",
+                                                 at=1)):
+                    FabricCoordinator(journal, fdir, fcfg,
+                                      alerts=AlertWatcher(rep)).run(
+                        [u for _, u, _ in specs], spawn,
+                        pools={u: n for _, u, n in specs})
+            except InjectedKill:
+                killed = True
+    finally:
+        journal.close()
+    return summary, killed, fdir, rep
+
+
+def check_parity_and_owners(fdir, label):
+    """Schema-validate every journal/WAL, then the EXACTLY-ONE-OWNER +
+    parity gate: each user has exactly one result row across every
+    host's results file, bit-identical to sequential."""
+    jp = os.path.join(fdir, "serve_journal.jsonl")
+    bad = validate_journal_file(jp)
+    for wal in sorted(glob.glob(os.path.join(fdir, "events_*.jsonl"))):
+        bad += validate_journal_file(wal)
+    assert bad == [], "journal violations:\n" + "\n".join(bad[:10])
+    rows = {}
+    for fname in sorted(os.listdir(fdir)):
+        if fname.startswith("results_") and fname.endswith(".jsonl"):
+            for rec in export.read_jsonl_tolerant(
+                    os.path.join(fdir, fname)):
+                rows.setdefault(rec["user"], []).append(rec)
+    for _, uid, _ in specs:
+        assert len(rows[uid]) == 1, (label, uid, rows.get(uid))
+        assert rows[uid][0]["error"] is None, (label, uid)
+        assert rows[uid][0]["result"]["trajectory"] \
+            == seq[uid]["trajectory"], (label, uid)
+
+
+def journal_events(fdir, event):
+    out = []
+    for rec in export.read_jsonl_tolerant(
+            os.path.join(fdir, "serve_journal.jsonl")):
+        if rec.get("event") == event:
+            out.append(rec)
+    return out
+
+
+# the ladder knobs: an absolute floor ABOVE the lease beat cadence and
+# normal CPU step walls (so only the 5 s stall qualifies) but inside
+# the stall window, short sustained-evidence gates so the drill
+# escalates inside the wedge, and a clear gate long enough that nothing
+# lifts mid-run (the recovery path is tier-1's fake-fleet drill)
+fcfg = FabricConfig(hosts=3, min_hosts=3, max_hosts=3, placement="load",
+                    gray=True, gray_ratio=3.0, gray_min_s=2.0,
+                    gray_hold_s=0.5, gray_drain_s=1.0,
+                    gray_clear_s=600.0)
+
+# ---- LEG 1: the full ladder on a live stalled host --------------------
+summary1, _, fdir1, rep1 = run_leg("ladder", fcfg)
+assert sorted(summary1["finished"]) == sorted(u for _, u, _ in specs)
+assert summary1["probations"] >= 1, summary1
+assert summary1["gray_drains"] >= 1, summary1
+assert summary1["migrations"] >= 1, summary1
+assert summary1["drains"] == 0 and summary1["revocations"] == 0, summary1
+gray_alerts = [kw for k, kw in rep1.events
+               if k == "alert" and kw.get("kind") == "gray_suspect"]
+# the ALERT stream is advisory and edge-triggered: under CPU
+# contention a busy peer mid-step can transiently look quiet, and the
+# hysteresis ladder is what filters that — so the gate pins the
+# STALLED host's evidence, not the absence of peer noise
+h0_alerts = [a for a in gray_alerts if a["host"] == "h0"]
+assert h0_alerts, "gray_suspect never fired for the stalled host"
+assert any(a["signals"] for a in h0_alerts), h0_alerts
+probs1 = [(r["host"], r["on"]) for r in journal_events(fdir1,
+                                                       "probation")]
+assert ("h0", True) in probs1, probs1
+assert any(r["action"] == "gray_drain" and r["host"] == "h0"
+           for r in journal_events(fdir1, "remedy"))
+st1 = AdmissionJournal(os.path.join(fdir1, "serve_journal.jsonl")).state
+assert sorted(st1.fleet_hosts()) == ["h0", "h1", "h2"]  # never retired
+assert "h0" in st1.probation, st1.probation
+check_parity_and_owners(fdir1, "ladder")
+print(f"gray_check: ladder climbed suspect->probation->drain on the "
+      f"stalled host (probations={summary1['probations']}, "
+      f"gray_drains={summary1['gray_drains']}, "
+      f"migrations={summary1['migrations']}), host kept, parity exact")
+
+# ---- LEG 2: coordinator killed at the rung transition -----------------
+_, killed, fdir2, _ = run_leg("kill", fcfg, inject_point="fabric.gray")
+assert killed, "fabric.gray never fired (no gray evidence developed?)"
+# fired-before-append: the killed rung decision never journaled
+assert journal_events(fdir2, "probation") == [], \
+    journal_events(fdir2, "probation")
+pend2 = AdmissionJournal(
+    os.path.join(fdir2, "serve_journal.jsonl")).state.pending
+last_host = {r["user"]: r["host"]
+             for r in journal_events(fdir2, "assign")}
+hostages = sorted(u for u in pend2 if last_host.get(u) == "h0")
+assert hostages, "the kill left nothing pending on the stalled host?"
+summary2, _, _, _ = run_leg("kill", fcfg)
+st2 = AdmissionJournal(os.path.join(fdir2, "serve_journal.jsonl")).state
+assert st2.finished == {u for _, u, _ in specs} and not st2.pending
+# the restart freed the hostages: each finished off the wedged host
+fin = {r["user"]: r["host"]
+       for r in journal_events(fdir2, "finish") if r.get("host")}
+for u in hostages:
+    assert fin.get(u) != "h0", (u, fin.get(u))
+check_parity_and_owners(fdir2, "kill")
+print(f"gray_check: kill@fabric.gray replayed clean — {len(specs)} "
+      f"users finished exactly once, the restart freed "
+      f"{len(hostages)} hostage(s) off the wedged host, parity exact")
+PY
+echo "gray check passed"

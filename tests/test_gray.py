@@ -1,0 +1,649 @@
+"""Gray-failure resilience (PR 20): the stall/slow fault grammar, the
+peer-relative slowness detector, and the journaled suspicion →
+probation → drain escalation ladder.
+
+Tier-1 keeps the pure kernels with threshold tables (``_gray_outliers``
+/ ``gray_suspect_alerts`` evidence merge, the ``gray_rung`` /
+``probation_clear`` / ``degrade_depth`` ladder gates), the grown
+``CETPU_FAULTS`` grammar (``stall=`` / ``slow=`` with clean parse
+errors) and its action semantics (a stall holds the hit, a slow factor
+is armed by ``fire`` and honored by the site's ``slow_hold`` bracket),
+the REPLAYED ``probation`` journal category (fold, compaction
+round-trip, append/validate rows), the config validation table, the
+committee depth dial (CNN seats kept first, ``min_members`` floor), the
+``cetpu-top`` staleness cue and the ``deadline-discipline`` lint rule —
+plus the DETERMINISTIC fake-worker drills: a slow-not-dead host climbs
+the full ladder (suspect alert with evidence → journaled probation →
+gray_drain moves every unresolved user over the ack-gated protocol), a
+recovered host earns its lift, and a coordinator SIGKILL at each new
+fault point (``fabric.gray``, the gray ``fabric.remedy`` decision,
+``serve.feed.poll``) replays from the journal to the SAME rung with
+exactly one owner per user.  The real-subprocess acceptance drill is
+``scripts/gray_check.sh`` (fault-matrix tier)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from consensus_entropy_tpu.obs.alerts import (
+    AlertWatcher,
+    _gray_outliers,
+    gray_suspect_alerts,
+)
+from consensus_entropy_tpu.resilience import faults
+from consensus_entropy_tpu.resilience.faults import FaultRule, InjectedKill
+from consensus_entropy_tpu.serve import (
+    AdmissionJournal,
+    FabricConfig,
+    FabricCoordinator,
+    FleetServer,
+    degrade_depth,
+    gray_rung,
+    probation_clear,
+    validate_journal_file,
+)
+from consensus_entropy_tpu.serve.journal import JournalState, JsonlTail
+from tests.test_elastic import _FakeWorker
+from tests.test_remedy import _Rec, _journal_records, _work
+
+pytestmark = [pytest.mark.serve, pytest.mark.faults]
+
+
+# -- the grown CETPU_FAULTS grammar: stall= / slow= ------------------------
+
+
+def test_parse_spec_gray_actions():
+    r, = faults.parse_spec("serve.dispatch:stall=2.5@1x-1")
+    assert (r.point, r.action, r.stall_s) == \
+        ("serve.dispatch", "stall", 2.5)
+    assert (r.at, r.times) == (1, -1)
+    r, = faults.parse_spec("serve.feed.poll:slow=3")
+    assert r.action == "slow" and r.slow_factor == 3.0
+    r, = faults.parse_spec("io.fsync:stall=inf")
+    assert r.stall_s == float("inf")
+    # bare stall/slow keep the rule-field defaults
+    r, = faults.parse_spec("io.fsync:stall")
+    assert r.stall_s == 1.0
+    r, = faults.parse_spec("io.fsync:slow")
+    assert r.slow_factor == 2.0
+
+
+def test_parse_spec_gray_errors():
+    with pytest.raises(ValueError, match="takes no '=value'"):
+        faults.parse_spec("io.fsync:kill=3")
+    with pytest.raises(ValueError, match="malformed float"):
+        faults.parse_spec("io.fsync:stall=abc")
+    with pytest.raises(ValueError, match="slow_factor"):
+        faults.parse_spec("io.fsync:slow=0.5")
+    with pytest.raises(ValueError, match="stall_s"):
+        faults.parse_spec("io.fsync:stall=-1")
+
+
+def test_stall_action_holds_the_hit():
+    with faults.inject(FaultRule("serve.feed.poll", "stall",
+                                 stall_s=0.05)) as inj:
+        t0 = time.perf_counter()
+        faults.fire("serve.feed.poll")
+        assert time.perf_counter() - t0 >= 0.05
+        assert inj.fired and inj.fired[0]["action"] == "stall"
+
+
+def test_slow_action_arms_fire_and_honors_slow_hold():
+    with faults.inject(FaultRule("serve.feed.poll", "slow",
+                                 slow_factor=3.0, times=-1)):
+        faults.fire("serve.feed.poll")  # arms this thread's factor
+        t0 = time.perf_counter()
+        faults.slow_hold("serve.feed.poll", 0.05)
+        assert time.perf_counter() - t0 >= 0.05 * (3.0 - 1.0) - 0.01
+        # the pending factor is CONSUMED: a hold without a new fire is
+        # free (the stickiness lives in the rule's hit window, re-armed
+        # per fire)
+        t0 = time.perf_counter()
+        faults.slow_hold("serve.feed.poll", 0.05)
+        assert time.perf_counter() - t0 < 0.04
+    # no injector installed: the module-level hook is a cheap no-op
+    faults.slow_hold("serve.feed.poll", 5.0)
+
+
+def test_feed_poll_fault_point_fires_in_jsonl_tail(tmp_path):
+    path = str(tmp_path / "feed.jsonl")
+    with open(path, "w") as f:
+        f.write('{"user": "u0"}\n')
+    tail = JsonlTail(path)
+    with faults.inject(FaultRule("serve.feed.poll", "kill", at=1)):
+        with pytest.raises(InjectedKill):
+            tail.poll()
+    # the lagging-tail arm: a slow rule brackets the poll (the read
+    # still completes and returns the records)
+    with faults.inject(FaultRule("serve.feed.poll", "slow",
+                                 slow_factor=2.0)) as inj:
+        assert [r for r, _ in tail.poll()] == [{"user": "u0"}]
+        assert inj.fired and inj.fired[0]["action"] == "slow"
+
+
+# -- the peer-relative detection kernels -----------------------------------
+
+
+def test_gray_outlier_kernel_threshold_table():
+    table = [
+        # one sick host against healthy peers
+        ({"h0": 9.0, "h1": 1.0, "h2": 1.2}, ["h0"]),
+        # exactly ratio * peer fires (>= gate; binary-exact values)
+        ({"h0": 3.75, "h1": 1.25, "h2": 1.25}, ["h0"]),
+        # just under the ratio gate
+        ({"h0": 3.74, "h1": 1.25, "h2": 1.25}, []),
+        # under the absolute floor: idle-fleet noise never flags
+        ({"h0": 0.9, "h1": 0.1, "h2": 0.1}, []),
+        # uniformly slow fleet is LOAD, not gray
+        ({"h0": 9.0, "h1": 9.0, "h2": 9.0}, []),
+        # fewer than two observed hosts: no peers, no outliers
+        ({"h0": 9.0}, []),
+        ({"h0": 9.0, "h1": None}, []),
+        # None = no observation, excluded from both sides
+        ({"h0": 9.0, "h1": None, "h2": 0.5}, ["h0"]),
+        # zero-valued peers: the absolute floor is the only gate left
+        ({"h0": 2.0, "h1": 0.0, "h2": 0.0}, ["h0"]),
+    ]
+    for values, want in table:
+        got = [h for h, _v, _p in _gray_outliers(values, ratio=3.0,
+                                                 min_abs_s=1.0)]
+        assert got == want, (values, got, want)
+
+
+def test_gray_suspect_alerts_merge_signals_with_evidence():
+    alerts = gray_suspect_alerts(
+        append_ages={"h0": 9.0, "h1": 0.5, "h2": 0.4},
+        ack_lags={"h0": 0.0, "h1": 0.0, "h2": 0.0},
+        lease_ages={"h0": 0.2, "h1": 0.2, "h2": 0.2},
+        step_walls={"h0": 6.0, "h1": 1.0, "h2": 1.0})
+    assert [a["host"] for a in alerts] == ["h0"]
+    a = alerts[0]
+    assert a["kind"] == "gray_suspect" and a["key"] == "h0"
+    # every firing signal listed, each with its value/peer evidence
+    assert a["signals"] == ["append_age", "step_wall"]
+    assert a["append_age_s"] == 9.0 and a["append_age_peer_s"] == 0.45
+    assert a["step_wall_s"] == 6.0 and a["step_wall_peer_s"] == 1.0
+    # no signals, no alerts; a healthy fleet is silent
+    assert gray_suspect_alerts() == []
+    assert gray_suspect_alerts(
+        step_walls={"h0": 1.0, "h1": 1.0, "h2": 1.1}) == []
+
+
+def test_gray_rung_ladder_table():
+    for held_since, want in [(None, "healthy"), (10.0, "suspect"),
+                             (8.5, "suspect"), (8.0, "probation"),
+                             (4.5, "probation"), (4.0, "drain"),
+                             (0.0, "drain")]:
+        got = gray_rung(held_since, 10.0, hold_s=2.0, drain_s=4.0)
+        assert got == want, (held_since, got, want)
+
+
+def test_probation_clear_and_degrade_depth_tables():
+    assert not probation_clear(None, 10.0, clear_s=4.0)   # still suspect
+    assert not probation_clear(7.0, 10.0, clear_s=4.0)    # not clean long enough
+    assert probation_clear(6.0, 10.0, clear_s=4.0)        # >= gate lifts
+    assert not degrade_depth(False, 99.0, hold_s=2.0)     # healthy host: load problem
+    assert not degrade_depth(True, None, hold_s=2.0)      # not burning
+    assert not degrade_depth(True, 1.9, hold_s=2.0)       # burn not sustained
+    assert degrade_depth(True, 2.0, hold_s=2.0)
+
+
+# -- the journaled (replayed) probation category ---------------------------
+
+
+def test_journal_probation_folds_and_replays(tmp_path):
+    jp = str(tmp_path / "j.jsonl")
+    j = AdmissionJournal(jp)
+    j.append("probation", host="h1", on=True)
+    j.append("probation", host="h2", on=True)
+    j.append("probation", host="h1", on=False)
+    assert j.state.probation == {"h2"}
+    j.close()
+    st = AdmissionJournal(jp).state
+    assert st.probation == {"h2"}
+    assert validate_journal_file(jp) == []
+    # the compaction checkpoint round-trips the set
+    assert JournalState.from_dict(st.to_dict()).probation == {"h2"}
+
+
+def test_journal_probation_survives_compaction_cycles(tmp_path):
+    jp = str(tmp_path / "j.jsonl")
+    j = AdmissionJournal(jp, compact_bytes=500)
+    for i in range(40):
+        j.append("probation", host=f"h{i % 3}", on=(i % 2 == 0))
+    assert j.compactions >= 1
+    want = j.state.probation
+    j.close()
+    assert AdmissionJournal(jp).state.probation == want == {"h2"}
+    assert validate_journal_file(jp) == []
+
+
+def test_journal_probation_append_and_validate_rows(tmp_path):
+    jp = str(tmp_path / "j.jsonl")
+    j = AdmissionJournal(jp)
+    with pytest.raises(ValueError, match="needs host= and on="):
+        j.append("probation", host="h1")
+    with pytest.raises(ValueError, match="needs host= and on="):
+        j.append("probation", on=True)
+    j.append("probation", host="h1", on=True)
+    # a hand-forged record missing on= is a validation finding
+    j._file.append({"event": "probation", "seq": j.state.seq + 1,
+                    "host": "h2"})
+    j.close()
+    errs = validate_journal_file(jp)
+    assert errs and any("probation" in e for e in errs)
+
+
+def test_fabric_config_gray_validation_table():
+    ok = FabricConfig(hosts=2, min_hosts=2, max_hosts=2, gray=True)
+    assert ok.gray and ok.elastic
+    with pytest.raises(ValueError, match="gray requires the elastic"):
+        FabricConfig(hosts=2, gray=True)
+    with pytest.raises(ValueError, match="gray_ratio"):
+        FabricConfig(hosts=2, min_hosts=2, max_hosts=2, gray=True,
+                     gray_ratio=0.5)
+    with pytest.raises(ValueError, match="gray_min_s"):
+        FabricConfig(hosts=2, min_hosts=2, max_hosts=2, gray=True,
+                     gray_min_s=-1.0)
+    with pytest.raises(ValueError, match="gray_hold_s/gray_drain_s"):
+        FabricConfig(hosts=2, min_hosts=2, max_hosts=2, gray=True,
+                     gray_hold_s=-1.0)
+    with pytest.raises(ValueError,
+                       match="depth_on_burn requires the gray"):
+        FabricConfig(hosts=2, min_hosts=2, max_hosts=2,
+                     depth_on_burn=True)
+    with pytest.raises(ValueError, match="depth_hold_s"):
+        FabricConfig(hosts=2, min_hosts=2, max_hosts=2, gray=True,
+                     depth_on_burn=True, depth_hold_s=-1.0)
+
+
+# -- the degradation dial: committee depth cap -----------------------------
+
+
+class _StubMember:
+    def __init__(self, name):
+        self.name = name
+
+
+def test_committee_depth_cap_keeps_cnn_seats_first():
+    from consensus_entropy_tpu.models.committee import Committee
+
+    c = Committee([_StubMember("a"), _StubMember("b")], [],
+                  min_members=1)
+    cnns = [_StubMember("c1"), _StubMember("c2")]
+    # duck-typed: _active_pair reads the member lists only, and real
+    # CNN members carry frontend-geometry configs the stub needn't
+    c.cnn_members = cnns
+    assert c.active_size == 4
+    c.depth_cap = 3
+    assert c.active_cnn_members == cnns  # the fast stage keeps its seats
+    assert [m.name for m in c.active_host_members] == ["a"]
+    # the dial is floored at min_members (never exhausts the committee)
+    c.depth_cap = 0
+    assert c.active_size == 1 and c.active_cnn_members == cnns[:1]
+    c.depth_cap = None  # restore is behavior-identical to the default
+    assert c.active_size == 4
+
+
+def test_scheduler_depth_dial_validates_and_applies():
+    from consensus_entropy_tpu.config import ALConfig
+    from consensus_entropy_tpu.fleet.scheduler import FleetScheduler
+
+    sched = FleetScheduler(ALConfig(queries=1, epochs=1, mode="mc",
+                                    seed=0))
+    assert sched.depth == "full"
+    with pytest.raises(ValueError, match="unknown depth"):
+        sched.set_depth("turbo")
+
+    class _C:
+        depth_cap = None
+        min_members = 2
+
+    c = _C()
+    sched.set_depth("cheap")
+    sched._apply_depth(c)
+    assert c.depth_cap == 2
+    sched.set_depth("full")
+    sched._apply_depth(c)
+    assert c.depth_cap is None
+
+
+def test_fleet_server_depth_delegates_to_scheduler():
+    class _Sched:
+        def __init__(self):
+            self.calls = []
+
+        def set_depth(self, depth):
+            self.calls.append(depth)
+
+    srv = FleetServer.__new__(FleetServer)
+    srv.scheduler = _Sched()
+    srv.set_depth("cheap")
+    assert srv.scheduler.calls == ["cheap"]
+
+
+# -- deterministic fake-fleet gray drills ----------------------------------
+
+
+class _GrayWorker(_FakeWorker):
+    """``_FakeWorker`` plus the step-wall advertisement: the real
+    worker's lease heartbeat carries the scheduler's dispatch-wall EMA
+    (``step_ema_s``) — the drill dials one host's EMA up to model a
+    slow-not-dead device, everything else stays journal/file-driven."""
+
+    def __init__(self, fabric_dir, host_id, step_ema_s=0.5):
+        self.step_ema_s = step_ema_s
+        super().__init__(fabric_dir, host_id)
+
+    def beat(self):
+        if self.dead:
+            return
+        tmp = self.paths["lease"] + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(json.dumps(
+                {"host": self.host_id, "pid": os.getpid(),
+                 "t": time.time(),
+                 "step_ema_s": self.step_ema_s}).encode())
+        os.replace(tmp, self.paths["lease"])
+
+
+def _gray_fleet(tmp_path, config, users, pools, script, *, workers=None,
+                alerts=None, slow=("h0",)):
+    """A 3-host fake fleet where hosts named in ``slow`` advertise a
+    gray step wall (9 s vs the 0.5 s fleet baseline).  ``workers`` may
+    be passed to keep a killed incarnation's hosts for exactly-once
+    accounting across reruns (the ``_remedy_fleet`` discipline)."""
+    fabric_dir = str(tmp_path / "fabric")
+    os.makedirs(fabric_dir, exist_ok=True)
+    journal = AdmissionJournal(
+        os.path.join(fabric_dir, "serve_journal.jsonl"))
+    workers = {} if workers is None else workers
+
+    def spawn(host_id):
+        workers[host_id] = _GrayWorker(
+            fabric_dir, host_id,
+            step_ema_s=9.0 if host_id in slow else 0.5)
+        return workers[host_id]
+
+    state = {"round": 0}
+
+    def on_poll(coord):
+        state["round"] += 1
+        if state["round"] > 2000:
+            raise AssertionError("gray drill wedged: "
+                                 f"unresolved={sorted(coord._unresolved)}")
+        for w in list(workers.values()):
+            w.pump()
+        script(state["round"], coord, workers)
+
+    coord = FabricCoordinator(journal, fabric_dir, config,
+                              on_poll=on_poll, alerts=alerts)
+    try:
+        summary = coord.run(users, spawn, pools=pools)
+    finally:
+        journal.close()
+    return summary, coord, workers, fabric_dir
+
+
+def _gray_cfg(**kw):
+    base = dict(hosts=3, min_hosts=3, max_hosts=3, poll_s=0.01,
+                drain_timeout_s=0.2, placement="load",
+                gray=True, gray_ratio=3.0, gray_min_s=1.0,
+                gray_hold_s=0.0, gray_drain_s=0.03, gray_clear_s=600.0)
+    base.update(kw)
+    return FabricConfig(**base)
+
+
+def test_gray_drill_climbs_to_probation_and_drain(tmp_path):
+    """The full ladder: h0's advertised step wall skews 18x over its
+    peers — the gray_suspect alert fires with step-wall evidence, the
+    coordinator journals PROBATION (one record, one counter tick), the
+    sustained suspicion escalates to gray_drain, and every one of h0's
+    users migrates over the ack-gated drop path to finish elsewhere.
+    h0 is never retired: probation + an empty assignment hold the line."""
+    users = [f"u{i}" for i in range(9)]
+    pools = {u: 30 for u in users}
+    rep = _Rec()
+
+    def script(rnd, coord, workers):
+        for hid, w in workers.items():
+            if hid == "h0":
+                continue  # gray: acks the control plane, admits nothing
+            _work(w)
+
+    summary, coord, workers, fabric_dir = _gray_fleet(
+        tmp_path, _gray_cfg(), users, pools, script,
+        alerts=AlertWatcher(rep))
+    assert sorted(summary["finished"]) == users
+    assert summary["probations"] == 1 and summary["gray_drains"] == 1
+    assert summary["depth_changes"] == 0  # dial default-off
+    # exactly one owner per user; the gray host ran none of them
+    ran = [u for w in workers.values() for u in w.finished]
+    assert sorted(ran) == users and not workers["h0"].finished
+    recs = _journal_records(fabric_dir)
+    probs = [(r["host"], r["on"]) for r in recs
+             if r["event"] == "probation"]
+    assert probs == [("h0", True)]
+    remedies = [(r["host"], r["action"]) for r in recs
+                if r["event"] == "remedy"]
+    assert remedies == [("h0", "gray_drain")]
+    # the alert carried its evidence: the step-wall value/peer pair
+    gray = [kw for k, kw in rep.events
+            if k == "alert" and kw["kind"] == "gray_suspect"]
+    assert gray and all(a["host"] == "h0" for a in gray)
+    assert "step_wall" in gray[0]["signals"]
+    assert gray[0]["step_wall_s"] >= 3.0 * gray[0]["step_wall_peer_s"]
+    jp = os.path.join(fabric_dir, "serve_journal.jsonl")
+    assert validate_journal_file(jp) == []
+    # the rung REPLAYS: probation is journal state, not coordinator RAM
+    st = AdmissionJournal(jp).state
+    assert st.probation == {"h0"}
+    assert AdmissionJournal(jp).state.probation == st.probation
+
+
+def test_gray_probation_lifts_after_recovery(tmp_path):
+    """The down-ladder: once h0's step wall returns to the fleet
+    baseline and stays clean past ``gray_clear_s``, probation lifts
+    (journaled ``on=False``), the host re-enters rotation and finishes
+    the users it kept — the ladder never drained them."""
+    users = [f"u{i}" for i in range(6)]
+    pools = {u: 30 for u in users}
+    cfg = _gray_cfg(gray_drain_s=600.0, gray_clear_s=0.02)
+    state = {"probed": False, "lifted": False}
+
+    def script(rnd, coord, workers):
+        st = coord.journal.state
+        if "h0" in st.probation and not state["probed"]:
+            state["probed"] = True
+            workers["h0"].step_ema_s = 0.5  # the slowness clears
+        if state["probed"] and not st.probation:
+            state["lifted"] = True
+        if state["lifted"]:
+            for w in workers.values():
+                _work(w)
+
+    summary, coord, workers, fabric_dir = _gray_fleet(
+        tmp_path, cfg, users, pools, script)
+    assert sorted(summary["finished"]) == users
+    assert summary["probations"] == 1 and summary["gray_drains"] == 0
+    recs = _journal_records(fabric_dir)
+    probs = [(r["host"], r["on"]) for r in recs
+             if r["event"] == "probation"]
+    assert probs == [("h0", True), ("h0", False)]
+    assert workers["h0"].finished  # back in rotation with its users
+    jp = os.path.join(fabric_dir, "serve_journal.jsonl")
+    assert AdmissionJournal(jp).state.probation == set()
+    assert validate_journal_file(jp) == []
+
+
+@pytest.mark.parametrize("point,at,probation_before", [
+    # killed at the rung transition, BEFORE the probation append: the
+    # decision never journaled, the rerun re-derives it from evidence
+    ("fabric.gray", 1, []),
+    # killed at the gray_drain decision: probation is already durable,
+    # the drain record is not — the rerun resumes ON the same rung
+    ("fabric.remedy", 1, [("h0", True)]),
+    # killed mid-feed-read (the lagging-tail seam): no decision state
+    # is tied to a poll, so the rerun just replays the journal
+    ("serve.feed.poll", 5, None),
+])
+def test_gray_kill_matrix_replays_to_same_rung(tmp_path, point, at,
+                                               probation_before):
+    users = [f"u{i}" for i in range(9)]
+    pools = {u: 30 for u in users}
+    cfg = _gray_cfg()
+
+    def script1(rnd, coord, workers):
+        for hid, w in workers.items():
+            if hid != "h0":
+                _work(w)
+
+    jp = str(tmp_path / "fabric" / "serve_journal.jsonl")
+    w1 = {}
+    with faults.inject(FaultRule(point, "kill", at=at)):
+        with pytest.raises(InjectedKill):
+            _gray_fleet(tmp_path, cfg, users, pools, script1,
+                        workers=w1)
+    recs_mid = _journal_records(str(tmp_path / "fabric"))
+    probs_mid = [(r["host"], r["on"]) for r in recs_mid
+                 if r["event"] == "probation"]
+    if probation_before is not None:
+        # fired-before-append: the killed decision left no half-record
+        assert probs_mid == probation_before
+        assert [r for r in recs_mid if r["event"] == "remedy"] == []
+    replayed = {h for h, on in probs_mid if on}
+    done1 = set(AdmissionJournal(jp).state.finished)
+    state = {"checked": False}
+
+    def script2(rnd, coord, workers):
+        if not state["checked"]:
+            state["checked"] = True
+            # replay-to-same-rung: the fresh coordinator starts from
+            # the journaled probation set, not from scratch
+            assert coord.journal.state.probation == replayed
+        for w in workers.values():
+            if w.dead:
+                continue
+            # stale feed lines re-deliver users the first incarnation
+            # already finished; they resolve from their complete
+            # workspaces, modeled by dropping them without running
+            for uid in list(w.queued):
+                if uid in done1:
+                    w.queued.remove(uid)
+            _work(w)
+
+    w2 = {}
+    summary, coord, workers, fabric_dir = _gray_fleet(
+        tmp_path, cfg, users, pools, script2, workers=w2, slow=())
+    assert sorted(list(done1) + summary["finished"]) == users
+    # exactly one owner per user ACROSS BOTH incarnations
+    ran = [u for w in list(w1.values()) + list(w2.values())
+           for u in w.finished]
+    assert sorted(ran) == users
+    assert validate_journal_file(jp) == []
+    # h0 healthy in the rerun: no NEW probation was ever derived, and
+    # a rung journaled before the kill is still the replayed state
+    # (clear_s is huge, so nothing lifted mid-run)
+    assert AdmissionJournal(jp).state.probation == replayed
+
+
+# -- the cetpu-top staleness cue -------------------------------------------
+
+
+def test_top_flags_and_dims_stale_snapshots():
+    from consensus_entropy_tpu.cli.top import (
+        STALE_INTERVALS,
+        _stale_bound,
+        render,
+    )
+
+    now = 1000.0
+    fresh = {"host": "w0", "t": now - 1.0, "interval_s": 1.0,
+             "live": 1, "target_live": 2}
+    stale = {"host": "w1", "t": now - 4.0, "interval_s": 1.0,
+             "live": 1, "target_live": 2}
+    out = render({"w0": fresh, "w1": stale}, now=now)
+    lines = out.splitlines()
+    w0 = next(ln for ln in lines if "[w0]" in ln)
+    w1 = next(ln for ln in lines if "[w1]" in ln)
+    assert "STALE" not in w0 and "\x1b[2m" not in w0
+    assert "STALE" in w1 and w1.startswith("\x1b[2m")  # dimmed, with age
+    assert "4.0s" in w1
+    # the bound is the WRITER'S OWN cadence when advertised; --stale-s
+    # is the fallback for pre-interval snapshots
+    assert _stale_bound({"interval_s": 2.0}, 10.0) == \
+        2.0 * STALE_INTERVALS
+    assert _stale_bound({}, 10.0) == 10.0
+    assert _stale_bound({"interval_s": 0}, 10.0) == 10.0
+    old_no_interval = {"host": "w2", "t": now - 4.0, "live": 1,
+                       "target_live": 2}
+    out = render({"w2": old_no_interval}, now=now, stale_s=10.0)
+    assert "STALE" not in out  # fallback bound, not yet stale
+
+
+def test_status_writer_stamps_its_cadence(tmp_path):
+    from consensus_entropy_tpu.obs.status import StatusWriter, read_status
+
+    w = StatusWriter(str(tmp_path), "w0", interval_s=2.5,
+                     clock=lambda: 7.0)
+    w.write({"live": 1})
+    snap = read_status(w.path)
+    assert snap["interval_s"] == 2.5 and snap["t"] == 7.0
+
+
+# -- the deadline-discipline lint rule -------------------------------------
+
+
+def test_deadline_discipline_flags_unbounded_waits():
+    from tests.test_lint import REPLAY_FILE, rules_fired
+
+    sel = ["deadline-discipline"]
+    assert rules_fired("""
+        def close(worker):
+            worker.thread.join()
+    """, REPLAY_FILE, select=sel) == ["deadline-discipline"]
+    assert rules_fired("""
+        def close(worker):
+            worker.thread.join(timeout=2.0)
+    """, REPLAY_FILE, select=sel) == []
+    assert rules_fired("""
+        import time
+
+        def watch(path):
+            while True:
+                time.sleep(0.1)
+    """, REPLAY_FILE, select=sel) == ["deadline-discipline"]
+
+
+def test_deadline_discipline_allows_bounded_loops():
+    from tests.test_lint import PKG_FILE, REPLAY_FILE, rules_fired
+
+    sel = ["deadline-discipline"]
+    # a deadline read through the injected clock seam bounds the loop
+    assert rules_fired("""
+        import time
+
+        class W:
+            def watch(self, deadline):
+                while True:
+                    if self._clock() > deadline:
+                        break
+                    time.sleep(0.1)
+    """, REPLAY_FILE, select=sel) == []
+    # a real exit condition is bounded by construction
+    assert rules_fired("""
+        import time
+
+        def drain(q):
+            while q:
+                q.pop()
+                time.sleep(0.01)
+    """, REPLAY_FILE, select=sel) == []
+    # scoped to serve/: the same bare join elsewhere is not this
+    # plane's contract
+    assert rules_fired("""
+        def close(worker):
+            worker.thread.join()
+    """, PKG_FILE, select=sel) == []
